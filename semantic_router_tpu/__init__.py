@@ -1,0 +1,33 @@
+"""semantic_router_tpu — a TPU-native intelligent LLM routing framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capability set of
+vllm-project/semantic-router (reference mounted at /root/reference): per-request
+signal extraction (~18 signal families, many backed by BERT-family classifiers
+running on TPU), a boolean decision engine, ~13 model-selection algorithms,
+pre/post plugin chains (semantic cache, prompt compression, RAG, hallucination
+detection, memory), and an OpenAI/Anthropic-shaped data plane.
+
+Architecture (TPU-first, not a port):
+
+- ``models/``   Flax encoder/embedding modules (ModernBERT/mmBERT-32K, BERT,
+                Qwen3, Gemma) with classification heads and stacked-LoRA
+                multi-task adapters.
+- ``ops/``      JAX/Pallas compute primitives: chunked SDPA, sliding-window
+                flash attention, RoPE+YaRN, Matryoshka slicing, distances.
+- ``engine/``   The inference service: model registry, dynamic batching shim
+                (bucketed padding + max-wait), unified classifier, FFI-shaped
+                public surface mirroring the reference's C ABI semantics.
+- ``parallel/`` Mesh construction, classifier-bank sharding, multi-chip
+                training step.
+- ``signals/``  Signal extractors (heuristic in pure Python, learned via the
+                engine) and the concurrent dispatch fan-out.
+- ``decision/`` Boolean rule engine + projections.
+- ``selection/``Model-selection algorithm registry.
+- ``cache/``    Semantic cache backends (in-memory, HNSW ANN, hybrid).
+- ``router/``   The data plane: request/response pipeline, plugins, servers.
+
+Reference parity map lives in SURVEY.md §2; docstrings cite reference
+file:line for behaviours reproduced here.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,158 @@
+"""YAML config loading with env substitution and hot-reload support.
+
+Reference behaviours reproduced:
+- env substitution ``${VAR}`` / ``${VAR:-default}`` in YAML scalars
+  (pkg/config/env_substitution.go)
+- process-global atomic Replace/Get (cmd/main.go:24-36, config.Replace)
+- file-watch hot reload (pkg/extproc/server_config_watch.go) — here a
+  polling watcher thread invoking a swap callback.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+import yaml
+
+from .schema import RouterConfig
+
+_ENV_PATTERN = re.compile(r"\$\{([A-Za-z_][A-Za-z0-9_]*)(?::-([^}]*))?\}")
+
+
+def substitute_env(text: str, env: Optional[Dict[str, str]] = None) -> str:
+    """Replace ${VAR} and ${VAR:-default} occurrences in *text*."""
+    env = os.environ if env is None else env
+
+    def repl(m: "re.Match[str]") -> str:
+        var, default = m.group(1), m.group(2)
+        val = env.get(var)
+        if val is None or val == "":
+            return default if default is not None else ""
+        return val
+
+    return _ENV_PATTERN.sub(repl, text)
+
+
+def load_dict(path: str, env: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as f:
+        raw = f.read()
+    return yaml.safe_load(substitute_env(raw, env)) or {}
+
+
+def load_config(path: str, env: Optional[Dict[str, str]] = None,
+                validate: bool = True) -> RouterConfig:
+    cfg = RouterConfig.from_dict(load_dict(path, env))
+    if validate:
+        from .validator import validate_config
+
+        errors = validate_config(cfg)
+        fatal = [e for e in errors if e.fatal]
+        if fatal:
+            raise ConfigError(
+                "invalid config: " + "; ".join(str(e) for e in fatal)
+            )
+    return cfg
+
+
+def loads_config(text: str, env: Optional[Dict[str, str]] = None,
+                 validate: bool = True) -> RouterConfig:
+    data = yaml.safe_load(substitute_env(text, env)) or {}
+    cfg = RouterConfig.from_dict(data)
+    if validate:
+        from .validator import validate_config
+
+        errors = [e for e in validate_config(cfg) if e.fatal]
+        if errors:
+            raise ConfigError("invalid config: " + "; ".join(map(str, errors)))
+    return cfg
+
+
+class ConfigError(ValueError):
+    pass
+
+
+class _Global:
+    """Atomic process-global config slot (reference config.Replace/Get)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cfg: Optional[RouterConfig] = None
+
+    def replace(self, cfg: RouterConfig) -> None:
+        with self._lock:
+            self._cfg = cfg
+
+    def get(self) -> Optional[RouterConfig]:
+        with self._lock:
+            return self._cfg
+
+
+_global = _Global()
+replace = _global.replace
+get = _global.get
+
+
+class ConfigWatcher:
+    """Polling file watcher that reloads config and invokes a swap callback
+    when the file's mtime or content hash changes (reference:
+    server_config_watch.go + RouterService.Swap, server.go:213)."""
+
+    def __init__(self, path: str, on_reload: Callable[[RouterConfig], None],
+                 poll_interval_s: float = 2.0) -> None:
+        self.path = path
+        self.on_reload = on_reload
+        self.poll_interval_s = poll_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_mtime: float = self._mtime()
+
+    def _mtime(self) -> float:
+        try:
+            return os.stat(self.path).st_mtime
+        except OSError:
+            return 0.0
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="config-watcher")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def poll_once(self) -> bool:
+        """Check once; reload + callback if changed. Returns True on reload.
+        Reload/callback failures are swallowed (old config stays live —
+        fail-open, matching the reference's hot-reload semantics).
+        ``_last_mtime`` advances only after a successful reload so a
+        half-written file seen mid-write is retried on the next poll even
+        under coarse mtime granularity."""
+        mtime = self._mtime()
+        if mtime == self._last_mtime:
+            return False
+        try:
+            cfg = load_config(self.path)
+        except Exception:
+            return False
+        self._last_mtime = mtime
+        replace(cfg)
+        try:
+            self.on_reload(cfg)
+        except Exception:
+            # The global slot already holds the new config; a broken swap
+            # callback must not kill the watcher thread.
+            pass
+        return True
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.poll_once()
+            except Exception:
+                pass  # never let the watcher thread die

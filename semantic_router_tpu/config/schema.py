@@ -1,0 +1,1022 @@
+"""Typed configuration model for the router.
+
+Capability parity with the reference's ``pkg/config`` (RouterConfig,
+reference: src/semantic-router/pkg/config/config.go:60-100 and the signal
+taxonomy at config.go:25-43) re-designed as Python dataclasses. The YAML
+surface mirrors the reference's ``config/config.yaml`` layout (``routing:``
+with ``modelCards``/``signals``/``projections``/``decisions``) so existing
+configs translate mechanically.
+
+Only the hot, structurally-validated parts get dedicated dataclasses
+(signals, projections, decisions, model refs); long-tail plugin payloads
+stay as open dicts validated by their consumers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+# --------------------------------------------------------------------------
+# Signal taxonomy (reference: pkg/config/config.go:25-43)
+# --------------------------------------------------------------------------
+
+SIGNAL_KEYWORD = "keyword"
+SIGNAL_EMBEDDING = "embedding"
+SIGNAL_DOMAIN = "domain"
+SIGNAL_FACT_CHECK = "fact_check"
+SIGNAL_USER_FEEDBACK = "user_feedback"
+SIGNAL_REASK = "reask"
+SIGNAL_PREFERENCE = "preference"
+SIGNAL_LANGUAGE = "language"
+SIGNAL_CONTEXT = "context"
+SIGNAL_STRUCTURE = "structure"
+SIGNAL_COMPLEXITY = "complexity"
+SIGNAL_MODALITY = "modality"
+SIGNAL_AUTHZ = "authz"
+SIGNAL_JAILBREAK = "jailbreak"
+SIGNAL_PII = "pii"
+SIGNAL_KB = "kb"
+SIGNAL_CONVERSATION = "conversation"
+SIGNAL_EVENT = "event"
+SIGNAL_PROJECTION = "projection"
+
+ALL_SIGNAL_TYPES = (
+    SIGNAL_KEYWORD,
+    SIGNAL_EMBEDDING,
+    SIGNAL_DOMAIN,
+    SIGNAL_FACT_CHECK,
+    SIGNAL_USER_FEEDBACK,
+    SIGNAL_REASK,
+    SIGNAL_PREFERENCE,
+    SIGNAL_LANGUAGE,
+    SIGNAL_CONTEXT,
+    SIGNAL_STRUCTURE,
+    SIGNAL_COMPLEXITY,
+    SIGNAL_MODALITY,
+    SIGNAL_AUTHZ,
+    SIGNAL_JAILBREAK,
+    SIGNAL_PII,
+    SIGNAL_KB,
+    SIGNAL_CONVERSATION,
+    SIGNAL_EVENT,
+    SIGNAL_PROJECTION,
+)
+
+
+def _take(d: Dict[str, Any], *names: str, default: Any = None) -> Any:
+    for n in names:
+        if n in d:
+            return d[n]
+    return default
+
+
+# --------------------------------------------------------------------------
+# Signal rule configs
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class KeywordRule:
+    """Keyword signal rule (methods: exact substring, regex, fuzzy, bm25, ngram).
+
+    Reference: routing.signals.keywords entries (config/config.yaml:135-160);
+    scorer implementations in nlp-binding/src/{bm25,ngram}_classifier.rs and
+    pkg/classification/keyword_classifier.go.
+    """
+
+    name: str
+    keywords: List[str] = field(default_factory=list)
+    operator: str = "OR"  # OR | AND
+    method: str = "exact"  # exact | regex | fuzzy | bm25 | ngram
+    case_sensitive: bool = False
+    fuzzy_match: bool = False
+    fuzzy_threshold: float = 80.0  # 0-100 similarity percent
+    bm25_threshold: float = 0.1
+    ngram_threshold: float = 0.4
+    ngram_arity: int = 3
+    description: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "KeywordRule":
+        return cls(
+            name=d["name"],
+            keywords=list(d.get("keywords", [])),
+            operator=str(d.get("operator", "OR")).upper(),
+            method=d.get("method", "fuzzy" if d.get("fuzzy_match") else "exact"),
+            case_sensitive=bool(d.get("case_sensitive", False)),
+            fuzzy_match=bool(d.get("fuzzy_match", False)),
+            fuzzy_threshold=float(d.get("fuzzy_threshold", 80.0)),
+            bm25_threshold=float(d.get("bm25_threshold", 0.1)),
+            ngram_threshold=float(d.get("ngram_threshold", 0.4)),
+            ngram_arity=int(d.get("ngram_arity", 3)),
+            description=d.get("description", ""),
+        )
+
+
+@dataclass
+class EmbeddingRule:
+    """Embedding-similarity signal rule (config/config.yaml:162-190)."""
+
+    name: str
+    candidates: List[str] = field(default_factory=list)
+    threshold: float = 0.75
+    aggregation_method: str = "max"  # max | any | mean
+    query_modality: str = "text"  # text | image | audio
+    description: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "EmbeddingRule":
+        return cls(
+            name=d["name"],
+            candidates=list(d.get("candidates", [])),
+            threshold=float(d.get("threshold", 0.75)),
+            aggregation_method=d.get("aggregation_method", "max"),
+            query_modality=d.get("query_modality", "text"),
+            description=d.get("description", ""),
+        )
+
+
+@dataclass
+class ModelScore:
+    model: str
+    score: float = 0.0
+    use_reasoning: bool = False
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ModelScore":
+        return cls(
+            model=d["model"],
+            score=float(d.get("score", 0.0)),
+            use_reasoning=bool(d.get("use_reasoning", False)),
+        )
+
+
+@dataclass
+class DomainRule:
+    """Domain/intent category (config/config.yaml:192-215; the learned
+    category classifier maps prompts onto these)."""
+
+    name: str
+    description: str = ""
+    mmlu_categories: List[str] = field(default_factory=list)
+    model_scores: List[ModelScore] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "DomainRule":
+        return cls(
+            name=d["name"],
+            description=d.get("description", ""),
+            mmlu_categories=list(d.get("mmlu_categories", [])),
+            model_scores=[ModelScore.from_dict(m) for m in d.get("model_scores", [])],
+        )
+
+
+@dataclass
+class NamedRule:
+    """Generic named signal class (fact_check, user_feedback, modality, ...)."""
+
+    name: str
+    description: str = ""
+    threshold: float = 0.0
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "NamedRule":
+        known = {"name", "description", "threshold"}
+        return cls(
+            name=d["name"],
+            description=d.get("description", ""),
+            threshold=float(d.get("threshold", 0.0)),
+            extra={k: v for k, v in d.items() if k not in known},
+        )
+
+
+@dataclass
+class ReaskRule:
+    """History-aware dissatisfaction detection (repeated user turns)."""
+
+    name: str
+    threshold: float = 0.8
+    lookback_turns: int = 1
+    description: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ReaskRule":
+        return cls(
+            name=d["name"],
+            threshold=float(d.get("threshold", 0.8)),
+            lookback_turns=int(d.get("lookback_turns", 1)),
+            description=d.get("description", ""),
+        )
+
+
+@dataclass
+class PreferenceRule:
+    name: str
+    examples: List[str] = field(default_factory=list)
+    threshold: float = 0.7
+    description: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PreferenceRule":
+        return cls(
+            name=d["name"],
+            examples=list(d.get("examples", [])),
+            threshold=float(d.get("threshold", 0.7)),
+            description=d.get("description", ""),
+        )
+
+
+_TOKEN_SUFFIX = {"k": 1024, "m": 1024 * 1024}
+
+
+def parse_token_count(v: Any) -> int:
+    """Parse '32K' / '256K' / plain ints into token counts."""
+    if v is None:
+        return 0
+    if isinstance(v, (int, float)):
+        return int(v)
+    s = str(v).strip().lower()
+    if not s:
+        return 0
+    if s[-1] in _TOKEN_SUFFIX:
+        return int(float(s[:-1]) * _TOKEN_SUFFIX[s[-1]])
+    return int(float(s))
+
+
+@dataclass
+class ContextRule:
+    """Token-length band rule (config/config.yaml:260-264)."""
+
+    name: str
+    min_tokens: int = 0
+    max_tokens: int = 0  # 0 = unbounded
+    description: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ContextRule":
+        return cls(
+            name=d["name"],
+            min_tokens=parse_token_count(d.get("min_tokens")),
+            max_tokens=parse_token_count(d.get("max_tokens")),
+            description=d.get("description", ""),
+        )
+
+
+@dataclass
+class FeatureSource:
+    """Where a structure/conversation feature is computed from."""
+
+    type: str = "regex"  # regex | keyword_set | sequence | message | tool_definition | active_tool_loop
+    pattern: str = ""
+    keywords: List[str] = field(default_factory=list)
+    sequences: List[List[str]] = field(default_factory=list)
+    case_sensitive: bool = False
+    role: str = ""  # for message source: user | assistant | developer | non_user
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FeatureSource":
+        return cls(
+            type=d.get("type", "regex"),
+            pattern=d.get("pattern", ""),
+            keywords=list(d.get("keywords", [])),
+            sequences=[list(s) for s in d.get("sequences", [])],
+            case_sensitive=bool(d.get("case_sensitive", False)),
+            role=d.get("role", ""),
+        )
+
+
+@dataclass
+class Predicate:
+    """Numeric comparison bundle: any subset of gt/gte/lt/lte/eq."""
+
+    gt: Optional[float] = None
+    gte: Optional[float] = None
+    lt: Optional[float] = None
+    lte: Optional[float] = None
+    eq: Optional[float] = None
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "Predicate":
+        d = d or {}
+        fv = lambda k: (float(d[k]) if k in d and d[k] is not None else None)
+        return cls(gt=fv("gt"), gte=fv("gte"), lt=fv("lt"), lte=fv("lte"), eq=fv("eq"))
+
+    def check(self, value: float) -> bool:
+        if self.gt is not None and not value > self.gt:
+            return False
+        if self.gte is not None and not value >= self.gte:
+            return False
+        if self.lt is not None and not value < self.lt:
+            return False
+        if self.lte is not None and not value <= self.lte:
+            return False
+        if self.eq is not None and value != self.eq:
+            return False
+        return True
+
+    def is_empty(self) -> bool:
+        return all(
+            v is None for v in (self.gt, self.gte, self.lt, self.lte, self.eq)
+        )
+
+
+@dataclass
+class StructureRule:
+    """Prompt-structure feature rule (count/exists/sequence/density over a
+    regex/keyword-set/sequence source). Reference:
+    pkg/classification/structure_classifier.go and config.yaml:266-335."""
+
+    name: str
+    feature_type: str = "count"  # count | exists | sequence | density
+    source: FeatureSource = field(default_factory=FeatureSource)
+    predicate: Predicate = field(default_factory=Predicate)
+    description: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "StructureRule":
+        feat = d.get("feature", {}) or {}
+        return cls(
+            name=d["name"],
+            feature_type=feat.get("type", "count"),
+            source=FeatureSource.from_dict(feat.get("source", {}) or {}),
+            predicate=Predicate.from_dict(d.get("predicate")),
+            description=d.get("description", ""),
+        )
+
+
+@dataclass
+class ComplexityRule:
+    """Learned complexity/difficulty rule with hard/easy prototype candidate
+    sets and an optional composer sub-expression (config.yaml:337-365)."""
+
+    name: str
+    threshold: float = 0.75
+    hard_candidates: List[str] = field(default_factory=list)
+    easy_candidates: List[str] = field(default_factory=list)
+    hard_image_candidates: List[str] = field(default_factory=list)
+    easy_image_candidates: List[str] = field(default_factory=list)
+    composer: Optional["RuleNode"] = None
+    description: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ComplexityRule":
+        hard = d.get("hard", {}) or {}
+        easy = d.get("easy", {}) or {}
+        composer = d.get("composer")
+        return cls(
+            name=d["name"],
+            threshold=float(d.get("threshold", 0.75)),
+            hard_candidates=list(hard.get("candidates", [])),
+            easy_candidates=list(easy.get("candidates", [])),
+            hard_image_candidates=list(hard.get("image_candidates", [])),
+            easy_image_candidates=list(easy.get("image_candidates", [])),
+            composer=RuleNode.from_dict(composer) if composer else None,
+            description=d.get("description", ""),
+        )
+
+
+@dataclass
+class AuthzRule:
+    """Role-binding rule: maps identity groups/users to a named role signal
+    (routing.signals.role_bindings, config.yaml:380-397)."""
+
+    name: str
+    role: str = ""
+    subjects: List[Dict[str, str]] = field(default_factory=list)  # {kind, name}
+    description: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "AuthzRule":
+        return cls(
+            name=d["name"],
+            role=d.get("role", d["name"]),
+            subjects=[dict(s) for s in d.get("subjects", [])],
+            description=d.get("description", ""),
+        )
+
+
+@dataclass
+class JailbreakRule:
+    """Jailbreak detection rule (config.yaml:399-410): method is
+    'classifier' (learned), 'pattern' (contrastive pattern match), or
+    'hybrid' (both)."""
+
+    name: str
+    method: str = "classifier"
+    threshold: float = 0.8
+    include_history: bool = False
+    jailbreak_patterns: List[str] = field(default_factory=list)
+    benign_patterns: List[str] = field(default_factory=list)
+    description: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "JailbreakRule":
+        return cls(
+            name=d["name"],
+            method=d.get("method", "classifier"),
+            threshold=float(d.get("threshold", 0.8)),
+            include_history=bool(d.get("include_history", False)),
+            jailbreak_patterns=list(d.get("jailbreak_patterns", [])),
+            benign_patterns=list(d.get("benign_patterns", [])),
+            description=d.get("description", ""),
+        )
+
+
+@dataclass
+class PIIRule:
+    """PII policy rule: token-classifier detects entity types; rule matches
+    when a *disallowed* type is present (config.yaml:412-419)."""
+
+    name: str
+    threshold: float = 0.85
+    include_history: bool = False
+    pii_types_allowed: List[str] = field(default_factory=list)
+    description: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PIIRule":
+        return cls(
+            name=d["name"],
+            threshold=float(d.get("threshold", 0.85)),
+            include_history=bool(d.get("include_history", False)),
+            pii_types_allowed=list(d.get("pii_types_allowed", [])),
+            description=d.get("description", ""),
+        )
+
+
+@dataclass
+class KBRule:
+    name: str
+    kb: str = ""
+    target: Dict[str, str] = field(default_factory=dict)  # {kind, value}
+    match: str = "best"
+    description: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "KBRule":
+        return cls(
+            name=d["name"],
+            kb=d.get("kb", ""),
+            target=dict(d.get("target", {}) or {}),
+            match=d.get("match", "best"),
+            description=d.get("description", ""),
+        )
+
+
+@dataclass
+class ConversationRule:
+    """Conversation-shape rule (message counts, tool defs, active tool loop)."""
+
+    name: str
+    feature_type: str = "count"
+    source: FeatureSource = field(default_factory=FeatureSource)
+    predicate: Predicate = field(default_factory=Predicate)
+    description: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ConversationRule":
+        feat = d.get("feature", {}) or {}
+        return cls(
+            name=d["name"],
+            feature_type=feat.get("type", "count"),
+            source=FeatureSource.from_dict(feat.get("source", {}) or {}),
+            predicate=Predicate.from_dict(d.get("predicate")),
+            description=d.get("description", ""),
+        )
+
+
+@dataclass
+class EventRule:
+    name: str
+    event_types: List[str] = field(default_factory=list)
+    severities: List[str] = field(default_factory=list)
+    action_codes: List[str] = field(default_factory=list)
+    temporal: bool = False
+    description: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "EventRule":
+        return cls(
+            name=d["name"],
+            event_types=list(d.get("event_types", [])),
+            severities=list(d.get("severities", [])),
+            action_codes=list(d.get("action_codes", [])),
+            temporal=bool(d.get("temporal", False)),
+            description=d.get("description", ""),
+        )
+
+
+@dataclass
+class SignalsConfig:
+    """All configured signal rules, by family."""
+
+    keywords: List[KeywordRule] = field(default_factory=list)
+    embeddings: List[EmbeddingRule] = field(default_factory=list)
+    domains: List[DomainRule] = field(default_factory=list)
+    fact_check: List[NamedRule] = field(default_factory=list)
+    user_feedbacks: List[NamedRule] = field(default_factory=list)
+    reasks: List[ReaskRule] = field(default_factory=list)
+    preferences: List[PreferenceRule] = field(default_factory=list)
+    language: List[NamedRule] = field(default_factory=list)
+    context: List[ContextRule] = field(default_factory=list)
+    structure: List[StructureRule] = field(default_factory=list)
+    complexity: List[ComplexityRule] = field(default_factory=list)
+    modality: List[NamedRule] = field(default_factory=list)
+    role_bindings: List[AuthzRule] = field(default_factory=list)
+    jailbreak: List[JailbreakRule] = field(default_factory=list)
+    pii: List[PIIRule] = field(default_factory=list)
+    kb: List[KBRule] = field(default_factory=list)
+    conversation: List[ConversationRule] = field(default_factory=list)
+    events: List[EventRule] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SignalsConfig":
+        d = d or {}
+        return cls(
+            keywords=[KeywordRule.from_dict(x) for x in d.get("keywords", [])],
+            embeddings=[EmbeddingRule.from_dict(x) for x in d.get("embeddings", [])],
+            domains=[DomainRule.from_dict(x) for x in d.get("domains", [])],
+            fact_check=[NamedRule.from_dict(x) for x in d.get("fact_check", [])],
+            user_feedbacks=[NamedRule.from_dict(x) for x in d.get("user_feedbacks", [])],
+            reasks=[ReaskRule.from_dict(x) for x in d.get("reasks", [])],
+            preferences=[PreferenceRule.from_dict(x) for x in d.get("preferences", [])],
+            language=[NamedRule.from_dict(x) for x in d.get("language", [])],
+            context=[ContextRule.from_dict(x) for x in d.get("context", [])],
+            structure=[StructureRule.from_dict(x) for x in d.get("structure", [])],
+            complexity=[ComplexityRule.from_dict(x) for x in d.get("complexity", [])],
+            modality=[NamedRule.from_dict(x) for x in d.get("modality", [])],
+            role_bindings=[AuthzRule.from_dict(x) for x in d.get("role_bindings", [])],
+            jailbreak=[JailbreakRule.from_dict(x) for x in d.get("jailbreak", [])],
+            pii=[PIIRule.from_dict(x) for x in d.get("pii", [])],
+            kb=[KBRule.from_dict(x) for x in d.get("kb", [])],
+            conversation=[ConversationRule.from_dict(x) for x in d.get("conversation", [])],
+            events=[EventRule.from_dict(x) for x in d.get("events", [])],
+        )
+
+    def rule_names(self, signal_type: str) -> List[str]:
+        """All configured rule names for a signal type (decision-engine leaf
+        validation)."""
+        family = {
+            SIGNAL_KEYWORD: self.keywords,
+            SIGNAL_EMBEDDING: self.embeddings,
+            SIGNAL_DOMAIN: self.domains,
+            SIGNAL_FACT_CHECK: self.fact_check,
+            SIGNAL_USER_FEEDBACK: self.user_feedbacks,
+            SIGNAL_REASK: self.reasks,
+            SIGNAL_PREFERENCE: self.preferences,
+            SIGNAL_LANGUAGE: self.language,
+            SIGNAL_CONTEXT: self.context,
+            SIGNAL_STRUCTURE: self.structure,
+            SIGNAL_COMPLEXITY: self.complexity,
+            SIGNAL_MODALITY: self.modality,
+            SIGNAL_AUTHZ: self.role_bindings,
+            SIGNAL_JAILBREAK: self.jailbreak,
+            SIGNAL_PII: self.pii,
+            SIGNAL_KB: self.kb,
+            SIGNAL_CONVERSATION: self.conversation,
+            SIGNAL_EVENT: self.events,
+        }.get(signal_type, [])
+        return [r.name for r in family]
+
+
+# --------------------------------------------------------------------------
+# Projections (reference: config.yaml:493-538, pkg/classification/classifier_projections.go)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class ProjectionPartition:
+    """Mutually-interacting signal group normalized into a distribution
+    (softmax over member confidences with a temperature)."""
+
+    name: str
+    members: List[str] = field(default_factory=list)
+    semantics: str = "exclusive"
+    temperature: float = 1.0
+    default: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ProjectionPartition":
+        return cls(
+            name=d["name"],
+            members=list(d.get("members", [])),
+            semantics=d.get("semantics", "exclusive"),
+            temperature=float(d.get("temperature", 1.0)),
+            default=d.get("default", ""),
+        )
+
+
+@dataclass
+class ScoreInput:
+    type: str = ""
+    name: str = ""
+    weight: float = 0.0
+    value_source: str = "match"  # match | confidence | score
+    match: float = 1.0
+    miss: float = 0.0
+    kb: str = ""
+    metric: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ScoreInput":
+        return cls(
+            type=d.get("type", ""),
+            name=d.get("name", ""),
+            weight=float(d.get("weight", 0.0)),
+            value_source=d.get("value_source", "match"),
+            match=float(d.get("match", 1.0)),
+            miss=float(d.get("miss", 0.0)),
+            kb=d.get("kb", ""),
+            metric=d.get("metric", ""),
+        )
+
+
+@dataclass
+class ProjectionScore:
+    name: str
+    method: str = "weighted_sum"
+    inputs: List[ScoreInput] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ProjectionScore":
+        return cls(
+            name=d["name"],
+            method=d.get("method", "weighted_sum"),
+            inputs=[ScoreInput.from_dict(x) for x in d.get("inputs", [])],
+        )
+
+
+@dataclass
+class MappingOutput:
+    name: str
+    predicate: Predicate = field(default_factory=Predicate)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "MappingOutput":
+        return cls(name=d["name"], predicate=Predicate.from_dict(d))
+
+
+@dataclass
+class ProjectionMapping:
+    """Score → derived routing-output band mapping."""
+
+    name: str
+    source: str = ""
+    method: str = "threshold_bands"
+    calibration: Dict[str, Any] = field(default_factory=dict)
+    outputs: List[MappingOutput] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ProjectionMapping":
+        return cls(
+            name=d["name"],
+            source=d.get("source", ""),
+            method=d.get("method", "threshold_bands"),
+            calibration=dict(d.get("calibration", {}) or {}),
+            outputs=[MappingOutput.from_dict(x) for x in d.get("outputs", [])],
+        )
+
+
+@dataclass
+class ProjectionsConfig:
+    partitions: List[ProjectionPartition] = field(default_factory=list)
+    scores: List[ProjectionScore] = field(default_factory=list)
+    mappings: List[ProjectionMapping] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ProjectionsConfig":
+        d = d or {}
+        return cls(
+            partitions=[ProjectionPartition.from_dict(x) for x in d.get("partitions", [])],
+            scores=[ProjectionScore.from_dict(x) for x in d.get("scores", [])],
+            mappings=[ProjectionMapping.from_dict(x) for x in d.get("mappings", [])],
+        )
+
+
+# --------------------------------------------------------------------------
+# Decisions (reference: decision/engine.go, config.yaml:540+)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class RuleNode:
+    """Boolean expression tree node. Leaf: {type, name}. Composite:
+    {operator: AND|OR|NOT, conditions: [...]}. Reference:
+    pkg/decision/engine.go:160-200 (evalNode)."""
+
+    operator: str = ""  # "" for leaf
+    conditions: List["RuleNode"] = field(default_factory=list)
+    signal_type: str = ""
+    name: str = ""
+
+    def is_leaf(self) -> bool:
+        return self.operator == "" and self.signal_type != ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "RuleNode":
+        if not d:
+            return cls()
+        if "operator" in d and d.get("operator"):
+            return cls(
+                operator=str(d["operator"]).upper(),
+                conditions=[cls.from_dict(c) for c in d.get("conditions", [])],
+            )
+        return cls(signal_type=d.get("type", ""), name=d.get("name", ""))
+
+    def leaves(self) -> List["RuleNode"]:
+        if self.is_leaf():
+            return [self]
+        out: List[RuleNode] = []
+        for c in self.conditions:
+            out.extend(c.leaves())
+        return out
+
+
+@dataclass
+class ModelRef:
+    """Candidate model for a decision, with reasoning controls and weight."""
+
+    model: str
+    weight: float = 1.0
+    use_reasoning: bool = False
+    reasoning_effort: str = ""
+    reasoning_description: str = ""
+    lora_name: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ModelRef":
+        return cls(
+            model=d["model"],
+            weight=float(d.get("weight", 1.0)),
+            use_reasoning=bool(d.get("use_reasoning", False)),
+            reasoning_effort=d.get("reasoning_effort", ""),
+            reasoning_description=d.get("reasoning_description", ""),
+            lora_name=d.get("lora_name", ""),
+        )
+
+
+@dataclass
+class PluginConfig:
+    type: str
+    configuration: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PluginConfig":
+        return cls(type=d["type"], configuration=dict(d.get("configuration", {}) or {}))
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.configuration.get("enabled", True))
+
+
+@dataclass
+class Decision:
+    name: str
+    rules: RuleNode = field(default_factory=RuleNode)
+    priority: int = 0
+    tier: int = 0
+    description: str = ""
+    model_refs: List[ModelRef] = field(default_factory=list)
+    algorithm: Dict[str, Any] = field(default_factory=dict)  # {type: static|confidence|...}
+    plugins: List[PluginConfig] = field(default_factory=list)
+    output_contract: str = ""
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Decision":
+        known = {
+            "name", "rules", "priority", "tier", "description", "modelRefs",
+            "model_refs", "algorithm", "plugins", "output_contract",
+        }
+        return cls(
+            name=d["name"],
+            rules=RuleNode.from_dict(d.get("rules", {}) or {}),
+            priority=int(d.get("priority", 0)),
+            tier=int(d.get("tier", 0)),
+            description=d.get("description", ""),
+            model_refs=[
+                ModelRef.from_dict(m)
+                for m in _take(d, "modelRefs", "model_refs", default=[])
+            ],
+            algorithm=dict(d.get("algorithm", {}) or {}),
+            plugins=[PluginConfig.from_dict(p) for p in d.get("plugins", [])],
+            output_contract=d.get("output_contract", ""),
+            extra={k: v for k, v in d.items() if k not in known},
+        )
+
+    def plugin(self, ptype: str) -> Optional[PluginConfig]:
+        for p in self.plugins:
+            if p.type == ptype:
+                return p
+        return None
+
+
+# --------------------------------------------------------------------------
+# Model catalog / backends
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class LoRACard:
+    name: str
+    description: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "LoRACard":
+        return cls(name=d["name"], description=d.get("description", ""))
+
+
+@dataclass
+class ModelCard:
+    """Backend model card (routing.modelCards, config.yaml:99-133)."""
+
+    name: str
+    param_size: str = ""
+    context_window_size: int = 0
+    description: str = ""
+    capabilities: List[str] = field(default_factory=list)
+    quality_score: float = 0.0
+    modality: str = "ar"  # ar | diffusion | omni
+    tags: List[str] = field(default_factory=list)
+    loras: List[LoRACard] = field(default_factory=list)
+    pricing: Dict[str, float] = field(default_factory=dict)  # prompt/completion per 1M
+    backend_refs: List[Dict[str, Any]] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ModelCard":
+        return cls(
+            name=d["name"],
+            param_size=str(d.get("param_size", "")),
+            context_window_size=parse_token_count(d.get("context_window_size", 0)),
+            description=d.get("description", ""),
+            capabilities=list(d.get("capabilities", [])),
+            quality_score=float(d.get("quality_score", 0.0)),
+            modality=d.get("modality", "ar"),
+            tags=list(d.get("tags", [])),
+            loras=[LoRACard.from_dict(x) for x in d.get("loras", [])],
+            pricing=dict(d.get("pricing", {}) or {}),
+            backend_refs=[dict(b) for b in d.get("backend_refs", [])],
+        )
+
+    def param_size_billions(self) -> float:
+        s = self.param_size.strip().upper().rstrip("B")
+        try:
+            return float(s)
+        except ValueError:
+            return 0.0
+
+
+# --------------------------------------------------------------------------
+# Top-level config
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class SemanticCacheConfig:
+    enabled: bool = False
+    backend_type: str = "memory"  # memory | hnsw | hybrid
+    similarity_threshold: float = 0.8
+    max_entries: int = 1000
+    ttl_seconds: int = 3600
+    eviction_policy: str = "fifo"  # fifo | lru | lfu
+    embedding_model: str = ""
+    use_hnsw: bool = True
+    backend_config: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SemanticCacheConfig":
+        d = d or {}
+        return cls(
+            enabled=bool(d.get("enabled", False)),
+            backend_type=d.get("backend_type", "memory"),
+            similarity_threshold=float(d.get("similarity_threshold", 0.8)),
+            max_entries=int(d.get("max_entries", 1000)),
+            ttl_seconds=int(d.get("ttl_seconds", 3600)),
+            eviction_policy=d.get("eviction_policy", "fifo"),
+            embedding_model=d.get("embedding_model", ""),
+            use_hnsw=bool(d.get("use_hnsw", True)),
+            backend_config=dict(d.get("backend_config", {}) or {}),
+        )
+
+
+@dataclass
+class InferenceEngineConfig:
+    """TPU inference engine knobs — this framework's analog of the reference's
+    candle/onnx device configuration plus the batching shim (N6) parameters
+    (continuous_batch_scheduler.rs:124-250)."""
+
+    max_batch_size: int = 32
+    max_wait_ms: float = 2.0
+    seq_len_buckets: List[int] = field(default_factory=lambda: [128, 512, 2048, 8192, 32768])
+    dtype: str = "bfloat16"
+    mesh_shape: Dict[str, int] = field(default_factory=dict)  # {"data": 4} etc.
+    use_flash_attention: bool = True
+    matryoshka_layers: List[int] = field(default_factory=list)
+    matryoshka_dims: List[int] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "InferenceEngineConfig":
+        d = d or {}
+        out = cls(
+            max_batch_size=int(d.get("max_batch_size", 32)),
+            max_wait_ms=float(d.get("max_wait_ms", 2.0)),
+            dtype=d.get("dtype", "bfloat16"),
+            mesh_shape=dict(d.get("mesh_shape", {}) or {}),
+            use_flash_attention=bool(d.get("use_flash_attention", True)),
+            matryoshka_layers=list(d.get("matryoshka_layers", [])),
+            matryoshka_dims=list(d.get("matryoshka_dims", [])),
+        )
+        if d.get("seq_len_buckets"):
+            out.seq_len_buckets = [int(x) for x in d["seq_len_buckets"]]
+        return out
+
+
+@dataclass
+class RouterConfig:
+    """The root configuration object (reference RouterConfig,
+    pkg/config/config.go:60-100)."""
+
+    model_cards: List[ModelCard] = field(default_factory=list)
+    signals: SignalsConfig = field(default_factory=SignalsConfig)
+    projections: ProjectionsConfig = field(default_factory=ProjectionsConfig)
+    decisions: List[Decision] = field(default_factory=list)
+    strategy: str = "priority"  # priority | confidence
+    default_model: str = ""
+    semantic_cache: SemanticCacheConfig = field(default_factory=SemanticCacheConfig)
+    engine: InferenceEngineConfig = field(default_factory=InferenceEngineConfig)
+    classifier_models: Dict[str, Any] = field(default_factory=dict)  # per-task model specs
+    authz: Dict[str, Any] = field(default_factory=dict)
+    ratelimit: Dict[str, Any] = field(default_factory=dict)
+    memory: Dict[str, Any] = field(default_factory=dict)
+    looper: Dict[str, Any] = field(default_factory=dict)
+    router_replay: Dict[str, Any] = field(default_factory=dict)
+    observability: Dict[str, Any] = field(default_factory=dict)
+    api_server: Dict[str, Any] = field(default_factory=dict)
+    tool_selection: Dict[str, Any] = field(default_factory=dict)
+    prompt_compression: Dict[str, Any] = field(default_factory=dict)
+    raw: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "RouterConfig":
+        d = d or {}
+        routing = d.get("routing", {}) or {}
+        return cls(
+            model_cards=[ModelCard.from_dict(m) for m in routing.get("modelCards", d.get("model_cards", []))],
+            signals=SignalsConfig.from_dict(routing.get("signals", d.get("signals", {}))),
+            projections=ProjectionsConfig.from_dict(routing.get("projections", d.get("projections", {}))),
+            decisions=[Decision.from_dict(x) for x in routing.get("decisions", d.get("decisions", []))],
+            strategy=routing.get("strategy", d.get("strategy", "priority")),
+            default_model=d.get("default_model", routing.get("default_model", "")),
+            semantic_cache=SemanticCacheConfig.from_dict(d.get("semantic_cache", {})),
+            engine=InferenceEngineConfig.from_dict(d.get("engine", d.get("inference_engine", {}))),
+            classifier_models=dict(d.get("classifier_models", {}) or {}),
+            authz=dict(d.get("authz", {}) or {}),
+            ratelimit=dict(d.get("ratelimit", {}) or {}),
+            memory=dict(d.get("memory", {}) or {}),
+            looper=dict(d.get("looper", {}) or {}),
+            router_replay=dict(d.get("router_replay", {}) or {}),
+            observability=dict(d.get("observability", {}) or {}),
+            api_server=dict(d.get("api_server", {}) or {}),
+            tool_selection=dict(d.get("tool_selection", {}) or {}),
+            prompt_compression=dict(d.get("prompt_compression", {}) or {}),
+            raw=d,
+        )
+
+    def model_card(self, name: str) -> Optional[ModelCard]:
+        for m in self.model_cards:
+            if m.name == name:
+                return m
+        return None
+
+    def used_signal_types(self) -> List[str]:
+        """Signal families actually referenced by decision rules, complexity
+        composers, or projections — the dispatch layer only evaluates these
+        (reference: classifier_signal_dispatch.go buildSignalDispatchers)."""
+        used: set = set()
+        for dec in self.decisions:
+            for leaf in dec.rules.leaves():
+                used.add(leaf.signal_type.lower())
+        for comp in self.signals.complexity:
+            if comp.composer is not None:
+                for leaf in comp.composer.leaves():
+                    used.add(leaf.signal_type.lower())
+        for score in self.projections.scores:
+            for inp in score.inputs:
+                if inp.type and inp.type != "kb_metric":
+                    used.add(inp.type.lower())
+        # Partition members are rule names from arbitrary families; the
+        # families providing them must be evaluated too.
+        member_names = {m for p in self.projections.partitions for m in p.members}
+        if member_names:
+            for styp in ALL_SIGNAL_TYPES:
+                if member_names & set(self.signals.rule_names(styp)):
+                    used.add(styp)
+        return sorted(t for t in used if t)
+
+
+def asdict(cfg: Any) -> Dict[str, Any]:
+    return dataclasses.asdict(cfg)

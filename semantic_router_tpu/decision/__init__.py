@@ -1,0 +1,16 @@
+from .engine import (
+    DecisionEngine,
+    DecisionResult,
+    DecisionTraceEntry,
+    SignalMatches,
+)
+from .projections import ProjectionEvaluator, ProjectionTrace
+
+__all__ = [
+    "DecisionEngine",
+    "DecisionResult",
+    "DecisionTraceEntry",
+    "ProjectionEvaluator",
+    "ProjectionTrace",
+    "SignalMatches",
+]

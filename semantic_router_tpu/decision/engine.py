@@ -1,0 +1,202 @@
+"""Boolean decision engine.
+
+Evaluates each configured decision's AND/OR/NOT rule tree against the set of
+matched signal rules, then selects the best match by strategy ("priority" or
+"confidence"). Capability parity with the reference engine
+(src/semantic-router/pkg/decision/engine.go:31-300): leaf matching by
+"type:name", confidence aggregation (AND=min, OR=max over matched children,
+NOT=1-based complement), priority tiebreak on confidence and vice versa.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..config.schema import Decision, RuleNode, SIGNAL_COMPLEXITY
+
+
+@dataclass
+class SignalMatches:
+    """Matched rule names per signal family + real-valued confidences.
+
+    ``matches`` maps signal type ("keyword", "domain", ...) to the list of
+    matched rule names. ``confidences`` maps "type:name" to a score in [0,1]
+    (default 1.0 when absent) — mirroring SignalMatches.SignalConfidences
+    (decision/engine.go:62-88).
+    """
+
+    matches: Dict[str, List[str]] = field(default_factory=dict)
+    confidences: Dict[str, float] = field(default_factory=dict)
+    # Extra payloads some consumers need (PII types found, matched keywords,
+    # detected language, entropy etc.) keyed by signal type.
+    details: Dict[str, dict] = field(default_factory=dict)
+
+    def add(self, signal_type: str, rule_name: str,
+            confidence: float = 1.0) -> None:
+        self.matches.setdefault(signal_type, []).append(rule_name)
+        self.confidences[f"{signal_type}:{rule_name}"] = float(confidence)
+
+    def extend(self, other: "SignalMatches") -> None:
+        for styp, names in other.matches.items():
+            self.matches.setdefault(styp, []).extend(names)
+        self.confidences.update(other.confidences)
+        for k, v in other.details.items():
+            self.details.setdefault(k, {}).update(v)
+
+    def matched(self, signal_type: str, name: str) -> bool:
+        names = self.matches.get(signal_type, ())
+        if name in names:
+            return True
+        # Complexity rules may be referenced as "rule:level" while the
+        # evaluator reports "rule:hard" etc.; exact match handled above, and
+        # a bare rule name matches any reported level.
+        if signal_type == SIGNAL_COMPLEXITY and ":" not in name:
+            return any(n.split(":", 1)[0] == name for n in names)
+        return False
+
+    def confidence(self, signal_type: str, name: str) -> float:
+        key = f"{signal_type}:{name}"
+        if key in self.confidences:
+            return self.confidences[key]
+        if signal_type == SIGNAL_COMPLEXITY and ":" not in name:
+            for n in self.matches.get(signal_type, ()):
+                if n.split(":", 1)[0] == name:
+                    return self.confidences.get(f"{signal_type}:{n}", 1.0)
+        return 1.0
+
+    def all_matched_rules(self) -> List[str]:
+        return [f"{t}:{n}" for t, names in sorted(self.matches.items())
+                for n in names]
+
+
+@dataclass
+class DecisionResult:
+    decision: Decision
+    confidence: float
+    matched_rules: List[str]
+    matched_keywords: List[str] = field(default_factory=list)
+
+
+@dataclass
+class DecisionTraceEntry:
+    decision: str
+    matched: bool
+    confidence: float
+    matched_rules: List[str]
+
+
+class DecisionEngine:
+    """Evaluates decisions over signal matches (reference engine.go:113)."""
+
+    def __init__(self, decisions: List[Decision], strategy: str = "priority") -> None:
+        self.decisions = list(decisions)
+        self.strategy = strategy or "priority"
+        self.last_eval_latency_s: float = 0.0
+
+    # -- public ------------------------------------------------------------
+
+    def evaluate(self, signals: SignalMatches,
+                 trace: Optional[List[DecisionTraceEntry]] = None
+                 ) -> Optional[DecisionResult]:
+        start = time.perf_counter()
+        try:
+            results: List[DecisionResult] = []
+            for dec in self.decisions:
+                matched, conf, rules = self._eval_node(dec.rules, signals)
+                if trace is not None:
+                    trace.append(DecisionTraceEntry(dec.name, matched, conf, rules))
+                if matched:
+                    results.append(DecisionResult(dec, conf, rules))
+            if not results:
+                return None
+            return self._select_best(results, signals)
+        finally:
+            self.last_eval_latency_s = time.perf_counter() - start
+
+    def evaluate_all(self, signals: SignalMatches) -> List[DecisionResult]:
+        """All matching decisions, best-first (used by eval APIs/tests)."""
+        results = []
+        for dec in self.decisions:
+            matched, conf, rules = self._eval_node(dec.rules, signals)
+            if matched:
+                results.append(DecisionResult(dec, conf, rules))
+        results.sort(key=self._sort_key)
+        return results
+
+    # -- tree evaluation ---------------------------------------------------
+
+    def _eval_node(self, node: RuleNode, signals: SignalMatches
+                   ) -> Tuple[bool, float, List[str]]:
+        if node.is_leaf():
+            return self._eval_leaf(node, signals)
+        op = node.operator.upper()
+        if op == "AND":
+            return self._eval_and(node.conditions, signals)
+        if op == "NOT":
+            return self._eval_not(node.conditions, signals)
+        return self._eval_or(node.conditions, signals)
+
+    def _eval_leaf(self, node: RuleNode, signals: SignalMatches
+                   ) -> Tuple[bool, float, List[str]]:
+        styp = node.signal_type.lower().strip()
+        if not signals.matched(styp, node.name):
+            return False, 0.0, []
+        conf = signals.confidence(styp, node.name)
+        return True, conf, [f"{styp}:{node.name}"]
+
+    def _eval_and(self, conds: List[RuleNode], signals: SignalMatches
+                  ) -> Tuple[bool, float, List[str]]:
+        if not conds:
+            return False, 0.0, []
+        min_conf = 1.0
+        rules: List[str] = []
+        for c in conds:
+            m, conf, r = self._eval_node(c, signals)
+            if not m:
+                return False, 0.0, []
+            min_conf = min(min_conf, conf)
+            rules.extend(r)
+        return True, min_conf, rules
+
+    def _eval_or(self, conds: List[RuleNode], signals: SignalMatches
+                 ) -> Tuple[bool, float, List[str]]:
+        best = 0.0
+        rules: List[str] = []
+        matched = False
+        for c in conds:
+            m, conf, r = self._eval_node(c, signals)
+            if m:
+                matched = True
+                best = max(best, conf)
+                rules.extend(r)
+        return matched, best, rules
+
+    def _eval_not(self, conds: List[RuleNode], signals: SignalMatches
+                  ) -> Tuple[bool, float, List[str]]:
+        # NOT matches when none of its children match; confidence is the
+        # complement of the strongest child match (1.0 when nothing matched).
+        for c in conds:
+            m, _conf, _r = self._eval_node(c, signals)
+            if m:
+                return False, 0.0, []
+        return True, 1.0, []
+
+    # -- selection ---------------------------------------------------------
+
+    def _sort_key(self, r: DecisionResult):
+        if self.strategy == "confidence":
+            return (-r.confidence, -r.decision.priority, r.decision.name)
+        return (-r.decision.priority, -r.confidence, r.decision.name)
+
+    def _select_best(self, results: List[DecisionResult],
+                     signals: SignalMatches) -> DecisionResult:
+        best = min(results, key=self._sort_key)
+        kw_detail = signals.details.get("keyword", {})
+        matched_kw: List[str] = []
+        for rule in best.matched_rules:
+            if rule.startswith("keyword:"):
+                matched_kw.extend(kw_detail.get(rule.split(":", 1)[1], []))
+        best.matched_keywords = matched_kw
+        return best

@@ -1,0 +1,134 @@
+"""Projection evaluation: signals → derived routing outputs.
+
+Capability parity with the reference's projection layer
+(pkg/classification/classifier_projections.go + config routing.projections,
+config/config.yaml:493-538):
+
+- **partitions** — a group of mutually-exclusive signals normalized into a
+  distribution (temperature softmax over member confidences); the winner is
+  emitted as a projection match; a configured default wins when no member
+  matched.
+- **scores** — weighted sums over signal match/confidence values.
+- **mappings** — scores mapped to named output bands by threshold predicates,
+  with optional sigmoid-distance calibration that turns distance-to-band-edge
+  into a confidence.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from ..config.schema import (
+    ProjectionsConfig,
+    SIGNAL_PROJECTION,
+)
+from .engine import SignalMatches
+
+
+@dataclass
+class ProjectionTrace:
+    partitions: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    scores: Dict[str, float] = field(default_factory=dict)
+    mappings: Dict[str, str] = field(default_factory=dict)
+
+
+class ProjectionEvaluator:
+    def __init__(self, cfg: ProjectionsConfig) -> None:
+        self.cfg = cfg
+
+    def evaluate(self, signals: SignalMatches,
+                 kb_metrics: Dict[str, Dict[str, float]] | None = None
+                 ) -> ProjectionTrace:
+        """Evaluate all projections, adding matches into *signals* under the
+        'projection' signal type, and return the trace."""
+        trace = ProjectionTrace()
+        self._eval_partitions(signals, trace)
+        self._eval_scores(signals, trace, kb_metrics or {})
+        self._eval_mappings(signals, trace)
+        return trace
+
+    # -- partitions --------------------------------------------------------
+
+    def _member_confidence(self, signals: SignalMatches, member: str) -> float:
+        """A partition member is a signal rule name from any family; take the
+        max confidence across families where it matched."""
+        best = 0.0
+        for styp, names in signals.matches.items():
+            if member in names:
+                best = max(best, signals.confidence(styp, member))
+        return best
+
+    def _eval_partitions(self, signals: SignalMatches,
+                         trace: ProjectionTrace) -> None:
+        for part in self.cfg.partitions:
+            confs = {m: self._member_confidence(signals, m) for m in part.members}
+            live = {m: c for m, c in confs.items() if c > 0.0}
+            if not live:
+                if part.default:
+                    signals.add(SIGNAL_PROJECTION, part.default, 1.0)
+                    trace.partitions[part.name] = {part.default: 1.0}
+                continue
+            temp = max(part.temperature, 1e-6)
+            mx = max(live.values())
+            exps = {m: math.exp((c - mx) / temp) for m, c in live.items()}
+            z = sum(exps.values())
+            dist = {m: e / z for m, e in exps.items()}
+            trace.partitions[part.name] = dist
+            if part.semantics == "exclusive":
+                winner = max(dist.items(), key=lambda kv: kv[1])
+                signals.add(SIGNAL_PROJECTION, winner[0], winner[1])
+            else:  # "overlapping": emit every live member with its share
+                for m, p in dist.items():
+                    signals.add(SIGNAL_PROJECTION, m, p)
+
+    # -- scores ------------------------------------------------------------
+
+    def _eval_scores(self, signals: SignalMatches, trace: ProjectionTrace,
+                     kb_metrics: Dict[str, Dict[str, float]]) -> None:
+        for score in self.cfg.scores:
+            total = 0.0
+            for inp in score.inputs:
+                if inp.type == "kb_metric":
+                    val = kb_metrics.get(inp.kb, {}).get(inp.metric, 0.0)
+                    total += inp.weight * val
+                    continue
+                styp = inp.type.lower()
+                hit = signals.matched(styp, inp.name)
+                if inp.value_source == "confidence" or inp.value_source == "score":
+                    val = signals.confidence(styp, inp.name) if hit else inp.miss
+                else:  # match/miss binary
+                    val = inp.match if hit else inp.miss
+                total += inp.weight * val
+            trace.scores[score.name] = total
+
+    # -- mappings ----------------------------------------------------------
+
+    def _eval_mappings(self, signals: SignalMatches,
+                       trace: ProjectionTrace) -> None:
+        for mapping in self.cfg.mappings:
+            value = trace.scores.get(mapping.source)
+            if value is None:
+                continue
+            for out in mapping.outputs:
+                if out.predicate.check(value):
+                    conf = self._calibrate(mapping.calibration, value, out)
+                    signals.add(SIGNAL_PROJECTION, out.name, conf)
+                    trace.mappings[mapping.name] = out.name
+                    break
+
+    @staticmethod
+    def _calibrate(calibration: Dict, value: float, out) -> float:
+        """sigmoid_distance: confidence grows with distance from the nearest
+        band edge — sigmoid(slope * min-edge-distance)."""
+        if calibration.get("method") != "sigmoid_distance":
+            return 1.0
+        slope = float(calibration.get("slope", 10.0))
+        edges = [e for e in (out.predicate.gt, out.predicate.gte,
+                             out.predicate.lt, out.predicate.lte)
+                 if e is not None]
+        if not edges:
+            return 1.0
+        dist = min(abs(value - e) for e in edges)
+        return 1.0 / (1.0 + math.exp(-slope * dist))

@@ -1,0 +1,28 @@
+from .base import (
+    Message,
+    RequestContext,
+    SignalEvaluator,
+    SignalHit,
+    SignalResult,
+)
+from .dispatch import DispatchReport, SignalDispatcher, build_heuristic_dispatcher
+from .heuristic import (
+    AuthzSignal,
+    ContextSignal,
+    ConversationSignal,
+    EventSignal,
+    LanguageSignal,
+    ReaskSignal,
+    StructureSignal,
+    detect_language,
+)
+from .keyword import BM25Scorer, KeywordSignal, NGramScorer, fuzzy_ratio
+
+__all__ = [
+    "AuthzSignal", "BM25Scorer", "ContextSignal", "ConversationSignal",
+    "DispatchReport", "EventSignal", "KeywordSignal", "LanguageSignal",
+    "Message", "NGramScorer", "ReaskSignal", "RequestContext",
+    "SignalDispatcher", "SignalEvaluator", "SignalHit", "SignalResult",
+    "StructureSignal", "build_heuristic_dispatcher", "detect_language",
+    "fuzzy_ratio",
+]

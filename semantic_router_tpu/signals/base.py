@@ -1,0 +1,157 @@
+"""Signal-extraction base types.
+
+A *signal evaluator* inspects the request and reports which configured rules
+of its family matched (with confidences). Evaluators are registered per
+signal type and fanned out concurrently by the dispatcher (reference:
+pkg/classification/classifier_signal_dispatch.go:16-133 — one goroutine per
+active family; here one thread per family, with ML-backed families issuing
+batched calls into the TPU engine).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Protocol, Tuple
+
+
+@dataclass
+class Message:
+    role: str
+    content: str = ""
+    # Non-text payloads (image/audio URLs) and tool call markers.
+    images: List[str] = field(default_factory=list)
+    audio: List[str] = field(default_factory=list)
+    tool_calls: List[dict] = field(default_factory=list)
+    tool_call_id: str = ""
+
+
+_WORD_RE = re.compile(r"\w+", re.UNICODE)
+
+
+def text_units(text: str) -> int:
+    """Multilingual text units: word-ish tokens + CJK chars. The shared cheap
+    token estimate used by the context signal, structure densities, and
+    prompt compression (the reference similarly avoids running the real
+    tokenizer on the hot path)."""
+    words = len(_WORD_RE.findall(text))
+    cjk = sum(1 for ch in text if "一" <= ch <= "鿿")
+    return words + cjk
+
+
+@dataclass
+class RequestContext:
+    """Everything signal evaluators may inspect about one request."""
+
+    messages: List[Message] = field(default_factory=list)
+    model: str = ""
+    headers: Dict[str, str] = field(default_factory=dict)
+    user_id: str = ""
+    user_groups: List[str] = field(default_factory=list)
+    tools: List[dict] = field(default_factory=list)
+    event: Dict[str, Any] = field(default_factory=dict)  # type/severity/action_code/ts
+    stream: bool = False
+    body: Dict[str, Any] = field(default_factory=dict)
+    _user_text: Optional[str] = None
+    _full_text: Optional[str] = None
+
+    # -- derived views -----------------------------------------------------
+
+    @property
+    def user_text(self) -> str:
+        """Latest user message content — the primary classification input."""
+        if self._user_text is None:
+            for m in reversed(self.messages):
+                if m.role == "user" and m.content:
+                    self._user_text = m.content
+                    break
+            else:
+                self._user_text = ""
+        return self._user_text
+
+    @property
+    def full_text(self) -> str:
+        """All message content joined (history-aware classifiers)."""
+        if self._full_text is None:
+            self._full_text = "\n".join(m.content for m in self.messages if m.content)
+        return self._full_text
+
+    def text_for(self, include_history: bool) -> str:
+        return self.full_text if include_history else self.user_text
+
+    def user_turns(self) -> List[str]:
+        return [m.content for m in self.messages if m.role == "user"]
+
+    def approx_token_count(self) -> int:
+        return text_units(self.full_text)
+
+    def has_images(self) -> bool:
+        return any(m.images for m in self.messages)
+
+    @classmethod
+    def from_openai_body(cls, body: Dict[str, Any],
+                         headers: Optional[Dict[str, str]] = None
+                         ) -> "RequestContext":
+        """Build from an OpenAI ChatCompletions-shaped request body."""
+        headers = {k.lower(): v for k, v in (headers or {}).items()}
+        msgs: List[Message] = []
+        for m in body.get("messages", []) or []:
+            content = m.get("content", "")
+            images: List[str] = []
+            audio: List[str] = []
+            if isinstance(content, list):
+                parts = []
+                for part in content:
+                    if not isinstance(part, dict):
+                        continue
+                    ptype = part.get("type", "")
+                    if ptype == "text":
+                        parts.append(part.get("text", ""))
+                    elif ptype in ("image_url", "input_image"):
+                        url = part.get("image_url")
+                        if isinstance(url, dict):
+                            url = url.get("url", "")
+                        images.append(url or "")
+                    elif ptype in ("input_audio", "audio"):
+                        audio.append(str(part.get("input_audio", "")))
+                content = "\n".join(parts)
+            msgs.append(Message(
+                role=m.get("role", "user"),
+                content=content if isinstance(content, str) else "",
+                images=images,
+                audio=audio,
+                tool_calls=list(m.get("tool_calls", []) or []),
+                tool_call_id=m.get("tool_call_id", "") or "",
+            ))
+        groups_hdr = headers.get("x-authz-user-groups", "")
+        return cls(
+            messages=msgs,
+            model=body.get("model", ""),
+            headers=headers,
+            user_id=headers.get("x-authz-user-id", body.get("user", "") or ""),
+            user_groups=[g.strip() for g in groups_hdr.split(",") if g.strip()],
+            tools=list(body.get("tools", []) or []),
+            stream=bool(body.get("stream", False)),
+            body=body,
+        )
+
+
+@dataclass
+class SignalHit:
+    rule: str
+    confidence: float = 1.0
+    detail: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class SignalResult:
+    signal_type: str
+    hits: List[SignalHit] = field(default_factory=list)
+    latency_s: float = 0.0
+    error: Optional[str] = None  # evaluators fail open: error recorded, no hits
+
+
+class SignalEvaluator(Protocol):
+    signal_type: str
+
+    def evaluate(self, ctx: RequestContext) -> SignalResult: ...

@@ -1,0 +1,128 @@
+"""Concurrent signal dispatch.
+
+Mirrors the reference's per-request fan-out (classifier_signal_dispatch.go:
+16-133): only signal families referenced by decisions/projections are
+evaluated; each active family runs on its own worker; the join is the
+wall-clock of the slowest family. Evaluator exceptions are contained and
+recorded (fail-open — a dead signal family never kills routing, matching
+processor_core.go:74-81's guarantee).
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..config.schema import RouterConfig, SIGNAL_PROJECTION
+from ..decision.engine import SignalMatches
+from ..decision.projections import ProjectionEvaluator, ProjectionTrace
+from .base import RequestContext, SignalEvaluator, SignalResult
+
+
+@dataclass
+class DispatchReport:
+    results: Dict[str, SignalResult] = field(default_factory=dict)
+    wall_s: float = 0.0
+    projection_trace: Optional[ProjectionTrace] = None
+
+
+class SignalDispatcher:
+    def __init__(self, evaluators: List[SignalEvaluator],
+                 projections: Optional[ProjectionEvaluator] = None,
+                 used_types: Optional[List[str]] = None,
+                 max_workers: int = 24) -> None:
+        self.evaluators = {e.signal_type: e for e in evaluators}
+        self.projections = projections
+        self.used_types = set(used_types) if used_types is not None else None
+        self.pool = ThreadPoolExecutor(max_workers=max_workers,
+                                       thread_name_prefix="signal")
+
+    def active_evaluators(self) -> List[SignalEvaluator]:
+        if self.used_types is None:
+            return list(self.evaluators.values())
+        return [e for t, e in self.evaluators.items() if t in self.used_types]
+
+    def evaluate(self, ctx: RequestContext,
+                 skip_signals: Optional[List[str]] = None
+                 ) -> tuple[SignalMatches, DispatchReport]:
+        start = time.perf_counter()
+        report = DispatchReport()
+        skip = set(skip_signals or ())
+        active = [e for e in self.active_evaluators() if e.signal_type not in skip]
+
+        def run(e: SignalEvaluator) -> SignalResult:
+            t0 = time.perf_counter()
+            try:
+                return e.evaluate(ctx)
+            except Exception as exc:  # fail open per family
+                return SignalResult(signal_type=e.signal_type,
+                                    latency_s=time.perf_counter() - t0,
+                                    error=f"{type(exc).__name__}: {exc}")
+
+        if len(active) <= 1:
+            results = [run(e) for e in active]
+        else:
+            results = list(self.pool.map(run, active))
+
+        signals = SignalMatches()
+        for r in results:
+            report.results[r.signal_type] = r
+            for h in r.hits:
+                signals.add(r.signal_type, h.rule, h.confidence)
+                if h.detail:
+                    signals.details.setdefault(r.signal_type, {})[h.rule] = \
+                        h.detail.get("keywords", h.detail)
+
+        needs_projection = (
+            self.projections is not None
+            and (self.used_types is None or SIGNAL_PROJECTION in self.used_types
+                 or bool(self.projections.cfg.scores)
+                 or bool(self.projections.cfg.partitions))
+        )
+        if needs_projection:
+            report.projection_trace = self.projections.evaluate(signals)
+
+        report.wall_s = time.perf_counter() - start
+        return signals, report
+
+    def shutdown(self) -> None:
+        self.pool.shutdown(wait=False, cancel_futures=True)
+
+
+def build_heuristic_dispatcher(cfg: RouterConfig,
+                               extra: Optional[List[SignalEvaluator]] = None
+                               ) -> SignalDispatcher:
+    """Build a dispatcher with every model-free evaluator wired from config.
+    Learned (TPU-backed) evaluators are appended via *extra* by the engine
+    bootstrap (see semantic_router_tpu.signals.learned)."""
+    from .heuristic import (
+        AuthzSignal,
+        ContextSignal,
+        ConversationSignal,
+        EventSignal,
+        LanguageSignal,
+        ReaskSignal,
+        StructureSignal,
+    )
+    from .keyword import KeywordSignal
+
+    evaluators: List[SignalEvaluator] = [
+        KeywordSignal(cfg.signals.keywords),
+        ContextSignal(cfg.signals.context),
+        StructureSignal(cfg.signals.structure),
+        ConversationSignal(cfg.signals.conversation),
+        LanguageSignal(cfg.signals.language),
+        AuthzSignal(cfg.signals.role_bindings,
+                    fail_open=bool(cfg.authz.get("fail_open", True))),
+        EventSignal(cfg.signals.events),
+        ReaskSignal(cfg.signals.reasks),
+    ]
+    evaluators.extend(extra or [])
+    used = cfg.used_signal_types() or None
+    return SignalDispatcher(
+        evaluators,
+        projections=ProjectionEvaluator(cfg.projections),
+        used_types=used,
+    )

@@ -1,0 +1,379 @@
+"""Heuristic (model-free) signal evaluators.
+
+Families with parity targets in the reference's pure-Go classifiers:
+- context  → pkg/classification/context_classifier.go (token-length bands)
+- structure→ structure_classifier.go (count/exists/sequence/density features)
+- conversation → conversation-shape rules (message counts, tool activity)
+- language → language_classifier.go (lingua-go; here a self-contained
+  script+stopword detector — no external deps)
+- authz    → authz_classifier.go (role bindings over identity headers)
+- event    → event rules over request event metadata
+- reask    → reask_classifier.go (repeated user turn similarity)
+
+All evaluators are threshold-gated, return per-rule confidences, and fail
+open (errors produce empty results, never exceptions across the dispatch
+boundary).
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from difflib import SequenceMatcher
+from typing import Dict, List
+
+from ..config.schema import (
+    AuthzRule,
+    ContextRule,
+    ConversationRule,
+    EventRule,
+    FeatureSource,
+    NamedRule,
+    Predicate,
+    ReaskRule,
+    StructureRule,
+)
+from .base import RequestContext, SignalHit, SignalResult, text_units
+
+
+class ContextSignal:
+    signal_type = "context"
+
+    def __init__(self, rules: List[ContextRule]) -> None:
+        self.rules = rules
+
+    def evaluate(self, ctx: RequestContext) -> SignalResult:
+        start = time.perf_counter()
+        res = SignalResult(self.signal_type)
+        tokens = ctx.approx_token_count()
+        for r in self.rules:
+            if tokens >= r.min_tokens and (r.max_tokens == 0 or tokens <= r.max_tokens):
+                res.hits.append(SignalHit(r.name, 1.0, {"tokens": tokens}))
+        res.latency_s = time.perf_counter() - start
+        return res
+
+
+# --------------------------------------------------------------------------
+# Structure / conversation features
+# --------------------------------------------------------------------------
+
+def _text_units(text: str) -> int:
+    """Multilingual text units (density denominators)."""
+    return max(text_units(text), 1)
+
+
+def _source_occurrences(src: FeatureSource, text: str) -> int:
+    if src.type == "regex":
+        flags = 0 if src.case_sensitive else re.IGNORECASE
+        return len(re.findall(src.pattern, text, flags))
+    if src.type == "keyword_set":
+        t = text if src.case_sensitive else text.lower()
+        total = 0
+        for kw in src.keywords:
+            k = kw if src.case_sensitive else kw.lower()
+            total += t.count(k)
+        return total
+    if src.type == "sequence":
+        t = text if src.case_sensitive else text.lower()
+        hits = 0
+        for seq in src.sequences:
+            pos = 0
+            ok = True
+            for item in seq:
+                it = item if src.case_sensitive else item.lower()
+                idx = t.find(it, pos)
+                if idx < 0:
+                    ok = False
+                    break
+                pos = idx + len(it)
+            if ok:
+                hits += 1
+        return hits
+    return 0
+
+
+def _eval_feature(feature_type: str, src: FeatureSource, pred: Predicate,
+                  text: str) -> tuple[bool, float, dict]:
+    if feature_type == "exists":
+        n = _source_occurrences(src, text)
+        return n > 0, 1.0, {"count": n}
+    if feature_type == "sequence":
+        n = _source_occurrences(src, text)
+        return n > 0, 1.0, {"sequences": n}
+    n = _source_occurrences(src, text)
+    if feature_type == "density":
+        value = n / _text_units(text)
+    else:  # count
+        value = float(n)
+    return pred.check(value), 1.0, {"value": value}
+
+
+class StructureSignal:
+    signal_type = "structure"
+
+    def __init__(self, rules: List[StructureRule]) -> None:
+        self.rules = rules
+
+    def evaluate(self, ctx: RequestContext) -> SignalResult:
+        start = time.perf_counter()
+        res = SignalResult(self.signal_type)
+        text = ctx.user_text
+        for r in self.rules:
+            ok, conf, detail = _eval_feature(r.feature_type, r.source,
+                                             r.predicate, text)
+            if ok:
+                res.hits.append(SignalHit(r.name, conf, detail))
+        res.latency_s = time.perf_counter() - start
+        return res
+
+
+class ConversationSignal:
+    """Message-shape rules: counts by role, tool definitions, active tool
+    loops, developer messages (config.yaml:438-473)."""
+
+    signal_type = "conversation"
+
+    def __init__(self, rules: List[ConversationRule]) -> None:
+        self.rules = rules
+
+    def _feature_value(self, src: FeatureSource, ctx: RequestContext) -> float:
+        if src.type == "message":
+            role = src.role
+            if role == "non_user":
+                return float(sum(1 for m in ctx.messages if m.role != "user"))
+            return float(sum(1 for m in ctx.messages if m.role == role))
+        if src.type == "tool_definition":
+            return float(len(ctx.tools))
+        if src.type == "active_tool_loop":
+            # A tool-result continuation: last messages include a tool role or
+            # an assistant message with tool_calls awaiting a result.
+            for m in reversed(ctx.messages):
+                if m.role == "tool" or m.tool_call_id:
+                    return 1.0
+                if m.role == "assistant" and m.tool_calls:
+                    return 1.0
+                if m.role == "user":
+                    break
+            return 0.0
+        return 0.0
+
+    def evaluate(self, ctx: RequestContext) -> SignalResult:
+        start = time.perf_counter()
+        res = SignalResult(self.signal_type)
+        for r in self.rules:
+            v = self._feature_value(r.source, ctx)
+            if r.feature_type == "exists":
+                ok = v > 0
+            else:
+                ok = r.predicate.check(v)
+            if ok:
+                res.hits.append(SignalHit(r.name, 1.0, {"value": v}))
+        res.latency_s = time.perf_counter() - start
+        return res
+
+
+# --------------------------------------------------------------------------
+# Language detection
+# --------------------------------------------------------------------------
+
+_STOPWORDS: Dict[str, frozenset] = {
+    "en": frozenset("the a an and or of to in is are was were be have has i you it this that "
+                    "with for on as at by not what how why when can will would".split()),
+    "es": frozenset("el la los las un una y o de en es son que con para por no se su como "
+                    "cuando donde qué cómo está".split()),
+    "fr": frozenset("le la les un une et ou de est sont que avec pour par ne pas vous je il "
+                    "elle ce cette comment quand où".split()),
+    "de": frozenset("der die das ein eine und oder von ist sind zu mit für nicht ich sie es "
+                    "wie wann wo was warum".split()),
+    "pt": frozenset("o a os as um uma e ou de em é são que com para por não se como quando "
+                    "onde você".split()),
+    "it": frozenset("il lo la i gli le un una e o di è sono che con per non si come quando "
+                    "dove cosa".split()),
+    "ru": frozenset("и в не на я что он она это как по но из у за мы вы они быть".split()),
+    "nl": frozenset("de het een en of van is zijn dat met voor niet ik je hoe wat waar".split()),
+}
+
+
+def detect_language(text: str) -> Dict[str, float]:
+    """Lightweight language detection: script ranges for CJK/Cyrillic/Arabic/
+    Hangul/Greek, stopword voting for Latin-script languages. Returns
+    language-code → confidence. Replaces lingua-go
+    (pkg/classification/language_classifier.go) with equal signal semantics."""
+    if not text:
+        return {}
+    counts = {"han": 0, "hiragana": 0, "katakana": 0, "hangul": 0,
+              "cyrillic": 0, "arabic": 0, "greek": 0, "latin": 0}
+    total_alpha = 0
+    for ch in text:
+        o = ord(ch)
+        if 0x4E00 <= o <= 0x9FFF or 0x3400 <= o <= 0x4DBF:
+            counts["han"] += 1
+        elif 0x3040 <= o <= 0x309F:
+            counts["hiragana"] += 1
+        elif 0x30A0 <= o <= 0x30FF:
+            counts["katakana"] += 1
+        elif 0xAC00 <= o <= 0xD7AF:
+            counts["hangul"] += 1
+        elif 0x0400 <= o <= 0x04FF:
+            counts["cyrillic"] += 1
+        elif 0x0600 <= o <= 0x06FF:
+            counts["arabic"] += 1
+        elif 0x0370 <= o <= 0x03FF:
+            counts["greek"] += 1
+        elif ch.isalpha():
+            counts["latin"] += 1
+        else:
+            continue
+        total_alpha += 1
+    if total_alpha == 0:
+        return {}
+    scores: Dict[str, float] = {}
+    if counts["hiragana"] + counts["katakana"] > 0.05 * total_alpha:
+        scores["ja"] = (counts["hiragana"] + counts["katakana"] + counts["han"]) / total_alpha
+    elif counts["han"] > 0.3 * total_alpha:
+        scores["zh"] = counts["han"] / total_alpha
+    if counts["hangul"] > 0.3 * total_alpha:
+        scores["ko"] = counts["hangul"] / total_alpha
+    if counts["cyrillic"] > 0.3 * total_alpha:
+        scores["ru"] = counts["cyrillic"] / total_alpha
+    if counts["arabic"] > 0.3 * total_alpha:
+        scores["ar"] = counts["arabic"] / total_alpha
+    if counts["greek"] > 0.3 * total_alpha:
+        scores["el"] = counts["greek"] / total_alpha
+    if counts["latin"] > 0.5 * total_alpha:
+        words = [w for w in re.findall(r"[^\W\d_]+", text.lower()) if len(w) > 1]
+        if words:
+            votes = {lang: sum(1 for w in words if w in sw)
+                     for lang, sw in _STOPWORDS.items()}
+            best = max(votes.items(), key=lambda kv: kv[1])
+            if best[1] > 0:
+                scores[best[0]] = min(1.0, 0.3 + best[1] / len(words) * 2.0)
+            else:
+                scores["en"] = 0.3  # latin default prior
+    return scores
+
+
+class LanguageSignal:
+    signal_type = "language"
+    # threshold 0 in config means "use the built-in default" — the reference
+    # documents exactly this (config/config.yaml: "0 = built-in default 0.3").
+    DEFAULT_THRESHOLD = 0.3
+
+    def __init__(self, rules: List[NamedRule]) -> None:
+        self.rules = rules
+
+    def evaluate(self, ctx: RequestContext) -> SignalResult:
+        start = time.perf_counter()
+        res = SignalResult(self.signal_type)
+        scores = detect_language(ctx.user_text)
+        for r in self.rules:
+            conf = scores.get(r.name, 0.0)
+            threshold = r.threshold or self.DEFAULT_THRESHOLD
+            if conf >= threshold:
+                res.hits.append(SignalHit(r.name, conf))
+        res.latency_s = time.perf_counter() - start
+        res.error = None
+        return res
+
+
+class AuthzSignal:
+    """Role bindings: match identity (user id/groups from ext_authz-injected
+    headers) against subjects (reference: authz_classifier.go +
+    role_bindings config)."""
+
+    signal_type = "authz"
+
+    def __init__(self, rules: List[AuthzRule], fail_open: bool = True) -> None:
+        self.rules = rules
+        self.fail_open = fail_open
+
+    def evaluate(self, ctx: RequestContext) -> SignalResult:
+        start = time.perf_counter()
+        res = SignalResult(self.signal_type)
+        try:
+            for r in self.rules:
+                if self._matches(r, ctx):
+                    res.hits.append(SignalHit(r.name, 1.0, {"role": r.role}))
+        except Exception:
+            # fail_open=False (reference authz_fail_open.go): an authz
+            # evaluation error must block rather than silently pass.
+            if not self.fail_open:
+                raise
+            res.error = "authz evaluation failed (fail-open)"
+        res.latency_s = time.perf_counter() - start
+        return res
+
+    @staticmethod
+    def _matches(rule: AuthzRule, ctx: RequestContext) -> bool:
+        for subj in rule.subjects:
+            kind = str(subj.get("kind", "")).lower()
+            name = subj.get("name", "")
+            if kind == "group" and name in ctx.user_groups:
+                return True
+            if kind == "user" and name == ctx.user_id:
+                return True
+        return False
+
+
+class EventSignal:
+    signal_type = "event"
+
+    def __init__(self, rules: List[EventRule]) -> None:
+        self.rules = rules
+
+    def evaluate(self, ctx: RequestContext) -> SignalResult:
+        start = time.perf_counter()
+        res = SignalResult(self.signal_type)
+        ev = ctx.event or {}
+        etype = ev.get("type") or ctx.headers.get("x-vsr-event-type", "")
+        severity = ev.get("severity") or ctx.headers.get("x-vsr-event-severity", "")
+        action = ev.get("action_code") or ctx.headers.get("x-vsr-event-action", "")
+        if not (etype or severity or action):
+            res.latency_s = time.perf_counter() - start
+            return res
+        for r in self.rules:
+            if r.event_types and etype not in r.event_types:
+                continue
+            if r.severities and severity not in r.severities:
+                continue
+            if r.action_codes and action not in r.action_codes:
+                continue
+            res.hits.append(SignalHit(r.name, 1.0, {
+                "type": etype, "severity": severity, "action": action}))
+        res.latency_s = time.perf_counter() - start
+        return res
+
+
+class ReaskSignal:
+    """Repeated-user-turn dissatisfaction detection
+    (pkg/classification/reask_classifier.go): the current user turn is
+    compared with the previous ``lookback_turns`` user turns; a rule matches
+    when *all* looked-back turns are ≥ threshold similar."""
+
+    signal_type = "reask"
+
+    def __init__(self, rules: List[ReaskRule]) -> None:
+        self.rules = rules
+
+    @staticmethod
+    def _similarity(a: str, b: str) -> float:
+        if not a or not b:
+            return 0.0
+        return SequenceMatcher(None, a.lower(), b.lower()).ratio()
+
+    def evaluate(self, ctx: RequestContext) -> SignalResult:
+        start = time.perf_counter()
+        res = SignalResult(self.signal_type)
+        turns = ctx.user_turns()
+        if len(turns) >= 2:
+            current = turns[-1]
+            for r in self.rules:
+                lookback = turns[-1 - r.lookback_turns:-1]
+                if len(lookback) < r.lookback_turns:
+                    continue
+                sims = [self._similarity(current, t) for t in lookback]
+                if sims and min(sims) >= r.threshold:
+                    res.hits.append(SignalHit(r.name, min(sims),
+                                              {"similarities": sims}))
+        res.latency_s = time.perf_counter() - start
+        return res

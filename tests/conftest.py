@@ -1,0 +1,30 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU platform so all
+sharding/pjit tests run without TPU hardware (the driver separately
+dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pathlib
+
+import pytest
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+
+@pytest.fixture(scope="session")
+def fixture_config_path() -> str:
+    return str(FIXTURES / "router_config.yaml")
+
+
+@pytest.fixture(scope="session")
+def router_config(fixture_config_path):
+    from semantic_router_tpu.config import load_config
+
+    return load_config(fixture_config_path)

@@ -1,0 +1,133 @@
+"""Config loader/schema/validator tests (reference: pkg/config validator*.go
+behaviours)."""
+
+import os
+
+import pytest
+
+from semantic_router_tpu.config import (
+    ConfigError,
+    RouterConfig,
+    load_config,
+    loads_config,
+    parse_token_count,
+    substitute_env,
+    validate_config,
+)
+
+
+def test_load_fixture(router_config):
+    cfg = router_config
+    assert [m.name for m in cfg.model_cards] == ["qwen3-8b", "qwen3-32b", "sdxl-image"]
+    assert cfg.default_model == "qwen3-8b"
+    assert cfg.semantic_cache.enabled
+    assert cfg.semantic_cache.eviction_policy == "lru"
+    assert cfg.engine.seq_len_buckets == [128, 512, 2048]
+    assert len(cfg.decisions) == 7
+    assert len(cfg.signals.keywords) == 5
+    assert cfg.signals.context[0].min_tokens == 2048  # "2K"
+    assert cfg.signals.complexity[0].composer is not None
+
+
+def test_validation_clean(router_config):
+    errors = [e for e in validate_config(router_config) if e.fatal]
+    assert errors == [], [str(e) for e in errors]
+
+
+def test_token_count_parsing():
+    assert parse_token_count("32K") == 32 * 1024
+    assert parse_token_count("256K") == 256 * 1024
+    assert parse_token_count(1000) == 1000
+    assert parse_token_count("2M") == 2 * 1024 * 1024
+    assert parse_token_count(None) == 0
+
+
+def test_env_substitution():
+    env = {"PORT": "9190", "EMPTY": ""}
+    assert substitute_env("port: ${PORT}", env) == "port: 9190"
+    assert substitute_env("x: ${MISSING:-fallback}", env) == "x: fallback"
+    assert substitute_env("x: ${EMPTY:-fb}", env) == "x: fb"
+    assert substitute_env("x: ${MISSING}", env) == "x: "
+
+
+def test_unknown_signal_reference_rejected():
+    bad = """
+routing:
+  signals:
+    domains:
+      - name: business
+  decisions:
+    - name: d1
+      rules:
+        operator: OR
+        conditions:
+          - {type: domain, name: nonexistent}
+      modelRefs: [{model: m1}]
+  modelCards:
+    - {name: m1}
+"""
+    with pytest.raises(ConfigError, match="nonexistent"):
+        loads_config(bad)
+
+
+def test_unknown_model_ref_rejected():
+    bad = """
+routing:
+  modelCards:
+    - {name: m1}
+  signals:
+    domains: [{name: business}]
+  decisions:
+    - name: d1
+      rules:
+        operator: OR
+        conditions: [{type: domain, name: business}]
+      modelRefs: [{model: ghost-model}]
+"""
+    with pytest.raises(ConfigError, match="ghost-model"):
+        loads_config(bad)
+
+
+def test_duplicate_names_rejected():
+    bad = """
+routing:
+  signals:
+    domains: [{name: a}, {name: a}]
+"""
+    with pytest.raises(ConfigError, match="duplicate"):
+        loads_config(bad)
+
+
+def test_used_signal_types(router_config):
+    used = router_config.used_signal_types()
+    # every family referenced in decisions/composer/projections
+    for expected in ("keyword", "domain", "complexity", "modality", "jailbreak",
+                     "authz", "language", "projection", "context",
+                     "embedding", "structure"):
+        assert expected in used, f"{expected} missing from {used}"
+
+
+def test_projection_output_reference_valid(router_config):
+    # escalated_band_route references projection:support_escalated — validator
+    # resolves it against mapping outputs.
+    errors = [str(e) for e in validate_config(router_config)]
+    assert not any("support_escalated" in e for e in errors)
+
+
+def test_model_card_helpers(router_config):
+    card = router_config.model_card("qwen3-32b")
+    assert card is not None
+    assert card.param_size_billions() == 32.0
+    assert card.loras[0].name == "cs-expert"
+    assert router_config.model_card("missing") is None
+
+
+def test_ascending_bucket_validation():
+    bad = """
+engine:
+  seq_len_buckets: [512, 128]
+routing:
+  modelCards: [{name: m1}]
+"""
+    with pytest.raises(ConfigError, match="ascending"):
+        loads_config(bad)

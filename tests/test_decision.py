@@ -1,0 +1,140 @@
+"""Decision engine tests (reference: pkg/decision/engine_*_test.go)."""
+
+from semantic_router_tpu.config import Decision, RuleNode
+from semantic_router_tpu.decision import DecisionEngine, SignalMatches
+
+
+def leaf(styp, name):
+    return RuleNode(signal_type=styp, name=name)
+
+
+def mk_decision(name, rules, priority=0):
+    return Decision(name=name, rules=rules, priority=priority)
+
+
+def test_or_match():
+    eng = DecisionEngine([
+        mk_decision("d1", RuleNode(operator="OR", conditions=[
+            leaf("domain", "business"), leaf("keyword", "urgent")]))
+    ])
+    sm = SignalMatches()
+    sm.add("domain", "business", 0.9)
+    res = eng.evaluate(sm)
+    assert res is not None
+    assert res.decision.name == "d1"
+    assert res.confidence == 0.9
+    assert res.matched_rules == ["domain:business"]
+
+
+def test_and_requires_all():
+    rules = RuleNode(operator="AND", conditions=[
+        leaf("domain", "business"), leaf("keyword", "urgent")])
+    eng = DecisionEngine([mk_decision("d1", rules)])
+    sm = SignalMatches()
+    sm.add("domain", "business", 0.9)
+    assert eng.evaluate(sm) is None
+    sm.add("keyword", "urgent", 0.7)
+    res = eng.evaluate(sm)
+    assert res is not None
+    assert res.confidence == 0.7  # AND = min
+
+
+def test_not_inverts():
+    rules = RuleNode(operator="AND", conditions=[
+        leaf("keyword", "urgent"),
+        RuleNode(operator="NOT", conditions=[leaf("authz", "admin")]),
+    ])
+    eng = DecisionEngine([mk_decision("d1", rules)])
+    sm = SignalMatches()
+    sm.add("keyword", "urgent")
+    assert eng.evaluate(sm) is not None
+    sm.add("authz", "admin")
+    assert eng.evaluate(sm) is None
+
+
+def test_priority_strategy_picks_highest_priority():
+    d_low = mk_decision("low", RuleNode(operator="OR", conditions=[
+        leaf("domain", "x")]), priority=10)
+    d_high = mk_decision("high", RuleNode(operator="OR", conditions=[
+        leaf("domain", "x")]), priority=100)
+    eng = DecisionEngine([d_low, d_high], strategy="priority")
+    sm = SignalMatches()
+    sm.add("domain", "x", 0.5)
+    assert eng.evaluate(sm).decision.name == "high"
+
+
+def test_confidence_strategy_picks_highest_confidence():
+    d1 = mk_decision("a", RuleNode(operator="OR", conditions=[
+        leaf("domain", "x")]), priority=100)
+    d2 = mk_decision("b", RuleNode(operator="OR", conditions=[
+        leaf("embedding", "y")]), priority=10)
+    eng = DecisionEngine([d1, d2], strategy="confidence")
+    sm = SignalMatches()
+    sm.add("domain", "x", 0.5)
+    sm.add("embedding", "y", 0.95)
+    assert eng.evaluate(sm).decision.name == "b"
+
+
+def test_no_match_returns_none():
+    eng = DecisionEngine([mk_decision("d1", RuleNode(operator="OR", conditions=[
+        leaf("domain", "business")]))])
+    assert eng.evaluate(SignalMatches()) is None
+
+
+def test_complexity_level_matching():
+    # decision references "needs_reasoning:hard"; evaluator reports exactly that
+    eng = DecisionEngine([mk_decision("d1", RuleNode(operator="OR", conditions=[
+        leaf("complexity", "needs_reasoning:hard")]))])
+    sm = SignalMatches()
+    sm.add("complexity", "needs_reasoning:hard", 0.8)
+    assert eng.evaluate(sm) is not None
+    # bare rule name matches any level
+    eng2 = DecisionEngine([mk_decision("d2", RuleNode(operator="OR", conditions=[
+        leaf("complexity", "needs_reasoning")]))])
+    assert eng2.evaluate(sm) is not None
+
+
+def test_default_confidence_is_one():
+    eng = DecisionEngine([mk_decision("d1", RuleNode(operator="OR", conditions=[
+        leaf("keyword", "k")]))])
+    sm = SignalMatches()
+    sm.matches["keyword"] = ["k"]  # no explicit confidence recorded
+    res = eng.evaluate(sm)
+    assert res.confidence == 1.0
+
+
+def test_nested_tree():
+    # (domain:a AND (keyword:k OR embedding:e)) — nested composite
+    rules = RuleNode(operator="AND", conditions=[
+        leaf("domain", "a"),
+        RuleNode(operator="OR", conditions=[
+            leaf("keyword", "k"), leaf("embedding", "e")]),
+    ])
+    eng = DecisionEngine([mk_decision("d", rules)])
+    sm = SignalMatches()
+    sm.add("domain", "a", 0.9)
+    sm.add("embedding", "e", 0.6)
+    res = eng.evaluate(sm)
+    assert res is not None
+    assert res.confidence == 0.6
+    assert set(res.matched_rules) == {"domain:a", "embedding:e"}
+
+
+def test_evaluate_all_ordering():
+    d1 = mk_decision("p200", RuleNode(operator="OR", conditions=[leaf("domain", "x")]), 200)
+    d2 = mk_decision("p100", RuleNode(operator="OR", conditions=[leaf("domain", "x")]), 100)
+    eng = DecisionEngine([d2, d1])
+    sm = SignalMatches()
+    sm.add("domain", "x")
+    ordered = eng.evaluate_all(sm)
+    assert [r.decision.name for r in ordered] == ["p200", "p100"]
+
+
+def test_fixture_decisions_end_to_end(router_config):
+    eng = DecisionEngine(router_config.decisions, router_config.strategy)
+    sm = SignalMatches()
+    sm.add("domain", "computer science", 0.92)
+    sm.add("complexity", "needs_reasoning:hard", 0.81)
+    res = eng.evaluate(sm)
+    assert res.decision.name == "cs_reasoning_route"
+    assert res.decision.model_refs[0].lora_name == "cs-expert"

@@ -1,0 +1,138 @@
+"""Projection evaluation tests (reference: classifier_projections.go)."""
+
+import pytest
+
+from semantic_router_tpu.config import ProjectionsConfig
+from semantic_router_tpu.decision import ProjectionEvaluator, SignalMatches
+
+
+def make_cfg(d):
+    return ProjectionsConfig.from_dict(d)
+
+
+def test_partition_exclusive_winner():
+    cfg = make_cfg({
+        "partitions": [{
+            "name": "intents", "semantics": "exclusive", "temperature": 0.3,
+            "members": ["tech", "billing"], "default": "tech"}],
+    })
+    sm = SignalMatches()
+    sm.add("embedding", "tech", 0.9)
+    sm.add("embedding", "billing", 0.4)
+    trace = ProjectionEvaluator(cfg).evaluate(sm)
+    assert "tech" in sm.matches["projection"]
+    assert "billing" not in sm.matches["projection"]
+    dist = trace.partitions["intents"]
+    assert dist["tech"] > dist["billing"]
+    assert abs(sum(dist.values()) - 1.0) < 1e-9
+
+
+def test_partition_default_on_no_match():
+    cfg = make_cfg({
+        "partitions": [{
+            "name": "intents", "members": ["tech", "billing"],
+            "default": "billing"}],
+    })
+    sm = SignalMatches()
+    ProjectionEvaluator(cfg).evaluate(sm)
+    assert sm.matches["projection"] == ["billing"]
+    assert sm.confidence("projection", "billing") == 1.0
+
+
+def test_weighted_sum_score_and_bands():
+    cfg = make_cfg({
+        "scores": [{
+            "name": "difficulty", "method": "weighted_sum",
+            "inputs": [
+                {"type": "embedding", "name": "tech", "weight": 0.5,
+                 "value_source": "confidence"},
+                {"type": "context", "name": "long", "weight": 0.5},
+            ]}],
+        "mappings": [{
+            "name": "band", "source": "difficulty",
+            "outputs": [
+                {"name": "low", "lte": 0.3},
+                {"name": "high", "gt": 0.3},
+            ]}],
+    })
+    sm = SignalMatches()
+    sm.add("embedding", "tech", 0.8)
+    sm.add("context", "long", 1.0)
+    trace = ProjectionEvaluator(cfg).evaluate(sm)
+    assert trace.scores["difficulty"] == pytest.approx(0.5 * 0.8 + 0.5)
+    assert trace.mappings["band"] == "high"
+    assert "high" in sm.matches["projection"]
+
+
+def test_miss_value_used_when_unmatched():
+    cfg = make_cfg({
+        "scores": [{
+            "name": "s",
+            "inputs": [{"type": "domain", "name": "x", "weight": 1.0,
+                        "match": 1.0, "miss": 0.25}]}],
+    })
+    sm = SignalMatches()
+    trace = ProjectionEvaluator(cfg).evaluate(sm)
+    assert trace.scores["s"] == pytest.approx(0.25)
+
+
+def test_negative_weights():
+    cfg = make_cfg({
+        "scores": [{
+            "name": "s",
+            "inputs": [
+                {"type": "embedding", "name": "a", "weight": 0.5},
+                {"type": "embedding", "name": "b", "weight": -0.3},
+            ]}],
+    })
+    sm = SignalMatches()
+    sm.add("embedding", "a", 1.0)
+    sm.add("embedding", "b", 1.0)
+    trace = ProjectionEvaluator(cfg).evaluate(sm)
+    assert trace.scores["s"] == pytest.approx(0.2)
+
+
+def test_sigmoid_calibration_confidence():
+    cfg = make_cfg({
+        "scores": [{
+            "name": "s",
+            "inputs": [{"type": "domain", "name": "x", "weight": 1.0}]}],
+        "mappings": [{
+            "name": "band", "source": "s",
+            "calibration": {"method": "sigmoid_distance", "slope": 10.0},
+            "outputs": [{"name": "hit", "gte": 0.5}]}],
+    })
+    sm = SignalMatches()
+    sm.add("domain", "x", 1.0)
+    ProjectionEvaluator(cfg).evaluate(sm)
+    conf = sm.confidence("projection", "hit")
+    # score=1.0, edge 0.5 → sigmoid(10*0.5) ≈ 0.993
+    assert 0.9 < conf < 1.0
+
+
+def test_kb_metric_input():
+    cfg = make_cfg({
+        "scores": [{
+            "name": "bias",
+            "inputs": [{"type": "kb_metric", "kb": "privacy_kb",
+                        "metric": "private_vs_public", "weight": 1.0,
+                        "value_source": "score"}]}],
+    })
+    sm = SignalMatches()
+    trace = ProjectionEvaluator(cfg).evaluate(
+        sm, kb_metrics={"privacy_kb": {"private_vs_public": 0.7}})
+    assert trace.scores["bias"] == pytest.approx(0.7)
+
+
+def test_fixture_projection_pipeline(router_config):
+    ev = ProjectionEvaluator(router_config.projections)
+    sm = SignalMatches()
+    sm.add("embedding", "technical_support", 0.9)
+    sm.add("complexity", "needs_reasoning:hard", 1.0)
+    sm.add("context", "long_context", 1.0)
+    sm.add("structure", "first_then_flow", 1.0)
+    trace = ev.evaluate(sm)
+    # 0.2*0.9 + 0.4 + 0.2 + 0.2 = 0.98 → support_escalated
+    assert trace.scores["request_difficulty"] == pytest.approx(0.98)
+    assert trace.mappings["request_band"] == "support_escalated"
+    assert "technical_support" in sm.matches["projection"]
