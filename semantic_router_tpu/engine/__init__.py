@@ -1,0 +1,12 @@
+from .batcher import BatchItem, DynamicBatcher, pick_bucket, pow2_batch
+from .classify import (
+    ClassResult,
+    EntitySpan,
+    InferenceEngine,
+    TokenClassResult,
+)
+
+__all__ = [
+    "BatchItem", "ClassResult", "DynamicBatcher", "EntitySpan",
+    "InferenceEngine", "TokenClassResult", "pick_bucket", "pow2_batch",
+]

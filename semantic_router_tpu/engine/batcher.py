@@ -1,0 +1,182 @@
+"""Dynamic batching shim — the host-side front end of the TPU engine.
+
+Capability parity with the reference's continuous batch scheduler (N6,
+candle-binding/src/model_architectures/embedding/continuous_batch_scheduler.rs:
+124-250: queue → batch builder bounded by max_batch_size / max_wait_ms →
+single forward → result distribution), re-designed for XLA's compilation
+model:
+
+- requests are grouped by (group_key, seq-len bucket); sequences pad to the
+  bucket edge and batches pad to the next power-of-two ≤ max_batch_size, so
+  the jit cache sees a small closed set of shapes (SURVEY.md hard-part 1:
+  bucketed padding + compile-cache discipline).
+- adaptive wait: the scheduler sleeps at most ``max_wait_ms`` past the
+  oldest queued item, but fires immediately when a full batch is ready or
+  the queue is drained at low QPS (no added queueing latency when idle —
+  hard-part 2).
+- fail-open: a forward error resolves every future in the batch with the
+  exception rather than wedging callers.
+
+The runner receives (group_key, list[BatchItem]) and returns one result per
+item; it owns padding/stacking since shapes are model-specific.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence
+
+
+@dataclass
+class BatchItem:
+    payload: Any  # model-specific (e.g. Encoding)
+    future: Future = field(default_factory=Future)
+    enqueue_t: float = field(default_factory=time.perf_counter)
+
+
+BatchRunner = Callable[[Hashable, List[BatchItem]], Sequence[Any]]
+
+
+def pow2_batch(n: int, max_batch: int) -> int:
+    """Smallest power of two ≥ n, capped at max_batch."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return min(b, max_batch)
+
+
+def pick_bucket(seq_len: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if seq_len <= b:
+            return b
+    return buckets[-1]
+
+
+class DynamicBatcher:
+    """Coalesces concurrent requests into padded batches per group."""
+
+    def __init__(self, runner: BatchRunner, max_batch_size: int = 32,
+                 max_wait_ms: float = 2.0, name: str = "batcher") -> None:
+        self.runner = runner
+        self.max_batch_size = max(1, max_batch_size)
+        self.max_wait_s = max_wait_ms / 1000.0
+        self._queues: Dict[Hashable, List[BatchItem]] = {}
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._stop = False
+        self._stats = {"batches": 0, "items": 0, "max_batch": 0}
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=name)
+        self._thread.start()
+
+    # -- public ------------------------------------------------------------
+
+    def submit(self, group_key: Hashable, payload: Any) -> Future:
+        item = BatchItem(payload)
+        with self._wake:
+            if self._stop:
+                raise RuntimeError("batcher stopped")
+            self._queues.setdefault(group_key, []).append(item)
+            self._wake.notify()
+        return item.future
+
+    def submit_many(self, group_key: Hashable,
+                    payloads: Sequence[Any]) -> List[Future]:
+        items = [BatchItem(p) for p in payloads]
+        with self._wake:
+            if self._stop:
+                raise RuntimeError("batcher stopped")
+            self._queues.setdefault(group_key, []).extend(items)
+            self._wake.notify()
+        return [i.future for i in items]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return dict(self._stats)
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        with self._wake:
+            self._stop = True
+            self._wake.notify_all()
+        self._thread.join(timeout=timeout)
+        # resolve anything left
+        with self._lock:
+            for items in self._queues.values():
+                for it in items:
+                    if not it.future.done():
+                        it.future.set_exception(RuntimeError("batcher stopped"))
+            self._queues.clear()
+
+    # -- scheduler loop ----------------------------------------------------
+
+    def _ready_group(self) -> Optional[Hashable]:
+        """A group is ready when full, or its oldest item aged past
+        max_wait, or (low-QPS fast path) nothing else is pending."""
+        now = time.perf_counter()
+        oldest_key, oldest_age = None, -1.0
+        total = 0
+        for key, items in self._queues.items():
+            if not items:
+                continue
+            total += len(items)
+            if len(items) >= self.max_batch_size:
+                return key
+            age = now - items[0].enqueue_t
+            if age > oldest_age:
+                oldest_key, oldest_age = key, age
+        if oldest_key is None:
+            return None
+        if oldest_age >= self.max_wait_s:
+            return oldest_key
+        # single pending group and small queue: fire immediately — waiting
+        # cannot grow the batch if no concurrent traffic exists
+        if total == len(self._queues.get(oldest_key, ())) and total <= 1:
+            return oldest_key
+        return None
+
+    def _next_deadline(self) -> Optional[float]:
+        deadline = None
+        for items in self._queues.values():
+            if items:
+                d = items[0].enqueue_t + self.max_wait_s
+                deadline = d if deadline is None else min(deadline, d)
+        return deadline
+
+    def _loop(self) -> None:
+        while True:
+            with self._wake:
+                while not self._stop:
+                    key = self._ready_group()
+                    if key is not None:
+                        break
+                    deadline = self._next_deadline()
+                    timeout = None if deadline is None else \
+                        max(0.0, deadline - time.perf_counter())
+                    self._wake.wait(timeout=timeout)
+                if self._stop:
+                    return
+                items = self._queues[key]
+                batch = items[:self.max_batch_size]
+                self._queues[key] = items[self.max_batch_size:]
+                self._stats["batches"] += 1
+                self._stats["items"] += len(batch)
+                self._stats["max_batch"] = max(self._stats["max_batch"],
+                                               len(batch))
+            self._run_batch(key, batch)
+
+    def _run_batch(self, key: Hashable, batch: List[BatchItem]) -> None:
+        try:
+            results = self.runner(key, batch)
+            if len(results) != len(batch):
+                raise RuntimeError(
+                    f"runner returned {len(results)} results for "
+                    f"{len(batch)} items")
+            for item, res in zip(batch, results):
+                item.future.set_result(res)
+        except Exception as exc:  # fail open: propagate to callers
+            for item in batch:
+                if not item.future.done():
+                    item.future.set_exception(exc)

@@ -1,0 +1,245 @@
+"""The TPU inference engine: classifier registry + batched jit execution.
+
+This collapses the reference's N1–N5/N7 native inference stack (Candle/ORT
+classifier + embedding engines behind the CGo FFI, SURVEY.md §2.1) into one
+JAX service:
+
+- tasks register a Flax module + params + tokenizer + label set;
+- requests flow through the DynamicBatcher, grouped by (task, seq bucket),
+  padded to bucket edges, executed as one jit forward per batch;
+- sequence tasks return softmax label results; token tasks decode entity
+  spans host-side with exact char offsets (hard-part 5).
+
+Shape discipline: seq lens come from ``engine.seq_len_buckets``, batch dims
+pad to powers of two, so the jit cache holds ≤ |buckets|·log2(max_batch)
+entries per task — this is what keeps p99 added latency in budget on TPU
+(SURVEY.md hard-part 1/2).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.schema import InferenceEngineConfig
+from ..utils.tokenization import Encoding, Tokenizer, decode_entity_spans
+from .batcher import BatchItem, DynamicBatcher, pick_bucket, pow2_batch
+
+
+@dataclass
+class ClassResult:
+    """Sequence-classification result (reference: the C structs marshalled
+    back through unified_classifier_cgo_results.go:261)."""
+
+    label: str
+    index: int
+    confidence: float
+    probs: Dict[str, float] = field(default_factory=dict)
+    latency_s: float = 0.0
+
+
+@dataclass
+class EntitySpan:
+    type: str
+    start: int
+    end: int
+    text: str
+    score: float
+
+
+@dataclass
+class TokenClassResult:
+    entities: List[EntitySpan] = field(default_factory=list)
+    latency_s: float = 0.0
+
+
+@dataclass
+class _Task:
+    name: str
+    kind: str  # "sequence" | "token"
+    labels: List[str]
+    tokenizer: Tokenizer
+    apply_fn: Callable  # jitted (params, ids, mask) -> logits
+    params: Any
+    max_seq_len: int
+    pad_id: int = 0
+
+
+@dataclass
+class _Payload:
+    text: str
+    encoding: Encoding
+    threshold: float = 0.5
+    submit_t: float = field(default_factory=time.perf_counter)
+
+
+class InferenceEngine:
+    """Owner of all TPU-served classifier tasks + the batching shim."""
+
+    def __init__(self, cfg: Optional[InferenceEngineConfig] = None) -> None:
+        self.cfg = cfg or InferenceEngineConfig()
+        self._tasks: Dict[str, _Task] = {}
+        self._lock = threading.Lock()
+        self.batcher = DynamicBatcher(
+            self._run_batch,
+            max_batch_size=self.cfg.max_batch_size,
+            max_wait_ms=self.cfg.max_wait_ms,
+            name="tpu-engine-batcher",
+        )
+
+    # -- registration ------------------------------------------------------
+
+    def register_task(self, name: str, kind: str, module, params,
+                      tokenizer: Tokenizer, labels: List[str],
+                      max_seq_len: int = 0, pad_id: int = 0) -> None:
+        if kind not in ("sequence", "token"):
+            raise ValueError(f"unknown task kind {kind!r}")
+        apply_fn = jax.jit(module.apply)
+        max_len = max_seq_len or self.cfg.seq_len_buckets[-1]
+        with self._lock:
+            self._tasks[name] = _Task(name, kind, list(labels), tokenizer,
+                                      apply_fn, params, max_len, pad_id)
+
+    def has_task(self, name: str) -> bool:
+        return name in self._tasks
+
+    def task_labels(self, name: str) -> List[str]:
+        return list(self._tasks[name].labels)
+
+    def tasks(self) -> List[str]:
+        return list(self._tasks)
+
+    # -- public inference --------------------------------------------------
+
+    def classify(self, task: str, text: str, timeout: float = 30.0
+                 ) -> ClassResult:
+        return self.classify_batch(task, [text], timeout=timeout)[0]
+
+    def classify_batch(self, task: str, texts: Sequence[str],
+                       timeout: float = 30.0) -> List[ClassResult]:
+        futures = self._submit_texts(task, texts)
+        return [f.result(timeout=timeout) for f in futures]
+
+    def classify_async(self, task: str, text: str):
+        return self._submit_texts(task, [text])[0]
+
+    def token_classify(self, task: str, text: str, threshold: float = 0.5,
+                       timeout: float = 30.0) -> TokenClassResult:
+        t = self._require(task, kind="token")
+        enc = t.tokenizer.encode(text, max_length=t.max_seq_len)
+        bucket = pick_bucket(len(enc), self.cfg.seq_len_buckets)
+        fut = self.batcher.submit((task, bucket),
+                                  _Payload(text, enc, threshold))
+        return fut.result(timeout=timeout)
+
+    def warmup(self, tasks: Optional[Sequence[str]] = None,
+               buckets: Optional[Sequence[int]] = None) -> None:
+        """Pre-trigger jit compilation for the hot (task, bucket, batch=1)
+        shapes (reference warmupRouterRuntime, runtime_bootstrap.go:439).
+        The warmup text carries ≥bucket words so (after truncation to the
+        task max) the encoding actually lands in the target bucket."""
+        for name in tasks or list(self._tasks):
+            t = self._tasks.get(name)
+            for b in buckets or self.cfg.seq_len_buckets[:2]:
+                if t is not None and b > t.max_seq_len:
+                    continue
+                try:
+                    fn = (self.token_classify if t is not None
+                          and t.kind == "token" else self.classify)
+                    fn(name, "warmup " * b)
+                except Exception:
+                    pass
+
+    def shutdown(self) -> None:
+        self.batcher.shutdown()
+
+    # -- internals ---------------------------------------------------------
+
+    def _require(self, task: str, kind: Optional[str] = None) -> _Task:
+        t = self._tasks.get(task)
+        if t is None:
+            raise KeyError(f"task {task!r} not registered "
+                           f"(known: {sorted(self._tasks)})")
+        if kind is not None and t.kind != kind:
+            raise TypeError(f"task {task!r} is a {t.kind} task; use "
+                            f"{'token_classify' if t.kind == 'token' else 'classify'}()")
+        return t
+
+    def _submit_texts(self, task: str, texts: Sequence[str]):
+        t = self._require(task, kind="sequence")
+        payloads = []
+        buckets = []
+        for text in texts:
+            enc = t.tokenizer.encode(text, max_length=t.max_seq_len)
+            payloads.append(_Payload(text, enc))
+            buckets.append(pick_bucket(len(enc), self.cfg.seq_len_buckets))
+        futures = []
+        for payload, bucket in zip(payloads, buckets):
+            futures.append(self.batcher.submit((task, bucket), payload))
+        return futures
+
+    def _run_batch(self, group_key: Hashable,
+                   items: List[BatchItem]) -> Sequence[Any]:
+        task_name, bucket = group_key
+        t = self._require(task_name)
+        n = len(items)
+        padded_n = pow2_batch(n, self.cfg.max_batch_size)
+
+        ids = np.full((padded_n, bucket), t.pad_id, dtype=np.int32)
+        mask = np.zeros((padded_n, bucket), dtype=np.int32)
+        for i, item in enumerate(items):
+            enc: Encoding = item.payload.encoding
+            L = min(len(enc), bucket)
+            ids[i, :L] = enc.ids[:L]
+            mask[i, :L] = enc.attention_mask[:L]
+
+        logits = t.apply_fn(t.params, jnp.asarray(ids), jnp.asarray(mask))
+        logits = np.asarray(jax.device_get(logits), dtype=np.float32)
+
+        now = time.perf_counter()
+        if t.kind == "sequence":
+            probs = _softmax(logits[:n])
+            out = []
+            for i, item in enumerate(items):
+                p = probs[i]
+                idx = int(p.argmax())
+                out.append(ClassResult(
+                    label=t.labels[idx] if idx < len(t.labels) else str(idx),
+                    index=idx,
+                    confidence=float(p[idx]),
+                    probs={t.labels[j] if j < len(t.labels) else str(j):
+                           float(p[j]) for j in range(p.shape[-1])},
+                    latency_s=now - item.payload.submit_t,
+                ))
+            return out
+        # token classification
+        probs = _softmax(logits[:n])  # [n, S, L]
+        out = []
+        for i, item in enumerate(items):
+            enc = item.payload.encoding
+            L = min(len(enc), bucket)
+            tok_probs = probs[i, :L]
+            pred = tok_probs.argmax(-1)
+            labels = [t.labels[j] if j < len(t.labels) else str(j)
+                      for j in pred]
+            scores = [float(tok_probs[k, j]) for k, j in enumerate(pred)]
+            spans = decode_entity_spans(
+                item.payload.text, enc.offsets[:L], labels, scores,
+                threshold=item.payload.threshold)
+            out.append(TokenClassResult(
+                entities=[EntitySpan(**s) for s in spans],
+                latency_s=now - item.payload.submit_t,
+            ))
+        return out
+
+
+def _softmax(x: np.ndarray) -> np.ndarray:
+    x = x - x.max(axis=-1, keepdims=True)
+    e = np.exp(x)
+    return e / e.sum(axis=-1, keepdims=True)

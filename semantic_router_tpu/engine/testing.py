@@ -1,0 +1,76 @@
+"""Model-free engine fixtures.
+
+The reference's Go tree compiles and tests with zero native deps via a full
+mock of the FFI surface (candle-binding/semantic-router_mock.go:1,
+unified_classifier_stub.go) — SURVEY.md §4 calls out replicating this seam.
+Here the equivalent is a tiny randomly-initialised ModernBERT + the
+deterministic HashTokenizer: real model code paths (jit, batching, padding,
+span decoding) with no checkpels/network, fast enough for unit tests.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import InferenceEngineConfig
+from ..models.modernbert import (
+    ModernBertConfig,
+    ModernBertForSequenceClassification,
+    ModernBertForTokenClassification,
+)
+from ..utils.tokenization import HashTokenizer
+from .classify import InferenceEngine
+
+TINY = dict(
+    vocab_size=1024,
+    hidden_size=32,
+    intermediate_size=48,
+    num_hidden_layers=2,
+    num_attention_heads=2,
+    max_position_embeddings=2048,
+    local_attention=8,
+    pad_token_id=0,
+)
+
+
+def tiny_config(num_labels: int, **overrides) -> ModernBertConfig:
+    return ModernBertConfig(**{**TINY, "num_labels": num_labels, **overrides})
+
+
+def make_test_engine(
+    tasks: Optional[Sequence[tuple]] = None,
+    engine_cfg: Optional[InferenceEngineConfig] = None,
+    seed: int = 0,
+) -> InferenceEngine:
+    """Engine with tiny random classifiers.
+
+    ``tasks``: iterable of (name, kind, labels); defaults to an
+    intent/jailbreak/PII trio mirroring the reference's default task set.
+    """
+    if tasks is None:
+        tasks = [
+            ("intent", "sequence", ["business", "law", "health",
+                                    "computer science", "other"]),
+            ("jailbreak", "sequence", ["benign", "jailbreak"]),
+            ("pii", "token", ["O", "B-EMAIL_ADDRESS", "I-EMAIL_ADDRESS",
+                              "B-PHONE_NUMBER", "I-PHONE_NUMBER",
+                              "B-PERSON", "I-PERSON"]),
+        ]
+    cfg = engine_cfg or InferenceEngineConfig(
+        max_batch_size=8, max_wait_ms=1.0, seq_len_buckets=[32, 128, 512])
+    engine = InferenceEngine(cfg)
+    tok = HashTokenizer(vocab_size=TINY["vocab_size"])
+    key = jax.random.PRNGKey(seed)
+    for i, (name, kind, labels) in enumerate(tasks):
+        mcfg = tiny_config(len(labels))
+        module = (ModernBertForSequenceClassification(mcfg)
+                  if kind == "sequence"
+                  else ModernBertForTokenClassification(mcfg))
+        params = module.init(jax.random.fold_in(key, i),
+                             jnp.ones((1, 8), jnp.int32))
+        engine.register_task(name, kind, module, params, tok, labels,
+                             max_seq_len=512)
+    return engine

@@ -1,0 +1,17 @@
+from .modernbert import (
+    ModernBertConfig,
+    ModernBertForSequenceClassification,
+    ModernBertForTokenClassification,
+    ModernBertModel,
+    ModernBertPredictionHead,
+)
+from .convert import modernbert_params_from_state_dict
+
+__all__ = [
+    "ModernBertConfig",
+    "ModernBertForSequenceClassification",
+    "ModernBertForTokenClassification",
+    "ModernBertModel",
+    "ModernBertPredictionHead",
+    "modernbert_params_from_state_dict",
+]

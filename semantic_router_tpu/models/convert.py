@@ -1,0 +1,108 @@
+"""Checkpoint conversion: HF/torch state dicts → Flax parameter trees.
+
+The reference loads HF safetensors checkpoints into Candle/ORT
+(candle-binding model loading, modeldownload/downloader.go); here the same
+checkpoints convert into our Flax modules. Conversion is pure renaming plus
+kernel transposition (torch Linear stores [out, in]; Flax Dense [in, out]).
+
+Works from any mapping of name → numpy array, so it accepts
+``{k: v.numpy() for k, v in torch_model.state_dict().items()}`` or a
+safetensors file loaded with ``safetensors.numpy.load_file``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+
+def _set(tree: Dict[str, Any], path: list, value: np.ndarray) -> None:
+    node = tree
+    for key in path[:-1]:
+        node = node.setdefault(key, {})
+    node[path[-1]] = value
+
+
+def modernbert_params_from_state_dict(
+    state: Mapping[str, np.ndarray],
+    with_model_prefix: bool | None = None,
+) -> Dict[str, Any]:
+    """Convert a (torch) ModernBERT state dict to Flax params for
+    ``ModernBertModel`` / ``ModernBertFor{Sequence,Token}Classification``.
+
+    ``with_model_prefix``: True for ``ModernBertFor*`` checkpoints whose
+    trunk lives under ``model.``; autodetected when None.
+    """
+    state = {k: np.asarray(v) for k, v in state.items()}
+    if with_model_prefix is None:
+        with_model_prefix = any(k.startswith("model.") for k in state)
+
+    params: Dict[str, Any] = {}
+
+    def trunk_key(suffix: str) -> str:
+        return f"model.{suffix}" if with_model_prefix else suffix
+
+    def trunk_path(*path: str) -> list:
+        return (["model", *path] if with_model_prefix else list(path))
+
+    # embeddings
+    _set(params, trunk_path("embeddings", "tok_embeddings", "embedding"),
+         state[trunk_key("embeddings.tok_embeddings.weight")])
+    _set(params, trunk_path("embeddings", "norm", "scale"),
+         state[trunk_key("embeddings.norm.weight")])
+    if trunk_key("embeddings.norm.bias") in state:
+        _set(params, trunk_path("embeddings", "norm", "bias"),
+             state[trunk_key("embeddings.norm.bias")])
+
+    # layers
+    layer_ids = sorted({
+        int(m.group(1))
+        for k in state
+        if (m := re.search(r"layers\.(\d+)\.", k))
+    })
+    for i in layer_ids:
+        pfx = trunk_key(f"layers.{i}.")
+        lp = trunk_path(f"layers_{i}")
+
+        def put(src: str, dst: list, transpose: bool = False) -> None:
+            key = pfx + src
+            if key in state:
+                w = state[key]
+                _set(params, lp + dst, w.T if transpose else w)
+
+        put("attn_norm.weight", ["attn_norm", "scale"])
+        put("attn_norm.bias", ["attn_norm", "bias"])
+        put("attn.Wqkv.weight", ["attn", "Wqkv", "kernel"], transpose=True)
+        put("attn.Wqkv.bias", ["attn", "Wqkv", "bias"])
+        put("attn.Wo.weight", ["attn", "Wo", "kernel"], transpose=True)
+        put("attn.Wo.bias", ["attn", "Wo", "bias"])
+        put("mlp_norm.weight", ["mlp_norm", "scale"])
+        put("mlp_norm.bias", ["mlp_norm", "bias"])
+        put("mlp.Wi.weight", ["mlp", "Wi", "kernel"], transpose=True)
+        put("mlp.Wi.bias", ["mlp", "Wi", "bias"])
+        put("mlp.Wo.weight", ["mlp", "Wo", "kernel"], transpose=True)
+        put("mlp.Wo.bias", ["mlp", "Wo", "bias"])
+
+    # final norm
+    _set(params, trunk_path("final_norm", "scale"),
+         state[trunk_key("final_norm.weight")])
+    if trunk_key("final_norm.bias") in state:
+        _set(params, trunk_path("final_norm", "bias"),
+             state[trunk_key("final_norm.bias")])
+
+    # classification head (present only on ForSequence/TokenClassification)
+    if "head.dense.weight" in state:
+        _set(params, ["head", "dense", "kernel"], state["head.dense.weight"].T)
+        if "head.dense.bias" in state:
+            _set(params, ["head", "dense", "bias"], state["head.dense.bias"])
+        _set(params, ["head", "norm", "scale"], state["head.norm.weight"])
+        if "head.norm.bias" in state:
+            _set(params, ["head", "norm", "bias"], state["head.norm.bias"])
+    if "classifier.weight" in state:
+        _set(params, ["classifier", "kernel"], state["classifier.weight"].T)
+        if "classifier.bias" in state:
+            _set(params, ["classifier", "bias"], state["classifier.bias"])
+
+    return {"params": params}

@@ -1,0 +1,296 @@
+"""Flax ModernBERT / mmBERT encoder family.
+
+TPU-native re-implementation of the reference's workhorse classifier
+encoder (candle-binding/src/model_architectures/traditional/modernbert.rs,
+1,575 LoC — seq & token classification; mmBERT and mmBERT-32K YaRN variants
+initialised via candle-binding/semantic-router.go:58-64). Architecture
+contract (validated bit-for-bit against the public HF implementation in
+tests/test_models_modernbert.py):
+
+- token embeddings + LayerNorm (no learned positions; RoPE in attention)
+- pre-LN layers; layer 0's attention norm is identity (embedding norm serves)
+- fused Wqkv; alternating attention: every ``global_attn_every_n_layers``-th
+  layer attends globally (theta=global_rope_theta), the rest use
+  sliding-window local attention (width ``local_attention``,
+  theta=local_rope_theta)
+- GeGLU MLP: Wi → split(input, gate) → act(input) * gate → Wo
+- final LayerNorm; classification heads: dense+act+norm then linear
+
+mmBERT-32K: same module with ``rope_scaling={"rope_type": "yarn", ...}`` on
+the global layers (SURVEY.md §5 long-context item 1).
+
+Long-context memory: ``attention_impl="chunked"`` streams query blocks
+(ops.chunked_sdpa — N8 parity); "dense" is the small-sequence fast path.
+The head-side Matryoshka early-exit (``exit_layer``) taps intermediate
+layers for 2D-Matryoshka embeddings (onnx-binding/README.md:38-62).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import (
+    chunked_sdpa,
+    cls_pool,
+    mean_pool,
+    padding_bias,
+    sdpa,
+    sliding_window_bias,
+)
+from ..ops.rope import RopeSpec, apply_rotary
+
+
+@dataclasses.dataclass(frozen=True)
+class ModernBertConfig:
+    vocab_size: int = 50368
+    hidden_size: int = 768
+    intermediate_size: int = 1152
+    num_hidden_layers: int = 22
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 8192
+    norm_eps: float = 1e-5
+    norm_bias: bool = False
+    pad_token_id: int = 50283
+    global_rope_theta: float = 160000.0
+    local_rope_theta: Optional[float] = 10000.0
+    global_attn_every_n_layers: int = 3
+    local_attention: int = 128  # full window width
+    attention_bias: bool = False
+    mlp_bias: bool = False
+    hidden_activation: str = "gelu"
+    classifier_pooling: str = "cls"  # cls | mean
+    classifier_bias: bool = False
+    classifier_activation: str = "gelu"
+    num_labels: int = 2
+    rope_scaling: Optional[Dict[str, Any]] = None  # {"rope_type": "yarn", ...}
+    attention_impl: str = "dense"  # dense | chunked
+    chunk_block_size: int = 512
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    def is_global_layer(self, layer_id: int) -> bool:
+        return layer_id % self.global_attn_every_n_layers == 0
+
+    @classmethod
+    def from_hf(cls, hf_config) -> "ModernBertConfig":
+        """Build from a transformers ModernBertConfig (duck-typed)."""
+        g = lambda k, d=None: getattr(hf_config, k, d)
+        return cls(
+            vocab_size=g("vocab_size"),
+            hidden_size=g("hidden_size"),
+            intermediate_size=g("intermediate_size"),
+            num_hidden_layers=g("num_hidden_layers"),
+            num_attention_heads=g("num_attention_heads"),
+            max_position_embeddings=g("max_position_embeddings"),
+            norm_eps=g("norm_eps", 1e-5),
+            norm_bias=g("norm_bias", False),
+            pad_token_id=g("pad_token_id", 0),
+            global_rope_theta=g("global_rope_theta", 160000.0),
+            local_rope_theta=g("local_rope_theta", 10000.0),
+            global_attn_every_n_layers=g("global_attn_every_n_layers", 3),
+            local_attention=g("local_attention", 128),
+            attention_bias=g("attention_bias", False),
+            mlp_bias=g("mlp_bias", False),
+            hidden_activation=g("hidden_activation", "gelu"),
+            classifier_pooling=g("classifier_pooling", "cls"),
+            classifier_bias=g("classifier_bias", False),
+            classifier_activation=g("classifier_activation", "gelu"),
+            num_labels=len(g("id2label") or {}) or 2,
+            rope_scaling=g("rope_scaling", None),
+        )
+
+
+def _act(name: str):
+    if name in ("gelu", "gelu_python"):
+        return lambda x: jax.nn.gelu(x, approximate=False)
+    if name in ("gelu_new", "gelu_pytorch_tanh"):
+        return lambda x: jax.nn.gelu(x, approximate=True)
+    if name == "relu":
+        return jax.nn.relu
+    if name in ("silu", "swish"):
+        return jax.nn.silu
+    raise ValueError(f"unknown activation {name!r}")
+
+
+class ModernBertEmbeddings(nn.Module):
+    config: ModernBertConfig
+
+    @nn.compact
+    def __call__(self, input_ids: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="tok_embeddings",
+                     dtype=cfg.dtype)(input_ids)
+        return nn.LayerNorm(epsilon=cfg.norm_eps, use_bias=cfg.norm_bias,
+                            name="norm", dtype=cfg.dtype)(x)
+
+
+class ModernBertMLP(nn.Module):
+    config: ModernBertConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        wi = nn.Dense(cfg.intermediate_size * 2, use_bias=cfg.mlp_bias,
+                      name="Wi", dtype=cfg.dtype)(x)
+        inp, gate = jnp.split(wi, 2, axis=-1)
+        h = _act(cfg.hidden_activation)(inp) * gate
+        return nn.Dense(cfg.hidden_size, use_bias=cfg.mlp_bias, name="Wo",
+                        dtype=cfg.dtype)(h)
+
+
+class ModernBertAttention(nn.Module):
+    config: ModernBertConfig
+    layer_id: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, attention_mask: jnp.ndarray
+                 ) -> jnp.ndarray:
+        cfg = self.config
+        B, S, _ = x.shape
+        H, D = cfg.num_attention_heads, cfg.head_dim
+        qkv = nn.Dense(3 * cfg.hidden_size, use_bias=cfg.attention_bias,
+                       name="Wqkv", dtype=cfg.dtype)(x)
+        qkv = qkv.reshape(B, S, 3, H, D)
+        q, k, v = [jnp.moveaxis(t.squeeze(2), 2, 1)
+                   for t in jnp.split(qkv, 3, axis=2)]  # [B, H, S, D]
+
+        is_global = cfg.is_global_layer(self.layer_id)
+        if is_global:
+            spec = RopeSpec(D, cfg.global_rope_theta, yarn=_yarn_dict(cfg))
+            window = 0
+        else:
+            theta = (cfg.local_rope_theta if cfg.local_rope_theta is not None
+                     else cfg.global_rope_theta)
+            spec = RopeSpec(D, theta, yarn=None)
+            window = cfg.local_attention
+        cos, sin = spec.tables(S)
+        q, k = apply_rotary(q, k, cos, sin)
+
+        if cfg.attention_impl == "chunked":
+            out = chunked_sdpa(q, k, v, key_padding_mask=attention_mask,
+                               window=window,
+                               block_size=cfg.chunk_block_size)
+        else:
+            bias = padding_bias(attention_mask)
+            if window > 0:
+                bias = bias + sliding_window_bias(S, window)
+            out = sdpa(q, k, v, bias=bias)
+
+        out = jnp.moveaxis(out, 1, 2).reshape(B, S, cfg.hidden_size)
+        return nn.Dense(cfg.hidden_size, use_bias=cfg.attention_bias,
+                        name="Wo", dtype=cfg.dtype)(out)
+
+
+def _yarn_dict(cfg: ModernBertConfig) -> Optional[dict]:
+    rs = cfg.rope_scaling
+    if rs and rs.get("rope_type", rs.get("type")) == "yarn":
+        return dict(rs)
+    return None
+
+
+class ModernBertEncoderLayer(nn.Module):
+    config: ModernBertConfig
+    layer_id: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, attention_mask: jnp.ndarray
+                 ) -> jnp.ndarray:
+        cfg = self.config
+        if self.layer_id == 0:
+            attn_in = x  # identity: embedding norm already applied
+        else:
+            attn_in = nn.LayerNorm(epsilon=cfg.norm_eps,
+                                   use_bias=cfg.norm_bias, name="attn_norm",
+                                   dtype=cfg.dtype)(x)
+        x = x + ModernBertAttention(cfg, self.layer_id, name="attn")(
+            attn_in, attention_mask)
+        mlp_in = nn.LayerNorm(epsilon=cfg.norm_eps, use_bias=cfg.norm_bias,
+                              name="mlp_norm", dtype=cfg.dtype)(x)
+        return x + ModernBertMLP(cfg, name="mlp")(mlp_in)
+
+
+class ModernBertModel(nn.Module):
+    """Encoder trunk → final-norm hidden states [B, S, hidden]."""
+
+    config: ModernBertConfig
+
+    @nn.compact
+    def __call__(self, input_ids: jnp.ndarray,
+                 attention_mask: Optional[jnp.ndarray] = None,
+                 exit_layer: Optional[int] = None) -> jnp.ndarray:
+        cfg = self.config
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        x = ModernBertEmbeddings(cfg, name="embeddings")(input_ids)
+        n_layers = cfg.num_hidden_layers if exit_layer is None \
+            else min(exit_layer, cfg.num_hidden_layers)
+        for i in range(cfg.num_hidden_layers):
+            if i >= n_layers:
+                break  # Matryoshka layer early-exit (static under jit)
+            x = ModernBertEncoderLayer(cfg, i, name=f"layers_{i}")(
+                x, attention_mask)
+        return nn.LayerNorm(epsilon=cfg.norm_eps, use_bias=cfg.norm_bias,
+                            name="final_norm", dtype=cfg.dtype)(x)
+
+
+class ModernBertPredictionHead(nn.Module):
+    config: ModernBertConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        x = nn.Dense(cfg.hidden_size, use_bias=cfg.classifier_bias,
+                     name="dense", dtype=cfg.dtype)(x)
+        x = _act(cfg.classifier_activation)(x)
+        return nn.LayerNorm(epsilon=cfg.norm_eps, use_bias=cfg.norm_bias,
+                            name="norm", dtype=cfg.dtype)(x)
+
+
+class ModernBertForSequenceClassification(nn.Module):
+    """Sequence classifier (intent/domain, jailbreak, fact-check, feedback,
+    complexity … — the reference's seq-cls FFI surface,
+    modernbert.rs `ModernBertForSequenceClassification`)."""
+
+    config: ModernBertConfig
+
+    @nn.compact
+    def __call__(self, input_ids: jnp.ndarray,
+                 attention_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        cfg = self.config
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        hidden = ModernBertModel(cfg, name="model")(input_ids, attention_mask)
+        if cfg.classifier_pooling == "mean":
+            pooled = mean_pool(hidden, attention_mask)
+        else:
+            pooled = cls_pool(hidden)
+        pooled = ModernBertPredictionHead(cfg, name="head")(pooled)
+        return nn.Dense(cfg.num_labels, use_bias=True, name="classifier",
+                        dtype=cfg.dtype)(pooled)
+
+
+class ModernBertForTokenClassification(nn.Module):
+    """Token classifier (PII spans, hallucination token detection — the
+    reference's token-cls surface, modernbert.rs token classification +
+    HaluGate N9)."""
+
+    config: ModernBertConfig
+
+    @nn.compact
+    def __call__(self, input_ids: jnp.ndarray,
+                 attention_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        cfg = self.config
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        hidden = ModernBertModel(cfg, name="model")(input_ids, attention_mask)
+        hidden = ModernBertPredictionHead(cfg, name="head")(hidden)
+        return nn.Dense(cfg.num_labels, use_bias=True, name="classifier",
+                        dtype=cfg.dtype)(hidden)  # [B, S, num_labels]

@@ -1,0 +1,23 @@
+from .attention import (
+    NEG_INF,
+    chunked_sdpa,
+    cls_pool,
+    mean_pool,
+    padding_bias,
+    sdpa,
+    sliding_window_bias,
+)
+from .rope import (
+    RopeSpec,
+    apply_rotary,
+    default_inv_freq,
+    rope_tables,
+    rotate_half,
+    yarn_inv_freq,
+)
+
+__all__ = [
+    "NEG_INF", "RopeSpec", "apply_rotary", "chunked_sdpa", "cls_pool",
+    "default_inv_freq", "mean_pool", "padding_bias", "rope_tables",
+    "rotate_half", "sdpa", "sliding_window_bias", "yarn_inv_freq",
+]

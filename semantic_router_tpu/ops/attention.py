@@ -1,0 +1,133 @@
+"""Attention primitives: dense SDPA, sliding-window masks, chunked SDPA.
+
+TPU-first equivalents of the reference's attention stack:
+
+- dense SDPA with additive masks — the baseline path (reference eager SDPA,
+  onnx-binding FP16 SDPA).
+- sliding-window (local) attention masks for ModernBERT's alternating
+  local/global layers (reference: ort-ck-flash-attn's native sliding-window
+  support, onnx-binding/ort-ck-flash-attn/README.md:1-40).
+- chunked (query-block streaming) SDPA with online softmax — O(block·seq)
+  memory instead of O(seq²), numerically identical to dense; capability
+  parity with candle-binding's chunked_sdpa.rs:1-25 (N8). Implemented with
+  `lax.scan` over query blocks so XLA keeps static shapes; on TPU the same
+  role is ultimately filled by the Pallas flash kernel
+  (semantic_router_tpu.ops.flash_attention), with this as the portable
+  fallback and the numerics oracle.
+
+Masks here are *additive biases*: 0 where attention is allowed, a large
+negative where disallowed (matching the reference's `masked_fill(-inf)`
+convention but using a finite min to stay NaN-free on fully-masked rows of
+padded batches).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e9  # finite: keeps fully-masked (padding) rows NaN-free
+
+
+def padding_bias(attention_mask: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    """[B, S] {0,1} mask → [B, 1, 1, S] additive key bias."""
+    bias = (1.0 - attention_mask.astype(dtype)) * NEG_INF
+    return bias[:, None, None, :]
+
+
+def sliding_window_bias(seq_len: int, window: int,
+                        dtype=jnp.float32) -> jnp.ndarray:
+    """[1, 1, S, S] additive bias allowing |i-j| <= window//2 (ModernBERT
+    local attention: `local_attention` is the full window width)."""
+    idx = jnp.arange(seq_len)
+    dist = jnp.abs(idx[:, None] - idx[None, :])
+    allowed = dist <= (window // 2)
+    return jnp.where(allowed, 0.0, NEG_INF).astype(dtype)[None, None, :, :]
+
+
+def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+         bias: Optional[jnp.ndarray] = None,
+         scale: Optional[float] = None) -> jnp.ndarray:
+    """Dense scaled-dot-product attention.
+
+    q/k/v: [B, H, S, D]; bias broadcastable to [B, H, S, S]. Softmax in
+    float32 regardless of input dtype (TPU-safe bfloat16 discipline).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+def chunked_sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                 key_padding_mask: Optional[jnp.ndarray] = None,
+                 window: int = 0,
+                 block_size: int = 512,
+                 scale: Optional[float] = None) -> jnp.ndarray:
+    """Streaming attention over query blocks with online softmax.
+
+    Never materializes the [S, S] score matrix: peak live score memory is
+    [B, H, block, S] inside one scan step. Semantics:
+
+    - ``key_padding_mask``: [B, S] with 1 = real token.
+    - ``window``: 0 for global attention; otherwise ModernBERT-style full
+      window width (keys with |i-j| > window//2 are masked).
+
+    Equivalent to ``sdpa`` with the corresponding biases (see
+    tests/test_ops_attention.py for the equivalence oracle); this is the
+    JAX analog of chunked_sdpa.rs's query-block loop (block default 512).
+    """
+    B, H, S, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    pad = (-S) % block_size
+    if pad:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    n_blocks = q.shape[2] // block_size
+    q_blocks = q.reshape(B, H, n_blocks, block_size, D).transpose(2, 0, 1, 3, 4)
+
+    key_idx = jnp.arange(S)
+    if key_padding_mask is not None:
+        key_bias = (1.0 - key_padding_mask.astype(jnp.float32)) * NEG_INF
+    else:
+        key_bias = jnp.zeros((B, S), jnp.float32)
+
+    half_window = window // 2
+
+    def block_attn(carry, inputs):
+        block_i, qb = inputs  # qb: [B, H, block, D]
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qb, k).astype(jnp.float32) * scale
+        scores = scores + key_bias[:, None, None, :]
+        if window > 0:
+            q_pos = block_i * block_size + jnp.arange(block_size)
+            dist = jnp.abs(q_pos[:, None] - key_idx[None, :])
+            wb = jnp.where(dist <= half_window, 0.0, NEG_INF)
+            scores = scores + wb[None, None, :, :]
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+        return carry, out
+
+    _, outs = lax.scan(block_attn, None,
+                       (jnp.arange(n_blocks), q_blocks))
+    # outs: [n_blocks, B, H, block, D] → [B, H, S(+pad), D]
+    out = outs.transpose(1, 2, 0, 3, 4).reshape(B, H, n_blocks * block_size, D)
+    return out[:, :, :S, :]
+
+
+def mean_pool(hidden: jnp.ndarray, attention_mask: jnp.ndarray) -> jnp.ndarray:
+    """Masked mean pooling: [B, S, D] × [B, S] → [B, D]."""
+    mask = attention_mask.astype(hidden.dtype)[..., None]
+    summed = jnp.sum(hidden * mask, axis=1)
+    counts = jnp.clip(jnp.sum(mask, axis=1), 1e-9, None)
+    return summed / counts
+
+
+def cls_pool(hidden: jnp.ndarray) -> jnp.ndarray:
+    return hidden[:, 0]

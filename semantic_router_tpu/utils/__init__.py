@@ -1,0 +1,10 @@
+from .tokenization import (
+    Encoding,
+    HashTokenizer,
+    HFTokenizer,
+    Tokenizer,
+    decode_entity_spans,
+)
+
+__all__ = ["Encoding", "HFTokenizer", "HashTokenizer", "Tokenizer",
+           "decode_entity_spans"]

@@ -1,0 +1,148 @@
+"""Tokenization seam.
+
+The reference keeps tokenization host-side in Rust (candle-binding
+core/tokenization.rs) with careful offset mapping for token-classification
+span decoding (SURVEY.md hard-part 5). Here:
+
+- ``HFTokenizer`` wraps a `tokenizers.Tokenizer` JSON file (the same file HF
+  checkpoints ship) and returns ids/mask/char offsets.
+- ``HashTokenizer`` is the deterministic model-free stand-in used by tests
+  and the mock backend (the seam the reference builds with
+  semantic-router_mock.go) — word-hash ids, exact char offsets.
+
+Both produce ``Encoding`` with char offsets so PII/hallucination span
+decoding is tokenizer-exact.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional, Protocol, Tuple
+
+
+@dataclass
+class Encoding:
+    ids: List[int]
+    attention_mask: List[int]
+    offsets: List[Tuple[int, int]]  # char [start, end) per token; (0,0) for specials
+
+    def __len__(self) -> int:
+        return len(self.ids)
+
+
+class Tokenizer(Protocol):
+    def encode(self, text: str, max_length: int = 0) -> Encoding: ...
+
+    @property
+    def vocab_size(self) -> int: ...
+
+
+_WORD_RE = re.compile(r"\S+")
+
+
+class HashTokenizer:
+    """Deterministic test tokenizer: one token per whitespace word, id =
+    stable hash into the vocab, [CLS]/[SEP] specials at 1/2, pad 0."""
+
+    CLS, SEP, PAD = 1, 2, 0
+
+    def __init__(self, vocab_size: int = 1024) -> None:
+        self._vocab_size = vocab_size
+
+    @property
+    def vocab_size(self) -> int:
+        return self._vocab_size
+
+    def _word_id(self, word: str) -> int:
+        h = int.from_bytes(hashlib.md5(word.lower().encode()).digest()[:4],
+                           "little")
+        return 3 + h % (self._vocab_size - 3)
+
+    def encode(self, text: str, max_length: int = 0) -> Encoding:
+        ids = [self.CLS]
+        offsets: List[Tuple[int, int]] = [(0, 0)]
+        for m in _WORD_RE.finditer(text):
+            ids.append(self._word_id(m.group(0)))
+            offsets.append((m.start(), m.end()))
+            if max_length and len(ids) >= max_length - 1:
+                break
+        ids.append(self.SEP)
+        offsets.append((0, 0))
+        return Encoding(ids=ids, attention_mask=[1] * len(ids), offsets=offsets)
+
+
+class HFTokenizer:
+    """Wraps a `tokenizers` fast tokenizer loaded from tokenizer.json."""
+
+    def __init__(self, path: str, cls_id: Optional[int] = None,
+                 sep_id: Optional[int] = None) -> None:
+        from tokenizers import Tokenizer as _Tok
+
+        self.tok = _Tok.from_file(path)
+        self._vocab_size = self.tok.get_vocab_size()
+
+    @classmethod
+    def from_pretrained_dir(cls, model_dir: str) -> "HFTokenizer":
+        import os
+
+        return cls(os.path.join(model_dir, "tokenizer.json"))
+
+    @property
+    def vocab_size(self) -> int:
+        return self._vocab_size
+
+    def encode(self, text: str, max_length: int = 0) -> Encoding:
+        # Truncate post-hoc rather than via enable_truncation: the tokenizer
+        # object is shared across tasks/threads and enable_truncation mutates
+        # global state (racy, and it would leak into max_length=0 calls).
+        enc = self.tok.encode(text)
+        ids = list(enc.ids)
+        mask = list(enc.attention_mask)
+        offsets = [tuple(o) for o in enc.offsets]
+        if max_length and len(ids) > max_length:
+            ids, mask, offsets = (ids[:max_length], mask[:max_length],
+                                  offsets[:max_length])
+        return Encoding(ids=ids, attention_mask=mask, offsets=offsets)
+
+
+def decode_entity_spans(text: str, offsets: List[Tuple[int, int]],
+                        labels: List[str], scores: List[float],
+                        threshold: float = 0.5,
+                        ignore_label: str = "O") -> List[dict]:
+    """BIO/plain token labels + char offsets → entity spans.
+
+    Mirrors the reference's Rust span decoding (token-classification results
+    marshalled through unified_classifier_cgo_results.go): adjacent tokens
+    with the same entity type merge; "B-"/"I-" prefixes handled; sub-threshold
+    tokens break spans. Returns [{type, start, end, text, score}].
+    """
+    spans: List[dict] = []
+    current: Optional[dict] = None
+
+    def flush() -> None:
+        nonlocal current
+        if current is not None:
+            current["text"] = text[current["start"]:current["end"]]
+            spans.append(current)
+            current = None
+
+    for (start, end), label, score in zip(offsets, labels, scores):
+        if start == end:  # special token
+            flush()
+            continue
+        is_begin = label.startswith("B-")
+        etype = label[2:] if label[:2] in ("B-", "I-") else label
+        if etype == ignore_label or score < threshold:
+            flush()
+            continue
+        if current is not None and current["type"] == etype and not is_begin:
+            current["end"] = end
+            current["score"] = min(current["score"], score)
+        else:
+            flush()
+            current = {"type": etype, "start": start, "end": end,
+                       "score": score}
+    flush()
+    return spans

@@ -1,15 +1,26 @@
 """Test bootstrap: force JAX onto a virtual 8-device CPU platform so all
 sharding/pjit tests run without TPU hardware (the driver separately
-dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip)."""
+dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip).
+
+NOTE: this environment injects an `axon` TPU PJRT plugin via sitecustomize
+and sets JAX_PLATFORMS=axon in the ambient env, so a plain setdefault is not
+enough — we must overwrite the env var *and* pin the config after import,
+before any backend initializes. Otherwise unit tests run over the TPU tunnel
+(slow first compiles, single shared chip, hangs if the tunnel is wedged).
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pathlib
 

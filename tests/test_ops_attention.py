@@ -1,0 +1,143 @@
+"""Attention/RoPE op tests: chunked SDPA must be numerically identical to
+dense SDPA (the reference's guarantee for chunked_sdpa.rs — "numerically
+identical to dense"), sliding-window masks must match the reference
+construction, YaRN must match the published formula."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from semantic_router_tpu.ops import (
+    RopeSpec,
+    apply_rotary,
+    chunked_sdpa,
+    mean_pool,
+    padding_bias,
+    sdpa,
+    sliding_window_bias,
+    yarn_inv_freq,
+)
+
+
+def rand(*shape, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal(shape), dtype=jnp.float32)
+
+
+class TestChunkedSDPA:
+    @pytest.mark.parametrize("S,block", [(64, 16), (100, 32), (33, 64), (16, 16)])
+    def test_matches_dense_global(self, S, block):
+        q, k, v = rand(2, 4, S, 16, seed=1), rand(2, 4, S, 16, seed=2), rand(2, 4, S, 16, seed=3)
+        dense = sdpa(q, k, v)
+        chunked = chunked_sdpa(q, k, v, block_size=block)
+        np.testing.assert_allclose(np.asarray(dense), np.asarray(chunked),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_matches_dense_with_padding(self):
+        S = 48
+        q, k, v = rand(2, 2, S, 8, seed=4), rand(2, 2, S, 8, seed=5), rand(2, 2, S, 8, seed=6)
+        mask = jnp.asarray(np.concatenate(
+            [np.ones((2, 30)), np.zeros((2, S - 30))], axis=1), jnp.float32)
+        dense = sdpa(q, k, v, bias=padding_bias(mask))
+        chunked = chunked_sdpa(q, k, v, key_padding_mask=mask, block_size=16)
+        np.testing.assert_allclose(np.asarray(dense)[:, :, :30],
+                                   np.asarray(chunked)[:, :, :30],
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_matches_dense_sliding_window(self):
+        S, window = 64, 16
+        q, k, v = rand(1, 2, S, 8, seed=7), rand(1, 2, S, 8, seed=8), rand(1, 2, S, 8, seed=9)
+        dense = sdpa(q, k, v, bias=sliding_window_bias(S, window))
+        chunked = chunked_sdpa(q, k, v, window=window, block_size=16)
+        np.testing.assert_allclose(np.asarray(dense), np.asarray(chunked),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_jit_compiles(self):
+        q, k, v = rand(1, 2, 32, 8), rand(1, 2, 32, 8), rand(1, 2, 32, 8)
+        f = jax.jit(lambda q, k, v: chunked_sdpa(q, k, v, block_size=16))
+        out = f(q, k, v)
+        assert out.shape == (1, 2, 32, 8)
+
+    def test_fully_masked_rows_are_finite(self):
+        # padding rows must not produce NaNs (finite NEG_INF convention)
+        S = 16
+        q, k, v = rand(1, 1, S, 4), rand(1, 1, S, 4), rand(1, 1, S, 4)
+        mask = jnp.zeros((1, S))
+        out = chunked_sdpa(q, k, v, key_padding_mask=mask, block_size=8)
+        assert bool(jnp.all(jnp.isfinite(out)))
+
+
+class TestMasks:
+    def test_sliding_window_bias_structure(self):
+        b = np.asarray(sliding_window_bias(8, 4))[0, 0]
+        for i in range(8):
+            for j in range(8):
+                if abs(i - j) <= 2:
+                    assert b[i, j] == 0.0
+                else:
+                    assert b[i, j] < -1e8
+
+    def test_mean_pool_ignores_padding(self):
+        h = jnp.asarray([[[1.0, 2.0], [3.0, 4.0], [100.0, 100.0]]])
+        mask = jnp.asarray([[1, 1, 0]])
+        out = np.asarray(mean_pool(h, mask))
+        np.testing.assert_allclose(out, [[2.0, 3.0]])
+
+
+class TestRope:
+    def test_yarn_matches_hf(self):
+        """Our YaRN must be numerically identical to HF's
+        _compute_yarn_parameters for a 32K mmBERT-style config."""
+        torch = pytest.importorskip("torch")
+        from transformers import ModernBertConfig as HFConfig
+        from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+        hf_cfg = HFConfig(
+            max_position_embeddings=32768,
+            rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                          "original_max_position_embeddings": 8192},
+        )
+        hf_cfg.rope_theta = 160000.0
+        hf_inv, hf_scale = ROPE_INIT_FUNCTIONS["yarn"](hf_cfg, "cpu")
+        ours, our_scale = yarn_inv_freq(
+            head_dim=64, base=160000.0, factor=4.0,
+            original_max_position_embeddings=8192)
+        np.testing.assert_allclose(ours, hf_inv.numpy(), rtol=1e-6)
+        assert our_scale == pytest.approx(hf_scale)
+
+    def test_rotary_preserves_norm(self):
+        q = rand(1, 2, 16, 8, seed=11)
+        k = rand(1, 2, 16, 8, seed=12)
+        spec = RopeSpec(8, 10000.0)
+        cos, sin = spec.tables(16)
+        q2, k2 = apply_rotary(q, k, cos, sin)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(q), axis=-1),
+            np.linalg.norm(np.asarray(q2), axis=-1), rtol=1e-5)
+
+    def test_rotary_relative_property(self):
+        """RoPE inner products depend only on relative position."""
+        spec = RopeSpec(8, 10000.0)
+        cos, sin = spec.tables(32)
+        rng = np.random.default_rng(13)
+        qv = jnp.asarray(rng.standard_normal((1, 1, 1, 8)), jnp.float32)
+        kv = jnp.asarray(rng.standard_normal((1, 1, 1, 8)), jnp.float32)
+
+        def score(i, j):
+            q = jnp.tile(qv, (1, 1, 32, 1))
+            k = jnp.tile(kv, (1, 1, 32, 1))
+            qr, kr = apply_rotary(q, k, cos, sin)
+            return float(jnp.dot(qr[0, 0, i], kr[0, 0, j]))
+
+        assert score(3, 1) == pytest.approx(score(13, 11), abs=1e-4)
+        assert score(0, 4) == pytest.approx(score(10, 14), abs=1e-4)
+
+    def test_yarn_attention_scaling_applied(self):
+        spec = RopeSpec(8, 160000.0, yarn={
+            "rope_type": "yarn", "factor": 4.0,
+            "original_max_position_embeddings": 8192})
+        assert spec.attention_scaling > 1.0
+        cos, _ = spec.tables(4)
+        assert float(cos[0, 0]) == pytest.approx(spec.attention_scaling)
