@@ -1,0 +1,82 @@
+"""Driver benchmark: flagship classifier throughput on the real chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Benchmark: mmBERT-32K-geometry ModernBERT intent classifier (ModernBERT-base
+dims, YaRN 32K rope), 512-token sequences, bf16, batched — the reference's
+headline signal-extraction number (BASELINE.md: mmBERT-32K classify 512 tok
+= 6.0 ms on MI300X ⇒ 166.7 signals/s single-stream; CPU 120 ms).
+
+vs_baseline = our signals/sec ÷ the GPU baseline's signals/sec (>1 ⇒ faster
+than the reference's GPU path).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+GPU_BASELINE_SIGNALS_PER_S = 1000.0 / 6.0  # MI300X, evaluation.tex:50-57
+
+BATCH = 32
+SEQ = 512
+WARMUP_ITERS = 2
+MEASURE_ITERS = 10
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    # On a CPU host (no accelerator) scale down so the smoke run finishes;
+    # the driver's real run executes on the TPU chip at full size.
+    global BATCH, MEASURE_ITERS
+    if jax.devices()[0].platform == "cpu":
+        BATCH, MEASURE_ITERS = 8, 2
+
+    from semantic_router_tpu.models.modernbert import (
+        ModernBertConfig,
+        ModernBertForSequenceClassification,
+    )
+
+    cfg = ModernBertConfig(
+        num_labels=14,
+        max_position_embeddings=32768,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                      "original_max_position_embeddings": 8192},
+        dtype=jnp.bfloat16,
+    )
+    model = ModernBertForSequenceClassification(cfg)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(3, cfg.vocab_size, (BATCH, SEQ)), jnp.int32)
+    mask = jnp.ones((BATCH, SEQ), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids[:1, :8])
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16)
+        if x.dtype == jnp.float32 else x, params)
+
+    fn = jax.jit(model.apply)
+    for _ in range(WARMUP_ITERS):
+        fn(params, ids, mask).block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_ITERS):
+        out = fn(params, ids, mask)
+    out.block_until_ready()
+    elapsed = time.perf_counter() - t0
+
+    signals_per_s = (BATCH * MEASURE_ITERS) / elapsed
+    print(json.dumps({
+        "metric": "mmBERT-32K intent classify throughput "
+                  f"(512 tok, b={BATCH}, bf16)",
+        "value": round(signals_per_s, 2),
+        "unit": "signals/s",
+        "vs_baseline": round(signals_per_s / GPU_BASELINE_SIGNALS_PER_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -133,31 +133,58 @@ class ModernBertEmbeddings(nn.Module):
 
 
 class ModernBertMLP(nn.Module):
+    """GeGLU MLP. ``dense_factory`` (shared with attention) lets the LoRA
+    path swap every projection for a task-adapted dense without duplicating
+    the trunk (see models/lora.py)."""
+
     config: ModernBertConfig
+    dense_factory: Any = None
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray,
+                 task_index: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         cfg = self.config
-        wi = nn.Dense(cfg.intermediate_size * 2, use_bias=cfg.mlp_bias,
-                      name="Wi", dtype=cfg.dtype)(x)
+        dense = _make_dense(self, cfg, task_index)
+        wi = dense(cfg.intermediate_size * 2, cfg.mlp_bias, "Wi")(x)
         inp, gate = jnp.split(wi, 2, axis=-1)
         h = _act(cfg.hidden_activation)(inp) * gate
-        return nn.Dense(cfg.hidden_size, use_bias=cfg.mlp_bias, name="Wo",
-                        dtype=cfg.dtype)(h)
+        return dense(cfg.hidden_size, cfg.mlp_bias, "Wo")(h)
+
+
+def _make_dense(module, cfg: ModernBertConfig,
+                task_index: Optional[jnp.ndarray]):
+    """Returns make(features, use_bias, name) → callable(x).
+
+    Default: plain nn.Dense. With a ``dense_factory`` on the module (the
+    LoRA path), the factory's module is called with the task index so the
+    adapter pair is selected per call (a gather — no recompile on swap)."""
+    factory = getattr(module, "dense_factory", None)
+
+    def make(features: int, use_bias: bool, name: str):
+        if factory is None:
+            layer = nn.Dense(features, use_bias=use_bias, name=name,
+                             dtype=cfg.dtype)
+            return layer
+        layer = factory(features, use_bias, name)
+        idx = task_index if task_index is not None else 0
+        return lambda x: layer(x, jnp.asarray(idx))
+
+    return make
 
 
 class ModernBertAttention(nn.Module):
     config: ModernBertConfig
     layer_id: int
+    dense_factory: Any = None
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, attention_mask: jnp.ndarray
-                 ) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, attention_mask: jnp.ndarray,
+                 task_index: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         cfg = self.config
+        dense = _make_dense(self, cfg, task_index)
         B, S, _ = x.shape
         H, D = cfg.num_attention_heads, cfg.head_dim
-        qkv = nn.Dense(3 * cfg.hidden_size, use_bias=cfg.attention_bias,
-                       name="Wqkv", dtype=cfg.dtype)(x)
+        qkv = dense(3 * cfg.hidden_size, cfg.attention_bias, "Wqkv")(x)
         qkv = qkv.reshape(B, S, 3, H, D)
         q, k, v = [jnp.moveaxis(t.squeeze(2), 2, 1)
                    for t in jnp.split(qkv, 3, axis=2)]  # [B, H, S, D]
@@ -185,8 +212,7 @@ class ModernBertAttention(nn.Module):
             out = sdpa(q, k, v, bias=bias)
 
         out = jnp.moveaxis(out, 1, 2).reshape(B, S, cfg.hidden_size)
-        return nn.Dense(cfg.hidden_size, use_bias=cfg.attention_bias,
-                        name="Wo", dtype=cfg.dtype)(out)
+        return dense(cfg.hidden_size, cfg.attention_bias, "Wo")(out)
 
 
 def _yarn_dict(cfg: ModernBertConfig) -> Optional[dict]:
@@ -199,10 +225,11 @@ def _yarn_dict(cfg: ModernBertConfig) -> Optional[dict]:
 class ModernBertEncoderLayer(nn.Module):
     config: ModernBertConfig
     layer_id: int
+    dense_factory: Any = None
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, attention_mask: jnp.ndarray
-                 ) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, attention_mask: jnp.ndarray,
+                 task_index: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         cfg = self.config
         if self.layer_id == 0:
             attn_in = x  # identity: embedding norm already applied
@@ -210,22 +237,30 @@ class ModernBertEncoderLayer(nn.Module):
             attn_in = nn.LayerNorm(epsilon=cfg.norm_eps,
                                    use_bias=cfg.norm_bias, name="attn_norm",
                                    dtype=cfg.dtype)(x)
-        x = x + ModernBertAttention(cfg, self.layer_id, name="attn")(
-            attn_in, attention_mask)
+        x = x + ModernBertAttention(cfg, self.layer_id, name="attn",
+                                    dense_factory=self.dense_factory)(
+            attn_in, attention_mask, task_index)
         mlp_in = nn.LayerNorm(epsilon=cfg.norm_eps, use_bias=cfg.norm_bias,
                               name="mlp_norm", dtype=cfg.dtype)(x)
-        return x + ModernBertMLP(cfg, name="mlp")(mlp_in)
+        return x + ModernBertMLP(cfg, name="mlp",
+                                 dense_factory=self.dense_factory)(
+            mlp_in, task_index)
 
 
 class ModernBertModel(nn.Module):
-    """Encoder trunk → final-norm hidden states [B, S, hidden]."""
+    """Encoder trunk → final-norm hidden states [B, S, hidden].
+
+    ``dense_factory``/``task_index`` thread the LoRA adaptation through
+    every projection (models/lora.py) without duplicating the trunk."""
 
     config: ModernBertConfig
+    dense_factory: Any = None
 
     @nn.compact
     def __call__(self, input_ids: jnp.ndarray,
                  attention_mask: Optional[jnp.ndarray] = None,
-                 exit_layer: Optional[int] = None) -> jnp.ndarray:
+                 exit_layer: Optional[int] = None,
+                 task_index: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         cfg = self.config
         if attention_mask is None:
             attention_mask = jnp.ones_like(input_ids)
@@ -235,8 +270,9 @@ class ModernBertModel(nn.Module):
         for i in range(cfg.num_hidden_layers):
             if i >= n_layers:
                 break  # Matryoshka layer early-exit (static under jit)
-            x = ModernBertEncoderLayer(cfg, i, name=f"layers_{i}")(
-                x, attention_mask)
+            x = ModernBertEncoderLayer(cfg, i, name=f"layers_{i}",
+                                       dense_factory=self.dense_factory)(
+                x, attention_mask, task_index)
         return nn.LayerNorm(epsilon=cfg.norm_eps, use_bias=cfg.norm_bias,
                             name="final_norm", dtype=cfg.dtype)(x)
 

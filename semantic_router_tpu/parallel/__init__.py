@@ -1,0 +1,21 @@
+from .mesh import (
+    AXIS_DATA,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+    batch_sharding,
+    create_mesh,
+    replicated,
+)
+from .sharding import param_shardings, shard_params
+from .train_step import (
+    TrainState,
+    cross_entropy_loss,
+    make_lora_optimizer,
+    make_train_step,
+)
+
+__all__ = [
+    "AXIS_DATA", "AXIS_SEQ", "AXIS_TENSOR", "TrainState", "batch_sharding",
+    "create_mesh", "cross_entropy_loss", "make_lora_optimizer",
+    "make_train_step", "param_shardings", "replicated", "shard_params",
+]

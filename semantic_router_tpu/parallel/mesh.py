@@ -1,0 +1,68 @@
+"""Device-mesh construction for the classifier bank and training.
+
+The reference has no device-side parallelism at all (SURVEY.md §2.4 — one
+GPU serializes concurrent classifier requests; latency ∝ concurrency,
+paper evaluation.tex:98-121). The TPU-native replacement scales the
+classifier bank across a slice with a `jax.sharding.Mesh`:
+
+- ``dp`` (data): request batches split across chips — the primary axis for
+  the bank (BASELINE north star: "shards the classifier bank across a v5e
+  slice"); collectives ride ICI.
+- ``tp`` (tensor): Megatron-style sharding of attention heads / MLP for the
+  larger embedding models (Qwen3/Gemma).
+- ``sp`` (sequence): activation sequence sharding for 32K-context
+  classification — the sequence-parallel analog of the reference's
+  chunked/flash long-context story, but across chips.
+
+Multi-host slices extend the same mesh over DCN via jax.distributed — the
+mesh axes are the communication backend; no hand-written collective layer
+exists or is needed (XLA inserts psum/all-gather from shardings).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DATA = "dp"
+AXIS_TENSOR = "tp"
+AXIS_SEQ = "sp"
+
+
+def create_mesh(shape: Optional[Dict[str, int]] = None,
+                devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh with (dp, tp, sp) axes.
+
+    ``shape``: explicit axis sizes, e.g. {"dp": 4} (missing axes default to
+    1; sizes must multiply to the device count). Without a shape, all
+    devices go to ``dp`` — the right default for the classifier bank.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if shape:
+        dp = int(shape.get(AXIS_DATA, 0)) or 0
+        tp = int(shape.get(AXIS_TENSOR, 1))
+        sp = int(shape.get(AXIS_SEQ, 1))
+        if dp == 0:
+            dp = n // (tp * sp)
+        if dp * tp * sp != n:
+            raise ValueError(
+                f"mesh shape dp={dp} tp={tp} sp={sp} != {n} devices")
+    else:
+        dp, tp, sp = n, 1, 1
+    arr = np.asarray(devices).reshape(dp, tp, sp)
+    return Mesh(arr, (AXIS_DATA, AXIS_TENSOR, AXIS_SEQ))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, shard_seq: bool = False) -> NamedSharding:
+    """[B, S] / [B, S, D] inputs: batch over dp, optionally sequence over sp."""
+    if shard_seq:
+        return NamedSharding(mesh, P(AXIS_DATA, AXIS_SEQ))
+    return NamedSharding(mesh, P(AXIS_DATA))

@@ -1,0 +1,59 @@
+"""Parameter sharding rules (Megatron-style tensor parallel + replication).
+
+Rules map Flax param path names to PartitionSpecs:
+
+- fused ``Wqkv`` / MLP ``Wi`` kernels: output features over ``tp``
+  (column-parallel)
+- attention/MLP ``Wo`` kernels: input features over ``tp`` (row-parallel —
+  XLA inserts the psum)
+- embeddings: vocab over ``tp`` (gathered at lookup)
+- LoRA stacks [T, d, r]: replicated (tiny)
+- everything else (norms, heads, biases): replicated
+
+With a dp-only mesh every rule degenerates to replication and the bank is
+pure data-parallel — the north-star layout for serving the classifier bank
+(BASELINE.json). The same tree rules drive both serving and the training
+step.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import AXIS_TENSOR
+
+
+def _spec_for(path: tuple, leaf: Any) -> P:
+    names = [str(getattr(p, "key", p)) for p in path]
+    joined = "/".join(names)
+    ndim = getattr(leaf, "ndim", 0)
+    last = names[-1] if names else ""
+
+    if last.startswith("lora_"):
+        return P()
+    if "tok_embeddings" in joined and last == "embedding":
+        return P(AXIS_TENSOR, None)
+    if last == "kernel" and ndim == 2:
+        parent = names[-2] if len(names) >= 2 else ""
+        if parent.startswith(("Wqkv", "Wi")):
+            return P(None, AXIS_TENSOR)  # column parallel
+        if parent.startswith("Wo"):
+            return P(AXIS_TENSOR, None)  # row parallel
+        return P()
+    return P()
+
+
+def param_shardings(params: Any, mesh: Mesh) -> Any:
+    """PyTree of NamedShardings matching *params*."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, _spec_for(path, leaf)), params)
+
+
+def shard_params(params: Any, mesh: Mesh) -> Any:
+    """Place a parameter tree onto the mesh per the rules."""
+    shardings = param_shardings(params, mesh)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s), params, shardings)

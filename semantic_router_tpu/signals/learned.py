@@ -1,0 +1,236 @@
+"""Learned (TPU-backed) signal evaluators.
+
+Each evaluator fans one request into the InferenceEngine's batching shim and
+maps classifier outputs onto configured signal rules. Reference parity:
+
+- domain   → category classifier (category_classifier.go;
+             ClassifyMmBert32KIntent, candle-binding/semantic-router.go:2329)
+- jailbreak→ classifier / pattern / hybrid methods
+             (classifier_jailbreak_init.go, contrastive_jailbreak_classifier.go:265,
+             ClassifyMmBert32KJailbreak :2417)
+- pii      → token classifier + allowed-types policy
+             (classifier_pii_init.go, token path :2538)
+- fact_check → binary seq classifier (fact_check_classifier.go)
+- user_feedback → feedback detector (feedback_detector.go:236)
+- modality → modality classifier (AR / DIFFUSION / BOTH)
+- embedding / preference / complexity-prototypes live in
+  signals/embedding_signal.py (they need the embedding engine).
+
+All evaluators fail open: engine errors are recorded on the SignalResult,
+never raised across the dispatch boundary (processor_core.go:74-81 parity).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ..config.schema import (
+    DomainRule,
+    JailbreakRule,
+    NamedRule,
+    PIIRule,
+)
+from ..engine.classify import InferenceEngine
+from .base import RequestContext, SignalHit, SignalResult
+
+
+class _EngineSignal:
+    """Shared plumbing: run fn against the engine, fail open on errors."""
+
+    signal_type = ""
+
+    def __init__(self, engine: InferenceEngine, task: str) -> None:
+        self.engine = engine
+        self.task = task
+
+    def evaluate(self, ctx: RequestContext) -> SignalResult:
+        start = time.perf_counter()
+        res = SignalResult(self.signal_type)
+        try:
+            if self.engine.has_task(self.task):
+                self._evaluate(ctx, res)
+            else:
+                res.error = f"task {self.task!r} not loaded"
+        except Exception as exc:
+            res.error = f"{type(exc).__name__}: {exc}"
+        res.latency_s = time.perf_counter() - start
+        return res
+
+    def _evaluate(self, ctx: RequestContext, res: SignalResult) -> None:
+        raise NotImplementedError
+
+
+class DomainSignal(_EngineSignal):
+    """Maps the category classifier's label onto configured domain rules.
+    The classifier's label set is the configured domain list (the reference
+    trains the intent head on exactly these MMLU-style categories)."""
+
+    signal_type = "domain"
+
+    def __init__(self, engine: InferenceEngine, rules: List[DomainRule],
+                 task: str = "intent", threshold: float = 0.0) -> None:
+        super().__init__(engine, task)
+        self.rules = rules
+        self.threshold = threshold
+        self._by_name = {r.name.lower(): r for r in rules}
+        for r in rules:
+            for cat in r.mmlu_categories:
+                self._by_name.setdefault(cat.lower(), r)
+
+    def _evaluate(self, ctx: RequestContext, res: SignalResult) -> None:
+        out = self.engine.classify(self.task, ctx.user_text)
+        rule = self._by_name.get(out.label.lower())
+        if rule is not None and out.confidence >= self.threshold:
+            res.hits.append(SignalHit(rule.name, out.confidence,
+                                      {"label": out.label}))
+
+
+class JailbreakSignal(_EngineSignal):
+    """method: classifier | pattern | hybrid. Pattern mode scores the text
+    against jailbreak vs benign pattern sets lexically (the contrastive
+    pattern path); hybrid ORs both."""
+
+    signal_type = "jailbreak"
+
+    def __init__(self, engine: InferenceEngine, rules: List[JailbreakRule],
+                 task: str = "jailbreak",
+                 positive_labels: Optional[List[str]] = None) -> None:
+        super().__init__(engine, task)
+        self.rules = rules
+        self.positive = set(l.lower() for l in
+                            (positive_labels or ["jailbreak", "injection",
+                                                 "unsafe", "malicious"]))
+
+    def evaluate(self, ctx: RequestContext) -> SignalResult:
+        # pattern-only rules must work with no model loaded
+        start = time.perf_counter()
+        res = SignalResult(self.signal_type)
+        try:
+            self._evaluate(ctx, res)
+        except Exception as exc:
+            res.error = f"{type(exc).__name__}: {exc}"
+        res.latency_s = time.perf_counter() - start
+        return res
+
+    def _classifier_score(self, text: str) -> float:
+        if not self.engine.has_task(self.task):
+            return 0.0
+        out = self.engine.classify(self.task, text)
+        if out.label.lower() in self.positive:
+            return out.confidence
+        # positive-class probability even when benign wins
+        return max((p for l, p in out.probs.items()
+                    if l.lower() in self.positive), default=0.0)
+
+    @staticmethod
+    def _pattern_score(text: str, rule: JailbreakRule) -> float:
+        """Contrastive lexical score: fraction of jailbreak patterns present
+        minus fraction of benign patterns present, clamped to [0, 1]."""
+        t = text.lower()
+        if not rule.jailbreak_patterns:
+            return 0.0
+        jb = sum(1 for p in rule.jailbreak_patterns if p.lower() in t)
+        if jb == 0:
+            return 0.0
+        benign = sum(1 for p in rule.benign_patterns if p.lower() in t)
+        score = 0.5 + 0.5 * jb / len(rule.jailbreak_patterns)
+        if rule.benign_patterns:
+            score -= 0.4 * benign / len(rule.benign_patterns)
+        return max(0.0, min(1.0, score))
+
+    def _evaluate(self, ctx: RequestContext, res: SignalResult) -> None:
+        cls_cache: Dict[str, float] = {}
+        for rule in self.rules:
+            text = ctx.text_for(rule.include_history)
+            score = 0.0
+            if rule.method in ("classifier", "hybrid"):
+                if not self.engine.has_task(self.task):
+                    # surface the disabled guard (pattern leg may still run)
+                    res.error = f"task {self.task!r} not loaded"
+                elif text not in cls_cache:
+                    cls_cache[text] = self._classifier_score(text)
+                score = cls_cache.get(text, 0.0)
+            if rule.method in ("pattern", "hybrid"):
+                score = max(score, self._pattern_score(text, rule))
+            if score >= rule.threshold:
+                res.hits.append(SignalHit(rule.name, score))
+
+
+class PIISignal(_EngineSignal):
+    """Token-classifies the text and matches rules whose *disallowed* PII
+    types are present (pii_types_allowed is the allowlist)."""
+
+    signal_type = "pii"
+
+    def __init__(self, engine: InferenceEngine, rules: List[PIIRule],
+                 task: str = "pii") -> None:
+        super().__init__(engine, task)
+        self.rules = rules
+
+    def _evaluate(self, ctx: RequestContext, res: SignalResult) -> None:
+        cache: Dict[tuple, list] = {}
+        for rule in self.rules:
+            key = (rule.include_history, rule.threshold)
+            if key not in cache:
+                text = ctx.text_for(rule.include_history)
+                out = self.engine.token_classify(
+                    self.task, text, threshold=rule.threshold)
+                cache[key] = out.entities
+            entities = cache[key]
+            allowed = {t.upper() for t in rule.pii_types_allowed}
+            denied = [e for e in entities if e.type.upper() not in allowed]
+            if denied:
+                res.hits.append(SignalHit(
+                    rule.name,
+                    min(e.score for e in denied),
+                    {"types": sorted({e.type for e in denied}),
+                     "entities": [
+                         {"type": e.type, "start": e.start, "end": e.end,
+                          "score": e.score} for e in denied]},
+                ))
+
+
+class BinaryTaskSignal(_EngineSignal):
+    """Generic classifier-label → rule-name mapper for fact_check,
+    user_feedback, and modality: a rule matches when the classifier emits
+    its name (label set == rule names by construction/training)."""
+
+    def __init__(self, engine: InferenceEngine, rules: List[NamedRule],
+                 task: str, signal_type: str) -> None:
+        super().__init__(engine, task)
+        self.signal_type = signal_type
+        self.rules = rules
+        self._names = {r.name.lower(): r for r in rules}
+
+    def _evaluate(self, ctx: RequestContext, res: SignalResult) -> None:
+        out = self.engine.classify(self.task, ctx.user_text)
+        rule = self._names.get(out.label.lower())
+        if rule is not None:
+            threshold = rule.threshold or 0.0
+            if out.confidence >= threshold:
+                res.hits.append(SignalHit(rule.name, out.confidence))
+
+
+def build_learned_evaluators(engine: InferenceEngine, cfg) -> list:
+    """Wire every learned family whose rules are configured. Task names
+    follow the engine's default registry: intent/jailbreak/pii/fact_check/
+    user_feedback/modality."""
+    evs: list = []
+    s = cfg.signals
+    if s.domains:
+        evs.append(DomainSignal(engine, s.domains))
+    if s.jailbreak:
+        evs.append(JailbreakSignal(engine, s.jailbreak))
+    if s.pii:
+        evs.append(PIISignal(engine, s.pii))
+    if s.fact_check:
+        evs.append(BinaryTaskSignal(engine, s.fact_check, "fact_check",
+                                    "fact_check"))
+    if s.user_feedbacks:
+        evs.append(BinaryTaskSignal(engine, s.user_feedbacks, "user_feedback",
+                                    "user_feedback"))
+    if s.modality:
+        evs.append(BinaryTaskSignal(engine, s.modality, "modality",
+                                    "modality"))
+    return evs
