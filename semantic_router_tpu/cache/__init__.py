@@ -1,0 +1,11 @@
+from .hnsw import HNSWIndex
+from .semantic_cache import (
+    CacheBackend,
+    CacheEntry,
+    CacheStats,
+    InMemorySemanticCache,
+    build_cache,
+)
+
+__all__ = ["CacheBackend", "CacheEntry", "CacheStats", "HNSWIndex",
+           "InMemorySemanticCache", "build_cache"]

@@ -87,6 +87,49 @@ class DecisionTraceEntry:
     matched_rules: List[str]
 
 
+def eval_rule_node(node: RuleNode, signals: SignalMatches
+                   ) -> Tuple[bool, float, List[str]]:
+    """Rule-tree evaluation (shared by the decision engine and complexity
+    composers, which are the same boolean expression shape)."""
+    if node.is_leaf():
+        styp = node.signal_type.lower().strip()
+        if not signals.matched(styp, node.name):
+            return False, 0.0, []
+        return True, signals.confidence(styp, node.name), \
+            [f"{styp}:{node.name}"]
+    op = node.operator.upper()
+    if op == "AND":
+        if not node.conditions:
+            return False, 0.0, []
+        min_conf = 1.0
+        rules: List[str] = []
+        for c in node.conditions:
+            m, conf, r = eval_rule_node(c, signals)
+            if not m:
+                return False, 0.0, []
+            min_conf = min(min_conf, conf)
+            rules.extend(r)
+        return True, min_conf, rules
+    if op == "NOT":
+        # matches when no child matches; confidence 1.0
+        for c in node.conditions:
+            m, _conf, _r = eval_rule_node(c, signals)
+            if m:
+                return False, 0.0, []
+        return True, 1.0, []
+    # OR (default)
+    best = 0.0
+    rules = []
+    matched = False
+    for c in node.conditions:
+        m, conf, r = eval_rule_node(c, signals)
+        if m:
+            matched = True
+            best = max(best, conf)
+            rules.extend(r)
+    return matched, best, rules
+
+
 class DecisionEngine:
     """Evaluates decisions over signal matches (reference engine.go:113)."""
 
@@ -129,59 +172,7 @@ class DecisionEngine:
 
     def _eval_node(self, node: RuleNode, signals: SignalMatches
                    ) -> Tuple[bool, float, List[str]]:
-        if node.is_leaf():
-            return self._eval_leaf(node, signals)
-        op = node.operator.upper()
-        if op == "AND":
-            return self._eval_and(node.conditions, signals)
-        if op == "NOT":
-            return self._eval_not(node.conditions, signals)
-        return self._eval_or(node.conditions, signals)
-
-    def _eval_leaf(self, node: RuleNode, signals: SignalMatches
-                   ) -> Tuple[bool, float, List[str]]:
-        styp = node.signal_type.lower().strip()
-        if not signals.matched(styp, node.name):
-            return False, 0.0, []
-        conf = signals.confidence(styp, node.name)
-        return True, conf, [f"{styp}:{node.name}"]
-
-    def _eval_and(self, conds: List[RuleNode], signals: SignalMatches
-                  ) -> Tuple[bool, float, List[str]]:
-        if not conds:
-            return False, 0.0, []
-        min_conf = 1.0
-        rules: List[str] = []
-        for c in conds:
-            m, conf, r = self._eval_node(c, signals)
-            if not m:
-                return False, 0.0, []
-            min_conf = min(min_conf, conf)
-            rules.extend(r)
-        return True, min_conf, rules
-
-    def _eval_or(self, conds: List[RuleNode], signals: SignalMatches
-                 ) -> Tuple[bool, float, List[str]]:
-        best = 0.0
-        rules: List[str] = []
-        matched = False
-        for c in conds:
-            m, conf, r = self._eval_node(c, signals)
-            if m:
-                matched = True
-                best = max(best, conf)
-                rules.extend(r)
-        return matched, best, rules
-
-    def _eval_not(self, conds: List[RuleNode], signals: SignalMatches
-                  ) -> Tuple[bool, float, List[str]]:
-        # NOT matches when none of its children match; confidence is the
-        # complement of the strongest child match (1.0 when nothing matched).
-        for c in conds:
-            m, _conf, _r = self._eval_node(c, signals)
-            if m:
-                return False, 0.0, []
-        return True, 1.0, []
+        return eval_rule_node(node, signals)
 
     # -- selection ---------------------------------------------------------
 

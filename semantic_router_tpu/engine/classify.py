@@ -62,10 +62,10 @@ class TokenClassResult:
 @dataclass
 class _Task:
     name: str
-    kind: str  # "sequence" | "token"
+    kind: str  # "sequence" | "token" | "embedding"
     labels: List[str]
     tokenizer: Tokenizer
-    apply_fn: Callable  # jitted (params, ids, mask) -> logits
+    apply_fn: Callable  # jitted (params, ids, mask, ...) -> logits/embeddings
     params: Any
     max_seq_len: int
     pad_id: int = 0
@@ -76,6 +76,8 @@ class _Payload:
     text: str
     encoding: Encoding
     threshold: float = 0.5
+    exit_layer: Optional[int] = None  # embedding: Matryoshka layer exit
+    output_dim: Optional[int] = None  # embedding: Matryoshka dim truncation
     submit_t: float = field(default_factory=time.perf_counter)
 
 
@@ -98,9 +100,15 @@ class InferenceEngine:
     def register_task(self, name: str, kind: str, module, params,
                       tokenizer: Tokenizer, labels: List[str],
                       max_seq_len: int = 0, pad_id: int = 0) -> None:
-        if kind not in ("sequence", "token"):
+        if kind not in ("sequence", "token", "embedding"):
             raise ValueError(f"unknown task kind {kind!r}")
-        apply_fn = jax.jit(module.apply)
+        if kind == "embedding":
+            # exit_layer/output_dim are static Matryoshka knobs: each
+            # configured (exit, dim) pair is its own compiled program
+            apply_fn = jax.jit(module.apply,
+                               static_argnames=("exit_layer", "output_dim"))
+        else:
+            apply_fn = jax.jit(module.apply)
         max_len = max_seq_len or self.cfg.seq_len_buckets[-1]
         with self._lock:
             self._tasks[name] = _Task(name, kind, list(labels), tokenizer,
@@ -138,6 +146,35 @@ class InferenceEngine:
                                   _Payload(text, enc, threshold))
         return fut.result(timeout=timeout)
 
+    def embed(self, task: str, texts: Sequence[str],
+              exit_layer: Optional[int] = None,
+              output_dim: Optional[int] = None,
+              timeout: float = 30.0) -> np.ndarray:
+        """Batch-embed texts → [n, dim] float32 (L2-normalized). Matryoshka
+        knobs select the layer-exit/dim-truncation variant (N5 2D-Matryoshka;
+        GetEmbedding2DMatryoshka semantic-router.go:1514)."""
+        if not texts:
+            return np.zeros((0, 0), dtype=np.float32)
+        futures = self.embed_async(task, texts, exit_layer, output_dim)
+        return np.stack([f.result(timeout=timeout) for f in futures])
+
+    def embed_async(self, task: str, texts: Sequence[str],
+                    exit_layer: Optional[int] = None,
+                    output_dim: Optional[int] = None) -> list:
+        t = self._require(task, kind="embedding")
+        futures = []
+        for text in texts:
+            enc = t.tokenizer.encode(text, max_length=t.max_seq_len)
+            bucket = pick_bucket(len(enc), self.cfg.seq_len_buckets)
+            # exit/dim participate in the group key: different variants are
+            # different XLA programs and must not share a device batch
+            fut = self.batcher.submit(
+                (task, bucket, exit_layer, output_dim),
+                _Payload(text, enc, exit_layer=exit_layer,
+                         output_dim=output_dim))
+            futures.append(fut)
+        return futures
+
     def warmup(self, tasks: Optional[Sequence[str]] = None,
                buckets: Optional[Sequence[int]] = None) -> None:
         """Pre-trigger jit compilation for the hot (task, bucket, batch=1)
@@ -150,9 +187,13 @@ class InferenceEngine:
                 if t is not None and b > t.max_seq_len:
                     continue
                 try:
-                    fn = (self.token_classify if t is not None
-                          and t.kind == "token" else self.classify)
-                    fn(name, "warmup " * b)
+                    text = "warmup " * b
+                    if t is not None and t.kind == "token":
+                        self.token_classify(name, text)
+                    elif t is not None and t.kind == "embedding":
+                        self.embed(name, [text])
+                    else:
+                        self.classify(name, text)
                 except Exception:
                     pass
 
@@ -167,8 +208,10 @@ class InferenceEngine:
             raise KeyError(f"task {task!r} not registered "
                            f"(known: {sorted(self._tasks)})")
         if kind is not None and t.kind != kind:
-            raise TypeError(f"task {task!r} is a {t.kind} task; use "
-                            f"{'token_classify' if t.kind == 'token' else 'classify'}()")
+            right_call = {"token": "token_classify", "sequence": "classify",
+                          "embedding": "embed"}[t.kind]
+            raise TypeError(
+                f"task {task!r} is a {t.kind} task; use {right_call}()")
         return t
 
     def _submit_texts(self, task: str, texts: Sequence[str]):
@@ -186,7 +229,7 @@ class InferenceEngine:
 
     def _run_batch(self, group_key: Hashable,
                    items: List[BatchItem]) -> Sequence[Any]:
-        task_name, bucket = group_key
+        task_name, bucket = group_key[0], group_key[1]
         t = self._require(task_name)
         n = len(items)
         padded_n = pow2_batch(n, self.cfg.max_batch_size)
@@ -198,6 +241,13 @@ class InferenceEngine:
             L = min(len(enc), bucket)
             ids[i, :L] = enc.ids[:L]
             mask[i, :L] = enc.attention_mask[:L]
+
+        if t.kind == "embedding":
+            p = items[0].payload
+            emb = t.apply_fn(t.params, jnp.asarray(ids), jnp.asarray(mask),
+                             exit_layer=p.exit_layer, output_dim=p.output_dim)
+            emb = np.asarray(jax.device_get(emb), dtype=np.float32)
+            return [emb[i] for i in range(n)]
 
         logits = t.apply_fn(t.params, jnp.asarray(ids), jnp.asarray(mask))
         logits = np.asarray(jax.device_get(logits), dtype=np.float32)

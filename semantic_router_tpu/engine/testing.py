@@ -24,6 +24,15 @@ from ..models.modernbert import (
 from ..utils.tokenization import HashTokenizer
 from .classify import InferenceEngine
 
+DEFAULT_TASKS = [
+    ("intent", "sequence", ["business", "law", "health",
+                            "computer science", "other"]),
+    ("jailbreak", "sequence", ["benign", "jailbreak"]),
+    ("pii", "token", ["O", "B-EMAIL_ADDRESS", "I-EMAIL_ADDRESS",
+                      "B-PHONE_NUMBER", "I-PHONE_NUMBER",
+                      "B-PERSON", "I-PERSON"]),
+]
+
 TINY = dict(
     vocab_size=1024,
     hidden_size=32,
@@ -51,14 +60,7 @@ def make_test_engine(
     intent/jailbreak/PII trio mirroring the reference's default task set.
     """
     if tasks is None:
-        tasks = [
-            ("intent", "sequence", ["business", "law", "health",
-                                    "computer science", "other"]),
-            ("jailbreak", "sequence", ["benign", "jailbreak"]),
-            ("pii", "token", ["O", "B-EMAIL_ADDRESS", "I-EMAIL_ADDRESS",
-                              "B-PHONE_NUMBER", "I-PHONE_NUMBER",
-                              "B-PERSON", "I-PERSON"]),
-        ]
+        tasks = DEFAULT_TASKS
     cfg = engine_cfg or InferenceEngineConfig(
         max_batch_size=8, max_wait_ms=1.0, seq_len_buckets=[32, 128, 512])
     engine = InferenceEngine(cfg)
@@ -66,11 +68,25 @@ def make_test_engine(
     key = jax.random.PRNGKey(seed)
     for i, (name, kind, labels) in enumerate(tasks):
         mcfg = tiny_config(len(labels))
-        module = (ModernBertForSequenceClassification(mcfg)
-                  if kind == "sequence"
-                  else ModernBertForTokenClassification(mcfg))
+        if kind == "embedding":
+            from ..models.embeddings import MmBertEmbeddingModel
+
+            module = MmBertEmbeddingModel(mcfg)
+        elif kind == "sequence":
+            module = ModernBertForSequenceClassification(mcfg)
+        else:
+            module = ModernBertForTokenClassification(mcfg)
         params = module.init(jax.random.fold_in(key, i),
                              jnp.ones((1, 8), jnp.int32))
         engine.register_task(name, kind, module, params, tok, labels,
                              max_seq_len=512)
     return engine
+
+
+def make_embedding_engine(seed: int = 0,
+                          engine_cfg: Optional[InferenceEngineConfig] = None
+                          ) -> InferenceEngine:
+    """Engine with the default trio plus a tiny embedding task."""
+    return make_test_engine(
+        tasks=DEFAULT_TASKS + [("embedding", "embedding", [])],
+        engine_cfg=engine_cfg, seed=seed)

@@ -1,0 +1,236 @@
+"""Flax Gemma3-style text encoder + embedding model.
+
+TPU-native equivalent of the reference's Gemma embedding stack (N5:
+gemma_embedding.rs:630 + gemma3_model.rs:1,323 + dense_layers.rs bottleneck).
+Gemma3 text-architecture contract (validated vs transformers' Gemma3 in
+tests/test_models_gemma.py):
+
+- RMSNorm with zero-init weight applied as ``x * (1 + w)``, normed in fp32
+- embeddings scaled by sqrt(hidden_size) (cast-rounded like the published
+  implementation)
+- sandwich norms: input/post-attention + pre/post-feedforward
+- GQA with per-head-dim q/k RMSNorm; query scaled by
+  query_pre_attn_scalar^-0.5
+- alternating sliding/full attention via ``layer_types``; separate rope
+  bases for local (rope_local_base_freq) vs global (rope_theta) layers,
+  optional linear rope scaling on global layers
+- GeGLU MLP with gelu_pytorch_tanh
+
+Embedding head: mean pooling → dense bottleneck stack (dense_layers.rs) →
+L2 normalize.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import NEG_INF, mean_pool, sdpa
+from ..ops.rope import RopeSpec, apply_rotary
+
+
+@dataclasses.dataclass(frozen=True)
+class GemmaConfig:
+    vocab_size: int = 262208
+    hidden_size: int = 768
+    intermediate_size: int = 1152
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 4
+    num_key_value_heads: int = 1
+    head_dim: int = 256
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 1000000.0
+    rope_local_base_freq: float = 10000.0
+    rope_scaling_factor: float = 1.0  # linear scaling on global layers
+    sliding_window: int = 512
+    layer_types: Tuple[str, ...] = ()  # sliding_attention | full_attention
+    sliding_pattern: int = 6  # used when layer_types empty: every Nth global
+    query_pre_attn_scalar: float = 256.0
+    max_position_embeddings: int = 131072
+    attention_bias: bool = False
+    causal: bool = True
+    dtype: Any = jnp.float32
+
+    def layer_type(self, i: int) -> str:
+        if self.layer_types:
+            return self.layer_types[i]
+        return ("full_attention" if (i + 1) % self.sliding_pattern == 0
+                else "sliding_attention")
+
+    @classmethod
+    def from_hf(cls, hf) -> "GemmaConfig":
+        g = lambda k, d=None: getattr(hf, k, d)
+        rs = g("rope_scaling") or {}
+        return cls(
+            vocab_size=g("vocab_size"),
+            hidden_size=g("hidden_size"),
+            intermediate_size=g("intermediate_size"),
+            num_hidden_layers=g("num_hidden_layers"),
+            num_attention_heads=g("num_attention_heads"),
+            num_key_value_heads=g("num_key_value_heads"),
+            head_dim=g("head_dim", 256),
+            rms_norm_eps=g("rms_norm_eps", 1e-6),
+            rope_theta=g("rope_theta", 1e6),
+            rope_local_base_freq=g("rope_local_base_freq", 1e4),
+            rope_scaling_factor=float(rs.get("factor", 1.0)) if rs else 1.0,
+            sliding_window=g("sliding_window", 512),
+            layer_types=tuple(g("layer_types") or ()),
+            query_pre_attn_scalar=float(g("query_pre_attn_scalar", 256.0)),
+            max_position_embeddings=g("max_position_embeddings", 131072),
+        )
+
+
+class GemmaRMSNorm(nn.Module):
+    """x * (1 + w), fp32 norm, product cast (not x-then-product)."""
+
+    eps: float = 1e-6
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        w = self.param("weight", nn.initializers.zeros, (x.shape[-1],))
+        xf = x.astype(jnp.float32)
+        out = xf * jax.lax.rsqrt(
+            jnp.mean(xf * xf, axis=-1, keepdims=True) + self.eps)
+        return (out * (1.0 + w.astype(jnp.float32))).astype(self.dtype)
+
+
+class GemmaAttention(nn.Module):
+    config: GemmaConfig
+    layer_id: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, attention_mask: jnp.ndarray
+                 ) -> jnp.ndarray:
+        cfg = self.config
+        B, S, _ = x.shape
+        H, KV, D = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                    cfg.head_dim)
+        q = nn.Dense(H * D, use_bias=cfg.attention_bias, name="q_proj",
+                     dtype=cfg.dtype)(x).reshape(B, S, H, D)
+        k = nn.Dense(KV * D, use_bias=cfg.attention_bias, name="k_proj",
+                     dtype=cfg.dtype)(x).reshape(B, S, KV, D)
+        v = nn.Dense(KV * D, use_bias=cfg.attention_bias, name="v_proj",
+                     dtype=cfg.dtype)(x).reshape(B, S, KV, D)
+        q = GemmaRMSNorm(cfg.rms_norm_eps, cfg.dtype, name="q_norm")(q)
+        k = GemmaRMSNorm(cfg.rms_norm_eps, cfg.dtype, name="k_norm")(k)
+        q, k, v = (jnp.moveaxis(t, 2, 1) for t in (q, k, v))
+
+        is_sliding = cfg.layer_type(self.layer_id) == "sliding_attention"
+        if is_sliding:
+            cos, sin = RopeSpec(D, cfg.rope_local_base_freq).tables(S)
+        elif cfg.rope_scaling_factor != 1.0:
+            # linear scaling: positions divided by factor
+            cos, sin = RopeSpec(D, cfg.rope_theta).tables_scaled(
+                S, cfg.rope_scaling_factor)
+        else:
+            cos, sin = RopeSpec(D, cfg.rope_theta).tables(S)
+        q, k = apply_rotary(q, k, cos, sin)
+
+        if KV != H:
+            rep = H // KV
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+
+        bias = (1.0 - attention_mask.astype(jnp.float32))[:, None, None, :] \
+            * NEG_INF
+        idx = jnp.arange(S)
+        if cfg.causal:
+            bias = bias + jnp.where(idx[:, None] >= idx[None, :], 0.0,
+                                    NEG_INF)[None, None]
+        if is_sliding:
+            dist = idx[:, None] - idx[None, :]
+            in_window = jnp.abs(dist) < cfg.sliding_window if not cfg.causal \
+                else (dist >= 0) & (dist < cfg.sliding_window)
+            bias = bias + jnp.where(in_window, 0.0, NEG_INF)[None, None]
+
+        scale = cfg.query_pre_attn_scalar ** -0.5
+        out = sdpa(q, k, v, bias=bias, scale=scale)
+        out = jnp.moveaxis(out, 1, 2).reshape(B, S, H * D)
+        return nn.Dense(cfg.hidden_size, use_bias=cfg.attention_bias,
+                        name="o_proj", dtype=cfg.dtype)(out)
+
+
+class GemmaMLP(nn.Module):
+    config: GemmaConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        gate = nn.Dense(cfg.intermediate_size, use_bias=False,
+                        name="gate_proj", dtype=cfg.dtype)(x)
+        up = nn.Dense(cfg.intermediate_size, use_bias=False, name="up_proj",
+                      dtype=cfg.dtype)(x)
+        act = jax.nn.gelu(gate, approximate=True)
+        return nn.Dense(cfg.hidden_size, use_bias=False, name="down_proj",
+                        dtype=cfg.dtype)(act * up)
+
+
+class GemmaDecoderLayer(nn.Module):
+    config: GemmaConfig
+    layer_id: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, attention_mask: jnp.ndarray
+                 ) -> jnp.ndarray:
+        cfg = self.config
+        h = GemmaRMSNorm(cfg.rms_norm_eps, cfg.dtype,
+                         name="input_layernorm")(x)
+        h = GemmaAttention(cfg, self.layer_id, name="self_attn")(
+            h, attention_mask)
+        h = GemmaRMSNorm(cfg.rms_norm_eps, cfg.dtype,
+                         name="post_attention_layernorm")(h)
+        x = x + h
+        h = GemmaRMSNorm(cfg.rms_norm_eps, cfg.dtype,
+                         name="pre_feedforward_layernorm")(x)
+        h = GemmaMLP(cfg, name="mlp")(h)
+        h = GemmaRMSNorm(cfg.rms_norm_eps, cfg.dtype,
+                         name="post_feedforward_layernorm")(h)
+        return x + h
+
+
+class GemmaModel(nn.Module):
+    config: GemmaConfig
+
+    @nn.compact
+    def __call__(self, input_ids: jnp.ndarray,
+                 attention_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        cfg = self.config
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens",
+                     dtype=cfg.dtype)(input_ids)
+        # sqrt-scale with the published cast-rounding behavior
+        normalizer = jnp.asarray(cfg.hidden_size ** 0.5, dtype=cfg.dtype)
+        x = x * normalizer
+        for i in range(cfg.num_hidden_layers):
+            x = GemmaDecoderLayer(cfg, i, name=f"layers_{i}")(
+                x, attention_mask)
+        return GemmaRMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)
+
+
+class GemmaEmbeddingModel(nn.Module):
+    """Gemma embedding: trunk → mean pool → dense bottleneck stack → L2
+    normalize (gemma_embedding.rs + dense_layers.rs)."""
+
+    config: GemmaConfig
+    bottleneck_dims: Tuple[int, ...] = ()  # e.g. (3072, 768)
+
+    @nn.compact
+    def __call__(self, input_ids: jnp.ndarray,
+                 attention_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        hidden = GemmaModel(self.config, name="model")(
+            input_ids, attention_mask)
+        pooled = mean_pool(hidden, attention_mask)
+        for i, dim in enumerate(self.bottleneck_dims):
+            pooled = nn.Dense(dim, use_bias=False, name=f"dense_{i}",
+                              dtype=self.config.dtype)(pooled)
+        pooled = pooled.astype(jnp.float32)
+        norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+        return (pooled / jnp.maximum(norm, 1e-9)).astype(self.config.dtype)

@@ -1,0 +1,36 @@
+"""2D-Matryoshka helpers: dim truncation × layer early-exit.
+
+Reference capability (onnx-binding/README.md:38-62; GetEmbedding2DMatryoshka
+semantic-router.go:1514): mmBERT embeddings trained 2D-Matryoshka can trade
+quality for speed along two axes — exit at layer 22/16/11/6 and/or truncate
+768→512/256/128/64 dims. On TPU, layer exit is a static ``exit_layer`` on
+the trunk (smaller XLA program per exit point); dim truncation is a slice +
+renormalize, free at serving time.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def truncate_normalize(emb: jnp.ndarray, dim: Optional[int] = None
+                       ) -> jnp.ndarray:
+    """Slice to the first ``dim`` features and re-L2-normalize."""
+    if dim is not None and dim < emb.shape[-1]:
+        emb = emb[..., :dim]
+    embf = emb.astype(jnp.float32)
+    norm = jnp.linalg.norm(embf, axis=-1, keepdims=True)
+    return embf / jnp.maximum(norm, 1e-9)
+
+
+def matryoshka_views(emb: np.ndarray, dims: Sequence[int]) -> dict:
+    """All configured dim views of one embedding batch (numpy, host-side)."""
+    out = {}
+    for d in dims:
+        v = emb[..., :d]
+        n = np.linalg.norm(v, axis=-1, keepdims=True)
+        out[d] = v / np.maximum(n, 1e-9)
+    return out

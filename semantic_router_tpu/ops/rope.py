@@ -178,3 +178,11 @@ class RopeSpec:
         return _cached_tables(self._inv_freq_key, int(seq_len),
                               float(self.attention_scaling),
                               jnp.dtype(dtype).name)
+
+    def tables_scaled(self, seq_len: int, factor: float, dtype=jnp.float32):
+        """Linear (position-interpolation) scaling: positions ÷ factor —
+        Gemma3's global-layer rope scaling."""
+        key = tuple(f / factor for f in self._inv_freq_key)
+        return _cached_tables(key, int(seq_len),
+                              float(self.attention_scaling),
+                              jnp.dtype(dtype).name)

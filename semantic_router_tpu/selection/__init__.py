@@ -1,0 +1,39 @@
+from .base import (
+    Feedback,
+    PercentileTracker,
+    SelectionContext,
+    SelectionResult,
+    Selector,
+    SelectorRegistry,
+    registry,
+)
+from . import algorithms as _algorithms  # noqa: F401  (registers selectors)
+from . import ml as _ml  # noqa: F401
+from .algorithms import (
+    AutoMixSelector,
+    EloSelector,
+    HybridSelector,
+    LatencyAwareSelector,
+    LookupTableSelector,
+    MultiFactorSelector,
+    RLDrivenSelector,
+    SessionAwareSelector,
+    StaticSelector,
+)
+from .ml import (
+    GMTRouterSelector,
+    KMeansSelector,
+    KNNSelector,
+    MLPSelector,
+    RouterDCSelector,
+    SVMSelector,
+)
+
+__all__ = [
+    "AutoMixSelector", "EloSelector", "Feedback", "GMTRouterSelector",
+    "HybridSelector", "KMeansSelector", "KNNSelector", "LatencyAwareSelector",
+    "LookupTableSelector", "MLPSelector", "MultiFactorSelector",
+    "PercentileTracker", "RLDrivenSelector", "RouterDCSelector",
+    "SVMSelector", "SelectionContext", "SelectionResult", "Selector",
+    "SelectorRegistry", "SessionAwareSelector", "StaticSelector", "registry",
+]

@@ -1,0 +1,474 @@
+"""ML-based selectors: KNN / KMeans / SVM (ml-binding N14 parity), a JAX
+MLP selector (N10: candle mlp_selector.rs — train/serialize/JSON
+round-trip), router_dc (dual-contrastive prototype routing), and gmtrouter
+(graph score propagation).
+
+All operate on query embeddings (ctx.embedding()); fitting is vectorized
+numpy/JAX — KMeans runs its Lloyd iterations as one jit'd lax loop on
+device (the TPU replacement for the Rust kmeans.rs)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..config.schema import ModelRef
+from .base import (
+    Feedback,
+    SelectionContext,
+    SelectionResult,
+    registry,
+)
+from .algorithms import StaticSelector
+
+
+class _EmbeddingMemory:
+    """Shared (embedding, model, reward) memory for instance-based
+    selectors."""
+
+    def __init__(self, capacity: int = 4096) -> None:
+        self.capacity = capacity
+        self.embeddings: List[np.ndarray] = []
+        self.models: List[str] = []
+        self.rewards: List[float] = []
+        self._lock = threading.Lock()
+
+    def add(self, emb: np.ndarray, model: str, reward: float) -> None:
+        with self._lock:
+            self.embeddings.append(np.asarray(emb, np.float32))
+            self.models.append(model)
+            self.rewards.append(reward)
+            if len(self.embeddings) > self.capacity:
+                drop = len(self.embeddings) - self.capacity
+                del self.embeddings[:drop]
+                del self.models[:drop]
+                del self.rewards[:drop]
+
+    def matrix(self):
+        with self._lock:
+            if not self.embeddings:
+                return None, [], []
+            return (np.stack(self.embeddings), list(self.models),
+                    list(self.rewards))
+
+
+class KNNSelector:
+    """k-nearest-neighbor vote over past (query, model, reward) outcomes
+    (ml-binding/src/knn.rs role)."""
+
+    name = "knn"
+
+    def __init__(self, k: int = 8, fallback: str = "static", **kwargs):
+        self.k = k
+        self.memory = _EmbeddingMemory()
+        self._fallback = registry.create(fallback, **kwargs)
+
+    def select(self, candidates: List[ModelRef], ctx: SelectionContext
+               ) -> SelectionResult:
+        emb = ctx.embedding()
+        mat, models, rewards = self.memory.matrix()
+        if emb is None or mat is None or len(models) < self.k:
+            return self._fallback.select(candidates, ctx)
+        sims = mat @ emb / (
+            np.linalg.norm(mat, axis=1) * max(np.linalg.norm(emb), 1e-9))
+        top = np.argsort(-sims)[:self.k]
+        cand_names = {c.model for c in candidates}
+        votes: Dict[str, float] = {}
+        for i in top:
+            if models[i] in cand_names:
+                votes[models[i]] = votes.get(models[i], 0.0) \
+                    + float(sims[i]) * rewards[i]
+        if not votes:
+            return self._fallback.select(candidates, ctx)
+        best_name = max(votes, key=votes.get)
+        best = next(c for c in candidates if c.model == best_name)
+        return SelectionResult(best, votes[best_name], f"knn k={self.k}")
+
+    def update(self, fb: Feedback) -> None:
+        if fb.query_embedding is not None:
+            reward = fb.quality if fb.quality else (1.0 if fb.success else 0.0)
+            self.memory.add(fb.query_embedding, fb.model, reward)
+        self._fallback.update(fb)
+
+
+class KMeansSelector:
+    """Cluster query embeddings; route each cluster to its best-performing
+    model (ml-binding/src/kmeans.rs role). Lloyd iterations run as one
+    jit'd JAX loop."""
+
+    name = "kmeans"
+
+    def __init__(self, n_clusters: int = 8, refit_every: int = 64,
+                 fallback: str = "static", **kwargs):
+        self.n_clusters = n_clusters
+        self.refit_every = refit_every
+        self.memory = _EmbeddingMemory()
+        self.centroids: Optional[np.ndarray] = None
+        self.cluster_best: Dict[int, str] = {}
+        self._since_fit = 0
+        self._fallback = registry.create(fallback, **kwargs)
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def fit_kmeans(x: np.ndarray, k: int, iters: int = 25,
+                   seed: int = 0) -> np.ndarray:
+        """Jit'd Lloyd's algorithm: [N, d] → [k, d] centroids."""
+        import jax
+        import jax.numpy as jnp
+
+        n = x.shape[0]
+        k = min(k, n)
+        rng = np.random.default_rng(seed)
+        init = x[rng.choice(n, size=k, replace=False)]
+
+        @jax.jit
+        def run(x, cents):
+            def step(cents, _):
+                d = ((x[:, None, :] - cents[None, :, :]) ** 2).sum(-1)
+                assign = jnp.argmin(d, axis=1)
+                one_hot = jax.nn.one_hot(assign, cents.shape[0], dtype=x.dtype)
+                counts = one_hot.sum(0)[:, None]
+                sums = one_hot.T @ x
+                new = jnp.where(counts > 0, sums / jnp.maximum(counts, 1),
+                                cents)
+                return new, None
+
+            cents, _ = jax.lax.scan(step, cents, None, length=iters)
+            return cents
+
+        return np.asarray(run(jnp.asarray(x), jnp.asarray(init)))
+
+    def _maybe_fit(self) -> None:
+        mat, models, rewards = self.memory.matrix()
+        if mat is None or len(models) < self.n_clusters:
+            return
+        self.centroids = self.fit_kmeans(mat, self.n_clusters)
+        d = ((mat[:, None, :] - self.centroids[None, :, :]) ** 2).sum(-1)
+        assign = d.argmin(1)
+        best: Dict[int, Dict[str, float]] = {}
+        for a, m, r in zip(assign, models, rewards):
+            best.setdefault(int(a), {}).setdefault(m, 0.0)
+            best[int(a)][m] += r
+        self.cluster_best = {a: max(ms, key=ms.get)
+                             for a, ms in best.items()}
+
+    def select(self, candidates: List[ModelRef], ctx: SelectionContext
+               ) -> SelectionResult:
+        emb = ctx.embedding()
+        with self._lock:
+            cents = self.centroids
+            mapping = dict(self.cluster_best)
+        if emb is None or cents is None:
+            return self._fallback.select(candidates, ctx)
+        cluster = int(((cents - emb) ** 2).sum(1).argmin())
+        model = mapping.get(cluster)
+        for c in candidates:
+            if c.model == model:
+                return SelectionResult(c, 1.0, f"kmeans cluster {cluster}")
+        return self._fallback.select(candidates, ctx)
+
+    def update(self, fb: Feedback) -> None:
+        if fb.query_embedding is not None:
+            reward = fb.quality if fb.quality else (1.0 if fb.success else 0.0)
+            self.memory.add(fb.query_embedding, fb.model, reward)
+            with self._lock:
+                self._since_fit += 1
+                if self._since_fit >= self.refit_every:
+                    self._since_fit = 0
+                    self._maybe_fit()
+        self._fallback.update(fb)
+
+
+class SVMSelector:
+    """Linear one-vs-rest SVM over query embeddings (ml-binding/src/svm.rs
+    role): hinge-loss SGD refit from the outcome memory."""
+
+    name = "svm"
+
+    def __init__(self, refit_every: int = 64, lr: float = 0.1,
+                 reg: float = 1e-3, epochs: int = 10,
+                 fallback: str = "static", **kwargs):
+        self.refit_every = refit_every
+        self.lr, self.reg, self.epochs = lr, reg, epochs
+        self.memory = _EmbeddingMemory()
+        self.weights: Optional[np.ndarray] = None  # [n_classes, d+1]
+        self.classes: List[str] = []
+        self._since_fit = 0
+        self._fallback = registry.create(fallback, **kwargs)
+        self._lock = threading.Lock()
+
+    def _fit(self) -> None:
+        mat, models, rewards = self.memory.matrix()
+        if mat is None:
+            return
+        good = [i for i, r in enumerate(rewards) if r > 0.5]
+        if len(good) < 8:
+            return
+        x = np.concatenate([mat[good],
+                            np.ones((len(good), 1), np.float32)], axis=1)
+        labels = [models[i] for i in good]
+        classes = sorted(set(labels))
+        if len(classes) < 2:
+            return
+        y = np.asarray([[1.0 if l == c else -1.0 for c in classes]
+                        for l in labels], np.float32)
+        w = np.zeros((len(classes), x.shape[1]), np.float32)
+        rng = np.random.default_rng(0)
+        for _ in range(self.epochs):
+            for i in rng.permutation(len(x)):
+                margins = y[i] * (w @ x[i])
+                mask = margins < 1.0
+                w = (1 - self.lr * self.reg) * w
+                w[mask] += self.lr * y[i][mask, None] * x[i][None, :]
+        with self._lock:
+            self.weights, self.classes = w, classes
+
+    def select(self, candidates: List[ModelRef], ctx: SelectionContext
+               ) -> SelectionResult:
+        emb = ctx.embedding()
+        with self._lock:
+            w, classes = self.weights, list(self.classes)
+        if emb is None or w is None:
+            return self._fallback.select(candidates, ctx)
+        x = np.concatenate([emb, [1.0]]).astype(np.float32)
+        scores = w @ x
+        order = np.argsort(-scores)
+        cand = {c.model: c for c in candidates}
+        for i in order:
+            if classes[i] in cand:
+                return SelectionResult(cand[classes[i]], float(scores[i]),
+                                       "svm margin")
+        return self._fallback.select(candidates, ctx)
+
+    def update(self, fb: Feedback) -> None:
+        if fb.query_embedding is not None:
+            reward = fb.quality if fb.quality else (1.0 if fb.success else 0.0)
+            self.memory.add(fb.query_embedding, fb.model, reward)
+            self._since_fit += 1
+            if self._since_fit >= self.refit_every:
+                self._since_fit = 0
+                self._fit()
+        self._fallback.update(fb)
+
+
+class MLPSelector:
+    """Two-layer JAX MLP scoring (embedding → model logits); train from the
+    outcome memory; JSON serialize/deserialize round-trip — N10 parity with
+    candle-binding mlp_selector.rs:538 (train/serialize/JSON, device+dtype
+    selectable; Go wrapper semantic-router.go:4026-4144)."""
+
+    name = "mlp"
+
+    def __init__(self, hidden: int = 64, refit_every: int = 64,
+                 lr: float = 1e-2, epochs: int = 30,
+                 fallback: str = "static", **kwargs):
+        self.hidden = hidden
+        self.refit_every = refit_every
+        self.lr, self.epochs = lr, epochs
+        self.memory = _EmbeddingMemory()
+        self.params: Optional[dict] = None
+        self.classes: List[str] = []
+        self._since_fit = 0
+        self._fallback = registry.create(fallback, **kwargs)
+        self._lock = threading.Lock()
+
+    def _forward(self, params, x):
+        import jax.numpy as jnp
+
+        h = jnp.tanh(x @ params["w1"] + params["b1"])
+        return h @ params["w2"] + params["b2"]
+
+    def fit(self, x: np.ndarray, labels: Sequence[str]) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        classes = sorted(set(labels))
+        if len(classes) < 2:
+            return
+        y = np.asarray([classes.index(l) for l in labels])
+        d = x.shape[1]
+        key = jax.random.PRNGKey(0)
+        k1, k2 = jax.random.split(key)
+        params = {
+            "w1": jax.random.normal(k1, (d, self.hidden)) * (1 / np.sqrt(d)),
+            "b1": jnp.zeros((self.hidden,)),
+            "w2": jax.random.normal(k2, (self.hidden, len(classes)))
+            * (1 / np.sqrt(self.hidden)),
+            "b2": jnp.zeros((len(classes),)),
+        }
+        opt = optax.adam(self.lr)
+        opt_state = opt.init(params)
+        xj, yj = jnp.asarray(x), jnp.asarray(y)
+
+        @jax.jit
+        def step(params, opt_state):
+            def loss_fn(p):
+                logits = self._forward(p, xj)
+                logp = jax.nn.log_softmax(logits)
+                return -jnp.take_along_axis(logp, yj[:, None], 1).mean()
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = opt.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        for _ in range(self.epochs):
+            params, opt_state, _loss = step(params, opt_state)
+        with self._lock:
+            self.params = {k: np.asarray(v) for k, v in params.items()}
+            self.classes = classes
+
+    def _refit_from_memory(self) -> None:
+        mat, models, rewards = self.memory.matrix()
+        if mat is None:
+            return
+        good = [i for i, r in enumerate(rewards) if r > 0.5]
+        if len(good) >= 8:
+            self.fit(mat[good], [models[i] for i in good])
+
+    def select(self, candidates: List[ModelRef], ctx: SelectionContext
+               ) -> SelectionResult:
+        emb = ctx.embedding()
+        with self._lock:
+            params, classes = self.params, list(self.classes)
+        if emb is None or params is None:
+            return self._fallback.select(candidates, ctx)
+        import jax.numpy as jnp
+
+        logits = np.asarray(self._forward(
+            {k: jnp.asarray(v) for k, v in params.items()},
+            jnp.asarray(emb[None, :])))[0]
+        order = np.argsort(-logits)
+        cand = {c.model: c for c in candidates}
+        for i in order:
+            if classes[i] in cand:
+                return SelectionResult(cand[classes[i]], float(logits[i]),
+                                       "mlp")
+        return self._fallback.select(candidates, ctx)
+
+    def update(self, fb: Feedback) -> None:
+        if fb.query_embedding is not None:
+            reward = fb.quality if fb.quality else (1.0 if fb.success else 0.0)
+            self.memory.add(fb.query_embedding, fb.model, reward)
+            self._since_fit += 1
+            if self._since_fit >= self.refit_every:
+                self._since_fit = 0
+                self._refit_from_memory()
+        self._fallback.update(fb)
+
+    # -- serialization (mlp_selector.rs JSON round-trip) -------------------
+
+    def to_json(self) -> str:
+        with self._lock:
+            return json.dumps({
+                "hidden": self.hidden,
+                "classes": self.classes,
+                "params": {k: v.tolist() for k, v in (self.params or {}).items()},
+            })
+
+    @classmethod
+    def from_json(cls, blob: str, **kwargs) -> "MLPSelector":
+        data = json.loads(blob)
+        sel = cls(hidden=data["hidden"], **kwargs)
+        if data["params"]:
+            sel.params = {k: np.asarray(v, np.float32)
+                          for k, v in data["params"].items()}
+            sel.classes = list(data["classes"])
+        return sel
+
+
+class RouterDCSelector:
+    """Dual-contrastive routing (router_dc): per-model prototype embeddings
+    learned from positively-rated queries; select by max prototype
+    similarity contrast."""
+
+    name = "router_dc"
+
+    def __init__(self, momentum: float = 0.9, fallback: str = "static",
+                 **kwargs):
+        self.momentum = momentum
+        self.prototypes: Dict[str, np.ndarray] = {}
+        self._fallback = registry.create(fallback, **kwargs)
+        self._lock = threading.Lock()
+
+    def select(self, candidates: List[ModelRef], ctx: SelectionContext
+               ) -> SelectionResult:
+        emb = ctx.embedding()
+        with self._lock:
+            protos = {m: p for m, p in self.prototypes.items()}
+        if emb is None or not protos:
+            return self._fallback.select(candidates, ctx)
+        n = max(np.linalg.norm(emb), 1e-9)
+        scores = {}
+        for c in candidates:
+            p = protos.get(c.model)
+            if p is not None:
+                scores[c.model] = float(emb @ p / (n * max(np.linalg.norm(p), 1e-9)))
+        if not scores:
+            return self._fallback.select(candidates, ctx)
+        best_name = max(scores, key=scores.get)
+        best = next(c for c in candidates if c.model == best_name)
+        return SelectionResult(best, scores[best_name], "router_dc prototype")
+
+    def update(self, fb: Feedback) -> None:
+        if fb.query_embedding is not None and fb.success:
+            with self._lock:
+                p = self.prototypes.get(fb.model)
+                e = np.asarray(fb.query_embedding, np.float32)
+                self.prototypes[fb.model] = e if p is None else \
+                    self.momentum * p + (1 - self.momentum) * e
+        self._fallback.update(fb)
+
+
+class GMTRouterSelector:
+    """Graph-based routing (gmtrouter): bipartite query-cluster ↔ model
+    graph; edge weights from rewards propagate one hop so sparsely-observed
+    clusters inherit neighboring evidence."""
+
+    name = "gmtrouter"
+
+    def __init__(self, n_nodes: int = 16, fallback: str = "static", **kwargs):
+        self.kmeans = KMeansSelector(n_clusters=n_nodes, fallback=fallback,
+                                     **kwargs)
+        self._edge: Dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def select(self, candidates: List[ModelRef], ctx: SelectionContext
+               ) -> SelectionResult:
+        emb = ctx.embedding()
+        cents = self.kmeans.centroids
+        if emb is None or cents is None:
+            return self.kmeans.select(candidates, ctx)
+        d = ((cents - emb) ** 2).sum(1)
+        order = np.argsort(d)
+        with self._lock:
+            scores: Dict[str, float] = {}
+            for rank, node in enumerate(order[:3]):  # one-hop propagation
+                w = 1.0 / (1 + rank)
+                for c in candidates:
+                    e = self._edge.get((int(node), c.model))
+                    if e is not None:
+                        scores[c.model] = scores.get(c.model, 0.0) + w * e
+        if not scores:
+            return self.kmeans.select(candidates, ctx)
+        best_name = max(scores, key=scores.get)
+        best = next(c for c in candidates if c.model == best_name)
+        return SelectionResult(best, scores[best_name], "gmtrouter graph")
+
+    def update(self, fb: Feedback) -> None:
+        self.kmeans.update(fb)
+        if fb.query_embedding is not None and self.kmeans.centroids is not None:
+            node = int(((self.kmeans.centroids - fb.query_embedding) ** 2)
+                       .sum(1).argmin())
+            reward = fb.quality if fb.quality else (1.0 if fb.success else 0.0)
+            with self._lock:
+                key = (node, fb.model)
+                self._edge[key] = 0.8 * self._edge.get(key, 0.5) + 0.2 * reward
+
+
+for _cls in (KNNSelector, KMeansSelector, SVMSelector, MLPSelector,
+             RouterDCSelector, GMTRouterSelector):
+    registry.register(_cls.name, _cls)

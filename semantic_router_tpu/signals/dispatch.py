@@ -32,10 +32,12 @@ class SignalDispatcher:
     def __init__(self, evaluators: List[SignalEvaluator],
                  projections: Optional[ProjectionEvaluator] = None,
                  used_types: Optional[List[str]] = None,
+                 complexity_rules: Optional[list] = None,
                  max_workers: int = 24) -> None:
         self.evaluators = {e.signal_type: e for e in evaluators}
         self.projections = projections
         self.used_types = set(used_types) if used_types is not None else None
+        self.complexity_rules = list(complexity_rules or [])
         self.pool = ThreadPoolExecutor(max_workers=max_workers,
                                        thread_name_prefix="signal")
 
@@ -74,6 +76,26 @@ class SignalDispatcher:
                 if h.detail:
                     signals.details.setdefault(r.signal_type, {})[h.rule] = \
                         h.detail.get("keywords", h.detail)
+
+        # Complexity composers: boolean expressions over sibling families
+        # that force-escalate a rule to "hard" (reference: the composer
+        # block on complexity signals — evaluated after the fan-out since
+        # it references other signals).
+        if self.complexity_rules:
+            from ..decision.engine import eval_rule_node
+
+            for rule in self.complexity_rules:
+                if rule.composer is None:
+                    continue
+                matched, conf, _ = eval_rule_node(rule.composer, signals)
+                hard = f"{rule.name}:hard"
+                if matched and hard not in signals.matches.get("complexity", ()):
+                    # drop any lower level this rule reported
+                    levels = signals.matches.get("complexity", [])
+                    signals.matches["complexity"] = [
+                        n for n in levels
+                        if n.split(":", 1)[0] != rule.name]
+                    signals.add("complexity", hard, max(conf, 0.5))
 
         needs_projection = (
             self.projections is not None
@@ -125,4 +147,5 @@ def build_heuristic_dispatcher(cfg: RouterConfig,
         evaluators,
         projections=ProjectionEvaluator(cfg.projections),
         used_types=used,
+        complexity_rules=cfg.signals.complexity,
     )

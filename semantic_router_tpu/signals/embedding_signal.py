@@ -1,0 +1,192 @@
+"""Embedding-similarity signal family + preference + complexity prototypes.
+
+Reference parity:
+- embedding rules → embedding_classifier*.go: rule candidates embedded once,
+  query embedded per request, cosine aggregation max|any|mean vs threshold
+  (GetEmbeddingBatched semantic-router.go:808 feeding the batch scheduler).
+- preference rules → contrastive_preference_classifier.go: example-set
+  similarity.
+- complexity → complexity_classifier.go + prototype_bank.go: hard/easy
+  prototype banks; the margin decides hard/medium/easy; an optional
+  ``composer`` boolean expression over other signals can force-escalate
+  (evaluated post-dispatch by the dispatcher since it references sibling
+  families).
+
+Candidate embeddings are computed lazily on first use and cached per rule —
+the prototype bank. Cosine scores are plain numpy dots on L2-normalized
+vectors (a [n_cand, dim] @ [dim] matmul — the N16 SIMD kernels' role).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..config.schema import ComplexityRule, EmbeddingRule, PreferenceRule
+from ..engine.classify import InferenceEngine
+from .base import RequestContext, SignalHit, SignalResult
+
+
+class _PrototypeBank:
+    """Lazy per-rule candidate-embedding cache (prototype_bank.go)."""
+
+    def __init__(self, engine: InferenceEngine, task: str) -> None:
+        self.engine = engine
+        self.task = task
+        self._cache: Dict[str, np.ndarray] = {}
+        self._lock = threading.Lock()
+
+    def get(self, key: str, texts: List[str]) -> np.ndarray:
+        with self._lock:
+            hit = self._cache.get(key)
+        if hit is not None:
+            return hit
+        emb = self.engine.embed(self.task, texts)
+        with self._lock:
+            self._cache[key] = emb
+        return emb
+
+    def embed_query(self, text: str) -> np.ndarray:
+        return self.engine.embed(self.task, [text])[0]
+
+
+def _aggregate(sims: np.ndarray, method: str, threshold: float
+               ) -> tuple[bool, float]:
+    if sims.size == 0:
+        return False, 0.0
+    if method == "mean":
+        score = float(sims.mean())
+        return score >= threshold, score
+    if method == "any":
+        matched = bool((sims >= threshold).any())
+        return matched, float(sims.max())
+    # max (default)
+    score = float(sims.max())
+    return score >= threshold, score
+
+
+class EmbeddingSignal:
+    signal_type = "embedding"
+
+    def __init__(self, engine: InferenceEngine, rules: List[EmbeddingRule],
+                 task: str = "embedding") -> None:
+        self.rules = rules
+        self.bank = _PrototypeBank(engine, task)
+        self.engine = engine
+        self.task = task
+
+    def evaluate(self, ctx: RequestContext) -> SignalResult:
+        start = time.perf_counter()
+        res = SignalResult(self.signal_type)
+        try:
+            if not self.engine.has_task(self.task):
+                res.error = f"task {self.task!r} not loaded"
+                return res
+            query = self.bank.embed_query(ctx.user_text)
+            for rule in self.rules:
+                if not rule.candidates:
+                    continue
+                cands = self.bank.get(f"emb:{rule.name}", rule.candidates)
+                sims = cands @ query
+                matched, score = _aggregate(sims, rule.aggregation_method,
+                                            rule.threshold)
+                if matched:
+                    res.hits.append(SignalHit(rule.name, score))
+        except Exception as exc:
+            res.error = f"{type(exc).__name__}: {exc}"
+        finally:
+            res.latency_s = time.perf_counter() - start
+        return res
+
+
+class PreferenceSignal:
+    signal_type = "preference"
+
+    def __init__(self, engine: InferenceEngine, rules: List[PreferenceRule],
+                 task: str = "embedding") -> None:
+        self.rules = rules
+        self.bank = _PrototypeBank(engine, task)
+        self.engine = engine
+        self.task = task
+
+    def evaluate(self, ctx: RequestContext) -> SignalResult:
+        start = time.perf_counter()
+        res = SignalResult(self.signal_type)
+        try:
+            if not self.engine.has_task(self.task):
+                res.error = f"task {self.task!r} not loaded"
+                return res
+            query = self.bank.embed_query(ctx.user_text)
+            for rule in self.rules:
+                if not rule.examples:
+                    continue
+                ex = self.bank.get(f"pref:{rule.name}", rule.examples)
+                score = float((ex @ query).max())
+                if score >= rule.threshold:
+                    res.hits.append(SignalHit(rule.name, score))
+        except Exception as exc:
+            res.error = f"{type(exc).__name__}: {exc}"
+        finally:
+            res.latency_s = time.perf_counter() - start
+        return res
+
+
+class ComplexitySignal:
+    """Prototype-margin difficulty scoring. Reports "rule:level" hits
+    (hard/medium/easy) the decision engine matches by exact or bare name."""
+
+    signal_type = "complexity"
+    MARGIN = 0.05
+
+    def __init__(self, engine: InferenceEngine, rules: List[ComplexityRule],
+                 task: str = "embedding") -> None:
+        self.rules = rules
+        self.bank = _PrototypeBank(engine, task)
+        self.engine = engine
+        self.task = task
+
+    def evaluate(self, ctx: RequestContext) -> SignalResult:
+        start = time.perf_counter()
+        res = SignalResult(self.signal_type)
+        try:
+            if not self.engine.has_task(self.task):
+                res.error = f"task {self.task!r} not loaded"
+                return res
+            query = self.bank.embed_query(ctx.user_text)
+            for rule in self.rules:
+                level, conf = self._level(rule, query, ctx)
+                if level is not None:
+                    res.hits.append(SignalHit(f"{rule.name}:{level}", conf))
+        except Exception as exc:
+            res.error = f"{type(exc).__name__}: {exc}"
+        finally:
+            res.latency_s = time.perf_counter() - start
+        return res
+
+    def _level(self, rule: ComplexityRule, query: np.ndarray,
+               ctx: RequestContext) -> tuple[Optional[str], float]:
+        hard_c = list(rule.hard_candidates)
+        easy_c = list(rule.easy_candidates)
+        variant = "txt"
+        if ctx.has_images():
+            hard_c += rule.hard_image_candidates
+            easy_c += rule.easy_image_candidates
+            variant = "img"  # distinct cache slot per candidate-set variant
+        sim_hard = sim_easy = 0.0
+        if hard_c:
+            bank = self.bank.get(f"cx:{rule.name}:hard:{variant}", hard_c)
+            sim_hard = float((bank @ query).max())
+        if easy_c:
+            bank = self.bank.get(f"cx:{rule.name}:easy:{variant}", easy_c)
+            sim_easy = float((bank @ query).max())
+        margin = sim_hard - sim_easy
+        if sim_hard >= rule.threshold and margin > self.MARGIN:
+            return "hard", sim_hard
+        if sim_easy >= rule.threshold and margin < -self.MARGIN:
+            return "easy", sim_easy
+        if max(sim_hard, sim_easy) >= rule.threshold * 0.5:
+            return "medium", max(sim_hard, sim_easy)
+        return None, 0.0

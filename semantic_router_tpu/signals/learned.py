@@ -215,7 +215,13 @@ class BinaryTaskSignal(_EngineSignal):
 def build_learned_evaluators(engine: InferenceEngine, cfg) -> list:
     """Wire every learned family whose rules are configured. Task names
     follow the engine's default registry: intent/jailbreak/pii/fact_check/
-    user_feedback/modality."""
+    user_feedback/modality/embedding."""
+    from .embedding_signal import (
+        ComplexitySignal,
+        EmbeddingSignal,
+        PreferenceSignal,
+    )
+
     evs: list = []
     s = cfg.signals
     if s.domains:
@@ -233,4 +239,10 @@ def build_learned_evaluators(engine: InferenceEngine, cfg) -> list:
     if s.modality:
         evs.append(BinaryTaskSignal(engine, s.modality, "modality",
                                     "modality"))
+    if s.embeddings:
+        evs.append(EmbeddingSignal(engine, s.embeddings))
+    if s.preferences:
+        evs.append(PreferenceSignal(engine, s.preferences))
+    if s.complexity:
+        evs.append(ComplexitySignal(engine, s.complexity))
     return evs

@@ -1,0 +1,162 @@
+"""Semantic cache + HNSW tests (reference: pkg/cache, pkg/hnsw behaviours —
+exact hit, paraphrase similarity hit, TTL, eviction policies, HNSW recall
+vs brute force)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from semantic_router_tpu.cache import HNSWIndex, InMemorySemanticCache
+
+
+def toy_embed(dim=32):
+    """Deterministic bag-of-words-ish embedding for tests."""
+    import hashlib
+
+    def fn(text):
+        v = np.zeros(dim, np.float32)
+        for w in text.lower().split():
+            h = int.from_bytes(hashlib.md5(w.encode()).digest()[:4], "little")
+            v[h % dim] += 1.0
+        n = np.linalg.norm(v)
+        return v / n if n else v
+
+    return fn
+
+
+class TestHNSW:
+    def test_recall_vs_bruteforce(self):
+        rng = np.random.default_rng(0)
+        n, dim = 500, 16
+        data = rng.standard_normal((n, dim)).astype(np.float32)
+        data /= np.linalg.norm(data, axis=1, keepdims=True)
+        idx = HNSWIndex(dim, m=16, ef_construction=100, ef_search=64)
+        for i, v in enumerate(data):
+            idx.add(i, v)
+        queries = rng.standard_normal((20, dim)).astype(np.float32)
+        hits = 0
+        for q in queries:
+            qn = q / np.linalg.norm(q)
+            true_top = set(np.argsort(-(data @ qn))[:10])
+            got = {i for i, _ in idx.search(q, k=10)}
+            hits += len(got & true_top)
+        recall = hits / (20 * 10)
+        assert recall >= 0.85, f"recall {recall}"
+
+    def test_similarity_ordering(self):
+        idx = HNSWIndex(4)
+        idx.add(0, [1, 0, 0, 0])
+        idx.add(1, [0, 1, 0, 0])
+        idx.add(2, [0.9, 0.1, 0, 0])
+        res = idx.search([1, 0, 0, 0], k=3)
+        assert res[0][0] == 0
+        assert res[0][1] == pytest.approx(1.0, abs=1e-5)
+        assert res[1][0] == 2
+
+    def test_remove_and_rebuild(self):
+        idx = HNSWIndex(4)
+        for i in range(20):
+            v = np.zeros(4)
+            v[i % 4] = 1.0
+            idx.add(i, v)
+        idx.remove(0)
+        assert 0 not in {i for i, _ in idx.search([1, 0, 0, 0], k=20)}
+        before = len(idx)
+        idx.rebuild()
+        assert len(idx) == before
+
+    def test_empty_search(self):
+        assert HNSWIndex(4).search([1, 0, 0, 0]) == []
+
+
+class TestSemanticCache:
+    def make(self, **kw):
+        defaults = dict(similarity_threshold=0.75, max_entries=10,
+                        ttl_seconds=60, use_hnsw=True)
+        defaults.update(kw)
+        return InMemorySemanticCache(toy_embed(), **defaults)
+
+    def test_exact_hit(self):
+        c = self.make()
+        c.add("what is kubernetes", "k8s is ...", model="m1")
+        hit = c.find_similar("what is kubernetes")
+        assert hit is not None
+        assert hit.response == "k8s is ..."
+        assert c.stats().exact_hits == 1
+
+    def test_similar_hit_and_miss(self):
+        c = self.make(similarity_threshold=0.5)
+        c.add("how do I reset my password", "click forgot")
+        hit = c.find_similar("how do I reset my password please")
+        assert hit is not None
+        miss = c.find_similar("completely unrelated quantum physics")
+        assert miss is None
+        s = c.stats()
+        assert s.hits == 1 and s.misses == 1
+
+    def test_ttl_expiry(self):
+        c = self.make(ttl_seconds=0.05)
+        c.add("q", "r")
+        assert c.find_similar("q") is not None
+        time.sleep(0.08)
+        assert c.find_similar("q") is None
+
+    def test_eviction_fifo(self):
+        c = self.make(max_entries=3, eviction_policy="fifo",
+                      similarity_threshold=0.99)
+        for i in range(4):
+            c.add(f"query number {i} xyz{i}", f"r{i}")
+        assert c.stats().entries == 3
+        assert c.find_similar("query number 0 xyz0") is None  # evicted
+        assert c.find_similar("query number 3 xyz3") is not None
+
+    def test_eviction_lru(self):
+        c = self.make(max_entries=3, eviction_policy="lru",
+                      similarity_threshold=0.99)
+        c.add("aaa unique1", "r0")
+        c.add("bbb unique2", "r1")
+        c.add("ccc unique3", "r2")
+        c.find_similar("aaa unique1")  # touch a
+        c.add("ddd unique4", "r3")  # evicts b (least recently used)
+        assert c.find_similar("aaa unique1") is not None
+        assert c.find_similar("bbb unique2") is None
+
+    def test_eviction_lfu(self):
+        c = self.make(max_entries=3, eviction_policy="lfu",
+                      similarity_threshold=0.99)
+        c.add("aaa unique1", "r0")
+        c.add("bbb unique2", "r1")
+        c.add("ccc unique3", "r2")
+        for _ in range(3):
+            c.find_similar("aaa unique1")
+        c.find_similar("bbb unique2")
+        c.add("ddd unique4", "r3")  # evicts c (least frequently used)
+        assert c.find_similar("ccc unique3") is None
+        assert c.find_similar("aaa unique1") is not None
+
+    def test_category_threshold(self):
+        c = InMemorySemanticCache(
+            toy_embed(), similarity_threshold=0.95,
+            category_thresholds={"chat": 0.3}, use_hnsw=False)
+        c.add("hello there friend", "hi", category="chat")
+        # default threshold too strict, category threshold lenient
+        assert c.find_similar("hello there my friend",
+                              category="chat") is not None
+
+    def test_invalidate(self):
+        c = self.make()
+        c.add("q1 abc", "r")
+        c.invalidate("q1 abc")
+        assert c.find_similar("q1 abc") is None
+
+    def test_bruteforce_backend_equivalent(self):
+        ch = self.make(use_hnsw=True, similarity_threshold=0.5)
+        cb = self.make(use_hnsw=False, similarity_threshold=0.5)
+        for c in (ch, cb):
+            c.add("install the package with pip", "use pip install")
+            c.add("configure the network adapter", "use nmcli")
+        q = "install that package using pip"
+        h1, h2 = ch.find_similar(q), cb.find_similar(q)
+        assert h1 is not None and h2 is not None
+        assert h1.response == h2.response

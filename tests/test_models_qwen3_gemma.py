@@ -1,0 +1,205 @@
+"""Qwen3 / Gemma parity vs the public HF/torch implementations (weight
+transplant, logit agreement) + embedding model behaviours."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+import transformers  # noqa: E402
+
+from semantic_router_tpu.models.qwen3 import (  # noqa: E402
+    Qwen3Config,
+    Qwen3EmbeddingModel,
+    Qwen3Model,
+    last_token_pool,
+    qwen3_params_from_state_dict,
+)
+from semantic_router_tpu.models.gemma import (  # noqa: E402
+    GemmaConfig,
+    GemmaEmbeddingModel,
+    GemmaModel,
+)
+
+QWEN_SMALL = dict(
+    vocab_size=128, hidden_size=64, intermediate_size=96,
+    num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+    head_dim=16, max_position_embeddings=128, rope_theta=10000.0,
+    tie_word_embeddings=True)
+
+
+def make_ids(B=2, S=12, vocab=128, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, vocab, (B, S))
+
+
+class TestQwen3Parity:
+    @pytest.fixture(scope="class")
+    def hf(self):
+        cfg = transformers.Qwen3Config(**QWEN_SMALL,
+                                       attn_implementation="eager")
+        torch.manual_seed(0)
+        return transformers.Qwen3Model(cfg).eval()
+
+    def test_trunk_parity(self, hf):
+        ids = make_ids()
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids)).last_hidden_state
+        cfg = Qwen3Config.from_hf(hf.config)
+        params = qwen3_params_from_state_dict(
+            {k: v.numpy() for k, v in hf.state_dict().items()})
+        out = Qwen3Model(cfg).apply(params, jnp.asarray(ids))
+        np.testing.assert_allclose(np.asarray(out), ref.numpy(),
+                                   atol=5e-4, rtol=1e-3)
+
+    def test_padded_parity(self, hf):
+        ids = make_ids()
+        mask = np.ones_like(ids)
+        ids[:, 9:] = 0
+        mask[:, 9:] = 0
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids),
+                     attention_mask=torch.tensor(mask)).last_hidden_state
+        cfg = Qwen3Config.from_hf(hf.config)
+        params = qwen3_params_from_state_dict(
+            {k: v.numpy() for k, v in hf.state_dict().items()})
+        out = Qwen3Model(cfg).apply(params, jnp.asarray(ids),
+                                    jnp.asarray(mask))
+        np.testing.assert_allclose(np.asarray(out)[:, :9], ref.numpy()[:, :9],
+                                   atol=5e-4, rtol=1e-3)
+
+
+class TestQwen3Embedding:
+    def test_last_token_pool(self):
+        hidden = jnp.asarray(np.arange(24, dtype=np.float32).reshape(1, 4, 6))
+        mask = jnp.asarray([[1, 1, 1, 0]])
+        out = last_token_pool(hidden, mask)
+        np.testing.assert_allclose(np.asarray(out)[0],
+                                   np.arange(12, 18, dtype=np.float32))
+
+    def test_embedding_normalized(self):
+        cfg = Qwen3Config(**{**QWEN_SMALL, "num_hidden_layers": 2})
+        model = Qwen3EmbeddingModel(cfg)
+        ids = jnp.asarray(make_ids(B=3, S=10))
+        params = model.init(jax.random.PRNGKey(0), ids)
+        emb = model.apply(params, ids)
+        norms = np.linalg.norm(np.asarray(emb), axis=-1)
+        np.testing.assert_allclose(norms, 1.0, atol=1e-5)
+
+
+class TestGemmaParity:
+    @pytest.fixture(scope="class")
+    def hf(self):
+        cfg = transformers.Gemma3TextConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=96,
+            num_hidden_layers=4, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=16, sliding_window=8,
+            max_position_embeddings=128, rope_theta=1e6,
+            rope_local_base_freq=1e4, query_pre_attn_scalar=16,
+            attn_implementation="eager")
+        torch.manual_seed(1)
+        return transformers.Gemma3TextModel(cfg).eval()
+
+    def test_trunk_parity(self, hf):
+        ids = make_ids(S=16)
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids)).last_hidden_state
+        cfg = GemmaConfig.from_hf(hf.config)
+        from semantic_router_tpu.models.gemma import GemmaModel
+
+        model = GemmaModel(cfg)
+        params = _gemma_params(hf)
+        out = model.apply(params, jnp.asarray(ids))
+        np.testing.assert_allclose(np.asarray(out), ref.numpy(),
+                                   atol=2e-3, rtol=5e-3)
+
+    def test_embedding_normalized_with_bottleneck(self, hf):
+        cfg = GemmaConfig.from_hf(hf.config)
+        model = GemmaEmbeddingModel(cfg, bottleneck_dims=(32, 16))
+        ids = jnp.asarray(make_ids(B=2, S=8))
+        params = model.init(jax.random.PRNGKey(0), ids)
+        emb = model.apply(params, ids)
+        assert emb.shape == (2, 16)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(emb), axis=-1), 1.0, atol=1e-5)
+
+
+def _gemma_params(hf):
+    """Torch Gemma3 text state dict → Flax params."""
+    state = {k: v.numpy() for k, v in hf.state_dict().items()}
+    out: dict = {}
+
+    def put(path, arr, transpose=False):
+        node = out
+        for p in path[:-1]:
+            node = node.setdefault(p, {})
+        node[path[-1]] = arr.T if transpose else arr
+
+    for key, w in state.items():
+        parts = key.split(".")
+        if parts[0] == "embed_tokens":
+            put(["embed_tokens", "embedding"], w)
+        elif parts[0] == "norm":
+            put(["norm", "weight"], w)
+        elif parts[0] == "layers":
+            i, rest = parts[1], parts[2:]
+            base = [f"layers_{i}"]
+            if rest[-1] == "weight" and len(rest) >= 2 and rest[-2].endswith("_proj"):
+                parent = "self_attn" if rest[0] == "self_attn" else "mlp"
+                put(base + [parent, rest[-2], "kernel"], w, transpose=True)
+            elif len(rest) >= 2 and rest[-2] in ("q_norm", "k_norm"):
+                put(base + ["self_attn", rest[-2], "weight"], w)
+            elif rest[0].endswith("layernorm"):
+                put(base + [rest[0], "weight"], w)
+    return {"params": out}
+
+
+class TestMmBertEmbedding:
+    def test_matryoshka_grid(self):
+        from semantic_router_tpu.models.embeddings import MmBertEmbeddingModel
+        from semantic_router_tpu.models.modernbert import ModernBertConfig
+
+        cfg = ModernBertConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=48,
+            num_hidden_layers=4, num_attention_heads=2,
+            max_position_embeddings=128, local_attention=8)
+        model = MmBertEmbeddingModel(cfg)
+        ids = jnp.asarray(make_ids(B=2, S=10))
+        params = model.init(jax.random.PRNGKey(0), ids)
+        full = model.apply(params, ids)
+        assert full.shape == (2, 32)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(full), axis=-1), 1.0, atol=1e-5)
+        # dim truncation
+        small = model.apply(params, ids, output_dim=16)
+        assert small.shape == (2, 16)
+        renorm = np.asarray(full)[:, :16]
+        renorm = renorm / np.linalg.norm(renorm, axis=-1, keepdims=True)
+        np.testing.assert_allclose(np.asarray(small), renorm, atol=1e-5)
+        # layer early-exit changes the embedding
+        early = model.apply(params, ids, exit_layer=2)
+        assert not np.allclose(np.asarray(early), np.asarray(full))
+
+    def test_engine_embed_path(self):
+        from semantic_router_tpu.engine.testing import make_embedding_engine
+
+        eng = make_embedding_engine()
+        try:
+            embs = eng.embed("embedding", ["hello world", "goodbye moon"])
+            assert embs.shape[0] == 2
+            np.testing.assert_allclose(np.linalg.norm(embs, axis=-1), 1.0,
+                                       atol=1e-4)
+            # same text → same embedding; different → different
+            again = eng.embed("embedding", ["hello world"])[0]
+            np.testing.assert_allclose(again, embs[0], atol=1e-4)
+            assert not np.allclose(embs[0], embs[1])
+            # matryoshka variants through the engine
+            small = eng.embed("embedding", ["hello world"], output_dim=16)
+            assert small.shape == (1, 16)
+            early = eng.embed("embedding", ["hello world"], exit_layer=1)
+            assert early.shape[1] == embs.shape[1]
+            assert not np.allclose(early[0], embs[0])
+        finally:
+            eng.shutdown()

@@ -1,0 +1,234 @@
+"""Selection algorithm tests (reference: pkg/selection 13-algorithm
+registry, elo updates, latency percentiles, automix escalation, lookup
+table auto-save, ml-binding KNN/KMeans/SVM, candle MLP selector JSON)."""
+
+import numpy as np
+import pytest
+
+from semantic_router_tpu.config import ModelCard, ModelRef
+from semantic_router_tpu.decision import SignalMatches
+from semantic_router_tpu.selection import (
+    Feedback,
+    MLPSelector,
+    SelectionContext,
+    registry,
+)
+
+SMALL = ModelRef(model="small-7b", weight=0.7)
+LARGE = ModelRef(model="large-70b", weight=0.3)
+CANDS = [SMALL, LARGE]
+
+CARDS = {
+    "small-7b": ModelCard(name="small-7b", param_size="7B",
+                          context_window_size=32768, quality_score=0.7,
+                          pricing={"prompt": 0.2, "completion": 0.4}),
+    "large-70b": ModelCard(name="large-70b", param_size="70B",
+                           context_window_size=131072, quality_score=0.95,
+                           pricing={"prompt": 1.0, "completion": 3.0}),
+}
+
+
+def ctx(**kw):
+    defaults = dict(query="what is 2+2", model_cards=CARDS)
+    defaults.update(kw)
+    return SelectionContext(**defaults)
+
+
+def test_registry_has_all_reference_algorithms():
+    known = registry.known()
+    for name in ("static", "elo", "router_dc", "automix", "hybrid", "knn",
+                 "kmeans", "svm", "mlp", "rl_driven", "gmtrouter",
+                 "latency_aware", "multi_factor", "session_aware",
+                 "lookup_table"):
+        assert name in known, f"{name} missing from registry"
+
+
+def test_static_weighted():
+    sel = registry.create("static", seed=0)
+    counts = {"small-7b": 0, "large-70b": 0}
+    for _ in range(500):
+        counts[sel.select(CANDS, ctx()).ref.model] += 1
+    assert counts["small-7b"] > counts["large-70b"]
+    assert counts["large-70b"] > 50  # still sampled
+
+
+def test_elo_learns_from_pairwise():
+    sel = registry.create("elo", exploration=0.0, seed=0)
+    for _ in range(20):
+        sel.update(Feedback(model="", winner="large-70b", loser="small-7b"))
+    assert sel.select(CANDS, ctx()).ref.model == "large-70b"
+    assert sel.rating("large-70b") > sel.rating("small-7b")
+
+
+def test_latency_aware_prefers_fast():
+    sel = registry.create("latency_aware", quality_weight=0.1)
+    for _ in range(30):
+        sel.update(Feedback(model="small-7b", latency_ms=100))
+        sel.update(Feedback(model="large-70b", latency_ms=2000))
+    assert sel.select(CANDS, ctx()).ref.model == "small-7b"
+
+
+def test_multi_factor_context_fit():
+    sel = registry.create("multi_factor",
+                          weights={"context_fit": 1.0, "quality": 0.0,
+                                   "cost": 0.0, "latency": 0.0})
+    res = sel.select(CANDS, ctx(token_count=100_000))
+    assert res.ref.model == "large-70b"  # small's 32K window doesn't fit
+
+
+def test_automix_easy_stays_small_hard_escalates():
+    sel = registry.create("automix")
+    easy = SignalMatches()
+    easy.add("complexity", "needs_reasoning:easy", 0.9)
+    hard = SignalMatches()
+    hard.add("complexity", "needs_reasoning:hard", 0.95)
+    hard.add("context", "long_context", 1.0)
+    assert sel.select(CANDS, ctx(signals=easy)).ref.model == "small-7b"
+    assert sel.select(CANDS, ctx(signals=hard)).ref.model == "large-70b"
+
+
+def test_rl_bandit_converges():
+    sel = registry.create("rl_driven", epsilon=0.3, seed=1)
+    for _ in range(100):
+        res = sel.select(CANDS, ctx(category="math"))
+        reward = 1.0 if res.ref.model == "large-70b" else 0.0
+        sel.update(Feedback(model=res.ref.model, success=reward > 0,
+                            quality=reward, category="math"))
+    wins = sum(sel.select(CANDS, ctx(category="math")).ref.model == "large-70b"
+               for _ in range(50))
+    assert wins > 40
+
+
+def test_session_affinity_and_break():
+    sel = registry.create("session_aware", seed=0)
+    first = sel.select(CANDS, ctx(session_id="s1")).ref.model
+    for _ in range(5):
+        assert sel.select(CANDS, ctx(session_id="s1")).ref.model == first
+    sel.update(Feedback(model=first, success=False, session_id="s1"))
+    # affinity broken: next pick re-selected (may coincide, but affinity
+    # reason must be gone on the first call after the break)
+    res = sel.select(CANDS, ctx(session_id="s1"))
+    assert res.reason != "session affinity"
+
+
+def test_lookup_table_learns_and_saves(tmp_path):
+    path = str(tmp_path / "table.json")
+    sel = registry.create("lookup_table", path=path, auto_save_every=1,
+                          seed=0)
+    c = ctx(query="the canonical question")
+    sel.select(CANDS, c)
+    sel.update(Feedback(model="large-70b", success=True))
+    assert sel.select(CANDS, c).reason == "lookup hit"
+    sel2 = registry.create("lookup_table", path=path, seed=0)
+    assert sel2.select(CANDS, c).ref.model == "large-70b"
+
+
+def rand_emb(seed, dim=8):
+    rng = np.random.default_rng(seed)
+    v = rng.standard_normal(dim).astype(np.float32)
+    return v / np.linalg.norm(v)
+
+
+def test_knn_uses_neighbors():
+    sel = registry.create("knn", k=3, seed=0)
+    base = rand_emb(1)
+    other = rand_emb(99)
+    for i in range(6):
+        sel.update(Feedback(model="large-70b", success=True, quality=1.0,
+                            query_embedding=base + 0.01 * rand_emb(i)))
+        sel.update(Feedback(model="small-7b", success=True, quality=1.0,
+                            query_embedding=other + 0.01 * rand_emb(50 + i)))
+    c = ctx()
+    c._embedding = base
+    assert sel.select(CANDS, c).ref.model == "large-70b"
+    c2 = ctx()
+    c2._embedding = other
+    assert sel.select(CANDS, c2).ref.model == "small-7b"
+
+
+def test_kmeans_clusters_route():
+    sel = registry.create("kmeans", n_clusters=2, refit_every=10, seed=0)
+    a, b = rand_emb(1), rand_emb(2)
+    for i in range(20):
+        which = a if i % 2 == 0 else b
+        model = "small-7b" if i % 2 == 0 else "large-70b"
+        sel.update(Feedback(model=model, success=True, quality=1.0,
+                            query_embedding=which + 0.02 * rand_emb(i + 10)))
+    c = ctx()
+    c._embedding = a
+    assert sel.select(CANDS, c).ref.model == "small-7b"
+
+
+def test_svm_separates():
+    sel = registry.create("svm", refit_every=8, seed=0)
+    rng = np.random.default_rng(0)
+    for i in range(40):
+        e = rng.standard_normal(8).astype(np.float32)
+        e[0] = abs(e[0]) if i % 2 == 0 else -abs(e[0])
+        e /= np.linalg.norm(e)
+        model = "small-7b" if i % 2 == 0 else "large-70b"
+        sel.update(Feedback(model=model, success=True, quality=1.0,
+                            query_embedding=e))
+    c = ctx()
+    e = np.zeros(8, np.float32)
+    e[0] = 1.0
+    c._embedding = e
+    assert sel.select(CANDS, c).ref.model == "small-7b"
+
+
+def test_mlp_fit_and_json_roundtrip():
+    sel = MLPSelector(hidden=16)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 8)).astype(np.float32)
+    labels = ["small-7b" if v[0] > 0 else "large-70b" for v in x]
+    sel.fit(x, labels)
+    blob = sel.to_json()
+    sel2 = MLPSelector.from_json(blob)
+    e = np.zeros(8, np.float32)
+    e[0] = 2.0
+    c = ctx()
+    c._embedding = e
+    assert sel2.select(CANDS, c).ref.model == "small-7b"
+    e2 = np.zeros(8, np.float32)
+    e2[0] = -2.0
+    c2 = ctx()
+    c2._embedding = e2
+    assert sel2.select(CANDS, c2).ref.model == "large-70b"
+
+
+def test_router_dc_prototypes():
+    sel = registry.create("router_dc", seed=0)
+    a, b = rand_emb(1), rand_emb(2)
+    for i in range(10):
+        sel.update(Feedback(model="small-7b", success=True,
+                            query_embedding=a + 0.01 * rand_emb(i)))
+        sel.update(Feedback(model="large-70b", success=True,
+                            query_embedding=b + 0.01 * rand_emb(i + 30)))
+    c = ctx()
+    c._embedding = a
+    assert sel.select(CANDS, c).ref.model == "small-7b"
+
+
+def test_gmtrouter_propagates():
+    sel = registry.create("gmtrouter", n_nodes=2, refit_every=10, seed=0)
+    a, b = rand_emb(3), rand_emb(4)
+    for i in range(20):
+        which = a if i % 2 == 0 else b
+        model = "small-7b" if i % 2 == 0 else "large-70b"
+        sel.update(Feedback(model=model, success=True, quality=1.0,
+                            query_embedding=which + 0.02 * rand_emb(i)))
+    c = ctx()
+    c._embedding = b
+    assert sel.select(CANDS, c).ref.model == "large-70b"
+
+
+def test_hybrid_blends():
+    sel = registry.create("hybrid", exploration=0.0, seed=0)
+    for _ in range(10):
+        sel.update(Feedback(model="", winner="small-7b", loser="large-70b"))
+    assert sel.select(CANDS, ctx()).ref.model == "small-7b"
+
+
+def test_unknown_algorithm_raises():
+    with pytest.raises(KeyError, match="unknown selection"):
+        registry.create("quantum_oracle")

@@ -1,0 +1,114 @@
+"""Embedding/preference/complexity signal tests over the tiny embedding
+engine (reference: embedding_classifier*.go, contrastive_preference,
+complexity prototype_bank + composer)."""
+
+import numpy as np
+import pytest
+
+from semantic_router_tpu.config import (
+    ComplexityRule,
+    EmbeddingRule,
+    PreferenceRule,
+    RuleNode,
+)
+from semantic_router_tpu.engine.testing import make_embedding_engine
+from semantic_router_tpu.signals import Message, RequestContext
+from semantic_router_tpu.signals.embedding_signal import (
+    ComplexitySignal,
+    EmbeddingSignal,
+    PreferenceSignal,
+)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = make_embedding_engine()
+    yield eng
+    eng.shutdown()
+
+
+def ctx(text):
+    return RequestContext(messages=[Message("user", text)])
+
+
+class TestEmbeddingSignal:
+    def test_identical_candidate_matches(self, engine):
+        rules = [EmbeddingRule(name="support", threshold=0.99,
+                               candidates=["how to configure the system"])]
+        sig = EmbeddingSignal(engine, rules)
+        res = sig.evaluate(ctx("how to configure the system"))
+        assert res.error is None
+        assert [h.rule for h in res.hits] == ["support"]
+        assert res.hits[0].confidence == pytest.approx(1.0, abs=1e-3)
+
+    def test_unrelated_below_threshold(self, engine):
+        rules = [EmbeddingRule(name="support", threshold=0.95,
+                               candidates=["how to configure the system"])]
+        sig = EmbeddingSignal(engine, rules)
+        res = sig.evaluate(ctx("completely different banana topic zzz"))
+        assert res.hits == []
+
+    def test_aggregation_mean_vs_max(self, engine):
+        cands = ["alpha beta gamma", "totally unrelated words here"]
+        query = "alpha beta gamma"
+        r_max = EmbeddingRule(name="m1", threshold=0.9, candidates=cands,
+                              aggregation_method="max")
+        r_mean = EmbeddingRule(name="m2", threshold=0.9, candidates=cands,
+                               aggregation_method="mean")
+        sig = EmbeddingSignal(engine, [r_max, r_mean])
+        res = sig.evaluate(ctx(query))
+        names = [h.rule for h in res.hits]
+        assert "m1" in names  # max over candidates clears 0.9
+        assert "m2" not in names  # mean dragged down by unrelated candidate
+
+    def test_missing_task_fails_open(self, engine):
+        sig = EmbeddingSignal(engine, [EmbeddingRule(name="x",
+                                                     candidates=["y"])],
+                              task="ghost")
+        res = sig.evaluate(ctx("hello"))
+        assert res.hits == [] and "not loaded" in res.error
+
+
+class TestPreferenceSignal:
+    def test_example_match(self, engine):
+        rules = [PreferenceRule(name="terse", threshold=0.99,
+                                examples=["keep it concise"])]
+        sig = PreferenceSignal(engine, rules)
+        assert [h.rule for h in sig.evaluate(ctx("keep it concise")).hits] \
+            == ["terse"]
+        assert sig.evaluate(ctx("write a long detailed essay zz")).hits == []
+
+
+class TestComplexitySignal:
+    def rule(self, **kw):
+        base = dict(name="needs_reasoning", threshold=0.9,
+                    hard_candidates=["solve this step by step"],
+                    easy_candidates=["answer briefly"])
+        base.update(kw)
+        return ComplexityRule(**base)
+
+    def test_hard_easy_levels(self, engine):
+        sig = ComplexitySignal(engine, [self.rule()])
+        hard = sig.evaluate(ctx("solve this step by step"))
+        assert [h.rule for h in hard.hits] == ["needs_reasoning:hard"]
+        easy = sig.evaluate(ctx("answer briefly"))
+        assert [h.rule for h in easy.hits] == ["needs_reasoning:easy"]
+
+    def test_composer_escalates(self, engine):
+        from semantic_router_tpu.signals import SignalDispatcher
+
+        rule = self.rule(composer=RuleNode(operator="OR", conditions=[
+            RuleNode(signal_type="context", name="long_context")]))
+        from semantic_router_tpu.config import ContextRule
+        from semantic_router_tpu.signals.heuristic import ContextSignal
+
+        d = SignalDispatcher(
+            [ComplexitySignal(engine, [rule]),
+             ContextSignal([ContextRule(name="long_context", min_tokens=5)])],
+            complexity_rules=[rule])
+        sm, report = d.evaluate(ctx("answer briefly " * 10))
+        # easy by prototypes, but composer (long_context) forces hard
+        assert "needs_reasoning:hard" in sm.matches["complexity"]
+        assert all(not n.endswith(":easy")
+                   for n in sm.matches["complexity"])
+        d.shutdown()
