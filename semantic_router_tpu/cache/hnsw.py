@@ -192,7 +192,9 @@ class HNSWIndex:
                     self._deleted.add(node)
 
     def rebuild(self) -> None:
-        """Compact: re-insert all live vectors into a fresh graph."""
+        """Compact: re-insert all live vectors into a fresh graph. The
+        original lock object is preserved (swapping it would let waiters on
+        the old lock race fresh acquirers of the new one)."""
         with self._lock:
             live = [(self._ids[i], self._vectors[i])
                     for i in range(len(self._vectors))
@@ -201,4 +203,6 @@ class HNSWIndex:
                               self.ef_search, space=self.space)
             for ext, vec in live:
                 fresh.add(ext, vec)
-            self.__dict__.update(fresh.__dict__)
+            for attr in ("_vectors", "_ids", "_levels", "_links", "_entry",
+                         "_max_level", "_deleted", "_rng"):
+                setattr(self, attr, getattr(fresh, attr))

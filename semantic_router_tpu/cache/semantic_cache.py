@@ -107,6 +107,11 @@ class InMemorySemanticCache:
         if n > 0:
             emb = emb / n
         with self._lock:
+            # replace a previous entry for the same query (otherwise the old
+            # row becomes an unreachable duplicate still served via ANN)
+            old = self._exact.get(_hash(query))
+            if old is not None:
+                self._remove(old)
             if len(self._entries) >= self.max_entries:
                 self._evict()
             rid = self._next_id
@@ -127,11 +132,14 @@ class InMemorySemanticCache:
                 category, self.similarity_threshold)
         now = time.time()
         with self._lock:
-            # exact path first (reference: 100% exact hit, <5 ms)
+            # exact path first (reference: 100% exact hit, <5 ms);
+            # category-scoped like the similarity path
             rid = self._exact.get(_hash(query))
             if rid is not None:
                 entry = self._entries.get(rid)
-                if entry is not None and self._live(entry, now):
+                if entry is not None and self._live(entry, now) and \
+                        (not category or not entry.category
+                         or entry.category == category):
                     self._touch(entry)
                     self._stats.hits += 1
                     self._stats.exact_hits += 1
@@ -152,7 +160,8 @@ class InMemorySemanticCache:
                     if best is None or sim > best[0]:
                         best = (sim, entry)
             elif self._entries:
-                live = [e for e in self._entries.values()
+                # snapshot first: _live() may expire-and-remove entries
+                live = [e for e in list(self._entries.values())
                         if self._live(e, now)
                         and (not category or not e.category
                              or e.category == category)]

@@ -1,0 +1,7 @@
+from . import metrics
+from .logging import component_event, get_logger
+from .metrics import MetricsRegistry, default_registry
+from .tracing import Span, Tracer, default_tracer
+
+__all__ = ["MetricsRegistry", "Span", "Tracer", "component_event",
+           "default_registry", "default_tracer", "get_logger", "metrics"]

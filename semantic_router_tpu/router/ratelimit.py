@@ -1,0 +1,100 @@
+"""Two-source rate limiting: remote RLS first, local token-bucket fallback.
+
+Reference: pkg/ratelimit (1.1k LoC; applied at
+processor_req_body_prepare.go:143-170): Envoy RLS when configured, else a
+local per-user/per-model token bucket. Here the remote hook is a pluggable
+callable (an RLS client when deployed behind Envoy); the local bucket is the
+in-proc default. Fail-open on remote errors.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Tuple
+
+
+@dataclass
+class RateLimitDecision:
+    allowed: bool
+    source: str = "local"  # local | remote | disabled
+    retry_after_s: float = 0.0
+
+
+class TokenBucket:
+    def __init__(self, rate_per_s: float, burst: float) -> None:
+        self.rate = rate_per_s
+        self.burst = burst
+        self.tokens = burst
+        self.last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def take(self, n: float = 1.0) -> Tuple[bool, float]:
+        with self._lock:
+            now = time.monotonic()
+            self.tokens = min(self.burst,
+                              self.tokens + (now - self.last) * self.rate)
+            self.last = now
+            if self.tokens >= n:
+                self.tokens -= n
+                return True, 0.0
+            needed = (n - self.tokens) / self.rate if self.rate > 0 else 60.0
+            return False, needed
+
+
+class RateLimiter:
+    """Per-(user, model) buckets with defaults + overrides, optional remote
+    check first (fail-open)."""
+
+    def __init__(self, requests_per_minute: float = 0.0, burst: int = 0,
+                 per_user: Optional[Dict[str, float]] = None,
+                 per_model: Optional[Dict[str, float]] = None,
+                 remote_check: Optional[Callable[[str, str],
+                                                Optional[bool]]] = None
+                 ) -> None:
+        self.default_rpm = requests_per_minute
+        self.default_burst = burst or max(1, int(requests_per_minute / 6) or 1)
+        self.per_user = per_user or {}
+        self.per_model = per_model or {}
+        self.remote_check = remote_check
+        self._buckets: Dict[Tuple[str, str], TokenBucket] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_config(cls, cfg: Dict) -> "RateLimiter":
+        return cls(
+            requests_per_minute=float(cfg.get("requests_per_minute", 0)),
+            burst=int(cfg.get("burst", 0)),
+            per_user={k: float(v) for k, v in
+                      (cfg.get("per_user", {}) or {}).items()},
+            per_model={k: float(v) for k, v in
+                       (cfg.get("per_model", {}) or {}).items()},
+        )
+
+    def _rpm_for(self, user: str, model: str) -> float:
+        if user in self.per_user:
+            return self.per_user[user]
+        if model in self.per_model:
+            return self.per_model[model]
+        return self.default_rpm
+
+    def check(self, user: str = "", model: str = "") -> RateLimitDecision:
+        if self.remote_check is not None:
+            try:
+                verdict = self.remote_check(user, model)
+                if verdict is not None:
+                    return RateLimitDecision(verdict, source="remote")
+            except Exception:
+                pass  # RLS failure → fall through to local (fail-open)
+        rpm = self._rpm_for(user, model)
+        if rpm <= 0:
+            return RateLimitDecision(True, source="disabled")
+        key = (user, model)
+        with self._lock:
+            bucket = self._buckets.get(key)
+            if bucket is None:
+                bucket = TokenBucket(rpm / 60.0, float(self.default_burst))
+                self._buckets[key] = bucket
+        ok, wait = bucket.take()
+        return RateLimitDecision(ok, source="local", retry_after_s=wait)

@@ -1,0 +1,355 @@
+"""Router HTTP server: OpenAI/Anthropic-compatible routing reverse proxy +
+management API.
+
+The reference's data plane is an Envoy ExtProc gRPC filter (extproc
+server.go:98); the same pipeline here fronts as a self-contained reverse
+proxy (the common non-Envoy deployment: client → router → backend), with
+the management "Route API" (pkg/apiserver routes_catalog.go surface) served
+on the same listener:
+
+  POST /v1/chat/completions     route + forward to the selected backend
+  POST /v1/messages             Anthropic inbound (translated both ways)
+  GET  /v1/models               configured model cards
+  POST /api/v1/classify/intent|pii|security|combined|batch
+  POST /api/v1/embeddings       embedding task
+  POST /api/v1/similarity       embedding cosine
+  GET  /health /ready           liveness/readiness
+  GET  /metrics                 Prometheus exposition
+  GET  /config/router           live config (redacted raw)
+
+Backend resolution: model → modelCard.backend_refs (weighted); requests
+forward over HTTP with credential/trace headers injected
+(resolveBackendForModel, processor_req_body.go:28 + appendCredentialHeaders).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+import urllib.error
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..config.schema import RouterConfig
+from ..observability.metrics import default_registry
+from ..observability.tracing import default_tracer
+from . import headers as H
+from .anthropic import (
+    anthropic_to_openai,
+    openai_to_anthropic_response,
+)
+from .pipeline import Router, RouteResult
+
+
+class BackendResolver:
+    """model name → base URL via modelCards[].backend_refs (weighted)."""
+
+    def __init__(self, cfg: RouterConfig,
+                 default_backend: str = "") -> None:
+        self.default_backend = default_backend
+        self._by_model: Dict[str, list] = {}
+        for card in cfg.model_cards:
+            refs = []
+            for ref in card.backend_refs:
+                endpoint = ref.get("endpoint", "")
+                if endpoint and not endpoint.startswith("http"):
+                    endpoint = f"http://{endpoint}"
+                refs.append((endpoint, float(ref.get("weight", 100))))
+            if refs:
+                self._by_model[card.name] = refs
+        self._rng = np.random.default_rng(0)
+
+    def resolve(self, model: str) -> str:
+        refs = self._by_model.get(model)
+        if not refs:
+            return self.default_backend
+        if len(refs) == 1:
+            return refs[0][0]
+        weights = np.asarray([w for _, w in refs])
+        probs = weights / weights.sum()
+        return refs[int(self._rng.choice(len(refs), p=probs))][0]
+
+
+class RouterServer:
+    def __init__(self, router: Router, cfg: RouterConfig,
+                 default_backend: str = "", port: int = 0,
+                 forward_timeout_s: float = 300.0) -> None:
+        self.router = router
+        self.cfg = cfg
+        self.resolver = BackendResolver(cfg, default_backend)
+        self.forward_timeout_s = forward_timeout_s
+        self.started_t = time.time()
+        self.ready = threading.Event()
+
+        handler = self._make_handler()
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "RouterServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="router-server")
+        self._thread.start()
+        self.ready.set()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.router.shutdown()
+
+    # ------------------------------------------------------------------
+
+    def _forward(self, url: str, body: Dict[str, Any],
+                 headers: Dict[str, str]) -> tuple[int, Dict[str, Any]]:
+        data = json.dumps(body).encode()
+        req = urllib.request.Request(
+            url + "/v1/chat/completions", data=data, method="POST")
+        req.add_header("content-type", "application/json")
+        for k, v in headers.items():
+            if k.lower() not in ("content-length", "host"):
+                req.add_header(k, v)
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.forward_timeout_s) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read() or b"{}")
+            except json.JSONDecodeError:
+                payload = {"error": {"message": str(e)}}
+            return e.code, payload
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            return 502, {"error": {"message": f"backend unreachable: {e}",
+                                   "type": "backend_error"}}
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            server_version = "semantic-router-tpu/0.1"
+
+            def log_message(self, *args):
+                pass
+
+            # -- helpers --------------------------------------------------
+
+            def _body(self) -> Dict[str, Any]:
+                length = int(self.headers.get("content-length", 0))
+                raw = self.rfile.read(length) if length else b"{}"
+                return json.loads(raw or b"{}")
+
+            def _json(self, status: int, payload: Any,
+                      extra_headers: Optional[Dict[str, str]] = None) -> None:
+                data = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("content-type", "application/json")
+                self.send_header("content-length", str(len(data)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _text(self, status: int, text: str,
+                      ctype: str = "text/plain") -> None:
+                data = text.encode()
+                self.send_response(status)
+                self.send_header("content-type", ctype)
+                self.send_header("content-length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _req_headers(self) -> Dict[str, str]:
+                return {k.lower(): v for k, v in self.headers.items()}
+
+            # -- GET ------------------------------------------------------
+
+            def do_GET(self):
+                path = self.path.split("?")[0]
+                if path == "/health":
+                    self._json(200, {"status": "healthy"})
+                elif path == "/ready":
+                    ok = server.ready.is_set()
+                    self._json(200 if ok else 503,
+                               {"ready": ok,
+                                "uptime_s": round(time.time()
+                                                  - server.started_t, 1)})
+                elif path == "/metrics":
+                    self._text(200, default_registry.expose(),
+                               "text/plain; version=0.0.4")
+                elif path == "/v1/models":
+                    self._json(200, {"object": "list", "data": [
+                        {"id": m.name, "object": "model",
+                         "metadata": {"quality_score": m.quality_score,
+                                      "modality": m.modality,
+                                      "tags": m.tags}}
+                        for m in server.cfg.model_cards]})
+                elif path == "/config/router":
+                    self._json(200, server.cfg.raw)
+                else:
+                    self._json(404, {"error": "not found"})
+
+            # -- POST -----------------------------------------------------
+
+            def do_POST(self):
+                path = self.path.split("?")[0]
+                try:
+                    body = self._body()
+                except json.JSONDecodeError:
+                    self._json(400, {"error": {"message": "invalid JSON"}})
+                    return
+                try:
+                    if path == "/v1/chat/completions":
+                        self._chat(body, anthropic=False)
+                    elif path == "/v1/messages":
+                        self._chat(body, anthropic=True)
+                    elif path.startswith("/api/v1/classify/"):
+                        self._classify(path.rsplit("/", 1)[1], body)
+                    elif path == "/api/v1/embeddings":
+                        self._embeddings(body)
+                    elif path in ("/api/v1/similarity", "/api/v1/similarity/batch"):
+                        self._similarity(body)
+                    else:
+                        self._json(404, {"error": "not found"})
+                except BrokenPipeError:
+                    pass
+                except Exception as exc:  # pipeline fail-open: surface 500
+                    self._json(500, {"error": {
+                        "message": f"{type(exc).__name__}: {exc}"}})
+
+            def _chat(self, body: Dict[str, Any], anthropic: bool) -> None:
+                headers = self._req_headers()
+                openai_body = anthropic_to_openai(body) if anthropic else body
+                route = server.router.route(openai_body, headers)
+
+                if route.kind in ("blocked", "rate_limited", "cache_hit") \
+                        or route.response_body is not None:
+                    payload = route.response_body
+                    if anthropic and route.status == 200 and payload \
+                            and "choices" in payload:
+                        payload = openai_to_anthropic_response(payload)
+                    self._json(route.status, payload, route.headers)
+                    return
+
+                backend = server.resolver.resolve(route.model)
+                if not backend:
+                    self._json(502, {"error": {
+                        "message": f"no backend for model {route.model!r}",
+                        "type": "backend_error"}}, route.headers)
+                    return
+                fwd_headers = dict(headers)
+                trace_id, _ = default_tracer.extract(headers)
+                default_tracer.inject(trace_id, route.request_id[:16].ljust(16, "0"),
+                                      fwd_headers)
+                fwd_headers.update(route.headers)
+                t0 = time.perf_counter()
+                status, resp = server._forward(backend, route.body,
+                                               fwd_headers)
+                latency_ms = (time.perf_counter() - t0) * 1e3
+                if status == 200:
+                    processed = server.router.process_response(route, resp)
+                    server.router.record_feedback(route, success=True,
+                                                  latency_ms=latency_ms)
+                    out_headers = dict(route.headers)
+                    out_headers.update(processed.headers)
+                    payload = processed.body
+                    if anthropic:
+                        payload = openai_to_anthropic_response(payload)
+                    self._json(200, payload, out_headers)
+                else:
+                    server.router.record_feedback(route, success=False,
+                                                  latency_ms=latency_ms)
+                    self._json(status, resp, route.headers)
+
+            def _classify(self, task: str, body: Dict[str, Any]) -> None:
+                """Route API classification endpoints
+                (apiserver route_classify.go surface)."""
+                eng = server.router.engine
+                if eng is None:
+                    self._json(503, {"error": "no inference engine"})
+                    return
+                task_map = {"intent": "intent", "security": "jailbreak",
+                            "pii": "pii", "fact-check": "fact_check",
+                            "user-feedback": "user_feedback"}
+                if task == "batch":
+                    texts = body.get("texts", [])
+                    results = eng.classify_batch(
+                        body.get("task", "intent"), texts)
+                    self._json(200, {"results": [
+                        {"label": r.label, "confidence": r.confidence}
+                        for r in results]})
+                    return
+                if task == "combined":
+                    text = body.get("text", "")
+                    out = {}
+                    for api_name, engine_task in task_map.items():
+                        if eng.has_task(engine_task):
+                            if engine_task == "pii":
+                                r = eng.token_classify(engine_task, text)
+                                out[api_name] = {"entities": [
+                                    e.__dict__ for e in r.entities]}
+                            else:
+                                r = eng.classify(engine_task, text)
+                                out[api_name] = {"label": r.label,
+                                                 "confidence": r.confidence}
+                    self._json(200, out)
+                    return
+                engine_task = task_map.get(task, task)
+                if not eng.has_task(engine_task):
+                    self._json(404, {"error": f"task {engine_task!r} not loaded"})
+                    return
+                text = body.get("text", "")
+                if engine_task == "pii":
+                    r = eng.token_classify(engine_task, text)
+                    self._json(200, {"entities": [e.__dict__
+                                                  for e in r.entities]})
+                else:
+                    r = eng.classify(engine_task, text)
+                    self._json(200, {"label": r.label,
+                                     "confidence": r.confidence,
+                                     "probs": r.probs})
+
+            def _embeddings(self, body: Dict[str, Any]) -> None:
+                eng = server.router.engine
+                task = body.get("model", server.router.embedding_task)
+                if eng is None or not eng.has_task(task):
+                    self._json(503, {"error": "embedding task not loaded"})
+                    return
+                texts = body.get("input")
+                if isinstance(texts, str):
+                    texts = [texts]
+                embs = eng.embed(task, texts,
+                                 output_dim=body.get("dimensions"))
+                self._json(200, {"object": "list", "data": [
+                    {"object": "embedding", "index": i,
+                     "embedding": e.tolist()} for i, e in enumerate(embs)]})
+
+            def _similarity(self, body: Dict[str, Any]) -> None:
+                eng = server.router.engine
+                task = server.router.embedding_task
+                if eng is None or not eng.has_task(task):
+                    self._json(503, {"error": "embedding task not loaded"})
+                    return
+                a = body.get("text_a") or body.get("text1", "")
+                pairs = body.get("pairs")
+                if pairs:
+                    out = []
+                    for p in pairs:
+                        e = eng.embed(task, [p.get("text_a", ""),
+                                             p.get("text_b", "")])
+                        out.append(float(e[0] @ e[1]))
+                    self._json(200, {"similarities": out})
+                    return
+                b = body.get("text_b") or body.get("text2", "")
+                e = eng.embed(task, [a, b])
+                self._json(200, {"similarity": float(e[0] @ e[1])})
+
+        return Handler

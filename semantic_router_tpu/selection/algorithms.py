@@ -187,8 +187,10 @@ class AutoMixSelector:
 
     def __init__(self, cost_quality_tradeoff: float = 0.5, **_):
         self.tradeoff = cost_quality_tradeoff
-        # per (difficulty-bucket, model): Beta posterior of success
-        self._posteriors: Dict[tuple, List[float]] = {}
+        # per-model Beta posterior of success (feedback carries no belief
+        # bucket, so the posterior is model-global; belief modulates the
+        # acceptance bar instead)
+        self._posteriors: Dict[str, List[float]] = {}
         self._lock = threading.Lock()
 
     @staticmethod
@@ -208,17 +210,13 @@ class AutoMixSelector:
             belief = min(1.0, belief + 0.15)
         return belief
 
-    def _bucket(self, belief: float) -> int:
-        return min(int(belief * 4), 3)
-
-    def _success_rate(self, bucket: int, model: str) -> float:
-        a, b = self._posteriors.get((bucket, model), [1.0, 1.0])
+    def _success_rate(self, model: str) -> float:
+        a, b = self._posteriors.get(model, [1.0, 1.0])
         return a / (a + b)
 
     def select(self, candidates: List[ModelRef], ctx: SelectionContext
                ) -> SelectionResult:
         belief = self._belief(ctx)
-        bucket = self._bucket(belief)
 
         def size(c: ModelRef) -> float:
             card = ctx.card(c.model)
@@ -228,7 +226,7 @@ class AutoMixSelector:
         for c in ordered:
             card = ctx.card(c.model)
             quality = card.quality_score if card else 0.5
-            expected = 0.5 * quality + 0.5 * self._success_rate(bucket, c.model)
+            expected = 0.5 * quality + 0.5 * self._success_rate(c.model)
             bar = 0.35 + belief * (0.55 - 0.25 * self.tradeoff)
             if expected >= bar:
                 return SelectionResult(
@@ -237,15 +235,12 @@ class AutoMixSelector:
 
     def update(self, fb: Feedback) -> None:
         with self._lock:
-            for bucket in range(4):
-                key = (bucket, fb.model)
-                if key in self._posteriors or bucket == 0:
-                    a, b = self._posteriors.get(key, [1.0, 1.0])
-                    if fb.success:
-                        a += 1.0
-                    else:
-                        b += 1.0
-                    self._posteriors[key] = [a, b]
+            a, b = self._posteriors.get(fb.model, [1.0, 1.0])
+            if fb.success:
+                a += 1.0
+            else:
+                b += 1.0
+            self._posteriors[fb.model] = [a, b]
 
 
 class RLDrivenSelector:
@@ -380,7 +375,7 @@ class LookupTableSelector:
     def select(self, candidates: List[ModelRef], ctx: SelectionContext
                ) -> SelectionResult:
         key = self._key(ctx.query)
-        self._last_query_hash = key
+        self._last_query_hash = key  # fallback attribution only
         with self._lock:
             model = self.table.get(key)
         if model:
@@ -390,9 +385,12 @@ class LookupTableSelector:
         return self._fallback.select(candidates, ctx)
 
     def update(self, fb: Feedback) -> None:
-        if fb.success and self._last_query_hash:
+        # Feedback.query gives exact attribution under concurrency; the
+        # last-select hash is only a single-threaded fallback.
+        key = self._key(fb.query) if fb.query else self._last_query_hash
+        if fb.success and key:
             with self._lock:
-                self.table[self._last_query_hash] = fb.model
+                self.table[key] = fb.model
                 self._dirty += 1
                 if self.path and self._dirty >= self.auto_save_every:
                     self.save()

@@ -65,6 +65,7 @@ class Feedback:
     cost: float = 0.0
     category: str = ""
     session_id: str = ""
+    query: str = ""            # original query text (lookup-table keying)
     query_embedding: Optional[np.ndarray] = None
     winner: str = ""           # pairwise: winning model (elo)
     loser: str = ""

@@ -52,6 +52,9 @@ class RequestContext:
     event: Dict[str, Any] = field(default_factory=dict)  # type/severity/action_code/ts
     stream: bool = False
     body: Dict[str, Any] = field(default_factory=dict)
+    # per-request scratch shared across evaluators (e.g. memoized query
+    # embeddings so embedding/preference/complexity share one forward)
+    ext: Dict[Any, Any] = field(default_factory=dict)
     _user_text: Optional[str] = None
     _full_text: Optional[str] = None
 

@@ -49,8 +49,17 @@ class _PrototypeBank:
             self._cache[key] = emb
         return emb
 
-    def embed_query(self, text: str) -> np.ndarray:
-        return self.engine.embed(self.task, [text])[0]
+    def embed_query(self, text: str,
+                    ctx: Optional[RequestContext] = None) -> np.ndarray:
+        """Embed the query, memoized per request so the embedding /
+        preference / complexity families share one forward pass."""
+        key = ("query_emb", self.task, text)
+        if ctx is not None and key in ctx.ext:
+            return ctx.ext[key]
+        emb = self.engine.embed(self.task, [text])[0]
+        if ctx is not None:
+            ctx.ext[key] = emb
+        return emb
 
 
 def _aggregate(sims: np.ndarray, method: str, threshold: float
@@ -85,7 +94,7 @@ class EmbeddingSignal:
             if not self.engine.has_task(self.task):
                 res.error = f"task {self.task!r} not loaded"
                 return res
-            query = self.bank.embed_query(ctx.user_text)
+            query = self.bank.embed_query(ctx.user_text, ctx)
             for rule in self.rules:
                 if not rule.candidates:
                     continue
@@ -119,7 +128,7 @@ class PreferenceSignal:
             if not self.engine.has_task(self.task):
                 res.error = f"task {self.task!r} not loaded"
                 return res
-            query = self.bank.embed_query(ctx.user_text)
+            query = self.bank.embed_query(ctx.user_text, ctx)
             for rule in self.rules:
                 if not rule.examples:
                     continue
@@ -155,7 +164,7 @@ class ComplexitySignal:
             if not self.engine.has_task(self.task):
                 res.error = f"task {self.task!r} not loaded"
                 return res
-            query = self.bank.embed_query(ctx.user_text)
+            query = self.bank.embed_query(ctx.user_text, ctx)
             for rule in self.rules:
                 level, conf = self._level(rule, query, ctx)
                 if level is not None:

@@ -1,0 +1,231 @@
+"""Anthropic translation, prompt compression, rate limiting, metrics,
+tracing unit tests."""
+
+import time
+
+import pytest
+
+from semantic_router_tpu.observability.metrics import MetricsRegistry
+from semantic_router_tpu.observability.tracing import Tracer
+from semantic_router_tpu.router.anthropic import (
+    anthropic_to_openai,
+    openai_sse_to_anthropic_events,
+    openai_to_anthropic_response,
+)
+from semantic_router_tpu.router.promptcompression import (
+    PromptCompressor,
+    split_sentences,
+)
+from semantic_router_tpu.router.ratelimit import RateLimiter, TokenBucket
+
+
+class TestAnthropicTranslation:
+    def test_request_system_and_text(self):
+        body = {
+            "model": "claude-x", "max_tokens": 64,
+            "system": "be helpful",
+            "messages": [{"role": "user", "content": [
+                {"type": "text", "text": "hello"},
+                {"type": "text", "text": "world"}]}],
+            "temperature": 0.5,
+            "stop_sequences": ["END"],
+        }
+        out = anthropic_to_openai(body)
+        assert out["messages"][0] == {"role": "system",
+                                      "content": "be helpful"}
+        assert out["messages"][1]["content"] == "hello\nworld"
+        assert out["max_tokens"] == 64
+        assert out["temperature"] == 0.5
+        assert out["stop"] == ["END"]
+
+    def test_tools_and_tool_use_round_trip(self):
+        body = {
+            "model": "m", "max_tokens": 10,
+            "messages": [
+                {"role": "user", "content": "weather?"},
+                {"role": "assistant", "content": [
+                    {"type": "tool_use", "id": "t1", "name": "get_weather",
+                     "input": {"city": "paris"}}]},
+                {"role": "user", "content": [
+                    {"type": "tool_result", "tool_use_id": "t1",
+                     "content": "sunny"}]},
+            ],
+            "tools": [{"name": "get_weather", "description": "w",
+                       "input_schema": {"type": "object"}}],
+        }
+        out = anthropic_to_openai(body)
+        assert out["tools"][0]["function"]["name"] == "get_weather"
+        tc = out["messages"][1]["tool_calls"][0]
+        assert tc["function"]["name"] == "get_weather"
+        assert '"paris"' in tc["function"]["arguments"]
+        tool_msg = out["messages"][2]
+        assert tool_msg["role"] == "tool"
+        assert tool_msg["tool_call_id"] == "t1"
+        assert tool_msg["content"] == "sunny"
+
+    def test_response_translation(self):
+        resp = {
+            "id": "chatcmpl-1", "model": "m",
+            "choices": [{"message": {
+                "role": "assistant", "content": "hi",
+                "tool_calls": [{"id": "t1", "type": "function",
+                                "function": {"name": "f",
+                                             "arguments": '{"a": 1}'}}]},
+                "finish_reason": "tool_calls"}],
+            "usage": {"prompt_tokens": 3, "completion_tokens": 7},
+        }
+        out = openai_to_anthropic_response(resp)
+        assert out["stop_reason"] == "tool_use"
+        assert out["content"][0] == {"type": "text", "text": "hi"}
+        assert out["content"][1]["type"] == "tool_use"
+        assert out["content"][1]["input"] == {"a": 1}
+        assert out["usage"] == {"input_tokens": 3, "output_tokens": 7}
+
+    def test_sse_resynthesis(self):
+        chunks = [
+            {"id": "c1", "model": "m",
+             "choices": [{"delta": {"content": "hel"}}]},
+            {"id": "c1", "model": "m",
+             "choices": [{"delta": {"content": "lo"}}]},
+            {"id": "c1", "model": "m",
+             "choices": [{"delta": {}, "finish_reason": "stop"}],
+             "usage": {"completion_tokens": 2}},
+        ]
+        events = list(openai_sse_to_anthropic_events(iter(chunks)))
+        kinds = [k for k, _ in events]
+        assert kinds == ["message_start", "content_block_start",
+                         "content_block_delta", "content_block_delta",
+                         "content_block_stop", "message_delta",
+                         "message_stop"]
+        text = "".join(p["delta"]["text"] for k, p in events
+                       if k == "content_block_delta")
+        assert text == "hello"
+
+    def test_cache_control_rides_extension(self):
+        body = {
+            "model": "m", "max_tokens": 5,
+            "system": [{"type": "text", "text": "sys",
+                        "cache_control": {"type": "ephemeral"}}],
+            "messages": [{"role": "user", "content": "q"}],
+        }
+        out = anthropic_to_openai(body)
+        assert out["_vsr_ext"]["system[0].cache_control"] == \
+            {"type": "ephemeral"}
+
+
+class TestPromptCompression:
+    TEXT = (
+        "The router receives a request. It extracts signals from the text. "
+        "The signals feed a decision engine. Unrelated filler sentence one. "
+        "Unrelated filler sentence two. Unrelated filler sentence two. "
+        "The decision engine picks a model. The model serves the answer. "
+        "Finally the response returns to the client.")
+
+    def test_compresses_to_ratio(self):
+        c = PromptCompressor(target_ratio=0.5, min_sentences=2)
+        res = c.compress(self.TEXT)
+        assert res.kept_sentences < res.original_sentences
+        assert res.ratio <= 0.85
+
+    def test_preserves_first_and_last(self):
+        c = PromptCompressor(target_ratio=0.3, min_sentences=2)
+        res = c.compress(self.TEXT)
+        assert res.text.startswith("The router receives")
+        assert res.text.rstrip().endswith("client.")
+
+    def test_short_text_untouched(self):
+        c = PromptCompressor()
+        res = c.compress("One. Two.")
+        assert res.ratio == 1.0
+        assert res.text == "One. Two."
+
+    def test_profiles_exist(self):
+        from semantic_router_tpu.router.promptcompression import PROFILES
+
+        assert set(PROFILES) == {"default", "coding", "medical", "security",
+                                 "multi_turn"}
+
+    def test_multilingual_split(self):
+        sents = split_sentences("第一句。第二句！third sentence. fourth?")
+        assert len(sents) == 4
+
+
+class TestRateLimiter:
+    def test_token_bucket_refills(self):
+        b = TokenBucket(rate_per_s=100.0, burst=2)
+        assert b.take()[0] and b.take()[0]
+        ok, wait = b.take()
+        assert not ok and wait > 0
+        time.sleep(0.03)
+        assert b.take()[0]
+
+    def test_per_user_override(self):
+        rl = RateLimiter(requests_per_minute=6000,
+                         per_user={"limited": 60}, burst=1)
+        assert rl.check("limited", "m").allowed
+        assert not rl.check("limited", "m").allowed
+        assert rl.check("other", "m").allowed
+
+    def test_disabled_when_zero(self):
+        rl = RateLimiter(requests_per_minute=0)
+        d = rl.check("u", "m")
+        assert d.allowed and d.source == "disabled"
+
+    def test_remote_first_fail_open(self):
+        calls = []
+
+        def remote(user, model):
+            calls.append(user)
+            raise RuntimeError("RLS down")
+
+        rl = RateLimiter(requests_per_minute=0, remote_check=remote)
+        assert rl.check("u", "m").allowed  # remote error → local (disabled)
+        assert calls == ["u"]
+
+
+class TestMetrics:
+    def test_counter_and_exposition(self):
+        reg = MetricsRegistry()
+        c = reg.counter("test_total")
+        c.inc(model="a")
+        c.inc(2.0, model="a")
+        c.inc(model="b")
+        text = reg.expose()
+        assert 'test_total{model="a"} 3.0' in text
+        assert 'test_total{model="b"} 1.0' in text
+
+    def test_histogram_percentile(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.05, 0.05, 0.5):
+            h.observe(v)
+        assert h.percentile(50) == 0.1
+        assert h.count() == 4
+        text = reg.expose()
+        assert "lat_seconds_bucket" in text
+        assert "lat_seconds_count 4" in text
+
+
+class TestTracing:
+    def test_span_nesting_and_query(self):
+        t = Tracer()
+        with t.span("request") as outer:
+            with t.signal_span("keyword") as inner:
+                inner.set(matched=2)
+        spans = t.spans()
+        assert [s.name for s in spans] == ["signal.keyword", "request"]
+        sig, req = spans
+        assert sig.parent_id == req.span_id
+        assert sig.trace_id == req.trace_id
+        assert sig.attributes["matched"] == 2
+
+    def test_w3c_propagation(self):
+        t = Tracer()
+        headers = {"traceparent":
+                   "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01"}
+        trace_id, parent = t.extract(headers)
+        assert trace_id == "0af7651916cd43dd8448eb211c80319c"
+        assert parent == "b7ad6b7169203331"
+        out: dict = {}
+        t.inject(trace_id, "aaaabbbbccccdddd", out)
+        assert out["traceparent"].startswith(f"00-{trace_id}-aaaa")

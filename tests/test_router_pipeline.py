@@ -1,0 +1,157 @@
+"""Router pipeline tests (reference: extproc request/response pipeline
+behaviours — decision → plugins → selection → mutation → headers; response
+screens; cache round trip; fail-open)."""
+
+import pytest
+
+from semantic_router_tpu.config import load_config
+from semantic_router_tpu.engine.testing import make_embedding_engine
+from semantic_router_tpu.router import Router
+from semantic_router_tpu.router import headers as H
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = make_embedding_engine()
+    yield eng
+    eng.shutdown()
+
+
+@pytest.fixture(scope="module")
+def router(engine, fixture_config_path):
+    cfg = load_config(fixture_config_path)
+    r = Router(cfg, engine=engine)
+    yield r
+    r.shutdown()
+
+
+def body(text, **kw):
+    return {"model": "auto",
+            "messages": [{"role": "user", "content": text}], **kw}
+
+
+class TestRoutingFlow:
+    def test_basic_route_headers(self, router):
+        res = router.route(body("this is urgent, fix asap"))
+        assert res.kind == "route"
+        assert res.decision.decision.name == "urgent_route"
+        assert res.headers[H.DECISION] == "urgent_route"
+        assert res.headers[H.MODEL] == res.model == "qwen3-8b"
+        assert res.headers[H.SCHEMA] == "v1"
+        assert res.body["model"] == "qwen3-8b"
+        assert res.routing_latency_s < 5.0
+
+    def test_cs_route_lora_and_reasoning(self, router):
+        res = router.route(body(
+            "solve this step by step: design a distributed algorithm"))
+        if res.decision and res.decision.decision.name == "cs_reasoning_route":
+            # lora_name folds into the model field; reasoning effort set
+            assert res.body["model"].startswith("qwen3-32b")
+            assert res.headers.get(H.REASONING) == "true"
+
+    def test_system_prompt_injection(self, router):
+        res = router.route(body("please debug this broken code function"))
+        assert res.decision.decision.name == "code_route"
+        msgs = res.body["messages"]
+        assert msgs[0]["role"] == "system"
+        assert "coding assistant" in msgs[0]["content"]
+        assert res.headers.get(H.INJECTED_SYSTEM_PROMPT) == "true"
+
+    def test_default_model_fallback(self, router):
+        res = router.route(body("纯中文请求没有匹配决策"))
+        assert res.kind == "route"
+        assert res.model == "qwen3-8b"  # default_model
+        assert res.body["model"] == "qwen3-8b"
+
+    def test_skip_processing_header(self, router):
+        res = router.route(body("anything"),
+                           headers={H.SKIP_PROCESSING: "true"})
+        assert res.kind == "passthrough"
+
+    def test_skip_signals_header(self, router):
+        res = router.route(body("this is urgent asap"),
+                           headers={"x-vsr-skip-signals": "keyword"})
+        assert res.decision is None or \
+            res.decision.decision.name != "urgent_route"
+
+
+class TestCachePath:
+    def test_cache_round_trip(self, router):
+        q = body("please debug the cache function in this code")
+        first = router.route(q)
+        assert first.kind == "route"
+        # simulate backend response, then re-ask
+        resp = {"choices": [{"message": {"role": "assistant",
+                                         "content": "use a debugger"},
+                             "finish_reason": "stop"}],
+                "usage": {"prompt_tokens": 5, "completion_tokens": 3}}
+        router.process_response(first, resp)
+        second = router.route(q)
+        assert second.kind == "cache_hit"
+        assert second.headers[H.CACHE_HIT] == "true"
+        content = second.response_body["choices"][0]["message"]["content"]
+        assert content == "use a debugger"
+
+
+class TestRateLimit:
+    def test_rate_limited(self, engine, fixture_config_path):
+        cfg = load_config(fixture_config_path)
+        cfg.ratelimit = {"requests_per_minute": 60, "burst": 2}
+        r = Router(cfg, engine=None)
+        try:
+            b = body("hello")
+            assert r.route(b).kind != "rate_limited"
+            assert r.route(b).kind != "rate_limited"
+            third = r.route(b)
+            assert third.kind == "rate_limited"
+            assert third.status == 429
+            assert "retry-after" in third.headers
+        finally:
+            r.shutdown()
+
+
+class TestEngineDeath:
+    def test_fail_open_without_engine(self, fixture_config_path):
+        cfg = load_config(fixture_config_path)
+        r = Router(cfg, engine=None)  # heuristics only
+        try:
+            res = r.route(body("this is urgent fix asap"))
+            assert res.kind == "route"
+            assert res.decision.decision.name == "urgent_route"
+        finally:
+            r.shutdown()
+
+
+class TestResponsePath:
+    def test_usage_cost_metrics(self, router):
+        from semantic_router_tpu.observability.metrics import model_cost
+
+        res = router.route(body("what is the urgent asap problem"))
+        before = model_cost.get(model=res.model)
+        router.process_response(res, {
+            "choices": [{"message": {"role": "assistant", "content": "hi"},
+                         "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": 1_000_000,
+                      "completion_tokens": 1_000_000}})
+        after = model_cost.get(model=res.model)
+        assert after > before  # qwen3-8b pricing 0.3 + 0.6
+
+    def test_feedback_does_not_crash(self, router):
+        res = router.route(body("tell me about business strategy"))
+        router.record_feedback(res, success=True, latency_ms=123.0)
+
+
+class TestSelectionIntegration:
+    def test_weighted_static_on_cs_route(self, router):
+        # cs_reasoning_route has two refs (0.7/0.3) under static
+        models = set()
+        for i in range(20):
+            res = router.route(body(
+                "solve this step by step: analyze the root cause of the "
+                f"distributed systems bug number {i}"))
+            if res.decision and \
+                    res.decision.decision.name == "cs_reasoning_route":
+                models.add(res.model)
+        # over 20 draws the weighted static should have hit the majority ref
+        if models:
+            assert "qwen3-32b" in models

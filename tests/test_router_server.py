@@ -1,0 +1,187 @@
+"""End-to-end server tests: client → router server → mock vLLM backend
+(reference: e2e harness with mock-vllm fixtures; routing assertions read
+the echoed request facts)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from semantic_router_tpu.config import load_config
+from semantic_router_tpu.engine.testing import make_embedding_engine
+from semantic_router_tpu.router import MockVLLMServer, Router, RouterServer
+from semantic_router_tpu.router import headers as H
+
+
+@pytest.fixture(scope="module")
+def stack(fixture_config_path):
+    backend = MockVLLMServer().start()
+    cfg = load_config(fixture_config_path)
+    engine = make_embedding_engine()
+    router = Router(cfg, engine=engine)
+    server = RouterServer(router, cfg,
+                          default_backend=backend.url).start()
+    yield server, backend
+    server.stop()
+    backend.stop()
+    engine.shutdown()
+
+
+def post(url, path, payload, headers=None):
+    req = urllib.request.Request(url + path,
+                                 data=json.dumps(payload).encode(),
+                                 method="POST")
+    req.add_header("content-type", "application/json")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, dict(resp.headers), json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read() or b"{}")
+
+
+def get(url, path):
+    with urllib.request.urlopen(url + path, timeout=30) as resp:
+        return resp.status, resp.read().decode()
+
+
+def chat(text, **kw):
+    return {"model": "auto",
+            "messages": [{"role": "user", "content": text}], **kw}
+
+
+class TestChatCompletions:
+    def test_routes_and_forwards(self, stack):
+        server, _ = stack
+        status, headers, body = post(server.url, "/v1/chat/completions",
+                                     chat("this is urgent, asap please"))
+        assert status == 200
+        assert headers.get(H.DECISION) == "urgent_route"
+        assert headers.get(H.MODEL) == "qwen3-8b"
+        echoed = json.loads(body["choices"][0]["message"]["content"])
+        assert echoed["model"] == "qwen3-8b"  # body rewritten before forward
+
+    def test_system_prompt_reaches_backend(self, stack):
+        server, _ = stack
+        status, headers, body = post(server.url, "/v1/chat/completions",
+                                     chat("debug my code function please"))
+        assert status == 200
+        echoed = json.loads(body["choices"][0]["message"]["content"])
+        assert echoed["has_system"] is True
+        assert "coding assistant" in echoed["system_prompt"]
+
+    def test_tool_filtering_reaches_backend(self, stack):
+        server, _ = stack
+        payload = chat("debug this code function")
+        payload["tools"] = [
+            {"type": "function", "function": {"name": "search_web",
+                                              "description": "search"}},
+            {"type": "function", "function": {"name": "exec_cmd",
+                                              "description": "execute"}},
+        ]
+        status, headers, body = post(server.url, "/v1/chat/completions",
+                                     payload)
+        assert status == 200
+        echoed = json.loads(body["choices"][0]["message"]["content"])
+        # code_route blocks exec_cmd and allows search_web
+        assert echoed["tool_names"] == ["search_web"]
+
+    def test_unknown_json_400(self, stack):
+        server, _ = stack
+        req = urllib.request.Request(
+            server.url + "/v1/chat/completions", data=b"{not json",
+            method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            assert False
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+
+class TestAnthropicEndpoint:
+    def test_messages_round_trip(self, stack):
+        server, _ = stack
+        payload = {
+            "model": "auto",
+            "max_tokens": 100,
+            "anthropic_version": "2023-06-01",
+            "system": "be nice",
+            "messages": [{"role": "user", "content": [
+                {"type": "text", "text": "this is urgent respond asap"}]}],
+        }
+        status, headers, body = post(server.url, "/v1/messages", payload)
+        assert status == 200
+        assert body["type"] == "message"
+        assert body["role"] == "assistant"
+        assert body["stop_reason"] == "end_turn"
+        assert body["usage"]["output_tokens"] == 23
+        echoed = json.loads(body["content"][0]["text"])
+        assert echoed["has_system"] is True  # system survived translation
+        assert headers.get(H.DECISION) == "urgent_route"
+
+
+class TestManagementAPI:
+    def test_health_ready_metrics(self, stack):
+        server, _ = stack
+        assert get(server.url, "/health")[0] == 200
+        assert get(server.url, "/ready")[0] == 200
+        status, text = get(server.url, "/metrics")
+        assert status == 200
+        assert "llm_model_requests_total" in text
+        assert "llm_model_routing_latency_seconds" in text
+
+    def test_models_list(self, stack):
+        server, _ = stack
+        status, text = get(server.url, "/v1/models")
+        data = json.loads(text)
+        assert {m["id"] for m in data["data"]} == \
+            {"qwen3-8b", "qwen3-32b", "sdxl-image"}
+
+    def test_classify_endpoints(self, stack):
+        server, _ = stack
+        status, _, body = post(server.url, "/api/v1/classify/intent",
+                               {"text": "how do I sue my landlord"})
+        assert status == 200
+        assert "label" in body and "confidence" in body
+        status, _, body = post(server.url, "/api/v1/classify/pii",
+                               {"text": "my email is a@b.com"})
+        assert status == 200
+        assert "entities" in body
+        status, _, body = post(server.url, "/api/v1/classify/combined",
+                               {"text": "hello"})
+        assert status == 200
+        assert "intent" in body and "security" in body
+
+    def test_embeddings_and_similarity(self, stack):
+        server, _ = stack
+        status, _, body = post(server.url, "/api/v1/embeddings",
+                               {"input": ["hello world"],
+                                "model": "embedding"})
+        assert status == 200
+        assert len(body["data"]) == 1
+        assert len(body["data"][0]["embedding"]) == 32
+        status, _, body = post(server.url, "/api/v1/similarity",
+                               {"text_a": "hello world",
+                                "text_b": "hello world"})
+        assert status == 200
+        assert body["similarity"] == pytest.approx(1.0, abs=1e-4)
+
+    def test_config_endpoint(self, stack):
+        server, _ = stack
+        status, text = get(server.url, "/config/router")
+        assert status == 200
+        assert "routing" in json.loads(text)
+
+    def test_backend_unreachable_502(self, fixture_config_path):
+        cfg = load_config(fixture_config_path)
+        router = Router(cfg, engine=None)
+        server = RouterServer(router, cfg,
+                              default_backend="http://127.0.0.1:1").start()
+        try:
+            status, _, body = post(server.url, "/v1/chat/completions",
+                                   chat("urgent asap"))
+            assert status == 502
+            assert body["error"]["type"] == "backend_error"
+        finally:
+            server.stop()
