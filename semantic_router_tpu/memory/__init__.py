@@ -1,0 +1,11 @@
+from .store import (
+    InMemoryMemoryStore,
+    MemoryExtractor,
+    MemoryItem,
+    MemoryStore,
+    extract_memories_heuristic,
+    sanitize_pii,
+)
+
+__all__ = ["InMemoryMemoryStore", "MemoryExtractor", "MemoryItem",
+           "MemoryStore", "extract_memories_heuristic", "sanitize_pii"]

@@ -68,7 +68,7 @@ class ModernBertConfig:
     classifier_activation: str = "gelu"
     num_labels: int = 2
     rope_scaling: Optional[Dict[str, Any]] = None  # {"rope_type": "yarn", ...}
-    attention_impl: str = "dense"  # dense | chunked
+    attention_impl: str = "dense"  # dense | chunked | flash (pallas on TPU)
     chunk_block_size: int = 512
     dtype: Any = jnp.float32
 
@@ -201,7 +201,12 @@ class ModernBertAttention(nn.Module):
         cos, sin = spec.tables(S)
         q, k = apply_rotary(q, k, cos, sin)
 
-        if cfg.attention_impl == "chunked":
+        if cfg.attention_impl == "flash":
+            from ..ops.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, key_padding_mask=attention_mask,
+                                  window=window)
+        elif cfg.attention_impl == "chunked":
             out = chunked_sdpa(q, k, v, key_padding_mask=attention_mask,
                                window=window,
                                block_size=cfg.chunk_block_size)

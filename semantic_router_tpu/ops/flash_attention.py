@@ -1,0 +1,169 @@
+"""Pallas TPU flash attention with native sliding-window support.
+
+The role of the reference's two long-context attention kernels in one
+TPU-native kernel (SURVEY.md N8/N12):
+
+- chunked_sdpa.rs (N8): O(n) memory via query-block streaming — here the
+  standard flash online-softmax over K/V blocks.
+- ort-ck-flash-attn (N12, C++/HIP Composable-Kernel FMHA): tiled MXU
+  attention with *native sliding-window* masking for ModernBERT's local
+  layers (no dense [1,1,S,S] mask materialisation) — here the window is a
+  block-index predicate: K/V blocks wholly outside the window are skipped
+  (never read from VMEM), partial blocks are masked in-register.
+
+Layout: q/k/v reshaped to [B*H, S, D]; grid = (B*H, Sq/BLOCK_Q). Each
+program streams K/V blocks through the MXU with fp32 accumulators
+(m/l/acc carried as fori_loop values). Padding arrives as a per-(B) additive
+key bias, indexed by bh // H.
+
+``flash_attention`` is the public entry: Pallas on TPU, dense/chunked JAX
+fallback elsewhere (bit-compatible semantics; the fallback is also the
+numerics oracle in tests via interpret mode).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from .attention import NEG_INF, chunked_sdpa, padding_bias, sdpa, \
+    sliding_window_bias
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *,
+                  scale: float, block_k: int, seq_len: int,
+                  window: int, causal: bool):
+    """One (bh, q-block) program: stream K/V blocks with online softmax."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [Bq, D]
+    block_q = q.shape[0]
+    n_kb = seq_len // block_k
+
+    q_start = qi * block_q
+    if window > 0:
+        half = window // 2
+        lo = jnp.maximum(q_start - half, 0) // block_k
+        hi = jnp.minimum(
+            (q_start + block_q - 1 + half) // block_k + 1, n_kb)
+    elif causal:
+        lo = jnp.int32(0)
+        hi = (q_start + block_q - 1) // block_k + 1
+    else:
+        lo = jnp.int32(0)
+        hi = jnp.int32(n_kb)
+
+    m0 = jnp.full((block_q,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k),
+                                               0)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k.T  # [Bq, Bk]
+        s = s + bias_ref[0, pl.ds(kb * block_k, block_k)][None, :]
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if window > 0:
+            dist = jnp.abs(q_pos - k_pos)
+            s = jnp.where(dist <= window // 2, s, NEG_INF)
+        if causal:
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + p.sum(axis=1)
+        acc_new = acc * correction[:, None] + p @ v
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(lo, hi, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-20)  # fully-masked rows stay finite
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention_pallas(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                           key_padding_mask: Optional[jnp.ndarray] = None,
+                           window: int = 0, causal: bool = False,
+                           block_q: int = DEFAULT_BLOCK_Q,
+                           block_k: int = DEFAULT_BLOCK_K,
+                           scale: Optional[float] = None,
+                           interpret: bool = False) -> jnp.ndarray:
+    """q/k/v: [B, H, S, D]; key_padding_mask: [B, S] (1 = real token).
+    ``window``: ModernBERT-style full window width (0 = global)."""
+    B, H, S, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    pad = (-S) % max(block_q, block_k)
+    Sp = S + pad
+    if pad:
+        zq = ((0, 0), (0, 0), (0, pad), (0, 0))
+        q = jnp.pad(q, zq)
+        k = jnp.pad(k, zq)
+        v = jnp.pad(v, zq)
+    if key_padding_mask is None:
+        bias = jnp.zeros((B, Sp), jnp.float32)
+        if pad:
+            bias = bias.at[:, S:].set(NEG_INF)
+    else:
+        mask = key_padding_mask
+        if pad:
+            mask = jnp.pad(mask, ((0, 0), (0, pad)))
+        bias = (1.0 - mask.astype(jnp.float32)) * NEG_INF
+
+    BH = B * H
+    qf = q.reshape(BH, Sp, D)
+    kf = k.reshape(BH, Sp, D)
+    vf = v.reshape(BH, Sp, D)
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, block_k=block_k, seq_len=Sp,
+        window=window, causal=causal)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(BH, Sp // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, Sp, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, Sp, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, Sp), lambda bh, qi, H=H: (bh // H, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Sp, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, bias)
+    return out.reshape(B, H, Sp, D)[:, :, :S, :]
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    key_padding_mask: Optional[jnp.ndarray] = None,
+                    window: int = 0, causal: bool = False,
+                    scale: Optional[float] = None) -> jnp.ndarray:
+    """Dispatch: Pallas kernel on TPU; JAX fallback elsewhere."""
+    platform = q.devices().pop().platform if hasattr(q, "devices") else \
+        jax.default_backend()
+    if platform == "tpu":
+        return flash_attention_pallas(q, k, v, key_padding_mask,
+                                      window=window, causal=causal,
+                                      scale=scale)
+    if causal:
+        S = q.shape[2]
+        bias = jnp.triu(jnp.full((S, S), NEG_INF, jnp.float32), k=1)[None, None]
+        if key_padding_mask is not None:
+            bias = bias + padding_bias(key_padding_mask)
+        if window > 0:
+            bias = bias + sliding_window_bias(S, window)
+        return sdpa(q, k, v, bias=bias, scale=scale)
+    return chunked_sdpa(q, k, v, key_padding_mask=key_padding_mask,
+                        window=window, scale=scale)

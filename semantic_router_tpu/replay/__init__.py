@@ -1,0 +1,3 @@
+from .recorder import ReplayRecord, ReplayRecorder, ReplayStore
+
+__all__ = ["ReplayRecord", "ReplayRecorder", "ReplayStore"]

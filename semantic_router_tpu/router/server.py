@@ -85,6 +85,16 @@ class RouterServer:
         self.started_t = time.time()
         self.ready = threading.Event()
 
+        # shared looper plumbing (client is stateless; pool shared across
+        # requests — a per-request Looper wraps them with request state)
+        from ..looper import HTTPLLMClient
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.looper_client = HTTPLLMClient(self.resolver.resolve,
+                                           forward_timeout_s)
+        self.looper_pool = ThreadPoolExecutor(max_workers=16,
+                                              thread_name_prefix="looper")
+
         handler = self._make_handler()
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]
@@ -104,6 +114,7 @@ class RouterServer:
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+        self.looper_pool.shutdown(wait=False, cancel_futures=True)
         self.router.shutdown()
 
     # ------------------------------------------------------------------
@@ -239,6 +250,17 @@ class RouterServer:
                     self._json(route.status, payload, route.headers)
                     return
 
+                # looper short-circuit: a looper-marked request is one of
+                # our own fan-out calls re-entering through a layered
+                # deployment — serve it single-model, never re-fan-out
+                # (isLooperRequest, processor_req_body.go:64)
+                is_looper_subrequest = headers.get(
+                    H.LOOPER, "").lower() in ("1", "true")
+                if route.looper_algorithm and route.decision is not None \
+                        and not is_looper_subrequest:
+                    self._looper_chat(route, headers, anthropic)
+                    return
+
                 backend = server.resolver.resolve(route.model)
                 if not backend:
                     self._json(502, {"error": {
@@ -268,6 +290,52 @@ class RouterServer:
                     server.router.record_feedback(route, success=False,
                                                   latency_ms=latency_ms)
                     self._json(status, resp, route.headers)
+
+            def _looper_chat(self, route, req_headers: Dict[str, str],
+                             anthropic: bool) -> None:
+                """Multi-model execution strategies (looper dispatch,
+                looper.go:123-129): the router becomes the client.
+                Caller credentials/trace headers forward to every fan-out
+                call (appendCredentialHeaders parity)."""
+                from ..looper import Looper
+
+                decision = route.decision.decision
+                nli = None
+                eng = server.router.engine
+                if eng is not None and eng.has_task("nli"):
+                    def nli(premise, claim):
+                        r = eng.classify("nli", f"{premise}\n[SEP]\n{claim}")
+                        return r.probs.get("entailment", r.confidence)
+                looper = Looper(server.looper_client, nli,
+                                pool=server.looper_pool)
+                t0 = time.perf_counter()
+                try:
+                    result = looper.execute(decision.algorithm,
+                                            decision.model_refs, route.body,
+                                            headers=req_headers)
+                except Exception as exc:
+                    server.router.record_feedback(
+                        route, success=False,
+                        latency_ms=(time.perf_counter() - t0) * 1e3)
+                    self._json(502, {"error": {
+                        "message": f"looper failed: {exc}",
+                        "type": "looper_error"}}, route.headers)
+                    return
+                latency_ms = (time.perf_counter() - t0) * 1e3
+                route.model = result.model
+                processed = server.router.process_response(route, result.body)
+                server.router.record_feedback(route, success=True,
+                                              latency_ms=latency_ms)
+                out_headers = dict(route.headers)
+                out_headers.update(processed.headers)
+                out_headers[H.MODEL] = result.model
+                out_headers["x-vsr-looper-algorithm"] = result.algorithm
+                out_headers["x-vsr-looper-candidates"] = ",".join(
+                    result.candidates_used)
+                payload = processed.body
+                if anthropic:
+                    payload = openai_to_anthropic_response(payload)
+                self._json(200, payload, out_headers)
 
             def _classify(self, task: str, body: Dict[str, Any]) -> None:
                 """Route API classification endpoints

@@ -1,0 +1,3 @@
+from .startup import PHASES, StartupStatus, StartupTracker
+
+__all__ = ["PHASES", "StartupStatus", "StartupTracker"]

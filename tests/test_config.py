@@ -23,8 +23,8 @@ def test_load_fixture(router_config):
     assert cfg.semantic_cache.enabled
     assert cfg.semantic_cache.eviction_policy == "lru"
     assert cfg.engine.seq_len_buckets == [128, 512, 2048]
-    assert len(cfg.decisions) == 7
-    assert len(cfg.signals.keywords) == 5
+    assert len(cfg.decisions) == 8
+    assert len(cfg.signals.keywords) == 6
     assert cfg.signals.context[0].min_tokens == 2048  # "2K"
     assert cfg.signals.complexity[0].composer is not None
 

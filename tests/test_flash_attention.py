@@ -1,0 +1,84 @@
+"""Pallas flash-attention kernel numerics (interpret mode on CPU) vs the
+dense SDPA oracle — global, sliding-window, causal, padded."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from semantic_router_tpu.ops import padding_bias, sdpa, sliding_window_bias
+from semantic_router_tpu.ops.attention import NEG_INF
+from semantic_router_tpu.ops.flash_attention import flash_attention_pallas
+
+
+def rand(*shape, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal(shape), dtype=jnp.float32)
+
+
+def run(q, k, v, **kw):
+    return flash_attention_pallas(q, k, v, block_q=16, block_k=16,
+                                  interpret=True, **kw)
+
+
+class TestFlashKernel:
+    def test_global_matches_dense(self):
+        q, k, v = (rand(2, 2, 64, 32, seed=s) for s in (1, 2, 3))
+        out = run(q, k, v)
+        ref = sdpa(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_sliding_window_matches_dense(self):
+        q, k, v = (rand(1, 2, 64, 16, seed=s) for s in (4, 5, 6))
+        out = run(q, k, v, window=16)
+        ref = sdpa(q, k, v, bias=sliding_window_bias(64, 16))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_padding_mask(self):
+        q, k, v = (rand(2, 1, 48, 16, seed=s) for s in (7, 8, 9))
+        mask = jnp.asarray(np.concatenate(
+            [np.ones((2, 30)), np.zeros((2, 18))], 1), jnp.float32)
+        out = run(q, k, v, key_padding_mask=mask)
+        ref = sdpa(q, k, v, bias=padding_bias(mask))
+        np.testing.assert_allclose(np.asarray(out)[:, :, :30],
+                                   np.asarray(ref)[:, :, :30],
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_causal(self):
+        q, k, v = (rand(1, 1, 32, 16, seed=s) for s in (10, 11, 12))
+        out = run(q, k, v, causal=True)
+        bias = jnp.triu(jnp.full((32, 32), NEG_INF, jnp.float32),
+                        k=1)[None, None]
+        ref = sdpa(q, k, v, bias=bias)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_non_divisible_seq_padding(self):
+        q, k, v = (rand(1, 2, 50, 16, seed=s) for s in (13, 14, 15))
+        out = run(q, k, v)
+        ref = sdpa(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_window_plus_padding(self):
+        q, k, v = (rand(2, 2, 64, 16, seed=s) for s in (16, 17, 18))
+        mask = jnp.asarray(np.concatenate(
+            [np.ones((2, 40)), np.zeros((2, 24))], 1), jnp.float32)
+        out = run(q, k, v, window=16, key_padding_mask=mask)
+        ref = sdpa(q, k, v, bias=padding_bias(mask)
+                   + sliding_window_bias(64, 16))
+        np.testing.assert_allclose(np.asarray(out)[:, :, :40],
+                                   np.asarray(ref)[:, :, :40],
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_bf16_inputs(self):
+        q, k, v = (rand(1, 1, 32, 16, seed=s).astype(jnp.bfloat16)
+                   for s in (19, 20, 21))
+        out = run(q, k, v)
+        ref = sdpa(q.astype(jnp.float32), k.astype(jnp.float32),
+                   v.astype(jnp.float32))
+        np.testing.assert_allclose(
+            np.asarray(out, dtype=np.float32), np.asarray(ref),
+            atol=2e-2, rtol=2e-2)

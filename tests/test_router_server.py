@@ -99,6 +99,23 @@ class TestChatCompletions:
             assert e.code == 400
 
 
+class TestLooperEndToEnd:
+    def test_fusion_route_executes_panel(self, stack):
+        server, backend = stack
+        status, headers, body = post(
+            server.url, "/v1/chat/completions",
+            chat("ask a panel of experts: is P equal to NP?"))
+        assert status == 200
+        assert headers.get(H.DECISION) == "fusion_route"
+        assert headers.get("x-vsr-looper-algorithm") == "fusion"
+        cands = set(headers.get("x-vsr-looper-candidates", "").split(","))
+        assert cands == {"qwen3-8b", "qwen3-32b"}
+        # synthesis response comes from the synthesis model via the backend
+        assert headers.get(H.MODEL) == "qwen3-32b"
+        content = body["choices"][0]["message"]["content"]
+        assert "Panel answers" in json.loads(content)["last_user"]
+
+
 class TestAnthropicEndpoint:
     def test_messages_round_trip(self, stack):
         server, _ = stack
