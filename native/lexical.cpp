@@ -1,0 +1,197 @@
+// Native lexical scorers + distance kernels for the router's hot host-side
+// loops.
+//
+// TPU-native equivalent of the reference's native runtime components that
+// are NOT device compute (SURVEY.md §2.1):
+//   N15 nlp-binding (Rust): BM25 + char-ngram keyword scorers
+//   N16 SIMD distance (Go asm): batched dot/cosine for in-proc ANN
+//
+// Exposed as a plain C ABI consumed via ctypes (semantic_router_tpu.native).
+// Scoring semantics mirror the Python implementations bit-for-bit where
+// float order allows (the Python versions remain the portable fallback and
+// the test oracle).
+
+#include <algorithm>
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Tokenization (word-ish tokens, ASCII lowercase; multibyte bytes pass
+// through so UTF-8 sequences stay intact)
+// ---------------------------------------------------------------------------
+
+static void tokenize(const char* text, std::vector<std::string>& out) {
+  std::string cur;
+  for (const unsigned char* p = (const unsigned char*)text; *p; ++p) {
+    unsigned char c = *p;
+    bool word = (c >= 0x80) || std::isalnum(c) || c == '_';
+    if (word) {
+      cur.push_back((c < 0x80) ? (char)std::tolower(c) : (char)c);
+    } else if (!cur.empty()) {
+      out.push_back(cur);
+      cur.clear();
+    }
+  }
+  if (!cur.empty()) out.push_back(cur);
+}
+
+// ---------------------------------------------------------------------------
+// BM25 keyword-set scorer (nlp-binding/src/bm25_classifier.rs role).
+// keywords: '\n'-separated phrases. Returns the normalized score; when
+// matched_out is non-null it receives a bitmask of matched keyword indices
+// (up to 64).
+// ---------------------------------------------------------------------------
+
+double bm25_score(const char* text, const char* keywords, double k1, double b,
+                  double avgdl, uint64_t* matched_out) {
+  std::vector<std::string> doc;
+  tokenize(text, doc);
+  if (doc.empty()) {
+    if (matched_out) *matched_out = 0;
+    return 0.0;
+  }
+  std::unordered_map<std::string, int> tf;
+  for (auto& t : doc) tf[t]++;
+  double dl = (double)doc.size();
+  double norm = k1 * (1.0 - b + b * dl / avgdl);
+
+  double total = 0.0;
+  uint64_t matched = 0;
+  int kw_count = 0;
+
+  const char* start = keywords;
+  while (*start) {
+    const char* end = strchr(start, '\n');
+    std::string phrase = end ? std::string(start, end - start)
+                             : std::string(start);
+    start = end ? end + 1 : start + phrase.size();
+    if (phrase.empty()) continue;
+    std::vector<std::string> toks;
+    tokenize(phrase.c_str(), toks);
+    if (!toks.empty()) {
+      double kw_score = 1e300;
+      for (auto& t : toks) {
+        auto it = tf.find(t);
+        double f = (it == tf.end()) ? 0.0 : (double)it->second;
+        double s = (f > 0.0) ? (f * (k1 + 1.0)) / (f + norm) : 0.0;
+        kw_score = std::min(kw_score, s);
+      }
+      if (kw_score > 0.0 && kw_count < 64) matched |= (1ull << kw_count);
+      total += kw_score;
+    }
+    kw_count++;
+  }
+  if (matched_out) *matched_out = matched;
+  return total / std::max(kw_count, 1);
+}
+
+// ---------------------------------------------------------------------------
+// Char n-gram containment (nlp-binding/src/ngram_classifier.rs role):
+// best containment of any keyword's n-grams in the text's n-gram set.
+// ---------------------------------------------------------------------------
+
+static void grams(const std::string& s, int n,
+                  std::unordered_set<std::string>& out) {
+  std::string padded = " " + s + " ";
+  if ((int)padded.size() < n) {
+    out.insert(padded);
+    return;
+  }
+  for (size_t i = 0; i + n <= padded.size(); ++i)
+    out.insert(padded.substr(i, n));
+}
+
+static std::string lower_ascii(const char* s) {
+  std::string out(s);
+  for (auto& c : out)
+    if ((unsigned char)c < 0x80) c = (char)std::tolower((unsigned char)c);
+  return out;
+}
+
+double ngram_score(const char* text, const char* keywords, int arity) {
+  std::unordered_set<std::string> text_grams;
+  grams(lower_ascii(text), arity, text_grams);
+  double best = 0.0;
+  const char* start = keywords;
+  while (*start) {
+    const char* end = strchr(start, '\n');
+    std::string phrase = end ? std::string(start, end - start)
+                             : std::string(start);
+    start = end ? end + 1 : start + phrase.size();
+    if (phrase.empty()) continue;
+    std::unordered_set<std::string> kw_grams;
+    grams(lower_ascii(phrase.c_str()), arity, kw_grams);
+    if (kw_grams.empty()) continue;
+    int hit = 0;
+    for (auto& g : kw_grams)
+      if (text_grams.count(g)) hit++;
+    best = std::max(best, (double)hit / (double)kw_grams.size());
+  }
+  return best;
+}
+
+// ---------------------------------------------------------------------------
+// Batched distance kernels (N16 role). Compilers auto-vectorize these inner
+// loops (AVX2/AVX-512 where available; the build uses -O3 -march=native).
+// vectors: [n, dim] row-major float32; query: [dim]; out: [n].
+// ---------------------------------------------------------------------------
+
+void batch_dot(const float* vectors, const float* query, float* out,
+               int64_t n, int64_t dim) {
+  for (int64_t i = 0; i < n; ++i) {
+    const float* v = vectors + i * dim;
+    float acc = 0.f;
+    for (int64_t d = 0; d < dim; ++d) acc += v[d] * query[d];
+    out[i] = acc;
+  }
+}
+
+void batch_cosine(const float* vectors, const float* query, float* out,
+                  int64_t n, int64_t dim) {
+  float qn = 0.f;
+  for (int64_t d = 0; d < dim; ++d) qn += query[d] * query[d];
+  qn = std::sqrt(qn);
+  if (qn < 1e-12f) qn = 1e-12f;
+  for (int64_t i = 0; i < n; ++i) {
+    const float* v = vectors + i * dim;
+    float acc = 0.f, vn = 0.f;
+    for (int64_t d = 0; d < dim; ++d) {
+      acc += v[d] * query[d];
+      vn += v[d] * v[d];
+    }
+    vn = std::sqrt(vn);
+    if (vn < 1e-12f) vn = 1e-12f;
+    out[i] = acc / (vn * qn);
+  }
+}
+
+// Fuzzy similarity percent (0-100): Indel-distance ratio over bytes — the
+// same family of score difflib/rapidfuzz produce for keyword fuzzy match.
+double fuzzy_ratio(const char* a, const char* b) {
+  size_t la = strlen(a), lb = strlen(b);
+  if (la == 0 && lb == 0) return 100.0;
+  if (la == 0 || lb == 0) return 0.0;
+  // LCS via DP rows (O(la*lb) time, O(lb) space)
+  std::vector<int> prev(lb + 1, 0), cur(lb + 1, 0);
+  for (size_t i = 1; i <= la; ++i) {
+    for (size_t j = 1; j <= lb; ++j) {
+      if (a[i - 1] == b[j - 1])
+        cur[j] = prev[j - 1] + 1;
+      else
+        cur[j] = std::max(prev[j], cur[j - 1]);
+    }
+    std::swap(prev, cur);
+  }
+  int lcs = prev[lb];
+  return 200.0 * (double)lcs / (double)(la + lb);
+}
+
+}  // extern "C"

@@ -1,0 +1,97 @@
+"""ctypes bindings for the native C++ lexical/distance library.
+
+Build: ``python -m semantic_router_tpu.native.build`` (or the Makefile) —
+compiles native/lexical.cpp into _lexical.so next to this package. Every
+consumer falls back to the pure-Python implementation when the library is
+absent, mirroring the reference's CGo-free build seam (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "_lexical.so")
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    if not os.path.exists(_LIB_PATH):
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.bm25_score.restype = ctypes.c_double
+    lib.bm25_score.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                               ctypes.c_double, ctypes.c_double,
+                               ctypes.c_double,
+                               ctypes.POINTER(ctypes.c_uint64)]
+    lib.ngram_score.restype = ctypes.c_double
+    lib.ngram_score.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                ctypes.c_int]
+    lib.fuzzy_ratio.restype = ctypes.c_double
+    lib.fuzzy_ratio.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    fptr = np.ctypeslib.ndpointer(dtype=np.float32, flags="C_CONTIGUOUS")
+    lib.batch_dot.restype = None
+    lib.batch_dot.argtypes = [fptr, fptr, fptr, ctypes.c_int64,
+                              ctypes.c_int64]
+    lib.batch_cosine.restype = None
+    lib.batch_cosine.argtypes = [fptr, fptr, fptr, ctypes.c_int64,
+                                 ctypes.c_int64]
+    _LIB = lib
+    return lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def bm25_score(text: str, keywords: List[str], k1: float = 1.5,
+               b: float = 0.75, avgdl: float = 64.0
+               ) -> Tuple[float, List[int]]:
+    """Returns (score, matched keyword indices)."""
+    lib = load()
+    assert lib is not None
+    matched = ctypes.c_uint64(0)
+    score = lib.bm25_score(text.encode(), "\n".join(keywords).encode(),
+                           k1, b, avgdl, ctypes.byref(matched))
+    idx = [i for i in range(min(len(keywords), 64))
+           if matched.value & (1 << i)]
+    return float(score), idx
+
+
+def ngram_score(text: str, keywords: List[str], arity: int = 3) -> float:
+    lib = load()
+    assert lib is not None
+    return float(lib.ngram_score(text.encode(),
+                                 "\n".join(keywords).encode(), arity))
+
+
+def fuzzy_ratio(a: str, b: str) -> float:
+    lib = load()
+    assert lib is not None
+    return float(lib.fuzzy_ratio(a.encode(), b.encode()))
+
+
+def batch_dot(vectors: np.ndarray, query: np.ndarray) -> np.ndarray:
+    lib = load()
+    assert lib is not None
+    vectors = np.ascontiguousarray(vectors, np.float32)
+    query = np.ascontiguousarray(query, np.float32)
+    out = np.empty(vectors.shape[0], np.float32)
+    lib.batch_dot(vectors, query, out, vectors.shape[0], vectors.shape[1])
+    return out
+
+
+def batch_cosine(vectors: np.ndarray, query: np.ndarray) -> np.ndarray:
+    lib = load()
+    assert lib is not None
+    vectors = np.ascontiguousarray(vectors, np.float32)
+    query = np.ascontiguousarray(query, np.float32)
+    out = np.empty(vectors.shape[0], np.float32)
+    lib.batch_cosine(vectors, query, out, vectors.shape[0], vectors.shape[1])
+    return out

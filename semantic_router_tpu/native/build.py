@@ -1,0 +1,26 @@
+"""Build the native lexical library: python -m semantic_router_tpu.native.build"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(__file__)
+SRC = os.path.join(HERE, "..", "..", "native", "lexical.cpp")
+OUT = os.path.join(HERE, "_lexical.so")
+
+
+def build(verbose: bool = True) -> str:
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+           os.path.abspath(SRC), "-o", OUT]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True)
+    return OUT
+
+
+if __name__ == "__main__":
+    build()
+    print(f"built {OUT}")
+    sys.exit(0)

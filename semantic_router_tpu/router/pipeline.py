@@ -123,8 +123,10 @@ class Router:
 
         self.model_cards = {m.name: m for m in cfg.model_cards}
         self._selectors: Dict[str, Any] = {}
-        self._last_context: Dict[str, tuple] = {}  # request_id → (decision, query_emb)
-        self.response_hooks: List[Any] = []  # replay/learning recorders (M5)
+        self.response_hooks: List[Any] = []  # replay/learning recorders
+        # optional subsystems (attach externally or via bootstrap)
+        self.vectorstores = None  # vectorstore.VectorStoreManager
+        self.memory_store = None  # memory.InMemoryMemoryStore
 
     # ------------------------------------------------------------------
     # request path
@@ -337,6 +339,11 @@ class Router:
                                 ctx: RequestContext,
                                 result: RouteResult) -> None:
         body = result.body
+
+        # Order: decision system-prompt first (replace/insert applies to
+        # the ORIGINAL system message), then memory/RAG context prepend
+        # ahead of it — retrieval context is never clobbered by
+        # mode=replace.
         sp = decision.plugin("system_prompt")
         if sp is not None and sp.enabled and body is not None:
             prompt = sp.configuration.get("system_prompt", "")
@@ -354,6 +361,53 @@ class Router:
                     messages = [{"role": "system", "content": prompt}] + messages
                 body["messages"] = messages
                 result.headers[H.INJECTED_SYSTEM_PROMPT] = "true"
+
+        # memory retrieval (req_filter_memory*, memory search + rewrite)
+        mem = decision.plugin("memory")
+        if mem is not None and mem.enabled and self.memory_store is not None \
+                and body is not None and ctx.user_id:
+            try:
+                items = self.memory_store.search(
+                    ctx.user_id, ctx.user_text,
+                    limit=int(mem.configuration.get("retrieval_limit", 5)),
+                    threshold=float(
+                        mem.configuration.get("similarity_threshold", 0.0)))
+                if items:
+                    facts = "; ".join(i.text for i in items)
+                    body["messages"] = (
+                        [{"role": "system",
+                          "content": f"Known about this user: {facts}"}]
+                        + list(body.get("messages", [])))
+                    result.headers["x-vsr-memories-used"] = str(len(items))
+            except Exception:
+                pass
+
+        # RAG: retrieve from the configured vector store and inject context
+        # (executeRAGPlugin, req_filter_rag.go)
+        rag = decision.plugin("rag")
+        if rag is not None and rag.enabled and self.vectorstores is not None \
+                and body is not None:
+            try:
+                store = self.vectorstores.get(
+                    rag.configuration.get("store", "default"))
+                if store is not None:
+                    from ..vectorstore import format_rag_context
+
+                    hits = store.search(
+                        ctx.user_text,
+                        top_k=int(rag.configuration.get("top_k", 4)),
+                        threshold=float(
+                            rag.configuration.get("threshold", 0.0)))
+                    context = format_rag_context(
+                        hits, max_chars=int(
+                            rag.configuration.get("max_chars", 4000)))
+                    if context:
+                        body["messages"] = (
+                            [{"role": "system", "content": context}]
+                            + list(body.get("messages", [])))
+                        result.headers["x-vsr-rag-chunks"] = str(len(hits))
+            except Exception:
+                pass  # fail open
 
         tools_plugin = decision.plugin("tools") or decision.plugin("tool_selection")
         if tools_plugin is not None and tools_plugin.enabled \
@@ -483,6 +537,28 @@ class Router:
                         + usage.get("completion_tokens", 0) / 1e6
                         * card.pricing.get("completion", 0.0))
                 M.model_cost.inc(cost, model=route.model)
+
+        # memory auto-store after a successful exchange
+        # (processor_res_memory.go)
+        if self.memory_store is not None and decision is not None \
+                and route.body:
+            mem = decision.plugin("memory")
+            if mem is not None and mem.enabled \
+                    and mem.configuration.get("auto_store") :
+                try:
+                    ctx = RequestContext.from_openai_body(route.body)
+                    if ctx.user_id:
+                        # exclude system messages: router-injected context
+                        # ("Known about this user", RAG blocks) must not
+                        # feed back into extraction
+                        convo = [m for m in route.body.get("messages", [])
+                                 if m.get("role") != "system"]
+                        self.memory_store.auto_store(
+                            ctx.user_id,
+                            convo + [{"role": "assistant",
+                                      "content": content}])
+                except Exception:
+                    pass
 
         for hook in self.response_hooks:
             try:

@@ -272,6 +272,11 @@ class RouterServer:
                 default_tracer.inject(trace_id, route.request_id[:16].ljust(16, "0"),
                                       fwd_headers)
                 fwd_headers.update(route.headers)
+
+                if route.body.get("stream"):
+                    self._stream_chat(route, backend, fwd_headers, anthropic)
+                    return
+
                 t0 = time.perf_counter()
                 status, resp = server._forward(backend, route.body,
                                                fwd_headers)
@@ -290,6 +295,124 @@ class RouterServer:
                     server.router.record_feedback(route, success=False,
                                                   latency_ms=latency_ms)
                     self._json(status, resp, route.headers)
+
+            def _stream_chat(self, route, backend: str,
+                             fwd_headers: Dict[str, str],
+                             anthropic: bool) -> None:
+                """Streaming relay: SSE chunks pass through per-frame with
+                TTFT/TPOT measurement and cache-on-complete
+                (processor_res_body_streaming*; sse_frame_buffer.go;
+                Anthropic re-synthesis for /v1/messages clients)."""
+                import urllib.request as _ur
+                from .anthropic import openai_sse_to_anthropic_events
+
+                req = _ur.Request(backend + "/v1/chat/completions",
+                                  data=json.dumps(route.body).encode(),
+                                  method="POST")
+                req.add_header("content-type", "application/json")
+                for k, v in fwd_headers.items():
+                    if k.lower() not in ("content-length", "host"):
+                        req.add_header(k, v)
+                t0 = time.perf_counter()
+                try:
+                    upstream = _ur.urlopen(req,
+                                           timeout=server.forward_timeout_s)
+                except urllib.error.HTTPError as e:
+                    # relay the backend's real status/payload (parity with
+                    # the non-streaming _forward path)
+                    try:
+                        payload = json.loads(e.read() or b"{}")
+                    except json.JSONDecodeError:
+                        payload = {"error": {"message": str(e)}}
+                    server.router.record_feedback(
+                        route, success=False,
+                        latency_ms=(time.perf_counter() - t0) * 1e3)
+                    self._json(e.code, payload, route.headers)
+                    return
+                except Exception as exc:
+                    server.router.record_feedback(
+                        route, success=False,
+                        latency_ms=(time.perf_counter() - t0) * 1e3)
+                    self._json(502, {"error": {
+                        "message": f"backend unreachable: {exc}",
+                        "type": "backend_error"}}, route.headers)
+                    return
+
+                self.send_response(200)
+                self.send_header("content-type", "text/event-stream")
+                for k, v in route.headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+
+                chunks = []
+                ttft_ms = 0.0
+                aborted = False
+                finished = False
+
+                def iter_chunks():
+                    nonlocal ttft_ms, finished
+                    while True:
+                        line = upstream.readline()
+                        if not line:
+                            break
+                        if not line.startswith(b"data:"):
+                            continue
+                        payload = line[5:].strip()
+                        if payload == b"[DONE]":
+                            finished = True
+                            break
+                        try:
+                            chunk = json.loads(payload)
+                        except json.JSONDecodeError:
+                            continue
+                        if not ttft_ms:
+                            ttft_ms = (time.perf_counter() - t0) * 1e3
+                        chunks.append(chunk)
+                        if any((c.get("finish_reason") or "")
+                               for c in chunk.get("choices", ())):
+                            finished = True
+                        yield chunk
+
+                try:
+                    if anthropic:
+                        for event, payload in openai_sse_to_anthropic_events(
+                                iter_chunks()):
+                            self.wfile.write(
+                                f"event: {event}\ndata: "
+                                f"{json.dumps(payload)}\n\n".encode())
+                    else:
+                        for chunk in iter_chunks():
+                            self.wfile.write(
+                                f"data: {json.dumps(chunk)}\n\n".encode())
+                        self.wfile.write(b"data: [DONE]\n\n")
+                except Exception:
+                    # client disconnect or upstream stall mid-stream: the
+                    # SSE headers are already on the wire — stop writing,
+                    # never emit a second HTTP response into the body
+                    aborted = True
+                finally:
+                    upstream.close()
+
+                latency_ms = (time.perf_counter() - t0) * 1e3
+                if aborted or not finished:
+                    # truncated stream: never cache, record failure
+                    server.router.record_feedback(route, success=False,
+                                                  latency_ms=latency_ms,
+                                                  ttft_ms=ttft_ms)
+                    return
+                # assemble final text for cache/feedback (cache-on-complete)
+                text = "".join(
+                    (c.get("choices") or [{}])[0].get("delta", {})
+                    .get("content") or "" for c in chunks)
+                usage = next((c.get("usage") for c in reversed(chunks)
+                              if c.get("usage")), {})
+                final = {"choices": [{"message": {
+                    "role": "assistant", "content": text},
+                    "finish_reason": "stop"}], "usage": usage or {}}
+                server.router.process_response(route, final)
+                server.router.record_feedback(route, success=True,
+                                              latency_ms=latency_ms,
+                                              ttft_ms=ttft_ms)
 
             def _looper_chat(self, route, req_headers: Dict[str, str],
                              anthropic: bool) -> None:

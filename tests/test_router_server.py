@@ -99,6 +99,47 @@ class TestChatCompletions:
             assert e.code == 400
 
 
+class TestStreaming:
+    def test_sse_relay(self, stack):
+        server, _ = stack
+        req = urllib.request.Request(
+            server.url + "/v1/chat/completions",
+            data=json.dumps(chat("this is urgent asap", stream=True)).encode(),
+            method="POST")
+        req.add_header("content-type", "application/json")
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.headers["content-type"].startswith("text/event-stream")
+            assert resp.headers.get(H.DECISION) == "urgent_route"
+            raw = resp.read().decode()
+        frames = [l[5:].strip() for l in raw.splitlines()
+                  if l.startswith("data:")]
+        assert frames[-1] == "[DONE]"
+        text = "".join(
+            json.loads(f)["choices"][0]["delta"].get("content") or ""
+            for f in frames[:-1])
+        echoed = json.loads(text)
+        assert echoed["model"] == "qwen3-8b"
+        assert echoed["stream"] is True
+
+    def test_anthropic_streaming_resynthesis(self, stack):
+        server, _ = stack
+        payload = {"model": "auto", "max_tokens": 50, "stream": True,
+                   "anthropic_version": "2023-06-01",
+                   "messages": [{"role": "user",
+                                 "content": "urgent asap help"}]}
+        req = urllib.request.Request(server.url + "/v1/messages",
+                                     data=json.dumps(payload).encode(),
+                                     method="POST")
+        req.add_header("content-type", "application/json")
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            raw = resp.read().decode()
+        events = [l.split(":", 1)[1].strip() for l in raw.splitlines()
+                  if l.startswith("event:")]
+        assert events[0] == "message_start"
+        assert "content_block_delta" in events
+        assert events[-1] == "message_stop"
+
+
 class TestLooperEndToEnd:
     def test_fusion_route_executes_panel(self, stack):
         server, backend = stack

@@ -1,0 +1,154 @@
+"""Vector store + RAG plugin tests (reference: pkg/vectorstore chunking/
+hybrid search, req_filter_rag injection, memory plugin injection)."""
+
+import numpy as np
+import pytest
+
+from semantic_router_tpu.config import load_config, loads_config
+from semantic_router_tpu.memory import InMemoryMemoryStore
+from semantic_router_tpu.router import Router
+from semantic_router_tpu.vectorstore import (
+    InMemoryVectorStore,
+    VectorStoreManager,
+    chunk_text,
+    format_rag_context,
+)
+
+DOC = ("The router extracts signals from requests. Signals feed the "
+       "decision engine. The decision engine selects a model. "
+       "Quantum tunneling is unrelated. So are bananas entirely. "
+       "Model selection supports thirteen algorithms. Elo ratings update "
+       "from pairwise feedback. The cache stores semantic embeddings.")
+
+
+def toy_embed(dim=32):
+    import hashlib
+
+    def fn(text):
+        v = np.zeros(dim, np.float32)
+        for w in text.lower().split():
+            h = int.from_bytes(hashlib.md5(w.encode()).digest()[:4], "little")
+            v[h % dim] += 1.0
+        n = np.linalg.norm(v)
+        return v / n if n else v
+
+    return fn
+
+
+class TestChunking:
+    def test_windows_with_overlap(self):
+        chunks = chunk_text(DOC, chunk_sentences=3, overlap_sentences=1)
+        assert len(chunks) >= 3
+        # overlap: last sentence of chunk N reappears in chunk N+1
+        assert chunks[0].split(". ")[-1].rstrip(".") in chunks[1]
+
+    def test_empty(self):
+        assert chunk_text("") == []
+
+
+class TestStore:
+    def test_ingest_search_hybrid(self):
+        store = InMemoryVectorStore(toy_embed())
+        doc = store.ingest("guide", DOC, metadata={"source": "guide.md"})
+        assert store.stats()["chunks"] >= 2
+        hits = store.search("how does the decision engine select a model")
+        assert hits
+        assert "decision engine" in hits[0].chunk.text.lower()
+        assert hits[0].vector_score > 0
+
+    def test_keyword_only_store(self):
+        store = InMemoryVectorStore(embed_fn=None)
+        store.ingest("guide", DOC)
+        hits = store.search("elo ratings pairwise")
+        assert hits and "Elo ratings" in hits[0].chunk.text
+
+    def test_delete_document(self):
+        store = InMemoryVectorStore(toy_embed())
+        doc = store.ingest("d", DOC)
+        assert store.delete_document(doc.id)
+        assert store.stats() == {"documents": 0, "chunks": 0}
+        assert store.search("anything") == []
+
+    def test_manager(self):
+        mgr = VectorStoreManager(toy_embed())
+        mgr.create("kb1")
+        mgr.get_or_create("kb2")
+        assert mgr.list() == ["kb1", "kb2"]
+        with pytest.raises(ValueError):
+            mgr.create("kb1")
+        assert mgr.delete("kb1")
+
+    def test_format_context_caps_chars(self):
+        store = InMemoryVectorStore(toy_embed())
+        store.ingest("d", DOC, metadata={"source": "guide.md"})
+        hits = store.search("decision engine", top_k=10)
+        ctx = format_rag_context(hits, max_chars=100)
+        assert ctx.startswith("Relevant context:")
+        assert "guide.md" in ctx
+
+
+RAG_CONFIG = """
+default_model: m1
+routing:
+  modelCards: [{name: m1}]
+  signals:
+    keywords:
+      - {name: docs_kw, method: exact, keywords: ["decision engine"]}
+  decisions:
+    - name: rag_route
+      priority: 10
+      rules:
+        operator: OR
+        conditions: [{type: keyword, name: docs_kw}]
+      modelRefs: [{model: m1}]
+      algorithm: {type: static}
+      plugins:
+        - type: rag
+          configuration: {enabled: true, store: docs, top_k: 2}
+        - type: memory
+          configuration: {enabled: true, retrieval_limit: 3, auto_store: true}
+"""
+
+
+class TestRAGPlugin:
+    def test_context_injected(self):
+        cfg = loads_config(RAG_CONFIG)
+        router = Router(cfg, engine=None)
+        try:
+            mgr = VectorStoreManager(toy_embed())
+            mgr.get_or_create("docs").ingest(
+                "guide", DOC, metadata={"source": "guide.md"})
+            router.vectorstores = mgr
+            res = router.route({"messages": [
+                {"role": "user",
+                 "content": "explain the decision engine selection"}]})
+            assert res.kind == "route"
+            assert res.headers.get("x-vsr-rag-chunks")
+            first = res.body["messages"][0]
+            assert first["role"] == "system"
+            assert "Relevant context" in first["content"]
+        finally:
+            router.shutdown()
+
+    def test_memory_injection_and_autostore(self):
+        cfg = loads_config(RAG_CONFIG)
+        router = Router(cfg, engine=None)
+        try:
+            store = InMemoryMemoryStore()
+            store.remember("u1", "prefers the decision engine explained "
+                                 "with diagrams")
+            router.memory_store = store
+            body = {"messages": [
+                {"role": "user",
+                 "content": "my name is Carol. explain the decision engine"}],
+                "user": "u1"}
+            res = router.route(body, headers={"x-authz-user-id": "u1"})
+            assert res.headers.get("x-vsr-memories-used") == "1"
+            assert "Known about this user" in res.body["messages"][0]["content"]
+            # auto-store on response extracts the name fact
+            router.process_response(res, {"choices": [{"message": {
+                "role": "assistant", "content": "sure!"}}]})
+            texts = " | ".join(i.text for i in store.list("u1"))
+            assert "name: Carol" in texts
+        finally:
+            router.shutdown()
