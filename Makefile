@@ -1,0 +1,29 @@
+PY ?= python
+
+.PHONY: test test-fast native bench perf perf-record serve-mock clean
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-fast:
+	$(PY) -m pytest tests/ -q -m "not slow"
+
+native:
+	$(PY) -m semantic_router_tpu.native.build
+
+bench:
+	$(PY) bench.py
+
+perf:
+	$(PY) perf/benchmarks.py --compare
+
+perf-record:
+	$(PY) perf/benchmarks.py --record
+
+serve-mock:
+	$(PY) -m semantic_router_tpu serve \
+	  --config tests/fixtures/router_config.yaml --mock-models --port 8801
+
+clean:
+	rm -f semantic_router_tpu/native/_lexical.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

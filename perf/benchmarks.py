@@ -1,0 +1,176 @@
+"""Microbenchmark harness with baseline gating.
+
+Capability parity with the reference's perf/ harness (Go testing.B
+benchmarks + baseline JSONs + CI regression gate, perf/README.md:1-60;
+reference numbers e.g. decision eval 12.7-18.8 µs/op,
+perf/testdata/baselines/decision.json; header manipulation 731 ns/op).
+
+Usage:
+  python perf/benchmarks.py                 # run, print JSON
+  python perf/benchmarks.py --record        # write baselines.json
+  python perf/benchmarks.py --compare       # gate vs baselines.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Callable, Dict
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), "baselines.json")
+REGRESSION_FACTOR = 1.6  # fail when >60% slower than baseline
+
+
+def bench(fn: Callable[[], None], min_time_s: float = 0.3,
+          warmup: int = 20) -> float:
+    """Returns µs/op (median-of-3 batched timing)."""
+    for _ in range(warmup):
+        fn()
+    # calibrate
+    t0 = time.perf_counter()
+    fn()
+    per_call = time.perf_counter() - t0
+    n = max(1, int(min_time_s / max(per_call, 1e-7) / 3))
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        samples.append((time.perf_counter() - t0) / n)
+    return sorted(samples)[1] * 1e6
+
+
+def build_benchmarks() -> Dict[str, Callable[[], float]]:
+    from semantic_router_tpu.config import load_config
+    from semantic_router_tpu.decision import DecisionEngine, SignalMatches
+    from semantic_router_tpu.decision.projections import ProjectionEvaluator
+    from semantic_router_tpu.router import headers as H
+    from semantic_router_tpu.signals import (
+        KeywordSignal,
+        Message,
+        RequestContext,
+        build_heuristic_dispatcher,
+    )
+
+    fixture = os.path.join(os.path.dirname(__file__), "..", "tests",
+                           "fixtures", "router_config.yaml")
+    cfg = load_config(fixture)
+    engine = DecisionEngine(cfg.decisions, cfg.strategy)
+    sm = SignalMatches()
+    sm.add("domain", "computer science", 0.92)
+    sm.add("complexity", "needs_reasoning:hard", 0.81)
+    sm.add("keyword", "code_keywords", 1.0)
+    sm.add("language", "en", 0.6)
+
+    dispatcher = build_heuristic_dispatcher(cfg)
+    ctx = RequestContext(messages=[Message(
+        "user", "URGENT: please debug this broken function asap, "
+                "the algorithm crashes under load")])
+    kw = KeywordSignal(cfg.signals.keywords)
+    projections = ProjectionEvaluator(cfg.projections)
+
+    def decision_eval():
+        engine.evaluate(sm)
+
+    def signal_dispatch():
+        dispatcher.evaluate(ctx)
+
+    def keyword_signal():
+        kw.evaluate(ctx)
+
+    def projection_eval():
+        local = SignalMatches()
+        local.add("embedding", "technical_support", 0.9)
+        local.add("complexity", "needs_reasoning:hard", 1.0)
+        projections.evaluate(local)
+
+    def header_build():
+        H.decision_headers("cs_reasoning_route", "qwen3-32b",
+                           category="computer science", use_reasoning=True,
+                           matched_rules=["domain:computer science"])
+
+    # semantic cache lookup over 1k entries (N16/ANN hot path)
+    import numpy as np
+
+    from semantic_router_tpu.cache import InMemorySemanticCache
+
+    rng = np.random.default_rng(0)
+    dim = 64
+    table = {f"q{i}": rng.standard_normal(dim).astype(np.float32)
+             for i in range(1000)}
+
+    def embed(text):
+        return table.get(text, rng.standard_normal(dim).astype(np.float32))
+
+    cache = InMemorySemanticCache(embed, similarity_threshold=0.99,
+                                  max_entries=2000)
+    for q in table:
+        cache.add(q, "resp")
+
+    def cache_lookup():
+        cache.find_similar("q500")
+
+    benches = {
+        "decision_eval": lambda: bench(decision_eval),
+        "signal_dispatch_full": lambda: bench(signal_dispatch,
+                                              min_time_s=0.5),
+        "keyword_signal": lambda: bench(keyword_signal),
+        "projection_eval": lambda: bench(projection_eval),
+        "header_build": lambda: bench(header_build),
+        "cache_exact_lookup": lambda: bench(cache_lookup),
+    }
+    return benches
+
+
+def run() -> Dict[str, float]:
+    results = {}
+    for name, runner in build_benchmarks().items():
+        results[name] = round(runner(), 3)
+    return results
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--record", action="store_true",
+                    help="write results as the new baseline")
+    ap.add_argument("--compare", action="store_true",
+                    help="gate against baselines.json")
+    args = ap.parse_args()
+
+    results = run()
+    print(json.dumps({"unit": "us/op", "results": results}, indent=2))
+
+    if args.record:
+        with open(BASELINE_PATH, "w") as f:
+            json.dump(results, f, indent=2)
+        print(f"recorded baselines to {BASELINE_PATH}", file=sys.stderr)
+        return 0
+
+    if args.compare:
+        if not os.path.exists(BASELINE_PATH):
+            print("no baselines recorded; run --record first",
+                  file=sys.stderr)
+            return 1
+        with open(BASELINE_PATH) as f:
+            baseline = json.load(f)
+        failures = []
+        for name, value in results.items():
+            base = baseline.get(name)
+            if base and value > base * REGRESSION_FACTOR:
+                failures.append(f"{name}: {value:.1f}µs vs baseline "
+                                f"{base:.1f}µs (> {REGRESSION_FACTOR}x)")
+        if failures:
+            print("PERF REGRESSIONS:\n" + "\n".join(failures),
+                  file=sys.stderr)
+            return 1
+        print("perf gate passed", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,59 @@
+"""CLI: python -m semantic_router_tpu serve --config config.yaml
+
+The reference's `vllm-sr` CLI + cmd/main.go role: serve the router, or
+validate a config.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="semantic_router_tpu")
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    serve_p = sub.add_parser("serve", help="run the router server")
+    serve_p.add_argument("--config", required=True)
+    serve_p.add_argument("--port", type=int, default=8801)
+    serve_p.add_argument("--backend", default="",
+                         help="default backend URL for models without "
+                              "backend_refs")
+    serve_p.add_argument("--mock-models", action="store_true",
+                         help="tiny random classifiers (model-free seam)")
+    serve_p.add_argument("--status-file", default="")
+    serve_p.add_argument("--no-watch", action="store_true")
+
+    val_p = sub.add_parser("validate", help="validate a config file")
+    val_p.add_argument("--config", required=True)
+
+    args = ap.parse_args(argv)
+
+    if args.command == "validate":
+        from .config import load_config, validate_config
+
+        try:
+            cfg = load_config(args.config)
+        except Exception as exc:
+            print(json.dumps({"valid": False, "error": str(exc)}))
+            return 1
+        warnings = [str(e) for e in validate_config(cfg) if not e.fatal]
+        print(json.dumps({"valid": True, "warnings": warnings,
+                          "decisions": len(cfg.decisions),
+                          "models": len(cfg.model_cards),
+                          "signal_families": cfg.used_signal_types()}))
+        return 0
+
+    from .runtime.bootstrap import serve
+
+    serve(args.config, port=args.port, default_backend=args.backend,
+          mock_models=args.mock_models,
+          status_path=args.status_file or None,
+          watch_config=not args.no_watch)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

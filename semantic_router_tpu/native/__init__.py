@@ -15,14 +15,18 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 _LIB: Optional[ctypes.CDLL] = None
+_LOAD_FAILED = False  # negative result cached: no per-call stat on hot paths
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "_lexical.so")
 
 
 def load() -> Optional[ctypes.CDLL]:
-    global _LIB
+    global _LIB, _LOAD_FAILED
     if _LIB is not None:
         return _LIB
+    if _LOAD_FAILED:
+        return None
     if not os.path.exists(_LIB_PATH):
+        _LOAD_FAILED = True
         return None
     lib = ctypes.CDLL(_LIB_PATH)
     lib.bm25_score.restype = ctypes.c_double

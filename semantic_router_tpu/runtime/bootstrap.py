@@ -1,0 +1,184 @@
+"""Runtime bootstrap: assemble and launch the full router.
+
+Parity with the reference's startup sequence (cmd/main.go:18 →
+runtime_bootstrap.go, SURVEY.md §3.1): load config → start status tracking
+early → initialize the TPU engine (classifier tasks from config) → build
+the router (+cache, vectorstores, memory, replay) → warm up → start the
+server with config hot-reload (file watch → rebuild → atomic swap,
+server_config_watch.go + RouterService.Swap).
+
+Model loading: checkpoint paths in cfg.classifier_models map task name →
+{checkpoint, tokenizer, kind, labels}; absent checkpoints leave the task
+unloaded (signals fail open) — the model-free mock seam is
+``--mock-models`` which installs the tiny random test engine.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from ..config import ConfigWatcher, RouterConfig, load_config, replace
+from ..observability.logging import component_event
+from ..replay import ReplayRecorder, ReplayStore
+from ..router.pipeline import Router
+from ..router.server import RouterServer
+from .startup import StartupTracker
+
+
+def build_engine(cfg: RouterConfig, mock: bool = False):
+    """Engine from config (or the mock seam). Returns None when no
+    classifier models are configured — the router then runs heuristics-only
+    (fail-open posture)."""
+    if mock:
+        from ..engine.testing import make_embedding_engine
+
+        return make_embedding_engine()
+    specs = cfg.classifier_models or {}
+    if not specs:
+        return None
+    import jax
+    import numpy as np
+
+    from ..engine.classify import InferenceEngine
+    from ..models.convert import modernbert_params_from_state_dict
+    from ..models.modernbert import (
+        ModernBertConfig,
+        ModernBertForSequenceClassification,
+        ModernBertForTokenClassification,
+    )
+    from ..models.embeddings import MmBertEmbeddingModel
+    from ..utils.tokenization import HFTokenizer
+
+    engine = InferenceEngine(cfg.engine)
+    for task, spec in specs.items():
+        path = spec.get("checkpoint", "")
+        if not path or not os.path.exists(path):
+            component_event("bootstrap", "model_missing", task=task,
+                            path=path, level="warning")
+            continue
+        from safetensors.numpy import load_file
+
+        state = load_file(os.path.join(path, "model.safetensors")) \
+            if os.path.isdir(path) else load_file(path)
+        import json
+
+        cfg_path = os.path.join(path, "config.json") if os.path.isdir(path) \
+            else os.path.join(os.path.dirname(path), "config.json")
+        with open(cfg_path) as f:
+            hf_cfg = json.load(f)
+        labels = spec.get("labels") or \
+            [hf_cfg.get("id2label", {}).get(str(i), str(i))
+             for i in range(len(hf_cfg.get("id2label", {})))]
+        mcfg = ModernBertConfig(
+            vocab_size=hf_cfg["vocab_size"],
+            hidden_size=hf_cfg["hidden_size"],
+            intermediate_size=hf_cfg["intermediate_size"],
+            num_hidden_layers=hf_cfg["num_hidden_layers"],
+            num_attention_heads=hf_cfg["num_attention_heads"],
+            max_position_embeddings=hf_cfg.get("max_position_embeddings",
+                                               8192),
+            rope_scaling=hf_cfg.get("rope_scaling"),
+            num_labels=max(len(labels), 2),
+            classifier_pooling=hf_cfg.get("classifier_pooling", "cls"),
+        )
+        kind = spec.get("kind", "sequence")
+        if kind == "embedding":
+            module = MmBertEmbeddingModel(mcfg)
+        elif kind == "token":
+            module = ModernBertForTokenClassification(mcfg)
+        else:
+            module = ModernBertForSequenceClassification(mcfg)
+        params = modernbert_params_from_state_dict(state)
+        tok = HFTokenizer.from_pretrained_dir(
+            spec.get("tokenizer", path if os.path.isdir(path) else
+                     os.path.dirname(path)))
+        engine.register_task(task, kind, module, params, tok, labels,
+                             max_seq_len=int(spec.get("max_seq_len", 0)))
+        component_event("bootstrap", "model_loaded", task=task, kind=kind)
+    return engine
+
+
+def build_router(cfg: RouterConfig, engine=None,
+                 replay_path: Optional[str] = None) -> Router:
+    router = Router(cfg, engine=engine)
+    from ..memory import InMemoryMemoryStore
+    from ..vectorstore import VectorStoreManager
+
+    embed_fn = None
+    if engine is not None and engine.has_task("embedding"):
+        embed_fn = lambda text: engine.embed("embedding", [text])[0]
+    router.memory_store = InMemoryMemoryStore(embed_fn)
+    router.vectorstores = VectorStoreManager(embed_fn)
+
+    replay_cfg = cfg.router_replay or {}
+    if replay_cfg.get("enabled", True):
+        store = ReplayStore(
+            max_records=int(replay_cfg.get("max_records", 10_000)),
+            path=replay_path or replay_cfg.get("path"))
+        router.replay_store = store
+        router.response_hooks.append(ReplayRecorder(
+            store,
+            capture_request_body=bool(
+                replay_cfg.get("capture_request_body", False)),
+            capture_response_body=bool(
+                replay_cfg.get("capture_response_body", False)),
+        ))
+    return router
+
+
+def serve(config_path: str, port: int = 8801,
+          default_backend: str = "", mock_models: bool = False,
+          status_path: Optional[str] = None,
+          watch_config: bool = True,
+          block: bool = True):
+    """Full startup sequence; returns (server, tracker) when block=False."""
+    tracker = StartupTracker(path=status_path)
+    tracker.advance("loading_config", config_path)
+    cfg = load_config(config_path)
+    replace(cfg)
+
+    tracker.advance("loading_models",
+                    "mock" if mock_models else
+                    f"{len(cfg.classifier_models or {})} configured")
+    engine = build_engine(cfg, mock=mock_models)
+
+    router = build_router(cfg, engine)
+    server = RouterServer(router, cfg, default_backend=default_backend,
+                          port=port)
+
+    tracker.advance("warming")
+    if engine is not None:
+        threading.Thread(target=engine.warmup, daemon=True,
+                         name="warmup").start()
+
+    watcher = None
+    if watch_config:
+        def on_reload(new_cfg: RouterConfig) -> None:
+            # atomic swap: rebuild routing state, keep engine + server
+            # (RouterService.Swap, server.go:213)
+            new_router = build_router(new_cfg, engine)
+            old = server.router
+            server.router = new_router
+            server.cfg = new_cfg
+            old.shutdown()
+            component_event("bootstrap", "config_reloaded")
+
+        watcher = ConfigWatcher(config_path, on_reload)
+        watcher.start()
+    server.watcher = watcher
+
+    server.start()
+    tracker.advance("ready", f"listening on :{server.port}")
+    component_event("bootstrap", "ready", port=server.port)
+    if block:
+        try:
+            threading.Event().wait()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            if watcher:
+                watcher.stop()
+            server.stop()
+    return server, tracker

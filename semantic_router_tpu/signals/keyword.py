@@ -17,7 +17,6 @@ import re
 import time
 import unicodedata
 from dataclasses import dataclass
-from difflib import SequenceMatcher
 from typing import Dict, List, Sequence, Tuple
 
 from ..config.schema import KeywordRule
@@ -49,10 +48,37 @@ def _norm(text: str, case_sensitive: bool) -> str:
     return text if case_sensitive else text.lower()
 
 
+def _lcs_ratio_py(a: str, b: str) -> float:
+    """2·LCS/(|a|+|b|) percent — the indel ratio (rapidfuzz `ratio` family,
+    which is what the reference's fuzzy matching uses). Pure-Python
+    fallback; the native kernel computes the identical metric."""
+    la, lb = len(a), len(b)
+    if la == 0 and lb == 0:
+        return 100.0
+    if la == 0 or lb == 0:
+        return 0.0
+    prev = [0] * (lb + 1)
+    for i in range(1, la + 1):
+        cur = [0] * (lb + 1)
+        ca = a[i - 1]
+        for j in range(1, lb + 1):
+            if ca == b[j - 1]:
+                cur[j] = prev[j - 1] + 1
+            else:
+                cur[j] = prev[j] if prev[j] >= cur[j - 1] else cur[j - 1]
+        prev = cur
+    return 200.0 * prev[lb] / (la + lb)
+
+
 def fuzzy_ratio(a: str, b: str) -> float:
-    """Similarity percent in [0,100] (difflib ratio; the reference uses a
-    Levenshtein-family percent score)."""
-    return 100.0 * SequenceMatcher(None, a, b).ratio()
+    """Similarity percent in [0,100]: LCS-indel ratio. The native kernel
+    and the Python fallback compute the SAME metric, so fuzzy thresholds
+    route identically whether or not _lexical.so is built."""
+    if a.isascii() and b.isascii():
+        native = _native()
+        if native is not None:
+            return native.fuzzy_ratio(a, b)
+    return _lcs_ratio_py(a, b)
 
 
 def fuzzy_partial_ratio(needle: str, haystack: str) -> float:

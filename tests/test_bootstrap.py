@@ -1,0 +1,77 @@
+"""Bootstrap/CLI tests: full startup sequence, hot reload swap, validate
+command (reference: cmd/main.go flow + server_config_watch.go)."""
+
+import json
+import os
+import shutil
+import time
+import urllib.request
+
+import pytest
+
+from semantic_router_tpu.__main__ import main as cli_main
+from semantic_router_tpu.runtime.bootstrap import serve
+
+
+def test_validate_command(fixture_config_path, capsys):
+    rc = cli_main(["validate", "--config", fixture_config_path])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["valid"] is True
+    assert out["decisions"] == 8
+
+
+def test_validate_rejects_bad(tmp_path, capsys):
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("routing:\n  decisions:\n    - name: d\n      rules:\n"
+                   "        operator: OR\n"
+                   "        conditions: [{type: domain, name: ghost}]\n")
+    rc = cli_main(["validate", "--config", str(bad)])
+    assert rc == 1
+    assert json.loads(capsys.readouterr().out)["valid"] is False
+
+
+@pytest.mark.slow
+def test_serve_and_hot_reload(fixture_config_path, tmp_path):
+    from semantic_router_tpu.router import MockVLLMServer
+
+    backend = MockVLLMServer().start()
+    cfg_path = str(tmp_path / "cfg.yaml")
+    shutil.copy(fixture_config_path, cfg_path)
+    status_path = str(tmp_path / "status.json")
+
+    server, tracker = serve(cfg_path, port=0,
+                            default_backend=backend.url,
+                            mock_models=True, status_path=status_path,
+                            watch_config=True, block=False)
+    try:
+        assert tracker.ready
+        assert json.load(open(status_path))["ready"] is True
+
+        def chat(text):
+            req = urllib.request.Request(
+                server.url + "/v1/chat/completions",
+                data=json.dumps({"model": "auto", "messages": [
+                    {"role": "user", "content": text}]}).encode(),
+                method="POST")
+            req.add_header("content-type", "application/json")
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, dict(resp.headers)
+
+        status, headers = chat("this is urgent asap")
+        assert status == 200
+        assert headers["x-vsr-selected-decision"] == "urgent_route"
+
+        # hot reload: swap config with one that renames the decision
+        text = open(cfg_path).read().replace("urgent_route",
+                                             "renamed_urgent")
+        open(cfg_path, "w").write(text)
+        os.utime(cfg_path, (time.time() + 5, time.time() + 5))
+        assert server.watcher.poll_once()
+        status, headers = chat("this is urgent asap")
+        assert headers["x-vsr-selected-decision"] == "renamed_urgent"
+    finally:
+        if server.watcher:
+            server.watcher.stop()
+        server.stop()
+        backend.stop()

@@ -18,6 +18,7 @@ def built():
         except Exception as e:
             pytest.skip(f"native toolchain unavailable: {e}")
         native._LIB = None  # force reload
+        native._LOAD_FAILED = False
     assert native.available()
 
 
@@ -57,15 +58,21 @@ class TestNgram:
 
 
 class TestFuzzy:
-    def test_close_to_difflib(self):
-        from semantic_router_tpu.signals.keyword import fuzzy_ratio as py_fr
+    def test_exactly_matches_python_lcs_oracle(self):
+        # the pure-Python LCS ratio is the canonical metric; the native
+        # kernel must agree EXACTLY (routing must not depend on the .so)
+        from semantic_router_tpu.signals.keyword import _lcs_ratio_py
 
+        rng = __import__("random").Random(0)
+        alphabet = "abcd efg"
         pairs = [("credit card", "credit-card"), ("password", "passw0rd"),
-                 ("abc", "xyz"), ("same", "same")]
+                 ("abc", "xyz"), ("same", "same"), ("", ""), ("a", "")]
+        pairs += [("".join(rng.choices(alphabet, k=rng.randint(0, 16))),
+                   "".join(rng.choices(alphabet, k=rng.randint(0, 16))))
+                  for _ in range(200)]
         for a, b in pairs:
-            c = native.fuzzy_ratio(a, b)
-            p = py_fr(a, b)
-            assert c == pytest.approx(p, abs=2.0), (a, b)  # same family
+            assert native.fuzzy_ratio(a, b) == \
+                pytest.approx(_lcs_ratio_py(a, b), abs=1e-9), (a, b)
 
 
 class TestDistances:
