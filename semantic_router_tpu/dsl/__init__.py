@@ -1,0 +1,11 @@
+from .compiler import (
+    DSLCompileError,
+    compile_dsl,
+    compile_program,
+    decompile,
+    emit_yaml,
+)
+from .parser import DSLSyntaxError, parse
+
+__all__ = ["DSLCompileError", "DSLSyntaxError", "compile_dsl",
+           "compile_program", "decompile", "emit_yaml", "parse"]

@@ -101,14 +101,29 @@ def build_engine(cfg: RouterConfig, mock: bool = False):
 
 
 def build_router(cfg: RouterConfig, engine=None,
-                 replay_path: Optional[str] = None) -> Router:
-    router = Router(cfg, engine=engine)
+                 replay_path: Optional[str] = None,
+                 carry_from: Optional[Router] = None) -> Router:
+    """Build a router; ``carry_from`` transplants the stateful subsystems
+    (semantic cache, memory, vectorstores, replay store/hooks) from a
+    previous router so a config hot-reload keeps accumulated state
+    (RouterService.Swap semantics — swap routing logic, keep state)."""
+    router = Router(cfg, engine=engine,
+                    cache=carry_from.cache if carry_from is not None else None)
     from ..memory import InMemoryMemoryStore
     from ..vectorstore import VectorStoreManager
 
     embed_fn = None
     if engine is not None and engine.has_task("embedding"):
         embed_fn = lambda text: engine.embed("embedding", [text])[0]
+
+    if carry_from is not None:
+        router.memory_store = carry_from.memory_store
+        router.vectorstores = carry_from.vectorstores
+        router.response_hooks = list(carry_from.response_hooks)
+        if hasattr(carry_from, "replay_store"):
+            router.replay_store = carry_from.replay_store
+        return router
+
     router.memory_store = InMemoryMemoryStore(embed_fn)
     router.vectorstores = VectorStoreManager(embed_fn)
 
@@ -135,18 +150,24 @@ def serve(config_path: str, port: int = 8801,
           block: bool = True):
     """Full startup sequence; returns (server, tracker) when block=False."""
     tracker = StartupTracker(path=status_path)
-    tracker.advance("loading_config", config_path)
-    cfg = load_config(config_path)
-    replace(cfg)
+    try:
+        tracker.advance("loading_config", config_path)
+        cfg = load_config(config_path)
+        replace(cfg)
 
-    tracker.advance("loading_models",
-                    "mock" if mock_models else
-                    f"{len(cfg.classifier_models or {})} configured")
-    engine = build_engine(cfg, mock=mock_models)
+        tracker.advance("loading_models",
+                        "mock" if mock_models else
+                        f"{len(cfg.classifier_models or {})} configured")
+        engine = build_engine(cfg, mock=mock_models)
 
-    router = build_router(cfg, engine)
-    server = RouterServer(router, cfg, default_backend=default_backend,
-                          port=port)
+        router = build_router(cfg, engine)
+        server = RouterServer(router, cfg, default_backend=default_backend,
+                              port=port)
+    except Exception as exc:
+        # explicit failStartup (runtime_bootstrap.go:170): readiness
+        # monitors must see failed=true, not eternally-starting
+        tracker.fail(f"{type(exc).__name__}: {exc}")
+        raise
 
     tracker.advance("warming")
     if engine is not None:
@@ -156,13 +177,15 @@ def serve(config_path: str, port: int = 8801,
     watcher = None
     if watch_config:
         def on_reload(new_cfg: RouterConfig) -> None:
-            # atomic swap: rebuild routing state, keep engine + server
-            # (RouterService.Swap, server.go:213)
-            new_router = build_router(new_cfg, engine)
+            # atomic swap: rebuild routing logic, carry stateful subsystems,
+            # keep engine + server (RouterService.Swap, server.go:213)
             old = server.router
+            new_router = build_router(new_cfg, engine, carry_from=old)
             server.router = new_router
             server.cfg = new_cfg
-            old.shutdown()
+            # grace period before tearing down the old dispatcher so
+            # requests already inside old.route() finish their fan-out
+            threading.Timer(30.0, old.dispatcher.shutdown).start()
             component_event("bootstrap", "config_reloaded")
 
         watcher = ConfigWatcher(config_path, on_reload)

@@ -1,0 +1,145 @@
+"""DSL parser/compiler/decompiler tests (reference: pkg/dsl pipeline —
+parse → validate → compile → RouterConfig; decompile round trip)."""
+
+import pytest
+
+from semantic_router_tpu.dsl import (
+    DSLCompileError,
+    DSLSyntaxError,
+    compile_dsl,
+    decompile,
+    emit_yaml,
+    parse,
+)
+
+PROGRAM = '''
+# models
+model "qwen3-8b" { param_size: "8B" quality_score: 0.83 }
+model "qwen3-32b" { param_size: "32B" quality_score: 0.96
+                    loras: [{ name: "cs-expert" }] }
+
+signal keyword urgent_kw { method: ngram keywords: ["urgent", "asap"]
+                           ngram_threshold: 0.4 }
+signal domain "computer science"
+signal domain business
+signal complexity needs_reasoning {
+    threshold: 0.6
+    hard: { candidates: ["solve step by step"] }
+    easy: { candidates: ["answer briefly"] }
+}
+signal authz admin { role: admin subjects: [{ kind: Group name: admins }] }
+
+decision cs_route priority 200 {
+    when domain("computer science") and complexity("needs_reasoning:hard")
+    route to "qwen3-32b" weight 0.7 reasoning high lora "cs-expert"
+    route to "qwen3-8b" weight 0.3
+    algorithm elo { exploration: 0.1 }
+    plugin semantic-cache { similarity_threshold: 0.85 }
+}
+
+decision urgent_route priority 150 {
+    when urgent_kw_ref or (domain(business) and not authz(admin))
+    route to "qwen3-8b"
+    algorithm static
+}
+
+default model "qwen3-8b"
+'''.replace("urgent_kw_ref", "keyword(urgent_kw)")
+
+
+class TestCompile:
+    def test_full_program(self):
+        cfg = compile_dsl(PROGRAM)
+        assert [m.name for m in cfg.model_cards] == ["qwen3-8b", "qwen3-32b"]
+        assert cfg.default_model == "qwen3-8b"
+        assert len(cfg.decisions) == 2
+
+        cs = cfg.decisions[0]
+        assert cs.name == "cs_route" and cs.priority == 200
+        leaves = {(l.signal_type, l.name) for l in cs.rules.leaves()}
+        assert leaves == {("domain", "computer science"),
+                          ("complexity", "needs_reasoning:hard")}
+        assert cs.model_refs[0].model == "qwen3-32b"
+        assert cs.model_refs[0].lora_name == "cs-expert"
+        assert cs.model_refs[0].use_reasoning
+        assert cs.algorithm["type"] == "elo"
+        assert cs.algorithm["elo"]["exploration"] == 0.1
+        assert cs.plugin("semantic-cache").configuration[
+            "similarity_threshold"] == 0.85
+
+        urgent = cfg.decisions[1]
+        tree = urgent.rules
+        assert tree.operator == "OR"
+        assert tree.conditions[1].operator == "AND"
+        assert tree.conditions[1].conditions[1].operator == "NOT"
+
+    def test_compiled_config_routes(self):
+        from semantic_router_tpu.decision import DecisionEngine, SignalMatches
+
+        cfg = compile_dsl(PROGRAM)
+        eng = DecisionEngine(cfg.decisions, cfg.strategy)
+        sm = SignalMatches()
+        sm.add("domain", "computer science", 0.9)
+        sm.add("complexity", "needs_reasoning:hard", 0.8)
+        assert eng.evaluate(sm).decision.name == "cs_route"
+
+    def test_unknown_signal_reference_fails_compile(self):
+        bad = '''
+signal domain business
+decision d priority 1 {
+    when domain(nonexistent)
+    route to "m1"
+    algorithm static
+}
+model "m1"
+'''
+        with pytest.raises(DSLCompileError, match="nonexistent"):
+            compile_dsl(bad)
+
+    def test_unknown_family_fails(self):
+        with pytest.raises(DSLCompileError, match="unknown signal family"):
+            compile_dsl('signal wibble x\n')
+
+    def test_syntax_error_reports_line(self):
+        with pytest.raises(DSLSyntaxError, match="line 3"):
+            parse('model "a"\nmodel "b"\ndecision }')
+
+    def test_missing_when_fails(self):
+        bad = 'model "m1"\ndecision d { route to "m1"\n algorithm static }'
+        with pytest.raises(DSLCompileError, match="no `when`"):
+            compile_dsl(bad)
+
+
+class TestRoundTrip:
+    def test_decompile_recompiles_identically(self):
+        cfg = compile_dsl(PROGRAM)
+        text = decompile(cfg)
+        cfg2 = compile_dsl(text)
+        # routing semantics survive the round trip
+        assert [d.name for d in cfg2.decisions] == \
+            [d.name for d in cfg.decisions]
+        for d1, d2 in zip(cfg.decisions, cfg2.decisions):
+            assert d1.priority == d2.priority
+            assert {(l.signal_type, l.name) for l in d1.rules.leaves()} == \
+                {(l.signal_type, l.name) for l in d2.rules.leaves()}
+            assert [(r.model, r.weight, r.lora_name)
+                    for r in d1.model_refs] == \
+                [(r.model, r.weight, r.lora_name) for r in d2.model_refs]
+            assert d1.algorithm.get("type") == d2.algorithm.get("type")
+        assert cfg2.default_model == cfg.default_model
+
+    def test_yaml_fixture_decompiles(self, router_config):
+        text = decompile(router_config)
+        assert "decision urgent_route" in text
+        assert "when " in text
+        cfg2 = compile_dsl(text)
+        assert [d.name for d in cfg2.decisions] == \
+            [d.name for d in router_config.decisions]
+
+    def test_emit_yaml(self):
+        cfg = compile_dsl(PROGRAM)
+        text = emit_yaml(cfg)
+        import yaml
+
+        data = yaml.safe_load(text)
+        assert data["routing"]["decisions"][0]["name"] == "cs_route"
