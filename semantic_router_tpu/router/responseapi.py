@@ -1,0 +1,179 @@
+"""OpenAI Responses API ⇄ ChatCompletions translation + response store.
+
+Capability parity with pkg/responseapi (1.9k LoC; wired at
+extproc/req_filter_response_api.go:527) and pkg/responsestore (2.3k):
+inbound `/v1/responses` requests translate to the internal ChatCompletions
+shape for the signal/decision pipeline; completions translate back to
+Response objects; `previous_response_id` threads stored conversation
+history into the new request; responses persist in a store (in-memory here;
+Redis/Redis-Cluster behind the same protocol in deployment images).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class StoredResponse:
+    id: str
+    model: str
+    messages: List[dict]  # full conversation incl. the assistant turn
+    created_t: float = field(default_factory=time.time)
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+
+class ResponseStore:
+    """In-memory response/conversation persistence (pkg/responsestore)."""
+
+    def __init__(self, max_entries: int = 10_000,
+                 ttl_seconds: float = 86_400.0) -> None:
+        self.max_entries = max_entries
+        self.ttl_seconds = ttl_seconds
+        self._items: Dict[str, StoredResponse] = {}
+        self._lock = threading.Lock()
+
+    def put(self, resp: StoredResponse) -> None:
+        with self._lock:
+            # insertion order == age (created_t monotonic): O(1) eviction
+            while len(self._items) >= self.max_entries:
+                self._items.pop(next(iter(self._items)))
+            self._items[resp.id] = resp
+
+    def get(self, response_id: str) -> Optional[StoredResponse]:
+        with self._lock:
+            resp = self._items.get(response_id)
+            if resp and time.time() - resp.created_t > self.ttl_seconds:
+                del self._items[response_id]
+                return None
+            return resp
+
+    def delete(self, response_id: str) -> bool:
+        with self._lock:
+            return self._items.pop(response_id, None) is not None
+
+
+def _input_to_messages(inp: Any) -> List[dict]:
+    """Responses API `input` (string | item list) → chat messages."""
+    if isinstance(inp, str):
+        return [{"role": "user", "content": inp}]
+    messages: List[dict] = []
+    for item in inp or []:
+        itype = item.get("type", "message")
+        if itype == "message":
+            content = item.get("content", "")
+            if isinstance(content, list):
+                texts = [c.get("text", "") for c in content
+                         if c.get("type") in ("input_text", "output_text",
+                                              "text")]
+                content = "\n".join(texts)
+            messages.append({"role": item.get("role", "user"),
+                             "content": content})
+        elif itype == "function_call":
+            messages.append({"role": "assistant", "content": None,
+                             "tool_calls": [{
+                                 "id": item.get("call_id", ""),
+                                 "type": "function",
+                                 "function": {
+                                     "name": item.get("name", ""),
+                                     "arguments": item.get("arguments",
+                                                           "{}")}}]})
+        elif itype == "function_call_output":
+            messages.append({"role": "tool",
+                             "tool_call_id": item.get("call_id", ""),
+                             "content": item.get("output", "")})
+    return messages
+
+
+def responses_to_chat(body: Dict[str, Any],
+                      store: Optional[ResponseStore] = None
+                      ) -> Dict[str, Any]:
+    """Responses API request → ChatCompletions request. When
+    ``previous_response_id`` is set and found in the store, its conversation
+    prefixes the new input (the store interplay,
+    req_filter_response_api.go)."""
+    messages: List[dict] = []
+    if body.get("instructions"):
+        messages.append({"role": "system", "content": body["instructions"]})
+    prev_id = body.get("previous_response_id")
+    if prev_id and store is not None:
+        prev = store.get(prev_id)
+        if prev is not None:
+            messages.extend(m for m in prev.messages
+                            if m.get("role") != "system")
+    messages.extend(_input_to_messages(body.get("input")))
+
+    out: Dict[str, Any] = {"model": body.get("model", ""),
+                           "messages": messages}
+    if body.get("max_output_tokens"):
+        out["max_tokens"] = body["max_output_tokens"]
+    # NOTE: `stream` is intentionally NOT forwarded — the Responses
+    # endpoint serves complete Response objects; streaming events are a
+    # round-2 item (the chat endpoint streams).
+    for k in ("temperature", "top_p", "user", "metadata"):
+        if k in body:
+            out[k] = body[k]
+    if body.get("tools"):
+        out["tools"] = [
+            {"type": "function",
+             "function": {"name": t.get("name", ""),
+                          "description": t.get("description", ""),
+                          "parameters": t.get("parameters", {})}}
+            if t.get("type") == "function" else t
+            for t in body["tools"]]
+    return out
+
+
+def chat_to_response(chat_resp: Dict[str, Any], request_body: Dict[str, Any],
+                     chat_request: Optional[Dict[str, Any]] = None,
+                     store: Optional[ResponseStore] = None) -> Dict[str, Any]:
+    """ChatCompletions response → Responses API response object; persists
+    the conversation when store=True on the request (the API default)."""
+    choice = (chat_resp.get("choices") or [{}])[0]
+    msg = choice.get("message") or {}
+    response_id = f"resp_{uuid.uuid4().hex[:24]}"
+    output: List[dict] = []
+    if msg.get("content"):
+        output.append({
+            "type": "message", "id": f"msg_{uuid.uuid4().hex[:16]}",
+            "role": "assistant", "status": "completed",
+            "content": [{"type": "output_text", "text": msg["content"],
+                         "annotations": []}]})
+    for tc in msg.get("tool_calls") or []:
+        fn = tc.get("function", {})
+        output.append({"type": "function_call",
+                       "call_id": tc.get("id", ""),
+                       "name": fn.get("name", ""),
+                       "arguments": fn.get("arguments", "{}"),
+                       "status": "completed"})
+    usage = chat_resp.get("usage") or {}
+    result = {
+        "id": response_id,
+        "object": "response",
+        "created_at": int(time.time()),
+        "model": chat_resp.get("model", request_body.get("model", "")),
+        "status": "completed",
+        "output": output,
+        "output_text": msg.get("content") or "",
+        "previous_response_id": request_body.get("previous_response_id"),
+        "usage": {"input_tokens": usage.get("prompt_tokens", 0),
+                  "output_tokens": usage.get("completion_tokens", 0),
+                  "total_tokens": usage.get("total_tokens", 0)},
+        "metadata": request_body.get("metadata") or {},
+    }
+    if store is not None and request_body.get("store", True):
+        conversation = list((chat_request or {}).get("messages", []))
+        if msg.get("content") or msg.get("tool_calls"):
+            conversation.append({"role": "assistant",
+                                 "content": msg.get("content") or "",
+                                 **({"tool_calls": msg["tool_calls"]}
+                                    if msg.get("tool_calls") else {})})
+        store.put(StoredResponse(id=response_id,
+                                 model=result["model"],
+                                 messages=conversation,
+                                 metadata=result["metadata"]))
+    return result

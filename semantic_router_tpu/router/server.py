@@ -95,6 +95,12 @@ class RouterServer:
         self.looper_pool = ThreadPoolExecutor(max_workers=16,
                                               thread_name_prefix="looper")
 
+        from .authz import CredentialResolver
+        from .responseapi import ResponseStore
+
+        self.credentials = CredentialResolver.from_config(cfg.authz)
+        self.response_store = ResponseStore()
+
         handler = self._make_handler()
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]
@@ -118,6 +124,17 @@ class RouterServer:
         self.router.shutdown()
 
     # ------------------------------------------------------------------
+
+    def _credential_headers(self, route, headers: Dict[str, str]
+                            ) -> Dict[str, str]:
+        """Per-user upstream credentials (appendCredentialHeaders role).
+        Identity headers only count when authz.trust_identity_headers is
+        set (see CredentialResolver). Raises PermissionError fail-closed."""
+        user_id = headers.get("x-authz-user-id", "")
+        groups = [g.strip() for g in
+                  headers.get("x-authz-user-groups", "").split(",")
+                  if g.strip()]
+        return self.credentials.headers_for(route.model, user_id, groups)
 
     def _forward(self, url: str, body: Dict[str, Any],
                  headers: Dict[str, str]) -> tuple[int, Dict[str, Any]]:
@@ -222,6 +239,8 @@ class RouterServer:
                         self._chat(body, anthropic=False)
                     elif path == "/v1/messages":
                         self._chat(body, anthropic=True)
+                    elif path == "/v1/responses":
+                        self._responses(body)
                     elif path.startswith("/api/v1/classify/"):
                         self._classify(path.rsplit("/", 1)[1], body)
                     elif path == "/api/v1/embeddings":
@@ -272,6 +291,14 @@ class RouterServer:
                 default_tracer.inject(trace_id, route.request_id[:16].ljust(16, "0"),
                                       fwd_headers)
                 fwd_headers.update(route.headers)
+                try:
+                    fwd_headers.update(
+                        server._credential_headers(route, headers))
+                except PermissionError as exc:
+                    self._json(403, {"error": {"message": str(exc),
+                                               "type": "authz_error"}},
+                               route.headers)
+                    return
 
                 if route.body.get("stream"):
                     self._stream_chat(route, backend, fwd_headers, anthropic)
@@ -291,6 +318,68 @@ class RouterServer:
                     if anthropic:
                         payload = openai_to_anthropic_response(payload)
                     self._json(200, payload, out_headers)
+                else:
+                    server.router.record_feedback(route, success=False,
+                                                  latency_ms=latency_ms)
+                    self._json(status, resp, route.headers)
+
+            def _responses(self, body: Dict[str, Any]) -> None:
+                """OpenAI Responses API endpoint: translate → route →
+                forward → translate back + persist (pkg/responseapi +
+                pkg/responsestore; req_filter_response_api.go:527)."""
+                from .responseapi import chat_to_response, responses_to_chat
+
+                headers = self._req_headers()
+                chat_body = responses_to_chat(body, server.response_store)
+                route = server.router.route(chat_body, headers)
+                if route.kind in ("blocked", "rate_limited", "cache_hit") \
+                        or route.response_body is not None:
+                    payload = route.response_body
+                    if route.status == 200 and payload \
+                            and "choices" in payload:
+                        payload = chat_to_response(
+                            payload, body, chat_request=route.body,
+                            store=server.response_store)
+                    self._json(route.status, payload, route.headers)
+                    return
+                # looper decisions execute multi-model strategies here too
+                if route.looper_algorithm and route.decision is not None \
+                        and headers.get(H.LOOPER, "").lower() not in \
+                        ("1", "true"):
+                    self._looper_chat(route, headers, anthropic=False,
+                                      responses_request=body)
+                    return
+                backend = server.resolver.resolve(route.model)
+                if not backend:
+                    self._json(502, {"error": {
+                        "message": f"no backend for model {route.model!r}",
+                        "type": "backend_error"}}, route.headers)
+                    return
+                fwd = dict(headers)
+                trace_id, _ = default_tracer.extract(headers)
+                default_tracer.inject(
+                    trace_id, route.request_id[:16].ljust(16, "0"), fwd)
+                fwd.update(route.headers)
+                try:
+                    fwd.update(server._credential_headers(route, headers))
+                except PermissionError as exc:
+                    self._json(403, {"error": {"message": str(exc),
+                                               "type": "authz_error"}},
+                               route.headers)
+                    return
+                t0 = time.perf_counter()
+                status, resp = server._forward(backend, route.body, fwd)
+                latency_ms = (time.perf_counter() - t0) * 1e3
+                if status == 200:
+                    processed = server.router.process_response(route, resp)
+                    server.router.record_feedback(route, success=True,
+                                                  latency_ms=latency_ms)
+                    out = chat_to_response(processed.body, body,
+                                           chat_request=route.body,
+                                           store=server.response_store)
+                    out_headers = dict(route.headers)
+                    out_headers.update(processed.headers)
+                    self._json(200, out, out_headers)
                 else:
                     server.router.record_feedback(route, success=False,
                                                   latency_ms=latency_ms)
@@ -415,7 +504,9 @@ class RouterServer:
                                               ttft_ms=ttft_ms)
 
             def _looper_chat(self, route, req_headers: Dict[str, str],
-                             anthropic: bool) -> None:
+                             anthropic: bool,
+                             responses_request: Optional[dict] = None
+                             ) -> None:
                 """Multi-model execution strategies (looper dispatch,
                 looper.go:123-129): the router becomes the client.
                 Caller credentials/trace headers forward to every fan-out
@@ -458,6 +549,12 @@ class RouterServer:
                 payload = processed.body
                 if anthropic:
                     payload = openai_to_anthropic_response(payload)
+                elif responses_request is not None:
+                    from .responseapi import chat_to_response
+
+                    payload = chat_to_response(
+                        payload, responses_request, chat_request=route.body,
+                        store=server.response_store)
                 self._json(200, payload, out_headers)
 
             def _classify(self, task: str, body: Dict[str, Any]) -> None:

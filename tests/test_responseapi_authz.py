@@ -1,0 +1,172 @@
+"""Responses API translation + store, credential resolution
+(reference: pkg/responseapi, pkg/responsestore, pkg/authz)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from semantic_router_tpu.router.authz import CredentialResolver
+from semantic_router_tpu.router.responseapi import (
+    ResponseStore,
+    StoredResponse,
+    chat_to_response,
+    responses_to_chat,
+)
+
+
+class TestResponsesTranslation:
+    def test_string_input(self):
+        out = responses_to_chat({"model": "m", "input": "hello",
+                                 "instructions": "be kind",
+                                 "max_output_tokens": 64,
+                                 "temperature": 0.3})
+        assert out["messages"][0] == {"role": "system", "content": "be kind"}
+        assert out["messages"][1] == {"role": "user", "content": "hello"}
+        assert out["max_tokens"] == 64
+        assert out["temperature"] == 0.3
+
+    def test_item_list_with_function_calls(self):
+        out = responses_to_chat({"model": "m", "input": [
+            {"type": "message", "role": "user", "content": [
+                {"type": "input_text", "text": "weather?"}]},
+            {"type": "function_call", "call_id": "c1", "name": "get",
+             "arguments": "{}"},
+            {"type": "function_call_output", "call_id": "c1",
+             "output": "sunny"},
+        ]})
+        assert out["messages"][0]["content"] == "weather?"
+        assert out["messages"][1]["tool_calls"][0]["id"] == "c1"
+        assert out["messages"][2] == {"role": "tool", "tool_call_id": "c1",
+                                      "content": "sunny"}
+
+    def test_previous_response_threads_history(self):
+        store = ResponseStore()
+        store.put(StoredResponse(id="resp_1", model="m", messages=[
+            {"role": "user", "content": "first question"},
+            {"role": "assistant", "content": "first answer"}]))
+        out = responses_to_chat({"model": "m", "input": "follow up",
+                                 "previous_response_id": "resp_1"}, store)
+        contents = [m["content"] for m in out["messages"]]
+        assert contents == ["first question", "first answer", "follow up"]
+
+    def test_chat_to_response_and_store(self):
+        store = ResponseStore()
+        chat_resp = {
+            "model": "m",
+            "choices": [{"message": {"role": "assistant",
+                                     "content": "the answer"},
+                         "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": 3, "completion_tokens": 5,
+                      "total_tokens": 8}}
+        req = {"model": "m", "input": "q", "store": True}
+        chat_req = {"messages": [{"role": "user", "content": "q"}]}
+        out = chat_to_response(chat_resp, req, chat_req, store)
+        assert out["object"] == "response"
+        assert out["output_text"] == "the answer"
+        assert out["output"][0]["content"][0]["text"] == "the answer"
+        assert out["usage"]["total_tokens"] == 8
+        stored = store.get(out["id"])
+        assert stored is not None
+        assert stored.messages[-1]["content"] == "the answer"
+
+    def test_store_false_skips_persist(self):
+        store = ResponseStore()
+        out = chat_to_response(
+            {"choices": [{"message": {"content": "x"}}]},
+            {"store": False}, {"messages": []}, store)
+        assert store.get(out["id"]) is None
+
+
+class TestCredentialResolver:
+    CFG = {
+        "fail_open": True,
+        # simulates the ext_authz-fronted deployment where identity
+        # headers are injected by the proxy and therefore trustworthy
+        "trust_identity_headers": True,
+        "credentials": [
+            {"models": ["premium-model"], "groups": ["premium-tier"],
+             "api_key": "sk-premium"},
+            {"models": ["premium-model"], "api_key": "sk-default"},
+            {"users": ["vip-1"], "api_key": "sk-vip",
+             "header": "x-api-key"},
+        ],
+    }
+
+    def test_group_match_wins_first(self):
+        r = CredentialResolver.from_config(self.CFG)
+        h = r.headers_for("premium-model", "u1", ["premium-tier"])
+        assert h == {"authorization": "Bearer sk-premium"}
+
+    def test_fallthrough_to_model_default(self):
+        r = CredentialResolver.from_config(self.CFG)
+        assert r.headers_for("premium-model", "u2", []) == \
+            {"authorization": "Bearer sk-default"}
+
+    def test_user_rule_any_model_custom_header(self):
+        r = CredentialResolver.from_config(self.CFG)
+        assert r.headers_for("other-model", "vip-1", []) == \
+            {"x-api-key": "sk-vip"}
+
+    def test_no_match_fail_open(self):
+        r = CredentialResolver.from_config(self.CFG)
+        assert r.headers_for("other-model", "nobody", []) == {}
+
+    def test_fail_closed_raises(self):
+        cfg = dict(self.CFG, fail_open=False)
+        r = CredentialResolver.from_config(cfg)
+        with pytest.raises(PermissionError):
+            r.headers_for("other-model", "nobody", [])
+
+    def test_untrusted_identity_headers_ignored(self):
+        """Forged x-authz-* headers must NOT unlock identity-scoped
+        credentials unless the operator declared them trusted."""
+        cfg = dict(self.CFG)
+        cfg.pop("trust_identity_headers")
+        r = CredentialResolver.from_config(cfg)
+        # forged premium-tier group: identity-scoped rule skipped, falls
+        # through to the model-default rule
+        assert r.headers_for("premium-model", "attacker",
+                             ["premium-tier"]) == \
+            {"authorization": "Bearer sk-default"}
+        # forged vip user on another model: nothing matches
+        assert r.headers_for("other-model", "vip-1", []) == {}
+
+
+class TestResponsesEndToEnd:
+    def test_responses_roundtrip_through_server(self, fixture_config_path):
+        from semantic_router_tpu.config import load_config
+        from semantic_router_tpu.router import (
+            MockVLLMServer,
+            Router,
+            RouterServer,
+        )
+
+        backend = MockVLLMServer().start()
+        cfg = load_config(fixture_config_path)
+        router = Router(cfg, engine=None)
+        server = RouterServer(router, cfg,
+                              default_backend=backend.url).start()
+        try:
+            def call(payload):
+                req = urllib.request.Request(
+                    server.url + "/v1/responses",
+                    data=json.dumps(payload).encode(), method="POST")
+                req.add_header("content-type", "application/json")
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    return json.loads(resp.read()), dict(resp.headers)
+
+            out, headers = call({"model": "auto",
+                                 "input": "this is urgent, asap!"})
+            assert out["object"] == "response"
+            assert headers["x-vsr-selected-decision"] == "urgent_route"
+            echoed = json.loads(out["output_text"])
+            assert echoed["model"] == "qwen3-8b"
+            # follow-up threads prior conversation via previous_response_id
+            out2, _ = call({"model": "auto", "input": "and another thing",
+                            "previous_response_id": out["id"]})
+            echoed2 = json.loads(out2["output_text"])
+            assert echoed2["n_messages"] >= 3
+        finally:
+            server.stop()
+            backend.stop()
