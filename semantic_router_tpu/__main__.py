@@ -26,6 +26,12 @@ def main(argv=None) -> int:
     serve_p.add_argument("--status-file", default="")
     serve_p.add_argument("--no-watch", action="store_true")
 
+    ext_p = sub.add_parser("serve-extproc",
+                           help="run the Envoy ExtProc gRPC filter")
+    ext_p.add_argument("--config", required=True)
+    ext_p.add_argument("--port", type=int, default=50051)
+    ext_p.add_argument("--mock-models", action="store_true")
+
     val_p = sub.add_parser("validate", help="validate a config file")
     val_p.add_argument("--config", required=True)
 
@@ -44,6 +50,27 @@ def main(argv=None) -> int:
                           "decisions": len(cfg.decisions),
                           "models": len(cfg.model_cards),
                           "signal_families": cfg.used_signal_types()}))
+        return 0
+
+    if args.command == "serve-extproc":
+        import time
+
+        from .config import load_config
+        from .extproc import ExtProcServer
+        from .router import Router
+        from .runtime.bootstrap import build_engine
+
+        cfg = load_config(args.config)
+        engine = build_engine(cfg, mock=args.mock_models)
+        router = Router(cfg, engine=engine)
+        server = ExtProcServer(router, port=args.port).start()
+        print(f"extproc listening on {server.address}", file=sys.stderr)
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            server.stop()
+            router.shutdown()
         return 0
 
     from .runtime.bootstrap import serve

@@ -44,6 +44,8 @@ class CacheStats:
     exact_hits: int = 0
     evictions: int = 0
     entries: int = 0
+    additions: int = 0
+    errors: int = 0  # external-backend IO failures (fail-open occurrences)
 
     @property
     def hit_rate(self) -> float:
@@ -246,5 +248,19 @@ def build_cache(cfg, embed_fn: Callable[[str], np.ndarray]) -> Optional[CacheBac
             eviction_policy=cfg.eviction_policy,
             use_hnsw=cfg.backend_type != "memory" or cfg.use_hnsw,
         )
+    if cfg.backend_type in ("redis", "valkey"):
+        from .redis_cache import RedisSemanticCache
+
+        bc = cfg.backend_config or {}
+        return RedisSemanticCache(
+            embed_fn,
+            host=bc.get("host", "127.0.0.1"),
+            port=int(bc.get("port", 6379)),
+            db=int(bc.get("db", 0)),
+            password=str(bc.get("password", "")),
+            key_prefix=bc.get("key_prefix", "vsr:cache"),
+            similarity_threshold=cfg.similarity_threshold,
+            ttl_seconds=cfg.ttl_seconds,
+        )
     raise ValueError(f"unsupported cache backend {cfg.backend_type!r} "
-                     f"(in-proc backends: memory|hnsw|hybrid)")
+                     f"(backends: memory|hnsw|hybrid|redis|valkey)")

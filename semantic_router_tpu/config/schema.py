@@ -958,6 +958,11 @@ class RouterConfig:
     api_server: Dict[str, Any] = field(default_factory=dict)
     tool_selection: Dict[str, Any] = field(default_factory=dict)
     prompt_compression: Dict[str, Any] = field(default_factory=dict)
+    # Client-controlled bypass headers are OFF unless the operator opts in
+    # (reference SkipProcessingConfig, pkg/config/config.go:186:
+    # x-vsr-skip-processing is honored only when enabled; skip_signals is
+    # operator config, never a bare request header).
+    skip_processing: Dict[str, Any] = field(default_factory=dict)
     raw: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
@@ -983,6 +988,7 @@ class RouterConfig:
             api_server=dict(d.get("api_server", {}) or {}),
             tool_selection=dict(d.get("tool_selection", {}) or {}),
             prompt_compression=dict(d.get("prompt_compression", {}) or {}),
+            skip_processing=dict(d.get("skip_processing", {}) or {}),
             raw=d,
         )
 
@@ -1020,3 +1026,38 @@ class RouterConfig:
 
 def asdict(cfg: Any) -> Dict[str, Any]:
     return dataclasses.asdict(cfg)
+
+
+_SECRET_KEY_MARKERS = ("api_key", "apikey", "secret", "password",
+                       "private_key", "access_key")
+
+
+def _is_secret_key(key: str) -> bool:
+    lk = key.lower()
+    if any(m in lk for m in _SECRET_KEY_MARKERS):
+        return True
+    # "token" only as the trailing word: auth_token/bearer_token/token are
+    # secrets; min_tokens/max_tokens are routing limits and must survive
+    return lk == "token" or lk.endswith("_token") or lk == "credential"
+
+
+def redact_config(d: Any) -> Any:
+    """Deep-copy ``d`` with secret-bearing values masked.
+
+    Any mapping value whose key names a secret (api_key, *_token, secret,
+    password, ...) becomes ``"***"`` regardless of value type — a list or
+    dict under a secret key is masked whole, never recursed into.  Used
+    before serving raw config on unauthenticated listeners (reference
+    redacts unless the principal has secret_view,
+    pkg/config/management_api.go:67).
+    """
+    if isinstance(d, dict):
+        out = {}
+        for k, v in d.items():
+            out[k] = "***" if _is_secret_key(str(k)) else redact_config(v)
+        return out
+    if isinstance(d, list):
+        return [redact_config(x) for x in d]
+    if isinstance(d, tuple):
+        return tuple(redact_config(x) for x in d)
+    return d

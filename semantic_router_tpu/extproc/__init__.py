@@ -1,0 +1,5 @@
+"""Envoy ExtProc gRPC integration layer (reference: pkg/extproc)."""
+
+from .server import ExtProcServer, ExtProcService, SERVICE_NAME
+
+__all__ = ["ExtProcServer", "ExtProcService", "SERVICE_NAME"]

@@ -1,0 +1,359 @@
+"""Envoy ExtProc gRPC frontend — the reference's primary deployment shape.
+
+Implements the ext_proc v3 bidirectional stream over the routing pipeline
+(reference: pkg/extproc/router.go:80 ``ExternalProcessorServer``,
+server.go:98 serve loop, processor_core.go:28-71 message dispatch):
+
+  Envoy ──ProcessingRequest stream──▶ this server ──ProcessingResponse──▶
+
+Phases handled per stream (BUFFERED mode, the reference default —
+deploy/local/envoy.yaml:90-118; STREAMED request chunks are accumulated to
+the same effect):
+
+- request_headers  → record; CONTINUE
+- request_body     → full pipeline (Router.route): mutate body (model
+  rewrite) + set x-vsr-* routing headers (appendRoutingHeaders sets
+  x-vsr-selected-model; Envoy's route config cluster-matches on it and owns
+  endpoint load balancing) + clear_route_cache; or ImmediateResponse for
+  cache hits / policy blocks / rate limits (processor_req_body.go:31).
+- response_headers → record status / detect SSE; CONTINUE (mode_override
+  to STREAMED for event-stream responses, allow_mode_override parity)
+- response_body    → response pipeline (Router.process_response): screens,
+  annotations, cache update, selector feedback (processor_res_body.go)
+
+Every pipeline error fails open to CONTINUE — a dead engine degrades the
+router, never the data plane (processor_core.go:74-81 recover parity). The
+gRPC service is registered with generic handlers against the real Envoy
+method path /envoy.service.ext_proc.v3.ExternalProcessor/Process, so a
+stock Envoy with the reference's filter config connects unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from concurrent import futures
+from typing import Any, Dict, Iterator, Optional
+
+import grpc
+
+from ..observability import metrics as M
+from ..observability.inflight import default_tracker
+from ..observability.logging import component_event
+from ..router import headers as H
+from ..router.pipeline import RouteResult, Router
+from . import external_processor_pb2 as pb
+
+SERVICE_NAME = "envoy.service.ext_proc.v3.ExternalProcessor"
+
+extproc_messages = M.default_registry.counter(
+    "llm_extproc_messages_total", "ExtProc stream messages by phase")
+
+
+def _headers_to_dict(header_map: pb.HeaderMap) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for hv in header_map.headers:
+        val = hv.raw_value.decode("utf-8", "replace") if hv.raw_value \
+            else hv.value
+        out[hv.key.lower()] = val
+    return out
+
+
+def _set_headers(mapping: Dict[str, str]) -> pb.HeaderMutation:
+    return pb.HeaderMutation(set_headers=[
+        pb.HeaderValueOption(
+            header=pb.HeaderValue(key=k, raw_value=v.encode()),
+            append_action=pb.HeaderValueOption.OVERWRITE_IF_EXISTS_OR_ADD)
+        for k, v in mapping.items()])
+
+
+def _immediate(status: int, body: Dict[str, Any],
+               headers: Dict[str, str]) -> pb.ProcessingResponse:
+    hdrs = {"content-type": "application/json"}
+    hdrs.update(headers)
+    return pb.ProcessingResponse(immediate_response=pb.ImmediateResponse(
+        status=pb.HttpStatus(code=status),
+        headers=_set_headers(hdrs),
+        body=json.dumps(body)))
+
+
+def _continue_headers() -> pb.ProcessingResponse:
+    return pb.ProcessingResponse(request_headers=pb.HeadersResponse(
+        response=pb.CommonResponse(status=pb.CommonResponse.CONTINUE)))
+
+
+class _StreamState:
+    """Per-Process-stream request context (reference RequestContext,
+    processor_core.go:86)."""
+
+    __slots__ = ("headers", "body_chunks", "route", "response_status",
+                 "is_sse", "response_chunks", "t_start", "inflight_token")
+
+    def __init__(self) -> None:
+        self.headers: Dict[str, str] = {}
+        self.body_chunks: list[bytes] = []
+        self.route: Optional[RouteResult] = None
+        self.response_status = 200
+        self.is_sse = False
+        self.response_chunks: list[bytes] = []
+        self.t_start = 0.0
+        self.inflight_token: Optional[int] = None
+
+
+class ExtProcService:
+    """The stream handler. One instance serves all streams; per-stream
+    state lives in _StreamState."""
+
+    def __init__(self, router: Router,
+                 looper_execute=None) -> None:
+        self.router = router
+        # optional callable(route, headers) -> (model, response_body);
+        # when set, looper decisions execute inside the filter and return
+        # an ImmediateResponse (the reference's looper path re-enters the
+        # router as a client; behind Envoy the filter must answer directly)
+        self.looper_execute = looper_execute
+
+    # -- stream loop -----------------------------------------------------
+
+    def Process(self, request_iterator: Iterator[pb.ProcessingRequest],
+                context: grpc.ServicerContext
+                ) -> Iterator[pb.ProcessingResponse]:
+        state = _StreamState()
+        try:
+            for req in request_iterator:
+                which = req.WhichOneof("request")
+                extproc_messages.inc(phase=which or "unknown")
+                if which == "request_headers":
+                    yield self._on_request_headers(req.request_headers, state)
+                elif which == "request_body":
+                    resp = self._on_request_body(req.request_body, state)
+                    if resp is not None:
+                        yield resp
+                elif which == "response_headers":
+                    yield self._on_response_headers(req.response_headers,
+                                                    state)
+                elif which == "response_body":
+                    resp = self._on_response_body(req.response_body, state)
+                    if resp is not None:
+                        yield resp
+                elif which == "request_trailers":
+                    yield pb.ProcessingResponse(
+                        request_trailers=pb.TrailersResponse())
+                elif which == "response_trailers":
+                    yield pb.ProcessingResponse(
+                        response_trailers=pb.TrailersResponse())
+                else:  # unknown phase: keep the stream alive
+                    yield _continue_headers()
+        finally:
+            if state.inflight_token is not None and state.route is not None:
+                default_tracker.end(state.route.model, state.inflight_token)
+
+    # -- phases ----------------------------------------------------------
+
+    def _on_request_headers(self, msg: pb.HttpHeaders,
+                            state: _StreamState) -> pb.ProcessingResponse:
+        state.headers = _headers_to_dict(msg.headers)
+        state.t_start = time.perf_counter()
+        return _continue_headers()
+
+    def _on_request_body(self, msg: pb.HttpBody, state: _StreamState
+                         ) -> Optional[pb.ProcessingResponse]:
+        state.body_chunks.append(bytes(msg.body))
+        if not msg.end_of_stream:
+            # STREAMED chunk (empty mid-stream frames are protocol-legal):
+            # acknowledge and keep accumulating until end_of_stream
+            return pb.ProcessingResponse(request_body=pb.BodyResponse(
+                response=pb.CommonResponse(
+                    status=pb.CommonResponse.CONTINUE)))
+        raw = b"".join(state.body_chunks)
+        state.body_chunks = []
+        try:
+            body = json.loads(raw or b"{}")
+        except json.JSONDecodeError:
+            return _immediate(400, {"error": {"message": "invalid JSON"}},
+                              {})
+        try:
+            route = self.router.route(body, state.headers)
+        except Exception as exc:  # fail open: continue unmodified
+            component_event("extproc", "route_error", error=str(exc))
+            return pb.ProcessingResponse(request_body=pb.BodyResponse(
+                response=pb.CommonResponse(
+                    status=pb.CommonResponse.CONTINUE)))
+        state.route = route
+
+        if route.kind in ("blocked", "rate_limited", "cache_hit") \
+                or route.response_body is not None:
+            return _immediate(route.status, route.response_body,
+                              route.headers)
+        if route.kind == "passthrough":
+            return pb.ProcessingResponse(request_body=pb.BodyResponse(
+                response=pb.CommonResponse(
+                    status=pb.CommonResponse.CONTINUE)))
+
+        # looper decisions answer from inside the filter when an executor
+        # is wired (multi-model strategies cannot be expressed as a single
+        # Envoy upstream request)
+        is_looper_sub = state.headers.get(H.LOOPER, "").lower() in \
+            ("1", "true")
+        if route.looper_algorithm and route.decision is not None \
+                and not is_looper_sub and self.looper_execute is not None:
+            try:
+                model, resp_body, extra = self.looper_execute(
+                    route, state.headers)
+                out_headers = dict(route.headers)
+                out_headers.update(extra)
+                out_headers[H.MODEL] = model
+                return _immediate(200, resp_body, out_headers)
+            except Exception as exc:
+                component_event("extproc", "looper_error", error=str(exc))
+                # fall through to single-model routing (fail open)
+
+        state.inflight_token = default_tracker.begin(route.model)
+        mutated = json.dumps(route.body).encode()
+        set_hdrs = dict(route.headers)
+        set_hdrs["content-length"] = str(len(mutated))
+        return pb.ProcessingResponse(request_body=pb.BodyResponse(
+            response=pb.CommonResponse(
+                status=pb.CommonResponse.CONTINUE,
+                header_mutation=_set_headers(set_hdrs),
+                body_mutation=pb.BodyMutation(body=mutated),
+                # Envoy re-evaluates route config so header-match cluster
+                # selection sees x-vsr-selected-model
+                clear_route_cache=True)))
+
+    def _on_response_headers(self, msg: pb.HttpHeaders,
+                             state: _StreamState) -> pb.ProcessingResponse:
+        hdrs = _headers_to_dict(msg.headers)
+        try:
+            state.response_status = int(hdrs.get(":status", "200"))
+        except ValueError:
+            state.response_status = 200
+        state.is_sse = "text/event-stream" in hdrs.get("content-type", "")
+        resp = pb.ProcessingResponse(response_headers=pb.HeadersResponse(
+            response=pb.CommonResponse(status=pb.CommonResponse.CONTINUE)))
+        if state.is_sse:
+            # Buffering an SSE stream would stall the client; switch the
+            # response body to streamed pass-through (allow_mode_override)
+            resp.mode_override.response_body_mode = pb.ProcessingMode.STREAMED
+        return resp
+
+    def _on_response_body(self, msg: pb.HttpBody, state: _StreamState
+                          ) -> Optional[pb.ProcessingResponse]:
+        state.response_chunks.append(bytes(msg.body))
+        cont = pb.ProcessingResponse(response_body=pb.BodyResponse(
+            response=pb.CommonResponse(status=pb.CommonResponse.CONTINUE)))
+        if not msg.end_of_stream:
+            return cont  # streamed chunk passes through untouched
+        raw = b"".join(state.response_chunks)
+        state.response_chunks = []
+        route = state.route
+        if route is None:
+            return cont
+        if state.inflight_token is not None:
+            default_tracker.end(route.model, state.inflight_token)
+            state.inflight_token = None
+        latency_ms = (time.perf_counter() - state.t_start) * 1e3 \
+            if state.t_start else 0.0
+        success = state.response_status == 200
+
+        if state.is_sse:
+            final = self._assemble_sse(raw)
+            try:
+                if success and final is not None:
+                    self.router.process_response(route, final)
+                self.router.record_feedback(route, success=success,
+                                            latency_ms=latency_ms)
+            except Exception:
+                pass
+            return cont
+
+        try:
+            body = json.loads(raw or b"{}")
+        except json.JSONDecodeError:
+            self.router.record_feedback(route, success=False,
+                                        latency_ms=latency_ms)
+            return cont
+        try:
+            if success:
+                processed = self.router.process_response(route, body)
+                self.router.record_feedback(route, success=True,
+                                            latency_ms=latency_ms)
+                if processed.headers or processed.body is not body:
+                    mutated = json.dumps(processed.body).encode()
+                    set_hdrs = dict(processed.headers)
+                    set_hdrs["content-length"] = str(len(mutated))
+                    return pb.ProcessingResponse(
+                        response_body=pb.BodyResponse(
+                            response=pb.CommonResponse(
+                                status=pb.CommonResponse.CONTINUE,
+                                header_mutation=_set_headers(set_hdrs),
+                                body_mutation=pb.BodyMutation(
+                                    body=mutated))))
+            else:
+                self.router.record_feedback(route, success=False,
+                                            latency_ms=latency_ms)
+        except Exception as exc:
+            component_event("extproc", "response_error", error=str(exc))
+        return cont
+
+    @staticmethod
+    def _assemble_sse(raw: bytes) -> Optional[Dict[str, Any]]:
+        """Reassemble a buffered/accumulated SSE body into a final chat
+        completion for cache/feedback (sse_frame_buffer.go role)."""
+        text_parts = []
+        usage: Dict[str, Any] = {}
+        for line in raw.splitlines():
+            line = line.strip()
+            if not line.startswith(b"data:"):
+                continue
+            payload = line[5:].strip()
+            if payload == b"[DONE]":
+                continue
+            try:
+                chunk = json.loads(payload)
+            except json.JSONDecodeError:
+                continue
+            for choice in chunk.get("choices", ()):
+                delta = choice.get("delta") or {}
+                if delta.get("content"):
+                    text_parts.append(delta["content"])
+            if chunk.get("usage"):
+                usage = chunk["usage"]
+        if not text_parts:
+            return None
+        return {"choices": [{"message": {
+            "role": "assistant", "content": "".join(text_parts)},
+            "finish_reason": "stop"}], "usage": usage}
+
+
+class ExtProcServer:
+    """gRPC server wrapper: binds the service on ``port`` (0 = ephemeral)
+    and serves until stop()."""
+
+    def __init__(self, router: Router, port: int = 0,
+                 max_workers: int = 16, looper_execute=None) -> None:
+        self.service = ExtProcService(router, looper_execute=looper_execute)
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers,
+                                       thread_name_prefix="extproc"),
+            options=[("grpc.max_receive_message_length", 64 * 1024 * 1024),
+                     ("grpc.max_send_message_length", 64 * 1024 * 1024)])
+        rpc = grpc.stream_stream_rpc_method_handler(
+            self.service.Process,
+            request_deserializer=pb.ProcessingRequest.FromString,
+            response_serializer=pb.ProcessingResponse.SerializeToString)
+        self._server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler(
+                SERVICE_NAME, {"Process": rpc}),))
+        self.port = self._server.add_insecure_port(f"127.0.0.1:{port}")
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self) -> "ExtProcServer":
+        self._server.start()
+        return self
+
+    def stop(self, grace: float = 0.5) -> None:
+        self._server.stop(grace).wait()

@@ -120,10 +120,17 @@ class Looper:
 
     def execute(self, algorithm: Dict[str, Any], refs: Sequence[ModelRef],
                 body: Dict[str, Any],
-                headers: Optional[Dict[str, str]] = None) -> LooperResponse:
+                headers: Optional[Dict[str, str]] = None,
+                headers_for: Optional[Callable[[str], Dict[str, str]]] = None
+                ) -> LooperResponse:
+        """``headers_for(model)`` resolves per-candidate upstream credentials
+        for every fan-out call (appendCredentialHeaders parity — the
+        reference resolves credentials per upstream request, not once per
+        client request). A raise from it fails that candidate closed."""
         algo = str(algorithm.get("type", "confidence"))
         conf = dict(algorithm.get(algo, {}) or {})
         self._headers = dict(headers or {})
+        self._headers_for = headers_for
         self._errors: List[str] = []
         try:
             if algo == "confidence":
@@ -147,8 +154,11 @@ class Looper:
     def _call(self, body: Dict[str, Any], model: str,
               usage: Dict[str, Dict[str, int]]) -> Optional[Dict[str, Any]]:
         try:
-            resp = self.client.complete(body, model,
-                                        headers=getattr(self, "_headers", None))
+            hdrs = dict(getattr(self, "_headers", None) or {})
+            headers_for = getattr(self, "_headers_for", None)
+            if headers_for is not None:
+                hdrs.update(headers_for(model))
+            resp = self.client.complete(body, model, headers=hdrs)
         except Exception as exc:  # on_error: skip (fail open), but remember
             self._errors.append(f"{model}: {type(exc).__name__}: {exc}")
             return None

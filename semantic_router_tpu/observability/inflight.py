@@ -1,0 +1,73 @@
+"""Per-model in-flight request tracking with self-healing expiry.
+
+Capability parity with pkg/inflight/tracker.go: each ``begin`` records a
+start timestamp; entries older than ``max_age_s`` are treated as abandoned
+(missed ``end`` after a panic or lost stream) and dropped, so the count
+self-corrects instead of leaking forever.  The tracker is the data source
+for load-aware selection (multi_factor selector) and mirrors into the
+``llm_inflight_requests`` Prometheus gauge.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Dict
+
+from .metrics import default_registry
+
+DEFAULT_MAX_AGE_S = 600.0
+
+inflight_gauge = default_registry.gauge(
+    "llm_inflight_requests", "Concurrent in-flight requests per model")
+
+
+class InflightTracker:
+    def __init__(self, max_age_s: float = DEFAULT_MAX_AGE_S) -> None:
+        self.max_age_s = max_age_s
+        self._entries: Dict[str, Dict[int, float]] = {}
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+
+    def begin(self, model: str) -> int:
+        """Record a request start; returns a token for :meth:`end`."""
+        token = next(self._ids)
+        with self._lock:
+            self._entries.setdefault(model, {})[token] = time.monotonic()
+            n = self._count_locked(model)
+        inflight_gauge.set(float(n), model=model)
+        return token
+
+    def end(self, model: str, token: int) -> None:
+        with self._lock:
+            entries = self._entries.get(model)
+            if entries is not None:
+                entries.pop(token, None)
+                if not entries:
+                    self._entries.pop(model, None)
+            n = self._count_locked(model)
+        inflight_gauge.set(float(n), model=model)
+
+    def count(self, model: str) -> int:
+        with self._lock:
+            return self._count_locked(model)
+
+    def total(self) -> int:
+        with self._lock:
+            return sum(self._count_locked(m) for m in list(self._entries))
+
+    def _count_locked(self, model: str) -> int:
+        entries = self._entries.get(model)
+        if not entries:
+            return 0
+        cutoff = time.monotonic() - self.max_age_s
+        stale = [t for t, ts in entries.items() if ts < cutoff]
+        for t in stale:
+            del entries[t]
+        return len(entries)
+
+
+# process-global tracker (selectors read it without threading a handle
+# through SelectionContext, mirroring the reference's package-level API)
+default_tracker = InflightTracker()

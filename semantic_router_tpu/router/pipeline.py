@@ -104,6 +104,12 @@ class Router:
         self.dispatcher = build_heuristic_dispatcher(cfg, extra=extra)
         self.decision_engine = DecisionEngine(cfg.decisions, cfg.strategy)
         self.rate_limiter = RateLimiter.from_config(cfg.ratelimit)
+        sp_cfg = cfg.skip_processing or {}
+        self._skip_enabled = bool(sp_cfg.get("enabled", False))
+        self._allow_skip_signals_header = bool(
+            sp_cfg.get("allow_skip_signals_header", False))
+        self._skip_signals_cfg = [str(s) for s in
+                                  (sp_cfg.get("skip_signals", []) or [])]
         pc_cfg = cfg.prompt_compression or {}
         self.compressor = PromptCompressor(
             profile=pc_cfg.get("profile", "default"),
@@ -138,13 +144,10 @@ class Router:
         headers = {k.lower(): v for k, v in (headers or {}).items()}
         request_id = headers.get(H.REQUEST_ID, uuid.uuid4().hex[:16])
 
-        if headers.get(H.SKIP_PROCESSING, "").lower() in ("1", "true"):
-            return RouteResult(kind="passthrough", body=body,
-                               request_id=request_id)
-
         ctx = RequestContext.from_openai_body(body, headers)
 
-        # rate limit (processor_req_body_prepare.go:143-170)
+        # rate limit (processor_req_body_prepare.go:143-170) — runs BEFORE
+        # any client-controlled skip so a bypass header can't evade limits
         rl = self.rate_limiter.check(ctx.user_id, ctx.model)
         if not rl.allowed:
             return RouteResult(
@@ -155,14 +158,28 @@ class Router:
                     "retry_after": round(rl.retry_after_s, 2)}},
                 headers={"retry-after": str(int(rl.retry_after_s) + 1)})
 
+        # x-vsr-skip-processing is honored ONLY when the operator enabled it
+        # (SkipProcessingConfig.Enabled, pkg/config/config.go:186 — default
+        # disabled; an unauthenticated client must not get passthrough)
+        if self._skip_enabled \
+                and headers.get(H.SKIP_PROCESSING, "").lower() in ("1", "true"):
+            return RouteResult(kind="passthrough", body=body,
+                               request_id=request_id)
+
         # prompt compression bounds what reaches the classifiers
         if self.compressor is not None \
                 and ctx.approx_token_count() >= self.pc_min_tokens:
             compressed = self.compressor.compress(ctx.user_text)
             ctx._user_text = compressed.text
 
-        skip = [s.strip() for s in
-                headers.get("x-vsr-skip-signals", "").split(",") if s.strip()]
+        # Signal families are dropped from operator config; the request
+        # header is honored only behind the same opt-in (a client must not
+        # be able to empty e.g. the pii family and dodge the block policy).
+        skip = list(self._skip_signals_cfg)
+        if self._skip_enabled and self._allow_skip_signals_header:
+            skip += [s.strip() for s in
+                     headers.get("x-vsr-skip-signals", "").split(",")
+                     if s.strip()]
         with default_tracer.span("signals.evaluate", request_id=request_id):
             signals, report = self.dispatcher.evaluate(ctx, skip_signals=skip)
         for family, res in report.results.items():

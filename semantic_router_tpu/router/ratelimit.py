@@ -54,7 +54,10 @@ class RateLimiter:
                                                 Optional[bool]]] = None
                  ) -> None:
         self.default_rpm = requests_per_minute
-        self.default_burst = burst or max(1, int(requests_per_minute / 6) or 1)
+        # burst=0 means "derive from the bucket's resolved rpm" — deriving
+        # from the GLOBAL rpm here would give per-user/per-model override
+        # buckets capacity 1 when the global rpm is 0
+        self.configured_burst = burst
         self.per_user = per_user or {}
         self.per_model = per_model or {}
         self.remote_check = remote_check
@@ -94,7 +97,8 @@ class RateLimiter:
         with self._lock:
             bucket = self._buckets.get(key)
             if bucket is None:
-                bucket = TokenBucket(rpm / 60.0, float(self.default_burst))
+                burst = self.configured_burst or max(1, int(rpm / 6))
+                bucket = TokenBucket(rpm / 60.0, float(burst))
                 self._buckets[key] = bucket
         ok, wait = bucket.take()
         return RateLimitDecision(ok, source="local", retry_after_s=wait)

@@ -130,11 +130,15 @@ class RouterServer:
         """Per-user upstream credentials (appendCredentialHeaders role).
         Identity headers only count when authz.trust_identity_headers is
         set (see CredentialResolver). Raises PermissionError fail-closed."""
+        return self._credentials_for_model(route.model, headers)
+
+    def _credentials_for_model(self, model: str, headers: Dict[str, str]
+                               ) -> Dict[str, str]:
         user_id = headers.get("x-authz-user-id", "")
         groups = [g.strip() for g in
                   headers.get("x-authz-user-groups", "").split(",")
                   if g.strip()]
-        return self.credentials.headers_for(route.model, user_id, groups)
+        return self.credentials.headers_for(model, user_id, groups)
 
     def _forward(self, url: str, body: Dict[str, Any],
                  headers: Dict[str, str]) -> tuple[int, Dict[str, Any]]:
@@ -221,7 +225,13 @@ class RouterServer:
                                       "tags": m.tags}}
                         for m in server.cfg.model_cards]})
                 elif path == "/config/router":
-                    self._json(200, server.cfg.raw)
+                    # secrets masked — cfg.raw holds post-env-substitution
+                    # values (resolved API keys); this listener is
+                    # unauthenticated (reference: secret_view-gated,
+                    # pkg/config/management_api.go:67)
+                    from ..config.schema import redact_config
+
+                    self._json(200, redact_config(server.cfg.raw))
                 else:
                     self._json(404, {"error": "not found"})
 
@@ -522,11 +532,22 @@ class RouterServer:
                         return r.probs.get("entailment", r.confidence)
                 looper = Looper(server.looper_client, nli,
                                 pool=server.looper_pool)
+
+                # per-candidate upstream credentials: each fan-out call gets
+                # headers_for(candidate_model), same as the single-model path
+                # (appendCredentialHeaders runs per upstream request in the
+                # reference). A PermissionError for one candidate skips that
+                # candidate fail-closed; if every candidate is denied the
+                # looper surfaces the aggregate failure.
+                def headers_for(model: str) -> Dict[str, str]:
+                    return server._credentials_for_model(model, req_headers)
+
                 t0 = time.perf_counter()
                 try:
                     result = looper.execute(decision.algorithm,
                                             decision.model_refs, route.body,
-                                            headers=req_headers)
+                                            headers=req_headers,
+                                            headers_for=headers_for)
                 except Exception as exc:
                     server.router.record_feedback(
                         route, success=False,

@@ -1,0 +1,11 @@
+"""External state backends: wire clients + embedded dev servers.
+
+Reference: the state taxonomy (docs/architecture/
+state-taxonomy-and-inventory.md) — semantic cache, response store, replay,
+vectorstore, and memory all support external durable backends so replicas
+share state and restarts lose nothing.
+"""
+
+from .resp import ConnectionError_, MiniRedis, RedisClient, RespError
+
+__all__ = ["ConnectionError_", "MiniRedis", "RedisClient", "RespError"]

@@ -1,0 +1,520 @@
+"""RESP (REdis Serialization Protocol) wire client + embedded mini-server.
+
+The reference reaches external state over Redis/Valkey clients
+(pkg/responsestore redis backend, pkg/cache backends via their factories;
+state taxonomy: docs/architecture/state-taxonomy-and-inventory.md).  This
+module provides the same capability with zero dependencies:
+
+- :class:`RedisClient` — a real RESP2 socket client (pipelining, auth,
+  reconnect) that talks to any Redis/Valkey/KeyDB server in production.
+- :class:`MiniRedis` — an embedded RESP2 server implementing the command
+  subset the framework uses (strings+TTL, hashes, scan, counters).  It
+  backs tests and single-node dev deployments the way the reference's test
+  suites use an embedded store; the client cannot tell the difference.
+
+Both speak the public RESP2 protocol over real sockets.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class RespError(Exception):
+    """Server-reported -ERR reply."""
+
+
+class ConnectionError_(Exception):
+    """Socket-level failure after retry."""
+
+
+def encode_command(*args) -> bytes:
+    """RESP array-of-bulk-strings command encoding."""
+    out = [b"*%d\r\n" % len(args)]
+    for a in args:
+        if isinstance(a, bytes):
+            b = a
+        elif isinstance(a, str):
+            b = a.encode()
+        elif isinstance(a, float):
+            b = repr(a).encode()
+        else:
+            b = str(a).encode()
+        out.append(b"$%d\r\n%s\r\n" % (len(b), b))
+    return b"".join(out)
+
+
+class _Reader:
+    """Buffered RESP reply parser over a socket."""
+
+    def __init__(self, sock: socket.socket) -> None:
+        self.sock = sock
+        self.buf = b""
+
+    def _read_line(self) -> bytes:
+        while b"\r\n" not in self.buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError_("connection closed")
+            self.buf += chunk
+        line, self.buf = self.buf.split(b"\r\n", 1)
+        return line
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self.buf) < n + 2:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError_("connection closed")
+            self.buf += chunk
+        data, self.buf = self.buf[:n], self.buf[n + 2:]
+        return data
+
+    def read_reply(self) -> Any:
+        line = self._read_line()
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            raise RespError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n == -1:
+                return None
+            return self._read_exact(n)
+        if kind == b"*":
+            n = int(rest)
+            if n == -1:
+                return None
+            return [self.read_reply() for _ in range(n)]
+        raise RespError(f"unknown reply type {line!r}")
+
+
+class RedisClient:
+    """Thread-safe RESP2 client (one pooled connection guarded by a lock;
+    commands are short and the router's state calls are not the hot path)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379,
+                 db: int = 0, password: str = "",
+                 timeout_s: float = 5.0, retries: int = 1) -> None:
+        self.host, self.port, self.db = host, port, db
+        self.password = password
+        self.timeout_s = timeout_s
+        self.retries = retries
+        self._sock: Optional[socket.socket] = None
+        self._reader: Optional[_Reader] = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> None:
+        sock = socket.create_connection((self.host, self.port),
+                                        timeout=self.timeout_s)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+        self._reader = _Reader(sock)
+        if self.password:
+            self._roundtrip("AUTH", self.password)
+        if self.db:
+            self._roundtrip("SELECT", self.db)
+
+    def _roundtrip(self, *args) -> Any:
+        self._sock.sendall(encode_command(*args))
+        return self._reader.read_reply()
+
+    def execute(self, *args) -> Any:
+        """Run one command; reconnects once on socket failure."""
+        with self._lock:
+            for attempt in range(self.retries + 1):
+                try:
+                    if self._sock is None:
+                        self._connect()
+                    return self._roundtrip(*args)
+                except (OSError, ConnectionError_):
+                    self.close_nolock()
+                    if attempt == self.retries:
+                        raise ConnectionError_(
+                            f"redis {self.host}:{self.port} unreachable")
+
+    def pipeline(self, commands: List[Tuple]) -> List[Any]:
+        """Send N commands in one write, read N replies (RESP pipelining)."""
+        with self._lock:
+            if self._sock is None:
+                self._connect()
+            payload = b"".join(encode_command(*c) for c in commands)
+            self._sock.sendall(payload)
+            out = []
+            for _ in commands:
+                try:
+                    out.append(self._reader.read_reply())
+                except RespError as e:
+                    out.append(e)
+            return out
+
+    # -- convenience wrappers -------------------------------------------
+
+    def ping(self) -> bool:
+        return self.execute("PING") == "PONG"
+
+    def set(self, key: str, value, ex: Optional[int] = None) -> bool:
+        args = ["SET", key, value]
+        if ex:
+            args += ["EX", ex]
+        return self.execute(*args) == "OK"
+
+    def get(self, key: str) -> Optional[bytes]:
+        return self.execute("GET", key)
+
+    def delete(self, *keys: str) -> int:
+        return self.execute("DEL", *keys) if keys else 0
+
+    def exists(self, key: str) -> bool:
+        return bool(self.execute("EXISTS", key))
+
+    def expire(self, key: str, seconds: int) -> bool:
+        return bool(self.execute("EXPIRE", key, seconds))
+
+    def ttl(self, key: str) -> int:
+        return self.execute("TTL", key)
+
+    def incr(self, key: str, by: int = 1) -> int:
+        return self.execute("INCRBY", key, by)
+
+    def keys(self, pattern: str = "*") -> List[bytes]:
+        return self.execute("KEYS", pattern) or []
+
+    def hset(self, key: str, mapping: Dict[str, Any]) -> int:
+        args: List[Any] = ["HSET", key]
+        for k, v in mapping.items():
+            args += [k, v]
+        return self.execute(*args)
+
+    def hget(self, key: str, field: str) -> Optional[bytes]:
+        return self.execute("HGET", key, field)
+
+    def hgetall(self, key: str) -> Dict[bytes, bytes]:
+        flat = self.execute("HGETALL", key) or []
+        return {flat[i]: flat[i + 1] for i in range(0, len(flat), 2)}
+
+    def scan_iter(self, pattern: str = "*", count: int = 500):
+        cursor = 0
+        while True:
+            cursor_b, batch = self.execute("SCAN", cursor, "MATCH", pattern,
+                                           "COUNT", count)
+            for k in batch:
+                yield k
+            cursor = int(cursor_b)
+            if cursor == 0:
+                return
+
+    def flushdb(self) -> bool:
+        return self.execute("FLUSHDB") == "OK"
+
+    def dbsize(self) -> int:
+        return self.execute("DBSIZE")
+
+    def close_nolock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = None
+        self._reader = None
+
+    def close(self) -> None:
+        with self._lock:
+            self.close_nolock()
+
+
+# ---------------------------------------------------------------------------
+# embedded server
+# ---------------------------------------------------------------------------
+
+
+class MiniRedis:
+    """Embedded RESP2 server (strings+TTL, hashes, counters, scan/keys).
+
+    One python dict guarded by one lock; lazy TTL expiry on access plus a
+    sweep on DBSIZE/KEYS/SCAN.  Runs a thread per connection — suitable for
+    tests and dev, not for production fleets (point RedisClient at a real
+    Redis/Valkey there)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._data: Dict[bytes, Any] = {}
+        self._expiry: Dict[bytes, float] = {}
+        self._lock = threading.Lock()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self.host, self.port = self._listener.getsockname()
+        self._running = False
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> "MiniRedis":
+        self._running = True
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="miniredis-accept")
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    # -- internals -------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True, name="miniredis-conn")
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        reader = _Reader(conn)
+        try:
+            while True:
+                try:
+                    cmd = reader.read_reply()
+                except (ConnectionError_, OSError):
+                    return
+                if not isinstance(cmd, list) or not cmd:
+                    conn.sendall(b"-ERR protocol error\r\n")
+                    continue
+                name = cmd[0].decode().upper() if isinstance(cmd[0], bytes) \
+                    else str(cmd[0]).upper()
+                try:
+                    reply = self._dispatch(name, cmd[1:])
+                except RespError as e:
+                    conn.sendall(b"-ERR " + str(e).encode() + b"\r\n")
+                    continue
+                if reply == "__QUIT__":
+                    conn.sendall(b"+OK\r\n")
+                    return
+                conn.sendall(reply)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- encoding helpers ------------------------------------------------
+
+    @staticmethod
+    def _bulk(v: Optional[bytes]) -> bytes:
+        if v is None:
+            return b"$-1\r\n"
+        return b"$%d\r\n%s\r\n" % (len(v), v)
+
+    @staticmethod
+    def _int(n: int) -> bytes:
+        return b":%d\r\n" % n
+
+    @staticmethod
+    def _arr(items: List[bytes]) -> bytes:
+        return b"*%d\r\n" % len(items) + b"".join(items)
+
+    # -- state helpers ---------------------------------------------------
+
+    def _alive(self, key: bytes) -> bool:
+        exp = self._expiry.get(key)
+        if exp is not None and exp <= time.monotonic():
+            self._data.pop(key, None)
+            self._expiry.pop(key, None)
+            return False
+        return key in self._data
+
+    def _sweep(self) -> None:
+        for k in list(self._expiry):
+            self._alive(k)
+
+    # -- command dispatch ------------------------------------------------
+
+    def _dispatch(self, name: str, args: List[bytes]) -> Any:
+        with self._lock:
+            return getattr(self, f"_cmd_{name.lower()}", self._cmd_unknown)(
+                name, args)
+
+    def _cmd_unknown(self, name: str, args):
+        raise RespError(f"unknown command '{name}'")
+
+    def _cmd_ping(self, name, args):
+        return b"+PONG\r\n"
+
+    def _cmd_quit(self, name, args):
+        return "__QUIT__"
+
+    def _cmd_auth(self, name, args):
+        return b"+OK\r\n"  # accepts any credentials (dev server)
+
+    def _cmd_select(self, name, args):
+        return b"+OK\r\n"  # single logical db
+
+    def _cmd_set(self, name, args):
+        key, value = args[0], args[1]
+        ex = None
+        i = 2
+        while i < len(args):
+            opt = args[i].upper()
+            if opt == b"EX":
+                ex = int(args[i + 1]); i += 2
+            elif opt == b"PX":
+                ex = int(args[i + 1]) / 1000.0; i += 2
+            else:
+                i += 1
+        self._data[key] = value
+        if ex is not None:
+            self._expiry[key] = time.monotonic() + float(ex)
+        else:
+            self._expiry.pop(key, None)
+        return b"+OK\r\n"
+
+    def _cmd_setex(self, name, args):
+        key, secs, value = args[0], int(args[1]), args[2]
+        self._data[key] = value
+        self._expiry[key] = time.monotonic() + secs
+        return b"+OK\r\n"
+
+    def _cmd_get(self, name, args):
+        key = args[0]
+        if not self._alive(key):
+            return self._bulk(None)
+        v = self._data[key]
+        if not isinstance(v, bytes):
+            raise RespError("WRONGTYPE")
+        return self._bulk(v)
+
+    def _cmd_del(self, name, args):
+        n = 0
+        for key in args:
+            if self._alive(key):
+                del self._data[key]
+                self._expiry.pop(key, None)
+                n += 1
+        return self._int(n)
+
+    def _cmd_exists(self, name, args):
+        return self._int(sum(1 for k in args if self._alive(k)))
+
+    def _cmd_expire(self, name, args):
+        key, secs = args[0], int(args[1])
+        if not self._alive(key):
+            return self._int(0)
+        self._expiry[key] = time.monotonic() + secs
+        return self._int(1)
+
+    def _cmd_ttl(self, name, args):
+        key = args[0]
+        if not self._alive(key):
+            return self._int(-2)
+        exp = self._expiry.get(key)
+        if exp is None:
+            return self._int(-1)
+        return self._int(max(0, int(round(exp - time.monotonic()))))
+
+    def _cmd_incrby(self, name, args):
+        key, by = args[0], int(args[1])
+        cur = int(self._data.get(key, b"0")) if self._alive(key) else 0
+        cur += by
+        self._data[key] = str(cur).encode()
+        return self._int(cur)
+
+    def _cmd_incr(self, name, args):
+        return self._cmd_incrby(name, [args[0], b"1"])
+
+    def _cmd_keys(self, name, args):
+        self._sweep()
+        pattern = args[0].decode() if args else "*"
+        out = [self._bulk(k) for k in sorted(self._data)
+               if fnmatch.fnmatchcase(k.decode("utf-8", "replace"), pattern)]
+        return self._arr(out)
+
+    def _cmd_scan(self, name, args):
+        # single-pass cursor: all matching keys in one batch, cursor 0
+        self._sweep()
+        pattern = "*"
+        for i, a in enumerate(args):
+            if isinstance(a, bytes) and a.upper() == b"MATCH":
+                pattern = args[i + 1].decode()
+        keys = [self._bulk(k) for k in sorted(self._data)
+                if fnmatch.fnmatchcase(k.decode("utf-8", "replace"), pattern)]
+        return self._arr([self._bulk(b"0"), self._arr(keys)])
+
+    def _cmd_hset(self, name, args):
+        key = args[0]
+        if not self._alive(key):
+            self._data[key] = {}
+        h = self._data[key]
+        if not isinstance(h, dict):
+            raise RespError("WRONGTYPE")
+        added = 0
+        for i in range(1, len(args) - 1, 2):
+            if args[i] not in h:
+                added += 1
+            h[args[i]] = args[i + 1]
+        return self._int(added)
+
+    def _cmd_hget(self, name, args):
+        key, fld = args[0], args[1]
+        if not self._alive(key):
+            return self._bulk(None)
+        h = self._data[key]
+        if not isinstance(h, dict):
+            raise RespError("WRONGTYPE")
+        return self._bulk(h.get(fld))
+
+    def _cmd_hgetall(self, name, args):
+        key = args[0]
+        if not self._alive(key):
+            return self._arr([])
+        h = self._data[key]
+        if not isinstance(h, dict):
+            raise RespError("WRONGTYPE")
+        out = []
+        for k, v in h.items():
+            out.append(self._bulk(k))
+            out.append(self._bulk(v))
+        return self._arr(out)
+
+    def _cmd_hdel(self, name, args):
+        key = args[0]
+        if not self._alive(key):
+            return self._int(0)
+        h = self._data[key]
+        n = 0
+        for fld in args[1:]:
+            if fld in h:
+                del h[fld]
+                n += 1
+        return self._int(n)
+
+    def _cmd_flushdb(self, name, args):
+        self._data.clear()
+        self._expiry.clear()
+        return b"+OK\r\n"
+
+    _cmd_flushall = _cmd_flushdb
+
+    def _cmd_dbsize(self, name, args):
+        self._sweep()
+        return self._int(len(self._data))
+
+    def _cmd_type(self, name, args):
+        key = args[0]
+        if not self._alive(key):
+            return b"+none\r\n"
+        v = self._data[key]
+        return b"+hash\r\n" if isinstance(v, dict) else b"+string\r\n"

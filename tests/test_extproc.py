@@ -1,0 +1,306 @@
+"""Envoy ExtProc gRPC frontend e2e (reference: pkg/extproc — Process
+stream over headers/body/response phases; BUFFERED + STREAMED accumulation;
+ImmediateResponse short-circuits; fail-open degradation).
+
+The test client drives the exact ProcessingRequest sequence Envoy sends
+with the reference's filter config (deploy/local/envoy.yaml processing_mode
+SEND/BUFFERED), over a real gRPC channel against the real method path.
+"""
+
+import json
+
+import grpc
+import pytest
+
+from semantic_router_tpu.config import load_config
+from semantic_router_tpu.extproc import ExtProcServer, SERVICE_NAME
+from semantic_router_tpu.extproc import external_processor_pb2 as pb
+from semantic_router_tpu.router import Router
+from semantic_router_tpu.router import headers as H
+
+
+def _headers_msg(extra=None, eos=False):
+    base = {":method": "POST", ":path": "/v1/chat/completions",
+            ":authority": "router.local", "content-type": "application/json"}
+    base.update(extra or {})
+    return pb.ProcessingRequest(request_headers=pb.HttpHeaders(
+        headers=pb.HeaderMap(headers=[
+            pb.HeaderValue(key=k, raw_value=v.encode())
+            for k, v in base.items()]),
+        end_of_stream=eos))
+
+
+def _body_msg(payload, eos=True):
+    raw = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
+    return pb.ProcessingRequest(request_body=pb.HttpBody(
+        body=raw, end_of_stream=eos))
+
+
+def _resp_headers_msg(status="200", ctype="application/json"):
+    return pb.ProcessingRequest(response_headers=pb.HttpHeaders(
+        headers=pb.HeaderMap(headers=[
+            pb.HeaderValue(key=":status", raw_value=status.encode()),
+            pb.HeaderValue(key="content-type", raw_value=ctype.encode())])))
+
+
+def _resp_body_msg(payload, eos=True):
+    raw = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
+    return pb.ProcessingRequest(response_body=pb.HttpBody(
+        body=raw, end_of_stream=eos))
+
+
+def _mutated_headers(common):
+    return {opt.header.key: opt.header.raw_value.decode()
+            for opt in common.header_mutation.set_headers}
+
+
+def chat(text, **kw):
+    return {"model": "auto",
+            "messages": [{"role": "user", "content": text}], **kw}
+
+
+@pytest.fixture(scope="module")
+def cfg(fixture_config_path):
+    return load_config(fixture_config_path)
+
+
+@pytest.fixture()
+def served(cfg):
+    router = Router(cfg, engine=None)
+    server = ExtProcServer(router, port=0).start()
+    channel = grpc.insecure_channel(server.address)
+    call = channel.stream_stream(
+        f"/{SERVICE_NAME}/Process",
+        request_serializer=pb.ProcessingRequest.SerializeToString,
+        response_deserializer=pb.ProcessingResponse.FromString)
+    yield router, server, call
+    channel.close()
+    server.stop()
+    router.shutdown()
+
+
+class TestRequestPath:
+    def test_route_mutates_body_and_sets_headers(self, served):
+        router, server, call = served
+        msgs = [_headers_msg(), _body_msg(chat("this is urgent, fix asap")),
+                _resp_headers_msg(),
+                _resp_body_msg({"choices": [{"message": {
+                    "role": "assistant", "content": "done"},
+                    "finish_reason": "stop"}],
+                    "usage": {"prompt_tokens": 3, "completion_tokens": 1}})]
+        resps = list(call(iter(msgs)))
+        assert len(resps) == 4
+        assert resps[0].WhichOneof("response") == "request_headers"
+        body_resp = resps[1]
+        assert body_resp.WhichOneof("response") == "request_body"
+        common = body_resp.request_body.response
+        assert common.status == pb.CommonResponse.CONTINUE
+        assert common.clear_route_cache
+        mutated = json.loads(common.body_mutation.body)
+        assert mutated["model"] == "qwen3-8b"
+        hdrs = _mutated_headers(common)
+        assert hdrs[H.MODEL] == "qwen3-8b"
+        assert hdrs[H.DECISION] == "urgent_route"
+        assert hdrs["content-length"] == str(len(common.body_mutation.body))
+        # response phases both continue
+        assert resps[2].WhichOneof("response") == "response_headers"
+        assert resps[3].WhichOneof("response") == "response_body"
+
+    def test_streamed_request_chunks_accumulate(self, served):
+        router, server, call = served
+        raw = json.dumps(chat("this is urgent, fix asap")).encode()
+        msgs = [_headers_msg(),
+                _body_msg(raw[:20], eos=False),
+                _body_msg(raw[20:], eos=True)]
+        resps = list(call(iter(msgs)))
+        assert len(resps) == 3
+        # chunk ack then the full-pipeline mutation on end_of_stream
+        assert resps[1].request_body.response.status == \
+            pb.CommonResponse.CONTINUE
+        assert not resps[1].request_body.response.HasField("body_mutation")
+        mutated = json.loads(
+            resps[2].request_body.response.body_mutation.body)
+        assert mutated["model"] == "qwen3-8b"
+
+    def test_policy_block_immediate_response(self):
+        from semantic_router_tpu.config import RouterConfig
+
+        cfg = RouterConfig.from_dict({
+            "default_model": "m-default",
+            "routing": {
+                "modelCards": [{"name": "m-default"}],
+                "signals": {"keywords": [{
+                    "name": "forbidden", "operator": "OR",
+                    "method": "exact",
+                    "keywords": ["forbidden topic"]}]},
+                "decisions": [{
+                    "name": "block_forbidden", "priority": 100,
+                    "rules": {"operator": "OR", "conditions": [
+                        {"type": "keyword", "name": "forbidden"}]},
+                    "modelRefs": [{"model": "m-default"}],
+                    "plugins": [{"type": "fast_response",
+                                 "configuration": {
+                                     "enabled": True,
+                                     "response": "Request blocked by "
+                                                 "policy."}}],
+                }]},
+        })
+        router = Router(cfg, engine=None)
+        server = ExtProcServer(router, port=0).start()
+        channel = grpc.insecure_channel(server.address)
+        call = channel.stream_stream(
+            f"/{SERVICE_NAME}/Process",
+            request_serializer=pb.ProcessingRequest.SerializeToString,
+            response_deserializer=pb.ProcessingResponse.FromString)
+        try:
+            msgs = [_headers_msg(),
+                    _body_msg(chat("tell me about the forbidden topic"))]
+            resps = list(call(iter(msgs)))
+            imm = resps[1].immediate_response
+            assert resps[1].WhichOneof("response") == "immediate_response"
+            assert imm.status.code == 200
+            payload = json.loads(imm.body)
+            assert payload["choices"][0]["message"]["content"] == \
+                "Request blocked by policy."
+            hdrs = {o.header.key: o.header.raw_value.decode()
+                    for o in imm.headers.set_headers}
+            assert hdrs[H.JAILBREAK_BLOCKED] == "true"
+        finally:
+            channel.close()
+            server.stop()
+            router.shutdown()
+
+    def test_invalid_json_immediate_400(self, served):
+        router, server, call = served
+        msgs = [_headers_msg(), _body_msg(b"{not json", eos=True)]
+        resps = list(call(iter(msgs)))
+        assert resps[1].immediate_response.status.code == 400
+
+    def test_rate_limited_immediate_429(self, cfg, fixture_config_path):
+        cfg2 = load_config(fixture_config_path)
+        cfg2.ratelimit = {"requests_per_minute": 60, "burst": 1}
+        router = Router(cfg2, engine=None)
+        server = ExtProcServer(router, port=0).start()
+        channel = grpc.insecure_channel(server.address)
+        call = channel.stream_stream(
+            f"/{SERVICE_NAME}/Process",
+            request_serializer=pb.ProcessingRequest.SerializeToString,
+            response_deserializer=pb.ProcessingResponse.FromString)
+        try:
+            def once():
+                return list(call(iter([_headers_msg(),
+                                       _body_msg(chat("hello"))])))
+            first = once()
+            assert first[1].WhichOneof("response") != "immediate_response" \
+                or first[1].immediate_response.status.code != 429
+            second = once()
+            assert second[1].immediate_response.status.code == 429
+        finally:
+            channel.close()
+            server.stop()
+            router.shutdown()
+
+    def test_pipeline_error_fails_open(self, cfg):
+        router = Router(cfg, engine=None)
+        router.route = lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError("engine dead"))
+        server = ExtProcServer(router, port=0).start()
+        channel = grpc.insecure_channel(server.address)
+        call = channel.stream_stream(
+            f"/{SERVICE_NAME}/Process",
+            request_serializer=pb.ProcessingRequest.SerializeToString,
+            response_deserializer=pb.ProcessingResponse.FromString)
+        try:
+            resps = list(call(iter([_headers_msg(),
+                                    _body_msg(chat("anything"))])))
+            common = resps[1].request_body.response
+            assert common.status == pb.CommonResponse.CONTINUE
+            assert not common.HasField("body_mutation")  # untouched
+        finally:
+            channel.close()
+            server.stop()
+
+
+class TestResponsePath:
+    def test_sse_response_mode_override_and_passthrough(self, served):
+        router, server, call = served
+        sse = (b'data: {"choices":[{"delta":{"content":"hi "}}]}\n\n'
+               b'data: {"choices":[{"delta":{"content":"there"}}],'
+               b'"usage":{"completion_tokens":2}}\n\n'
+               b'data: [DONE]\n\n')
+        msgs = [_headers_msg(),
+                _body_msg(chat("this is urgent, fix asap", stream=True)),
+                _resp_headers_msg(ctype="text/event-stream"),
+                _resp_body_msg(sse[:30], eos=False),
+                _resp_body_msg(sse[30:], eos=True)]
+        resps = list(call(iter(msgs)))
+        assert len(resps) == 5
+        rh = resps[2]
+        assert rh.mode_override.response_body_mode == \
+            pb.ProcessingMode.STREAMED
+        # streamed response chunks pass through unmodified
+        assert not resps[3].response_body.response.HasField("body_mutation")
+        assert not resps[4].response_body.response.HasField("body_mutation")
+
+
+class TestCachePath:
+    def test_cache_round_trip_across_streams(self, fixture_config_path):
+        from semantic_router_tpu.engine.testing import make_embedding_engine
+
+        eng = make_embedding_engine()
+        cfg = load_config(fixture_config_path)
+        router = Router(cfg, engine=eng)
+        server = ExtProcServer(router, port=0).start()
+        channel = grpc.insecure_channel(server.address)
+        call = channel.stream_stream(
+            f"/{SERVICE_NAME}/Process",
+            request_serializer=pb.ProcessingRequest.SerializeToString,
+            response_deserializer=pb.ProcessingResponse.FromString)
+        try:
+            q = chat("please debug the cache function in this code")
+            first = list(call(iter([
+                _headers_msg(), _body_msg(q), _resp_headers_msg(),
+                _resp_body_msg({"choices": [{"message": {
+                    "role": "assistant", "content": "use a debugger"},
+                    "finish_reason": "stop"}],
+                    "usage": {"prompt_tokens": 5, "completion_tokens": 3}}),
+            ])))
+            assert first[1].WhichOneof("response") == "request_body"
+            second = list(call(iter([_headers_msg(), _body_msg(q)])))
+            imm = second[1].immediate_response
+            assert second[1].WhichOneof("response") == "immediate_response"
+            payload = json.loads(imm.body)
+            assert payload["choices"][0]["message"]["content"] == \
+                "use a debugger"
+            hdrs = {o.header.key: o.header.raw_value.decode()
+                    for o in imm.headers.set_headers}
+            assert hdrs[H.CACHE_HIT] == "true"
+        finally:
+            channel.close()
+            server.stop()
+            router.shutdown()
+            eng.shutdown()
+
+
+class TestInflight:
+    def test_inflight_tracker_begin_end(self):
+        from semantic_router_tpu.observability.inflight import InflightTracker
+
+        t = InflightTracker(max_age_s=60)
+        tok1 = t.begin("m1")
+        tok2 = t.begin("m1")
+        assert t.count("m1") == 2
+        t.end("m1", tok1)
+        assert t.count("m1") == 1
+        t.end("m1", tok2)
+        assert t.count("m1") == 0 and t.total() == 0
+
+    def test_inflight_self_heals_abandoned(self):
+        from semantic_router_tpu.observability.inflight import InflightTracker
+
+        t = InflightTracker(max_age_s=0.01)
+        t.begin("m1")
+        import time as _t
+
+        _t.sleep(0.03)
+        assert t.count("m1") == 0  # abandoned entry dropped

@@ -133,6 +133,86 @@ class TestCredentialResolver:
         assert r.headers_for("other-model", "vip-1", []) == {}
 
 
+class TestConfigRedaction:
+    def test_redact_masks_secret_values_deeply(self):
+        from semantic_router_tpu.config import redact_config
+
+        raw = {
+            "authz": {"credentials": [
+                {"models": ["m"], "api_key": "sk-resolved-secret"},
+                {"users": ["u"], "api_key": "sk-2", "header": "x-api-key"},
+            ]},
+            "backends": [{"endpoint": "http://b:8000",
+                          "auth_token": "tok-123"}],
+            "nested": {"password": "hunter2", "ok": "visible"},
+            "default_model": "qwen3-8b",
+            # secret-keyed containers are masked whole, never recursed
+            "api_keys": ["sk-live-1", "sk-live-2"],
+            "bearer_token": {"value": "tok-x"},
+            # routing limits containing "token(s)" must survive
+            "limits": {"min_tokens": "2K", "max_tokens": 256000},
+        }
+        red = redact_config(raw)
+        assert red["authz"]["credentials"][0]["api_key"] == "***"
+        assert red["authz"]["credentials"][1]["api_key"] == "***"
+        assert red["backends"][0]["auth_token"] == "***"
+        assert red["nested"]["password"] == "***"
+        # non-secrets untouched; original not mutated
+        assert red["nested"]["ok"] == "visible"
+        assert red["default_model"] == "qwen3-8b"
+        assert raw["authz"]["credentials"][0]["api_key"] \
+            == "sk-resolved-secret"
+        dumped = json.dumps(red)
+        for leaked in ("sk-resolved-secret", "sk-live-1", "tok-x"):
+            assert leaked not in dumped
+        assert red["api_keys"] == "***"
+        assert red["bearer_token"] == "***"
+        assert red["limits"] == {"min_tokens": "2K", "max_tokens": 256000}
+
+
+class TestLooperCredentials:
+    def test_headers_for_applied_per_candidate(self):
+        """Each fan-out call must carry the credentials resolved for ITS
+        candidate model (appendCredentialHeaders runs per upstream request
+        in the reference), and a PermissionError skips that candidate."""
+        from semantic_router_tpu.config.schema import ModelRef
+        from semantic_router_tpu.looper import Looper
+
+        seen = {}
+
+        class FakeClient:
+            def complete(self, body, model, headers=None):
+                seen[model] = dict(headers or {})
+                if model == "denied-model":
+                    raise AssertionError("denied candidate must be skipped "
+                                         "before the client is called")
+                return {"choices": [{"message": {
+                    "role": "assistant",
+                    "content": f"answer from {model} with enough substance "
+                               "to score well on the heuristic confidence "
+                               "check so the cascade stops here."}}],
+                    "usage": {"total_tokens": 3}}
+
+        def headers_for(model):
+            if model == "denied-model":
+                raise PermissionError("no credentials for denied-model")
+            return {"authorization": f"Bearer key-for-{model}"}
+
+        looper = Looper(FakeClient())
+        try:
+            res = looper.execute(
+                {"type": "confidence", "confidence": {"threshold": 0.5}},
+                [ModelRef(model="denied-model"), ModelRef(model="model-b")],
+                {"messages": [{"role": "user", "content": "q"}]},
+                headers={"x-request-id": "r1"}, headers_for=headers_for)
+        finally:
+            looper.shutdown()
+        assert res.model == "model-b"
+        assert seen["model-b"]["authorization"] == "Bearer key-for-model-b"
+        assert seen["model-b"]["x-request-id"] == "r1"
+        assert "denied-model" not in seen
+
+
 class TestResponsesEndToEnd:
     def test_responses_roundtrip_through_server(self, fixture_config_path):
         from semantic_router_tpu.config import load_config

@@ -171,6 +171,14 @@ class TestRateLimiter:
         d = rl.check("u", "m")
         assert d.allowed and d.source == "disabled"
 
+    def test_override_burst_scales_with_resolved_rpm(self):
+        # global rpm 0 + a 600-rpm per-user override: the bucket must get
+        # burst derived from 600 (=100), not capacity 1 from the global
+        rl = RateLimiter(requests_per_minute=0, per_user={"u": 600})
+        got = sum(rl.check("u", "m").allowed for _ in range(10))
+        assert got == 10
+        assert rl.check("anon", "m").allowed  # global still disabled
+
     def test_remote_first_fail_open(self):
         calls = []
 

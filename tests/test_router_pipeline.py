@@ -63,16 +63,46 @@ class TestRoutingFlow:
         assert res.model == "qwen3-8b"  # default_model
         assert res.body["model"] == "qwen3-8b"
 
-    def test_skip_processing_header(self, router):
-        res = router.route(body("anything"),
+    def test_skip_processing_header_ignored_by_default(self, router):
+        # client-forgeable bypass must be inert unless the operator opts in
+        # (SkipProcessingConfig default-disabled, pkg/config/config.go:186)
+        res = router.route(body("this is urgent, fix asap"),
                            headers={H.SKIP_PROCESSING: "true"})
-        assert res.kind == "passthrough"
+        assert res.kind == "route"
 
-    def test_skip_signals_header(self, router):
+    def test_skip_signals_header_ignored_by_default(self, router):
         res = router.route(body("this is urgent asap"),
                            headers={"x-vsr-skip-signals": "keyword"})
-        assert res.decision is None or \
-            res.decision.decision.name != "urgent_route"
+        assert res.decision is not None
+        assert res.decision.decision.name == "urgent_route"
+
+    def test_skip_processing_when_enabled(self, engine, fixture_config_path):
+        cfg = load_config(fixture_config_path)
+        cfg.skip_processing = {"enabled": True,
+                               "allow_skip_signals_header": True}
+        r = Router(cfg, engine=None)
+        try:
+            res = r.route(body("anything"),
+                          headers={H.SKIP_PROCESSING: "true"})
+            assert res.kind == "passthrough"
+            res = r.route(body("this is urgent asap"),
+                          headers={"x-vsr-skip-signals": "keyword"})
+            assert res.decision is None or \
+                res.decision.decision.name != "urgent_route"
+        finally:
+            r.shutdown()
+
+    def test_skip_signals_operator_config(self, engine, fixture_config_path):
+        # operator-configured family drop works without any request header
+        cfg = load_config(fixture_config_path)
+        cfg.skip_processing = {"skip_signals": ["keyword"]}
+        r = Router(cfg, engine=None)
+        try:
+            res = r.route(body("this is urgent asap"))
+            assert res.decision is None or \
+                res.decision.decision.name != "urgent_route"
+        finally:
+            r.shutdown()
 
 
 class TestCachePath:
