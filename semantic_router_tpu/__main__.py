@@ -57,12 +57,13 @@ def main(argv=None) -> int:
 
         from .config import load_config
         from .extproc import ExtProcServer
-        from .router import Router
-        from .runtime.bootstrap import build_engine
+        from .runtime.bootstrap import build_engine, build_router
 
         cfg = load_config(args.config)
         engine = build_engine(cfg, mock=args.mock_models)
-        router = Router(cfg, engine=engine)
+        # build_router wires replay/memory/vectorstores identically to the
+        # HTTP serve path — same config, same behavior behind Envoy
+        router = build_router(cfg, engine=engine)
         server = ExtProcServer(router, port=args.port).start()
         print(f"extproc listening on {server.address}", file=sys.stderr)
         try:

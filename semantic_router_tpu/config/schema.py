@@ -963,6 +963,11 @@ class RouterConfig:
     # x-vsr-skip-processing is honored only when enabled; skip_signals is
     # operator config, never a bare request header).
     skip_processing: Dict[str, Any] = field(default_factory=dict)
+    # external durable-state backends (state taxonomy: response store,
+    # vectorstore; cache/replay/memory carry backend fields in their own
+    # blocks)
+    response_store: Dict[str, Any] = field(default_factory=dict)
+    vectorstore: Dict[str, Any] = field(default_factory=dict)
     raw: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
@@ -989,6 +994,8 @@ class RouterConfig:
             tool_selection=dict(d.get("tool_selection", {}) or {}),
             prompt_compression=dict(d.get("prompt_compression", {}) or {}),
             skip_processing=dict(d.get("skip_processing", {}) or {}),
+            response_store=dict(d.get("response_store", {}) or {}),
+            vectorstore=dict(d.get("vectorstore", {}) or {}),
             raw=d,
         )
 

@@ -35,8 +35,9 @@ class InflightTracker:
         token = next(self._ids)
         with self._lock:
             self._entries.setdefault(model, {})[token] = time.monotonic()
-            n = self._count_locked(model)
-        inflight_gauge.set(float(n), model=model)
+            # gauge set under the lock: an interleaved begin/end outside it
+            # could publish a stale count that never self-corrects
+            inflight_gauge.set(float(self._count_locked(model)), model=model)
         return token
 
     def end(self, model: str, token: int) -> None:
@@ -46,8 +47,7 @@ class InflightTracker:
                 entries.pop(token, None)
                 if not entries:
                     self._entries.pop(model, None)
-            n = self._count_locked(model)
-        inflight_gauge.set(float(n), model=model)
+            inflight_gauge.set(float(self._count_locked(model)), model=model)
 
     def count(self, model: str) -> int:
         with self._lock:

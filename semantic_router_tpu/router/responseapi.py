@@ -57,6 +57,80 @@ class ResponseStore:
             return self._items.pop(response_id, None) is not None
 
 
+class RedisResponseStore:
+    """Redis/Valkey-backed response store (pkg/responsestore redis backend):
+    conversation threads survive restarts and are shared across replicas.
+    Same surface as ResponseStore; entries carry a server-side TTL."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379,
+                 db: int = 0, password: str = "",
+                 key_prefix: str = "vsr:resp",
+                 ttl_seconds: float = 86_400.0, client=None) -> None:
+        from ..state.resp import RedisClient
+
+        self.prefix = key_prefix
+        self.ttl_seconds = ttl_seconds
+        self.client = client or RedisClient(host, port, db, password)
+
+    def _key(self, response_id: str) -> str:
+        return f"{self.prefix}:{response_id}"
+
+    def put(self, resp: StoredResponse) -> None:
+        import json
+
+        payload = json.dumps({
+            "id": resp.id, "model": resp.model, "messages": resp.messages,
+            "created_t": resp.created_t, "metadata": resp.metadata})
+        # sub-second TTLs round up to 1s rather than silently never expiring
+        ex = max(1, int(round(self.ttl_seconds))) \
+            if self.ttl_seconds > 0 else None
+        try:
+            self.client.set(self._key(resp.id), payload, ex=ex)
+        except Exception:
+            pass  # fail open: thread continuity degrades, requests succeed
+
+    def get(self, response_id: str) -> Optional[StoredResponse]:
+        import json
+
+        try:
+            raw = self.client.get(self._key(response_id))
+            if not raw:
+                return None
+            d = json.loads(raw)
+        except Exception:
+            # unreachable store, WRONGTYPE collision, corrupt payload —
+            # all degrade to "no stored thread", never a 500
+            return None
+        return StoredResponse(id=d["id"], model=d.get("model", ""),
+                              messages=d.get("messages", []),
+                              created_t=d.get("created_t", time.time()),
+                              metadata=d.get("metadata", {}))
+
+    def delete(self, response_id: str) -> bool:
+        try:
+            return bool(self.client.delete(self._key(response_id)))
+        except Exception:
+            return False
+
+
+def build_response_store(cfg: Dict[str, Any]):
+    """Factory from the ``response_store`` config block
+    (cache_factory.go-style backend selection)."""
+    cfg = cfg or {}
+    backend = cfg.get("backend", "memory")
+    if backend in ("redis", "valkey"):
+        return RedisResponseStore(
+            host=cfg.get("host", "127.0.0.1"),
+            port=int(cfg.get("port", 6379)),
+            db=int(cfg.get("db", 0)),
+            password=str(cfg.get("password", "")),
+            key_prefix=cfg.get("key_prefix", "vsr:resp"),
+            ttl_seconds=float(cfg.get("ttl_seconds", 86_400.0)))
+    return ResponseStore(
+        max_entries=int(cfg.get("max_entries", 10_000)),
+        ttl_seconds=float(cfg.get("ttl_seconds", 86_400.0)))
+
+
 def _input_to_messages(inp: Any) -> List[dict]:
     """Responses API `input` (string | item list) → chat messages."""
     if isinstance(inp, str):

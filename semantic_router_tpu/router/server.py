@@ -96,10 +96,11 @@ class RouterServer:
                                               thread_name_prefix="looper")
 
         from .authz import CredentialResolver
-        from .responseapi import ResponseStore
+        from .responseapi import build_response_store
 
         self.credentials = CredentialResolver.from_config(cfg.authz)
-        self.response_store = ResponseStore()
+        self.response_store = build_response_store(
+            getattr(cfg, "response_store", {}))
 
         handler = self._make_handler()
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)

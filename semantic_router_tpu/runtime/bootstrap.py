@@ -124,14 +124,34 @@ def build_router(cfg: RouterConfig, engine=None,
             router.replay_store = carry_from.replay_store
         return router
 
-    router.memory_store = InMemoryMemoryStore(embed_fn)
-    router.vectorstores = VectorStoreManager(embed_fn)
+    # memory backend (pkg/memory external stores role)
+    mem_cfg = cfg.memory or {}
+    if mem_cfg.get("backend") == "sqlite" and mem_cfg.get("path"):
+        from ..memory.sqlite_store import SQLiteMemoryStore
+
+        router.memory_store = SQLiteMemoryStore(mem_cfg["path"], embed_fn)
+    else:
+        router.memory_store = InMemoryMemoryStore(embed_fn)
+
+    # vectorstore backend (pkg/vectorstore registry role)
+    vs_cfg = cfg.vectorstore or {}
+    router.vectorstores = VectorStoreManager(
+        embed_fn, backend=vs_cfg.get("backend", "memory"),
+        base_path=vs_cfg.get("path"))
 
     replay_cfg = cfg.router_replay or {}
     if replay_cfg.get("enabled", True):
-        store = ReplayStore(
-            max_records=int(replay_cfg.get("max_records", 10_000)),
-            path=replay_path or replay_cfg.get("path"))
+        if replay_cfg.get("backend") == "sqlite" \
+                and (replay_path or replay_cfg.get("path")):
+            from ..replay.sqlite_store import SQLiteReplayStore
+
+            store = SQLiteReplayStore(
+                replay_path or replay_cfg["path"],
+                max_records=int(replay_cfg.get("max_records", 100_000)))
+        else:
+            store = ReplayStore(
+                max_records=int(replay_cfg.get("max_records", 10_000)),
+                path=replay_path or replay_cfg.get("path"))
         router.replay_store = store
         router.response_hooks.append(ReplayRecorder(
             store,

@@ -140,19 +140,28 @@ class RedisClient:
                             f"redis {self.host}:{self.port} unreachable")
 
     def pipeline(self, commands: List[Tuple]) -> List[Any]:
-        """Send N commands in one write, read N replies (RESP pipelining)."""
+        """Send N commands in one write, read N replies (RESP pipelining).
+        Same error contract as execute(): one reconnect retry, then
+        ConnectionError_ — never a raw OSError."""
         with self._lock:
-            if self._sock is None:
-                self._connect()
-            payload = b"".join(encode_command(*c) for c in commands)
-            self._sock.sendall(payload)
-            out = []
-            for _ in commands:
+            for attempt in range(self.retries + 1):
                 try:
-                    out.append(self._reader.read_reply())
-                except RespError as e:
-                    out.append(e)
-            return out
+                    if self._sock is None:
+                        self._connect()
+                    payload = b"".join(encode_command(*c) for c in commands)
+                    self._sock.sendall(payload)
+                    out = []
+                    for _ in commands:
+                        try:
+                            out.append(self._reader.read_reply())
+                        except RespError as e:
+                            out.append(e)
+                    return out
+                except (OSError, ConnectionError_):
+                    self.close_nolock()
+                    if attempt == self.retries:
+                        raise ConnectionError_(
+                            f"redis {self.host}:{self.port} unreachable")
 
     # -- convenience wrappers -------------------------------------------
 
@@ -253,14 +262,11 @@ class MiniRedis:
         self._listener.listen(64)
         self.host, self.port = self._listener.getsockname()
         self._running = False
-        self._threads: List[threading.Thread] = []
 
     def start(self) -> "MiniRedis":
         self._running = True
-        t = threading.Thread(target=self._accept_loop, daemon=True,
-                             name="miniredis-accept")
-        t.start()
-        self._threads.append(t)
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="miniredis-accept").start()
         return self
 
     def stop(self) -> None:
@@ -278,10 +284,10 @@ class MiniRedis:
                 conn, _ = self._listener.accept()
             except OSError:
                 return
-            t = threading.Thread(target=self._serve_conn, args=(conn,),
-                                 daemon=True, name="miniredis-conn")
-            t.start()
-            self._threads.append(t)
+            # daemon threads are not tracked: retaining a Thread object per
+            # connection would leak in long-lived dev servers
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="miniredis-conn").start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
         reader = _Reader(conn)
@@ -300,6 +306,11 @@ class MiniRedis:
                     reply = self._dispatch(name, cmd[1:])
                 except RespError as e:
                     conn.sendall(b"-ERR " + str(e).encode() + b"\r\n")
+                    continue
+                except Exception as e:  # malformed args must not kill the
+                    # connection silently — real Redis replies with -ERR
+                    conn.sendall(b"-ERR " + type(e).__name__.encode()
+                                 + b": " + str(e).encode()[:200] + b"\r\n")
                     continue
                 if reply == "__QUIT__":
                     conn.sendall(b"+OK\r\n")
@@ -427,7 +438,16 @@ class MiniRedis:
 
     def _cmd_incrby(self, name, args):
         key, by = args[0], int(args[1])
-        cur = int(self._data.get(key, b"0")) if self._alive(key) else 0
+        if self._alive(key):
+            v = self._data[key]
+            if not isinstance(v, bytes):
+                raise RespError("WRONGTYPE")
+            try:
+                cur = int(v)
+            except ValueError:
+                raise RespError("value is not an integer or out of range")
+        else:
+            cur = 0
         cur += by
         self._data[key] = str(cur).encode()
         return self._int(cur)
@@ -494,6 +514,8 @@ class MiniRedis:
         if not self._alive(key):
             return self._int(0)
         h = self._data[key]
+        if not isinstance(h, dict):
+            raise RespError("WRONGTYPE")
         n = 0
         for fld in args[1:]:
             if fld in h:

@@ -160,29 +160,69 @@ class InMemoryVectorStore:
 
 
 class VectorStoreManager:
-    """Named stores + registry (manager.go / metadata registry role)."""
+    """Named stores + registry (manager.go / metadata registry role).
 
-    def __init__(self, embed_fn: Optional[Callable] = None) -> None:
+    ``backend="sqlite"`` + ``base_path`` makes every named store durable
+    (one DB file per store under base_path); previously-persisted stores
+    are re-attached lazily by name after a restart."""
+
+    def __init__(self, embed_fn: Optional[Callable] = None,
+                 backend: str = "memory",
+                 base_path: Optional[str] = None) -> None:
         self.embed_fn = embed_fn
+        self.backend = backend
+        self.base_path = base_path
         self._stores: Dict[str, InMemoryVectorStore] = {}
         self._lock = threading.Lock()
 
+    def _new_store(self, name: str, **kwargs) -> InMemoryVectorStore:
+        if self.backend == "sqlite":
+            import os
+
+            from .sqlite_store import SQLiteVectorStore
+
+            base = self.base_path or "."
+            os.makedirs(base, exist_ok=True)
+            return SQLiteVectorStore(
+                os.path.join(base, f"{name}.vectorstore.db"),
+                embed_fn=self.embed_fn, **kwargs)
+        return InMemoryVectorStore(self.embed_fn, **kwargs)
+
+    def _db_path(self, name: str) -> str:
+        import os
+
+        return os.path.join(self.base_path or ".", f"{name}.vectorstore.db")
+
     def create(self, name: str, **kwargs) -> InMemoryVectorStore:
+        import os
+
         with self._lock:
-            if name in self._stores:
+            if name in self._stores or (
+                    self.backend == "sqlite"
+                    and os.path.exists(self._db_path(name))):
                 raise ValueError(f"store {name!r} exists")
-            store = InMemoryVectorStore(self.embed_fn, **kwargs)
+            store = self._new_store(name, **kwargs)
             self._stores[name] = store
             return store
 
     def get(self, name: str) -> Optional[InMemoryVectorStore]:
+        import os
+
         with self._lock:
-            return self._stores.get(name)
+            store = self._stores.get(name)
+            if store is None and self.backend == "sqlite" \
+                    and os.path.exists(self._db_path(name)):
+                store = self._new_store(name)  # re-attach persisted store
+                self._stores[name] = store
+            return store
 
     def get_or_create(self, name: str) -> InMemoryVectorStore:
+        existing = self.get(name)
+        if existing is not None:
+            return existing
         with self._lock:
             if name not in self._stores:
-                self._stores[name] = InMemoryVectorStore(self.embed_fn)
+                self._stores[name] = self._new_store(name)
             return self._stores[name]
 
     def list(self) -> List[str]:
@@ -190,8 +230,19 @@ class VectorStoreManager:
             return sorted(self._stores)
 
     def delete(self, name: str) -> bool:
+        import os
+
         with self._lock:
-            return self._stores.pop(name, None) is not None
+            store = self._stores.pop(name, None)
+            if store is not None and hasattr(store, "close"):
+                store.close()
+            if self.backend == "sqlite" \
+                    and os.path.exists(self._db_path(name)):
+                # remove the persisted file even when the store was never
+                # re-attached this process — otherwise it resurrects
+                os.remove(self._db_path(name))
+                return True
+            return store is not None
 
 
 def format_rag_context(hits: Sequence[SearchHit],

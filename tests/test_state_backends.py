@@ -1,0 +1,376 @@
+"""External state backends (reference: pkg/cache/cache_factory.go,
+pkg/responsestore, pkg/routerreplay/store/, pkg/vectorstore registries,
+docs/architecture/state-taxonomy-and-inventory.md).
+
+Covers the RESP wire client against the embedded server over real sockets,
+every durable backend's restart story (new instance, same store → state
+survives), and the bootstrap factory wiring.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from semantic_router_tpu.state.resp import MiniRedis, RedisClient
+
+
+@pytest.fixture(scope="module")
+def mini():
+    server = MiniRedis().start()
+    yield server
+    server.stop()
+
+
+@pytest.fixture()
+def client(mini):
+    c = RedisClient(port=mini.port)
+    c.flushdb()
+    yield c
+    c.close()
+
+
+def embed(text):
+    rng = np.random.default_rng(abs(hash(text)) % 2**31)
+    v = rng.normal(size=48).astype(np.float32)
+    return v / np.linalg.norm(v)
+
+
+class TestRespProtocol:
+    def test_strings_ttl_and_counters(self, client):
+        assert client.ping()
+        assert client.set("k", "v")
+        assert client.get("k") == b"v"
+        assert client.set("tmp", "x", ex=50)
+        assert 0 < client.ttl("tmp") <= 50
+        assert client.ttl("k") == -1
+        assert client.ttl("missing") == -2
+        assert client.incr("n") == 1
+        assert client.incr("n", 5) == 6
+        assert client.delete("k", "n") == 2
+        assert client.get("k") is None
+
+    def test_expiry_enforced(self, client):
+        client.execute("SET", "gone", "x", "PX", 30)  # 30ms
+        assert client.get("gone") == b"x"
+        time.sleep(0.06)
+        assert client.get("gone") is None
+        assert not client.exists("gone")
+
+    def test_hashes_and_binary_values(self, client):
+        blob = bytes(range(256))
+        client.hset("h", {"a": "1", "emb": blob})
+        assert client.hget("h", "a") == b"1"
+        assert client.hgetall("h")[b"emb"] == blob
+        assert client.execute("HDEL", "h", "a") == 1
+        assert client.hget("h", "a") is None
+
+    def test_scan_and_keys_patterns(self, client):
+        for i in range(5):
+            client.set(f"pfx:{i}", "v")
+        client.set("other", "v")
+        assert sorted(client.scan_iter("pfx:*")) == \
+            [f"pfx:{i}".encode() for i in range(5)]
+        assert client.keys("other") == [b"other"]
+
+    def test_pipeline(self, client):
+        out = client.pipeline([("SET", "p1", "a"), ("SET", "p2", "b"),
+                               ("GET", "p1"), ("GET", "p2")])
+        assert out == ["OK", "OK", b"a", b"b"]
+
+    def test_wrongtype_error(self, client):
+        from semantic_router_tpu.state.resp import RespError
+
+        client.hset("h2", {"f": "v"})
+        with pytest.raises(RespError):
+            client.get("h2")
+
+    def test_reconnect_after_server_side_close(self, client):
+        assert client.ping()
+        client.execute("QUIT")
+        # next command reconnects transparently (retries=1)
+        assert client.ping()
+
+
+class TestRedisSemanticCache:
+    def test_restart_durability_and_stats(self, mini):
+        from semantic_router_tpu.cache.redis_cache import RedisSemanticCache
+
+        c1 = RedisSemanticCache(embed, port=mini.port,
+                                key_prefix="t1:cache", ttl_seconds=300)
+        c1.clear()
+        c1.add("how do I sort a list in python", "use sorted()", model="m1")
+        c1.add("what is the capital of france", "paris", model="m2")
+        assert c1.stats().entries == 2
+        hit = c1.find_similar("how do I sort a list in python")
+        assert hit is not None and hit.response == "use sorted()"
+
+        # "restart": a fresh instance rebuilds the mirror from the store
+        c2 = RedisSemanticCache(embed, port=mini.port,
+                                key_prefix="t1:cache", ttl_seconds=300)
+        assert c2.stats().entries == 2
+        hit2 = c2.find_similar("what is the capital of france")
+        assert hit2 is not None and hit2.response == "paris"
+        assert hit2.model == "m2"
+
+    def test_server_side_expiry_counts_as_miss(self, mini):
+        from semantic_router_tpu.cache.redis_cache import RedisSemanticCache
+
+        c = RedisSemanticCache(embed, port=mini.port,
+                               key_prefix="t2:cache", ttl_seconds=1)
+        c.clear()
+        c.add("ephemeral question", "answer")
+        # expire server-side behind the mirror's back
+        cli = RedisClient(port=mini.port)
+        for key in cli.scan_iter("t2:cache:entry:*"):
+            cli.execute("PEXPIRE", key, 1) if False else \
+                cli.execute("EXPIRE", key, 0)
+        time.sleep(0.01)
+        assert c.find_similar("ephemeral question") is None
+        assert c.stats().entries == 0  # dropped from mirror
+
+    def test_invalidate_and_clear(self, mini):
+        from semantic_router_tpu.cache.redis_cache import RedisSemanticCache
+
+        c = RedisSemanticCache(embed, port=mini.port,
+                               key_prefix="t3:cache", ttl_seconds=300)
+        c.clear()
+        c.add("query one", "resp one")
+        c.add("query two", "resp two")
+        c.invalidate("query one")
+        assert c.find_similar("query one") is None
+        c.clear()
+        assert c.stats().entries == 0
+
+    def test_unreachable_store_fails_open(self):
+        from semantic_router_tpu.cache.redis_cache import RedisSemanticCache
+
+        c = RedisSemanticCache(embed, port=1, ttl_seconds=300)  # nothing there
+        c.add("q", "r")  # no raise
+        assert c.find_similar("q") is None
+        assert c.stats().errors >= 1
+
+
+class TestRedisResponseStore:
+    def test_round_trip_and_restart(self, mini):
+        from semantic_router_tpu.router.responseapi import (
+            RedisResponseStore,
+            StoredResponse,
+        )
+
+        s1 = RedisResponseStore(port=mini.port, key_prefix="t:resp")
+        s1.put(StoredResponse(id="resp_1", model="m",
+                              messages=[{"role": "user", "content": "hi"},
+                                        {"role": "assistant",
+                                         "content": "hello"}],
+                              metadata={"user": "u1"}))
+        s2 = RedisResponseStore(port=mini.port, key_prefix="t:resp")
+        got = s2.get("resp_1")
+        assert got is not None
+        assert got.messages[1]["content"] == "hello"
+        assert got.metadata == {"user": "u1"}
+        assert s2.delete("resp_1")
+        assert s2.get("resp_1") is None
+
+    def test_factory_selects_backend(self, mini):
+        from semantic_router_tpu.router.responseapi import (
+            RedisResponseStore,
+            ResponseStore,
+            build_response_store,
+        )
+
+        assert isinstance(build_response_store({}), ResponseStore)
+        assert isinstance(
+            build_response_store({"backend": "redis", "port": mini.port}),
+            RedisResponseStore)
+
+
+class TestSQLiteReplayStore:
+    def test_restart_filters_and_retention(self, tmp_path):
+        from semantic_router_tpu.replay.recorder import ReplayRecord
+        from semantic_router_tpu.replay.sqlite_store import SQLiteReplayStore
+
+        path = str(tmp_path / "replay.db")
+        s1 = SQLiteReplayStore(path, max_records=50)
+        now = time.time()
+        for i in range(10):
+            s1.add(ReplayRecord(
+                record_id=f"r{i}", request_id=f"req{i}",
+                timestamp=now + i,
+                decision="urgent" if i % 2 else "code",
+                model=f"m{i % 3}", confidence=0.5 + i / 100))
+        assert len(s1) == 10
+        s1.close()
+
+        s2 = SQLiteReplayStore(path)
+        assert len(s2) == 10
+        urgent = s2.list(decision="urgent")
+        assert len(urgent) == 5 and all(r.decision == "urgent"
+                                        for r in urgent)
+        assert len(s2.list(model="m0")) == 4
+        assert len(s2.list(since=now + 7)) == 3
+        got = s2.get("r3")
+        assert got is not None and got.request_id == "req3"
+        # newest-first ordering
+        listed = s2.list(limit=3)
+        assert [r.record_id for r in listed] == ["r9", "r8", "r7"]
+        s2.close()
+
+    def test_bounded_retention(self, tmp_path):
+        from semantic_router_tpu.replay.recorder import ReplayRecord
+        from semantic_router_tpu.replay.sqlite_store import SQLiteReplayStore
+
+        s = SQLiteReplayStore(str(tmp_path / "r.db"), max_records=5)
+        for i in range(12):
+            s.add(ReplayRecord(record_id=f"r{i}", request_id="x",
+                               timestamp=time.time() + i))
+        assert len(s) == 5
+        assert s.get("r0") is None and s.get("r11") is not None
+        s.close()
+
+
+class TestSQLiteVectorStore:
+    def test_ingest_search_restart_delete(self, tmp_path):
+        from semantic_router_tpu.vectorstore.sqlite_store import (
+            SQLiteVectorStore,
+        )
+
+        path = str(tmp_path / "vs.db")
+        s1 = SQLiteVectorStore(path, embed_fn=embed)
+        doc = s1.ingest("guide", "Sorting in python uses sorted. "
+                                 "Dictionaries map keys to values. "
+                                 "Lists are ordered collections.",
+                        metadata={"lang": "en"})
+        assert s1.stats()["documents"] == 1
+        s1.close()
+
+        s2 = SQLiteVectorStore(path, embed_fn=embed)
+        assert s2.stats() == s1.stats()
+        hits = s2.search("python sorted", top_k=2)
+        assert hits and "sorted" in hits[0].chunk.text.lower()
+        assert hits[0].chunk.metadata["lang"] == "en"
+        assert s2.delete_document(doc.id)
+        s2.close()
+
+        s3 = SQLiteVectorStore(path, embed_fn=embed)
+        assert s3.stats()["documents"] == 0
+        s3.close()
+
+    def test_manager_sqlite_backend_reattach(self, tmp_path):
+        from semantic_router_tpu.vectorstore import VectorStoreManager
+
+        m1 = VectorStoreManager(embed, backend="sqlite",
+                                base_path=str(tmp_path))
+        m1.get_or_create("kb").ingest("doc", "Grapes grow on vines.")
+        # fresh manager (restart): store re-attaches lazily by name
+        m2 = VectorStoreManager(embed, backend="sqlite",
+                                base_path=str(tmp_path))
+        store = m2.get("kb")
+        assert store is not None
+        assert store.stats()["documents"] == 1
+        assert m2.delete("kb")
+        m3 = VectorStoreManager(embed, backend="sqlite",
+                                base_path=str(tmp_path))
+        assert m3.get("kb") is None  # file removed
+
+
+class TestSQLiteMemoryStore:
+    def test_remember_restart_search_delete(self, tmp_path):
+        from semantic_router_tpu.memory.sqlite_store import SQLiteMemoryStore
+
+        path = str(tmp_path / "mem.db")
+        s1 = SQLiteMemoryStore(path, embed)
+        s1.remember("u1", "prefers metric units", kind="preference")
+        s1.remember("u1", "works on compilers")
+        s1.remember("u2", "allergic to peanuts")
+        s1.close()
+
+        s2 = SQLiteMemoryStore(path, embed)
+        assert len(s2.list("u1")) == 2
+        assert len(s2.list("u2")) == 1
+        found = s2.search("u1", "compilers", limit=1)
+        assert found and "compilers" in found[0].text
+        item = s2.list("u2")[0]
+        assert s2.delete("u2", item.id)
+        s2.close()
+
+        s3 = SQLiteMemoryStore(path, embed)
+        assert s3.list("u2") == []
+        s3.close()
+
+    def test_dedup_consolidation_persists(self, tmp_path):
+        from semantic_router_tpu.memory.sqlite_store import SQLiteMemoryStore
+
+        path = str(tmp_path / "mem2.db")
+        s1 = SQLiteMemoryStore(path, embed)
+        s1.remember("u", "loves coffee")
+        s1.remember("u", "loves coffee")  # dedup: refresh, not duplicate
+        assert len(s1.list("u")) == 1
+        s1.close()
+        s2 = SQLiteMemoryStore(path, embed)
+        assert len(s2.list("u")) == 1
+        s2.close()
+
+
+class TestRouterRestartE2E:
+    def test_cache_and_replay_survive_router_restart(self, mini, tmp_path,
+                                                     fixture_config_path):
+        """Full restart story: route → respond → shut down the router →
+        rebuild from the same config → the semantic cache answers from the
+        external store and replay history is intact."""
+        from semantic_router_tpu.cache.redis_cache import RedisSemanticCache
+        from semantic_router_tpu.config import load_config
+        from semantic_router_tpu.runtime.bootstrap import build_router
+
+        def make_cfg():
+            cfg = load_config(fixture_config_path)
+            cfg.semantic_cache.backend_type = "redis"
+            cfg.semantic_cache.enabled = True
+            cfg.semantic_cache.backend_config = {
+                "port": mini.port, "key_prefix": "e2e:cache"}
+            cfg.router_replay = {"enabled": True, "backend": "sqlite",
+                                 "path": str(tmp_path / "replay.db")}
+            cfg.memory = {"backend": "sqlite",
+                          "path": str(tmp_path / "memory.db")}
+            return cfg
+
+        q = {"model": "auto", "messages": [
+            {"role": "user", "content":
+             "please debug the persistent cache function code"}]}
+
+        cfg = make_cfg()
+        r1 = build_router(cfg)
+        # engine=None → no embed; install the redis cache directly (the
+        # factory path needs an embedding engine)
+        r1.cache = RedisSemanticCache(embed, port=mini.port,
+                                      key_prefix="e2e:cache",
+                                      ttl_seconds=300)
+        r1.cache.clear()
+        route = r1.route(q)
+        assert route.kind == "route"
+        r1.process_response(route, {
+            "choices": [{"message": {"role": "assistant",
+                                     "content": "use a debugger"},
+                         "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": 4, "completion_tokens": 3}})
+        r1.memory_store.remember("u1", "debugging a cache")
+        assert len(r1.replay_store) >= 1
+        r1.replay_store.close()
+        r1.memory_store.close()
+        r1.shutdown()
+
+        # restart
+        cfg2 = make_cfg()
+        r2 = build_router(cfg2)
+        r2.cache = RedisSemanticCache(embed, port=mini.port,
+                                      key_prefix="e2e:cache",
+                                      ttl_seconds=300)
+        second = r2.route(q)
+        assert second.kind == "cache_hit"
+        assert second.response_body["choices"][0]["message"]["content"] \
+            == "use a debugger"
+        assert len(r2.replay_store) >= 1
+        assert r2.memory_store.list("u1")
+        r2.replay_store.close()
+        r2.memory_store.close()
+        r2.shutdown()
