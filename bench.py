@@ -96,11 +96,33 @@ def _force_cpu() -> None:
         pass
 
 
+def _reexec_cpu_isolated() -> int:
+    """Re-exec this script with the ambient sitecustomize stripped
+    (PYTHONPATH cleared) and CPU forced.  When the TPU tunnel is wedged,
+    even ``import jax`` in THIS process can hang inside the ambient
+    plugin's registration hook — a clean child is the only reliable
+    fallback.  The child's stdout (the JSON line) passes through."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SRT_BENCH_CPU_DIRECT"] = "1"
+    proc = subprocess.run([sys.executable, "-u", os.path.abspath(__file__)],
+                          env=env)
+    return proc.returncode
+
+
 def main() -> None:
+    if os.environ.get("SRT_BENCH_CPU_DIRECT"):
+        _force_cpu()
+        _run_bench("cpu")
+        return
     platform = _probe_tpu()
     if platform is None or platform == "cpu":
-        _force_cpu()
-        platform = "cpu"
+        raise SystemExit(_reexec_cpu_isolated())
+    _run_bench(platform)
+
+
+def _run_bench(platform: str) -> None:
     sys.stderr.write(f"bench: running on platform={platform}\n")
 
     import jax

@@ -62,13 +62,15 @@ class TokenClassResult:
 @dataclass
 class _Task:
     name: str
-    kind: str  # "sequence" | "token" | "embedding"
+    kind: str  # "sequence" | "token" | "embedding" | "generative"
     labels: List[str]
     tokenizer: Tokenizer
     apply_fn: Callable  # jitted (params, ids, mask, ...) -> logits/embeddings
     params: Any
     max_seq_len: int
     pad_id: int = 0
+    generator: Any = None  # generative kind: models.generate.GreedyGenerator
+    adapter_index: Dict[str, int] = field(default_factory=dict)
 
 
 @dataclass
@@ -94,6 +96,10 @@ class InferenceEngine:
             max_wait_ms=self.cfg.max_wait_ms,
             name="tpu-engine-batcher",
         )
+        # generative decode mutates per-generator jit/cache state; one
+        # generation runs on-device at a time (decode steps saturate the
+        # chip anyway — concurrency comes from the classify batcher)
+        self._generative_lock = threading.Lock()
 
     # -- registration ------------------------------------------------------
 
@@ -114,8 +120,61 @@ class InferenceEngine:
             self._tasks[name] = _Task(name, kind, list(labels), tokenizer,
                                       apply_fn, params, max_len, pad_id)
 
+    def register_generative(self, name: str, generator,
+                            labels: Optional[List[str]] = None,
+                            adapter_index: Optional[Dict[str, int]] = None
+                            ) -> None:
+        """Register a KV-cached greedy generator as a "generative" task
+        (qwen3_multi_lora_classifier.rs / qwen3_guard.rs serving role).
+        ``adapter_index`` maps logical adapter names → LoRA task rows so a
+        request can select its adapter by name (O(1) swap, no recompile)."""
+        with self._lock:
+            self._tasks[name] = _Task(
+                name, "generative", list(labels or []),
+                generator.tokenizer, None, None, 0,
+                generator=generator, adapter_index=dict(adapter_index or {}))
+
+    def generate(self, task: str, prompts: Sequence[str],
+                 max_new_tokens: int = 64, adapter: str = "",
+                 stop_strings: Sequence[str] = ()) -> List[Any]:
+        """Greedy generation on a generative task; ``adapter`` selects the
+        LoRA row by name (generative multi-LoRA per-request selection)."""
+        t = self._require(task, kind="generative")
+        if adapter:
+            if adapter not in t.adapter_index:
+                # a silent row-0 fallback would run the WRONG safety/LoRA
+                # policy on config drift — fail loudly instead
+                raise KeyError(
+                    f"unknown adapter {adapter!r} for task {task!r} "
+                    f"(known: {sorted(t.adapter_index)})")
+            task_index = t.adapter_index[adapter]
+        else:
+            task_index = 0
+        with self._generative_lock:
+            return t.generator.generate(list(prompts),
+                                        max_new_tokens=max_new_tokens,
+                                        task_index=task_index,
+                                        stop_strings=stop_strings)
+
+    def guard_classify(self, task: str, text: str, role: str = "user",
+                       adapter: str = "", max_new_tokens: int = 32):
+        """Qwen3Guard-style safety classification: structured-output
+        generation + regex parse (qwen3_guard.rs:513). Returns a
+        GuardVerdict; parse failures fail closed to Controversial."""
+        from ..models.generate import build_guard_prompt, parse_guard_output
+
+        prompt = build_guard_prompt(text, role=role)
+        out = self.generate(task, [prompt], max_new_tokens=max_new_tokens,
+                            adapter=adapter)
+        return parse_guard_output(out[0].text)
+
     def has_task(self, name: str) -> bool:
         return name in self._tasks
+
+    def task_kind(self, name: str) -> str:
+        """"sequence" | "token" | "embedding" | "generative" | "" (absent)."""
+        t = self._tasks.get(name)
+        return t.kind if t is not None else ""
 
     def task_labels(self, name: str) -> List[str]:
         return list(self._tasks[name].labels)
@@ -209,7 +268,8 @@ class InferenceEngine:
                            f"(known: {sorted(self._tasks)})")
         if kind is not None and t.kind != kind:
             right_call = {"token": "token_classify", "sequence": "classify",
-                          "embedding": "embed"}[t.kind]
+                          "embedding": "embed",
+                          "generative": "generate"}[t.kind]
             raise TypeError(
                 f"task {task!r} is a {t.kind} task; use {right_call}()")
         return t

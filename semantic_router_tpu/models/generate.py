@@ -1,0 +1,435 @@
+"""Generative serving: KV-cached incremental decoding for Qwen3.
+
+Reference capabilities re-designed TPU-first:
+- qwen3_guard.rs (safety generation: greedy short-generation + structured
+  regex parse) and qwen3_multi_lora_classifier.rs:1-60 (multi-LoRA
+  generative classification with per-request adapter selection).
+
+Design notes (XLA-native, no torch-style dynamic shapes):
+- The KV cache is an explicit pytree of fixed-shape arrays
+  ``[B, KV_heads, M, head_dim]`` updated with ``lax.dynamic_update_slice``
+  at a uniform column offset — prompt tokens fill columns ``0..S`` (padding
+  columns are masked forever), decode step ``t`` writes column ``S+t``.
+  Every step is a fixed-shape jitted program: two compilations total per
+  (batch, prompt-bucket, cache-length) triple, then O(1) per token.
+- RoPE uses per-row absolute positions (right-padded prompts keep their
+  true lengths), gathered from the precomputed float32 tables.
+- Multi-LoRA rides the same stacked-adapter LoRADense as the classifier
+  trunk: ``task_index`` is a traced integer → switching adapters per
+  request is a gather, never a recompile.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from ..ops.rope import RopeSpec, rotate_half
+from .lora import LoRAConfig, LoRADense
+from .qwen3 import Qwen3Config, RMSNorm
+
+NEG_INF = -1e30
+
+
+def _rotary_at(x: jnp.ndarray, cos: jnp.ndarray,
+               sin: jnp.ndarray) -> jnp.ndarray:
+    """Apply RoPE to ``x [B, H, S, D]`` with per-position tables
+    ``cos/sin [B, 1, S, D]`` (already gathered at absolute positions)."""
+    xf = x.astype(jnp.float32)
+    out = xf * cos + rotate_half(xf) * sin
+    return out.astype(x.dtype)
+
+
+class _DecodeAttention(nn.Module):
+    """Qwen3 attention reading/writing an explicit KV cache. Same param
+    tree as Qwen3Attention (q/k/v/o_proj + q/k_norm) so pretrained weights
+    transplant unchanged."""
+
+    config: Qwen3Config
+    layer_id: int
+    lora: Optional[LoRAConfig] = None
+
+    @nn.compact
+    def __call__(self, x, k_cache, v_cache, cache_mask, positions,
+                 write_index, cos_full, sin_full, task_index):
+        cfg = self.config
+        B, S, _ = x.shape
+        H, KV, D = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                    cfg.head_dim)
+        M = k_cache.shape[2]
+
+        def dense(features, name):
+            if self.lora is not None:
+                layer = LoRADense(features, self.lora,
+                                  use_bias=cfg.attention_bias, name=name)
+                return lambda h: layer(h, task_index)
+            layer = nn.Dense(features, use_bias=cfg.attention_bias,
+                             name=name, dtype=cfg.dtype)
+            return layer
+
+        q = dense(H * D, "q_proj")(x).reshape(B, S, H, D)
+        k = dense(KV * D, "k_proj")(x).reshape(B, S, KV, D)
+        v = dense(KV * D, "v_proj")(x).reshape(B, S, KV, D)
+        q = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="q_norm")(q)
+        k = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="k_norm")(k)
+        q = jnp.moveaxis(q, 2, 1)  # [B, H, S, D]
+        k = jnp.moveaxis(k, 2, 1)  # [B, KV, S, D]
+        v = jnp.moveaxis(v, 2, 1)
+
+        # RoPE at absolute positions [B, S]
+        cos = jnp.take(cos_full, positions, axis=0)[:, None]  # [B,1,S,D]
+        sin = jnp.take(sin_full, positions, axis=0)[:, None]
+        q = _rotary_at(q, cos, sin)
+        k = _rotary_at(k, cos, sin)
+
+        # write current k/v into the cache at the uniform column offset
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, write_index, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, write_index, 0))
+
+        kk, vv = k_cache, v_cache
+        if KV != H:  # GQA broadcast over the full cache
+            rep = H // KV
+            kk = jnp.repeat(kk, rep, axis=1)
+            vv = jnp.repeat(vv, rep, axis=1)
+
+        scores = jnp.einsum(
+            "bhsd,bhmd->bhsm", q.astype(jnp.float32),
+            kk.astype(jnp.float32)) / jnp.sqrt(float(D))
+        # validity: cache_mask [B, M] marks live columns (prompt padding
+        # stays dead forever); causality: column c visible to the token at
+        # absolute write position write_index+s iff c <= write_index+s
+        col = jnp.arange(M)
+        row_pos = write_index + jnp.arange(S)
+        causal = (col[None, :] <= row_pos[:, None])  # [S, M]
+        bias = jnp.where(cache_mask[:, None, None, :]
+                         & causal[None, None, :, :], 0.0, NEG_INF)
+        out = jnp.einsum(
+            "bhsm,bhmd->bhsd",
+            jax.nn.softmax(scores + bias, axis=-1), vv.astype(jnp.float32))
+        out = jnp.moveaxis(out.astype(cfg.dtype), 1, 2).reshape(B, S, H * D)
+        return dense(cfg.hidden_size, "o_proj")(out), k_cache, v_cache
+
+
+class _DecodeMLP(nn.Module):
+    config: Qwen3Config
+    lora: Optional[LoRAConfig] = None
+
+    @nn.compact
+    def __call__(self, x, task_index):
+        cfg = self.config
+
+        def dense(features, name):
+            if self.lora is not None:
+                layer = LoRADense(features, self.lora, use_bias=False,
+                                  name=name)
+                return lambda h: layer(h, task_index)
+            return nn.Dense(features, use_bias=False, name=name,
+                            dtype=cfg.dtype)
+
+        gate = dense(cfg.intermediate_size, "gate_proj")(x)
+        up = dense(cfg.intermediate_size, "up_proj")(x)
+        return dense(cfg.hidden_size, "down_proj")(jax.nn.silu(gate) * up)
+
+
+class Qwen3Decoder(nn.Module):
+    """KV-cached Qwen3 causal LM (param tree matches Qwen3ForCausalLM, so
+    ``qwen3_params_from_state_dict`` output loads directly; LoRA adds
+    lora_A/lora_B leaves on top of the same base names)."""
+
+    config: Qwen3Config
+    lora: Optional[LoRAConfig] = None
+
+    @nn.compact
+    def __call__(self, input_ids, kv_caches, cache_mask, positions,
+                 write_index, task_index=0):
+        cfg = self.config
+        task_index = jnp.asarray(task_index)
+        M = kv_caches[0][0].shape[2]
+        spec = RopeSpec(cfg.head_dim, cfg.rope_theta,
+                        yarn=dict(cfg.rope_scaling)
+                        if cfg.rope_scaling
+                        and cfg.rope_scaling.get(
+                            "rope_type",
+                            cfg.rope_scaling.get("type")) == "yarn"
+                        else None)
+        cos_full, sin_full = spec.tables(M)
+
+        # trunk scoped under "model" to mirror Qwen3ForCausalLM's tree
+        class _Trunk(nn.Module):
+            config: Qwen3Config
+            lora: Optional[LoRAConfig]
+
+            @nn.compact
+            def __call__(self, input_ids, kv_caches, cache_mask, positions,
+                         write_index, task_index):
+                cfg = self.config
+                x = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                             name="embed_tokens", dtype=cfg.dtype)(input_ids)
+                new_caches = []
+                for i in range(cfg.num_hidden_layers):
+                    k_cache, v_cache = kv_caches[i]
+                    layer_out, k_cache, v_cache = Qwen3DecodeLayer(
+                        cfg, i, self.lora, name=f"layers_{i}")(
+                        x, k_cache, v_cache, cache_mask, positions,
+                        write_index, cos_full, sin_full, task_index)
+                    x = layer_out
+                    new_caches.append((k_cache, v_cache))
+                x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)
+                return x, new_caches
+
+        hidden, new_caches = _Trunk(cfg, self.lora, name="model")(
+            input_ids, kv_caches, cache_mask, positions, write_index,
+            task_index)
+        if cfg.tie_word_embeddings:
+            embed = self.variables["params"]["model"]["embed_tokens"][
+                "embedding"]
+            logits = hidden @ embed.T.astype(cfg.dtype)
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False,
+                              name="lm_head", dtype=cfg.dtype)(hidden)
+        return logits, new_caches
+
+
+class Qwen3DecodeLayer(nn.Module):
+    config: Qwen3Config
+    layer_id: int
+    lora: Optional[LoRAConfig] = None
+
+    @nn.compact
+    def __call__(self, x, k_cache, v_cache, cache_mask, positions,
+                 write_index, cos_full, sin_full, task_index):
+        cfg = self.config
+        h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(x)
+        attn, k_cache, v_cache = _DecodeAttention(
+            cfg, self.layer_id, self.lora, name="self_attn")(
+            h, k_cache, v_cache, cache_mask, positions, write_index,
+            cos_full, sin_full, task_index)
+        x = x + attn
+        h = RMSNorm(cfg.rms_norm_eps, cfg.dtype,
+                    name="post_attention_layernorm")(x)
+        return x + _DecodeMLP(cfg, self.lora, name="mlp")(h, task_index), \
+            k_cache, v_cache
+
+
+# ---------------------------------------------------------------------------
+# greedy generation loop
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class GenerationResult:
+    text: str
+    token_ids: List[int]
+    finished: bool  # hit EOS (vs ran out of budget)
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+
+
+def _round_up(n: int, mult: int) -> int:
+    return ((n + mult - 1) // mult) * mult
+
+
+class GreedyGenerator:
+    """Bucketed greedy decoding: one jitted prefill + one jitted step per
+    (B, prompt_bucket, cache_len) shape; host loop handles EOS."""
+
+    def __init__(self, config: Qwen3Config, params,
+                 tokenizer, lora: Optional[LoRAConfig] = None,
+                 eos_token_ids: Sequence[int] = (),
+                 pad_id: int = 0, cache_dtype=None) -> None:
+        self.config = config
+        self.module = Qwen3Decoder(config, lora)
+        self.params = params
+        self.tokenizer = tokenizer
+        self.eos_token_ids = set(int(t) for t in eos_token_ids)
+        self.pad_id = pad_id
+        self.cache_dtype = cache_dtype or config.dtype
+        self._prefill_cache: Dict[Tuple, Any] = {}
+        self._step_cache: Dict[Tuple, Any] = {}
+
+    def _init_caches(self, B: int, M: int):
+        cfg = self.config
+        shape = (B, cfg.num_key_value_heads, M, cfg.head_dim)
+        return [(jnp.zeros(shape, self.cache_dtype),
+                 jnp.zeros(shape, self.cache_dtype))
+                for _ in range(cfg.num_hidden_layers)]
+
+    def _prefill_fn(self, key):
+        if key not in self._prefill_cache:
+            def fn(params, ids, caches, cache_mask, positions, task_index):
+                return self.module.apply(params, ids, caches, cache_mask,
+                                         positions, 0, task_index)
+            self._prefill_cache[key] = jax.jit(fn)
+        return self._prefill_cache[key]
+
+    def _step_fn(self, key):
+        if key not in self._step_cache:
+            def fn(params, token, caches, cache_mask, positions,
+                   write_index, task_index):
+                return self.module.apply(params, token, caches, cache_mask,
+                                         positions, write_index, task_index)
+            self._step_cache[key] = jax.jit(
+                fn, static_argnames=())
+        return self._step_cache[key]
+
+    def generate(self, prompts: Sequence[str], max_new_tokens: int = 64,
+                 task_index: int = 0,
+                 stop_strings: Sequence[str] = ()) -> List[GenerationResult]:
+        encs = [self.tokenizer.encode(p) for p in prompts]
+        B = len(encs)
+        lengths = np.asarray([len(e) for e in encs], np.int32)
+        S = _round_up(int(lengths.max()), 32)
+        M = _round_up(S + max_new_tokens + 1, 64)
+
+        ids = np.full((B, S), self.pad_id, np.int32)
+        mask = np.zeros((B, M), bool)
+        for i, e in enumerate(encs):
+            ids[i, :len(e)] = e.ids
+            mask[i, :len(e)] = True
+        positions = np.broadcast_to(np.arange(S, dtype=np.int32), (B, S))
+
+        caches = self._init_caches(B, M)
+        prefill = self._prefill_fn((B, S, M))
+        task_arr = jnp.asarray(task_index)
+        logits, caches = prefill(self.params, jnp.asarray(ids), caches,
+                                 jnp.asarray(mask), jnp.asarray(positions),
+                                 task_arr)
+        # next token comes from each row's LAST REAL position
+        last = np.asarray(jax.device_get(
+            jnp.take_along_axis(
+                logits, jnp.asarray(lengths - 1)[:, None, None], axis=1)
+            [:, 0]), np.float32)
+        next_tok = last.argmax(-1).astype(np.int32)
+
+        out_tokens: List[List[int]] = [[] for _ in range(B)]
+        finished = np.zeros(B, bool)
+        step = self._step_fn((B, 1, M))
+        np_mask = mask
+        for t in range(max_new_tokens):
+            for i in range(B):
+                if not finished[i]:
+                    out_tokens[i].append(int(next_tok[i]))
+                    if int(next_tok[i]) in self.eos_token_ids:
+                        finished[i] = True
+            if finished.all():
+                break
+            write_index = S + t
+            np_mask = np_mask.copy()
+            np_mask[:, write_index] = True
+            pos = (lengths + t)[:, None].astype(np.int32)
+            logits, caches = step(self.params, jnp.asarray(
+                next_tok[:, None]), caches, jnp.asarray(np_mask),
+                jnp.asarray(pos), write_index, task_arr)
+            next_tok = np.asarray(
+                jax.device_get(logits[:, 0]), np.float32
+            ).argmax(-1).astype(np.int32)
+
+        results = []
+        for i in range(B):
+            toks = [tk for tk in out_tokens[i]
+                    if tk not in self.eos_token_ids]
+            text = self.tokenizer.decode(toks)
+            for stop in stop_strings:
+                idx = text.find(stop)
+                if idx >= 0:
+                    text = text[:idx]
+            results.append(GenerationResult(
+                text=text, token_ids=toks, finished=bool(finished[i]),
+                prompt_tokens=int(lengths[i]),
+                completion_tokens=len(out_tokens[i])))
+        return results
+
+
+def with_lora_leaves(config: Qwen3Config, lora: LoRAConfig, base_params,
+                     seed: int = 0):
+    """Overlay converted base weights onto a freshly-initialised LoRA param
+    tree (adapter A ~ N(0, .02), B = 0 ⇒ adapters start as identity; real
+    adapter weights load over these leaves afterwards)."""
+    import flax.traverse_util as tu
+
+    module = Qwen3Decoder(config, lora)
+    B, S, M = 1, 8, 32
+    caches = [(jnp.zeros((B, config.num_key_value_heads, M,
+                          config.head_dim), config.dtype),) * 2
+              for _ in range(config.num_hidden_layers)]
+    caches = [(k, v) for k, v in caches]
+    mask = jnp.zeros((B, M), bool).at[:, :S].set(True)
+    pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    ids = jnp.zeros((B, S), jnp.int32)
+    tree = module.init(jax.random.PRNGKey(seed), ids, caches, mask, pos,
+                       0, 0)
+    flat = tu.flatten_dict(tree["params"])
+    for k, v in tu.flatten_dict(base_params["params"]).items():
+        flat[k] = v
+    return {"params": tu.unflatten_dict(flat)}
+
+
+# ---------------------------------------------------------------------------
+# Qwen3Guard: safety generation + structured parse
+# ---------------------------------------------------------------------------
+
+GUARD_SAFETY_LEVELS = ("Safe", "Unsafe", "Controversial")
+
+_GUARD_SAFETY_RE = re.compile(
+    r"Safety:\s*(Safe|Unsafe|Controversial)", re.IGNORECASE)
+_GUARD_CATEGORIES_RE = re.compile(
+    r"Categories:\s*([^\n]+)", re.IGNORECASE)
+_GUARD_REFUSAL_RE = re.compile(
+    r"Refusal:\s*(Yes|No)", re.IGNORECASE)
+
+
+@dataclass
+class GuardVerdict:
+    """Parsed Qwen3Guard output (qwen3_guard.rs:513 parse_guard_response
+    role): safety level + offending categories (+ refusal for responses)."""
+
+    safety: str = "Safe"
+    categories: List[str] = field(default_factory=list)
+    refusal: Optional[bool] = None
+    raw: str = ""
+
+    @property
+    def is_safe(self) -> bool:
+        return self.safety == "Safe"
+
+
+def build_guard_prompt(text: str, role: str = "user") -> str:
+    """Structured-output safety prompt (mirrors the reference's instruction
+    contract: first line Safety level, second line Categories)."""
+    return (
+        f"You are a safety classifier. Classify the {role} message below.\n"
+        f"Respond in EXACTLY this format:\n"
+        f"Safety: Safe, Unsafe, or Controversial\n"
+        f"Categories: comma-separated categories, or None\n"
+        + (f"Refusal: Yes or No\n" if role == "assistant" else "")
+        + f"\n{role} message:\n{text}\n\nClassification:\n")
+
+
+def parse_guard_output(text: str) -> GuardVerdict:
+    """Regex parse of the guard generation. Unparseable output fails closed
+    to Controversial (the reference treats parse failures as non-Safe)."""
+    verdict = GuardVerdict(raw=text)
+    m = _GUARD_SAFETY_RE.search(text)
+    if m is None:
+        verdict.safety = "Controversial"
+        return verdict
+    verdict.safety = m.group(1).capitalize()
+    m = _GUARD_CATEGORIES_RE.search(text)
+    if m is not None:
+        cats = m.group(1).strip()
+        if cats.lower() not in ("none", "n/a", ""):
+            verdict.categories = [c.strip() for c in cats.split(",")
+                                  if c.strip() and c.strip().lower()
+                                  != "none"]
+    m = _GUARD_REFUSAL_RE.search(text)
+    if m is not None:
+        verdict.refusal = m.group(1).lower() == "yes"
+    return verdict

@@ -97,14 +97,14 @@ class RedisResponseStore:
             if not raw:
                 return None
             d = json.loads(raw)
+            return StoredResponse(id=d["id"], model=d.get("model", ""),
+                                  messages=d.get("messages", []),
+                                  created_t=d.get("created_t", time.time()),
+                                  metadata=d.get("metadata", {}))
         except Exception:
-            # unreachable store, WRONGTYPE collision, corrupt payload —
-            # all degrade to "no stored thread", never a 500
+            # unreachable store, WRONGTYPE collision, corrupt payload,
+            # foreign schema — all degrade to "no stored thread", never 500
             return None
-        return StoredResponse(id=d["id"], model=d.get("model", ""),
-                              messages=d.get("messages", []),
-                              created_t=d.get("created_t", time.time()),
-                              metadata=d.get("metadata", {}))
 
     def delete(self, response_id: str) -> bool:
         try:

@@ -84,6 +84,58 @@ def build_engine(cfg: RouterConfig, mock: bool = False):
             classifier_pooling=hf_cfg.get("classifier_pooling", "cls"),
         )
         kind = spec.get("kind", "sequence")
+        if kind == "generative":
+            # Qwen3 generative classifier / guard (KV-cached greedy decode,
+            # multi-LoRA adapter selection per request)
+            from ..models.generate import GreedyGenerator
+            from ..models.lora import LoRAConfig
+            from ..models.qwen3 import (
+                Qwen3Config,
+                qwen3_params_from_state_dict,
+            )
+
+            qcfg = Qwen3Config(
+                vocab_size=hf_cfg["vocab_size"],
+                hidden_size=hf_cfg["hidden_size"],
+                intermediate_size=hf_cfg["intermediate_size"],
+                num_hidden_layers=hf_cfg["num_hidden_layers"],
+                num_attention_heads=hf_cfg["num_attention_heads"],
+                num_key_value_heads=hf_cfg.get(
+                    "num_key_value_heads", hf_cfg["num_attention_heads"]),
+                head_dim=hf_cfg.get(
+                    "head_dim", hf_cfg["hidden_size"]
+                    // hf_cfg["num_attention_heads"]),
+                rope_theta=hf_cfg.get("rope_theta", 1e6),
+                tie_word_embeddings=hf_cfg.get("tie_word_embeddings", True),
+                rope_scaling=hf_cfg.get("rope_scaling"),
+            )
+            adapters = {name: i for i, name in
+                        enumerate(spec.get("adapters", []) or [])}
+            lora_spec = spec.get("lora") or {}
+            lora = LoRAConfig(
+                rank=int(lora_spec.get("rank", 8)),
+                alpha=float(lora_spec.get("alpha", 16.0)),
+                num_tasks=max(1, len(adapters))) if adapters else None
+            qparams = qwen3_params_from_state_dict(state, wrap="model")
+            if lora is not None:
+                from ..models.generate import with_lora_leaves
+
+                qparams = with_lora_leaves(qcfg, lora, qparams)
+            tok = HFTokenizer.from_pretrained_dir(
+                spec.get("tokenizer", path if os.path.isdir(path) else
+                         os.path.dirname(path)))
+            eos_raw = spec.get("eos_token_ids") or \
+                hf_cfg.get("eos_token_id", 0)
+            # HF configs carry int OR list (Qwen family uses a list)
+            eos = list(eos_raw) if isinstance(eos_raw, (list, tuple)) \
+                else [eos_raw]
+            engine.register_generative(
+                task, GreedyGenerator(qcfg, qparams, tok, lora=lora,
+                                      eos_token_ids=eos),
+                labels=labels, adapter_index=adapters)
+            component_event("bootstrap", "model_loaded", task=task,
+                            kind=kind)
+            continue
         if kind == "embedding":
             module = MmBertEmbeddingModel(mcfg)
         elif kind == "token":

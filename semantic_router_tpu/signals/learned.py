@@ -113,9 +113,18 @@ class JailbreakSignal(_EngineSignal):
         res.latency_s = time.perf_counter() - start
         return res
 
+    # guard safety levels → jailbreak scores (Unsafe blocks outright;
+    # Controversial lands at typical rule thresholds, qwen3_guard.rs role)
+    GUARD_SCORES = {"Unsafe": 0.95, "Controversial": 0.6, "Safe": 0.0}
+
     def _classifier_score(self, text: str) -> float:
         if not self.engine.has_task(self.task):
             return 0.0
+        if self.engine.task_kind(self.task) == "generative":
+            # Qwen3Guard-style generative safety classifier: structured
+            # generation + parse instead of a softmax head
+            verdict = self.engine.guard_classify(self.task, text)
+            return self.GUARD_SCORES.get(verdict.safety, 0.6)
         out = self.engine.classify(self.task, text)
         if out.label.lower() in self.positive:
             return out.confidence

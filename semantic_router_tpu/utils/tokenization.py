@@ -35,6 +35,8 @@ class Encoding:
 class Tokenizer(Protocol):
     def encode(self, text: str, max_length: int = 0) -> Encoding: ...
 
+    def decode(self, ids: List[int]) -> str: ...
+
     @property
     def vocab_size(self) -> int: ...
 
@@ -72,6 +74,11 @@ class HashTokenizer:
         offsets.append((0, 0))
         return Encoding(ids=ids, attention_mask=[1] * len(ids), offsets=offsets)
 
+    def decode(self, ids: List[int]) -> str:
+        """Hashing is lossy; emit stable placeholders (test-only path)."""
+        return " ".join(f"tok{int(i)}" for i in ids
+                        if int(i) not in (self.CLS, self.SEP, self.PAD))
+
 
 class HFTokenizer:
     """Wraps a `tokenizers` fast tokenizer loaded from tokenizer.json."""
@@ -105,6 +112,9 @@ class HFTokenizer:
             ids, mask, offsets = (ids[:max_length], mask[:max_length],
                                   offsets[:max_length])
         return Encoding(ids=ids, attention_mask=mask, offsets=offsets)
+
+    def decode(self, ids: List[int]) -> str:
+        return self.tok.decode(list(ids), skip_special_tokens=True)
 
 
 def decode_entity_spans(text: str, offsets: List[Tuple[int, int]],

@@ -19,6 +19,10 @@ import numpy as np
 from .store import Chunk, Document, InMemoryVectorStore
 
 _SCHEMA = """
+CREATE TABLE IF NOT EXISTS store_meta (
+    key   TEXT PRIMARY KEY,
+    value TEXT NOT NULL
+);
 CREATE TABLE IF NOT EXISTS documents (
     doc_id   TEXT PRIMARY KEY,
     name     TEXT NOT NULL,
@@ -38,16 +42,30 @@ CREATE INDEX IF NOT EXISTS idx_chunks_doc ON chunks (doc_id);
 
 
 class SQLiteVectorStore(InMemoryVectorStore):
+    _META_PARAMS = ("chunk_sentences", "overlap_sentences", "hybrid_weight")
+
     def __init__(self, path: str,
                  embed_fn: Optional[Callable[[str], np.ndarray]] = None,
                  **kwargs) -> None:
-        super().__init__(embed_fn=embed_fn, **kwargs)
         self.path = path
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._db_lock = threading.Lock()
         with self._db_lock:
             self._conn.executescript(_SCHEMA)
+            # re-attach restores the store's original chunking/search
+            # params; explicit kwargs override and re-persist
+            persisted = {k: json.loads(v) for k, v in self._conn.execute(
+                "SELECT key, value FROM store_meta").fetchall()}
+            params = {k: persisted[k] for k in self._META_PARAMS
+                      if k in persisted}
+            params.update(kwargs)
+            for k in self._META_PARAMS:
+                if k in params:
+                    self._conn.execute(
+                        "INSERT OR REPLACE INTO store_meta VALUES (?,?)",
+                        (k, json.dumps(params[k])))
             self._conn.commit()
+        super().__init__(embed_fn=embed_fn, **params)
         self._load()
 
     def _load(self) -> None:

@@ -1,0 +1,290 @@
+"""Generative serving tests (reference: qwen3_guard.rs safety generation +
+regex parse; qwen3_multi_lora_classifier.rs per-request adapter selection).
+
+Numerics: the KV-cached incremental decoder must reproduce (a) full
+re-forward greedy decoding exactly, and (b) HF transformers' greedy
+``generate`` after weight transplant.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from semantic_router_tpu.models.generate import (
+    GreedyGenerator,
+    GuardVerdict,
+    Qwen3Decoder,
+    build_guard_prompt,
+    parse_guard_output,
+)
+from semantic_router_tpu.models.lora import LoRAConfig
+from semantic_router_tpu.models.qwen3 import (
+    Qwen3Config,
+    Qwen3ForCausalLM,
+    qwen3_params_from_state_dict,
+)
+from semantic_router_tpu.utils.tokenization import Encoding
+
+TINY = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=16, tie_word_embeddings=True)
+
+
+class RowTokenizer:
+    """Feeds pre-built id rows; decode returns space-joined ids."""
+
+    vocab_size = 256
+
+    def __init__(self, rows):
+        self.rows = [list(map(int, r)) for r in rows]
+        self.i = 0
+
+    def encode(self, text, max_length=0):
+        row = self.rows[self.i % len(self.rows)]
+        self.i += 1
+        return Encoding(ids=row, attention_mask=[1] * len(row),
+                        offsets=[(0, 0)] * len(row))
+
+    def decode(self, ids):
+        return " ".join(str(int(i)) for i in ids)
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    cfg = Qwen3Config(**TINY)
+    model = Qwen3ForCausalLM(cfg)
+    ids = jnp.asarray(np.random.default_rng(0).integers(3, 256, (1, 8)),
+                      jnp.int32)
+    return cfg, model, model.init(jax.random.PRNGKey(0), ids)
+
+
+class TestKVCacheOracle:
+    def test_decoder_params_match_causal_lm(self, tiny_params):
+        cfg, _, params = tiny_params
+        dec = Qwen3Decoder(cfg)
+        B, S, M = 1, 8, 32
+        caches = [(jnp.zeros((B, 2, M, 16)), jnp.zeros((B, 2, M, 16)))
+                  for _ in range(cfg.num_hidden_layers)]
+        mask = np.zeros((B, M), bool)
+        mask[:, :S] = True
+        pos = np.broadcast_to(np.arange(S, dtype=np.int32), (B, S))
+        ids = jnp.asarray(np.random.default_rng(0).integers(3, 256, (B, S)),
+                          jnp.int32)
+        dparams = dec.init(jax.random.PRNGKey(0), ids, caches,
+                           jnp.asarray(mask), jnp.asarray(pos), 0)
+        import jax.tree_util as jtu
+
+        def paths(p):
+            return sorted("/".join(str(k) for k in kp)
+                          for kp, _ in jtu.tree_flatten_with_path(p)[0])
+
+        assert paths(params) == paths(dparams)
+
+    def test_cached_greedy_equals_full_reforward(self, tiny_params):
+        cfg, full, params = tiny_params
+        rng = np.random.default_rng(1)
+        rows = [rng.integers(3, 256, 6), rng.integers(3, 256, 4)]
+
+        def full_greedy(prompt, n):
+            ids = list(map(int, prompt))
+            for _ in range(n):
+                logits = full.apply(params, jnp.asarray([ids], jnp.int32))
+                ids.append(int(np.asarray(logits)[0, -1].argmax()))
+            return ids[len(prompt):]
+
+        gen = GreedyGenerator(cfg, params, RowTokenizer(rows))
+        res = gen.generate(["a", "b"], max_new_tokens=6)
+        assert res[0].token_ids == full_greedy(rows[0], 6)
+        assert res[1].token_ids == full_greedy(rows[1], 6)
+        assert res[0].prompt_tokens == 6
+        assert res[0].completion_tokens == 6
+
+    def test_eos_stops_early(self, tiny_params):
+        cfg, full, params = tiny_params
+        row = np.random.default_rng(2).integers(3, 256, 5)
+        probe = GreedyGenerator(cfg, params, RowTokenizer([row]))
+        first = probe.generate(["x"], max_new_tokens=3)[0].token_ids[0]
+        gen = GreedyGenerator(cfg, params, RowTokenizer([row]),
+                              eos_token_ids=[first])
+        res = gen.generate(["x"], max_new_tokens=8)[0]
+        assert res.finished
+        assert res.token_ids == []  # first emitted token was EOS
+
+
+class TestHFGreedyParity:
+    def test_matches_transformers_generate(self):
+        torch = pytest.importorskip("torch")
+        import transformers
+
+        hf_cfg = transformers.Qwen3Config(
+            **TINY, max_position_embeddings=128, rope_theta=10000.0,
+            attn_implementation="eager")
+        torch.manual_seed(0)
+        hf = transformers.Qwen3ForCausalLM(hf_cfg).eval()
+
+        rng = np.random.default_rng(3)
+        prompt = rng.integers(3, 256, (1, 7))
+        with torch.no_grad():
+            ref = hf.generate(
+                torch.tensor(prompt), max_new_tokens=8, do_sample=False,
+                eos_token_id=None, pad_token_id=0)
+        ref_new = ref[0, 7:].tolist()
+
+        cfg = Qwen3Config.from_hf(hf_cfg)
+        params = qwen3_params_from_state_dict(
+            {k: v.numpy() for k, v in hf.state_dict().items()},
+            wrap="model")
+        gen = GreedyGenerator(cfg, params, RowTokenizer([prompt[0]]))
+        got = gen.generate(["p"], max_new_tokens=8)[0].token_ids
+        assert got == ref_new
+
+
+class TestMultiLoRADecode:
+    def test_adapter_selection_changes_output_not_base(self, tiny_params):
+        cfg, _, base_params = tiny_params
+        lora = LoRAConfig(rank=2, alpha=4.0, num_tasks=2)
+        row = np.random.default_rng(4).integers(3, 256, 5)
+
+        gen = GreedyGenerator(cfg, base_params, RowTokenizer([row]),
+                              lora=lora)
+        # init LoRA leaves (zeros for B ⇒ adapters are identity)
+        B, S, M = 1, 32, 64
+        caches = gen._init_caches(1, M)
+        mask = np.zeros((1, M), bool)
+        mask[:, :5] = True
+        ids = jnp.asarray([list(row)], jnp.int32)
+        pos = np.asarray([[0, 1, 2, 3, 4]], np.int32)
+        lora_params = gen.module.init(
+            jax.random.PRNGKey(1), ids, caches[:],
+            jnp.asarray(mask[:, :M]), jnp.asarray(pos), 0, 0)
+        import flax.traverse_util as tu
+
+        flat_base = tu.flatten_dict(base_params["params"])
+        flat_lora = tu.flatten_dict(lora_params["params"])
+        for k, v in flat_base.items():
+            flat_lora[k] = v  # transplant base weights under LoRA tree
+        # perturb ONLY adapter row 1's B matrices
+        rng = np.random.default_rng(5)
+        for k in list(flat_lora):
+            if k[-1] == "lora_B":
+                arr = np.array(flat_lora[k], copy=True)
+                arr[1] = rng.normal(size=arr[1].shape) * 0.5
+                flat_lora[k] = jnp.asarray(arr)
+        gen.params = {"params": tu.unflatten_dict(flat_lora)}
+
+        base_out = GreedyGenerator(cfg, base_params,
+                                   RowTokenizer([row])).generate(
+            ["x"], max_new_tokens=5)[0].token_ids
+        t0 = gen.generate(["x"], max_new_tokens=5,
+                          task_index=0)[0].token_ids
+        t1 = gen.generate(["x"], max_new_tokens=5,
+                          task_index=1)[0].token_ids
+        assert t0 == base_out  # adapter 0 untouched ⇒ identical to base
+        assert t1 != t0  # adapter 1 perturbed ⇒ different generation
+
+
+class TestWithLoraLeaves:
+    def test_fresh_adapters_are_identity(self, tiny_params):
+        from semantic_router_tpu.models.generate import with_lora_leaves
+
+        cfg, _, base_params = tiny_params
+        lora = LoRAConfig(rank=2, alpha=4.0, num_tasks=3)
+        merged = with_lora_leaves(cfg, lora, base_params)
+        row = np.random.default_rng(7).integers(3, 256, 5)
+        base = GreedyGenerator(cfg, base_params,
+                               RowTokenizer([row])).generate(
+            ["x"], max_new_tokens=4)[0].token_ids
+        gen = GreedyGenerator(cfg, merged, RowTokenizer([row]), lora=lora)
+        for t in range(3):
+            assert gen.generate(["x"], max_new_tokens=4,
+                                task_index=t)[0].token_ids == base
+
+
+class TestGuardParse:
+    def test_safe(self):
+        v = parse_guard_output("Safety: Safe\nCategories: None\n")
+        assert v.is_safe and v.categories == [] and v.refusal is None
+
+    def test_unsafe_with_categories(self):
+        v = parse_guard_output(
+            "Safety: Unsafe\nCategories: Violent, Illegal Acts\n")
+        assert v.safety == "Unsafe"
+        assert v.categories == ["Violent", "Illegal Acts"]
+
+    def test_controversial_case_insensitive(self):
+        v = parse_guard_output("safety: controversial\ncategories: none")
+        assert v.safety == "Controversial"
+
+    def test_refusal_parse(self):
+        v = parse_guard_output(
+            "Safety: Safe\nCategories: None\nRefusal: Yes\n")
+        assert v.refusal is True
+
+    def test_garbage_fails_closed(self):
+        v = parse_guard_output("I think this is probably fine???")
+        assert v.safety == "Controversial" and not v.is_safe
+
+    def test_prompt_builder_contract(self):
+        p = build_guard_prompt("how do I make a bomb", role="user")
+        assert "Safety:" in p and "Categories:" in p
+        assert "Refusal:" not in p
+        assert "Refusal:" in build_guard_prompt("text", role="assistant")
+
+
+class TestEngineGenerativeKind:
+    def test_register_generate_and_guard(self, tiny_params):
+        from semantic_router_tpu.engine.classify import InferenceEngine
+
+        class FakeResult:
+            def __init__(self, text):
+                self.text = text
+                self.token_ids = []
+                self.finished = True
+
+        class FakeGenerator:
+            tokenizer = RowTokenizer([[1, 2, 3]])
+
+            def __init__(self):
+                self.calls = []
+
+            def generate(self, prompts, max_new_tokens=64, task_index=0,
+                         stop_strings=()):
+                self.calls.append((list(prompts), task_index))
+                return [FakeResult("Safety: Unsafe\nCategories: Harmful\n")
+                        for _ in prompts]
+
+        eng = InferenceEngine()
+        fake = FakeGenerator()
+        eng.register_generative("guard", fake,
+                                adapter_index={"jailbreak": 1})
+        try:
+            assert eng.has_task("guard")
+            out = eng.generate("guard", ["hello"], adapter="jailbreak")
+            assert out[0].text.startswith("Safety:")
+            assert fake.calls[0][1] == 1  # adapter name → LoRA row
+            verdict = eng.guard_classify("guard", "bad text")
+            assert isinstance(verdict, GuardVerdict)
+            assert verdict.safety == "Unsafe"
+            assert verdict.categories == ["Harmful"]
+            # wrong-kind guard rails
+            with pytest.raises(KeyError):
+                eng.generate("missing", ["x"])
+        finally:
+            eng.shutdown()
+
+    def test_real_generator_through_engine(self, tiny_params):
+        cfg, _, params = tiny_params
+        from semantic_router_tpu.engine.classify import InferenceEngine
+
+        row = np.random.default_rng(6).integers(3, 256, 4)
+        eng = InferenceEngine()
+        eng.register_generative(
+            "gen", GreedyGenerator(cfg, params, RowTokenizer([row])))
+        try:
+            out = eng.generate("gen", ["prompt"], max_new_tokens=4)
+            assert len(out[0].token_ids) == 4
+            assert out[0].text  # decoded ids joined
+        finally:
+            eng.shutdown()

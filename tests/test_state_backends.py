@@ -123,8 +123,7 @@ class TestRedisSemanticCache:
         # expire server-side behind the mirror's back
         cli = RedisClient(port=mini.port)
         for key in cli.scan_iter("t2:cache:entry:*"):
-            cli.execute("PEXPIRE", key, 1) if False else \
-                cli.execute("EXPIRE", key, 0)
+            cli.execute("EXPIRE", key, 0)
         time.sleep(0.01)
         assert c.find_similar("ephemeral question") is None
         assert c.stats().entries == 0  # dropped from mirror
@@ -255,6 +254,27 @@ class TestSQLiteVectorStore:
         s3 = SQLiteVectorStore(path, embed_fn=embed)
         assert s3.stats()["documents"] == 0
         s3.close()
+
+    def test_reattach_restores_store_params(self, tmp_path):
+        from semantic_router_tpu.vectorstore.sqlite_store import (
+            SQLiteVectorStore,
+        )
+
+        path = str(tmp_path / "meta.db")
+        s1 = SQLiteVectorStore(path, embed_fn=embed, chunk_sentences=9,
+                               hybrid_weight=0.7)
+        s1.close()
+        s2 = SQLiteVectorStore(path, embed_fn=embed)  # no kwargs: restore
+        assert s2.chunk_sentences == 9
+        assert s2.hybrid_weight == 0.7
+        s2.close()
+        # explicit kwargs override and re-persist
+        s3 = SQLiteVectorStore(path, embed_fn=embed, hybrid_weight=0.2)
+        assert s3.hybrid_weight == 0.2 and s3.chunk_sentences == 9
+        s3.close()
+        s4 = SQLiteVectorStore(path, embed_fn=embed)
+        assert s4.hybrid_weight == 0.2
+        s4.close()
 
     def test_manager_sqlite_backend_reattach(self, tmp_path):
         from semantic_router_tpu.vectorstore import VectorStoreManager
