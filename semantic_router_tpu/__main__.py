@@ -31,6 +31,8 @@ def main(argv=None) -> int:
     ext_p.add_argument("--config", required=True)
     ext_p.add_argument("--port", type=int, default=50051)
     ext_p.add_argument("--mock-models", action="store_true")
+    ext_p.add_argument("--backend", default="",
+                       help="default backend URL for looper fan-out calls")
 
     val_p = sub.add_parser("validate", help="validate a config file")
     val_p.add_argument("--config", required=True)
@@ -57,6 +59,7 @@ def main(argv=None) -> int:
 
         from .config import load_config
         from .extproc import ExtProcServer
+        from .extproc.server import build_looper_executor
         from .runtime.bootstrap import build_engine, build_router
 
         cfg = load_config(args.config)
@@ -64,7 +67,9 @@ def main(argv=None) -> int:
         # build_router wires replay/memory/vectorstores identically to the
         # HTTP serve path — same config, same behavior behind Envoy
         router = build_router(cfg, engine=engine)
-        server = ExtProcServer(router, port=args.port).start()
+        server = ExtProcServer(
+            router, port=args.port,
+            looper_execute=build_looper_executor(cfg, args.backend)).start()
         print(f"extproc listening on {server.address}", file=sys.stderr)
         try:
             while True:

@@ -326,6 +326,50 @@ class ExtProcService:
             "finish_reason": "stop"}], "usage": usage}
 
 
+def build_looper_executor(cfg, default_backend: str = "",
+                          timeout_s: float = 120.0):
+    """Multi-model strategies behind Envoy: the filter itself becomes the
+    client (the reference's looper path re-enters the router;
+    an ext_proc filter must answer with an ImmediateResponse instead).
+    Returns a callable(route, headers) -> (model, response_body,
+    extra_headers) suitable for ExtProcServer(looper_execute=...)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ..looper import HTTPLLMClient, Looper
+    from ..looper.workflows import (
+        WorkflowsLooper,
+        build_workflow_state_store,
+    )
+    from ..router.server import BackendResolver
+
+    resolver = BackendResolver(cfg, default_backend)
+    client = HTTPLLMClient(resolver.resolve, timeout_s)
+    # one long-lived pool for every looper request (a per-request pool
+    # would churn 8 threads per call); state store honors the same
+    # looper.workflow_state config as the HTTP serve path
+    pool = ThreadPoolExecutor(max_workers=16,
+                              thread_name_prefix="extproc-looper")
+    workflows = WorkflowsLooper(
+        client, pool=pool,
+        state_store=build_workflow_state_store(getattr(cfg, "looper", {})))
+
+    def execute(route, headers):
+        decision = route.decision.decision
+        if route.looper_algorithm == "workflows":
+            result = workflows.execute(decision.algorithm,
+                                       decision.model_refs, route.body,
+                                       headers=headers)
+        else:
+            result = Looper(client, pool=pool).execute(
+                decision.algorithm, decision.model_refs,
+                route.body, headers=headers)
+        extra = {"x-vsr-looper-algorithm": result.algorithm,
+                 "x-vsr-looper-candidates": ",".join(result.candidates_used)}
+        return result.model, result.body, extra
+
+    return execute
+
+
 class ExtProcServer:
     """gRPC server wrapper: binds the service on ``port`` (0 = ephemeral)
     and serves until stop()."""

@@ -41,7 +41,8 @@ from . import headers as H
 from .promptcompression import PromptCompressor
 from .ratelimit import RateLimiter
 
-LOOPER_ALGORITHMS = ("confidence", "ratings", "remom", "fusion")
+LOOPER_ALGORITHMS = ("confidence", "ratings", "remom", "fusion",
+                     "workflows")
 
 
 @dataclass

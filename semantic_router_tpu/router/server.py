@@ -95,6 +95,17 @@ class RouterServer:
         self.looper_pool = ThreadPoolExecutor(max_workers=16,
                                               thread_name_prefix="looper")
 
+        # workflows engine is server-scoped: its pending-tool-state store
+        # must survive across requests (interrupt → client tools → resume)
+        from ..looper.workflows import (
+            WorkflowsLooper,
+            build_workflow_state_store,
+        )
+
+        self.workflows = WorkflowsLooper(
+            self.looper_client, pool=self.looper_pool,
+            state_store=build_workflow_state_store(cfg.looper))
+
         from .authz import CredentialResolver
         from .responseapi import build_response_store
 
@@ -545,10 +556,17 @@ class RouterServer:
 
                 t0 = time.perf_counter()
                 try:
-                    result = looper.execute(decision.algorithm,
-                                            decision.model_refs, route.body,
-                                            headers=req_headers,
-                                            headers_for=headers_for)
+                    if route.looper_algorithm == "workflows":
+                        result = server.workflows.execute(
+                            decision.algorithm, decision.model_refs,
+                            route.body, headers=req_headers,
+                            headers_for=headers_for)
+                    else:
+                        result = looper.execute(decision.algorithm,
+                                                decision.model_refs,
+                                                route.body,
+                                                headers=req_headers,
+                                                headers_for=headers_for)
                 except Exception as exc:
                     server.router.record_feedback(
                         route, success=False,

@@ -221,6 +221,94 @@ class TestRequestPath:
             server.stop()
 
 
+class TestLooperPath:
+    def test_workflows_decision_answers_via_immediate_response(self):
+        from semantic_router_tpu.config import RouterConfig
+
+        cfg = RouterConfig.from_dict({
+            "default_model": "worker-a",
+            "routing": {
+                "modelCards": [{"name": "worker-a"}],
+                "signals": {"keywords": [{
+                    "name": "wf", "operator": "OR", "method": "exact",
+                    "keywords": ["orchestrate"]}]},
+                "decisions": [{
+                    "name": "wf_route", "priority": 50,
+                    "rules": {"operator": "OR", "conditions": [
+                        {"type": "keyword", "name": "wf"}]},
+                    "modelRefs": [{"model": "worker-a"}],
+                    "algorithm": {"type": "workflows", "workflows": {
+                        "mode": "static",
+                        "roles": [{"id": "s1", "models": ["worker-a"],
+                                   "prompt": "Work."}]}},
+                }]},
+        })
+
+        def looper_execute(route, headers):
+            assert route.looper_algorithm == "workflows"
+            return "worker-a", {"choices": [{"message": {
+                "role": "assistant", "content": "wf done"},
+                "finish_reason": "stop"}]}, {"x-vsr-looper-algorithm":
+                                             "workflows"}
+
+        router = Router(cfg, engine=None)
+        server = ExtProcServer(router, port=0,
+                               looper_execute=looper_execute).start()
+        channel = grpc.insecure_channel(server.address)
+        call = channel.stream_stream(
+            f"/{SERVICE_NAME}/Process",
+            request_serializer=pb.ProcessingRequest.SerializeToString,
+            response_deserializer=pb.ProcessingResponse.FromString)
+        try:
+            resps = list(call(iter([
+                _headers_msg(), _body_msg(chat("orchestrate the task"))])))
+            imm = resps[1].immediate_response
+            assert resps[1].WhichOneof("response") == "immediate_response"
+            payload = json.loads(imm.body)
+            assert payload["choices"][0]["message"]["content"] == "wf done"
+            hdrs = {o.header.key: o.header.raw_value.decode()
+                    for o in imm.headers.set_headers}
+            assert hdrs["x-vsr-looper-algorithm"] == "workflows"
+            assert hdrs[H.MODEL] == "worker-a"
+        finally:
+            channel.close()
+            server.stop()
+            router.shutdown()
+
+    def test_build_looper_executor_against_live_backend(self):
+        from semantic_router_tpu.config import RouterConfig
+        from semantic_router_tpu.extproc.server import build_looper_executor
+        from semantic_router_tpu.router import MockVLLMServer
+
+        backend = MockVLLMServer().start()
+        cfg = RouterConfig.from_dict({
+            "default_model": "m1",
+            "routing": {"modelCards": [{"name": "m1"}, {"name": "m2"}],
+                        "decisions": []},
+        })
+        execute = build_looper_executor(cfg, default_backend=backend.url)
+
+        class FakeDecision:
+            class decision:
+                algorithm = {"type": "confidence",
+                             "confidence": {"threshold": 0.0}}
+                from semantic_router_tpu.config.schema import ModelRef
+                model_refs = [ModelRef(model="m1"), ModelRef(model="m2")]
+
+        class FakeRoute:
+            looper_algorithm = "confidence"
+            decision = FakeDecision
+            body = chat("hello")
+
+        try:
+            model, resp_body, extra = execute(FakeRoute, {})
+            assert model == "m1"  # threshold 0 → first candidate wins
+            assert resp_body["choices"][0]["message"]["content"]
+            assert extra["x-vsr-looper-algorithm"] == "confidence"
+        finally:
+            backend.stop()
+
+
 class TestResponsePath:
     def test_sse_response_mode_override_and_passthrough(self, served):
         router, server, call = served

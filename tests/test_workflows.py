@@ -1,0 +1,449 @@
+"""Agentic workflows engine tests (reference: pkg/looper/workflows*.go —
+planner, plan parse/validate, staged execution with access lists, tool
+interrupt/resume with durable state, output contracts, fallbacks)."""
+
+import json
+
+import pytest
+
+from semantic_router_tpu.config.schema import ModelRef
+from semantic_router_tpu.looper.workflows import (
+    MemoryWorkflowStateStore,
+    PlanStep,
+    RedisWorkflowStateStore,
+    WorkflowConfig,
+    WorkflowPlan,
+    WorkflowsLooper,
+    extract_json_object,
+    find_workflow_state_id,
+    make_interrupt_tool_call_id,
+    parse_tool_call_state_id,
+    parse_workflow_plan,
+    validate_plan,
+)
+
+
+def chat(text, **kw):
+    return {"model": "auto",
+            "messages": [{"role": "user", "content": text}], **kw}
+
+
+def reply(text, model="m", usage=None, tool_calls=None):
+    msg = {"role": "assistant", "content": text}
+    if tool_calls:
+        msg["tool_calls"] = tool_calls
+        msg["content"] = None
+    return {"choices": [{"message": msg,
+                         "finish_reason":
+                         "tool_calls" if tool_calls else "stop"}],
+            "model": model, "usage": usage or {"total_tokens": 7}}
+
+
+class ScriptedClient:
+    """Returns canned responses per model; records every call."""
+
+    def __init__(self, script):
+        self.script = dict(script)  # model -> list of responses (popped)
+        self.calls = []
+
+    def complete(self, body, model, headers=None):
+        self.calls.append({"model": model, "body": body,
+                           "headers": dict(headers or {})})
+        responses = self.script.get(model)
+        if not responses:
+            raise RuntimeError(f"no scripted response for {model}")
+        resp = responses.pop(0)
+        if isinstance(resp, Exception):
+            raise resp
+        return resp
+
+
+REFS = [ModelRef(model="worker-a"), ModelRef(model="worker-b")]
+
+
+class TestPlanParsing:
+    def test_extract_json_from_fence(self):
+        text = "Here is the plan:\n```json\n{\"steps\": []}\n```\nDone."
+        assert extract_json_object(text) == {"steps": []}
+
+    def test_extract_json_from_braces(self):
+        assert extract_json_object('noise {"a": 1} trailing') == {"a": 1}
+
+    def test_parse_plan_roundtrip(self):
+        plan = parse_workflow_plan(json.dumps({
+            "steps": [{"id": "s1", "role": "research",
+                       "models": ["worker-a"], "prompt": "dig"}],
+            "final": {"model": "worker-b", "prompt": "fuse"}}))
+        assert plan.steps[0].id == "s1"
+        assert plan.final_model == "worker-b"
+
+    def test_parse_plan_no_json_raises(self):
+        with pytest.raises(ValueError):
+            parse_workflow_plan("I could not produce a plan, sorry")
+
+    def test_validation_catches_bad_plans(self):
+        cfg = WorkflowConfig(max_steps=2)
+        workers = ["worker-a", "worker-b"]
+        good = WorkflowPlan(steps=[
+            PlanStep(id="s1", models=["worker-a"], prompt="p"),
+            PlanStep(id="s2", models=["worker-b"], prompt="p",
+                     access_list=["s1"])])
+        validate_plan(good, workers, cfg)  # ok
+        with pytest.raises(ValueError, match="unknown models"):
+            validate_plan(WorkflowPlan(steps=[
+                PlanStep(id="s1", models=["nope"], prompt="p")]),
+                workers, cfg)
+        with pytest.raises(ValueError, match="max_steps"):
+            validate_plan(WorkflowPlan(steps=[
+                PlanStep(id=f"s{i}", models=["worker-a"], prompt="p")
+                for i in range(3)]), workers, cfg)
+        with pytest.raises(ValueError, match="duplicate"):
+            validate_plan(WorkflowPlan(steps=[
+                PlanStep(id="s1", models=["worker-a"], prompt="p"),
+                PlanStep(id="s1", models=["worker-a"], prompt="p")]),
+                workers, cfg)
+        with pytest.raises(ValueError, match="access_list"):
+            validate_plan(WorkflowPlan(steps=[
+                PlanStep(id="s1", models=["worker-a"], prompt="p",
+                         access_list=["s2"]),
+                PlanStep(id="s2", models=["worker-a"], prompt="p")]),
+                workers, cfg)
+
+
+class TestStaticMode:
+    def test_two_steps_with_access_list_and_final(self):
+        client = ScriptedClient({
+            "worker-a": [reply("research notes", "worker-a")],
+            "worker-b": [reply("draft using notes", "worker-b"),
+                         reply("final fused answer", "worker-b")],
+        })
+        wf = WorkflowsLooper(client)
+        try:
+            res = wf.execute({"workflows": {
+                "mode": "static",
+                "roles": [
+                    {"id": "research", "role": "researcher",
+                     "models": ["worker-a"], "prompt": "Research this."},
+                    {"id": "draft", "role": "writer",
+                     "models": ["worker-b"], "prompt": "Write a draft.",
+                     "access_list": ["research"]},
+                ],
+                "final": {"model": "worker-b", "prompt": "Fuse."},
+            }}, REFS, chat("explain quantum computing"))
+        finally:
+            wf.shutdown()
+        assert res.algorithm == "workflows"
+        content = res.body["choices"][0]["message"]["content"]
+        assert content == "final fused answer"
+        # draft step saw the research output (access_list wiring)
+        draft_call = client.calls[1]
+        assert "research notes" in \
+            draft_call["body"]["messages"][0]["content"]
+        # final call saw both step outputs
+        final_call = client.calls[2]
+        assert "draft using notes" in \
+            final_call["body"]["messages"][0]["content"]
+        trace = res.body["vsr_annotations"]["workflow_trace"]
+        assert [s["id"] for s in trace["plan"]["steps"]] == \
+            ["research", "draft"]
+
+    def test_access_list_hides_other_steps(self):
+        client = ScriptedClient({
+            "worker-a": [reply("SECRET-A", "worker-a"),
+                         reply("step2 out", "worker-a"),
+                         reply("final", "worker-a")],
+        })
+        wf = WorkflowsLooper(client)
+        try:
+            wf.execute({"workflows": {
+                "mode": "static",
+                "roles": [
+                    {"id": "s1", "models": ["worker-a"], "prompt": "one"},
+                    {"id": "s2", "models": ["worker-a"], "prompt": "two",
+                     "access_list": []},
+                ],
+                "final": {"model": "worker-a"},
+            }}, [ModelRef(model="worker-a")], chat("q"))
+        finally:
+            wf.shutdown()
+        s2_prompt = client.calls[1]["body"]["messages"][0]["content"]
+        assert "SECRET-A" not in s2_prompt  # empty access_list → blind
+
+
+class TestDynamicMode:
+    PLAN = {"steps": [
+        {"id": "s1", "role": "analyst", "models": ["worker-a"],
+         "prompt": "Analyze."},
+        {"id": "s2", "role": "critic", "models": ["worker-b"],
+         "prompt": "Critique.", "access_list": ["s1"]}],
+        "final": {"model": "worker-a", "prompt": "Merge."}}
+
+    def test_planner_plan_executes(self):
+        client = ScriptedClient({
+            "worker-a": [reply(f"```json\n{json.dumps(self.PLAN)}\n```",
+                               "worker-a"),  # planner (defaults to first)
+                         reply("analysis", "worker-a"),
+                         reply("merged", "worker-a")],
+            "worker-b": [reply("critique", "worker-b")],
+        })
+        wf = WorkflowsLooper(client)
+        try:
+            res = wf.execute({"workflows": {"mode": "dynamic"}}, REFS,
+                             chat("hard question"))
+        finally:
+            wf.shutdown()
+        assert res.body["choices"][0]["message"]["content"] == "merged"
+        trace = res.body["vsr_annotations"]["workflow_trace"]
+        assert trace["mode"] == "dynamic"
+        assert [s["id"] for s in trace["plan"]["steps"]] == ["s1", "s2"]
+        # planner prompt listed the worker models
+        planner_prompt = client.calls[0]["body"]["messages"][0]["content"]
+        assert "worker-a" in planner_prompt and "worker-b" in planner_prompt
+
+    def test_invalid_plan_raises_by_default(self):
+        client = ScriptedClient({
+            "worker-a": [reply("no json here", "worker-a")]})
+        wf = WorkflowsLooper(client)
+        try:
+            with pytest.raises(ValueError):
+                wf.execute({"workflows": {"mode": "dynamic"}}, REFS,
+                           chat("q"))
+        finally:
+            wf.shutdown()
+
+    def test_invalid_plan_falls_back_on_skip(self):
+        client = ScriptedClient({
+            "worker-a": [reply("garbage", "worker-a"),
+                         reply("a answer", "worker-a"),
+                         reply("fused", "worker-a")],
+            "worker-b": [reply("b answer", "worker-b")],
+        })
+        wf = WorkflowsLooper(client)
+        try:
+            res = wf.execute({"workflows": {
+                "mode": "dynamic", "on_error": "skip",
+                "final": {"model": "worker-a"}}}, REFS, chat("q"))
+        finally:
+            wf.shutdown()
+        # fallback: one fan-out step over both workers, then final
+        assert res.body["choices"][0]["message"]["content"] == "fused"
+        models_called = [c["model"] for c in client.calls]
+        assert models_called.count("worker-b") == 1
+
+
+class TestToolInterruptResume:
+    TOOL_CALL = {"id": "call_orig1", "type": "function",
+                 "function": {"name": "search_web",
+                              "arguments": '{"q": "x"}'}}
+
+    def _run_interrupt(self, store):
+        client = ScriptedClient({
+            "worker-a": [reply(None, "worker-a",
+                               tool_calls=[dict(self.TOOL_CALL)])],
+        })
+        wf = WorkflowsLooper(client, state_store=store)
+        res = wf.execute({"workflows": {
+            "mode": "static",
+            "roles": [{"id": "s1", "models": ["worker-a"],
+                       "prompt": "Use tools."}],
+            "final": {"model": "worker-a"},
+        }}, [ModelRef(model="worker-a")],
+            chat("look this up", tools=[{"type": "function",
+                                         "function": {"name":
+                                                      "search_web"}}]))
+        wf.shutdown()
+        return res
+
+    def test_interrupt_returns_tool_calls_with_state_id(self):
+        res = self._run_interrupt(MemoryWorkflowStateStore())
+        choice = res.body["choices"][0]
+        assert choice["finish_reason"] == "tool_calls"
+        tc = choice["message"]["tool_calls"][0]
+        state_id = parse_tool_call_state_id(tc["id"])
+        assert state_id
+        assert tc["id"].endswith("::call_orig1")
+
+    def test_full_interrupt_resume_cycle(self):
+        store = MemoryWorkflowStateStore()
+        res = self._run_interrupt(store)
+        tc_id = res.body["choices"][0]["message"]["tool_calls"][0]["id"]
+        state_id = parse_tool_call_state_id(tc_id)
+
+        # client executed the tool; resumes with the tool result
+        resume_body = chat("look this up")
+        resume_body["messages"].append(
+            {"role": "tool", "tool_call_id": tc_id,
+             "content": "tool says 42"})
+        assert find_workflow_state_id(resume_body) == state_id
+
+        client = ScriptedClient({
+            "worker-a": [reply("answer using 42", "worker-a"),
+                         reply("final: 42", "worker-a")],
+        })
+        wf = WorkflowsLooper(client, state_store=store)
+        try:
+            res2 = wf.execute({"workflows": {}},
+                              [ModelRef(model="worker-a")], resume_body)
+        finally:
+            wf.shutdown()
+        assert res2.body["choices"][0]["message"]["content"] == "final: 42"
+        # the resumed call restored the ORIGINAL tool_call_id and included
+        # the assistant tool_calls turn + tool result
+        resumed_msgs = client.calls[0]["body"]["messages"]
+        assert resumed_msgs[-1]["tool_call_id"] == "call_orig1"
+        assert any(m.get("tool_calls") for m in resumed_msgs
+                   if m.get("role") == "assistant")
+        trace = res2.body["vsr_annotations"]["workflow_trace"]
+        assert trace["tool_trajectory"][0]["model"] == "worker-a"
+
+    def test_resume_unknown_state_raises(self):
+        body = chat("q")
+        body["messages"].append(
+            {"role": "tool",
+             "tool_call_id": make_interrupt_tool_call_id("deadbeef", "x"),
+             "content": "r"})
+        wf = WorkflowsLooper(ScriptedClient({}),
+                             state_store=MemoryWorkflowStateStore())
+        try:
+            with pytest.raises(RuntimeError, match="expired or unknown"):
+                wf.execute({"workflows": {}}, REFS, body)
+        finally:
+            wf.shutdown()
+
+    def test_redis_state_store_cross_instance_resume(self):
+        from semantic_router_tpu.state.resp import MiniRedis
+
+        mini = MiniRedis().start()
+        try:
+            res = self._run_interrupt(
+                RedisWorkflowStateStore(port=mini.port))
+            tc_id = res.body["choices"][0]["message"]["tool_calls"][0]["id"]
+            resume_body = chat("look this up")
+            resume_body["messages"].append(
+                {"role": "tool", "tool_call_id": tc_id, "content": "42"})
+            # a DIFFERENT store instance (second replica) resumes it
+            client = ScriptedClient({
+                "worker-a": [reply("done", "worker-a"),
+                             reply("final", "worker-a")]})
+            wf = WorkflowsLooper(client, state_store=RedisWorkflowStateStore(
+                port=mini.port))
+            try:
+                res2 = wf.execute({"workflows": {}},
+                                  [ModelRef(model="worker-a")], resume_body)
+            finally:
+                wf.shutdown()
+            assert res2.body["choices"][0]["message"]["content"] == "final"
+        finally:
+            mini.stop()
+
+
+class TestOutputContracts:
+    def test_json_action_extracts_object(self):
+        client = ScriptedClient({
+            "worker-a": [reply("w", "worker-a"),
+                         reply('action: ```json\n{"tool": "x"}\n```',
+                               "worker-a")],
+        })
+        wf = WorkflowsLooper(client)
+        try:
+            res = wf.execute({"workflows": {
+                "mode": "static",
+                "roles": [{"id": "s1", "models": ["worker-a"],
+                           "prompt": "p"}],
+                "final": {"model": "worker-a"},
+                "output_contract": {"type": "json_action"},
+            }}, [ModelRef(model="worker-a")], chat("q"))
+        finally:
+            wf.shutdown()
+        assert json.loads(
+            res.body["choices"][0]["message"]["content"]) == {"tool": "x"}
+
+    def test_reference_selection_picks_candidate(self):
+        client = ScriptedClient({
+            "worker-a": [reply("candidate A", "worker-a"),
+                         reply("The best answer is 1.", "worker-a")],
+            "worker-b": [reply("candidate B", "worker-b")],
+        })
+        wf = WorkflowsLooper(client)
+        try:
+            res = wf.execute({"workflows": {
+                "mode": "static",
+                "roles": [{"id": "s1",
+                           "models": ["worker-a", "worker-b"],
+                           "prompt": "p"}],
+                "final": {"model": "worker-a"},
+                "output_contract": {"type": "reference_selection"},
+            }}, REFS, chat("q"))
+        finally:
+            wf.shutdown()
+        assert res.body["choices"][0]["message"]["content"] == "candidate A"
+
+    def test_final_failure_falls_back_to_best_worker_on_skip(self):
+        client = ScriptedClient({
+            "worker-a": [reply("the long detailed worker answer",
+                               "worker-a"),
+                         RuntimeError("final model down")],
+        })
+        wf = WorkflowsLooper(client)
+        try:
+            res = wf.execute({"workflows": {
+                "mode": "static", "on_error": "skip",
+                "roles": [{"id": "s1", "models": ["worker-a"],
+                           "prompt": "p"}],
+                "final": {"model": "worker-a"},
+            }}, [ModelRef(model="worker-a")], chat("q"))
+        finally:
+            wf.shutdown()
+        assert res.body["choices"][0]["message"]["content"] == \
+            "the long detailed worker answer"
+
+
+class TestServerIntegration:
+    def test_workflow_decision_through_router_server(self):
+        import urllib.request
+
+        from semantic_router_tpu.config import RouterConfig
+        from semantic_router_tpu.router import Router, RouterServer
+
+        cfg = RouterConfig.from_dict({
+            "default_model": "worker-a",
+            "routing": {
+                "modelCards": [{"name": "worker-a"}, {"name": "worker-b"}],
+                "signals": {"keywords": [{
+                    "name": "wf_kw", "operator": "OR", "method": "exact",
+                    "keywords": ["orchestrate"]}]},
+                "decisions": [{
+                    "name": "wf_route", "priority": 100,
+                    "rules": {"operator": "OR", "conditions": [
+                        {"type": "keyword", "name": "wf_kw"}]},
+                    "modelRefs": [{"model": "worker-a"},
+                                  {"model": "worker-b"}],
+                    "algorithm": {"type": "workflows", "workflows": {
+                        "mode": "static",
+                        "roles": [{"id": "s1", "models": ["worker-a"],
+                                   "prompt": "Work."}],
+                        "final": {"model": "worker-b",
+                                  "prompt": "Fuse."}}},
+                }]},
+        })
+        router = Router(cfg, engine=None)
+        server = RouterServer(router, cfg).start()
+        server.workflows.client = ScriptedClient({
+            "worker-a": [reply("step out", "worker-a")],
+            "worker-b": [reply("workflow final", "worker-b")],
+        })
+        try:
+            req = urllib.request.Request(
+                server.url + "/v1/chat/completions",
+                data=json.dumps(chat("please orchestrate this")).encode(),
+                method="POST")
+            req.add_header("content-type", "application/json")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                out = json.loads(resp.read())
+                headers = dict(resp.headers)
+            assert out["choices"][0]["message"]["content"] == \
+                "workflow final"
+            assert headers["x-vsr-looper-algorithm"] == "workflows"
+        finally:
+            server.stop()
+            router.shutdown()
