@@ -120,6 +120,28 @@ class InferenceEngine:
             self._tasks[name] = _Task(name, kind, list(labels), tokenizer,
                                       apply_fn, params, max_len, pad_id)
 
+    def register_multimodal(self, name: str, embedder) -> None:
+        """Register a shared text/image embedding space task
+        (multimodal_embedding.rs role; embedder = models.siglip
+        SiglipEmbedder)."""
+        with self._lock:
+            self._tasks[name] = _Task(
+                name, "multimodal", [], getattr(embedder, "tokenizer", None),
+                None, None, 0, generator=embedder)
+
+    def embed_multimodal(self, task: str, texts=None,
+                         images=None) -> Dict[str, np.ndarray]:
+        """Embed texts and/or images into the task's shared space.
+        Returns {"text": [n, d], "image": [m, d]} (present keys only);
+        cross-modal similarity is the dot product."""
+        t = self._require(task, kind="multimodal")
+        out: Dict[str, np.ndarray] = {}
+        if texts:
+            out["text"] = t.generator.embed_text(list(texts))
+        if images is not None and len(images):
+            out["image"] = t.generator.embed_image(images)
+        return out
+
     def register_generative(self, name: str, generator,
                             labels: Optional[List[str]] = None,
                             adapter_index: Optional[Dict[str, int]] = None
@@ -269,7 +291,8 @@ class InferenceEngine:
         if kind is not None and t.kind != kind:
             right_call = {"token": "token_classify", "sequence": "classify",
                           "embedding": "embed",
-                          "generative": "generate"}[t.kind]
+                          "generative": "generate",
+                          "multimodal": "embed_multimodal"}[t.kind]
             raise TypeError(
                 f"task {task!r} is a {t.kind} task; use {right_call}()")
         return t

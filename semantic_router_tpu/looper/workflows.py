@@ -357,6 +357,7 @@ class _Ctx:
     headers: Dict[str, str]
     headers_for: Optional[Callable[[str], Dict[str, str]]]
     usage: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    errors: List[str] = field(default_factory=list)
 
 
 class WorkflowsLooper:
@@ -414,12 +415,13 @@ class WorkflowsLooper:
                 raise ValueError("static workflow mode requires roles")
             steps = []
             for i, role in enumerate(cfg.roles):
-                models = [m for m in (role.get("models") or workers)
-                          if m in set(workers)]
+                # keep configured models verbatim — validate_plan raises on
+                # unknown names (a typo must not silently fan out to every
+                # worker; dynamic mode raises for the same mistake)
                 steps.append(PlanStep(
                     id=str(role.get("id", f"step_{i + 1}")),
                     role=str(role.get("role", f"role_{i + 1}")),
-                    models=models or list(workers),
+                    models=list(role.get("models") or workers),
                     prompt=str(role.get("prompt",
                                         "Answer the request.")),
                     access_list=None if role.get("access_list") is None
@@ -444,8 +446,12 @@ class WorkflowsLooper:
                 plan.final_prompt = cfg.final_prompt
             validate_plan(plan, workers, cfg)
             return plan, text
-        except ValueError:
+        except ValueError as exc:
             if cfg.on_error != "skip":
+                if ctx.errors:
+                    raise ValueError(
+                        f"{exc} (call errors: "
+                        f"{'; '.join(ctx.errors[:4])})") from exc
                 raise
             plan = fallback_plan(workers, original, cfg)
             validate_plan(plan, workers, cfg)
@@ -480,6 +486,7 @@ class WorkflowsLooper:
             if body.get("tools"):
                 ask["tools"] = body["tools"]
             responses, pending = [], None
+            deferred_tool_models = []
             # every model runs; max_parallel bounds CONCURRENCY (waves),
             # it never drops models from the step
             wave_size = max(1, cfg.max_parallel)
@@ -494,19 +501,33 @@ class WorkflowsLooper:
                     tool_calls = self._tool_calls(resp)
                     if tool_calls and pending is None:
                         pending = (m, resp, tool_calls, messages)
+                    elif tool_calls:
+                        # one pending interrupt at a time (reference
+                        # parity); other tool-callers are recorded so the
+                        # trace shows why their output is absent
+                        deferred_tool_models.append(m)
                     elif _content(resp):
                         responses.append({"model": m,
                                           "content": _content(resp)})
+                if pending is not None:
+                    # stop dispatching further waves: they would be paid
+                    # for and then discarded by the pause
+                    break
             if pending is not None:
+                if deferred_tool_models:
+                    trajectory = trajectory + [{
+                        "dropped_tool_models": deferred_tool_models}]
                 return results, self._interrupt(
                     cfg, plan, body, idx, pending, responses, results,
                     trajectory, ctx, phase="step")
             if len(responses) < cfg.min_successful \
                     and cfg.on_error != "skip":
+                detail = f" (call errors: {'; '.join(ctx.errors[:4])})" \
+                    if ctx.errors else ""
                 raise RuntimeError(
                     f"workflow step {step.id!r}: "
                     f"{len(responses)}/{cfg.min_successful} successful "
-                    f"responses")
+                    f"responses{detail}")
             results.append({"id": step.id, "role": step.role,
                             "responses": responses})
         return results, None
@@ -748,7 +769,10 @@ class WorkflowsLooper:
             if ctx.headers_for is not None:
                 hdrs.update(ctx.headers_for(model))
             resp = self.client.complete(ask, model, headers=hdrs)
-        except Exception:
+        except Exception as exc:
+            # remember the real cause: a 401 must not surface as
+            # "planner produced no JSON" / "0 successful responses"
+            ctx.errors.append(f"{model}: {type(exc).__name__}: {exc}")
             return None
         u = resp.get("usage") or {}
         if u:

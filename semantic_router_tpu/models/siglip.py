@@ -1,0 +1,384 @@
+"""SigLIP multimodal embeddings: shared text/image space.
+
+Reference capability: candle-binding multimodal_embedding.rs (2,598 LoC —
+shared text/image embedding space used for modality-aware routing and
+multimodal RAG).  Semantics match the public HF ``SiglipModel``
+(google/siglip-*): pre-LN ViT towers, tanh-gelu MLPs, last-token text
+pooling + head dense, attention-probe (MAP) vision pooling, and
+L2-normalized embeddings whose dot product is the SigLIP logit.
+
+TPU-first: the patch embedding is a strided conv (MXU-friendly), towers
+run in the configured dtype with float32 softmax/normalization, and both
+towers are plain jittable Flax modules (static image/text shapes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+NEG_INF = -1e30
+
+
+@dataclass
+class SiglipTowerConfig:
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    layer_norm_eps: float = 1e-6
+    # text
+    vocab_size: int = 32000
+    max_position_embeddings: int = 64
+    projection_size: int = 768
+    # vision
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    dtype: Any = jnp.float32
+
+    @classmethod
+    def from_hf(cls, hf, dtype=jnp.float32) -> "SiglipTowerConfig":
+        g = lambda k, d=None: getattr(hf, k, d)
+        return cls(
+            hidden_size=g("hidden_size"),
+            intermediate_size=g("intermediate_size"),
+            num_hidden_layers=g("num_hidden_layers"),
+            num_attention_heads=g("num_attention_heads"),
+            layer_norm_eps=g("layer_norm_eps", 1e-6),
+            vocab_size=g("vocab_size", 32000),
+            max_position_embeddings=g("max_position_embeddings", 64),
+            projection_size=g("projection_size", g("hidden_size")),
+            image_size=g("image_size", 224),
+            patch_size=g("patch_size", 16),
+            num_channels=g("num_channels", 3),
+            dtype=dtype,
+        )
+
+
+class SiglipAttention(nn.Module):
+    config: SiglipTowerConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray,
+                 mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        cfg = self.config
+        B, S, H = x.shape
+        N = cfg.num_attention_heads
+        D = H // N
+        q = nn.Dense(H, name="q_proj", dtype=cfg.dtype)(x)
+        k = nn.Dense(H, name="k_proj", dtype=cfg.dtype)(x)
+        v = nn.Dense(H, name="v_proj", dtype=cfg.dtype)(x)
+        q = q.reshape(B, S, N, D).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, N, D).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, N, D).transpose(0, 2, 1, 3)
+        scores = jnp.einsum("bnsd,bntd->bnst", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) / np.sqrt(D)
+        if mask is not None:  # [B, S] key padding; SigLIP text is NON-causal
+            scores = jnp.where(mask[:, None, None, :].astype(bool),
+                               scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bnst,bntd->bnsd", probs, v.astype(jnp.float32))
+        out = out.astype(cfg.dtype).transpose(0, 2, 1, 3).reshape(B, S, H)
+        return nn.Dense(H, name="out_proj", dtype=cfg.dtype)(out)
+
+
+class SiglipMLP(nn.Module):
+    config: SiglipTowerConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        x = nn.Dense(cfg.intermediate_size, name="fc1", dtype=cfg.dtype)(x)
+        # HF hidden_act is gelu_pytorch_tanh
+        x = jax.nn.gelu(x.astype(jnp.float32),
+                        approximate=True).astype(cfg.dtype)
+        return nn.Dense(cfg.hidden_size, name="fc2", dtype=cfg.dtype)(x)
+
+
+class SiglipEncoderLayer(nn.Module):
+    config: SiglipTowerConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        cfg = self.config
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="layer_norm1",
+                         dtype=cfg.dtype)(x)
+        x = x + SiglipAttention(cfg, name="self_attn")(h, mask)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="layer_norm2",
+                         dtype=cfg.dtype)(x)
+        return x + SiglipMLP(cfg, name="mlp")(h)
+
+
+class SiglipTextTower(nn.Module):
+    """Token+position embeddings → encoder → final LN → LAST-token pool →
+    head dense (SiglipTextTransformer semantics — the pool really is
+    position -1, padding included, matching HF)."""
+
+    config: SiglipTowerConfig
+
+    @nn.compact
+    def __call__(self, input_ids: jnp.ndarray,
+                 attention_mask: Optional[jnp.ndarray] = None
+                 ) -> jnp.ndarray:
+        cfg = self.config
+        B, S = input_ids.shape
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                     name="token_embedding", dtype=cfg.dtype)(input_ids)
+        pos = self.param("position_embedding",
+                         nn.initializers.normal(0.02),
+                         (cfg.max_position_embeddings, cfg.hidden_size))
+        x = x + pos[None, :S].astype(cfg.dtype)
+        for i in range(cfg.num_hidden_layers):
+            x = SiglipEncoderLayer(cfg, name=f"layers_{i}")(
+                x, attention_mask)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                         name="final_layer_norm", dtype=cfg.dtype)(x)
+        pooled = x[:, -1]
+        return nn.Dense(cfg.projection_size, name="head",
+                        dtype=cfg.dtype)(pooled)
+
+
+class SiglipMAPHead(nn.Module):
+    """Multihead attention pooling: a learned probe attends over the
+    patch sequence (SiglipMultiheadAttentionPoolingHead)."""
+
+    config: SiglipTowerConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        B, S, H = x.shape
+        N = cfg.num_attention_heads
+        D = H // N
+        probe = self.param("probe", nn.initializers.normal(0.02), (1, 1, H))
+        q = nn.Dense(H, name="attn_q", dtype=cfg.dtype)(
+            jnp.broadcast_to(probe.astype(cfg.dtype), (B, 1, H)))
+        k = nn.Dense(H, name="attn_k", dtype=cfg.dtype)(x)
+        v = nn.Dense(H, name="attn_v", dtype=cfg.dtype)(x)
+        q = q.reshape(B, 1, N, D).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, N, D).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, N, D).transpose(0, 2, 1, 3)
+        scores = jnp.einsum("bnsd,bntd->bnst", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) / np.sqrt(D)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bnst,bntd->bnsd", probs, v.astype(jnp.float32))
+        out = out.astype(cfg.dtype).transpose(0, 2, 1, 3).reshape(B, 1, H)
+        out = nn.Dense(H, name="attn_out", dtype=cfg.dtype)(out)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="layernorm",
+                         dtype=cfg.dtype)(out)
+        out = out + SiglipMLP(cfg, name="mlp")(h)
+        return out[:, 0]
+
+
+class SiglipVisionTower(nn.Module):
+    """Patch conv embed + learned positions → encoder → post-LN → MAP
+    pooling (SiglipVisionTransformer semantics). Input: NHWC pixels."""
+
+    config: SiglipTowerConfig
+
+    @nn.compact
+    def __call__(self, pixel_values: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        P = cfg.patch_size
+        x = nn.Conv(cfg.hidden_size, kernel_size=(P, P), strides=(P, P),
+                    padding="VALID", name="patch_embedding",
+                    dtype=cfg.dtype)(pixel_values.astype(cfg.dtype))
+        B, Hp, Wp, C = x.shape
+        x = x.reshape(B, Hp * Wp, C)
+        n_pos = (cfg.image_size // P) ** 2
+        pos = self.param("position_embedding",
+                         nn.initializers.normal(0.02),
+                         (n_pos, cfg.hidden_size))
+        x = x + pos[None, :Hp * Wp].astype(cfg.dtype)
+        for i in range(cfg.num_hidden_layers):
+            x = SiglipEncoderLayer(cfg, name=f"layers_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                         name="post_layernorm", dtype=cfg.dtype)(x)
+        return SiglipMAPHead(cfg, name="head")(x)
+
+
+class SiglipModel(nn.Module):
+    """Both towers; returns L2-normalized embeddings in the shared space
+    (SiglipModel.get_text_features / get_image_features + normalization)."""
+
+    text_config: SiglipTowerConfig
+    vision_config: SiglipTowerConfig
+
+    def setup(self):
+        self.text_model = SiglipTextTower(self.text_config)
+        self.vision_model = SiglipVisionTower(self.vision_config)
+        self.logit_scale = self.param("logit_scale",
+                                      nn.initializers.zeros, ())
+        self.logit_bias = self.param("logit_bias",
+                                     nn.initializers.zeros, ())
+
+    @staticmethod
+    def _normalize(x: jnp.ndarray) -> jnp.ndarray:
+        xf = x.astype(jnp.float32)
+        return xf / jnp.maximum(
+            jnp.linalg.norm(xf, axis=-1, keepdims=True), 1e-9)
+
+    def embed_text(self, input_ids, attention_mask=None) -> jnp.ndarray:
+        return self._normalize(self.text_model(input_ids, attention_mask))
+
+    def embed_image(self, pixel_values) -> jnp.ndarray:
+        return self._normalize(self.vision_model(pixel_values))
+
+    def __call__(self, input_ids, pixel_values, attention_mask=None):
+        """Returns (text_embeds, image_embeds, logits) where
+        logits[i, j] = scale · ⟨img_i, txt_j⟩ + bias (SigLIP pairing)."""
+        t = self.embed_text(input_ids, attention_mask)
+        v = self.embed_image(pixel_values)
+        logits = (v @ t.T) * jnp.exp(
+            self.logit_scale.astype(jnp.float32)) \
+            + self.logit_bias.astype(jnp.float32)
+        return t, v, logits
+
+
+class SiglipEmbedder:
+    """Serving wrapper: jitted text/image embedding into the shared space
+    (the reference's multimodal embedding service role). Images arrive as
+    float NHWC arrays already sized to ``image_size`` (preprocessing via
+    :func:`preprocess_image`)."""
+
+    def __init__(self, text_config: SiglipTowerConfig,
+                 vision_config: SiglipTowerConfig, params,
+                 tokenizer=None, pad_id: int = 1) -> None:
+        self.model = SiglipModel(text_config, vision_config)
+        self.params = params
+        self.tokenizer = tokenizer
+        self.pad_id = pad_id  # SiglipTextConfig.pad_token_id default is 1
+        self.text_config = text_config
+        self.vision_config = vision_config
+        self._embed_text = jax.jit(
+            lambda p, ids: self.model.apply(
+                p, ids, None, method=SiglipModel.embed_text))
+        self._embed_image = jax.jit(
+            lambda p, px: self.model.apply(
+                p, px, method=SiglipModel.embed_image))
+
+    def embed_text(self, texts) -> np.ndarray:
+        if self.tokenizer is None:
+            raise ValueError("no tokenizer configured for text embedding")
+        # SigLIP checkpoint semantics: pad to max_length with the pad
+        # token and NO attention mask — the towers were trained that way
+        # and the pooled position is literally the last slot, so masking
+        # padded keys would shift every short text out of distribution
+        S = self.text_config.max_position_embeddings
+        ids = np.full((len(texts), S), self.pad_id, np.int32)
+        for i, t in enumerate(texts):
+            enc = self.tokenizer.encode(t, max_length=S)
+            L = min(len(enc.ids), S)
+            ids[i, :L] = enc.ids[:L]
+        out = self._embed_text(self.params, jnp.asarray(ids))
+        return np.asarray(jax.device_get(out), np.float32)
+
+    def embed_image(self, images) -> np.ndarray:
+        """images: [B, H, W, C] float array (already preprocessed)."""
+        px = jnp.asarray(np.asarray(images, np.float32))
+        return np.asarray(jax.device_get(
+            self._embed_image(self.params, px)), np.float32)
+
+
+def preprocess_image(img: np.ndarray, image_size: int,
+                     mean: float = 0.5, std: float = 0.5) -> np.ndarray:
+    """uint8 HWC image → normalized float HWC at the tower's resolution
+    (SigLIP processors rescale to [0,1] then (x-0.5)/0.5). Nearest-pixel
+    resize — dependency-free; swap in a better resampler upstream."""
+    img = np.asarray(img)
+    h, w = img.shape[:2]
+    ys = (np.arange(image_size) * (h / image_size)).astype(np.int64)
+    xs = (np.arange(image_size) * (w / image_size)).astype(np.int64)
+    resized = img[np.clip(ys, 0, h - 1)][:, np.clip(xs, 0, w - 1)]
+    out = resized.astype(np.float32) / 255.0
+    return (out - mean) / std
+
+
+def siglip_params_from_state_dict(state) -> dict:
+    """Torch SiglipModel state dict → Flax params. Handles the packed
+    torch MultiheadAttention in the MAP head (in_proj split into q/k/v)
+    and NCHW→HWIO conv kernel layout."""
+    tree: dict = {}
+
+    def put(path, arr, transpose=False):
+        node = tree
+        for p in path[:-1]:
+            node = node.setdefault(p, {})
+        node[path[-1]] = arr.T if transpose else arr
+
+    state = {k: np.asarray(v) for k, v in state.items()}
+    H = None
+    for key, w in state.items():
+        parts = key.split(".")
+        is_w = parts[-1] == "weight"
+        leaf = "kernel" if is_w else "bias"
+
+        if parts[0] == "logit_scale":
+            put(["logit_scale"], w.reshape(()))
+            continue
+        if parts[0] == "logit_bias":
+            put(["logit_bias"], w.reshape(()))
+            continue
+
+        tower = parts[0]  # text_model | vision_model
+        rest = parts[1:]
+        # HF nests <tower>.text_model/<tower>.vision_model once more in
+        # SiglipModel (text_model.embeddings...) — already flat here.
+        base = [tower]
+        if rest[0] == "embeddings":
+            if rest[1] == "token_embedding":
+                put(base + ["token_embedding", "embedding"], w)
+            elif rest[1] == "position_embedding":
+                put(base + ["position_embedding"], w)
+            elif rest[1] == "patch_embedding":
+                if is_w:  # [out, in, kh, kw] → [kh, kw, in, out]
+                    put(base + ["patch_embedding", "kernel"],
+                        w.transpose(2, 3, 1, 0))
+                else:
+                    put(base + ["patch_embedding", "bias"], w)
+        elif rest[0] == "encoder" and rest[1] == "layers":
+            i = rest[2]
+            sub = rest[3:]
+            lbase = base + [f"layers_{i}"]
+            if sub[0] == "self_attn":
+                put(lbase + ["self_attn", sub[1], leaf], w,
+                    transpose=is_w)
+            elif sub[0] == "mlp":
+                put(lbase + ["mlp", sub[1], leaf], w, transpose=is_w)
+            elif sub[0] in ("layer_norm1", "layer_norm2"):
+                put(lbase + [sub[0], "scale" if is_w else "bias"], w)
+        elif rest[0] == "final_layer_norm":
+            put(base + ["final_layer_norm", "scale" if is_w else "bias"], w)
+        elif rest[0] == "post_layernorm":
+            put(base + ["post_layernorm", "scale" if is_w else "bias"], w)
+        elif rest[0] == "head" and tower == "text_model":
+            put(base + ["head", leaf], w, transpose=is_w)
+        elif rest[0] == "head" and tower == "vision_model":
+            sub = rest[1:]
+            hbase = base + ["head"]
+            if sub[0] == "probe":
+                put(hbase + ["probe"], w)
+            elif sub[0] == "attention":
+                if sub[1] == "in_proj_weight":
+                    H = w.shape[1]
+                    put(hbase + ["attn_q", "kernel"], w[:H].T)
+                    put(hbase + ["attn_k", "kernel"], w[H:2 * H].T)
+                    put(hbase + ["attn_v", "kernel"], w[2 * H:].T)
+                elif sub[1] == "in_proj_bias":
+                    H3 = w.shape[0] // 3
+                    put(hbase + ["attn_q", "bias"], w[:H3])
+                    put(hbase + ["attn_k", "bias"], w[H3:2 * H3])
+                    put(hbase + ["attn_v", "bias"], w[2 * H3:])
+                elif sub[1] == "out_proj":
+                    put(hbase + ["attn_out", leaf], w, transpose=is_w)
+            elif sub[0] == "layernorm":
+                put(hbase + ["layernorm", "scale" if is_w else "bias"], w)
+            elif sub[0] == "mlp":
+                put(hbase + ["mlp", sub[1], leaf], w, transpose=is_w)
+    return {"params": tree}

@@ -84,6 +84,35 @@ def build_engine(cfg: RouterConfig, mock: bool = False):
             classifier_pooling=hf_cfg.get("classifier_pooling", "cls"),
         )
         kind = spec.get("kind", "sequence")
+        arch = spec.get("architecture",
+                        hf_cfg.get("model_type", "modernbert"))
+        if arch in ("deberta", "deberta-v2", "deberta-v3") \
+                and kind in ("sequence", "token"):
+            from types import SimpleNamespace
+
+            from ..models.deberta import (
+                DebertaV3Config,
+                DebertaV3ForSequenceClassification,
+                DebertaV3ForTokenClassification,
+                deberta_params_from_state_dict,
+            )
+
+            # single source of truth for the HF-config mapping
+            dcfg = DebertaV3Config.from_hf(SimpleNamespace(**hf_cfg))
+            dcfg.num_labels = max(len(labels), 2)
+            module = DebertaV3ForTokenClassification(dcfg) \
+                if kind == "token" \
+                else DebertaV3ForSequenceClassification(dcfg)
+            params = deberta_params_from_state_dict(state)
+            tok = HFTokenizer.from_pretrained_dir(
+                spec.get("tokenizer", path if os.path.isdir(path) else
+                         os.path.dirname(path)))
+            engine.register_task(task, kind, module, params, tok, labels,
+                                 max_seq_len=int(spec.get("max_seq_len",
+                                                          0)))
+            component_event("bootstrap", "model_loaded", task=task,
+                            kind=kind, architecture="deberta-v3")
+            continue
         if kind == "generative":
             # Qwen3 generative classifier / guard (KV-cached greedy decode,
             # multi-LoRA adapter selection per request)
