@@ -454,6 +454,8 @@ class KBRule:
     kb: str = ""
     target: Dict[str, str] = field(default_factory=dict)  # {kind, value}
     match: str = "best"
+    # None = evaluator default; an explicit 0.0 means "unconditional"
+    threshold: Optional[float] = None
     description: str = ""
 
     @classmethod
@@ -463,7 +465,39 @@ class KBRule:
             kb=d.get("kb", ""),
             target=dict(d.get("target", {}) or {}),
             match=d.get("match", "best"),
+            threshold=None if d.get("threshold") is None
+            else float(d["threshold"]),
             description=d.get("description", ""),
+        )
+
+
+@dataclass
+class KnowledgeBaseDef:
+    """Exemplar-based knowledge base (reference KnowledgeBaseConfig,
+    category_kb_classifier.go): labels with exemplar texts, label groups,
+    and derived metrics (best_score/best_matched_score built-in;
+    group_margin configured) that feed kb_metric projection inputs."""
+
+    name: str
+    labels: Dict[str, List[str]] = field(default_factory=dict)  # label→exemplars
+    groups: Dict[str, List[str]] = field(default_factory=dict)  # group→labels
+    metrics: List[Dict[str, str]] = field(default_factory=list)
+    # metric: {name, type: group_margin, positive_group, negative_group}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "KnowledgeBaseDef":
+        labels = {}
+        for label, spec in (d.get("labels", {}) or {}).items():
+            if isinstance(spec, dict):
+                labels[label] = list(spec.get("exemplars", []) or [])
+            else:
+                labels[label] = list(spec or [])
+        return cls(
+            name=d["name"],
+            labels=labels,
+            groups={g: list(v or []) for g, v in
+                    (d.get("groups", {}) or {}).items()},
+            metrics=[dict(m) for m in (d.get("metrics", []) or [])],
         )
 
 
@@ -968,6 +1002,7 @@ class RouterConfig:
     # blocks)
     response_store: Dict[str, Any] = field(default_factory=dict)
     vectorstore: Dict[str, Any] = field(default_factory=dict)
+    knowledge_bases: List["KnowledgeBaseDef"] = field(default_factory=list)
     raw: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
@@ -996,6 +1031,10 @@ class RouterConfig:
             skip_processing=dict(d.get("skip_processing", {}) or {}),
             response_store=dict(d.get("response_store", {}) or {}),
             vectorstore=dict(d.get("vectorstore", {}) or {}),
+            knowledge_bases=[KnowledgeBaseDef.from_dict(k) for k in
+                             d.get("knowledge_bases",
+                                   routing.get("knowledge_bases", []))
+                             or []],
             raw=d,
         )
 
@@ -1019,7 +1058,10 @@ class RouterConfig:
                     used.add(leaf.signal_type.lower())
         for score in self.projections.scores:
             for inp in score.inputs:
-                if inp.type and inp.type != "kb_metric":
+                if inp.type == "kb_metric":
+                    # kb_metric values come from the kb family evaluator
+                    used.add("kb")
+                elif inp.type:
                     used.add(inp.type.lower())
         # Partition members are rule names from arbitrary families; the
         # families providing them must be evaluated too.

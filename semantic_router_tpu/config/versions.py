@@ -1,0 +1,127 @@
+"""Config version management: backup, patch, rollback, hash.
+
+Reference: /config/router PATCH/PUT (validates, backs up, writes, triggers
+hot-reload), /config/router/versions, /config/router/rollback,
+/config/hash (pkg/apiserver routes_catalog.go:193-226 +
+pkg/config/management_api.go).  Versions are timestamped YAML snapshots
+next to the live file; writing the live file is what triggers the
+mtime-polled hot-reload watcher.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+
+def deep_merge(base: Dict[str, Any], patch: Dict[str, Any]) -> Dict[str, Any]:
+    """RFC-7396-style merge: dicts merge recursively, ``None`` deletes a
+    key, everything else replaces."""
+    out = dict(base)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        elif isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def config_hash(raw: Dict[str, Any]) -> str:
+    """Stable content hash of a config dict."""
+    dumped = yaml.safe_dump(raw, sort_keys=True)
+    return hashlib.sha256(dumped.encode()).hexdigest()[:16]
+
+
+@dataclass
+class ConfigVersion:
+    version_id: str
+    created_t: float
+    hash: str
+    path: str
+
+
+class ConfigVersionStore:
+    """Timestamped YAML backups under ``<config>.versions/``."""
+
+    def __init__(self, config_path: str, max_versions: int = 20) -> None:
+        self.config_path = config_path
+        self.dir = config_path + ".versions"
+        self.max_versions = max_versions
+
+    # -- queries ---------------------------------------------------------
+
+    def list(self) -> List[ConfigVersion]:
+        if not os.path.isdir(self.dir):
+            return []
+        out = []
+        for name in sorted(os.listdir(self.dir), reverse=True):
+            if not name.endswith(".yaml"):
+                continue
+            path = os.path.join(self.dir, name)
+            vid = name[:-len(".yaml")]
+            try:
+                with open(path) as f:
+                    raw = yaml.safe_load(f) or {}
+                out.append(ConfigVersion(
+                    version_id=vid, created_t=os.path.getmtime(path),
+                    hash=config_hash(raw), path=path))
+            except Exception:
+                continue
+        return out
+
+    def get(self, version_id: str) -> Optional[str]:
+        # version ids are generated basenames — never trust path traversal
+        if "/" in version_id or ".." in version_id:
+            return None
+        path = os.path.join(self.dir, version_id + ".yaml")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return f.read()
+
+    # -- mutations -------------------------------------------------------
+
+    def snapshot(self) -> ConfigVersion:
+        """Back up the CURRENT live file as a new version."""
+        os.makedirs(self.dir, exist_ok=True)
+        with open(self.config_path) as f:
+            text = f.read()
+        vid = time.strftime("%Y%m%dT%H%M%S") + f"-{int(time.time() * 1e3) % 1000:03d}"
+        path = os.path.join(self.dir, vid + ".yaml")
+        with open(path, "w") as f:
+            f.write(text)
+        self._prune()
+        raw = yaml.safe_load(text) or {}
+        return ConfigVersion(vid, time.time(), config_hash(raw), path)
+
+    def write_live(self, raw: Dict[str, Any]) -> None:
+        """Atomic write of the live config file (rename over) — the
+        hot-reload watcher picks up the mtime change."""
+        tmp = self.config_path + ".tmp"
+        with open(tmp, "w") as f:
+            yaml.safe_dump(raw, f, sort_keys=False)
+        os.replace(tmp, self.config_path)
+
+    def rollback(self, version_id: str) -> bool:
+        text = self.get(version_id)
+        if text is None:
+            return False
+        self.snapshot()  # current state becomes a version too
+        tmp = self.config_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, self.config_path)
+        return True
+
+    def _prune(self) -> None:
+        versions = sorted(os.listdir(self.dir))
+        versions = [v for v in versions if v.endswith(".yaml")]
+        while len(versions) > self.max_versions:
+            os.remove(os.path.join(self.dir, versions.pop(0)))

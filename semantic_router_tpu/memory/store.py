@@ -212,6 +212,15 @@ class InMemoryMemoryStore:
                     return True
         return False
 
+    def find_by_id(self, memory_id: str) -> Optional[MemoryItem]:
+        """Cross-user lookup by id (management GET /v1/memory/{id})."""
+        with self._lock:
+            for items in self._items.values():
+                for item in items:
+                    if item.id == memory_id:
+                        return item
+        return None
+
     # -- pipeline integration ---------------------------------------------
 
     def auto_store(self, user_id: str, messages: Sequence[dict],

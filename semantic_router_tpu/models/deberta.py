@@ -281,8 +281,12 @@ class DebertaV3ForSequenceClassification(nn.Module):
                                                      attention_mask)
         pooled = nn.Dense(cfg.hidden_size, name="pooler_dense",
                           dtype=cfg.dtype)(hidden[:, 0])
-        act = jax.nn.gelu if cfg.pooler_hidden_act == "gelu" else jnp.tanh
-        pooled = act(pooled.astype(jnp.float32)).astype(cfg.dtype)
+        if cfg.pooler_hidden_act == "gelu":
+            # HF ACT2FN["gelu"] is the exact erf form
+            pooled = jax.nn.gelu(pooled.astype(jnp.float32),
+                                 approximate=False).astype(cfg.dtype)
+        else:
+            pooled = jnp.tanh(pooled.astype(jnp.float32)).astype(cfg.dtype)
         return nn.Dense(cfg.num_labels, name="classifier",
                         dtype=cfg.dtype)(pooled)
 

@@ -27,6 +27,8 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+import numpy as np
+
 from ..cache.semantic_cache import CacheBackend, build_cache
 from ..config.schema import Decision, ModelRef, RouterConfig
 from ..decision.engine import DecisionEngine, DecisionResult, SignalMatches
@@ -129,6 +131,12 @@ class Router:
             self.cache = None
 
         self.model_cards = {m.name: m for m in cfg.model_cards}
+        # operator-configured tools database for auto-selection; its
+        # description embeddings are static config → computed once on
+        # first use, not per request
+        self._tools_db: List[dict] = list(
+            (cfg.tool_selection or {}).get("tools", []) or [])
+        self._tools_db_embs = None
         self._selectors: Dict[str, Any] = {}
         self.response_hooks: List[Any] = []  # replay/learning recorders
         # optional subsystems (attach externally or via bootstrap)
@@ -429,9 +437,20 @@ class Router:
 
         tools_plugin = decision.plugin("tools") or decision.plugin("tool_selection")
         if tools_plugin is not None and tools_plugin.enabled \
-                and body is not None and body.get("tools"):
-            body["tools"] = self._filter_tools(tools_plugin.configuration,
-                                               ctx, body["tools"])
+                and body is not None:
+            conf = tools_plugin.configuration
+            if body.get("tools"):
+                body["tools"] = self._filter_tools(conf, ctx,
+                                                   body["tools"])
+            elif conf.get("auto_select") and self._tools_db:
+                # tools-DB auto-selection: the request carries no tools;
+                # inject the best-matching configured tools
+                # (req_filter_tools.go auto-selection role)
+                selected = self._auto_select_tools(conf, ctx)
+                if selected:
+                    body["tools"] = selected
+                    result.headers["x-vsr-tools-injected"] = \
+                        str(len(selected))
 
     def _filter_tools(self, conf: Dict[str, Any], ctx: RequestContext,
                       tools: List[dict]) -> List[dict]:
@@ -466,6 +485,46 @@ class Router:
             except Exception:
                 pass  # fail open: unfiltered tools
         return out
+
+    def _auto_select_tools(self, conf: Dict[str, Any],
+                           ctx: RequestContext) -> List[dict]:
+        """Pick top-k tools from the configured DB by description
+        similarity; lexical overlap fallback when no embedding engine."""
+        top_k = int(conf.get("top_k", 3))
+        thresh = float(conf.get("similarity_threshold", 0.1))
+
+        def name_of(t: dict) -> str:
+            return (t.get("function", {}) or {}).get("name",
+                                                     t.get("name", ""))
+
+        def desc_of(t: dict) -> str:
+            f = t.get("function", {}) or {}
+            return f"{name_of(t)}: {f.get('description', '')}"
+
+        try:
+            if self.engine is not None \
+                    and self.engine.has_task(self.embedding_task):
+                if self._tools_db_embs is None:
+                    self._tools_db_embs = self.engine.embed(
+                        self.embedding_task,
+                        [desc_of(t) for t in self._tools_db])
+                q = self.engine.embed(self.embedding_task,
+                                      [ctx.user_text])[0]
+                sims = self._tools_db_embs @ q
+            else:
+                import re as _re
+
+                q_words = set(w.lower() for w in
+                              _re.findall(r"\w+", ctx.user_text))
+                sims = np.asarray([
+                    len(q_words & set(w.lower() for w in _re.findall(
+                        r"\w+", desc_of(t)))) / (len(q_words) or 1)
+                    for t in self._tools_db])
+            order = np.argsort(-sims)
+            return [self._tools_db[i] for i in order[:top_k]
+                    if sims[i] >= thresh]
+        except Exception:
+            return []  # fail open: no injection
 
     def _finalize_body(self, result: RouteResult, ctx: RequestContext,
                        ref: Optional[ModelRef]) -> None:

@@ -45,6 +45,56 @@ from .anthropic import (
 from .pipeline import Router, RouteResult
 
 
+# discovery document (routes_catalog.go role): route-for-route map of the
+# management surface, served at GET /api/v1
+API_CATALOG = {
+    "endpoints": [
+        {"path": "/health", "method": "GET"},
+        {"path": "/ready", "method": "GET"},
+        {"path": "/startup-status", "method": "GET"},
+        {"path": "/metrics", "method": "GET"},
+        {"path": "/api/v1", "method": "GET"},
+        {"path": "/v1/chat/completions", "method": "POST"},
+        {"path": "/v1/messages", "method": "POST"},
+        {"path": "/v1/responses", "method": "POST"},
+        {"path": "/v1/models", "method": "GET"},
+        {"path": "/api/v1/classify/intent", "method": "POST"},
+        {"path": "/api/v1/classify/pii", "method": "POST"},
+        {"path": "/api/v1/classify/security", "method": "POST"},
+        {"path": "/api/v1/classify/fact-check", "method": "POST"},
+        {"path": "/api/v1/classify/user-feedback", "method": "POST"},
+        {"path": "/api/v1/classify/combined", "method": "POST"},
+        {"path": "/api/v1/classify/batch", "method": "POST"},
+        {"path": "/api/v1/eval", "method": "POST"},
+        {"path": "/api/v1/nli", "method": "POST"},
+        {"path": "/api/v1/embeddings", "method": "POST"},
+        {"path": "/api/v1/similarity", "method": "POST"},
+        {"path": "/api/v1/similarity/batch", "method": "POST"},
+        {"path": "/info/models", "method": "GET"},
+        {"path": "/config/router", "method": "GET"},
+        {"path": "/config/router", "method": "PATCH"},
+        {"path": "/config/router", "method": "PUT"},
+        {"path": "/config/router/rollback", "method": "POST"},
+        {"path": "/config/router/versions", "method": "GET"},
+        {"path": "/config/hash", "method": "GET"},
+        {"path": "/v1/memory", "method": "GET"},
+        {"path": "/v1/memory", "method": "POST"},
+        {"path": "/v1/memory", "method": "DELETE"},
+        {"path": "/v1/memory/{id}", "method": "GET"},
+        {"path": "/v1/memory/{id}", "method": "DELETE"},
+        {"path": "/v1/vector_stores", "method": "GET"},
+        {"path": "/v1/vector_stores", "method": "POST"},
+        {"path": "/v1/vector_stores/{id}", "method": "GET"},
+        {"path": "/v1/vector_stores/{id}", "method": "DELETE"},
+        {"path": "/v1/vector_stores/{id}/search", "method": "POST"},
+        {"path": "/v1/vector_stores/{id}/files", "method": "GET"},
+        {"path": "/v1/vector_stores/{id}/files", "method": "POST"},
+        {"path": "/v1/vector_stores/{id}/files/{file_id}",
+         "method": "DELETE"},
+    ],
+}
+
+
 class BackendResolver:
     """model name → base URL via modelCards[].backend_refs (weighted)."""
 
@@ -77,13 +127,29 @@ class BackendResolver:
 class RouterServer:
     def __init__(self, router: Router, cfg: RouterConfig,
                  default_backend: str = "", port: int = 0,
-                 forward_timeout_s: float = 300.0) -> None:
+                 forward_timeout_s: float = 300.0,
+                 config_path: str = "") -> None:
         self.router = router
         self.cfg = cfg
         self.resolver = BackendResolver(cfg, default_backend)
         self.forward_timeout_s = forward_timeout_s
         self.started_t = time.time()
         self.ready = threading.Event()
+        self.startup = None  # StartupTracker attached by bootstrap
+
+        # management-API auth (routes.go:27-45 wrapper role): api_server
+        # api_keys gate management routes by role; with no keys configured
+        # the management surface is open (dev) but secrets stay redacted
+        self.api_keys: Dict[str, set] = {}
+        for entry in (cfg.api_server or {}).get("api_keys", []) or []:
+            self.api_keys[str(entry.get("key", ""))] = \
+                set(entry.get("roles", []) or [])
+
+        # config version management (PATCH/PUT/rollback/versions/hash)
+        from ..config.versions import ConfigVersionStore
+
+        self.version_store = ConfigVersionStore(config_path) \
+            if config_path else None
 
         # shared looper plumbing (client is stateless; pool shared across
         # requests — a per-request Looper wraps them with request state)
@@ -214,6 +280,52 @@ class RouterServer:
             def _req_headers(self) -> Dict[str, str]:
                 return {k.lower(): v for k, v in self.headers.items()}
 
+            # -- management auth (RBAC + audit) -----------------------
+            # NOTE: the open/management split is the branch order in
+            # do_GET/do_POST — data-plane + liveness routes dispatch
+            # before any _authorize() call
+
+            def _roles(self) -> Optional[set]:
+                """Roles for the presented API key; set() when no keys are
+                configured (open dev mode); None = bad/missing key."""
+                if not server.api_keys:
+                    return set()
+                h = self._req_headers()
+                key = h.get("x-api-key", "")
+                auth = h.get("authorization", "")
+                if not key and auth.lower().startswith("bearer "):
+                    key = auth[7:].strip()
+                return server.api_keys.get(key)
+
+            def _authorize(self, write: bool = False,
+                           action: str = "") -> Optional[set]:
+                """Gate a management route: 'view' for reads, 'edit' for
+                mutations; sensitive mutations audit-log. Returns roles
+                (possibly empty in dev mode) or None after sending 401/403."""
+                roles = self._roles()
+                if roles is None:
+                    self._json(401, {"error": "missing or invalid API key"})
+                    return None
+                if server.api_keys:
+                    need = "edit" if write else "view"
+                    if need not in roles and "admin" not in roles:
+                        self._json(403, {"error":
+                                         f"requires role {need!r}"})
+                        return None
+                if action:
+                    from ..observability.logging import component_event
+
+                    component_event("audit", action,
+                                    path=self.path.split("?")[0],
+                                    roles=sorted(roles))
+                return roles
+
+            def _query(self) -> Dict[str, str]:
+                from urllib.parse import parse_qsl
+
+                parts = self.path.split("?", 1)
+                return dict(parse_qsl(parts[1])) if len(parts) > 1 else {}
+
             # -- GET ------------------------------------------------------
 
             def do_GET(self):
@@ -236,14 +348,96 @@ class RouterServer:
                                       "modality": m.modality,
                                       "tags": m.tags}}
                         for m in server.cfg.model_cards]})
+                elif path == "/startup-status":
+                    if server.startup is not None:
+                        self._json(200, server.startup.snapshot())
+                    else:
+                        self._json(200, {"ready": server.ready.is_set(),
+                                         "uptime_s": round(
+                                             time.time()
+                                             - server.started_t, 1)})
+                else:
+                    self._management_get(path)
+
+            def _management_get(self, path: str) -> None:
+                roles = self._authorize()
+                if roles is None:
+                    return
+                if path == "/api/v1":
+                    self._json(200, API_CATALOG)
                 elif path == "/config/router":
-                    # secrets masked — cfg.raw holds post-env-substitution
-                    # values (resolved API keys); this listener is
-                    # unauthenticated (reference: secret_view-gated,
-                    # pkg/config/management_api.go:67)
+                    # secrets masked unless the key holds secret_view
+                    # (management_api.go:67)
                     from ..config.schema import redact_config
 
-                    self._json(200, redact_config(server.cfg.raw))
+                    if server.api_keys and ("secret_view" in roles
+                                            or "admin" in roles):
+                        self._json(200, server.cfg.raw)
+                    else:
+                        self._json(200, redact_config(server.cfg.raw))
+                elif path == "/config/hash":
+                    from ..config.versions import config_hash
+
+                    self._json(200, {"hash": config_hash(server.cfg.raw)})
+                elif path == "/config/router/versions":
+                    if server.version_store is None:
+                        self._json(503, {"error": "no config path "
+                                                  "configured"})
+                        return
+                    self._json(200, {"versions": [
+                        {"id": v.version_id, "created": v.created_t,
+                         "hash": v.hash}
+                        for v in server.version_store.list()]})
+                elif path == "/info/models":
+                    eng = server.router.engine
+                    tasks = []
+                    if eng is not None:
+                        tasks = [{"task": t, "kind": eng.task_kind(t),
+                                  "labels": (eng.task_labels(t)
+                                             if eng.task_kind(t) in
+                                             ("sequence", "token") else [])}
+                                 for t in eng.tasks()]
+                    self._json(200, {"tasks": tasks})
+                elif path == "/v1/memory":
+                    store = server.router.memory_store
+                    if store is None:
+                        self._json(503, {"error": "no memory store"})
+                        return
+                    user = self._query().get("user_id", "")
+                    items = store.list(user) if user else []
+                    self._json(200, {"data": [
+                        {"id": i.id, "user_id": i.user_id, "text": i.text,
+                         "kind": i.kind, "created": i.created_t}
+                        for i in items]})
+                elif path.startswith("/v1/memory/"):
+                    store = server.router.memory_store
+                    mid = path.rsplit("/", 1)[1]
+                    item = store.find_by_id(mid) if store else None
+                    if item is None:
+                        self._json(404, {"error": "memory not found"})
+                    else:
+                        self._json(200, {"id": item.id, "text": item.text,
+                                         "kind": item.kind,
+                                         "user_id": item.user_id})
+                elif path == "/v1/vector_stores":
+                    mgr = server.router.vectorstores
+                    names = mgr.list() if mgr is not None else []
+                    self._json(200, {"data": [
+                        {"id": n, **(mgr.get(n).stats() if mgr.get(n)
+                                     else {})} for n in names]})
+                elif path.startswith("/v1/vector_stores/"):
+                    mgr = server.router.vectorstores
+                    name = path.split("/")[3]
+                    store = mgr.get(name) if mgr is not None else None
+                    if store is None:
+                        self._json(404, {"error": "vector store not found"})
+                    elif path.endswith("/files"):
+                        self._json(200, {"data": [
+                            {"id": d.id, "name": d.name,
+                             "chunks": len(d.chunk_ids)}
+                            for d in store.documents.values()]})
+                    else:
+                        self._json(200, {"id": name, **store.stats()})
                 else:
                     self._json(404, {"error": "not found"})
 
@@ -264,11 +458,54 @@ class RouterServer:
                     elif path == "/v1/responses":
                         self._responses(body)
                     elif path.startswith("/api/v1/classify/"):
+                        if self._authorize() is None:
+                            return
                         self._classify(path.rsplit("/", 1)[1], body)
                     elif path == "/api/v1/embeddings":
+                        if self._authorize() is None:
+                            return
                         self._embeddings(body)
-                    elif path in ("/api/v1/similarity", "/api/v1/similarity/batch"):
+                    elif path in ("/api/v1/similarity",
+                                  "/api/v1/similarity/batch"):
+                        if self._authorize() is None:
+                            return
                         self._similarity(body)
+                    elif path == "/api/v1/eval":
+                        if self._authorize() is None:
+                            return
+                        self._eval(body)
+                    elif path == "/api/v1/nli":
+                        if self._authorize() is None:
+                            return
+                        self._nli(body)
+                    elif path == "/config/router/rollback":
+                        if self._authorize(write=True,
+                                           action="config_rollback") is None:
+                            return
+                        self._config_rollback(body)
+                    elif path == "/v1/vector_stores":
+                        if self._authorize(write=True,
+                                           action="vectorstore_create") \
+                                is None:
+                            return
+                        self._vectorstore_create(body)
+                    elif path.startswith("/v1/vector_stores/") \
+                            and path.endswith("/search"):
+                        if self._authorize() is None:
+                            return
+                        self._vectorstore_search(path.split("/")[3], body)
+                    elif path.startswith("/v1/vector_stores/") \
+                            and path.endswith("/files"):
+                        if self._authorize(write=True,
+                                           action="vectorstore_ingest") \
+                                is None:
+                            return
+                        self._vectorstore_ingest(path.split("/")[3], body)
+                    elif path == "/v1/memory":
+                        if self._authorize(write=True,
+                                           action="memory_create") is None:
+                            return
+                        self._memory_create(body)
                     else:
                         self._json(404, {"error": "not found"})
                 except BrokenPipeError:
@@ -276,6 +513,230 @@ class RouterServer:
                 except Exception as exc:  # pipeline fail-open: surface 500
                     self._json(500, {"error": {
                         "message": f"{type(exc).__name__}: {exc}"}})
+
+            # -- management handlers ----------------------------------
+
+            def do_PATCH(self):
+                self._config_write(merge=True)
+
+            def do_PUT(self):
+                self._config_write(merge=False)
+
+            def _config_write(self, merge: bool) -> None:
+                path = self.path.split("?")[0]
+                if path != "/config/router":
+                    self._json(404, {"error": "not found"})
+                    return
+                if self._authorize(write=True,
+                                   action="config_patch" if merge
+                                   else "config_put") is None:
+                    return
+                if server.version_store is None:
+                    self._json(503, {"error": "no config path configured"})
+                    return
+                try:
+                    patch = self._body()
+                except json.JSONDecodeError:
+                    self._json(400, {"error": {"message": "invalid JSON"}})
+                    return
+                import yaml as _yaml
+
+                from ..config.loader import substitute_env
+                from ..config.schema import RouterConfig as RC
+                from ..config.validator import validate_config
+                from ..config.versions import config_hash, deep_merge
+
+                # CRITICAL: merge into the ON-DISK (pre-env-substitution)
+                # document, never cfg.raw — cfg.raw carries resolved
+                # ${VAR} secrets, and persisting it would write plaintext
+                # keys into the live file and every version snapshot
+                try:
+                    with open(server.version_store.config_path) as f:
+                        disk_raw = _yaml.safe_load(f) or {}
+                except Exception as exc:
+                    self._json(500, {"error": {
+                        "message": f"cannot read live config: {exc}"}})
+                    return
+                new_raw = deep_merge(disk_raw, patch) if merge else patch
+                try:
+                    # validate the config as it will actually load
+                    # (env placeholders substituted)
+                    resolved = _yaml.safe_load(substitute_env(
+                        _yaml.safe_dump(new_raw))) or {}
+                    candidate = RC.from_dict(resolved)
+                    fatal = [str(e) for e in validate_config(candidate)
+                             if e.fatal]
+                except Exception as exc:
+                    self._json(400, {"error": {
+                        "message": f"invalid config: {exc}"}})
+                    return
+                if fatal:
+                    self._json(400, {"error": {"message": "invalid config",
+                                               "details": fatal}})
+                    return
+                version = server.version_store.snapshot()
+                server.version_store.write_live(new_raw)
+                self._json(200, {"applied": True,
+                                 "backup_version": version.version_id,
+                                 "hash": config_hash(new_raw),
+                                 "note": "hot-reload watcher applies the "
+                                         "new config within its poll "
+                                         "interval"})
+
+            def _config_rollback(self, body: Dict[str, Any]) -> None:
+                if server.version_store is None:
+                    self._json(503, {"error": "no config path configured"})
+                    return
+                version = str(body.get("version", ""))
+                if server.version_store.rollback(version):
+                    self._json(200, {"rolled_back_to": version})
+                else:
+                    self._json(404, {"error":
+                                     f"version {version!r} not found"})
+
+            def _eval(self, body: Dict[str, Any]) -> None:
+                """Evaluate ALL configured signals + decisions for a text
+                (routes_catalog.go:85 — the TPU verification endpoint)."""
+                from ..signals.base import RequestContext as RC
+
+                text = body.get("text", "")
+                ctx = RC.from_openai_body(
+                    {"messages": [{"role": "user", "content": text}]})
+                signals, report = server.router.dispatcher.evaluate(ctx)
+                decisions = server.router.decision_engine.evaluate_all(
+                    signals)
+                kb_metrics = {}
+                for r in report.results.values():
+                    if r.metrics:
+                        kb_metrics.update(r.metrics)
+                self._json(200, {
+                    "signals": {t: list(names) for t, names in
+                                signals.matches.items()},
+                    "confidences": dict(signals.confidences),
+                    "kb_metrics": kb_metrics,
+                    "families": {t: {"latency_ms": round(
+                        r.latency_s * 1e3, 3), "error": r.error}
+                        for t, r in report.results.items()},
+                    "decisions": [
+                        {"name": d.decision.name,
+                         "confidence": round(d.confidence, 4),
+                         "matched_rules": d.matched_rules}
+                        for d in decisions],
+                })
+
+            def _nli(self, body: Dict[str, Any]) -> None:
+                eng = server.router.engine
+                if eng is None or not eng.has_task("nli"):
+                    self._json(503, {"error": "nli task not loaded"})
+                    return
+                premise = body.get("premise", "")
+                hypothesis = body.get("hypothesis", "")
+                r = eng.classify("nli", f"{premise}\n[SEP]\n{hypothesis}")
+                self._json(200, {"label": r.label,
+                                 "confidence": r.confidence,
+                                 "probs": r.probs})
+
+            def _memory_create(self, body: Dict[str, Any]) -> None:
+                store = server.router.memory_store
+                if store is None:
+                    self._json(503, {"error": "no memory store"})
+                    return
+                item = store.remember(
+                    str(body.get("user_id", "")), str(body.get("text", "")),
+                    kind=str(body.get("kind", "fact")))
+                self._json(200, {"id": item.id, "text": item.text})
+
+            def _vectorstore_create(self, body: Dict[str, Any]) -> None:
+                mgr = server.router.vectorstores
+                if mgr is None:
+                    self._json(503, {"error": "no vectorstore manager"})
+                    return
+                name = str(body.get("name", ""))
+                if not name:
+                    self._json(400, {"error": "name required"})
+                    return
+                try:
+                    mgr.create(name)
+                except ValueError as exc:
+                    self._json(409, {"error": str(exc)})
+                    return
+                self._json(200, {"id": name})
+
+            def _vectorstore_search(self, name: str,
+                                    body: Dict[str, Any]) -> None:
+                mgr = server.router.vectorstores
+                store = mgr.get(name) if mgr is not None else None
+                if store is None:
+                    self._json(404, {"error": "vector store not found"})
+                    return
+                hits = store.search(str(body.get("query", "")),
+                                    top_k=int(body.get("top_k", 5)),
+                                    threshold=float(
+                                        body.get("threshold", 0.0)))
+                self._json(200, {"data": [
+                    {"text": h.chunk.text, "score": round(h.score, 4),
+                     "document_id": h.chunk.document_id,
+                     "metadata": h.chunk.metadata} for h in hits]})
+
+            def _vectorstore_ingest(self, name: str,
+                                    body: Dict[str, Any]) -> None:
+                mgr = server.router.vectorstores
+                if mgr is None:
+                    self._json(503, {"error": "no vectorstore manager"})
+                    return
+                store = mgr.get(name) or mgr.get_or_create(name)
+                doc = store.ingest(str(body.get("name", "file")),
+                                   str(body.get("text", "")),
+                                   metadata=body.get("metadata"))
+                self._json(200, {"id": doc.id, "chunks":
+                                 len(doc.chunk_ids)})
+
+            def do_DELETE(self):
+                path = self.path.split("?")[0]
+                if path.startswith("/v1/memory"):
+                    if self._authorize(write=True,
+                                       action="memory_delete") is None:
+                        return
+                    store = server.router.memory_store
+                    if store is None:
+                        self._json(503, {"error": "no memory store"})
+                        return
+                    user = self._query().get("user_id", "")
+                    if path == "/v1/memory":  # delete by scope
+                        n = 0
+                        for item in list(store.list(user)):
+                            n += bool(store.delete(user, item.id))
+                        self._json(200, {"deleted": n})
+                    else:
+                        mid = path.rsplit("/", 1)[1]
+                        # resolve the owner by id when user_id is absent
+                        if not user:
+                            item = store.find_by_id(mid)
+                            user = item.user_id if item else ""
+                        ok = store.delete(user, mid) if user else False
+                        self._json(200 if ok else 404,
+                                   {"deleted": bool(ok)})
+                elif path.startswith("/v1/vector_stores/"):
+                    if self._authorize(write=True,
+                                       action="vectorstore_delete") is None:
+                        return
+                    mgr = server.router.vectorstores
+                    parts = path.split("/")
+                    if mgr is None:
+                        self._json(503, {"error": "no vectorstore manager"})
+                        return
+                    if len(parts) >= 6 and parts[4] == "files":
+                        store = mgr.get(parts[3])
+                        ok = store.delete_document(parts[5]) if store \
+                            else False
+                        self._json(200 if ok else 404,
+                                   {"deleted": bool(ok)})
+                    else:
+                        ok = mgr.delete(parts[3])
+                        self._json(200 if ok else 404,
+                                   {"deleted": bool(ok)})
+                else:
+                    self._json(404, {"error": "not found"})
 
             def _chat(self, body: Dict[str, Any], anthropic: bool) -> None:
                 headers = self._req_headers()
@@ -323,12 +784,25 @@ class RouterServer:
                     return
 
                 if route.body.get("stream"):
-                    self._stream_chat(route, backend, fwd_headers, anthropic)
+                    from ..observability.inflight import default_tracker
+
+                    tok = default_tracker.begin(route.model)
+                    try:
+                        self._stream_chat(route, backend, fwd_headers,
+                                          anthropic)
+                    finally:
+                        default_tracker.end(route.model, tok)
                     return
 
+                from ..observability.inflight import default_tracker
+
                 t0 = time.perf_counter()
-                status, resp = server._forward(backend, route.body,
-                                               fwd_headers)
+                tok = default_tracker.begin(route.model)
+                try:
+                    status, resp = server._forward(backend, route.body,
+                                                   fwd_headers)
+                finally:
+                    default_tracker.end(route.model, tok)
                 latency_ms = (time.perf_counter() - t0) * 1e3
                 if status == 200:
                     processed = server.router.process_response(route, resp)

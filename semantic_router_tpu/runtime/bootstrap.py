@@ -263,7 +263,8 @@ def serve(config_path: str, port: int = 8801,
 
         router = build_router(cfg, engine)
         server = RouterServer(router, cfg, default_backend=default_backend,
-                              port=port)
+                              port=port, config_path=config_path)
+        server.startup = tracker
     except Exception as exc:
         # explicit failStartup (runtime_bootstrap.go:170): readiness
         # monitors must see failed=true, not eternally-starting

@@ -134,40 +134,49 @@ class LatencyAwareSelector:
 
 
 class MultiFactorSelector:
-    """Weighted quality/cost/latency/context-fit score (multi_factor)."""
+    """Weighted quality/cost/latency/context-fit/load score (multi_factor;
+    the load factor reads the in-flight tracker the way the reference's
+    selector reads pkg/inflight)."""
 
     name = "multi_factor"
 
     def __init__(self, weights: Optional[Dict[str, float]] = None, **_):
         self.weights = {"quality": 0.4, "cost": 0.25, "latency": 0.2,
-                        "context_fit": 0.15, **(weights or {})}
+                        "context_fit": 0.15, "load": 0.0,
+                        **(weights or {})}
         self.tracker = PercentileTracker()
 
     def select(self, candidates: List[ModelRef], ctx: SelectionContext
                ) -> SelectionResult:
+        from ..observability.inflight import default_tracker as inflight
+
         w = self.weights
         scored = []
-        costs, lats = [], []
+        costs, lats, loads = [], [], []
         for c in candidates:
             card = ctx.card(c.model)
             pricing = (card.pricing if card else {}) or {}
             costs.append(pricing.get("completion", 0.0)
                          + pricing.get("prompt", 0.0))
             lats.append(self.tracker.percentile(c.model, 90.0, 0.0))
+            loads.append(float(inflight.count(c.model)))
         max_cost = max(costs) or 1.0
         max_lat = max(lats) or 1.0
-        for c, cost, lat in zip(candidates, costs, lats):
+        max_load = max(loads) or 1.0
+        for c, cost, lat, load in zip(candidates, costs, lats, loads):
             card = ctx.card(c.model)
             quality = card.quality_score if card else 0.5
             cost_score = 1.0 - cost / max_cost
             lat_score = 1.0 - lat / max_lat if lat else 0.5
+            load_score = 1.0 - load / max_load if load else 1.0
             if card and card.context_window_size:
                 fit = 1.0 if ctx.token_count <= card.context_window_size \
                     else 0.0
             else:
                 fit = 0.5
             score = (w["quality"] * quality + w["cost"] * cost_score
-                     + w["latency"] * lat_score + w["context_fit"] * fit)
+                     + w["latency"] * lat_score + w["context_fit"] * fit
+                     + w["load"] * load_score)
             scored.append((score, c))
         score, best = max(scored, key=lambda t: t[0])
         return SelectionResult(best, score, "multi-factor")

@@ -152,6 +152,9 @@ class SignalResult:
     hits: List[SignalHit] = field(default_factory=list)
     latency_s: float = 0.0
     error: Optional[str] = None  # evaluators fail open: error recorded, no hits
+    # kb family: per-KB metric values forwarded to kb_metric projection
+    # inputs ({kb_name: {metric: value}})
+    metrics: Dict[str, Dict[str, float]] = field(default_factory=dict)
 
 
 class SignalEvaluator(Protocol):

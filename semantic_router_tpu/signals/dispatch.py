@@ -69,6 +69,7 @@ class SignalDispatcher:
             results = list(self.pool.map(run, active))
 
         signals = SignalMatches()
+        kb_metrics: dict = {}
         for r in results:
             report.results[r.signal_type] = r
             for h in r.hits:
@@ -76,6 +77,8 @@ class SignalDispatcher:
                 if h.detail:
                     signals.details.setdefault(r.signal_type, {})[h.rule] = \
                         h.detail.get("keywords", h.detail)
+            if r.metrics:  # kb family → kb_metric projection inputs
+                kb_metrics.update(r.metrics)
 
         # Complexity composers: boolean expressions over sibling families
         # that force-escalate a rule to "hard" (reference: the composer
@@ -104,7 +107,8 @@ class SignalDispatcher:
                  or bool(self.projections.cfg.partitions))
         )
         if needs_projection:
-            report.projection_trace = self.projections.evaluate(signals)
+            report.projection_trace = self.projections.evaluate(
+                signals, kb_metrics=kb_metrics)
 
         report.wall_s = time.perf_counter() - start
         return signals, report

@@ -1,0 +1,141 @@
+"""Knowledge-base signal: exemplar-embedding classification + metrics.
+
+Reference: pkg/classification/category_kb_classifier.go +
+category_kb_scoring.go — each configured knowledge base holds labels with
+exemplar texts; the query embedding scores against exemplar embeddings to
+produce label/group scores, rule matches (target label/group), and metric
+values (best_score, best_matched_score, configured group_margins) that
+feed ``kb_metric`` projection inputs (classifier_projection_inputs.go:44).
+
+Exemplar embeddings are computed once per process per KB (preload on
+first use) through the engine's batching shim; per-query work is one
+embedding + numpy dot products. Fails open like every signal family.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..config.schema import KBRule, KnowledgeBaseDef
+from .base import RequestContext, SignalHit, SignalResult
+
+KB_METRIC_BEST_SCORE = "best_score"
+KB_METRIC_BEST_MATCHED_SCORE = "best_matched_score"
+
+
+class KBSignal:
+    signal_type = "kb"
+
+    def __init__(self, engine, rules: List[KBRule],
+                 kbs: List[KnowledgeBaseDef],
+                 task: str = "embedding",
+                 default_threshold: float = 0.5) -> None:
+        self.engine = engine
+        self.task = task
+        self.rules = rules
+        self.default_threshold = default_threshold
+        self.kbs = {kb.name: kb for kb in kbs}
+        self._exemplars: Dict[str, Dict[str, np.ndarray]] = {}  # kb→label→[n,d]
+        self._lock = threading.Lock()
+
+    # -- embedding preload ----------------------------------------------
+
+    def _ensure_loaded(self, kb: KnowledgeBaseDef) -> Dict[str, np.ndarray]:
+        with self._lock:
+            cached = self._exemplars.get(kb.name)
+        if cached is not None:
+            return cached
+        texts, spans = [], []
+        for label, exemplars in kb.labels.items():
+            spans.append((label, len(texts), len(texts) + len(exemplars)))
+            texts.extend(exemplars)
+        embs = self.engine.embed(self.task, texts) if texts else \
+            np.zeros((0, 1), np.float32)
+        table = {label: embs[a:b] for label, a, b in spans}
+        with self._lock:
+            self._exemplars[kb.name] = table
+        return table
+
+    # -- scoring ---------------------------------------------------------
+
+    def _score_kb(self, kb: KnowledgeBaseDef, query_emb: np.ndarray,
+                  threshold: float):
+        """Returns (label_scores, group_scores, metrics)."""
+        table = self._ensure_loaded(kb)
+        label_scores: Dict[str, float] = {}
+        for label, embs in table.items():
+            if len(embs):
+                label_scores[label] = float((embs @ query_emb).max())
+        group_scores = {
+            g: max((label_scores.get(l, 0.0) for l in labels),
+                   default=0.0)
+            for g, labels in kb.groups.items()}
+
+        best_score = max(label_scores.values(), default=0.0)
+        matched = {l: s for l, s in label_scores.items() if s >= threshold}
+        best_matched = max(matched.values(), default=0.0)
+        metrics = {KB_METRIC_BEST_SCORE: best_score,
+                   KB_METRIC_BEST_MATCHED_SCORE: best_matched}
+        for m in kb.metrics:
+            if m.get("type") == "group_margin":
+                metrics[m["name"]] = (
+                    group_scores.get(m.get("positive_group", ""), 0.0)
+                    - group_scores.get(m.get("negative_group", ""), 0.0))
+        return label_scores, group_scores, metrics
+
+    # -- SignalEvaluator -------------------------------------------------
+
+    def evaluate(self, ctx: RequestContext) -> SignalResult:
+        start = time.perf_counter()
+        res = SignalResult(self.signal_type)
+        try:
+            self._evaluate(ctx, res)
+        except Exception as exc:  # fail open
+            res.error = f"{type(exc).__name__}: {exc}"
+        res.latency_s = time.perf_counter() - start
+        return res
+
+    def _evaluate(self, ctx: RequestContext, res: SignalResult) -> None:
+        if not self.engine.has_task(self.task):
+            res.error = f"task {self.task!r} not loaded"
+            return
+        # score each referenced KB once
+        needed = {r.kb for r in self.rules if r.kb in self.kbs}
+        if not needed:
+            return
+        query_emb = self.engine.embed(self.task, [ctx.user_text])[0]
+
+        def rule_threshold(r: KBRule) -> float:
+            # explicit 0.0 is a real value ("unconditional"), not unset
+            return self.default_threshold if r.threshold is None \
+                else r.threshold
+
+        scored = {}
+        for kb_name in needed:
+            thresholds = [rule_threshold(r)
+                          for r in self.rules if r.kb == kb_name]
+            scored[kb_name] = self._score_kb(
+                self.kbs[kb_name], query_emb, min(thresholds))
+            res.metrics[kb_name] = scored[kb_name][2]
+
+        for rule in self.rules:
+            if rule.kb not in scored:
+                continue
+            label_scores, group_scores, _ = scored[rule.kb]
+            threshold = rule_threshold(rule)
+            kind = rule.target.get("kind", "label")
+            value = rule.target.get("value", "")
+            pool = group_scores if kind == "group" else label_scores
+            score = pool.get(value, 0.0)
+            if rule.match == "best":
+                best_name = max(pool, key=pool.get) if pool else ""
+                hit = best_name == value and score >= threshold
+            else:  # any
+                hit = score >= threshold
+            if hit:
+                res.hits.append(SignalHit(rule.name, score,
+                                          {"kb": rule.kb, kind: value}))

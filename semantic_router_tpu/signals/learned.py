@@ -248,6 +248,10 @@ def build_learned_evaluators(engine: InferenceEngine, cfg) -> list:
     if s.modality:
         evs.append(BinaryTaskSignal(engine, s.modality, "modality",
                                     "modality"))
+    if s.kb and getattr(cfg, "knowledge_bases", None):
+        from .kb import KBSignal
+
+        evs.append(KBSignal(engine, s.kb, cfg.knowledge_bases))
     if s.embeddings:
         evs.append(EmbeddingSignal(engine, s.embeddings))
     if s.preferences:

@@ -1,0 +1,410 @@
+"""Management API parity + kb signal + tools auto-selection + load-aware
+selection (reference: pkg/apiserver routes_catalog.go /
+category_kb_classifier.go / req_filter_tools.go / pkg/inflight)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+import yaml
+
+from semantic_router_tpu.config import RouterConfig, load_config
+from semantic_router_tpu.router import Router, RouterServer
+
+
+def http(url, method="GET", body=None, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode() if body is not None else None,
+        method=method)
+    req.add_header("content-type", "application/json")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+class WordEmbedEngine:
+    """Deterministic bag-of-words embedding engine for kb tests: texts
+    sharing words embed nearby."""
+
+    VOCAB = 512
+
+    def has_task(self, name):
+        return name == "embedding"
+
+    def task_kind(self, name):
+        return "embedding" if name == "embedding" else ""
+
+    def embed(self, task, texts, **kw):
+        out = []
+        for t in texts:
+            v = np.zeros(self.VOCAB, np.float32)
+            for w in t.lower().split():
+                v[hash(w) % self.VOCAB] += 1.0
+            n = np.linalg.norm(v)
+            out.append(v / n if n else v)
+        return np.stack(out)
+
+    def shutdown(self):
+        pass
+
+
+class TestKBSignal:
+    @pytest.fixture()
+    def kb_signal(self, fixture_config_path):
+        from semantic_router_tpu.signals.kb import KBSignal
+
+        cfg = load_config(fixture_config_path)
+        return KBSignal(WordEmbedEngine(), cfg.signals.kb,
+                        cfg.knowledge_bases)
+
+    def test_group_best_match_and_metrics(self, kb_signal):
+        from semantic_router_tpu.signals.base import RequestContext
+
+        ctx = RequestContext.from_openai_body({"messages": [
+            {"role": "user",
+             "content": "how long do you keep my personal data"}]})
+        res = kb_signal.evaluate(ctx)
+        assert res.error is None
+        assert [h.rule for h in res.hits] == ["privacy_policy"]
+        metrics = res.metrics["privacy_kb"]
+        assert metrics["best_score"] > 0.9  # near-exact exemplar match
+        assert metrics["privacy_vs_billing"] > 0.5  # group margin
+        assert "best_matched_score" in metrics
+
+    def test_non_matching_query_misses_but_metrics_flow(self, kb_signal):
+        from semantic_router_tpu.signals.base import RequestContext
+
+        ctx = RequestContext.from_openai_body({"messages": [
+            {"role": "user",
+             "content": "how much does the subscription cost"}]})
+        res = kb_signal.evaluate(ctx)
+        # best group is billing → the privacy rule (match: best) misses
+        assert res.hits == []
+        assert res.metrics["privacy_kb"]["privacy_vs_billing"] < 0
+
+    def test_fails_open_without_engine_task(self, fixture_config_path):
+        from semantic_router_tpu.signals.base import RequestContext
+        from semantic_router_tpu.signals.kb import KBSignal
+
+        class NoTask:
+            def has_task(self, n):
+                return False
+
+        cfg = load_config(fixture_config_path)
+        sig = KBSignal(NoTask(), cfg.signals.kb, cfg.knowledge_bases)
+        res = sig.evaluate(RequestContext.from_openai_body(
+            {"messages": [{"role": "user", "content": "x"}]}))
+        assert res.error and res.hits == []
+
+    def test_kb_metrics_reach_projections(self, fixture_config_path):
+        """kb metric values flow dispatcher → projections (the VERDICT's
+        'kb projections are dead code' gap closed)."""
+        cfg = load_config(fixture_config_path)
+        cfg.projections.scores[0].inputs.append(type(
+            cfg.projections.scores[0].inputs[0])(
+            type="kb_metric", kb="privacy_kb", metric="best_score",
+            weight=0.3))
+        from semantic_router_tpu.signals.kb import KBSignal
+        from semantic_router_tpu.signals.dispatch import (
+            build_heuristic_dispatcher,
+        )
+        from semantic_router_tpu.signals.base import RequestContext
+
+        eng = WordEmbedEngine()
+        d = build_heuristic_dispatcher(
+            cfg, extra=[KBSignal(eng, cfg.signals.kb,
+                                 cfg.knowledge_bases)])
+        ctx = RequestContext.from_openai_body({"messages": [
+            {"role": "user",
+             "content": "how long do you keep my personal data"}]})
+        signals, report = d.evaluate(ctx)
+        d.shutdown()
+        assert "privacy_policy" in signals.matches.get("kb", ())
+        trace = report.projection_trace
+        assert trace is not None
+        # the kb_metric input contributed (best_score ≈ 1 × 0.3 weight)
+        assert trace.scores["request_difficulty"] >= 0.25
+
+
+@pytest.fixture()
+def mgmt_server(tmp_path, fixture_config_path):
+    # live config file the server can PATCH/rollback
+    with open(fixture_config_path) as f:
+        raw = yaml.safe_load(f)
+    cfg_path = str(tmp_path / "router.yaml")
+    with open(cfg_path, "w") as f:
+        yaml.safe_dump(raw, f)
+    from semantic_router_tpu.runtime.bootstrap import build_router
+
+    cfg = load_config(cfg_path)
+    router = build_router(cfg)  # wires memory/vectorstores/replay
+    server = RouterServer(router, cfg, config_path=cfg_path).start()
+    yield server, cfg_path
+    server.stop()
+    router.shutdown()
+
+
+class TestManagementRoutes:
+    def test_api_discovery_catalog(self, mgmt_server):
+        server, _ = mgmt_server
+        status, body = http(server.url + "/api/v1")
+        assert status == 200
+        paths = {(e["path"], e["method"]) for e in body["endpoints"]}
+        assert ("/config/router", "PATCH") in paths
+        assert ("/api/v1/eval", "POST") in paths
+        assert ("/v1/vector_stores/{id}/search", "POST") in paths
+
+    def test_eval_endpoint_reports_all_families(self, mgmt_server):
+        server, _ = mgmt_server
+        status, body = http(server.url + "/api/v1/eval", "POST",
+                            {"text": "this is urgent, fix asap"})
+        assert status == 200
+        assert "urgent_keywords" in body["signals"].get("keyword", [])
+        assert any(d["name"] == "urgent_route" for d in body["decisions"])
+        assert "keyword" in body["families"]
+
+    def test_nli_unavailable_returns_503(self, mgmt_server):
+        server, _ = mgmt_server
+        status, _ = http(server.url + "/api/v1/nli", "POST",
+                         {"premise": "a", "hypothesis": "b"})
+        assert status == 503
+
+    def test_startup_status_route(self, mgmt_server):
+        server, _ = mgmt_server
+        status, body = http(server.url + "/startup-status")
+        assert status == 200 and body["ready"] is True
+
+    def test_config_patch_versions_rollback_hash(self, mgmt_server):
+        server, cfg_path = mgmt_server
+        _, h1 = http(server.url + "/config/hash")
+        status, body = http(server.url + "/config/router", "PATCH",
+                            {"default_model": "qwen3-32b"})
+        assert status == 200 and body["applied"]
+        backup = body["backup_version"]
+        # live file rewritten
+        with open(cfg_path) as f:
+            assert yaml.safe_load(f)["default_model"] == "qwen3-32b"
+        status, vers = http(server.url + "/config/router/versions")
+        assert any(v["id"] == backup for v in vers["versions"])
+        status, body = http(server.url + "/config/router/rollback", "POST",
+                            {"version": backup})
+        assert status == 200
+        with open(cfg_path) as f:
+            assert yaml.safe_load(f)["default_model"] == "qwen3-8b"
+        status, _ = http(server.url + "/config/router/rollback", "POST",
+                         {"version": "nope"})
+        assert status == 404
+
+    def test_patch_preserves_env_placeholders(self, tmp_path, monkeypatch):
+        """PATCH must merge into the on-disk (pre-substitution) document:
+        resolved ${VAR} secrets must never be written back to the file."""
+        monkeypatch.setenv("UPSTREAM_KEY", "sk-resolved-secret")
+        raw = {
+            "default_model": "m1",
+            "authz": {"credentials": [
+                {"models": ["m1"], "api_key": "${UPSTREAM_KEY}"}]},
+            "routing": {"modelCards": [{"name": "m1"}], "decisions": []},
+        }
+        cfg_path = str(tmp_path / "router.yaml")
+        with open(cfg_path, "w") as f:
+            yaml.safe_dump(raw, f)
+        cfg = load_config(cfg_path)
+        # sanity: the loaded config resolved the env var
+        assert cfg.authz["credentials"][0]["api_key"] == \
+            "sk-resolved-secret"
+        router = Router(cfg, engine=None)
+        server = RouterServer(router, cfg, config_path=cfg_path).start()
+        try:
+            status, _ = http(server.url + "/config/router", "PATCH",
+                             {"default_model": "m1"})
+            assert status == 200
+            on_disk = open(cfg_path).read()
+            assert "sk-resolved-secret" not in on_disk
+            assert "${UPSTREAM_KEY}" in on_disk
+        finally:
+            server.stop()
+            router.shutdown()
+
+    def test_config_patch_rejects_invalid(self, mgmt_server):
+        server, cfg_path = mgmt_server
+        before = open(cfg_path).read()
+        status, body = http(
+            server.url + "/config/router", "PATCH",
+            {"routing": {"decisions": [{"name": "bad", "rules": {
+                "operator": "OR", "conditions": [
+                    {"type": "keyword", "name": "missing_rule"}]},
+                "modelRefs": [{"model": "ghost-model"}]}]}})
+        assert status == 400
+        assert open(cfg_path).read() == before  # untouched on reject
+
+    def test_memory_crud(self, mgmt_server):
+        server, _ = mgmt_server
+        status, created = http(server.url + "/v1/memory", "POST",
+                               {"user_id": "u1",
+                                "text": "prefers dark mode"})
+        assert status == 200
+        status, listed = http(server.url + "/v1/memory?user_id=u1")
+        assert status == 200 and len(listed["data"]) == 1
+        mid = listed["data"][0]["id"]
+        status, one = http(server.url + f"/v1/memory/{mid}?user_id=u1")
+        assert status == 200 and "dark mode" in one["text"]
+        status, out = http(server.url + f"/v1/memory/{mid}?user_id=u1",
+                           "DELETE")
+        assert status == 200 and out["deleted"]
+        status, listed = http(server.url + "/v1/memory?user_id=u1")
+        assert listed["data"] == []
+
+    def test_vector_store_crud_and_search(self, mgmt_server):
+        server, _ = mgmt_server
+        status, _ = http(server.url + "/v1/vector_stores", "POST",
+                         {"name": "kb1"})
+        assert status == 200
+        status, _ = http(server.url + "/v1/vector_stores", "POST",
+                         {"name": "kb1"})
+        assert status == 409  # duplicate
+        status, doc = http(server.url + "/v1/vector_stores/kb1/files",
+                           "POST", {"name": "doc",
+                                    "text": "TPUs multiply matrices. "
+                                            "Grapes grow on vines."})
+        assert status == 200 and doc["chunks"] >= 1
+        status, res = http(server.url + "/v1/vector_stores/kb1/search",
+                           "POST", {"query": "TPUs matrices"})
+        assert status == 200 and res["data"]
+        assert "TPU" in res["data"][0]["text"]
+        status, files = http(server.url + "/v1/vector_stores/kb1/files")
+        assert len(files["data"]) == 1
+        status, out = http(
+            server.url + f"/v1/vector_stores/kb1/files/{doc['id']}",
+            "DELETE")
+        assert status == 200 and out["deleted"]
+        status, out = http(server.url + "/v1/vector_stores/kb1", "DELETE")
+        assert status == 200 and out["deleted"]
+
+
+class TestManagementAuth:
+    @pytest.fixture()
+    def secured(self, tmp_path, fixture_config_path):
+        with open(fixture_config_path) as f:
+            raw = yaml.safe_load(f)
+        raw["api_server"] = {"api_keys": [
+            {"key": "viewer-key", "roles": ["view"]},
+            {"key": "editor-key", "roles": ["view", "edit"]},
+            {"key": "root-key", "roles": ["admin", "secret_view"]},
+        ]}
+        raw.setdefault("authz", {})["credentials"] = [
+            {"models": ["qwen3-8b"], "api_key": "sk-upstream-secret"}]
+        cfg_path = str(tmp_path / "router.yaml")
+        with open(cfg_path, "w") as f:
+            yaml.safe_dump(raw, f)
+        cfg = load_config(cfg_path)
+        router = Router(cfg, engine=None)
+        server = RouterServer(router, cfg, config_path=cfg_path).start()
+        yield server
+        server.stop()
+        router.shutdown()
+
+    def test_401_without_key(self, secured):
+        status, _ = http(secured.url + "/config/router")
+        assert status == 401
+
+    def test_view_cannot_write(self, secured):
+        status, _ = http(secured.url + "/config/router", "PATCH",
+                         {"default_model": "x"},
+                         headers={"x-api-key": "viewer-key"})
+        assert status == 403
+
+    def test_editor_can_write(self, secured):
+        status, body = http(secured.url + "/config/router", "PATCH",
+                            {"default_model": "qwen3-32b"},
+                            headers={"x-api-key": "editor-key"})
+        assert status == 200
+
+    def test_secret_view_gates_redaction(self, secured):
+        _, redacted = http(secured.url + "/config/router",
+                           headers={"x-api-key": "viewer-key"})
+        assert "sk-upstream-secret" not in json.dumps(redacted)
+        _, full = http(secured.url + "/config/router",
+                       headers={"authorization": "Bearer root-key"})
+        assert "sk-upstream-secret" in json.dumps(full)
+
+    def test_data_plane_stays_open(self, secured):
+        # chat completions must NOT require the management key
+        status, _ = http(secured.url + "/v1/chat/completions", "POST",
+                         {"model": "auto", "messages": [
+                             {"role": "user", "content": "hello"}]})
+        assert status != 401
+
+
+class TestToolsAutoSelection:
+    def test_injects_best_tools_when_request_has_none(self):
+        cfg = RouterConfig.from_dict({
+            "default_model": "m1",
+            "tool_selection": {"tools": [
+                {"type": "function", "function": {
+                    "name": "search_web",
+                    "description": "search the internet for information"}},
+                {"type": "function", "function": {
+                    "name": "run_sql",
+                    "description": "query the database with sql"}},
+                {"type": "function", "function": {
+                    "name": "send_email",
+                    "description": "send an email message"}},
+            ]},
+            "routing": {
+                "modelCards": [{"name": "m1"}],
+                "signals": {"keywords": [{
+                    "name": "kw", "operator": "OR", "method": "exact",
+                    "keywords": ["search"]}]},
+                "decisions": [{
+                    "name": "d", "priority": 10,
+                    "rules": {"operator": "OR", "conditions": [
+                        {"type": "keyword", "name": "kw"}]},
+                    "modelRefs": [{"model": "m1"}],
+                    "plugins": [{"type": "tools", "configuration": {
+                        "enabled": True, "auto_select": True,
+                        "top_k": 1}}],
+                }]},
+        })
+        router = Router(cfg, engine=None)
+        try:
+            res = router.route({"model": "auto", "messages": [
+                {"role": "user",
+                 "content": "search the internet for facts"}]})
+            assert res.decision.decision.name == "d"
+            tools = res.body.get("tools", [])
+            assert len(tools) == 1
+            assert tools[0]["function"]["name"] == "search_web"
+            assert res.headers["x-vsr-tools-injected"] == "1"
+        finally:
+            router.shutdown()
+
+
+class TestLoadAwareSelection:
+    def test_multi_factor_prefers_unloaded_model(self):
+        from semantic_router_tpu.config.schema import ModelRef
+        from semantic_router_tpu.observability.inflight import (
+            default_tracker,
+        )
+        from semantic_router_tpu.selection import SelectionContext
+        from semantic_router_tpu.selection.algorithms import (
+            MultiFactorSelector,
+        )
+
+        sel = MultiFactorSelector(weights={
+            "quality": 0.0, "cost": 0.0, "latency": 0.0,
+            "context_fit": 0.0, "load": 1.0})
+        toks = [default_tracker.begin("busy-model") for _ in range(4)]
+        try:
+            res = sel.select(
+                [ModelRef(model="busy-model"), ModelRef(model="idle-model")],
+                SelectionContext(query="q"))
+            assert res.ref.model == "idle-model"
+        finally:
+            for t in toks:
+                default_tracker.end("busy-model", t)
