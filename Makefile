@@ -1,6 +1,11 @@
 PY ?= python
 
-.PHONY: test test-fast native bench perf perf-record serve-mock clean
+.PHONY: test test-fast native bench bench-replay perf perf-record \
+	serve-mock clean
+
+bench-replay:
+	$(PY) benchmarks/replay_bench.py --n 500 \
+	  --out benchmarks/results/replay_latest.json
 
 test:
 	$(PY) -m pytest tests/ -q

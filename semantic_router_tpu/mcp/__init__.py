@@ -1,0 +1,14 @@
+"""MCP integration (reference: pkg/mcp + mcp_classifier)."""
+
+from .classifier import MCPClassifySignal
+from .client import (
+    HTTPClient,
+    MCPError,
+    StdioClient,
+    Tool,
+    ToolResult,
+    create_client,
+)
+
+__all__ = ["HTTPClient", "MCPClassifySignal", "MCPError", "StdioClient",
+           "Tool", "ToolResult", "create_client"]

@@ -51,12 +51,26 @@ def build_engine(cfg: RouterConfig, mock: bool = False):
     from ..models.embeddings import MmBertEmbeddingModel
     from ..utils.tokenization import HFTokenizer
 
+    # resolve/auto-download checkpoints not already on disk
+    # (pkg/modeldownload role; absent CLI or gated repos soft-skip and
+    # the task's signals fail open)
+    from .modeldownload import ModelDownloader
+
+    downloader = ModelDownloader()
+    missing = {t: s for t, s in specs.items()
+               if s.get("checkpoint")
+               and not os.path.exists(s["checkpoint"])}
+    resolved_paths = downloader.ensure_all(missing) if missing else {}
+
     engine = InferenceEngine(cfg.engine)
     for task, spec in specs.items():
         path = spec.get("checkpoint", "")
+        if path and not os.path.exists(path):
+            path = resolved_paths.get(task, "")
         if not path or not os.path.exists(path):
             component_event("bootstrap", "model_missing", task=task,
-                            path=path, level="warning")
+                            path=spec.get("checkpoint", ""),
+                            level="warning")
             continue
         from safetensors.numpy import load_file
 

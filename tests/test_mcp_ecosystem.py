@@ -1,0 +1,305 @@
+"""MCP clients, model auto-download, K8s operator rendering, replay
+bench (reference: pkg/mcp, pkg/classification/mcp_classifier.go,
+pkg/modeldownload, deploy/operator, bench/)."""
+
+import json
+import sys
+import textwrap
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+import yaml
+
+from semantic_router_tpu.mcp import (
+    HTTPClient,
+    MCPClassifySignal,
+    MCPError,
+    StdioClient,
+    create_client,
+)
+
+MOCK_SERVER = textwrap.dedent("""
+    import json, sys
+    TOOLS = [{"name": "classify_text",
+              "description": "classify a text",
+              "inputSchema": {"type": "object"}}]
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        msg = json.loads(line)
+        if "id" not in msg:
+            continue  # notification
+        method = msg.get("method")
+        if method == "initialize":
+            result = {"protocolVersion": "2024-11-05",
+                      "serverInfo": {"name": "mock-mcp", "version": "1"}}
+        elif method == "tools/list":
+            result = {"tools": TOOLS}
+        elif method == "tools/call":
+            args = msg["params"]["arguments"]
+            text = args.get("text", "")
+            label = "math" if "integral" in text else "other"
+            result = {"content": [{"type": "text", "text": json.dumps(
+                {"class": label, "confidence": 0.9})}]}
+        elif method == "ping":
+            result = {}
+        else:
+            print(json.dumps({"jsonrpc": "2.0", "id": msg["id"],
+                              "error": {"code": -32601,
+                                        "message": "no such method"}}),
+                  flush=True)
+            continue
+        print(json.dumps({"jsonrpc": "2.0", "id": msg["id"],
+                          "result": result}), flush=True)
+""")
+
+
+@pytest.fixture()
+def stdio_client(tmp_path):
+    script = tmp_path / "mock_mcp.py"
+    script.write_text(MOCK_SERVER)
+    client = StdioClient("mock", sys.executable, [str(script)])
+    client.connect()
+    yield client
+    client.close()
+
+
+class TestStdioClient:
+    def test_connect_lists_tools(self, stdio_client):
+        assert stdio_client.server_info["name"] == "mock-mcp"
+        assert [t.name for t in stdio_client.tools] == ["classify_text"]
+        assert stdio_client.ping()
+
+    def test_call_tool(self, stdio_client):
+        out = stdio_client.call_tool("classify_text",
+                                     {"text": "compute the integral"})
+        assert not out.is_error
+        assert json.loads(out.text)["class"] == "math"
+
+    def test_unknown_method_maps_to_mcp_error(self, stdio_client):
+        with pytest.raises(MCPError) as e:
+            stdio_client._request("bogus/method")
+        assert e.value.code == -32601
+
+
+class TestHTTPClient:
+    @pytest.fixture()
+    def http_server(self):
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("content-length", 0))
+                msg = json.loads(self.rfile.read(n))
+                method = msg.get("method")
+                if "id" not in msg:
+                    self.send_response(204)
+                    self.end_headers()
+                    return
+                if method == "initialize":
+                    result = {"serverInfo": {"name": "http-mcp"}}
+                elif method == "tools/list":
+                    result = {"tools": [{"name": "echo"}]}
+                elif method == "tools/call":
+                    result = {"content": [{
+                        "type": "text",
+                        "text": msg["params"]["arguments"]["text"]}]}
+                else:
+                    result = {}
+                data = json.dumps({"jsonrpc": "2.0", "id": msg["id"],
+                                   "result": result}).encode()
+                self.send_response(200)
+                self.send_header("content-length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        yield f"http://127.0.0.1:{httpd.server_address[1]}"
+        httpd.shutdown()
+
+    def test_http_round_trip(self, http_server):
+        client = HTTPClient("h", http_server)
+        client.connect()
+        assert client.server_info["name"] == "http-mcp"
+        assert [t.name for t in client.tools] == ["echo"]
+        assert client.call_tool("echo", {"text": "hi"}).text == "hi"
+
+    def test_factory(self, http_server):
+        c = create_client({"name": "x", "url": http_server})
+        assert isinstance(c, HTTPClient)
+        c2 = create_client({"name": "y", "command": "python"})
+        assert isinstance(c2, StdioClient)
+
+
+class TestMCPClassifySignal:
+    def test_maps_remote_label_to_domain_rule(self, stdio_client):
+        from semantic_router_tpu.config.schema import DomainRule
+        from semantic_router_tpu.signals.base import RequestContext
+
+        sig = MCPClassifySignal(stdio_client, [
+            DomainRule(name="math", description="math questions")])
+        res = sig.evaluate(RequestContext.from_openai_body({
+            "messages": [{"role": "user",
+                          "content": "compute the integral of x^2"}]}))
+        assert res.error is None
+        assert [h.rule for h in res.hits] == ["math"]
+        assert res.hits[0].detail["via"] == "mcp"
+
+    def test_fails_open_on_dead_server(self):
+        from semantic_router_tpu.config.schema import DomainRule
+        from semantic_router_tpu.signals.base import RequestContext
+
+        client = HTTPClient("dead", "http://127.0.0.1:9/")
+        sig = MCPClassifySignal(client, [DomainRule(name="math")])
+        res = sig.evaluate(RequestContext.from_openai_body(
+            {"messages": [{"role": "user", "content": "x"}]}))
+        assert res.error is not None and res.hits == []
+
+
+class TestModelDownload:
+    def test_local_path_resolution_and_presence(self, tmp_path):
+        from semantic_router_tpu.runtime.modeldownload import (
+            ModelDownloader,
+        )
+
+        d = ModelDownloader(cache_dir=str(tmp_path))
+        local = tmp_path / "org__model"
+        local.mkdir()
+        (local / "model.safetensors").write_bytes(b"x")
+        assert d.is_present("org/model")
+        assert d.local_path("org/model") == str(local)
+        # literal config paths win
+        assert d.local_path(str(local)) == str(local)
+
+    def test_gated_detection(self):
+        from semantic_router_tpu.runtime.modeldownload import (
+            is_gated_error,
+        )
+
+        assert is_gated_error("401 unauthorized", "org/m", "tok")
+        assert is_gated_error("", "google/gemma-2b", "tok")
+        assert is_gated_error("exit status 1", "org/m", "")  # no token
+        assert not is_gated_error("disk full", "org/m", "tok")
+
+    def test_ensure_all_degrades_not_crashes(self, tmp_path, monkeypatch):
+        from semantic_router_tpu.runtime import modeldownload as md
+
+        monkeypatch.setattr(md, "_hf_cli", lambda: None)  # zero egress
+        present = tmp_path / "have"
+        present.mkdir()
+        (present / "config.json").write_text("{}")
+        d = md.ModelDownloader(cache_dir=str(tmp_path))
+        resolved = d.ensure_all({
+            "intent": {"checkpoint": str(present)},
+            "pii": {"checkpoint": "org/not-downloaded"}})
+        assert resolved == {"intent": str(present)}
+        assert d.state.phase == "degraded"
+        assert d.state.ready_models == 1
+
+
+class TestOperator:
+    POOL = {"apiVersion": "srt.tpu.dev/v1alpha1",
+            "kind": "IntelligentPool",
+            "metadata": {"name": "pool"},
+            "spec": {"defaultModel": "m1", "models": [
+                {"name": "m1", "qualityScore": 0.7,
+                 "pricing": {"promptPerM": 1.0, "completionPerM": 2.0},
+                 "backends": [{"endpoint": "vllm:8000", "weight": 100}]},
+                {"name": "m2"}]}}
+    ROUTE = {"apiVersion": "srt.tpu.dev/v1alpha1",
+             "kind": "IntelligentRoute",
+             "metadata": {"name": "route"},
+             "spec": {
+                 "signals": {"keywords": [{
+                     "name": "kw", "operator": "OR", "method": "exact",
+                     "keywords": ["urgent"]}]},
+                 "decisions": [{
+                     "name": "d1", "priority": 10,
+                     "rules": {"operator": "OR", "conditions": [
+                         {"type": "keyword", "name": "kw"}]},
+                     "modelRefs": [{"model": "m2"}]}]}}
+
+    def test_render_config(self):
+        from semantic_router_tpu.runtime.operator import render_config
+
+        raw = render_config(self.POOL, [self.ROUTE])
+        assert raw["default_model"] == "m1"
+        cards = raw["routing"]["modelCards"]
+        assert cards[0]["pricing"]["prompt"] == 1.0
+        assert cards[0]["backend_refs"][0]["endpoint"] == "vllm:8000"
+        assert raw["routing"]["decisions"][0]["name"] == "d1"
+
+    def test_file_operator_reconciles_and_router_loads(self, tmp_path):
+        from semantic_router_tpu.config import load_config
+        from semantic_router_tpu.router import Router
+        from semantic_router_tpu.runtime.operator import FileOperator
+
+        cr_dir = tmp_path / "crs"
+        cr_dir.mkdir()
+        (cr_dir / "pool.yaml").write_text(yaml.safe_dump(self.POOL))
+        (cr_dir / "route.yaml").write_text(yaml.safe_dump(self.ROUTE))
+        cfg_path = str(tmp_path / "router.yaml")
+        op = FileOperator(str(cr_dir), cfg_path)
+        assert op.reconcile_once() == "applied"
+        assert op.reconcile_once() == "unchanged"
+
+        cfg = load_config(cfg_path)
+        router = Router(cfg, engine=None)
+        try:
+            res = router.route({"model": "auto", "messages": [
+                {"role": "user", "content": "this is urgent"}]})
+            assert res.decision.decision.name == "d1"
+            assert res.model == "m2"
+        finally:
+            router.shutdown()
+
+    def test_invalid_cr_never_touches_live_config(self, tmp_path):
+        from semantic_router_tpu.runtime.operator import reconcile
+
+        bad_route = {"kind": "IntelligentRoute", "spec": {"decisions": [{
+            "name": "d", "rules": {"operator": "OR", "conditions": [
+                {"type": "keyword", "name": "missing"}]},
+            "modelRefs": [{"model": "ghost"}]}]}}
+        cfg_path = str(tmp_path / "live.yaml")
+        with open(cfg_path, "w") as f:
+            f.write("default_model: keep\n")
+        changed, status = reconcile(self.POOL, [bad_route], cfg_path)
+        assert not changed and status.startswith("invalid")
+        assert open(cfg_path).read() == "default_model: keep\n"
+
+
+class TestReplayBench:
+    def test_bench_runs_and_reports(self, capsys, monkeypatch):
+        from benchmarks import replay_bench
+
+        monkeypatch.setattr(
+            sys, "argv",
+            ["replay_bench.py", "--n", "40", "--concurrency", "2"])
+        assert replay_bench.main() == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["requests"] == 40
+        assert report["signals_per_s"] > 0
+        assert report["routing_latency_ms"]["p99"] >= \
+            report["routing_latency_ms"]["p50"]
+        assert "code_route" in report["decisions"]
+
+    def test_sharegpt_format_loading(self, tmp_path):
+        from benchmarks.replay_bench import first_human_turn, load_dataset
+
+        data = [{"conversations": [
+            {"from": "system", "value": "s"},
+            {"from": "human", "value": "the question"},
+            {"from": "gpt", "value": "the answer"}]}]
+        p = tmp_path / "d.json"
+        p.write_text(json.dumps(data))
+        convs = load_dataset(str(p), 10)
+        assert first_human_turn(convs[0]) == "the question"
+        # jsonl + openai-style roles
+        p2 = tmp_path / "d.jsonl"
+        p2.write_text(json.dumps({"messages": [
+            {"role": "user", "content": "hi"}]}) + "\n")
+        assert first_human_turn(load_dataset(str(p2), 10)[0]) == "hi"

