@@ -90,6 +90,23 @@ class InferenceEngine:
         self.cfg = cfg or InferenceEngineConfig()
         self._tasks: Dict[str, _Task] = {}
         self._lock = threading.Lock()
+
+        # serving-side sharded classifier bank (SURVEY §2.4 north-star
+        # layout: pjit-sharded bank over a slice): engine.mesh_shape
+        # builds a (dp, tp, sp) Mesh; task params shard per the Megatron
+        # rules and batches land dp-sharded — XLA inserts the collectives
+        self.mesh = None
+        if self.cfg.mesh_shape:
+            from ..parallel import create_mesh
+
+            self.mesh = create_mesh(dict(self.cfg.mesh_shape))
+            if self.mesh.shape.get("sp", 1) > 1:
+                # the serving bank shards batch (dp) and weights (tp);
+                # an sp axis would replicate all work — fail loudly
+                # instead of silently wasting half the slice
+                raise ValueError(
+                    "serving mesh_shape supports dp/tp only; fold sp "
+                    "into dp for the classifier bank")
         self.batcher = DynamicBatcher(
             self._run_batch,
             max_batch_size=self.cfg.max_batch_size,
@@ -116,6 +133,10 @@ class InferenceEngine:
         else:
             apply_fn = jax.jit(module.apply)
         max_len = max_seq_len or self.cfg.seq_len_buckets[-1]
+        if self.mesh is not None:
+            from ..parallel import shard_params
+
+            params = shard_params(params, self.mesh)
         with self._lock:
             self._tasks[name] = _Task(name, kind, list(labels), tokenizer,
                                       apply_fn, params, max_len, pad_id)
@@ -260,21 +281,43 @@ class InferenceEngine:
                buckets: Optional[Sequence[int]] = None) -> None:
         """Pre-trigger jit compilation for the hot (task, bucket, batch=1)
         shapes (reference warmupRouterRuntime, runtime_bootstrap.go:439).
-        The warmup text carries ≥bucket words so (after truncation to the
-        task max) the encoding actually lands in the target bucket."""
+
+        EVERY bucket a task can serve warms by default — a cold bucket in
+        production is a guaranteed SLO breach (one full XLA compile on the
+        first request of that shape).  Warmup calls the task's jitted
+        apply DIRECTLY instead of going through the batcher: the batcher
+        has ONE worker thread shared with live traffic, and parking a
+        multi-second 32K-bucket compile on it would queue real requests
+        past their timeouts — the exact breach warmup exists to prevent.
+        The compile cache is on the jitted function, so live requests of
+        the same shape hit it either way."""
         for name in tasks or list(self._tasks):
             t = self._tasks.get(name)
-            for b in buckets or self.cfg.seq_len_buckets[:2]:
-                if t is not None and b > t.max_seq_len:
+            if t is None or t.kind in ("generative", "multimodal"):
+                continue  # their compile caches key on other shapes
+            for b in buckets or self.cfg.seq_len_buckets:
+                if b > t.max_seq_len:
                     continue
                 try:
-                    text = "warmup " * b
-                    if t is not None and t.kind == "token":
-                        self.token_classify(name, text)
-                    elif t is not None and t.kind == "embedding":
-                        self.embed(name, [text])
+                    padded_n = pow2_batch(1, self.cfg.max_batch_size)
+                    if self.mesh is not None:
+                        dp = self.mesh.shape.get("dp", 1)
+                        padded_n = max(dp,
+                                       ((padded_n + dp - 1) // dp) * dp)
+                    ids = np.full((padded_n, b), t.pad_id, np.int32)
+                    ids[:, 0] = 1
+                    mask = np.ones((padded_n, b), np.int32)
+                    if self.mesh is not None:
+                        from ..parallel import batch_sharding
+
+                        sh = batch_sharding(self.mesh)
+                        ids_dev = jax.device_put(ids, sh)
+                        mask_dev = jax.device_put(mask, sh)
                     else:
-                        self.classify(name, text)
+                        ids_dev = jnp.asarray(ids)
+                        mask_dev = jnp.asarray(mask)
+                    out = t.apply_fn(t.params, ids_dev, mask_dev)
+                    jax.block_until_ready(out)
                 except Exception:
                     pass
 
@@ -316,6 +359,10 @@ class InferenceEngine:
         t = self._require(task_name)
         n = len(items)
         padded_n = pow2_batch(n, self.cfg.max_batch_size)
+        if self.mesh is not None:
+            # dp-sharded batches must divide evenly across the data axis
+            dp = self.mesh.shape.get("dp", 1)
+            padded_n = max(dp, ((padded_n + dp - 1) // dp) * dp)
 
         ids = np.full((padded_n, bucket), t.pad_id, dtype=np.int32)
         mask = np.zeros((padded_n, bucket), dtype=np.int32)
@@ -325,14 +372,27 @@ class InferenceEngine:
             ids[i, :L] = enc.ids[:L]
             mask[i, :L] = enc.attention_mask[:L]
 
+        if self.mesh is not None:
+            from ..parallel import batch_sharding
+
+            # device_put the HOST arrays directly: each device receives
+            # only its shard (asarray-then-reshard would stage the full
+            # batch on device 0 first — double transfer on the hot path)
+            sharding = batch_sharding(self.mesh)
+            ids_dev = jax.device_put(ids, sharding)
+            mask_dev = jax.device_put(mask, sharding)
+        else:
+            ids_dev = jnp.asarray(ids)
+            mask_dev = jnp.asarray(mask)
+
         if t.kind == "embedding":
             p = items[0].payload
-            emb = t.apply_fn(t.params, jnp.asarray(ids), jnp.asarray(mask),
+            emb = t.apply_fn(t.params, ids_dev, mask_dev,
                              exit_layer=p.exit_layer, output_dim=p.output_dim)
             emb = np.asarray(jax.device_get(emb), dtype=np.float32)
             return [emb[i] for i in range(n)]
 
-        logits = t.apply_fn(t.params, jnp.asarray(ids), jnp.asarray(mask))
+        logits = t.apply_fn(t.params, ids_dev, mask_dev)
         logits = np.asarray(jax.device_get(logits), dtype=np.float32)
 
         now = time.perf_counter()

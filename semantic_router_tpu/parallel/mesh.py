@@ -43,6 +43,14 @@ def create_mesh(shape: Optional[Dict[str, int]] = None,
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if shape:
+        # accept the long spellings too ({"data": 4} per the config docs)
+        alias = {"data": AXIS_DATA, "tensor": AXIS_TENSOR, "seq": AXIS_SEQ}
+        shape = {alias.get(k, k): int(v) for k, v in shape.items()}
+        unknown = set(shape) - {AXIS_DATA, AXIS_TENSOR, AXIS_SEQ}
+        if unknown:
+            # a typo'd axis must not silently degrade to pure-dp
+            raise ValueError(f"unknown mesh axes {sorted(unknown)} "
+                             f"(valid: dp/tp/sp or data/tensor/seq)")
         dp = int(shape.get(AXIS_DATA, 0)) or 0
         tp = int(shape.get(AXIS_TENSOR, 1))
         sp = int(shape.get(AXIS_SEQ, 1))
