@@ -1,0 +1,171 @@
+"""OTLP trace exporter: ship finished spans to a collector.
+
+Reference: the observability stack exports spans via OTLP (pkg/
+observability tracing exporters); this implementation speaks the
+standard OTLP/HTTP **JSON** encoding (officially supported by the spec
+and every collector) to ``{endpoint}/v1/traces`` — zero dependencies.
+
+Spans are buffered and flushed in batches by a daemon thread (and on
+buffer pressure); export failures drop the batch after bounded retries —
+tracing must never block or destabilize the data plane.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+from .logging import component_event
+from .tracing import Span, Tracer
+
+
+def _attr_value(v) -> Dict:
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+def span_to_otlp(span: Span) -> Dict:
+    return {
+        "traceId": span.trace_id,
+        "spanId": span.span_id,
+        **({"parentSpanId": span.parent_id} if span.parent_id else {}),
+        "name": span.name,
+        "kind": 1,  # SPAN_KIND_INTERNAL
+        "startTimeUnixNano": str(int(span.start_t * 1e9)),
+        "endTimeUnixNano": str(int((span.end_t or time.time()) * 1e9)),
+        "attributes": [{"key": k, "value": _attr_value(v)}
+                       for k, v in span.attributes.items()],
+    }
+
+
+def build_payload(spans: List[Span],
+                  service_name: str = "semantic-router-tpu") -> Dict:
+    return {"resourceSpans": [{
+        "resource": {"attributes": [
+            {"key": "service.name",
+             "value": {"stringValue": service_name}}]},
+        "scopeSpans": [{
+            "scope": {"name": "semantic_router_tpu"},
+            "spans": [span_to_otlp(s) for s in spans],
+        }],
+    }]}
+
+
+class OTLPExporter:
+    """Attachable span sink: ``exporter.attach(tracer)`` registers it;
+    spans batch in memory and flush every ``flush_interval_s`` or at
+    ``max_batch`` pressure."""
+
+    def __init__(self, endpoint: str,
+                 headers: Optional[Dict[str, str]] = None,
+                 service_name: str = "semantic-router-tpu",
+                 flush_interval_s: float = 5.0,
+                 max_batch: int = 256,
+                 max_buffer: int = 4096,
+                 timeout_s: float = 10.0) -> None:
+        self.endpoint = endpoint.rstrip("/")
+        self.headers = dict(headers or {})
+        self.service_name = service_name
+        self.flush_interval_s = flush_interval_s
+        self.max_batch = max_batch
+        self.max_buffer = max_buffer
+        self.timeout_s = timeout_s
+        self._buffer: List[Span] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.exported = 0
+        self.dropped = 0
+
+    # -- sink ------------------------------------------------------------
+
+    def __call__(self, span: Span) -> None:
+        with self._lock:
+            self._buffer.append(span)
+            if len(self._buffer) > self.max_buffer:
+                # bounded memory: oldest spans drop first
+                overflow = len(self._buffer) - self.max_buffer
+                del self._buffer[:overflow]
+                self.dropped += overflow
+            pressure = len(self._buffer) >= self.max_batch
+        if pressure:
+            # wake the daemon flusher; flushing INLINE here would put
+            # network I/O (up to 2×timeout) on the span-ending request
+            # thread — tracing must never block the data plane
+            self._wake.set()
+
+    def attach(self, tracer: Tracer) -> "OTLPExporter":
+        tracer.add_sink(self)
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="otlp-exporter")
+            self._thread.start()
+        return self
+
+    def detach(self, tracer: Tracer) -> None:
+        tracer.remove_sink(self)
+        self._stop.set()
+        self._wake.set()  # unblock the flusher so it exits promptly
+
+    # -- flushing --------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self.flush_interval_s)
+            self._wake.clear()
+            self.flush()
+        self.flush()
+
+    def flush(self) -> int:
+        with self._lock:
+            batch, self._buffer = self._buffer[:self.max_batch], \
+                self._buffer[self.max_batch:]
+        if not batch:
+            return 0
+        payload = json.dumps(build_payload(batch, self.service_name))
+        req = urllib.request.Request(
+            self.endpoint + "/v1/traces", data=payload.encode(),
+            method="POST")
+        req.add_header("content-type", "application/json")
+        for k, v in self.headers.items():
+            req.add_header(k, v)
+        for attempt in range(2):
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout_s):
+                    self.exported += len(batch)
+                    return len(batch)
+            except Exception as exc:
+                if attempt == 1:
+                    self.dropped += len(batch)
+                    component_event("otlp", "export_failed",
+                                    error=str(exc)[:200],
+                                    dropped=len(batch), level="warning")
+                else:
+                    time.sleep(0.2)
+        return 0
+
+
+def build_exporter_from_config(obs_cfg: Dict,
+                               tracer: Tracer) -> Optional[OTLPExporter]:
+    """observability.tracing.otlp_endpoint wires the exporter at
+    bootstrap; absent config → tracing stays in-proc only."""
+    tr = (obs_cfg or {}).get("tracing", {}) or {}
+    endpoint = tr.get("otlp_endpoint", "")
+    if not endpoint:
+        return None
+    exporter = OTLPExporter(
+        endpoint,
+        headers=tr.get("otlp_headers"),
+        service_name=tr.get("service_name", "semantic-router-tpu"),
+        flush_interval_s=float(tr.get("flush_interval_s", 5.0)))
+    return exporter.attach(tracer)

@@ -51,6 +51,16 @@ class Tracer:
         self._spans: List[Span] = []
         self._lock = threading.Lock()
         self._local = threading.local()
+        self._sinks: List = []  # callables(span) invoked on span end
+
+    def add_sink(self, sink) -> None:
+        with self._lock:
+            self._sinks.append(sink)
+
+    def remove_sink(self, sink) -> None:
+        with self._lock:
+            if sink in self._sinks:
+                self._sinks.remove(sink)
 
     # -- context propagation (W3C traceparent) ----------------------------
 
@@ -91,6 +101,12 @@ class Tracer:
                 self._spans.append(s)
                 if len(self._spans) > self.capacity:
                     del self._spans[:len(self._spans) - self.capacity]
+                sinks = list(self._sinks)
+            for sink in sinks:  # exporters (OTLP); never raise into spans
+                try:
+                    sink(s)
+                except Exception:
+                    pass
 
     def signal_span(self, family: str, **attrs):
         return self.span(f"signal.{family}", **attrs)

@@ -151,6 +151,15 @@ class RouterServer:
         self.version_store = ConfigVersionStore(config_path) \
             if config_path else None
 
+        # image-generation backends, one per decision plugin config
+        # (pkg/imagegen factory role), built lazily and cached
+        self._imagegen_backends: Dict[str, Any] = {}
+        self._imagegen_lock = threading.Lock()
+
+        from ..observability.session import default_session_telemetry
+
+        self.sessions = default_session_telemetry
+
         # shared looper plumbing (client is stateless; pool shared across
         # requests — a per-request Looper wraps them with request state)
         from ..looper import HTTPLLMClient
@@ -184,6 +193,20 @@ class RouterServer:
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
+    def _imagegen_backend(self, decision_name: str, conf: Dict[str, Any]):
+        from .imagegen import build_backend
+
+        # keyed by (decision, conf) so a config hot-reload that changes
+        # the plugin builds a fresh backend instead of serving the stale
+        # endpoint forever
+        key = (decision_name, json.dumps(conf, sort_keys=True))
+        with self._imagegen_lock:
+            backend = self._imagegen_backends.get(key)
+            if backend is None:
+                backend = build_backend(conf)
+                self._imagegen_backends[key] = backend
+            return backend
+
     @property
     def url(self) -> str:
         return f"http://127.0.0.1:{self.port}"
@@ -199,6 +222,11 @@ class RouterServer:
         self.httpd.shutdown()
         self.httpd.server_close()
         self.looper_pool.shutdown(wait=False, cancel_futures=True)
+        exporter = getattr(self, "otlp_exporter", None)
+        if exporter is not None:  # a leaked sink would double-export
+            from ..observability.tracing import default_tracer
+
+            exporter.detach(default_tracer)
         self.router.shutdown()
 
     # ------------------------------------------------------------------
@@ -763,6 +791,15 @@ class RouterServer:
                     self._looper_chat(route, headers, anthropic)
                     return
 
+                # image-generation decisions execute on an image backend
+                # and answer as a chat completion (pkg/imagegen role)
+                ig_plugin = route.decision.decision.plugin(
+                    "image_generation") if route.decision else None
+                if ig_plugin is not None and ig_plugin.enabled:
+                    self._image_generation(route, ig_plugin.configuration,
+                                           anthropic)
+                    return
+
                 backend = server.resolver.resolve(route.model)
                 if not backend:
                     self._json(502, {"error": {
@@ -808,6 +845,7 @@ class RouterServer:
                     processed = server.router.process_response(route, resp)
                     server.router.record_feedback(route, success=True,
                                                   latency_ms=latency_ms)
+                    self._record_session(route, resp, headers)
                     out_headers = dict(route.headers)
                     out_headers.update(processed.headers)
                     payload = processed.body
@@ -818,6 +856,104 @@ class RouterServer:
                     server.router.record_feedback(route, success=False,
                                                   latency_ms=latency_ms)
                     self._json(status, resp, route.headers)
+
+            def _record_session(self, route, resp: Dict[str, Any],
+                                headers: Dict[str, str]) -> None:
+                """Session telemetry after a successful turn
+                (sessiontelemetry.RecordTurn role)."""
+                try:
+                    usage = resp.get("usage") or {}
+                    card = server.router.model_cards.get(route.model)
+                    pricing = (card.pricing if card else {}) or {}
+                    cost = (usage.get("prompt_tokens", 0) / 1e6
+                            * pricing.get("prompt", 0.0)
+                            + usage.get("completion_tokens", 0) / 1e6
+                            * pricing.get("completion", 0.0))
+                    category = ""
+                    if route.signals:
+                        category = next(iter(
+                            route.signals.matches.get("domain", ())), "")
+                    server.sessions.record_turn(
+                        (route.body or {}).get("messages", []),
+                        route.model,
+                        user_id=headers.get("x-authz-user-id",
+                                            (route.body or {}).get("user",
+                                                                   "")),
+                        prompt_tokens=usage.get("prompt_tokens", 0),
+                        completion_tokens=usage.get("completion_tokens",
+                                                    0),
+                        cost=cost, domain=category)
+                except Exception:
+                    pass  # telemetry must never fail a request
+
+            def _image_generation(self, route, conf: Dict[str, Any],
+                                  anthropic: bool) -> None:
+                from ..signals.base import RequestContext as RC
+                from .imagegen import GenerateRequest, image_chat_completion
+
+                try:
+                    backend = server._imagegen_backend(
+                        route.decision.decision.name, conf)
+                except ValueError as exc:
+                    self._json(502, {"error": {"message": str(exc),
+                                               "type": "imagegen_error"}},
+                               route.headers)
+                    return
+                prompt = RC.from_openai_body(route.body or {}).user_text
+                req = GenerateRequest(
+                    prompt=prompt,
+                    model=conf.get("model", ""),
+                    width=int(conf.get("width", 1024)),
+                    height=int(conf.get("height", 1024)),
+                    num_inference_steps=int(conf.get(
+                        "num_inference_steps", 0)),
+                    guidance_scale=float(conf.get("guidance_scale", 0.0)),
+                    quality=conf.get("quality", ""),
+                    style=conf.get("style", ""))
+                t0 = time.perf_counter()
+                try:
+                    result = backend.generate(req)
+                except Exception as exc:
+                    server.router.record_feedback(
+                        route, success=False,
+                        latency_ms=(time.perf_counter() - t0) * 1e3)
+                    self._json(502, {"error": {
+                        "message": f"image generation failed: {exc}",
+                        "type": "imagegen_error"}}, route.headers)
+                    return
+                payload = image_chat_completion(result, prompt)
+                server.router.record_feedback(
+                    route, success=True,
+                    latency_ms=(time.perf_counter() - t0) * 1e3)
+                out_headers = dict(route.headers)
+                out_headers["x-vsr-image-backend"] = result.backend
+                if anthropic:
+                    payload = openai_to_anthropic_response(payload)
+                    self._json(200, payload, out_headers)
+                    return
+                if (route.body or {}).get("stream"):
+                    # the client negotiated SSE: answer as a single-chunk
+                    # stream so OpenAI SDK parsers work unchanged
+                    self.send_response(200)
+                    self.send_header("content-type", "text/event-stream")
+                    for k, v in out_headers.items():
+                        self.send_header(k, v)
+                    self.end_headers()
+                    chunk = {
+                        "id": payload["id"], "object":
+                        "chat.completion.chunk",
+                        "created": payload["created"],
+                        "model": payload["model"],
+                        "choices": [{"index": 0, "delta": {
+                            "role": "assistant",
+                            "content": payload["choices"][0]["message"][
+                                "content"]},
+                            "finish_reason": "stop"}]}
+                    self.wfile.write(
+                        f"data: {json.dumps(chunk)}\n\n".encode())
+                    self.wfile.write(b"data: [DONE]\n\n")
+                    return
+                self._json(200, payload, out_headers)
 
             def _responses(self, body: Dict[str, Any]) -> None:
                 """OpenAI Responses API endpoint: translate → route →

@@ -290,6 +290,13 @@ def serve(config_path: str, port: int = 8801,
         threading.Thread(target=engine.warmup, daemon=True,
                          name="warmup").start()
 
+    # OTLP span export when configured (observability.tracing.otlp_endpoint)
+    from ..observability.otlp import build_exporter_from_config
+    from ..observability.tracing import default_tracer
+
+    server.otlp_exporter = build_exporter_from_config(
+        cfg.observability, default_tracer)
+
     watcher = None
     if watch_config:
         def on_reload(new_cfg: RouterConfig) -> None:

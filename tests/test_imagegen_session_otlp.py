@@ -1,0 +1,339 @@
+"""Image generation backends, session telemetry, OTLP export
+(reference: pkg/imagegen, pkg/sessiontelemetry, observability OTLP)."""
+
+import base64
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+
+def _serve(handler_cls):
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+class TestImageBackends:
+    @pytest.fixture()
+    def openai_image_server(self):
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("content-length", 0))
+                body = json.loads(self.rfile.read(n))
+                assert self.path == "/v1/images/generations"
+                data = json.dumps({"data": [{
+                    "b64_json": base64.b64encode(b"PNGBYTES").decode(),
+                    "revised_prompt": "a refined " + body["prompt"],
+                }]}).encode()
+                self.send_response(200)
+                self.send_header("content-length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        httpd, url = _serve(Handler)
+        yield url
+        httpd.shutdown()
+
+    def test_openai_backend_generate(self, openai_image_server):
+        from semantic_router_tpu.router.imagegen import (
+            GenerateRequest,
+            OpenAIImageBackend,
+        )
+
+        b = OpenAIImageBackend(openai_image_server, model="img-model")
+        out = b.generate(GenerateRequest(prompt="a cat on a mat",
+                                         width=512, height=512))
+        assert out.image_base64
+        assert out.revised_prompt == "a refined a cat on a mat"
+        assert out.backend == "openai"
+
+    def test_vllm_omni_backend_parses_content_parts(self):
+        from semantic_router_tpu.router.imagegen import (
+            GenerateRequest,
+            VLLMOmniBackend,
+        )
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("content-length", 0))
+                body = json.loads(self.rfile.read(n))
+                assert body["extra_body"]["size"] == "256x256"
+                data = json.dumps({"model": "omni", "choices": [{
+                    "message": {"role": "assistant", "content": [
+                        {"type": "image_url",
+                         "image_url": {"url": "data:image/png;base64,AA"}},
+                    ]}}]}).encode()
+                self.send_response(200)
+                self.send_header("content-length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        httpd, url = _serve(Handler)
+        try:
+            out = VLLMOmniBackend(url, model="omni").generate(
+                GenerateRequest(prompt="draw", width=256, height=256))
+            assert out.image_url.startswith("data:image/png")
+        finally:
+            httpd.shutdown()
+
+    def test_factory_rejects_unknown(self):
+        from semantic_router_tpu.router.imagegen import build_backend
+
+        with pytest.raises(ValueError, match="unknown imagegen backend"):
+            build_backend({"backend": "nope"})
+
+    def test_image_route_through_server(self, openai_image_server):
+        """Modality decision → image backend → chat completion with the
+        image embedded (the full execution arm the modality signal was
+        missing)."""
+        from semantic_router_tpu.config import RouterConfig
+        from semantic_router_tpu.router import Router, RouterServer
+
+        cfg = RouterConfig.from_dict({
+            "default_model": "m1",
+            "routing": {
+                "modelCards": [{"name": "m1"}, {"name": "sdxl"}],
+                "signals": {"keywords": [{
+                    "name": "draw_kw", "operator": "OR", "method": "exact",
+                    "keywords": ["draw me"]}]},
+                "decisions": [{
+                    "name": "image_route", "priority": 100,
+                    "rules": {"operator": "OR", "conditions": [
+                        {"type": "keyword", "name": "draw_kw"}]},
+                    "modelRefs": [{"model": "sdxl"}],
+                    "plugins": [{"type": "image_generation",
+                                 "configuration": {
+                                     "enabled": True,
+                                     "backend": "openai",
+                                     "base_url": openai_image_server,
+                                     "model": "sdxl"}}],
+                }]},
+        })
+        router = Router(cfg, engine=None)
+        server = RouterServer(router, cfg).start()
+        try:
+            req = urllib.request.Request(
+                server.url + "/v1/chat/completions",
+                data=json.dumps({"model": "auto", "messages": [
+                    {"role": "user",
+                     "content": "draw me a sunset over hills"}]}).encode(),
+                method="POST")
+            req.add_header("content-type", "application/json")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                out = json.loads(resp.read())
+                headers = dict(resp.headers)
+            content = out["choices"][0]["message"]["content"]
+            assert content.startswith("![")
+            assert "data:image/png;base64," in content
+            assert headers["x-vsr-image-backend"] == "openai"
+            assert out["vsr_annotations"]["revised_prompt"]
+        finally:
+            server.stop()
+            router.shutdown()
+
+
+class TestImageStreamNegotiation:
+    def test_stream_true_gets_single_chunk_sse(self):
+        from semantic_router_tpu.config import RouterConfig
+        from semantic_router_tpu.router import Router, RouterServer
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("content-length", 0))
+                self.rfile.read(n)
+                data = json.dumps({"data": [{
+                    "b64_json": base64.b64encode(b"I").decode()}]}).encode()
+                self.send_response(200)
+                self.send_header("content-length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        httpd, url = _serve(Handler)
+        cfg = RouterConfig.from_dict({
+            "default_model": "m1",
+            "routing": {
+                "modelCards": [{"name": "m1"}],
+                "signals": {"keywords": [{
+                    "name": "kw", "operator": "OR", "method": "exact",
+                    "keywords": ["draw me"]}]},
+                "decisions": [{
+                    "name": "img", "priority": 10,
+                    "rules": {"operator": "OR", "conditions": [
+                        {"type": "keyword", "name": "kw"}]},
+                    "modelRefs": [{"model": "m1"}],
+                    "plugins": [{"type": "image_generation",
+                                 "configuration": {
+                                     "enabled": True, "backend": "openai",
+                                     "base_url": url}}]}]},
+        })
+        router = Router(cfg, engine=None)
+        server = RouterServer(router, cfg).start()
+        try:
+            req = urllib.request.Request(
+                server.url + "/v1/chat/completions",
+                data=json.dumps({"model": "auto", "stream": True,
+                                 "messages": [{"role": "user",
+                                               "content": "draw me x"}]}
+                                ).encode(), method="POST")
+            req.add_header("content-type", "application/json")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert resp.headers["content-type"].startswith(
+                    "text/event-stream")
+                body = resp.read().decode()
+            lines = [l for l in body.splitlines() if l.startswith("data:")]
+            assert lines[-1] == "data: [DONE]"
+            chunk = json.loads(lines[0][5:])
+            assert chunk["object"] == "chat.completion.chunk"
+            assert "data:image/png" in chunk["choices"][0]["delta"][
+                "content"]
+        finally:
+            server.stop()
+            router.shutdown()
+            httpd.shutdown()
+
+
+class TestSessionTelemetry:
+    def test_session_id_stable_and_turns(self):
+        from semantic_router_tpu.observability.session import (
+            chat_turn_number,
+            derive_session_id,
+        )
+
+        msgs1 = [{"role": "user", "content": "hello world"}]
+        msgs2 = [{"role": "user", "content": "hello world"},
+                 {"role": "assistant", "content": "hi"},
+                 {"role": "user", "content": "more"}]
+        a = derive_session_id(msgs1, "u1")
+        assert a.startswith("cc-") and len(a) == 19
+        assert derive_session_id(msgs2, "u1") == a  # same first message
+        assert derive_session_id(msgs1, "u2") != a
+        assert chat_turn_number(msgs1) == 1
+        assert chat_turn_number(msgs2) == 2
+
+    def test_record_turn_accumulates_and_transitions(self):
+        from semantic_router_tpu.observability.session import (
+            SessionTelemetry,
+        )
+
+        st = SessionTelemetry()
+        msgs = [{"role": "user", "content": "start a session"}]
+        t1 = st.record_turn(msgs, "model-a", user_id="u",
+                            prompt_tokens=10, completion_tokens=5,
+                            cost=0.01)
+        assert t1 is None
+        msgs2 = msgs + [{"role": "assistant", "content": "ok"},
+                        {"role": "user", "content": "next"}]
+        t2 = st.record_turn(msgs2, "model-b", user_id="u", cost=0.02)
+        assert t2 is not None
+        assert (t2.from_model, t2.to_model) == ("model-a", "model-b")
+        state = st.get(t2.session_id)
+        assert state.turns == 2
+        assert abs(state.total_cost - 0.03) < 1e-9
+        assert state.models_used == ["model-a", "model-b"]
+        assert st.last_model(msgs, "u") == "model-b"
+
+    def test_ttl_and_size_eviction(self):
+        from semantic_router_tpu.observability.session import (
+            SessionTelemetry,
+        )
+
+        st = SessionTelemetry(ttl_s=0.01, max_sessions=2)
+        st.record_turn([{"role": "user", "content": "a"}], "m")
+        time.sleep(0.03)
+        assert st.count() == 0  # TTL
+        st2 = SessionTelemetry(max_sessions=2)
+        for c in "abc":
+            st2.record_turn([{"role": "user", "content": c}], "m")
+        assert st2.count() == 2  # size cap evicts oldest
+
+
+class TestOTLPExport:
+    def test_spans_export_as_otlp_json(self):
+        from semantic_router_tpu.observability.otlp import OTLPExporter
+        from semantic_router_tpu.observability.tracing import Tracer
+
+        received = []
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("content-length", 0))
+                received.append((self.path,
+                                 json.loads(self.rfile.read(n))))
+                self.send_response(200)
+                self.send_header("content-length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+        httpd, url = _serve(Handler)
+        tracer = Tracer()
+        exporter = OTLPExporter(url, flush_interval_s=60.0)
+        exporter.attach(tracer)
+        try:
+            with tracer.span("signals.evaluate", family="kb", count=3):
+                pass
+            with tracer.span("decision.evaluate"):
+                pass
+            assert exporter.flush() == 2
+            path, payload = received[0]
+            assert path == "/v1/traces"
+            spans = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+            assert {s["name"] for s in spans} == \
+                {"signals.evaluate", "decision.evaluate"}
+            res_attrs = payload["resourceSpans"][0]["resource"][
+                "attributes"]
+            assert res_attrs[0]["value"]["stringValue"] == \
+                "semantic-router-tpu"
+            kb_span = next(s for s in spans
+                           if s["name"] == "signals.evaluate")
+            attrs = {a["key"]: a["value"] for a in kb_span["attributes"]}
+            assert attrs["family"]["stringValue"] == "kb"
+            assert attrs["count"]["intValue"] == "3"
+            assert int(kb_span["endTimeUnixNano"]) >= \
+                int(kb_span["startTimeUnixNano"])
+        finally:
+            exporter.detach(tracer)
+            httpd.shutdown()
+
+    def test_export_failure_drops_not_raises(self):
+        from semantic_router_tpu.observability.otlp import OTLPExporter
+        from semantic_router_tpu.observability.tracing import Tracer
+
+        tracer = Tracer()
+        exporter = OTLPExporter("http://127.0.0.1:9", flush_interval_s=60)
+        exporter.attach(tracer)
+        try:
+            with tracer.span("x"):
+                pass
+            assert exporter.flush() == 0
+            assert exporter.dropped == 1
+        finally:
+            exporter.detach(tracer)
+
+    def test_config_wiring(self):
+        from semantic_router_tpu.observability.otlp import (
+            build_exporter_from_config,
+        )
+        from semantic_router_tpu.observability.tracing import Tracer
+
+        tracer = Tracer()
+        assert build_exporter_from_config({}, tracer) is None
+        exp = build_exporter_from_config(
+            {"tracing": {"otlp_endpoint": "http://127.0.0.1:9"}}, tracer)
+        assert exp is not None
+        exp.detach(tracer)
