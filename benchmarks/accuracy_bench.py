@@ -1,0 +1,193 @@
+"""Router-vs-direct accuracy benchmark (MMLU-style multiple choice).
+
+Reference role: bench/ router_reasoning benchmarks (MMLU-Pro / ARC /
+GPQA router-vs-direct: does semantic routing match the big model's
+accuracy at lower cost?).
+
+Dataset: JSONL rows ``{"question", "choices": [...], "answer": "A"|idx,
+"category"}`` (``--dataset``), or the built-in synthetic set (zero
+egress; templated questions across categories, deterministic answers).
+
+Arms:
+- ``direct:<model>`` — every question to one model at a backend URL
+- ``router`` — through a router URL with model "auto" (the router picks)
+
+Report: per-arm accuracy (overall + per category), mean latency, token
+cost; JSON to stdout / ``--out``.
+
+Usage:
+  python benchmarks/accuracy_bench.py --router-url http://127.0.0.1:8801 \
+      --direct-url http://127.0.0.1:8000 --direct-model big-model [-n 200]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+LETTERS = "ABCDEFGH"
+
+_SYNTH = [
+    ("math", "What is {a} + {b}?", lambda a, b: a + b),
+    ("math", "What is {a} * {b}?", lambda a, b: a * b),
+    ("computer science", "How many bits are in {a} bytes?",
+     lambda a, b: a * 8),
+    ("history", "In a decade starting in {a}0, which year is last?",
+     lambda a, b: a * 10 + 9),
+]
+
+
+def synthetic_dataset(n: int) -> List[Dict]:
+    rows = []
+    for i in range(n):
+        cat, template, fn = _SYNTH[i % len(_SYNTH)]
+        a, b = 2 + i % 7, 3 + i % 5
+        correct = fn(a, b)
+        distractors = [correct + d for d in (1, -1, 2)]
+        choices = [str(c) for c in [correct] + distractors]
+        # rotate the correct answer through positions deterministically
+        rot = i % 4
+        choices = choices[-rot:] + choices[:-rot]
+        rows.append({"question": template.format(a=a, b=b),
+                     "choices": choices,
+                     "answer": LETTERS[choices.index(str(correct))],
+                     "category": cat})
+    return rows
+
+
+def load_dataset(path: str, n: int) -> List[Dict]:
+    rows = []
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                rows.append(json.loads(line))
+            if len(rows) >= n:
+                break
+    for r in rows:
+        if isinstance(r.get("answer"), int):
+            r["answer"] = LETTERS[r["answer"]]
+    return rows
+
+
+def build_prompt(row: Dict) -> str:
+    options = "\n".join(f"{LETTERS[i]}. {c}"
+                        for i, c in enumerate(row["choices"]))
+    return (f"{row['question']}\n{options}\n"
+            f"Answer with the letter of the correct option only.")
+
+
+def parse_letter(text: str, n_choices: int) -> Optional[str]:
+    m = re.search(rf"\b([{LETTERS[:n_choices]}])\b", text.strip().upper())
+    return m.group(1) if m else None
+
+
+def ask(url: str, model: str, prompt: str,
+        timeout_s: float = 120.0) -> Dict:
+    req = urllib.request.Request(
+        url.rstrip("/") + "/v1/chat/completions",
+        data=json.dumps({
+            "model": model, "temperature": 0,
+            "messages": [{"role": "user", "content": prompt}]}).encode(),
+        method="POST")
+    req.add_header("content-type", "application/json")
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        out = json.loads(resp.read())
+    out["_latency_s"] = time.perf_counter() - t0
+    return out
+
+
+def run_arm(name: str, url: str, model: str, rows: List[Dict],
+            pricing: Optional[Dict[str, Dict[str, float]]] = None) -> Dict:
+    correct = 0
+    by_cat: Dict[str, List[int]] = {}
+    latencies: List[float] = []
+    cost = 0.0
+    models_used: Dict[str, int] = {}
+    errors = 0
+    for row in rows:
+        try:
+            resp = ask(url, model, build_prompt(row))
+        except Exception:
+            errors += 1
+            continue
+        text = (resp.get("choices") or [{}])[0].get(
+            "message", {}).get("content") or ""
+        pred = parse_letter(text, len(row["choices"]))
+        ok = int(pred == row["answer"])
+        correct += ok
+        by_cat.setdefault(row.get("category", "?"), []).append(ok)
+        latencies.append(resp["_latency_s"])
+        used_model = resp.get("model", model)
+        models_used[used_model] = models_used.get(used_model, 0) + 1
+        usage = resp.get("usage") or {}
+        rates = (pricing or {}).get(used_model, {})
+        cost += (usage.get("prompt_tokens", 0) / 1e6
+                 * rates.get("prompt", 0.0)
+                 + usage.get("completion_tokens", 0) / 1e6
+                 * rates.get("completion", 0.0))
+    answered = len(rows) - errors
+    return {
+        "arm": name,
+        "accuracy": round(correct / answered, 4) if answered else 0.0,
+        "per_category": {c: round(sum(v) / len(v), 4)
+                         for c, v in sorted(by_cat.items())},
+        "answered": answered,
+        "errors": errors,
+        "mean_latency_ms": round(
+            sum(latencies) / len(latencies) * 1e3, 2) if latencies
+        else 0.0,
+        "cost": round(cost, 6),
+        "models_used": models_used,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", default="")
+    ap.add_argument("--n", type=int, default=100)
+    ap.add_argument("--router-url", default="")
+    ap.add_argument("--direct-url", default="")
+    ap.add_argument("--direct-model", default="")
+    ap.add_argument("--pricing", default="",
+                    help="JSON {model: {prompt, completion}} $/Mtok")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+
+    rows = load_dataset(args.dataset, args.n) if args.dataset \
+        else synthetic_dataset(args.n)
+    pricing = json.loads(args.pricing) if args.pricing else None
+    arms = []
+    if args.direct_url and args.direct_model:
+        arms.append(run_arm(f"direct:{args.direct_model}",
+                            args.direct_url, args.direct_model, rows,
+                            pricing))
+    if args.router_url:
+        arms.append(run_arm("router", args.router_url, "auto", rows,
+                            pricing))
+    if not arms:
+        print(json.dumps({"error": "need --router-url and/or "
+                                   "--direct-url + --direct-model"}))
+        return 2
+    report = {"questions": len(rows),
+              "dataset": args.dataset or f"synthetic({args.n})",
+              "arms": arms}
+    print(json.dumps(report, indent=2))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1003,6 +1003,9 @@ class RouterConfig:
     response_store: Dict[str, Any] = field(default_factory=dict)
     vectorstore: Dict[str, Any] = field(default_factory=dict)
     knowledge_bases: List["KnowledgeBaseDef"] = field(default_factory=list)
+    # remote MCP servers: {"classifiers": [{name, transport, command/url,
+    # tool, threshold}]} — served-classifier clients (pkg/mcp)
+    mcp: Dict[str, Any] = field(default_factory=dict)
     raw: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
@@ -1035,6 +1038,7 @@ class RouterConfig:
                              d.get("knowledge_bases",
                                    routing.get("knowledge_bases", []))
                              or []],
+            mcp=dict(d.get("mcp", {}) or {}),
             raw=d,
         )
 

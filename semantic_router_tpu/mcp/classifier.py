@@ -27,16 +27,27 @@ class MCPClassifySignal:
     def __init__(self, client: _BaseClient, rules: List[DomainRule],
                  tool_name: str = "classify_text",
                  threshold: float = 0.0) -> None:
+        import threading
+
         self.client = client
         self.rules = rules
         self.tool_name = tool_name
         self.threshold = threshold
+        self._connect_lock = threading.Lock()
         self._by_name = {r.name.lower(): r for r in rules}
         for r in rules:
             for cat in r.mmlu_categories:
                 self._by_name.setdefault(cat.lower(), r)
 
     def classify(self, text: str) -> Optional[Dict]:
+        if not self.client.is_connected:
+            # lazy connect under a lock: concurrent first requests must
+            # not double-connect (a stdio double-connect leaks the first
+            # server subprocess). A failed connect is this family's
+            # fail-open error.
+            with self._connect_lock:
+                if not self.client.is_connected:
+                    self.client.connect()
         result = self.client.call_tool(self.tool_name, {"text": text})
         if result.is_error:
             raise RuntimeError(f"MCP tool error: {result.text[:200]}")

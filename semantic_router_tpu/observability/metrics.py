@@ -48,6 +48,15 @@ class Counter:
     def get(self, **labels: str) -> float:
         return self._values.get(_label_key(labels), 0.0)
 
+    def values(self) -> Dict[tuple, float]:
+        """Snapshot of all labeled values (dashboard aggregation)."""
+        with self._lock:
+            return dict(self._values)
+
+    def total(self) -> float:
+        with self._lock:
+            return sum(self._values.values())
+
     def expose(self) -> List[str]:
         out = [f"# TYPE {self.name} counter"]
         with self._lock:
@@ -110,6 +119,37 @@ class Histogram:
 
     def count(self, **labels: str) -> int:
         return self._totals.get(_label_key(labels), 0)
+
+    def totals(self) -> Dict[tuple, int]:
+        """Locked snapshot of per-label observation counts."""
+        with self._lock:
+            return dict(self._totals)
+
+    def summary(self) -> Dict[str, float]:
+        """Aggregate count/mean/p50/p95/p99 across all label sets
+        (dashboard aggregation)."""
+        with self._lock:
+            total = sum(self._totals.values())
+            total_sum = sum(self._sums.values())
+            merged = [0] * (len(self.buckets) + 1)
+            for counts in self._counts.values():
+                for i, c in enumerate(counts):
+                    merged[i] += c
+
+        def pct(p: float) -> float:
+            if total == 0:
+                return 0.0
+            target = p / 100.0 * total
+            cum = 0
+            for i, c in enumerate(merged[:-1]):
+                cum += c
+                if cum >= target:
+                    return self.buckets[i]
+            return self.buckets[-1] if self.buckets else 0.0
+
+        return {"count": total,
+                "mean": total_sum / total if total else 0.0,
+                "p50": pct(50), "p95": pct(95), "p99": pct(99)}
 
     def expose(self) -> List[str]:
         out = [f"# TYPE {self.name} histogram"]

@@ -122,8 +122,18 @@ class OTLPExporter:
         while not self._stop.is_set():
             self._wake.wait(self.flush_interval_s)
             self._wake.clear()
-            self.flush()
-        self.flush()
+            self.drain()
+        self.drain()  # shutdown: ship the whole backlog, not one batch
+
+    def drain(self) -> int:
+        """Flush until the buffer is empty (a burst must not trickle out
+        at one batch per interval, and shutdown must not discard)."""
+        total = 0
+        while True:
+            sent = self.flush()
+            if sent == 0:
+                return total
+            total += sent
 
     def flush(self) -> int:
         with self._lock:

@@ -72,6 +72,16 @@ class ResponseResult:
     hallucination_spans: List[dict] = field(default_factory=list)
 
 
+def usage_cost(usage: Dict[str, Any], pricing: Dict[str, float]) -> float:
+    """$ cost of one response from its usage block and a model card's
+    per-Mtok pricing — the ONE place this formula lives (model cost
+    metrics and session telemetry must never diverge)."""
+    return ((usage or {}).get("prompt_tokens", 0) / 1e6
+            * (pricing or {}).get("prompt", 0.0)
+            + (usage or {}).get("completion_tokens", 0) / 1e6
+            * (pricing or {}).get("completion", 0.0))
+
+
 def _immediate_chat_completion(content: str, model: str = "router") -> dict:
     return {
         "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
@@ -104,6 +114,20 @@ class Router:
             from ..signals.learned import build_learned_evaluators
 
             extra = build_learned_evaluators(engine, cfg)
+        # MCP-served classifiers (pkg/classification/mcp_classifier.go):
+        # remote classify tools join the signal fan-out, fail-open like
+        # every family (lazy connect on first evaluate)
+        for spec in (cfg.mcp or {}).get("classifiers", []) or []:
+            try:
+                from ..mcp import MCPClassifySignal, create_client
+
+                extra.append(MCPClassifySignal(
+                    create_client(spec), cfg.signals.domains,
+                    tool_name=spec.get("tool", "classify_text"),
+                    threshold=float(spec.get("threshold", 0.0))))
+            except Exception as exc:
+                component_event("router", "mcp_classifier_skipped",
+                                error=str(exc), level="warning")
         self.dispatcher = build_heuristic_dispatcher(cfg, extra=extra)
         self.decision_engine = DecisionEngine(cfg.decisions, cfg.strategy)
         self.rate_limiter = RateLimiter.from_config(cfg.ratelimit)
@@ -609,11 +633,8 @@ class Router:
         if usage and route.model:
             card = self.model_cards.get(route.model)
             if card and card.pricing:
-                cost = (usage.get("prompt_tokens", 0) / 1e6
-                        * card.pricing.get("prompt", 0.0)
-                        + usage.get("completion_tokens", 0) / 1e6
-                        * card.pricing.get("completion", 0.0))
-                M.model_cost.inc(cost, model=route.model)
+                M.model_cost.inc(usage_cost(usage, card.pricing),
+                                 model=route.model)
 
         # memory auto-store after a successful exchange
         # (processor_res_memory.go)

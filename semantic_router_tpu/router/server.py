@@ -426,6 +426,8 @@ class RouterServer:
                                              ("sequence", "token") else [])}
                                  for t in eng.tasks()]
                     self._json(200, {"tasks": tasks})
+                elif path.startswith("/dashboard/api/"):
+                    self._dashboard(path)
                 elif path == "/v1/memory":
                     store = server.router.memory_store
                     if store is None:
@@ -541,6 +543,82 @@ class RouterServer:
                 except Exception as exc:  # pipeline fail-open: surface 500
                     self._json(500, {"error": {
                         "message": f"{type(exc).__name__}: {exc}"}})
+
+            # -- dashboard backend (reference dashboard/backend role:
+            # aggregate router state as JSON for a UI) -----------------
+
+            def _dashboard(self, path: str) -> None:
+                from ..observability import metrics as M
+
+                sub = path[len("/dashboard/api/"):]
+                if sub == "overview":
+                    cache_stats = {}
+                    if server.router.cache is not None:
+                        s = server.router.cache.stats()
+                        cache_stats = {"hits": s.hits, "misses": s.misses,
+                                       "entries": s.entries,
+                                       "hit_rate": round(s.hit_rate, 4)}
+                    self._json(200, {
+                        "uptime_s": round(time.time() - server.started_t,
+                                          1),
+                        "requests_total": M.model_requests.total(),
+                        "requests_by_model": {
+                            dict(k).get("model", "?"): v for k, v in
+                            M.model_requests.values().items()},
+                        "decisions": {
+                            dict(k).get("name", "?"): v for k, v in
+                            M.decision_matches.values().items()},
+                        "routing_latency": M.routing_latency.summary(),
+                        "completion_latency":
+                            M.completion_latency.summary(),
+                        "cost_total": round(M.model_cost.total(), 6),
+                        "cache": cache_stats,
+                        "sessions": server.sessions.count(),
+                        "blocks": {
+                            "jailbreak": M.jailbreak_blocks.total(),
+                            "pii": M.pii_violations.total()},
+                    })
+                elif sub == "signals":
+                    self._json(200, {
+                        "latency": {
+                            dict(k).get("family", "?"): {"count": v}
+                            for k, v in
+                            M.signal_latency.totals().items()},
+                        "summary": M.signal_latency.summary(),
+                    })
+                elif sub == "replay":
+                    store = getattr(server.router, "replay_store", None)
+                    if store is None:
+                        self._json(200, {"records": []})
+                        return
+                    try:
+                        limit = int(self._query().get("limit", "50"))
+                    except ValueError:
+                        self._json(400, {"error": "limit must be an "
+                                                  "integer"})
+                        return
+                    self._json(200, {"records": [
+                        {"id": r.record_id, "ts": r.timestamp,
+                         "decision": r.decision, "model": r.model,
+                         "kind": r.kind,
+                         "latency_ms": r.routing_latency_ms,
+                         "matched_rules": r.matched_rules}
+                        for r in store.list(limit=limit)]})
+                elif sub == "config":
+                    from ..config.schema import redact_config
+                    from ..config.versions import config_hash
+
+                    self._json(200, {
+                        "hash": config_hash(server.cfg.raw),
+                        "decisions": [d.name for d in
+                                      server.cfg.decisions],
+                        "models": [m.name for m in server.cfg.model_cards],
+                        "signal_families":
+                            server.cfg.used_signal_types(),
+                        "config": redact_config(server.cfg.raw),
+                    })
+                else:
+                    self._json(404, {"error": "not found"})
 
             # -- management handlers ----------------------------------
 
@@ -797,7 +875,7 @@ class RouterServer:
                     "image_generation") if route.decision else None
                 if ig_plugin is not None and ig_plugin.enabled:
                     self._image_generation(route, ig_plugin.configuration,
-                                           anthropic)
+                                           anthropic, headers)
                     return
 
                 backend = server.resolver.resolve(route.model)
@@ -862,13 +940,12 @@ class RouterServer:
                 """Session telemetry after a successful turn
                 (sessiontelemetry.RecordTurn role)."""
                 try:
+                    from .pipeline import usage_cost
+
                     usage = resp.get("usage") or {}
                     card = server.router.model_cards.get(route.model)
-                    pricing = (card.pricing if card else {}) or {}
-                    cost = (usage.get("prompt_tokens", 0) / 1e6
-                            * pricing.get("prompt", 0.0)
-                            + usage.get("completion_tokens", 0) / 1e6
-                            * pricing.get("completion", 0.0))
+                    cost = usage_cost(usage,
+                                      (card.pricing if card else {}) or {})
                     category = ""
                     if route.signals:
                         category = next(iter(
@@ -887,7 +964,8 @@ class RouterServer:
                     pass  # telemetry must never fail a request
 
             def _image_generation(self, route, conf: Dict[str, Any],
-                                  anthropic: bool) -> None:
+                                  anthropic: bool,
+                                  req_headers: Dict[str, str]) -> None:
                 from ..signals.base import RequestContext as RC
                 from .imagegen import GenerateRequest, image_chat_completion
 
@@ -925,6 +1003,9 @@ class RouterServer:
                 server.router.record_feedback(
                     route, success=True,
                     latency_ms=(time.perf_counter() - t0) * 1e3)
+                # image turns are session turns too: model continuity and
+                # text↔image transitions must see them
+                self._record_session(route, payload, req_headers)
                 out_headers = dict(route.headers)
                 out_headers["x-vsr-image-backend"] = result.backend
                 if anthropic:

@@ -1,0 +1,242 @@
+"""Dashboard backend API, accuracy bench harness, MCP config auto-wiring
+(reference: dashboard/backend, bench/ router-vs-direct, mcp wiring)."""
+
+import json
+import sys
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from semantic_router_tpu.config import load_config
+from semantic_router_tpu.router import MockVLLMServer, Router, RouterServer
+from semantic_router_tpu.runtime.bootstrap import build_router
+
+
+def http(url, method="GET", body=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode() if body is not None else None,
+        method=method)
+    req.add_header("content-type", "application/json")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, json.loads(resp.read() or b"{}")
+
+
+class TestDashboardAPI:
+    @pytest.fixture()
+    def served(self, fixture_config_path):
+        backend = MockVLLMServer().start()
+        cfg = load_config(fixture_config_path)
+        router = build_router(cfg)
+        server = RouterServer(router, cfg,
+                              default_backend=backend.url).start()
+        yield server
+        server.stop()
+        router.shutdown()
+        backend.stop()
+
+    def test_overview_reflects_traffic(self, served):
+        # drive a couple of requests so counters move
+        for text in ("this is urgent, fix asap", "hello there"):
+            http(served.url + "/v1/chat/completions", "POST",
+                 {"model": "auto",
+                  "messages": [{"role": "user", "content": text}]})
+        status, ov = http(served.url + "/dashboard/api/overview")
+        assert status == 200
+        assert ov["requests_total"] >= 2
+        assert "qwen3-8b" in ov["requests_by_model"]
+        assert ov["routing_latency"]["count"] >= 2
+        assert "decisions" in ov and "cache" in ov
+
+    def test_replay_and_config_views(self, served):
+        http(served.url + "/v1/chat/completions", "POST",
+             {"model": "auto",
+              "messages": [{"role": "user", "content": "urgent thing"}]})
+        status, rep = http(served.url + "/dashboard/api/replay?limit=10")
+        assert status == 200 and rep["records"]
+        assert rep["records"][0]["decision"]
+        status, cfgv = http(served.url + "/dashboard/api/config")
+        assert status == 200
+        assert "urgent_route" in cfgv["decisions"]
+        assert cfgv["hash"]
+        # secrets never leak through the dashboard view
+        assert "api_key" not in json.dumps(cfgv["config"]).replace(
+            '"api_key": "***"', "")
+
+    def test_signals_view(self, served):
+        status, sig = http(served.url + "/dashboard/api/signals")
+        assert status == 200 and "summary" in sig
+
+
+class AnswerBackend:
+    """OpenAI-shape backend that answers multiple-choice prompts: the
+    'big' model always correct, the 'small' model correct only for short
+    questions — so routing quality is measurable."""
+
+    def __init__(self):
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(inner):
+                n = int(inner.headers.get("content-length", 0))
+                body = json.loads(inner.rfile.read(n))
+                prompt = body["messages"][-1]["content"]
+                model = body.get("model", "")
+                # recover the correct letter from the synthetic prompt
+                import re
+
+                from benchmarks.accuracy_bench import (
+                    LETTERS,
+                    parse_letter,
+                )
+
+                lines = [l for l in prompt.splitlines()
+                         if re.match(r"^[A-H]\. ", l)]
+                question = prompt.splitlines()[0]
+                correct = None
+                try:
+                    # synthetic questions: recompute the answer
+                    m = re.search(r"(\d+) \+ (\d+)", question)
+                    if m:
+                        val = int(m.group(1)) + int(m.group(2))
+                    else:
+                        m = re.search(r"(\d+) \* (\d+)", question)
+                        if m:
+                            val = int(m.group(1)) * int(m.group(2))
+                        else:
+                            m = re.search(r"(\d+) bytes", question)
+                            if m:
+                                val = int(m.group(1)) * 8
+                            else:
+                                m = re.search(r"(\d+)0,", question)
+                                val = int(m.group(1)) * 10 + 9 if m else 0
+                    for line in lines:
+                        if line[3:].strip() == str(val):
+                            correct = line[0]
+                except Exception:
+                    correct = None
+                if model == "small-model" and "*" in question:
+                    # the small model fails multiplication
+                    answer = "A" if correct != "A" else "B"
+                else:
+                    answer = correct or "A"
+                data = json.dumps({
+                    "model": model,
+                    "choices": [{"message": {"role": "assistant",
+                                             "content": answer},
+                                 "finish_reason": "stop"}],
+                    "usage": {"prompt_tokens": len(prompt) // 4,
+                              "completion_tokens": 1}}).encode()
+                inner.send_response(200)
+                inner.send_header("content-length", str(len(data)))
+                inner.end_headers()
+                inner.wfile.write(data)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+class TestAccuracyBench:
+    def test_synthetic_dataset_shape(self):
+        from benchmarks.accuracy_bench import synthetic_dataset
+
+        rows = synthetic_dataset(20)
+        assert len(rows) == 20
+        for r in rows:
+            assert r["answer"] in "ABCD"
+            assert r["choices"][
+                "ABCD".index(r["answer"])] is not None
+
+    def test_direct_arms_measure_model_quality(self):
+        from benchmarks.accuracy_bench import run_arm, synthetic_dataset
+
+        backend = AnswerBackend()
+        try:
+            rows = synthetic_dataset(24)
+            big = run_arm("direct:big", backend.url, "big-model", rows)
+            small = run_arm("direct:small", backend.url, "small-model",
+                            rows)
+            assert big["accuracy"] == 1.0
+            assert small["accuracy"] < 1.0  # fails multiplication
+            assert small["per_category"]["math"] < 1.0
+            assert big["answered"] == 24 and big["errors"] == 0
+        finally:
+            backend.stop()
+
+    def test_cli_reports_router_vs_direct(self, capsys, monkeypatch):
+        from benchmarks import accuracy_bench
+
+        backend = AnswerBackend()
+        try:
+            monkeypatch.setattr(sys, "argv", [
+                "accuracy_bench.py", "--n", "12",
+                "--direct-url", backend.url,
+                "--direct-model", "big-model",
+                "--pricing", json.dumps({
+                    "big-model": {"prompt": 10.0, "completion": 30.0}})])
+            assert accuracy_bench.main() == 0
+            report = json.loads(capsys.readouterr().out)
+            assert report["arms"][0]["accuracy"] == 1.0
+            assert report["arms"][0]["cost"] > 0
+        finally:
+            backend.stop()
+
+
+class TestMCPAutoWiring:
+    def test_configured_mcp_classifier_joins_fanout(self, tmp_path):
+        import textwrap
+
+        from semantic_router_tpu.config import RouterConfig
+
+        script = tmp_path / "srv.py"
+        script.write_text(textwrap.dedent("""
+            import json, sys
+            for line in sys.stdin:
+                msg = json.loads(line)
+                if "id" not in msg: continue
+                m = msg.get("method")
+                if m == "tools/call":
+                    r = {"content": [{"type": "text", "text": json.dumps(
+                        {"class": "science", "confidence": 0.95})}]}
+                elif m == "initialize":
+                    r = {"serverInfo": {"name": "s"}}
+                elif m == "tools/list":
+                    r = {"tools": [{"name": "classify_text"}]}
+                else:
+                    r = {}
+                print(json.dumps({"jsonrpc": "2.0", "id": msg["id"],
+                                  "result": r}), flush=True)
+        """))
+        cfg = RouterConfig.from_dict({
+            "default_model": "m1",
+            "mcp": {"classifiers": [{
+                "name": "remote", "transport": "stdio",
+                "command": sys.executable, "args": [str(script)],
+                "tool": "classify_text"}]},
+            "routing": {
+                "modelCards": [{"name": "m1"}, {"name": "sci-model"}],
+                "signals": {"domains": [{"name": "science"}]},
+                "decisions": [{
+                    "name": "sci_route", "priority": 10,
+                    "rules": {"operator": "OR", "conditions": [
+                        {"type": "domain", "name": "science"}]},
+                    "modelRefs": [{"model": "sci-model"}],
+                }]},
+        })
+        router = Router(cfg, engine=None)
+        try:
+            res = router.route({"model": "auto", "messages": [
+                {"role": "user",
+                 "content": "explain quantum entanglement"}]})
+            assert res.decision is not None
+            assert res.decision.decision.name == "sci_route"
+            assert res.model == "sci-model"
+        finally:
+            router.shutdown()
