@@ -129,12 +129,10 @@ def run_arm(name: str, url: str, model: str, rows: List[Dict],
         latencies.append(resp["_latency_s"])
         used_model = resp.get("model", model)
         models_used[used_model] = models_used.get(used_model, 0) + 1
-        usage = resp.get("usage") or {}
-        rates = (pricing or {}).get(used_model, {})
-        cost += (usage.get("prompt_tokens", 0) / 1e6
-                 * rates.get("prompt", 0.0)
-                 + usage.get("completion_tokens", 0) / 1e6
-                 * rates.get("completion", 0.0))
+        from semantic_router_tpu.router.pipeline import usage_cost
+
+        cost += usage_cost(resp.get("usage") or {},
+                           (pricing or {}).get(used_model, {}))
     answered = len(rows) - errors
     return {
         "arm": name,

@@ -1,0 +1,16 @@
+"""TPU fleet simulator (reference: src/fleet-sim, ~13k LoC)."""
+
+from .sim import (
+    FleetAllocation,
+    ModelLoad,
+    SimReport,
+    SliceSpec,
+    TPU_CATALOG,
+    optimize_fleet,
+    simulate,
+    workload_from_replay_report,
+)
+
+__all__ = ["FleetAllocation", "ModelLoad", "SimReport", "SliceSpec",
+           "TPU_CATALOG", "optimize_fleet", "simulate",
+           "workload_from_replay_report"]

@@ -1,0 +1,194 @@
+"""NL→DSL generation, TPU fleet simulator, load bench
+(reference: pkg/nlgen, src/fleet-sim, load evidence for the data plane)."""
+
+import json
+import math
+import sys
+
+import pytest
+
+
+GOOD_DSL = '''
+model "fast-8b" { param_size: "8B" quality_score: 0.8 }
+signal keyword urgent_kw { method: exact keywords: ["urgent"] }
+decision urgent_route priority 100 {
+    when keyword(urgent_kw)
+    route to "fast-8b"
+    algorithm static
+}
+'''
+
+
+class TestNLGen:
+    def test_generate_valid_first_try(self):
+        from semantic_router_tpu.dsl.nlgen import generate_from_nl
+
+        calls = []
+
+        def llm(prompt):
+            calls.append(prompt)
+            return f"```\n{GOOD_DSL}\n```"
+
+        res = generate_from_nl(llm, "route urgent messages to fast-8b")
+        assert res.valid and res.attempts == 1
+        assert res.config.decisions[0].name == "urgent_route"
+        assert "routing policies in a DSL" in calls[0]
+        assert "route urgent messages" in calls[0]
+
+    def test_repair_loop_feeds_compiler_error_back(self):
+        from semantic_router_tpu.dsl.nlgen import generate_from_nl
+
+        calls = []
+
+        def llm(prompt):
+            calls.append(prompt)
+            if len(calls) == 1:
+                # references an undeclared model → semantic error
+                return ('decision d priority 10 { when kw '
+                        'route to "ghost" algorithm static }')
+            return GOOD_DSL
+
+        res = generate_from_nl(llm, "do the thing", max_retries=2)
+        assert res.valid and res.attempts == 2
+        assert len(res.errors) == 1
+        # the repair prompt carried the failing code AND the error
+        assert "ghost" in calls[1]
+        assert "FAILED to compile" in calls[1]
+
+    def test_gives_up_after_retries(self):
+        from semantic_router_tpu.dsl.nlgen import generate_from_nl
+
+        res = generate_from_nl(lambda p: "not dsl at all {",
+                               "x", max_retries=1)
+        assert not res.valid
+        assert res.attempts == 2
+        assert len(res.errors) == 2
+
+    def test_sanitize_output(self):
+        from semantic_router_tpu.dsl.nlgen import sanitize_llm_output
+
+        fenced = f"Sure! Here you go:\n```dsl\n{GOOD_DSL}```\nEnjoy."
+        assert sanitize_llm_output(fenced).startswith('model "fast-8b"')
+        assert sanitize_llm_output("  plain text ") == "plain text"
+
+    def test_repair_from_feedback(self):
+        from semantic_router_tpu.dsl.nlgen import repair_from_feedback
+
+        res = repair_from_feedback(
+            lambda p: GOOD_DSL, "route urgent",
+            bad_code="decision broken {", feedback="unbalanced brace")
+        assert res.valid
+
+
+class TestFleetSim:
+    def test_throughput_model_sanity(self):
+        from semantic_router_tpu.fleetsim import TPU_CATALOG
+        from semantic_router_tpu.fleetsim.sim import slice_tokens_per_s
+
+        v5e4 = TPU_CATALOG["v5e-4"]
+        small = slice_tokens_per_s(v5e4, 8.0)
+        assert small > 0
+        # bigger model → lower throughput on the same slice
+        assert slice_tokens_per_s(v5e4, 30.0) == 0.0 or \
+            slice_tokens_per_s(v5e4, 30.0) < small
+        # 70B does not fit a single v5e-4 (16 GiB × 4)
+        assert slice_tokens_per_s(v5e4, 70.0) == 0.0
+        # but fits a v5p-8 (95 GiB × 8)
+        assert slice_tokens_per_s(TPU_CATALOG["v5p-8"], 70.0) > 0
+
+    def test_optimize_produces_feasible_fleet(self):
+        from semantic_router_tpu.fleetsim import (
+            ModelLoad,
+            optimize_fleet,
+            simulate,
+        )
+
+        workload = [
+            ModelLoad(model="small", param_b=8, requests_per_s=5),
+            ModelLoad(model="big", param_b=70, requests_per_s=0.5),
+        ]
+        alloc = optimize_fleet(workload)
+        report = simulate(workload, alloc)
+        assert report.feasible
+        assert report.cost_per_hour > 0
+        for m in report.models:
+            assert m.utilization < 0.85
+            assert m.slo_ok
+
+    def test_whatif_detects_undersized_fleet(self):
+        from semantic_router_tpu.fleetsim import (
+            FleetAllocation,
+            ModelLoad,
+            simulate,
+        )
+
+        workload = [ModelLoad(model="big", param_b=70,
+                              requests_per_s=50)]
+        tiny = FleetAllocation(slices={"big": {"v5p-8": 1}})
+        report = simulate(workload, tiny)
+        assert not report.feasible
+        assert report.models[0].utilization > 0.85 or \
+            not report.models[0].slo_ok
+
+    def test_optimize_rejects_unfittable_model(self):
+        from semantic_router_tpu.fleetsim import ModelLoad, optimize_fleet
+        from semantic_router_tpu.fleetsim.sim import SliceSpec
+
+        tiny_catalog = {"nano": SliceSpec("nano", 1, 100, 4, 400, 1.0)}
+        with pytest.raises(ValueError, match="fits"):
+            optimize_fleet([ModelLoad(model="m", param_b=70,
+                                      requests_per_s=1)],
+                           catalog=tiny_catalog)
+
+    def test_cli_optimize_and_whatif(self, tmp_path, capsys, monkeypatch):
+        from semantic_router_tpu.fleetsim import __main__ as cli
+
+        wl = tmp_path / "w.json"
+        wl.write_text(json.dumps([
+            {"model": "small", "param_b": 8, "requests_per_s": 2}]))
+        assert cli.main(["optimize", "--workload", str(wl)]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["feasible"] and out["allocation"]["small"]
+
+        fleet = tmp_path / "f.json"
+        fleet.write_text(json.dumps(out["allocation"]))
+        assert cli.main(["whatif", "--workload", str(wl),
+                         "--fleet", str(fleet)]) == 0
+
+    def test_workload_from_replay_report(self):
+        from semantic_router_tpu.fleetsim import (
+            workload_from_replay_report,
+        )
+
+        report = {"signals_per_s": 100.0,
+                  "decisions": {"small_route": 75, "big_route": 25}}
+        wl = workload_from_replay_report(
+            report, {"small-model": 8.0, "big-model": 70.0},
+            decision_models={"small_route": "small-model",
+                             "big_route": "big-model"},
+            requests_per_s=100.0)
+        by_model = {l.model: l.requests_per_s for l in wl}
+        # replay mix maps through the decision→model table exactly
+        assert abs(by_model["small-model"] - 75.0) < 1e-6
+        assert abs(by_model["big-model"] - 25.0) < 1e-6
+        # unmapped decisions spread uniformly, totals preserved
+        wl2 = workload_from_replay_report(
+            report, {"small-model": 8.0, "big-model": 70.0},
+            decision_models={"small_route": "small-model"},
+            requests_per_s=100.0)
+        assert abs(sum(l.requests_per_s for l in wl2) - 100.0) < 1e-6
+        assert {l.requests_per_s for l in wl2} == {87.5, 12.5}
+
+
+class TestLoadBench:
+    def test_short_soak_no_errors(self, monkeypatch, capsys):
+        from benchmarks import load_bench
+
+        monkeypatch.setattr(sys, "argv", [
+            "load_bench.py", "--clients", "8", "--seconds", "3"])
+        rc = load_bench.main()
+        report = json.loads(capsys.readouterr().out)
+        assert rc == 0, report
+        assert report["errors"] == 0
+        assert report["requests"] > 50  # sustained concurrency
+        assert report["latency_ms"]["p99"] >= report["latency_ms"]["p50"]
