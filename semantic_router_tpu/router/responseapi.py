@@ -202,14 +202,93 @@ def responses_to_chat(body: Dict[str, Any],
     return out
 
 
+def chat_sse_to_response_events(chunks, request_body: Dict[str, Any],
+                                chat_request: Optional[Dict[str, Any]]
+                                = None,
+                                store: Optional[ResponseStore] = None):
+    """OpenAI chat-completions SSE chunks → Responses API streaming
+    events (the reference's missing responseapi streaming surface).
+
+    Yields ``(event_name, payload)`` in the public event order:
+    response.created → response.output_item.added →
+    response.content_part.added → response.output_text.delta* →
+    response.output_text.done → response.content_part.done →
+    response.output_item.done → response.completed.  The final completed
+    payload is a full response object and the conversation persists via
+    ``store`` exactly like the non-streaming path.
+    """
+    response_id = f"resp_{uuid.uuid4().hex[:24]}"
+    item_id = f"msg_{uuid.uuid4().hex[:16]}"
+    base = {"id": response_id, "object": "response",
+            "created_at": int(time.time()),
+            "model": request_body.get("model", ""),
+            "status": "in_progress", "output": [],
+            "previous_response_id":
+                request_body.get("previous_response_id"),
+            "metadata": request_body.get("metadata") or {}}
+    yield "response.created", {"type": "response.created",
+                               "response": dict(base)}
+    yield "response.output_item.added", {
+        "type": "response.output_item.added", "output_index": 0,
+        "item": {"type": "message", "id": item_id, "role": "assistant",
+                 "status": "in_progress", "content": []}}
+    yield "response.content_part.added", {
+        "type": "response.content_part.added", "item_id": item_id,
+        "output_index": 0, "content_index": 0,
+        "part": {"type": "output_text", "text": "", "annotations": []}}
+
+    text_parts: List[str] = []
+    usage: Dict[str, Any] = {}
+    model = base["model"]
+    for chunk in chunks:
+        model = chunk.get("model", model)
+        if chunk.get("usage"):
+            usage = chunk["usage"]
+        for choice in chunk.get("choices", ()):
+            delta = (choice.get("delta") or {}).get("content")
+            if delta:
+                text_parts.append(delta)
+                yield "response.output_text.delta", {
+                    "type": "response.output_text.delta",
+                    "item_id": item_id, "output_index": 0,
+                    "content_index": 0, "delta": delta}
+
+    text = "".join(text_parts)
+    yield "response.output_text.done", {
+        "type": "response.output_text.done", "item_id": item_id,
+        "output_index": 0, "content_index": 0, "text": text}
+    yield "response.content_part.done", {
+        "type": "response.content_part.done", "item_id": item_id,
+        "output_index": 0, "content_index": 0,
+        "part": {"type": "output_text", "text": text, "annotations": []}}
+    yield "response.output_item.done", {
+        "type": "response.output_item.done", "output_index": 0,
+        "item": {"type": "message", "id": item_id, "role": "assistant",
+                 "status": "completed",
+                 "content": [{"type": "output_text", "text": text,
+                              "annotations": []}]}}
+    final_chat = {"choices": [{"message": {"role": "assistant",
+                                           "content": text},
+                               "finish_reason": "stop"}],
+                  "model": model, "usage": usage}
+    final = chat_to_response(final_chat, request_body,
+                             chat_request=chat_request, store=store,
+                             response_id=response_id)
+    yield "response.completed", {"type": "response.completed",
+                                 "response": final}
+
+
 def chat_to_response(chat_resp: Dict[str, Any], request_body: Dict[str, Any],
                      chat_request: Optional[Dict[str, Any]] = None,
-                     store: Optional[ResponseStore] = None) -> Dict[str, Any]:
+                     store: Optional[ResponseStore] = None,
+                     response_id: str = "") -> Dict[str, Any]:
     """ChatCompletions response → Responses API response object; persists
-    the conversation when store=True on the request (the API default)."""
+    the conversation when store=True on the request (the API default).
+    ``response_id`` lets the streaming path store under the id its events
+    already announced (a mismatch would break previous_response_id)."""
     choice = (chat_resp.get("choices") or [{}])[0]
     msg = choice.get("message") or {}
-    response_id = f"resp_{uuid.uuid4().hex[:24]}"
+    response_id = response_id or f"resp_{uuid.uuid4().hex[:24]}"
     output: List[dict] = []
     if msg.get("content"):
         output.append({

@@ -29,6 +29,7 @@ import threading
 import time
 import urllib.request
 import urllib.error
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
@@ -462,10 +463,13 @@ class RouterServer:
                     if store is None:
                         self._json(404, {"error": "vector store not found"})
                     elif path.endswith("/files"):
-                        self._json(200, {"data": [
-                            {"id": d.id, "name": d.name,
-                             "chunks": len(d.chunk_ids)}
-                            for d in store.documents.values()]})
+                        if hasattr(store, "documents"):
+                            docs = [{"id": d.id, "name": d.name,
+                                     "chunks": len(d.chunk_ids)}
+                                    for d in store.documents.values()]
+                        else:  # server-side stores (qdrant) aggregate
+                            docs = store.list_documents()
+                        self._json(200, {"data": docs})
                     else:
                         self._json(200, {"id": name, **store.stats()})
                 else:
@@ -1053,6 +1057,13 @@ class RouterServer:
                         payload = chat_to_response(
                             payload, body, chat_request=route.body,
                             store=server.response_store)
+                        if body.get("stream"):
+                            # stream=true cache hits answer as a one-shot
+                            # event sequence, never a bare JSON body an
+                            # SSE parser would choke on
+                            self._oneshot_response_sse(payload,
+                                                       route.headers)
+                            return
                     self._json(route.status, payload, route.headers)
                     return
                 # looper decisions execute multi-model strategies here too
@@ -1080,6 +1091,9 @@ class RouterServer:
                                                "type": "authz_error"}},
                                route.headers)
                     return
+                if body.get("stream"):
+                    self._stream_responses(route, backend, fwd, body)
+                    return
                 t0 = time.perf_counter()
                 status, resp = server._forward(backend, route.body, fwd)
                 latency_ms = (time.perf_counter() - t0) * 1e3
@@ -1097,6 +1111,152 @@ class RouterServer:
                     server.router.record_feedback(route, success=False,
                                                   latency_ms=latency_ms)
                     self._json(status, resp, route.headers)
+
+            def _oneshot_response_sse(self, response_obj: Dict[str, Any],
+                                      headers: Dict[str, str]) -> None:
+                """Emit a finished response object as the minimal valid
+                event sequence (created → delta → completed)."""
+                self.send_response(200)
+                self.send_header("content-type", "text/event-stream")
+                for k, v in headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                text = response_obj.get("output_text", "")
+                item_id = f"msg_{uuid.uuid4().hex[:16]}"
+                events = [
+                    ("response.created",
+                     {"type": "response.created",
+                      "response": {**response_obj,
+                                   "status": "in_progress",
+                                   "output": []}}),
+                    ("response.output_text.delta",
+                     {"type": "response.output_text.delta",
+                      "item_id": item_id, "output_index": 0,
+                      "content_index": 0, "delta": text}),
+                    ("response.completed",
+                     {"type": "response.completed",
+                      "response": response_obj}),
+                ]
+                try:
+                    for event, payload in events:
+                        self.wfile.write(
+                            f"event: {event}\ndata: "
+                            f"{json.dumps(payload)}\n\n".encode())
+                except Exception:
+                    pass
+
+            def _stream_responses(self, route, backend: str,
+                                  fwd_headers: Dict[str, str],
+                                  request_body: Dict[str, Any]) -> None:
+                """Responses API streaming: the backend's chat SSE chunks
+                translate to the public response.* event sequence
+                (responseapi streaming surface)."""
+                import urllib.request as _ur
+
+                from .responseapi import chat_sse_to_response_events
+
+                upstream_body = dict(route.body)
+                upstream_body["stream"] = True
+                req = _ur.Request(backend + "/v1/chat/completions",
+                                  data=json.dumps(upstream_body).encode(),
+                                  method="POST")
+                req.add_header("content-type", "application/json")
+                for k, v in fwd_headers.items():
+                    if k.lower() not in ("content-length", "host"):
+                        req.add_header(k, v)
+                t0 = time.perf_counter()
+                try:
+                    upstream = _ur.urlopen(req,
+                                           timeout=server.forward_timeout_s)
+                except urllib.error.HTTPError as e:
+                    # relay the backend's REAL status/payload (parity with
+                    # _forward/_stream_chat — a 401 must not become 502)
+                    try:
+                        payload = json.loads(e.read() or b"{}")
+                    except json.JSONDecodeError:
+                        payload = {"error": {"message": str(e)}}
+                    server.router.record_feedback(
+                        route, success=False,
+                        latency_ms=(time.perf_counter() - t0) * 1e3)
+                    self._json(e.code, payload, route.headers)
+                    return
+                except Exception as exc:
+                    server.router.record_feedback(
+                        route, success=False,
+                        latency_ms=(time.perf_counter() - t0) * 1e3)
+                    self._json(502, {"error": {
+                        "message": f"backend unreachable: {exc}",
+                        "type": "backend_error"}}, route.headers)
+                    return
+
+                self.send_response(200)
+                self.send_header("content-type", "text/event-stream")
+                for k, v in route.headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+
+                finished = False
+
+                def iter_chunks():
+                    nonlocal finished
+                    while True:
+                        line = upstream.readline()
+                        if not line:
+                            break
+                        if not line.startswith(b"data:"):
+                            continue
+                        payload = line[5:].strip()
+                        if payload == b"[DONE]":
+                            finished = True
+                            break
+                        try:
+                            chunk = json.loads(payload)
+                        except json.JSONDecodeError:
+                            continue
+                        if any((c.get("finish_reason") or "")
+                               for c in chunk.get("choices", ())):
+                            finished = True
+                        yield chunk
+
+                completed = False
+                try:
+                    for event, payload in chat_sse_to_response_events(
+                            iter_chunks(), request_body,
+                            chat_request=route.body,
+                            store=server.response_store):
+                        if event == "response.output_text.done" \
+                                and not finished:
+                            # upstream died mid-generation: never emit
+                            # done/completed for partial text, never let
+                            # the generator persist the partial turn
+                            break
+                        self.wfile.write(
+                            f"event: {event}\ndata: "
+                            f"{json.dumps(payload)}\n\n".encode())
+                        if event == "response.completed":
+                            completed = True
+                            final = payload["response"]
+                            usage = final.get("usage") or {}
+                            server.router.process_response(route, {
+                                "choices": [{"message": {
+                                    "role": "assistant",
+                                    "content": final.get("output_text",
+                                                         "")},
+                                    "finish_reason": "stop"}],
+                                "usage": {
+                                    "prompt_tokens":
+                                        usage.get("input_tokens", 0),
+                                    "completion_tokens":
+                                        usage.get("output_tokens", 0),
+                                    "total_tokens":
+                                        usage.get("total_tokens", 0)}})
+                except Exception:
+                    pass  # client disconnect mid-stream: stop writing
+                finally:
+                    upstream.close()
+                server.router.record_feedback(
+                    route, success=completed,
+                    latency_ms=(time.perf_counter() - t0) * 1e3)
 
             def _stream_chat(self, route, backend: str,
                              fwd_headers: Dict[str, str],
@@ -1286,6 +1446,9 @@ class RouterServer:
                     payload = chat_to_response(
                         payload, responses_request, chat_request=route.body,
                         store=server.response_store)
+                    if responses_request.get("stream"):
+                        self._oneshot_response_sse(payload, out_headers)
+                        return
                 self._json(200, payload, out_headers)
 
             def _classify(self, task: str, body: Dict[str, Any]) -> None:

@@ -232,7 +232,8 @@ def build_router(cfg: RouterConfig, engine=None,
     vs_cfg = cfg.vectorstore or {}
     router.vectorstores = VectorStoreManager(
         embed_fn, backend=vs_cfg.get("backend", "memory"),
-        base_path=vs_cfg.get("path"))
+        base_path=vs_cfg.get("path"),
+        backend_config=vs_cfg.get("backend_config"))
 
     replay_cfg = cfg.router_replay or {}
     if replay_cfg.get("enabled", True):

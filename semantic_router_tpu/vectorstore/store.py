@@ -168,12 +168,24 @@ class VectorStoreManager:
 
     def __init__(self, embed_fn: Optional[Callable] = None,
                  backend: str = "memory",
-                 base_path: Optional[str] = None) -> None:
+                 base_path: Optional[str] = None,
+                 backend_config: Optional[Dict] = None) -> None:
         self.embed_fn = embed_fn
         self.backend = backend
         self.base_path = base_path
+        self.backend_config = dict(backend_config or {})
         self._stores: Dict[str, InMemoryVectorStore] = {}
         self._lock = threading.Lock()
+        self._qdrant = None
+
+    def _qdrant_client(self):
+        if self._qdrant is None:
+            from ..state.qdrant import QdrantClient
+
+            self._qdrant = QdrantClient(
+                self.backend_config.get("url", "http://127.0.0.1:6333"),
+                api_key=self.backend_config.get("api_key", ""))
+        return self._qdrant
 
     def _new_store(self, name: str, **kwargs) -> InMemoryVectorStore:
         if self.backend == "sqlite":
@@ -185,6 +197,13 @@ class VectorStoreManager:
             os.makedirs(base, exist_ok=True)
             return SQLiteVectorStore(
                 os.path.join(base, f"{name}.vectorstore.db"),
+                embed_fn=self.embed_fn, **kwargs)
+        if self.backend == "qdrant":
+            from ..state.qdrant import QdrantVectorStore
+
+            prefix = self.backend_config.get("collection_prefix", "vsr-")
+            return QdrantVectorStore(
+                self._qdrant_client(), f"{prefix}{name}",
                 embed_fn=self.embed_fn, **kwargs)
         return InMemoryVectorStore(self.embed_fn, **kwargs)
 
@@ -214,7 +233,20 @@ class VectorStoreManager:
                     and os.path.exists(self._db_path(name)):
                 store = self._new_store(name)  # re-attach persisted store
                 self._stores[name] = store
-            return store
+            if store is not None or self.backend != "qdrant":
+                return store
+        # qdrant probe is a network round-trip: NEVER hold the manager
+        # lock across it (a slow server would stall every store op)
+        prefix = self.backend_config.get("collection_prefix", "vsr-")
+        try:
+            if not self._qdrant_client().collection_exists(
+                    f"{prefix}{name}"):
+                return None
+            store = self._new_store(name)
+        except Exception:
+            return None  # unreachable server: behave as absent
+        with self._lock:  # publish (first attacher wins)
+            return self._stores.setdefault(name, store)
 
     def get_or_create(self, name: str) -> InMemoryVectorStore:
         existing = self.get(name)
@@ -242,6 +274,17 @@ class VectorStoreManager:
                 # re-attached this process — otherwise it resurrects
                 os.remove(self._db_path(name))
                 return True
+            if self.backend == "qdrant":
+                prefix = self.backend_config.get("collection_prefix",
+                                                 "vsr-")
+                try:
+                    if self._qdrant_client().collection_exists(
+                            f"{prefix}{name}"):
+                        self._qdrant_client().delete_collection(
+                            f"{prefix}{name}")
+                        return True
+                except Exception:
+                    pass
             return store is not None
 
 

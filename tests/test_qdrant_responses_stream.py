@@ -1,0 +1,197 @@
+"""Qdrant wire client + vector-store backend + Responses API streaming
+events (reference: pkg/vectorstore qdrant backend, responseapi streaming)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from semantic_router_tpu.state.qdrant import (
+    MiniQdrant,
+    QdrantClient,
+    QdrantError,
+    QdrantVectorStore,
+    match_filter,
+)
+
+
+def embed(text):
+    rng = np.random.default_rng(abs(hash(text)) % 2**31)
+    v = rng.normal(size=32).astype(np.float32)
+    return v / np.linalg.norm(v)
+
+
+@pytest.fixture(scope="module")
+def mini():
+    server = MiniQdrant()
+    yield server
+    server.stop()
+
+
+@pytest.fixture()
+def client(mini):
+    return QdrantClient(mini.url)
+
+
+class TestQdrantClient:
+    def test_collection_lifecycle(self, client):
+        assert not client.collection_exists("c1")
+        client.create_collection("c1", 32)
+        assert client.collection_exists("c1")
+        client.delete_collection("c1")
+        assert not client.collection_exists("c1")
+
+    def test_upsert_search_filter_delete(self, client):
+        client.create_collection("c2", 32)
+        v1, v2 = embed("cats purr"), embed("dogs bark")
+        client.upsert("c2", [
+            {"id": "11111111111111111111111111111111",
+             "vector": v1.tolist(), "payload": {"doc": "a", "t": "cats"}},
+            {"id": "22222222222222222222222222222222",
+             "vector": v2.tolist(), "payload": {"doc": "b", "t": "dogs"}},
+        ])
+        hits = client.search("c2", embed("cats purr"), limit=1)
+        assert hits[0]["payload"]["t"] == "cats"
+        assert hits[0]["score"] > 0.99
+        # filtered search only sees doc b
+        hits = client.search("c2", embed("cats purr"), limit=5,
+                             query_filter=match_filter("doc", "b"))
+        assert [h["payload"]["t"] for h in hits] == ["dogs"]
+        client.delete_points("c2",
+                             query_filter=match_filter("doc", "a"))
+        assert len(client.scroll("c2")) == 1
+
+    def test_error_surface(self, client):
+        with pytest.raises(QdrantError):
+            client.search("missing-collection", [0.0] * 32)
+
+
+class TestQdrantVectorStore:
+    def test_ingest_search_cross_instance(self, mini):
+        c = QdrantClient(mini.url)
+        s1 = QdrantVectorStore(c, "kb-x", embed)
+        doc = s1.ingest("guide", "Llamas hum at dusk. Grapes grow on "
+                                 "vines. Rivers carve canyons.",
+                        metadata={"lang": "en"})
+        assert s1.stats()["documents"] == 1
+        # a second instance (another replica) sees the same state
+        s2 = QdrantVectorStore(QdrantClient(mini.url), "kb-x", embed)
+        hits = s2.search("Llamas hum at dusk.", top_k=2)
+        assert hits and "hum" in hits[0].chunk.text
+        assert hits[0].chunk.metadata["lang"] == "en"
+        assert s2.delete_document(doc.id)
+        assert s2.stats()["chunks"] == 0
+
+    def test_manager_qdrant_backend_reattach(self, mini):
+        from semantic_router_tpu.vectorstore import VectorStoreManager
+
+        m1 = VectorStoreManager(embed, backend="qdrant",
+                                backend_config={"url": mini.url})
+        m1.get_or_create("shared").ingest("d", "Penguins huddle "
+                                               "for warmth.")
+        m2 = VectorStoreManager(embed, backend="qdrant",
+                                backend_config={"url": mini.url})
+        store = m2.get("shared")
+        assert store is not None
+        assert store.search("Penguins huddle for warmth.", top_k=1)
+        assert m2.delete("shared")
+        m3 = VectorStoreManager(embed, backend="qdrant",
+                                backend_config={"url": mini.url})
+        assert m3.get("shared") is None
+
+
+class TestResponsesStreaming:
+    CHUNKS = [
+        {"model": "m1", "choices": [{"delta": {"role": "assistant"}}]},
+        {"choices": [{"delta": {"content": "Hello"}}]},
+        {"choices": [{"delta": {"content": " world"}}]},
+        {"choices": [{"delta": {}, "finish_reason": "stop"}],
+         "usage": {"prompt_tokens": 3, "completion_tokens": 2,
+                   "total_tokens": 5}},
+    ]
+
+    def test_event_sequence_and_final_object(self):
+        from semantic_router_tpu.router.responseapi import (
+            ResponseStore,
+            chat_sse_to_response_events,
+        )
+
+        store = ResponseStore()
+        req = {"model": "auto", "input": "hi", "stream": True}
+        events = list(chat_sse_to_response_events(
+            iter(self.CHUNKS), req,
+            chat_request={"messages": [{"role": "user", "content": "hi"}]},
+            store=store))
+        names = [e for e, _ in events]
+        assert names[0] == "response.created"
+        assert names[-1] == "response.completed"
+        deltas = [p["delta"] for e, p in events
+                  if e == "response.output_text.delta"]
+        assert deltas == ["Hello", " world"]
+        done = next(p for e, p in events
+                    if e == "response.output_text.done")
+        assert done["text"] == "Hello world"
+        final = events[-1][1]["response"]
+        assert final["output_text"] == "Hello world"
+        assert final["usage"]["total_tokens"] == 5
+        # the stored thread uses the SAME id the events announced
+        created_id = events[0][1]["response"]["id"]
+        assert final["id"] == created_id
+        stored = store.get(created_id)
+        assert stored is not None
+        assert stored.messages[-1]["content"] == "Hello world"
+
+    def test_streaming_through_live_server(self, fixture_config_path):
+        from semantic_router_tpu.config import load_config
+        from semantic_router_tpu.router import (
+            MockVLLMServer,
+            Router,
+            RouterServer,
+        )
+
+        backend = MockVLLMServer().start()
+        cfg = load_config(fixture_config_path)
+        router = Router(cfg, engine=None)
+        server = RouterServer(router, cfg,
+                              default_backend=backend.url).start()
+        try:
+            req = urllib.request.Request(
+                server.url + "/v1/responses",
+                data=json.dumps({"model": "auto",
+                                 "input": "this is urgent, asap!",
+                                 "stream": True}).encode(),
+                method="POST")
+            req.add_header("content-type", "application/json")
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                assert resp.headers["content-type"].startswith(
+                    "text/event-stream")
+                assert resp.headers["x-vsr-selected-decision"] == \
+                    "urgent_route"
+                body = resp.read().decode()
+            events = [l.split(" ", 1)[1] for l in body.splitlines()
+                      if l.startswith("event: ")]
+            assert events[0] == "response.created"
+            assert "response.output_text.delta" in events
+            assert events[-1] == "response.completed"
+            completed = json.loads(
+                [l for l in body.splitlines()
+                 if l.startswith("data: ")][-1][6:])
+            assert completed["response"]["status"] == "completed"
+            # follow-up threads via the streamed response id
+            follow = json.loads(json.dumps({
+                "model": "auto", "input": "and more",
+                "previous_response_id":
+                    completed["response"]["id"]}))
+            req2 = urllib.request.Request(
+                server.url + "/v1/responses",
+                data=json.dumps(follow).encode(), method="POST")
+            req2.add_header("content-type", "application/json")
+            with urllib.request.urlopen(req2, timeout=60) as resp2:
+                out2 = json.loads(resp2.read())
+            echoed = json.loads(out2["output_text"])
+            assert echoed["n_messages"] >= 3  # prior turns threaded
+        finally:
+            server.stop()
+            router.shutdown()
+            backend.stop()
