@@ -86,23 +86,30 @@ class _StreamState:
     """Per-Process-stream request context (reference RequestContext,
     processor_core.go:86)."""
 
-    __slots__ = ("headers", "body_chunks", "route", "response_status",
-                 "is_sse", "response_chunks", "t_start", "inflight_token")
+    __slots__ = ("headers", "body_chunks", "body_bytes", "route",
+                 "response_status", "is_sse", "response_chunks",
+                 "t_start", "inflight_token", "passthrough")
 
     def __init__(self) -> None:
         self.headers: Dict[str, str] = {}
         self.body_chunks: list[bytes] = []
+        self.body_bytes = 0
         self.route: Optional[RouteResult] = None
         self.response_status = 200
         self.is_sse = False
         self.response_chunks: list[bytes] = []
         self.t_start = 0.0
         self.inflight_token: Optional[int] = None
+        self.passthrough = False  # skip-processing: no accumulation
 
 
 class ExtProcService:
     """The stream handler. One instance serves all streams; per-stream
     state lives in _StreamState."""
+
+    # bound on accumulated request bodies (Envoy's default per-connection
+    # buffer is 50 MiB — an unbounded accumulator would be a memory DoS)
+    MAX_BODY_BYTES = 50 * 1024 * 1024
 
     def __init__(self, router: Router,
                  looper_execute=None) -> None:
@@ -154,11 +161,31 @@ class ExtProcService:
                             state: _StreamState) -> pb.ProcessingResponse:
         state.headers = _headers_to_dict(msg.headers)
         state.t_start = time.perf_counter()
+        # skip-processing decided at HEADER time: opted-out requests pass
+        # every body chunk through with ZERO accumulation (the dispatch
+        # guarantee at processor_core.go:31 — no buffering, no model
+        # detection, no pipeline for skipped streams)
+        try:
+            state.passthrough = self.router.skip_requested(state.headers)
+        except Exception:
+            state.passthrough = False
         return _continue_headers()
 
     def _on_request_body(self, msg: pb.HttpBody, state: _StreamState
                          ) -> Optional[pb.ProcessingResponse]:
+        if state.passthrough:
+            return pb.ProcessingResponse(request_body=pb.BodyResponse(
+                response=pb.CommonResponse(
+                    status=pb.CommonResponse.CONTINUE)))
         state.body_chunks.append(bytes(msg.body))
+        state.body_bytes += len(msg.body)
+        if state.body_bytes > self.MAX_BODY_BYTES:
+            state.body_chunks = []
+            state.body_bytes = 0
+            return _immediate(413, {"error": {
+                "message": "request body exceeds the router's "
+                           f"{self.MAX_BODY_BYTES} byte buffer limit",
+                "type": "payload_too_large"}}, {})
         if not msg.end_of_stream:
             # STREAMED chunk (empty mid-stream frames are protocol-legal):
             # acknowledge and keep accumulating until end_of_stream
@@ -167,6 +194,7 @@ class ExtProcService:
                     status=pb.CommonResponse.CONTINUE)))
         raw = b"".join(state.body_chunks)
         state.body_chunks = []
+        state.body_bytes = 0
         try:
             body = json.loads(raw or b"{}")
         except json.JSONDecodeError:
@@ -239,11 +267,21 @@ class ExtProcService:
 
     def _on_response_body(self, msg: pb.HttpBody, state: _StreamState
                           ) -> Optional[pb.ProcessingResponse]:
-        state.response_chunks.append(bytes(msg.body))
         cont = pb.ProcessingResponse(response_body=pb.BodyResponse(
             response=pb.CommonResponse(status=pb.CommonResponse.CONTINUE)))
+        if state.passthrough:
+            return cont  # skipped stream: zero accumulation both ways
+        if state.response_chunks is not None:
+            state.response_chunks.append(bytes(msg.body))
+            if sum(len(c) for c in state.response_chunks) \
+                    > self.MAX_BODY_BYTES:
+                # response is already streaming to the client — can't
+                # 413; stop buffering and skip end-of-stream bookkeeping
+                state.response_chunks = None
         if not msg.end_of_stream:
             return cont  # streamed chunk passes through untouched
+        if state.response_chunks is None:
+            return cont  # over-budget stream: pass, no cache/feedback
         raw = b"".join(state.response_chunks)
         state.response_chunks = []
         route = state.route

@@ -167,6 +167,15 @@ class Router:
         self.vectorstores = None  # vectorstore.VectorStoreManager
         self.memory_store = None  # memory.InMemoryMemoryStore
 
+    def skip_requested(self, headers: Dict[str, str]) -> bool:
+        """True when the (operator-enabled) skip-processing header is on
+        this request — streamed frontends use it to pass chunks through
+        without buffering (handleRequestBodyDispatch,
+        processor_core.go:31)."""
+        headers = {k.lower(): v for k, v in (headers or {}).items()}
+        return self._skip_enabled and headers.get(
+            H.SKIP_PROCESSING, "").lower() in ("1", "true")
+
     # ------------------------------------------------------------------
     # request path
     # ------------------------------------------------------------------
@@ -194,8 +203,7 @@ class Router:
         # x-vsr-skip-processing is honored ONLY when the operator enabled it
         # (SkipProcessingConfig.Enabled, pkg/config/config.go:186 — default
         # disabled; an unauthenticated client must not get passthrough)
-        if self._skip_enabled \
-                and headers.get(H.SKIP_PROCESSING, "").lower() in ("1", "true"):
+        if self.skip_requested(headers):
             return RouteResult(kind="passthrough", body=body,
                                request_id=request_id)
 

@@ -1123,16 +1123,48 @@ class RouterServer:
                 self.end_headers()
                 text = response_obj.get("output_text", "")
                 item_id = f"msg_{uuid.uuid4().hex[:16]}"
+                # the FULL event sequence: SDK stream accumulators key
+                # deltas on the item announced by output_item.added, so a
+                # bare created→delta→completed would drop the text
+                part = {"type": "output_text", "text": text,
+                        "annotations": []}
                 events = [
                     ("response.created",
                      {"type": "response.created",
                       "response": {**response_obj,
                                    "status": "in_progress",
                                    "output": []}}),
+                    ("response.output_item.added",
+                     {"type": "response.output_item.added",
+                      "output_index": 0,
+                      "item": {"type": "message", "id": item_id,
+                               "role": "assistant",
+                               "status": "in_progress", "content": []}}),
+                    ("response.content_part.added",
+                     {"type": "response.content_part.added",
+                      "item_id": item_id, "output_index": 0,
+                      "content_index": 0,
+                      "part": {"type": "output_text", "text": "",
+                               "annotations": []}}),
                     ("response.output_text.delta",
                      {"type": "response.output_text.delta",
                       "item_id": item_id, "output_index": 0,
                       "content_index": 0, "delta": text}),
+                    ("response.output_text.done",
+                     {"type": "response.output_text.done",
+                      "item_id": item_id, "output_index": 0,
+                      "content_index": 0, "text": text}),
+                    ("response.content_part.done",
+                     {"type": "response.content_part.done",
+                      "item_id": item_id, "output_index": 0,
+                      "content_index": 0, "part": part}),
+                    ("response.output_item.done",
+                     {"type": "response.output_item.done",
+                      "output_index": 0,
+                      "item": {"type": "message", "id": item_id,
+                               "role": "assistant",
+                               "status": "completed",
+                               "content": [part]}}),
                     ("response.completed",
                      {"type": "response.completed",
                       "response": response_obj}),
@@ -1157,6 +1189,11 @@ class RouterServer:
 
                 upstream_body = dict(route.body)
                 upstream_body["stream"] = True
+                # without include_usage OpenAI-compatible backends omit
+                # the usage chunk and cost metrics would record 0 tokens
+                upstream_body.setdefault("stream_options", {})
+                upstream_body["stream_options"].setdefault(
+                    "include_usage", True)
                 req = _ur.Request(backend + "/v1/chat/completions",
                                   data=json.dumps(upstream_body).encode(),
                                   method="POST")

@@ -110,14 +110,27 @@ class QdrantClient:
                       body)
 
     def scroll(self, collection: str, limit: int = 100,
-               query_filter: Optional[Dict] = None) -> List[Dict]:
-        body: Dict[str, Any] = {"limit": limit, "with_payload": True}
-        if query_filter:
-            body["filter"] = query_filter
-        out = self._request("POST",
-                            f"/collections/{collection}/points/scroll",
-                            body)
-        return out.get("result", {}).get("points", [])
+               query_filter: Optional[Dict] = None,
+               max_total: int = 100_000) -> List[Dict]:
+        """Follows next_page_offset so listings never silently truncate
+        at one page (bounded by max_total as a runaway guard)."""
+        points: List[Dict] = []
+        offset = None
+        while len(points) < max_total:
+            body: Dict[str, Any] = {"limit": limit, "with_payload": True}
+            if query_filter:
+                body["filter"] = query_filter
+            if offset is not None:
+                body["offset"] = offset
+            out = self._request(
+                "POST", f"/collections/{collection}/points/scroll", body)
+            result = out.get("result", {}) or {}
+            page = result.get("points", [])
+            points.extend(page)
+            offset = result.get("next_page_offset")
+            if offset is None or not page:
+                break
+        return points
 
 
 def match_filter(field: str, value) -> Dict:

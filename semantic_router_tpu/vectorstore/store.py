@@ -187,6 +187,15 @@ class VectorStoreManager:
                 api_key=self.backend_config.get("api_key", ""))
         return self._qdrant
 
+    def _milvus_client(self):
+        if getattr(self, "_milvus", None) is None:
+            from ..state.milvus import MilvusClient
+
+            self._milvus = MilvusClient(
+                self.backend_config.get("url", "http://127.0.0.1:19530"),
+                token=self.backend_config.get("token", ""))
+        return self._milvus
+
     def _new_store(self, name: str, **kwargs) -> InMemoryVectorStore:
         if self.backend == "sqlite":
             import os
@@ -204,6 +213,13 @@ class VectorStoreManager:
             prefix = self.backend_config.get("collection_prefix", "vsr-")
             return QdrantVectorStore(
                 self._qdrant_client(), f"{prefix}{name}",
+                embed_fn=self.embed_fn, **kwargs)
+        if self.backend == "milvus":
+            from ..state.milvus import MilvusVectorStore
+
+            prefix = self.backend_config.get("collection_prefix", "vsr_")
+            return MilvusVectorStore(
+                self._milvus_client(), f"{prefix}{name}",
                 embed_fn=self.embed_fn, **kwargs)
         return InMemoryVectorStore(self.embed_fn, **kwargs)
 
@@ -233,14 +249,23 @@ class VectorStoreManager:
                     and os.path.exists(self._db_path(name)):
                 store = self._new_store(name)  # re-attach persisted store
                 self._stores[name] = store
-            if store is not None or self.backend != "qdrant":
+            if store is not None or self.backend not in ("qdrant",
+                                                         "milvus"):
                 return store
-        # qdrant probe is a network round-trip: NEVER hold the manager
-        # lock across it (a slow server would stall every store op)
-        prefix = self.backend_config.get("collection_prefix", "vsr-")
+        # remote probes are network round-trips: NEVER hold the manager
+        # lock across them (a slow server would stall every store op)
         try:
-            if not self._qdrant_client().collection_exists(
-                    f"{prefix}{name}"):
+            if self.backend == "qdrant":
+                prefix = self.backend_config.get("collection_prefix",
+                                                 "vsr-")
+                exists = self._qdrant_client().collection_exists(
+                    f"{prefix}{name}")
+            else:
+                prefix = self.backend_config.get("collection_prefix",
+                                                 "vsr_")
+                exists = self._milvus_client().has_collection(
+                    f"{prefix}{name}")
+            if not exists:
                 return None
             store = self._new_store(name)
         except Exception:
@@ -252,10 +277,11 @@ class VectorStoreManager:
         existing = self.get(name)
         if existing is not None:
             return existing
+        # remote-backend construction does network I/O — build OUTSIDE
+        # the lock (same invariant get() documents), publish under it
+        store = self._new_store(name)
         with self._lock:
-            if name not in self._stores:
-                self._stores[name] = self._new_store(name)
-            return self._stores[name]
+            return self._stores.setdefault(name, store)
 
     def list(self) -> List[str]:
         with self._lock:
@@ -266,26 +292,36 @@ class VectorStoreManager:
 
         with self._lock:
             store = self._stores.pop(name, None)
-            if store is not None and hasattr(store, "close"):
-                store.close()
-            if self.backend == "sqlite" \
-                    and os.path.exists(self._db_path(name)):
-                # remove the persisted file even when the store was never
-                # re-attached this process — otherwise it resurrects
-                os.remove(self._db_path(name))
-                return True
-            if self.backend == "qdrant":
-                prefix = self.backend_config.get("collection_prefix",
-                                                 "vsr-")
-                try:
-                    if self._qdrant_client().collection_exists(
-                            f"{prefix}{name}"):
-                        self._qdrant_client().delete_collection(
-                            f"{prefix}{name}")
-                        return True
-                except Exception:
-                    pass
-            return store is not None
+        if store is not None and hasattr(store, "close"):
+            store.close()
+        # durable cleanup runs OUTSIDE the lock (file IO / network)
+        if self.backend == "sqlite" \
+                and os.path.exists(self._db_path(name)):
+            # remove the persisted file even when the store was never
+            # re-attached this process — otherwise it resurrects
+            os.remove(self._db_path(name))
+            return True
+        if self.backend == "qdrant":
+            prefix = self.backend_config.get("collection_prefix", "vsr-")
+            try:
+                if self._qdrant_client().collection_exists(
+                        f"{prefix}{name}"):
+                    self._qdrant_client().delete_collection(
+                        f"{prefix}{name}")
+                    return True
+            except Exception:
+                pass
+        elif self.backend == "milvus":
+            prefix = self.backend_config.get("collection_prefix", "vsr_")
+            try:
+                if self._milvus_client().has_collection(
+                        f"{prefix}{name}"):
+                    self._milvus_client().drop_collection(
+                        f"{prefix}{name}")
+                    return True
+            except Exception:
+                pass
+        return store is not None
 
 
 def format_rag_context(hits: Sequence[SearchHit],

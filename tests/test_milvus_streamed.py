@@ -1,0 +1,184 @@
+"""Milvus wire client + backend, ExtProc STREAMED hardening
+(reference: pkg/vectorstore milvus backend,
+processor_req_body_streamed.go skip/bounds semantics)."""
+
+import json
+
+import grpc
+import numpy as np
+import pytest
+
+from semantic_router_tpu.state.milvus import (
+    MilvusClient,
+    MilvusError,
+    MilvusVectorStore,
+    MiniMilvus,
+)
+
+
+def embed(text):
+    rng = np.random.default_rng(abs(hash(text)) % 2**31)
+    v = rng.normal(size=32).astype(np.float32)
+    return v / np.linalg.norm(v)
+
+
+@pytest.fixture(scope="module")
+def mini():
+    server = MiniMilvus()
+    yield server
+    server.stop()
+
+
+@pytest.fixture()
+def client(mini):
+    return MilvusClient(mini.url)
+
+
+class TestMilvusClient:
+    def test_collection_lifecycle(self, client):
+        assert not client.has_collection("c1")
+        client.create_collection("c1", 32)
+        assert client.has_collection("c1")
+        client.drop_collection("c1")
+        assert not client.has_collection("c1")
+
+    def test_insert_search_filter_delete(self, client):
+        client.create_collection("c2", 32)
+        client.insert("c2", [
+            {"id": "a1", "vector": embed("cats purr").tolist(),
+             "doc": "a", "text": "cats purr"},
+            {"id": "b1", "vector": embed("dogs bark").tolist(),
+             "doc": "b", "text": "dogs bark"},
+        ])
+        hits = client.search("c2", embed("cats purr"), limit=1)
+        assert hits[0]["text"] == "cats purr"
+        assert hits[0]["distance"] > 0.99
+        hits = client.search("c2", embed("cats purr"), limit=5,
+                             flt='doc == "b"')
+        assert [h["text"] for h in hits] == ["dogs bark"]
+        client.delete("c2", 'doc == "a"')
+        assert len(client.query("c2")) == 1
+
+    def test_error_code_surface(self, client):
+        with pytest.raises(MilvusError):
+            client.insert("missing", [{"id": "x", "vector": [0.0] * 32}])
+
+
+class TestMilvusVectorStore:
+    def test_ingest_search_cross_instance(self, mini):
+        s1 = MilvusVectorStore(MilvusClient(mini.url), "kb_m", embed)
+        text = ("Otters hold hands while sleeping. "
+                "Moss grows on the north side.")
+        doc = s1.ingest("guide", text, metadata={"lang": "en"})
+        s2 = MilvusVectorStore(MilvusClient(mini.url), "kb_m", embed)
+        hits = s2.search(text, top_k=1)
+        assert hits and "Otters" in hits[0].chunk.text
+        assert hits[0].chunk.metadata["lang"] == "en"
+        assert s2.stats()["documents"] == 1
+        assert s2.list_documents()[0]["name"] == "guide"
+        assert s2.delete_document(doc.id)
+        assert s2.stats()["chunks"] == 0
+
+    def test_manager_milvus_backend_reattach(self, mini):
+        from semantic_router_tpu.vectorstore import VectorStoreManager
+
+        m1 = VectorStoreManager(embed, backend="milvus",
+                                backend_config={"url": mini.url})
+        m1.get_or_create("shared_m").ingest("d", "Bees dance to "
+                                                 "communicate.")
+        m2 = VectorStoreManager(embed, backend="milvus",
+                                backend_config={"url": mini.url})
+        store = m2.get("shared_m")
+        assert store is not None
+        assert store.search("Bees dance to communicate.", top_k=1)
+        assert m2.delete("shared_m")
+        assert VectorStoreManager(
+            embed, backend="milvus",
+            backend_config={"url": mini.url}).get("shared_m") is None
+
+
+class TestExtProcStreamedHardening:
+    def _call(self, router):
+        from semantic_router_tpu.extproc import ExtProcServer, SERVICE_NAME
+        from semantic_router_tpu.extproc import external_processor_pb2 as pb
+
+        server = ExtProcServer(router, port=0).start()
+        channel = grpc.insecure_channel(server.address)
+        call = channel.stream_stream(
+            f"/{SERVICE_NAME}/Process",
+            request_serializer=pb.ProcessingRequest.SerializeToString,
+            response_deserializer=pb.ProcessingResponse.FromString)
+        return server, channel, call, pb
+
+    def _headers_msg(self, pb, extra=None):
+        base = {":method": "POST", ":path": "/v1/chat/completions",
+                "content-type": "application/json"}
+        base.update(extra or {})
+        return pb.ProcessingRequest(request_headers=pb.HttpHeaders(
+            headers=pb.HeaderMap(headers=[
+                pb.HeaderValue(key=k, raw_value=v.encode())
+                for k, v in base.items()])))
+
+    def test_skip_processing_streams_pass_through_unbuffered(self):
+        from semantic_router_tpu.config import RouterConfig
+        from semantic_router_tpu.router import Router
+
+        cfg = RouterConfig.from_dict({
+            "default_model": "m1",
+            "skip_processing": {"enabled": True},
+            "routing": {"modelCards": [{"name": "m1"}],
+                        "decisions": []},
+        })
+        router = Router(cfg, engine=None)
+        server, channel, call, pb = self._call(router)
+        try:
+            msgs = [self._headers_msg(
+                pb, {"x-vsr-skip-processing": "true"})]
+            # many chunks, never an end_of_stream: a buffering handler
+            # would accumulate; passthrough must answer each immediately
+            for i in range(5):
+                msgs.append(pb.ProcessingRequest(
+                    request_body=pb.HttpBody(body=b"x" * 1000,
+                                             end_of_stream=False)))
+            resps = list(call(iter(msgs)))
+            assert len(resps) == 6
+            for r in resps[1:]:
+                common = r.request_body.response
+                assert common.status == pb.CommonResponse.CONTINUE
+                assert not common.HasField("body_mutation")
+        finally:
+            channel.close()
+            server.stop()
+            router.shutdown()
+
+    def test_oversized_body_answers_413(self):
+        from semantic_router_tpu.config import RouterConfig
+        from semantic_router_tpu.extproc.server import ExtProcService
+        from semantic_router_tpu.router import Router
+
+        cfg = RouterConfig.from_dict({
+            "default_model": "m1",
+            "routing": {"modelCards": [{"name": "m1"}],
+                        "decisions": []}})
+        router = Router(cfg, engine=None)
+        try:
+            ExtProcService.MAX_BODY_BYTES, saved = 4096, \
+                ExtProcService.MAX_BODY_BYTES
+            server, channel, call, pb = self._call(router)
+            try:
+                msgs = [self._headers_msg(pb)]
+                for _ in range(3):
+                    msgs.append(pb.ProcessingRequest(
+                        request_body=pb.HttpBody(body=b"y" * 2048,
+                                                 end_of_stream=False)))
+                resps = list(call(iter(msgs)))
+                imm = next(r for r in resps
+                           if r.WhichOneof("response")
+                           == "immediate_response")
+                assert imm.immediate_response.status.code == 413
+            finally:
+                channel.close()
+                server.stop()
+                ExtProcService.MAX_BODY_BYTES = saved
+        finally:
+            router.shutdown()
