@@ -377,6 +377,22 @@ class RouterServer:
                                       "modality": m.modality,
                                       "tags": m.tags}}
                         for m in server.cfg.model_cards]})
+                elif path in ("/dashboard", "/dashboard/"):
+                    # the static page is OPEN (it holds no data; its API
+                    # calls carry the key the operator types in) — the
+                    # /dashboard/api/* data stays behind the RBAC gate
+                    import os
+
+                    page = os.path.join(os.path.dirname(
+                        os.path.dirname(os.path.abspath(__file__))),
+                        "dashboard", "index.html")
+                    try:
+                        # explicit utf-8: a legacy-locale host must not
+                        # UnicodeDecodeError past the OSError handler
+                        with open(page, encoding="utf-8") as f:
+                            self._text(200, f.read(), "text/html")
+                    except (OSError, ValueError):
+                        self._json(404, {"error": "dashboard not bundled"})
                 elif path == "/startup-status":
                     if server.startup is not None:
                         self._json(200, server.startup.snapshot())
