@@ -213,6 +213,36 @@ class LoRAModernBertForSequenceClassification(nn.Module):
                         dtype=cfg.dtype)(pooled)
 
 
+class LoRAModernBertForTokenClassification(nn.Module):
+    """Token-level sibling of the LoRA sequence classifier (the PII /
+    hallucination-span training shape): same adapted trunk, per-token
+    head → [B, S, num_labels]."""
+
+    config: ModernBertConfig
+    lora: LoRAConfig
+    num_labels: int
+
+    @nn.compact
+    def __call__(self, input_ids: jnp.ndarray,
+                 attention_mask: Optional[jnp.ndarray] = None,
+                 task_index: jnp.ndarray | int = 0) -> jnp.ndarray:
+        cfg = self.config
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        lora_cfg = self.lora
+
+        def dense_factory(features: int, use_bias: bool, name: str):
+            return LoRADense(features, lora_cfg, use_bias=use_bias,
+                             name=name)
+
+        hidden = ModernBertModel(cfg, name="model",
+                                 dense_factory=dense_factory)(
+            input_ids, attention_mask, task_index=jnp.asarray(task_index))
+        hidden = ModernBertPredictionHead(cfg, name="head")(hidden)
+        return nn.Dense(self.num_labels, use_bias=True, name="classifier",
+                        dtype=cfg.dtype)(hidden)
+
+
 def lora_param_filter(path: tuple, _leaf) -> bool:
     """optax trainable-param predicate: True for adapter params only (the
     fine-tune recipe freezes the base; scripts/train-mmbert32k-gpu.sh

@@ -117,13 +117,7 @@ def finetune_classifier(
     from ..models.lora import LoRAConfig, \
         LoRAModernBertForSequenceClassification
     from ..models.modernbert import ModernBertConfig
-    from ..parallel import (
-        batch_sharding,
-        create_mesh,
-        make_lora_optimizer,
-        make_train_step,
-    )
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from .loop import run_lora_training
 
     tokenizer = tokenizer or HashTokenizer()
     if model_config is None:
@@ -135,37 +129,14 @@ def finetune_classifier(
     lora = LoRAConfig(rank=cfg.rank, alpha=cfg.alpha, num_tasks=1)
     model = LoRAModernBertForSequenceClassification(
         model_config, lora, num_labels=len(cfg.labels))
-
-    mesh = create_mesh(cfg.mesh_shape or None)
-    sample = jnp.ones((1, 8), jnp.int32)
     params = base_params if base_params is not None else \
-        model.init(jax.random.PRNGKey(cfg.seed), sample)
-
-    init_state, step = make_train_step(
+        model.init(jax.random.PRNGKey(cfg.seed),
+                   jnp.ones((1, 8), jnp.int32))
+    return run_lora_training(
         lambda p, ids, mask: model.apply(p, ids, mask, task_index=0),
-        make_lora_optimizer(cfg.learning_rate), mesh)
-
-    history: List[Dict[str, float]] = []
-    with mesh:
-        state = init_state(params)
-        in_sh = batch_sharding(mesh)
-        label_sh = NamedSharding(mesh, P("dp"))
-        it = batch_iterator(data, tokenizer, cfg)
-        t0 = time.perf_counter()
-        for i in range(cfg.num_steps):
-            ids, mask, labels = next(it)
-            state, metrics = step(
-                state,
-                jax.device_put(jnp.asarray(ids), in_sh),
-                jax.device_put(jnp.asarray(mask), in_sh),
-                jax.device_put(jnp.asarray(labels), label_sh))
-            if (i + 1) % log_every == 0 or i == cfg.num_steps - 1:
-                entry = {"step": i + 1,
-                         "loss": float(metrics["loss"]),
-                         "accuracy": float(metrics["accuracy"]),
-                         "wall_s": time.perf_counter() - t0}
-                history.append(entry)
-    return jax.device_get(state.params), history
+        params, batch_iterator(data, tokenizer, cfg),
+        cfg.num_steps, cfg.learning_rate, cfg.mesh_shape,
+        log_every=log_every)
 
 
 def save_adapters(params: dict, path: str) -> None:

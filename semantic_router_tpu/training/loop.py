@@ -1,0 +1,61 @@
+"""The shared LoRA training loop: mesh, optimizer, jitted step, history.
+
+Both the sequence and token fine-tunes delegate here — one loop body, so
+a fix to the machinery (sharding, history schema, device placement)
+never needs applying twice.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+
+def run_lora_training(apply_fn: Callable, params,
+                      iterator: Iterator[Tuple],
+                      num_steps: int, learning_rate: float,
+                      mesh_shape: Optional[Dict[str, int]] = None,
+                      loss_fn: Optional[Callable] = None,
+                      log_every: int = 20,
+                      track_accuracy: bool = True
+                      ) -> Tuple[dict, List[Dict[str, float]]]:
+    """``apply_fn(params, ids, mask) → logits``; iterator yields
+    (ids, mask, labels) numpy batches. Returns (trained params,
+    history)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel import (
+        batch_sharding,
+        create_mesh,
+        make_lora_optimizer,
+        make_train_step,
+    )
+
+    mesh = create_mesh(mesh_shape or None)
+    kwargs = {"loss_fn": loss_fn} if loss_fn is not None else {}
+    init_state, step = make_train_step(
+        apply_fn, make_lora_optimizer(learning_rate), mesh, **kwargs)
+
+    history: List[Dict[str, float]] = []
+    with mesh:
+        state = init_state(params)
+        in_sh = batch_sharding(mesh)
+        label_sh = NamedSharding(mesh, P("dp"))
+        t0 = time.perf_counter()
+        for i in range(num_steps):
+            ids, mask, labels = next(iterator)
+            state, metrics = step(
+                state,
+                jax.device_put(jnp.asarray(ids), in_sh),
+                jax.device_put(jnp.asarray(mask), in_sh),
+                jax.device_put(jnp.asarray(labels), label_sh))
+            if (i + 1) % log_every == 0 or i == num_steps - 1:
+                entry = {"step": i + 1,
+                         "loss": float(metrics["loss"]),
+                         "wall_s": time.perf_counter() - t0}
+                if track_accuracy and "accuracy" in metrics:
+                    entry["accuracy"] = float(metrics["accuracy"])
+                history.append(entry)
+    return jax.device_get(state.params), history

@@ -1,0 +1,130 @@
+"""Token-classification (PII) LoRA fine-tune on the SPMD training step.
+
+Reference: src/training PII pipeline — BIO span labels aligned to
+tokenizer offsets, masked token-level cross-entropy, adapters-only
+artifacts.  Reuses the sequence recipe's mesh/optimizer/step machinery;
+only the model (token head), batching (per-token labels) and loss
+(ignore-index masking) differ.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..engine.batcher import pick_bucket
+from ..utils.tokenization import HashTokenizer, Tokenizer
+from .datasets import TokenRow, align_bio, bio_labels
+
+IGNORE_INDEX = -100
+
+
+@dataclass
+class TokenTrainConfig:
+    entity_types: List[str]
+    rank: int = 32
+    alpha: float = 64.0
+    learning_rate: float = 1e-4
+    batch_size: int = 16
+    num_steps: int = 100
+    max_seq_len: int = 256
+    seq_buckets: Tuple[int, ...] = (64, 128, 256)
+    mesh_shape: Dict[str, int] = field(default_factory=dict)
+    seed: int = 0
+
+    @property
+    def labels(self) -> List[str]:
+        return bio_labels(self.entity_types)
+
+
+def token_batch_iterator(rows: Sequence[TokenRow], tokenizer: Tokenizer,
+                         cfg: TokenTrainConfig
+                         ) -> Iterator[Tuple[np.ndarray, np.ndarray,
+                                             np.ndarray]]:
+    label_index = {l: i for i, l in enumerate(cfg.labels)}
+    rng = np.random.default_rng(cfg.seed)
+    encs = []
+    for row in rows:
+        enc = tokenizer.encode(row.text, max_length=cfg.max_seq_len)
+        encs.append((enc, align_bio(row, enc.offsets, label_index)))
+    if not encs:
+        raise ValueError("empty token training dataset")
+    while len(encs) < cfg.batch_size:
+        encs = encs + encs
+    while True:
+        order = rng.permutation(len(encs))
+        for start in range(0, len(order) - cfg.batch_size + 1,
+                           cfg.batch_size):
+            batch = [encs[i] for i in order[start:start + cfg.batch_size]]
+            bucket = pick_bucket(max(len(e) for e, _ in batch),
+                                 list(cfg.seq_buckets))
+            ids = np.zeros((cfg.batch_size, bucket), np.int32)
+            mask = np.zeros((cfg.batch_size, bucket), np.int32)
+            labels = np.full((cfg.batch_size, bucket), IGNORE_INDEX,
+                             np.int32)
+            for i, (enc, lab) in enumerate(batch):
+                L = min(len(enc), bucket)
+                ids[i, :L] = enc.ids[:L]
+                mask[i, :L] = enc.attention_mask[:L]
+                labels[i, :L] = lab[:L]
+            yield ids, mask, labels
+
+
+def masked_token_cross_entropy(logits, labels):
+    """Per-token CE ignoring IGNORE_INDEX positions (padding/specials)."""
+    import jax.numpy as jnp
+    import optax
+
+    valid = labels != IGNORE_INDEX
+    safe = jnp.where(valid, labels, 0)
+    losses = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), safe)
+    denom = jnp.maximum(valid.sum(), 1)
+    return (losses * valid).sum() / denom
+
+
+def finetune_token_classifier(
+    rows: Sequence[TokenRow],
+    cfg: TokenTrainConfig,
+    model_config=None,
+    tokenizer: Optional[Tokenizer] = None,
+    base_params=None,
+    log_every: int = 20,
+) -> Tuple[dict, List[Dict[str, float]]]:
+    """LoRA token fine-tune; returns (params, history). NOTE: history
+    carries loss only — span-quality numbers come from
+    training.evaluate.evaluate_token on a held-out set."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.lora import (
+        LoRAConfig,
+        LoRAModernBertForTokenClassification,
+    )
+    from ..models.modernbert import ModernBertConfig
+    from .loop import run_lora_training
+
+    tokenizer = tokenizer or HashTokenizer()
+    n_labels = len(cfg.labels)
+    if model_config is None:
+        model_config = ModernBertConfig(
+            vocab_size=tokenizer.vocab_size, hidden_size=64,
+            intermediate_size=96, num_hidden_layers=4,
+            num_attention_heads=4,
+            max_position_embeddings=cfg.max_seq_len,
+            local_attention=32, num_labels=n_labels)
+    lora = LoRAConfig(rank=cfg.rank, alpha=cfg.alpha, num_tasks=1)
+    model = LoRAModernBertForTokenClassification(
+        model_config, lora, num_labels=n_labels)
+    params = base_params if base_params is not None else \
+        model.init(jax.random.PRNGKey(cfg.seed),
+                   jnp.ones((1, 8), jnp.int32))
+    return run_lora_training(
+        lambda p, ids, mask: model.apply(p, ids, mask, task_index=0),
+        params, token_batch_iterator(rows, tokenizer, cfg),
+        cfg.num_steps, cfg.learning_rate, cfg.mesh_shape,
+        loss_fn=masked_token_cross_entropy, log_every=log_every,
+        track_accuracy=False)

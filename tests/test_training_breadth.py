@@ -1,0 +1,211 @@
+"""Training-pipeline breadth: per-task datasets, token (PII) fine-tune,
+evaluation harness (reference: src/training per-classifier pipelines)."""
+
+import numpy as np
+import pytest
+
+from semantic_router_tpu.training.datasets import (
+    TokenRow,
+    align_bio,
+    bio_labels,
+    synthetic_sequence_dataset,
+    synthetic_token_dataset,
+    task_labels,
+)
+
+
+class TestDatasets:
+    @pytest.mark.parametrize("task", ["intent", "jailbreak", "fact_check"])
+    def test_sequence_sets_cover_labels(self, task):
+        data = synthetic_sequence_dataset(task, n_per_label=6)
+        labels = {l for _, l in data}
+        assert labels == set(task_labels(task))
+        assert all(t.strip() for t, _ in data)
+
+    def test_token_set_entities_align_with_text(self):
+        rows = synthetic_token_dataset(n=12)
+        assert any(r.entities for r in rows)
+        assert any(not r.entities for r in rows)  # negatives included
+        for row in rows:
+            for ent in row.entities:
+                span = row.text[ent["start"]:ent["end"]]
+                assert span and span == span.strip()
+                if ent["type"] == "EMAIL":
+                    assert "@" in span
+
+    def test_bio_alignment(self):
+        labels = bio_labels(["EMAIL", "PHONE"])
+        assert labels == ["O", "B-EMAIL", "I-EMAIL", "B-PHONE", "I-PHONE"]
+        index = {l: i for i, l in enumerate(labels)}
+        row = TokenRow(text="mail x@y.zz now",
+                       entities=[{"start": 5, "end": 11,
+                                  "type": "EMAIL"}])
+        # offsets: "mail"(0,4) "x@y.zz"→two tokens (5,8)(8,11) "now"(12,15)
+        offsets = [(0, 0), (0, 4), (5, 8), (8, 11), (12, 15), (0, 0)]
+        out = align_bio(row, offsets, index)
+        # specials get ignore-index (HF convention), real tokens O/B/I
+        assert list(out) == [-100, 0, index["B-EMAIL"],
+                             index["I-EMAIL"], 0, -100]
+
+    def test_bio_alignment_unknown_type_raises(self):
+        index = {l: i for i, l in enumerate(bio_labels(["EMAIL"]))}
+        row = TokenRow(text="ssn 123", entities=[
+            {"start": 4, "end": 7, "type": "SSN"}])
+        with pytest.raises(ValueError, match="SSN"):
+            align_bio(row, [(0, 3), (4, 7)], index)
+
+
+class TestTokenFinetune:
+    def test_loss_decreases_and_adapters_learn_spans(self):
+        from semantic_router_tpu.training.token_finetune import (
+            TokenTrainConfig,
+            finetune_token_classifier,
+            masked_token_cross_entropy,
+        )
+
+        rows = synthetic_token_dataset(n=48, seed=1)
+        cfg = TokenTrainConfig(entity_types=["EMAIL", "PHONE", "CARD"],
+                               rank=8, alpha=16.0, batch_size=8,
+                               num_steps=60, max_seq_len=64,
+                               seq_buckets=(64,), learning_rate=3e-3)
+        params, history = finetune_token_classifier(rows, cfg,
+                                                    log_every=20)
+        assert history[-1]["loss"] < history[0]["loss"]
+        assert history[-1]["loss"] < 0.5  # separable synthetic set
+
+    def test_masked_loss_ignores_padding(self):
+        import jax.numpy as jnp
+
+        from semantic_router_tpu.training.token_finetune import (
+            IGNORE_INDEX,
+            masked_token_cross_entropy,
+        )
+
+        logits = jnp.asarray(np.random.default_rng(0).normal(
+            size=(2, 4, 3)), jnp.float32)
+        labels = jnp.asarray([[0, 1, IGNORE_INDEX, IGNORE_INDEX],
+                              [2, IGNORE_INDEX, IGNORE_INDEX,
+                               IGNORE_INDEX]])
+        masked = masked_token_cross_entropy(logits, labels)
+        # equals the mean CE over ONLY the 3 valid positions
+        import optax
+
+        per = optax.softmax_cross_entropy_with_integer_labels(
+            logits, jnp.maximum(labels, 0))
+        expected = (per[0, 0] + per[0, 1] + per[1, 0]) / 3
+        assert abs(float(masked) - float(expected)) < 1e-6
+
+
+class TestEvaluationHarness:
+    class OracleEngine:
+        """Perfect on intent, imperfect on jailbreak — fixed confusions."""
+
+        def classify(self, task, text):
+            class R:
+                pass
+
+            r = R()
+            if task == "intent":
+                for label, temps in [
+                        ("billing", ["invoice", "refund", "payment"]),
+                        ("technical", ["api", "crashes", "configure"]),
+                        ("sales", ["plan", "tier", "pricing"])]:
+                    if any(w in text for w in temps):
+                        r.label = label
+                        return r
+                r.label = "sales"
+                return r
+            r.label = "jailbreak" if "ignore" in text else "benign"
+            return r
+
+        def token_classify(self, task, text, threshold=0.5):
+            class E:
+                def __init__(self, s, e, t):
+                    self.start, self.end, self.type = s, e, t
+                    self.text = text[s:e]
+                    self.score = 0.9
+
+            class R:
+                entities = []
+
+            r = R()
+            if "@" in text:
+                at = text.index("@")
+                a = text.rfind(" ", 0, at) + 1
+                b = text.find(" ", at)
+                b = len(text) if b < 0 else b
+                r.entities = [E(a, b, "EMAIL")]
+            return r
+
+    def test_sequence_metrics(self):
+        from semantic_router_tpu.training.evaluate import (
+            evaluate_sequence,
+        )
+
+        data = synthetic_sequence_dataset("intent", n_per_label=8)
+        report = evaluate_sequence(self.OracleEngine(), "intent", data)
+        assert report.accuracy == 1.0 and report.macro_f1 == 1.0
+        # imperfect oracle: jailbreak positives caught only via "ignore"
+        data2 = synthetic_sequence_dataset("jailbreak", n_per_label=9)
+        report2 = evaluate_sequence(self.OracleEngine(), "jailbreak",
+                                    data2)
+        assert 0.3 < report2.accuracy < 1.0
+        assert set(report2.per_label) == {"benign", "jailbreak"}
+        for stats in report2.per_label.values():
+            assert {"precision", "recall", "f1"} <= set(stats)
+
+    def test_token_metrics(self):
+        from semantic_router_tpu.training.evaluate import evaluate_token
+
+        rows = synthetic_token_dataset(n=24, seed=2)
+        report = evaluate_token(self.OracleEngine(), "pii", rows)
+        # oracle finds EMAILs only: perfect email precision, phone/card
+        # recall zero
+        assert report.per_type["EMAIL"]["recall"] == 1.0
+        assert report.per_type["EMAIL"]["precision"] == 1.0
+        assert report.per_type["PHONE"]["recall"] == 0.0
+        assert 0.0 < report.f1 < 1.0
+
+    def test_trained_token_model_scores_on_heldout(self):
+        """End-to-end: train the PII LoRA model, register it in the
+        engine, evaluate span F1 on held-out synthetic data."""
+        from semantic_router_tpu.config.schema import InferenceEngineConfig
+        from semantic_router_tpu.engine.classify import InferenceEngine
+        from semantic_router_tpu.models.lora import (
+            LoRAConfig,
+            LoRAModernBertForTokenClassification,
+        )
+        from semantic_router_tpu.models.modernbert import ModernBertConfig
+        from semantic_router_tpu.training.evaluate import evaluate_token
+        from semantic_router_tpu.training.token_finetune import (
+            TokenTrainConfig,
+            finetune_token_classifier,
+        )
+        from semantic_router_tpu.utils.tokenization import HashTokenizer
+
+        tok = HashTokenizer()
+        train_rows = synthetic_token_dataset(n=64, seed=3)
+        held_out = synthetic_token_dataset(n=16, seed=99)
+        cfg = TokenTrainConfig(entity_types=["EMAIL", "PHONE", "CARD"],
+                               rank=8, alpha=16.0, batch_size=8,
+                               num_steps=120, max_seq_len=64,
+                               seq_buckets=(64,), learning_rate=3e-3)
+        mcfg = ModernBertConfig(
+            vocab_size=tok.vocab_size, hidden_size=64,
+            intermediate_size=96, num_hidden_layers=4,
+            num_attention_heads=4, max_position_embeddings=64,
+            local_attention=32, num_labels=len(cfg.labels))
+        params, _ = finetune_token_classifier(train_rows, cfg,
+                                              model_config=mcfg,
+                                              tokenizer=tok)
+        model = LoRAModernBertForTokenClassification(
+            mcfg, LoRAConfig(rank=8, alpha=16.0, num_tasks=1),
+            num_labels=len(cfg.labels))
+        eng = InferenceEngine(InferenceEngineConfig(seq_len_buckets=[64]))
+        eng.register_task("pii", "token", model, params, tok, cfg.labels)
+        try:
+            report = evaluate_token(eng, "pii", held_out)
+            # synthetic templates are highly separable: demand real skill
+            assert report.f1 > 0.6, report.to_dict()
+        finally:
+            eng.shutdown()
