@@ -278,6 +278,23 @@ def chat_sse_to_response_events(chunks, request_body: Dict[str, Any],
                                  "response": final}
 
 
+def build_incomplete_response(created: Dict[str, Any], item_id: str,
+                              partial_text: str) -> Dict[str, Any]:
+    """The terminal ``response`` object for a stream whose upstream died
+    mid-generation: the created base marked incomplete, carrying whatever
+    text was already streamed. Lives here so the wire shape stays owned
+    by the same module that builds every other response object."""
+    failed = dict(created)
+    failed["status"] = "incomplete"
+    failed["incomplete_details"] = {"reason": "upstream_disconnected"}
+    failed["output"] = [{
+        "type": "message", "id": item_id, "role": "assistant",
+        "status": "incomplete",
+        "content": [{"type": "output_text", "text": partial_text,
+                     "annotations": []}]}]
+    return failed
+
+
 def chat_to_response(chat_resp: Dict[str, Any], request_body: Dict[str, Any],
                      chat_request: Optional[Dict[str, Any]] = None,
                      store: Optional[ResponseStore] = None,

@@ -143,14 +143,21 @@ class RouterServer:
         # the management surface is open (dev) but secrets stay redacted
         self.api_keys: Dict[str, set] = {}
         for entry in (cfg.api_server or {}).get("api_keys", []) or []:
-            self.api_keys[str(entry.get("key", ""))] = \
-                set(entry.get("roles", []) or [])
+            key = str(entry.get("key") or "")
+            if not key:
+                # an entry missing its key must not become a match for
+                # credential-less requests ('' == '' would grant roles)
+                continue
+            self.api_keys[key] = set(entry.get("roles", []) or [])
 
         # config version management (PATCH/PUT/rollback/versions/hash)
         from ..config.versions import ConfigVersionStore
 
         self.version_store = ConfigVersionStore(config_path) \
             if config_path else None
+        # serializes the read-merge-snapshot-write sequence so two
+        # concurrent PATCHes can't interleave and silently lose one
+        self.config_write_lock = threading.Lock()
 
         # image-generation backends, one per decision plugin config
         # (pkg/imagegen factory role), built lazily and cached
@@ -324,7 +331,20 @@ class RouterServer:
                 auth = h.get("authorization", "")
                 if not key and auth.lower().startswith("bearer "):
                     key = auth[7:].strip()
-                return server.api_keys.get(key)
+                # constant-time scan over every configured key so the
+                # lookup can't leak which prefixes exist via timing.
+                # Compare as bytes: compare_digest raises TypeError on
+                # non-ASCII str, and header values arrive latin-1-decoded
+                import hmac as _hmac
+
+                key_b = key.encode("utf-8", "surrogateescape")
+                found = None
+                for configured, roles in server.api_keys.items():
+                    if _hmac.compare_digest(
+                            configured.encode("utf-8", "surrogateescape"),
+                            key_b):
+                        found = roles
+                return found
 
             def _authorize(self, write: bool = False,
                            action: str = "") -> Optional[set]:
@@ -675,33 +695,39 @@ class RouterServer:
                 # CRITICAL: merge into the ON-DISK (pre-env-substitution)
                 # document, never cfg.raw — cfg.raw carries resolved
                 # ${VAR} secrets, and persisting it would write plaintext
-                # keys into the live file and every version snapshot
-                try:
-                    with open(server.version_store.config_path) as f:
-                        disk_raw = _yaml.safe_load(f) or {}
-                except Exception as exc:
-                    self._json(500, {"error": {
-                        "message": f"cannot read live config: {exc}"}})
-                    return
-                new_raw = deep_merge(disk_raw, patch) if merge else patch
-                try:
-                    # validate the config as it will actually load
-                    # (env placeholders substituted)
-                    resolved = _yaml.safe_load(substitute_env(
-                        _yaml.safe_dump(new_raw))) or {}
-                    candidate = RC.from_dict(resolved)
-                    fatal = [str(e) for e in validate_config(candidate)
-                             if e.fatal]
-                except Exception as exc:
-                    self._json(400, {"error": {
-                        "message": f"invalid config: {exc}"}})
-                    return
-                if fatal:
-                    self._json(400, {"error": {"message": "invalid config",
-                                               "details": fatal}})
-                    return
-                version = server.version_store.snapshot()
-                server.version_store.write_live(new_raw)
+                # keys into the live file and every version snapshot.
+                # The whole read-merge-validate-snapshot-write sequence
+                # holds config_write_lock so concurrent PATCHes serialize
+                # instead of silently dropping one update.
+                with server.config_write_lock:
+                    try:
+                        with open(server.version_store.config_path) as f:
+                            disk_raw = _yaml.safe_load(f) or {}
+                    except Exception as exc:
+                        self._json(500, {"error": {
+                            "message": f"cannot read live config: {exc}"}})
+                        return
+                    new_raw = deep_merge(disk_raw, patch) if merge \
+                        else patch
+                    try:
+                        # validate the config as it will actually load
+                        # (env placeholders substituted)
+                        resolved = _yaml.safe_load(substitute_env(
+                            _yaml.safe_dump(new_raw))) or {}
+                        candidate = RC.from_dict(resolved)
+                        fatal = [str(e) for e in validate_config(candidate)
+                                 if e.fatal]
+                    except Exception as exc:
+                        self._json(400, {"error": {
+                            "message": f"invalid config: {exc}"}})
+                        return
+                    if fatal:
+                        self._json(400, {"error": {
+                            "message": "invalid config",
+                            "details": fatal}})
+                        return
+                    version = server.version_store.snapshot()
+                    server.version_store.write_live(new_raw)
                 self._json(200, {"applied": True,
                                  "backup_version": version.version_id,
                                  "hash": config_hash(new_raw),
@@ -714,7 +740,11 @@ class RouterServer:
                     self._json(503, {"error": "no config path configured"})
                     return
                 version = str(body.get("version", ""))
-                if server.version_store.rollback(version):
+                # rollback mutates the live file: serialize with PATCH/PUT
+                # so a concurrent merge can't clobber the restored version
+                with server.config_write_lock:
+                    ok = server.version_store.rollback(version)
+                if ok:
                     self._json(200, {"rolled_back_to": version})
                 else:
                     self._json(404, {"error":
@@ -1253,7 +1283,13 @@ class RouterServer:
                 def iter_chunks():
                     nonlocal finished
                     while True:
-                        line = upstream.readline()
+                        try:
+                            line = upstream.readline()
+                        except OSError:
+                            # timeout/reset mid-generation: same as EOF —
+                            # finished stays False so the incomplete
+                            # terminal event still reaches the client
+                            break
                         if not line:
                             break
                         if not line.startswith(b"data:"):
@@ -1272,16 +1308,37 @@ class RouterServer:
                         yield chunk
 
                 completed = False
+                created_response: Dict[str, Any] = {}
                 try:
                     for event, payload in chat_sse_to_response_events(
                             iter_chunks(), request_body,
                             chat_request=route.body,
                             store=server.response_store):
+                        if event == "response.created":
+                            created_response = payload["response"]
                         if event == "response.output_text.done" \
                                 and not finished:
                             # upstream died mid-generation: never emit
                             # done/completed for partial text, never let
-                            # the generator persist the partial turn
+                            # the generator persist the partial turn —
+                            # but DO tell the client the stream is dead
+                            # (clients that saw delta events would
+                            # otherwise hang until their own timeout).
+                            # This event's payload carries the partial
+                            # text accumulated so far — surface it.
+                            from .responseapi import \
+                                build_incomplete_response
+
+                            failed = build_incomplete_response(
+                                created_response,
+                                payload.get("item_id", ""),
+                                payload.get("text", ""))
+                            self.wfile.write(
+                                b"event: response.incomplete\ndata: "
+                                + json.dumps(
+                                    {"type": "response.incomplete",
+                                     "response": failed}).encode()
+                                + b"\n\n")
                             break
                         self.wfile.write(
                             f"event: {event}\ndata: "

@@ -125,30 +125,95 @@ class RedisClient:
         self._sock.sendall(encode_command(*args))
         return self._reader.read_reply()
 
+    # commands safe to re-send after the full payload reached the server:
+    # re-executing them server-side cannot change state beyond a single
+    # execution (reads, connection setup, absolute SET/EXPIRE). INCRBY /
+    # DEL / LPUSH etc. are NOT here — the server may have executed the
+    # command even though the reply was lost; a retry would run it twice.
+    _IDEMPOTENT = frozenset({
+        "PING", "ECHO", "AUTH", "SELECT", "INFO", "GET", "MGET", "EXISTS",
+        "TTL", "PTTL", "TYPE", "KEYS", "SCAN", "STRLEN", "GETRANGE",
+        "HGET", "HMGET", "HGETALL", "HKEYS", "HLEN", "SMEMBERS", "SCARD",
+        "SISMEMBER", "LRANGE", "LLEN", "LINDEX", "ZRANGE", "ZSCORE",
+        "ZCARD", "SET", "EXPIRE", "PEXPIRE",
+    })
+
+    @classmethod
+    def _retry_safe(cls, args: Tuple) -> bool:
+        cmd = str(args[0]).upper()
+        if cmd not in cls._IDEMPOTENT:
+            return False
+        # conditional variants flip meaning when run twice: SET..NX that
+        # succeeded server-side returns nil on the retry (caller would
+        # wrongly conclude the lock was NOT acquired)
+        if cmd == "SET":
+            return not any(str(a).upper() in ("NX", "XX", "GET")
+                           for a in args[3:])
+        if cmd in ("EXPIRE", "PEXPIRE"):
+            return not any(str(a).upper() in ("NX", "XX", "GT", "LT")
+                           for a in args[3:])
+        return True
+
+    def _drop_if_stale(self) -> None:
+        """Close a connection the server has already half-closed (restart,
+        idle timeout). A readable socket with a pending EOF would make the
+        NEXT send 'succeed' into a dead pipe — detecting it here lets
+        non-idempotent commands reconnect without at-most-once risk."""
+        if self._sock is None:
+            return
+        import select
+
+        try:
+            readable, _, _ = select.select([self._sock], [], [], 0)
+            if readable:  # unsolicited data or EOF: connection is dead
+                self.close_nolock()
+        except (OSError, ValueError):
+            self.close_nolock()
+
     def execute(self, *args) -> Any:
-        """Run one command; reconnects once on socket failure."""
+        """Run one command; reconnects on socket failure.
+
+        Failures during connect or send (incomplete RESP frame — the
+        server cannot have executed it) always retry. Failures while
+        reading the reply (the command fully reached the server) retry
+        only for idempotent commands — otherwise a lost reply could
+        silently run a non-idempotent command (INCRBY, DEL, ...) twice."""
+        retry_after_send = self._retry_safe(args)
         with self._lock:
             for attempt in range(self.retries + 1):
+                sent = False
                 try:
+                    self._drop_if_stale()
                     if self._sock is None:
                         self._connect()
-                    return self._roundtrip(*args)
+                    self._sock.sendall(encode_command(*args))
+                    sent = True
+                    return self._reader.read_reply()
                 except (OSError, ConnectionError_):
                     self.close_nolock()
-                    if attempt == self.retries:
+                    if attempt == self.retries or (
+                            sent and not retry_after_send):
                         raise ConnectionError_(
                             f"redis {self.host}:{self.port} unreachable")
 
     def pipeline(self, commands: List[Tuple]) -> List[Any]:
         """Send N commands in one write, read N replies (RESP pipelining).
-        Same error contract as execute(): one reconnect retry, then
-        ConnectionError_ — never a raw OSError."""
+        Reconnect retry on connect-phase failure; once any byte of the
+        batch may be in flight a retry happens only when EVERY command in
+        the batch is idempotent — unlike execute(), a multi-command
+        payload can partially transmit COMPLETE frames (the server ran a
+        prefix), so a send failure is not proof nothing executed. Raises
+        ConnectionError_ — never raw OSError."""
+        retry_after_send = all(self._retry_safe(c) for c in commands)
         with self._lock:
             for attempt in range(self.retries + 1):
+                sent = False
                 try:
+                    self._drop_if_stale()
                     if self._sock is None:
                         self._connect()
                     payload = b"".join(encode_command(*c) for c in commands)
+                    sent = True
                     self._sock.sendall(payload)
                     out = []
                     for _ in commands:
@@ -159,7 +224,8 @@ class RedisClient:
                     return out
                 except (OSError, ConnectionError_):
                     self.close_nolock()
-                    if attempt == self.retries:
+                    if attempt == self.retries or (
+                            sent and not retry_after_send):
                         raise ConnectionError_(
                             f"redis {self.host}:{self.port} unreachable")
 

@@ -1,0 +1,197 @@
+"""Regression tests for the round-3 advisor fixes: RESP retry semantics
+(at-most-once for non-idempotent commands), management-auth hardening,
+concurrent config writes, and the streaming terminal event when an
+upstream dies mid-generation (ADVICE.md round 2)."""
+
+import json
+import socket
+import threading
+import urllib.request
+
+import pytest
+
+from semantic_router_tpu.state.resp import (
+    ConnectionError_,
+    MiniRedis,
+    RedisClient,
+)
+
+
+class TestRespRetrySemantics:
+    def test_send_phase_failure_retries_even_incrby(self):
+        mini = MiniRedis().start()
+        try:
+            c = RedisClient(port=mini.port)
+            c.execute("SET", "k", "1")
+            # client-side shutdown: the next send fails before a complete
+            # frame could reach the server -> safe to reconnect-retry
+            c._sock.shutdown(socket.SHUT_RDWR)
+            assert c.execute("INCRBY", "k", "5") == 6
+        finally:
+            mini.stop()
+
+    def test_read_phase_failure_does_not_retry_non_idempotent(self):
+        # a server that consumes the command then closes without replying:
+        # the command reached the server, so INCRBY must NOT be re-sent
+        lsock = socket.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(4)
+        hits = {"n": 0}
+
+        def eater():
+            while True:
+                try:
+                    conn, _ = lsock.accept()
+                except OSError:
+                    return
+                hits["n"] += 1
+                conn.recv(65536)
+                conn.close()
+
+        threading.Thread(target=eater, daemon=True).start()
+        try:
+            c = RedisClient(port=lsock.getsockname()[1], retries=1)
+            with pytest.raises(ConnectionError_):
+                c.execute("INCRBY", "k", "5")
+            assert hits["n"] == 1  # exactly one send: no retry
+            with pytest.raises(ConnectionError_):
+                c.execute("GET", "k")
+            assert hits["n"] == 3  # GET retried once (2 sends)
+        finally:
+            lsock.close()
+
+    def test_conditional_set_not_retry_safe(self):
+        assert not RedisClient._retry_safe(("SET", "k", "v", "NX", "EX", 3))
+        assert not RedisClient._retry_safe(("SET", "k", "v", "GET"))
+        assert RedisClient._retry_safe(("SET", "k", "v", "EX", 3))
+        assert RedisClient._retry_safe(("GET", "k"))
+        assert not RedisClient._retry_safe(("INCRBY", "k", 1))
+        assert not RedisClient._retry_safe(("EXPIRE", "k", 3, "NX"))
+
+    def test_stale_connection_reconnects_for_writes(self):
+        mini = MiniRedis().start()
+        try:
+            c = RedisClient(port=mini.port)
+            c.execute("SET", "k", "1")
+            # simulate a server-half-closed connection (restart/idle
+            # timeout): a socket whose peer is gone is readable with a
+            # pending EOF — the stale pre-check must drop it and
+            # reconnect rather than fail the first non-idempotent command
+            a, b = socket.socketpair()
+            b.close()
+            c._sock.close()
+            c._sock = a
+            assert c.execute("INCRBY", "j", "2") == 2
+        finally:
+            mini.stop()
+
+
+class TestAuthHardening:
+    def test_empty_key_entry_never_matches(self, fixture_config_path):
+        from semantic_router_tpu.config import load_config
+        from semantic_router_tpu.router import Router, RouterServer
+
+        cfg = load_config(fixture_config_path)
+        cfg.api_server = dict(cfg.api_server or {})
+        cfg.api_server["api_keys"] = [{"roles": ["admin"]},  # key omitted
+                                      {"key": "sk-ok", "roles": ["admin"]}]
+        router = Router(cfg, engine=None)
+        server = RouterServer(router, cfg).start()
+        try:
+            # credential-less request must 401, not inherit admin
+            req = urllib.request.Request(server.url + "/config/router")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=10)
+            assert e.value.code == 401
+            req = urllib.request.Request(server.url + "/config/router",
+                                         headers={"x-api-key": "sk-ok"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 200
+        finally:
+            server.stop()
+
+    def test_non_ascii_key_rejected_not_crash(self, fixture_config_path):
+        from semantic_router_tpu.config import load_config
+        from semantic_router_tpu.router import Router, RouterServer
+
+        cfg = load_config(fixture_config_path)
+        cfg.api_server = dict(cfg.api_server or {})
+        cfg.api_server["api_keys"] = [{"key": "sk-ok", "roles": ["admin"]}]
+        router = Router(cfg, engine=None)
+        server = RouterServer(router, cfg).start()
+        try:
+            req = urllib.request.Request(
+                server.url + "/config/router",
+                headers={"x-api-key": "ké\xff"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=10)
+            assert e.value.code == 401  # clean 401, not a handler crash
+        finally:
+            server.stop()
+
+
+class TestStreamIncompleteTerminal:
+    def test_upstream_death_emits_response_incomplete(
+            self, fixture_config_path):
+        from semantic_router_tpu.config import load_config
+        from semantic_router_tpu.router import Router, RouterServer
+
+        class Truncate(socket.socket):
+            pass
+
+        import http.server
+        import socketserver
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                self.rfile.read(int(self.headers["content-length"]))
+                self.send_response(200)
+                self.send_header("content-type", "text/event-stream")
+                self.end_headers()
+                chunk = {"id": "x", "object": "chat.completion.chunk",
+                         "model": "m",
+                         "choices": [{"index": 0,
+                                      "delta": {"content": "par"},
+                                      "finish_reason": None}]}
+                self.wfile.write(b"data: " + json.dumps(chunk).encode()
+                                 + b"\n\n")
+                self.wfile.flush()
+                # connection drops with no finish_reason and no [DONE]
+
+        upstream = socketserver.ThreadingTCPServer(("127.0.0.1", 0),
+                                                   Handler)
+        threading.Thread(target=upstream.serve_forever,
+                         daemon=True).start()
+        cfg = load_config(fixture_config_path)
+        router = Router(cfg, engine=None)
+        server = RouterServer(
+            router, cfg,
+            default_backend=f"http://127.0.0.1:"
+                            f"{upstream.server_address[1]}").start()
+        try:
+            req = urllib.request.Request(
+                server.url + "/v1/responses",
+                data=json.dumps({"model": "auto", "input": "hi",
+                                 "stream": True}).encode(),
+                method="POST")
+            req.add_header("content-type", "application/json")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                body = resp.read().decode()
+            events = [ln[7:] for ln in body.splitlines()
+                      if ln.startswith("event: ")]
+            assert "response.output_text.delta" in events
+            assert events[-1] == "response.incomplete"
+            assert "response.completed" not in events
+            # the terminal payload carries the partial text
+            terminal = [ln for ln in body.splitlines()
+                        if ln.startswith("data: ")][-1]
+            payload = json.loads(terminal[6:])
+            r = payload["response"]
+            assert r["status"] == "incomplete"
+            assert r["output"][0]["content"][0]["text"] == "par"
+        finally:
+            server.stop()
+            upstream.shutdown()
