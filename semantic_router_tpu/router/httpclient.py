@@ -1,0 +1,171 @@
+"""Pooled upstream HTTP client for the router's forward path.
+
+The reference data plane rides Envoy's upstream connection pools (the
+cluster manager keeps persistent connections to every backend; see
+deploy/local/envoy.yaml clusters). The standalone Python front needs its
+own equivalent: opening a fresh TCP connection per forwarded request —
+what urllib does — adds a SYN round-trip, slow-start, and FD churn per
+request and dominates the latency tail on busy loops.
+
+Design: per-(scheme, host, port) stacks of idle
+``http.client.HTTPConnection``. Borrowed connections are probed for
+staleness (a readable socket with pending EOF means the server closed it
+while idle — same trick as state/resp.py) and silently replaced. Retry
+discipline mirrors resp.py's at-most-once reasoning: an exception while
+SENDING the request means the server cannot have seen a complete frame
+(Content-Length framing — a partial body is never executed), so one
+retry on a fresh connection is safe even for POST; an exception while
+READING the response is never retried (the backend may have processed
+the request).
+"""
+
+from __future__ import annotations
+
+import http.client
+import select
+import socket
+import threading
+from typing import Dict, Optional, Tuple
+from urllib.parse import urlsplit
+
+__all__ = ["UpstreamPool"]
+
+
+class _Conn(http.client.HTTPConnection):
+    def connect(self) -> None:  # pragma: no cover - trivial
+        super().connect()
+        try:
+            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+
+
+class _ConnS(http.client.HTTPSConnection):
+    def connect(self) -> None:  # pragma: no cover - needs TLS backend
+        super().connect()
+        try:
+            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+
+
+def _stale(sock: Optional[socket.socket]) -> bool:
+    """True when the peer half-closed the idle connection (readable with
+    a pending EOF / unsolicited bytes) — reuse would send into a dead
+    pipe and surface as a spurious backend error."""
+    if sock is None:
+        return True
+    try:
+        readable, _, _ = select.select([sock], [], [], 0)
+        return bool(readable)
+    except (OSError, ValueError):
+        return True
+
+
+class UpstreamPool:
+    """Keep-alive connection pool, shared across handler threads."""
+
+    def __init__(self, max_idle_per_host: int = 16) -> None:
+        self._idle: Dict[Tuple[str, str, int], list] = {}
+        self._lock = threading.Lock()
+        self._max_idle = max_idle_per_host
+        self._closed = False
+
+    # -- lifecycle ------------------------------------------------------
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            conns = [c for stack in self._idle.values() for c in stack]
+            self._idle.clear()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    # -- internals ------------------------------------------------------
+
+    def _borrow(self, scheme: str, host: str, port: int,
+                timeout: float):
+        key = (scheme, host, port)
+        with self._lock:
+            stack = self._idle.get(key)
+            while stack:
+                conn = stack.pop()
+                if not _stale(conn.sock):
+                    conn.timeout = timeout
+                    if conn.sock is not None:
+                        conn.sock.settimeout(timeout)
+                    return conn, True
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+        cls = _ConnS if scheme == "https" else _Conn
+        return cls(host, port, timeout=timeout), False
+
+    def _give_back(self, scheme: str, host: str, port: int, conn) -> None:
+        with self._lock:
+            if not self._closed:
+                stack = self._idle.setdefault((scheme, host, port), [])
+                if len(stack) < self._max_idle:
+                    stack.append(conn)
+                    return
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    # -- request --------------------------------------------------------
+
+    def request(self, method: str, url: str, body: Optional[bytes],
+                headers: Dict[str, str], timeout: float
+                ) -> Tuple[int, Dict[str, str], bytes]:
+        """One fully-buffered HTTP exchange. Returns
+        ``(status, response_headers, response_body)``; raises OSError /
+        http.client.HTTPException when the backend is unreachable (the
+        caller maps that to its fail-open 502). Non-2xx statuses are
+        returned, not raised."""
+        parts = urlsplit(url)
+        scheme = parts.scheme or "http"
+        host = parts.hostname or "127.0.0.1"
+        port = parts.port or (443 if scheme == "https" else 80)
+        path = parts.path or "/"
+        if parts.query:
+            path += "?" + parts.query
+        last_exc: Optional[Exception] = None
+        for attempt in (0, 1):
+            conn, reused = self._borrow(scheme, host, port, timeout)
+            sent = False
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                sent = True
+                resp = conn.getresponse()
+                data = resp.read()
+                keep = (resp.version >= 11 and
+                        resp.headers.get("connection", "").lower()
+                        != "close")
+                if keep:
+                    self._give_back(scheme, host, port, conn)
+                else:
+                    conn.close()
+                return resp.status, dict(resp.headers), data
+            except (http.client.HTTPException, OSError) as exc:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                last_exc = exc
+                if sent and not (reused and attempt == 0 and
+                                 isinstance(exc,
+                                            http.client
+                                            .RemoteDisconnected)):
+                    # response-phase failure: the server may have
+                    # executed the request — never retry. Exception:
+                    # RemoteDisconnected on a REUSED connection means
+                    # the server closed it idle before reading anything
+                    # (the inherent keep-alive close race) — known
+                    # unprocessed, safe to retry once fresh.
+                    raise
+        raise last_exc  # both attempts failed in the send phase

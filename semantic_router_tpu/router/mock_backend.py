@@ -13,7 +13,7 @@ import json
 import threading
 import time
 import uuid
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Optional
 
 
@@ -39,6 +39,11 @@ def _echo_payload(body: dict) -> dict:
 
 class _Handler(BaseHTTPRequestHandler):
     server_version = "mock-vllm/0.1"
+    # keep-alive so the router's UpstreamPool can reuse connections —
+    # a mock that forces connection-per-request would dominate the very
+    # tail the load bench measures
+    protocol_version = "HTTP/1.1"
+    timeout = 65
 
     def log_message(self, *args):  # silence
         pass
@@ -82,6 +87,10 @@ class _Handler(BaseHTTPRequestHandler):
     def _stream(self, body, content, usage):
         self.send_response(200)
         self.send_header("content-type", "text/event-stream")
+        # no content-length on SSE: the connection must close after the
+        # stream or the next kept-alive request would hang
+        self.send_header("connection", "close")
+        self.close_connection = True
         self.end_headers()
         cid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
         chunks = [content[i:i + 40] for i in range(0, len(content), 40)]
@@ -116,7 +125,10 @@ class _Handler(BaseHTTPRequestHandler):
 
 class MockVLLMServer:
     def __init__(self, port: int = 0, model_name: str = "mock-model") -> None:
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        from .httpserver import PooledHTTPServer
+
+        self.httpd = PooledHTTPServer(("127.0.0.1", port), _Handler,
+                                      max_workers=64)
         self.httpd.model_name = model_name  # type: ignore[attr-defined]
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None

@@ -30,7 +30,7 @@ import time
 import urllib.request
 import urllib.error
 import uuid
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -44,6 +44,14 @@ from .anthropic import (
     openai_to_anthropic_response,
 )
 from .pipeline import Router, RouteResult
+
+# never forwarded upstream: hop-by-hop headers describe THIS connection
+# (RFC 9110 §7.6.1) — copying transfer-encoding while re-serializing the
+# body with content-length framing would corrupt the upstream request
+_HOP_BY_HOP = frozenset({
+    "content-length", "host", "transfer-encoding", "connection",
+    "keep-alive", "te", "upgrade", "proxy-connection", "trailer",
+})
 
 
 # discovery document (routes_catalog.go role): route-for-route map of the
@@ -196,8 +204,14 @@ class RouterServer:
         self.response_store = build_response_store(
             getattr(cfg, "response_store", {}))
 
+        from .httpclient import UpstreamPool
+        from .httpserver import PooledHTTPServer
+
+        self.upstream_pool = UpstreamPool()
         handler = self._make_handler()
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        workers = int((cfg.api_server or {}).get("http_workers", 64))
+        self.httpd = PooledHTTPServer(("127.0.0.1", port), handler,
+                                      max_workers=workers)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
@@ -229,6 +243,7 @@ class RouterServer:
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+        self.upstream_pool.close()
         self.looper_pool.shutdown(wait=False, cancel_futures=True)
         exporter = getattr(self, "otlp_exporter", None)
         if exporter is not None:  # a leaked sink would double-export
@@ -256,45 +271,131 @@ class RouterServer:
 
     def _forward(self, url: str, body: Dict[str, Any],
                  headers: Dict[str, str]) -> tuple[int, Dict[str, Any]]:
+        import http.client as _hc
+
         data = json.dumps(body).encode()
-        req = urllib.request.Request(
-            url + "/v1/chat/completions", data=data, method="POST")
-        req.add_header("content-type", "application/json")
+        hdrs = {"content-type": "application/json"}
         for k, v in headers.items():
-            if k.lower() not in ("content-length", "host"):
-                req.add_header(k, v)
+            if k.lower() not in _HOP_BY_HOP:
+                hdrs[k] = v
         try:
-            with urllib.request.urlopen(
-                    req, timeout=self.forward_timeout_s) as resp:
-                return resp.status, json.loads(resp.read() or b"{}")
-        except urllib.error.HTTPError as e:
-            try:
-                payload = json.loads(e.read() or b"{}")
-            except json.JSONDecodeError:
-                payload = {"error": {"message": str(e)}}
-            return e.code, payload
-        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            status, _, raw = self.upstream_pool.request(
+                "POST", url + "/v1/chat/completions", data, hdrs,
+                self.forward_timeout_s)
+        except (_hc.HTTPException, TimeoutError, OSError) as e:
             return 502, {"error": {"message": f"backend unreachable: {e}",
                                    "type": "backend_error"}}
+        try:
+            return status, json.loads(raw or b"{}")
+        except json.JSONDecodeError:
+            return status, {"error": {
+                "message": raw[:300].decode(errors="replace")}}
 
     def _make_handler(self):
         server = self
 
         class Handler(BaseHTTPRequestHandler):
             server_version = "semantic-router-tpu/0.1"
+            # HTTP/1.1 keep-alive: clients (and Envoy upstream pools)
+            # reuse the connection; _json/_text always send
+            # content-length, SSE paths close via _sse_headers
+            protocol_version = "HTTP/1.1"
+            # an idle kept-alive connection must not pin a pool worker
+            # forever — readline() in handle_one_request times out and
+            # closes the connection
+            timeout = 65
 
             def log_message(self, *args):
                 pass
 
+            def handle_one_request(self):
+                # per-request state: _drain_body/_body track whether THIS
+                # request's body was consumed; the handler instance is
+                # reused across keep-alive requests
+                self._body_consumed = False
+                super().handle_one_request()
+
+            def _sse_headers(self, headers: Dict[str, str]) -> None:
+                """Start a text/event-stream response. SSE has no
+                content-length, so under HTTP/1.1 the connection must
+                close when the stream ends — otherwise the next request
+                on the kept-alive connection would hang forever."""
+                self.send_response(200)
+                self.send_header("content-type", "text/event-stream")
+                self.send_header("connection", "close")
+                self.close_connection = True
+                for k, v in headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+
             # -- helpers --------------------------------------------------
 
             def _body(self) -> Dict[str, Any]:
-                length = int(self.headers.get("content-length", 0))
-                raw = self.rfile.read(length) if length else b"{}"
+                if "chunked" in self.headers.get("transfer-encoding",
+                                                 "").lower():
+                    raw = self._read_chunked()
+                else:
+                    length = int(self.headers.get("content-length", 0))
+                    raw = self.rfile.read(length) if length else b"{}"
+                self._body_consumed = True
                 return json.loads(raw or b"{}")
+
+            _MAX_CHUNKED = 64 * 1024 * 1024
+
+            def _read_chunked(self) -> bytes:
+                """Minimal Transfer-Encoding: chunked reader. Without it
+                a chunked POST on a kept-alive connection would leave
+                the body in rfile to be parsed as the next request."""
+                out, total = [], 0
+                while True:
+                    line = self.rfile.readline(65557)
+                    try:
+                        size = int(line.split(b";")[0].strip() or b"0",
+                                   16)
+                    except ValueError:
+                        self.close_connection = True
+                        break
+                    if size == 0:
+                        while True:  # trailers until blank line
+                            t = self.rfile.readline(65557)
+                            if t in (b"\r\n", b"\n", b""):
+                                break
+                        break
+                    total += size
+                    if total > self._MAX_CHUNKED:
+                        self.close_connection = True
+                        break
+                    out.append(self.rfile.read(size))
+                    self.rfile.read(2)  # trailing CRLF
+                return b"".join(out)
+
+            def _drain_body(self) -> None:
+                """Consume an unread request body before responding.
+
+                Under HTTP/1.1 keep-alive an early response (401/403/404
+                before _body() ran) would otherwise leave the body bytes
+                in rfile, where they get parsed as the NEXT request line
+                and corrupt the connection."""
+                if getattr(self, "_body_consumed", False):
+                    return
+                self._body_consumed = True
+                if "chunked" in self.headers.get("transfer-encoding",
+                                                 "").lower():
+                    # not worth a chunked parser for a drain: just stop
+                    # reusing the connection
+                    self.close_connection = True
+                    return
+                remaining = int(self.headers.get("content-length", 0)
+                                or 0)
+                while remaining > 0:
+                    chunk = self.rfile.read(min(65536, remaining))
+                    if not chunk:
+                        break
+                    remaining -= len(chunk)
 
             def _json(self, status: int, payload: Any,
                       extra_headers: Optional[Dict[str, str]] = None) -> None:
+                self._drain_body()
                 data = json.dumps(payload).encode()
                 self.send_response(status)
                 self.send_header("content-type", "application/json")
@@ -306,6 +407,7 @@ class RouterServer:
 
             def _text(self, status: int, text: str,
                       ctype: str = "text/plain") -> None:
+                self._drain_body()
                 data = text.encode()
                 self.send_response(status)
                 self.send_header("content-type", ctype)
@@ -1065,11 +1167,7 @@ class RouterServer:
                 if (route.body or {}).get("stream"):
                     # the client negotiated SSE: answer as a single-chunk
                     # stream so OpenAI SDK parsers work unchanged
-                    self.send_response(200)
-                    self.send_header("content-type", "text/event-stream")
-                    for k, v in out_headers.items():
-                        self.send_header(k, v)
-                    self.end_headers()
+                    self._sse_headers(out_headers)
                     chunk = {
                         "id": payload["id"], "object":
                         "chat.completion.chunk",
@@ -1162,11 +1260,7 @@ class RouterServer:
                                       headers: Dict[str, str]) -> None:
                 """Emit a finished response object as the minimal valid
                 event sequence (created → delta → completed)."""
-                self.send_response(200)
-                self.send_header("content-type", "text/event-stream")
-                for k, v in headers.items():
-                    self.send_header(k, v)
-                self.end_headers()
+                self._sse_headers(headers)
                 text = response_obj.get("output_text", "")
                 item_id = f"msg_{uuid.uuid4().hex[:16]}"
                 # the FULL event sequence: SDK stream accumulators key
@@ -1245,7 +1339,7 @@ class RouterServer:
                                   method="POST")
                 req.add_header("content-type", "application/json")
                 for k, v in fwd_headers.items():
-                    if k.lower() not in ("content-length", "host"):
+                    if k.lower() not in _HOP_BY_HOP:
                         req.add_header(k, v)
                 t0 = time.perf_counter()
                 try:
@@ -1272,11 +1366,7 @@ class RouterServer:
                         "type": "backend_error"}}, route.headers)
                     return
 
-                self.send_response(200)
-                self.send_header("content-type", "text/event-stream")
-                for k, v in route.headers.items():
-                    self.send_header(k, v)
-                self.end_headers()
+                self._sse_headers(route.headers)
 
                 finished = False
 
@@ -1383,7 +1473,7 @@ class RouterServer:
                                   method="POST")
                 req.add_header("content-type", "application/json")
                 for k, v in fwd_headers.items():
-                    if k.lower() not in ("content-length", "host"):
+                    if k.lower() not in _HOP_BY_HOP:
                         req.add_header(k, v)
                 t0 = time.perf_counter()
                 try:
@@ -1410,11 +1500,7 @@ class RouterServer:
                         "type": "backend_error"}}, route.headers)
                     return
 
-                self.send_response(200)
-                self.send_header("content-type", "text/event-stream")
-                for k, v in route.headers.items():
-                    self.send_header(k, v)
-                self.end_headers()
+                self._sse_headers(route.headers)
 
                 chunks = []
                 ttft_ms = 0.0

@@ -1,0 +1,38 @@
+"""CI load-bench gate (VERDICT r2 item 3): the HTTP data plane must keep
+its latency tail flat under concurrency — p99 < 10x p50 at c=16 against
+the mock backend, error rate < 2%. The round-2 ThreadingHTTPServer front
+measured p99/p50 = 50x here; the pooled HTTP/1.1 front measures ~2x."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                "benchmarks"))
+
+
+@pytest.mark.slow
+def test_tail_latency_gate(fixture_config_path):
+    from load_bench import run_load
+
+    from semantic_router_tpu.config import load_config
+    from semantic_router_tpu.router import MockVLLMServer, RouterServer
+    from semantic_router_tpu.runtime.bootstrap import build_router
+
+    backend = MockVLLMServer().start()
+    cfg = load_config(fixture_config_path)
+    router = build_router(cfg)
+    server = RouterServer(router, cfg,
+                          default_backend=backend.url).start()
+    try:
+        report = run_load(server.url, clients=16, seconds=4.0)
+    finally:
+        server.stop()
+        router.shutdown()
+        backend.stop()
+
+    assert report["requests"] > 100, report
+    assert report["error_rate"] < 0.02, report
+    # the round-2 regression this gate exists to catch was 50x
+    assert 0 < report["tail_ratio_p99_p50"] < 10.0, report
