@@ -28,7 +28,7 @@ import os
 import sys
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, List
+from typing import Dict, List, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -102,6 +102,147 @@ def percentile(sorted_vals: List[float], p: float) -> float:
     return sorted_vals[idx]
 
 
+def make_real_engine():
+    """Engine with the FULL ML signal stack at real model geometry.
+
+    ModernBERT-base dimensions (the reference's production classifier
+    size, candle-binding modernbert.rs) for intent/jailbreak/PII/
+    embedding — randomly initialised (zero-egress image: no weights),
+    which is latency-equivalent to trained checkpoints: the bench
+    measures routing cost, not accuracy (accuracy_bench.py does that).
+    One 128-token bucket bounds XLA compile count; longer texts truncate
+    (the reference's classify path truncates at max_length too,
+    classifier.go tokenize options)."""
+    import jax
+    import jax.numpy as jnp
+
+    from semantic_router_tpu.config.schema import InferenceEngineConfig
+    from semantic_router_tpu.engine.classify import InferenceEngine
+    from semantic_router_tpu.models.embeddings import MmBertEmbeddingModel
+    from semantic_router_tpu.models.modernbert import (
+        ModernBertConfig,
+        ModernBertForSequenceClassification,
+        ModernBertForTokenClassification,
+    )
+    from semantic_router_tpu.utils.tokenization import HashTokenizer
+
+    base = dict(pad_token_id=0)
+    tasks = [
+        ("intent", "sequence", ["business", "law", "psychology",
+                                "biology", "chemistry", "history",
+                                "other", "health", "economics", "math",
+                                "physics", "computer science",
+                                "philosophy", "engineering"]),
+        ("jailbreak", "sequence", ["benign", "jailbreak"]),
+        ("pii", "token", ["O"] + [f"{p}-{t}" for t in
+                          ("EMAIL_ADDRESS", "PHONE_NUMBER", "PERSON",
+                           "US_SSN", "CREDIT_CARD", "LOCATION",
+                           "ORGANIZATION", "DATE_TIME")
+                          for p in ("B", "I")]),
+        ("embedding", "embedding", []),
+    ]
+    engine = InferenceEngine(InferenceEngineConfig(
+        max_batch_size=16, max_wait_ms=2.0, seq_len_buckets=[128]))
+    tok = HashTokenizer(vocab_size=50368)
+    key = jax.random.PRNGKey(0)
+    for i, (name, kind, labels) in enumerate(tasks):
+        mcfg = ModernBertConfig(num_labels=max(len(labels), 2), **base)
+        if kind == "embedding":
+            module = MmBertEmbeddingModel(mcfg)
+        elif kind == "sequence":
+            module = ModernBertForSequenceClassification(mcfg)
+        else:
+            module = ModernBertForTokenClassification(mcfg)
+        params = module.init(jax.random.fold_in(key, i),
+                             jnp.ones((1, 8), jnp.int32))
+        engine.register_task(name, kind, module, params, tok, labels,
+                             max_seq_len=128)
+    return engine
+
+
+def run_e2e_delta(bodies: List[Dict], cfg, router,
+                  concurrency: int) -> Dict:
+    """The north-star framing (BASELINE.md:4-7): e2e request latency
+    THROUGH the router vs straight to the backend — the delta is what
+    semantic routing adds on the wire, measured, not inferred."""
+    import http.client
+
+    from semantic_router_tpu.router import MockVLLMServer, RouterServer
+
+    backend = MockVLLMServer().start()
+    server = RouterServer(router, cfg,
+                          default_backend=backend.url).start()
+
+    import threading
+
+    def drive(port: int) -> Tuple[List[float], int]:
+        """Fixed-request-set arm driver: persistent connection per
+        client (load_bench's client shape), returns (sorted latencies,
+        error count) — errors must be VISIBLE, a delta computed over
+        surviving fast requests only would under-report overhead."""
+        lats: List[float] = []
+        errors = [0]
+        lock = threading.Lock()
+        idx = {"i": 0}
+
+        def worker():
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=60)
+            while True:
+                with lock:
+                    i = idx["i"]
+                    if i >= len(bodies):
+                        break
+                    idx["i"] = i + 1
+                data = json.dumps(bodies[i]).encode()
+                t0 = time.perf_counter()
+                try:
+                    conn.request("POST", "/v1/chat/completions",
+                                 body=data, headers={
+                                     "content-type": "application/json"})
+                    resp = conn.getresponse()
+                    resp.read()
+                    if resp.status != 200:
+                        raise RuntimeError(f"HTTP {resp.status}")
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        lats.append(dt * 1e3)
+                except Exception:
+                    with lock:
+                        errors[0] += 1
+                    conn.close()
+                    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                      timeout=60)
+            conn.close()
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(concurrency)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        return sorted(lats), errors[0]
+
+    try:
+        # warmup both arms, then measure
+        drive(backend.port)
+        direct, direct_errs = drive(backend.port)
+        drive(server.port)
+        routed, routed_errs = drive(server.port)
+    finally:
+        # full teardown (incl. looper pool + upstream pool); the router
+        # has no further route() callers after the delta arms, and
+        # shutdown is idempotent for main()'s own later call
+        server.stop()
+        backend.stop()
+
+    def pcts(vals):
+        return {p: round(percentile(vals, p), 3) for p in (50, 95, 99)}
+
+    d, r = pcts(direct), pcts(routed)
+    return {"direct_ms": d, "routed_ms": r,
+            "added_ms": {p: round(r[p] - d[p], 3) for p in (50, 95, 99)},
+            "errors": {"direct": direct_errs, "routed": routed_errs}}
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--dataset", default="",
@@ -110,11 +251,19 @@ def main() -> int:
     ap.add_argument("--config",
                     default="tests/fixtures/router_config.yaml")
     ap.add_argument("--mock-models", action="store_true",
-                    help="include the learned-signal path via the tiny "
-                         "mock engine")
+                    help="alias for --engine mock")
+    ap.add_argument("--engine", default="none",
+                    choices=["none", "mock", "real"],
+                    help="none: heuristics only; mock: tiny random "
+                         "models; real: ModernBERT-base-geometry models "
+                         "(the full ML signal stack at production size)")
+    ap.add_argument("--no-delta", action="store_true",
+                    help="skip the e2e router-vs-direct delta arms")
     ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--out", default="")
     args = ap.parse_args()
+    if args.mock_models and args.engine == "none":
+        args.engine = "mock"
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from semantic_router_tpu.config import load_config
@@ -124,7 +273,10 @@ def main() -> int:
     )
 
     cfg = load_config(args.config)
-    engine = build_engine(cfg, mock=args.mock_models)
+    if args.engine == "real":
+        engine = make_real_engine()
+    else:
+        engine = build_engine(cfg, mock=args.engine == "mock")
     router = build_router(cfg, engine)
 
     convs = load_dataset(args.dataset, args.n) if args.dataset \
@@ -138,9 +290,18 @@ def main() -> int:
                "messages": [{"role": "user", "content": t}]}
               for t in texts]
 
-    # warmup (compile/caches)
-    for b in bodies[:8]:
-        router.route(b)
+    # warmup: cover EVERY (task, seq-bucket) pair so XLA compiles land
+    # here, not in the measurement (the long-context tail would otherwise
+    # pay a multi-second first-compile inside its latency sample)
+    warm_texts = ["short question about cache",
+                  " ".join(f"medium sentence {j} about routing"
+                           for j in range(30)),
+                  " ".join(f"long context sentence {j} about pipelines"
+                           for j in range(400))]
+    for t in warm_texts:
+        for _ in range(2):
+            router.route({"model": "auto",
+                          "messages": [{"role": "user", "content": t}]})
 
     latencies: List[float] = []
     decisions: Dict[str, int] = {}
@@ -167,6 +328,8 @@ def main() -> int:
         decisions[dec] = decisions.get(dec, 0) + 1
 
     latencies.sort()
+    import jax
+
     report = {
         "requests": len(results),
         "wall_s": round(wall, 3),
@@ -182,9 +345,12 @@ def main() -> int:
         "kinds": kinds,
         "dataset": args.dataset or f"synthetic({args.n})",
         "concurrency": args.concurrency,
-        "engine": "mock" if args.mock_models else
-                  ("none" if engine is None else "configured"),
+        "engine": args.engine if engine is not None else "none",
+        "platform": jax.default_backend(),
     }
+    if not args.no_delta:
+        report["e2e_delta"] = run_e2e_delta(bodies, cfg, router,
+                                            args.concurrency)
     print(json.dumps(report, indent=2, ensure_ascii=False))
     if args.out:
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)

@@ -1006,6 +1006,11 @@ class RouterConfig:
     # remote MCP servers: {"classifiers": [{name, transport, command/url,
     # tool, threshold}]} — served-classifier clients (pkg/mcp)
     mcp: Dict[str, Any] = field(default_factory=dict)
+    # external model endpoints: [{role: guardrail|embedding, base_url,
+    # model, api_key_env, ...}] — vLLM-served guard classifier
+    # (pkg/classification/vllm_classifier.go) and remote OpenAI-compatible
+    # embedding provider (pkg/embedding)
+    external_models: List[Dict[str, Any]] = field(default_factory=list)
     raw: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
@@ -1039,6 +1044,7 @@ class RouterConfig:
                                    routing.get("knowledge_bases", []))
                              or []],
             mcp=dict(d.get("mcp", {}) or {}),
+            external_models=list(d.get("external_models", []) or []),
             raw=d,
         )
 

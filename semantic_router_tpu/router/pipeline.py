@@ -128,6 +128,31 @@ class Router:
             except Exception as exc:
                 component_event("router", "mcp_classifier_skipped",
                                 error=str(exc), level="warning")
+        # external model clients (vllm_classifier.go + pkg/embedding):
+        # a vLLM-served guard joins the jailbreak family and a remote
+        # OpenAI-compatible embedding provider backs the embedding
+        # families — each only when no local task covers the role
+        self._remote_embedder_cache = None
+        if getattr(cfg, "external_models", None):
+            from ..signals.remote import (
+                build_external_evaluators,
+                embedding_engine_from_config,
+            )
+
+            try:
+                self._remote_embedder_cache = \
+                    embedding_engine_from_config(cfg)
+            except Exception as exc:
+                component_event("router", "external_model_skipped",
+                                role="embedding", error=str(exc),
+                                level="warning")
+            remote_evs, replaced = build_external_evaluators(
+                cfg, engine,
+                remote_embedder=self._remote_embedder_cache)
+            if replaced:
+                extra = [e for e in extra
+                         if type(e).__name__ not in replaced]
+            extra += remote_evs
         self.dispatcher = build_heuristic_dispatcher(cfg, extra=extra)
         self.decision_engine = DecisionEngine(cfg.decisions, cfg.strategy)
         self.rate_limiter = RateLimiter.from_config(cfg.ratelimit)
@@ -151,6 +176,15 @@ class Router:
             self.cache = build_cache(
                 cfg.semantic_cache,
                 lambda text: engine.embed(embedding_task, [text])[0])
+        elif cfg.semantic_cache.enabled \
+                and self._remote_embedder_cache is not None:
+            # no local embedding task, but a remote provider is
+            # configured (pkg/embedding backing the cache embedder) —
+            # the same provider instance the signal families use
+            remote_embed = self._remote_embedder_cache
+            self.cache = build_cache(
+                cfg.semantic_cache,
+                lambda text: remote_embed.embed("embedding", [text])[0])
         else:
             self.cache = None
 

@@ -1,0 +1,430 @@
+"""External model clients: vLLM-served guard classifier + remote
+OpenAI-compatible embedding provider.
+
+Reference parity (the last two signal-backend client families):
+- ``pkg/classification/vllm_classifier.go`` + ``vllm_jailbreak_parser.go``
+  — a guardrail LLM served by any OpenAI-compatible endpoint classifies
+  text for jailbreak/safety; output parsed by qwen3guard / json / simple
+  / auto parsers; joins the jailbreak signal family with the standard
+  fail-open contract.
+- ``pkg/embedding/openai_provider.go`` — a remote ``/v1/embeddings``
+  endpoint backs the embedding-similarity families (and the semantic
+  cache) when no local embedding task is loaded; dimension-validated,
+  index-reassembled, bounded retries with backoff.
+
+Config (RouterConfig.external_models — reference
+``config/config.yaml:2026-2032`` endpoint shape)::
+
+    external_models:
+      - role: guardrail
+        base_url: http://vllm:8000
+        model: Qwen/Qwen3Guard-8B
+        api_key_env: VLLM_API_KEY
+        timeout_seconds: 30
+        threshold: 0.5
+        parser: auto          # qwen3guard | json | simple | auto
+      - role: embedding
+        base_url: http://embedding-service:8000/v1
+        model: BAAI/bge-m3
+        api_key_env: EMBEDDING_API_KEY
+        timeout_seconds: 5
+        max_retries: 2
+        dimensions: 1024
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..observability.logging import component_event
+from .base import RequestContext, SignalHit, SignalResult
+
+__all__ = [
+    "RemoteEmbeddingProvider",
+    "RemoteEmbeddingEngine",
+    "VLLMGuardSignal",
+    "parse_safety_output",
+    "build_external_evaluators",
+]
+
+
+# ---------------------------------------------------------------------------
+# shared HTTP plumbing (rides the router's pooled keep-alive client)
+
+
+_pool_lock = threading.Lock()
+_shared_pool = None
+
+
+def _get_pool():
+    """One process-wide keep-alive pool for every external endpoint
+    (mirrors the reference's shared Go http.Client transports): idle
+    sockets are bounded per host and fragmenting reuse across per-signal
+    pools would defeat the pooling."""
+    global _shared_pool
+    with _pool_lock:
+        if _shared_pool is None:
+            from ..router.httpclient import UpstreamPool
+
+            _shared_pool = UpstreamPool(max_idle_per_host=4)
+        return _shared_pool
+
+
+class _Endpoint:
+    def __init__(self, base_url: str, api_key_env: str = "",
+                 timeout_s: float = 30.0) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.api_key_env = api_key_env
+        self.timeout_s = timeout_s
+        self.pool = _get_pool()
+
+    def headers(self) -> Dict[str, str]:
+        h = {"content-type": "application/json"}
+        key = os.environ.get(self.api_key_env, "") if self.api_key_env \
+            else ""
+        if key:
+            h["authorization"] = f"Bearer {key}"
+        return h
+
+    def post_json(self, path: str, payload: Dict) -> Dict:
+        status, _, raw = self.pool.request(
+            "POST", self.base_url + path,
+            json.dumps(payload).encode(), self.headers(), self.timeout_s)
+        if status != 200:
+            raise RuntimeError(
+                f"{path} HTTP {status}: {raw[:200].decode(errors='replace')}")
+        return json.loads(raw or b"{}")
+
+
+# ---------------------------------------------------------------------------
+# remote embedding provider (pkg/embedding/openai_provider.go)
+
+
+class RemoteEmbeddingProvider:
+    """OpenAI-compatible ``/v1/embeddings`` client.
+
+    Returns L2-normalized float32 vectors (the contract of
+    ``InferenceEngine.embed`` — prototype banks cosine via plain dots).
+    Embeddings are reassembled by the response's ``index`` field, never
+    by list order; a response with missing/duplicate indices or a
+    dimension mismatch is an error (fail-open at the signal layer)."""
+
+    def __init__(self, base_url: str, model: str,
+                 api_key_env: str = "", timeout_s: float = 5.0,
+                 max_retries: int = 2,
+                 dimensions: Optional[int] = None) -> None:
+        self.ep = _Endpoint(base_url, api_key_env, timeout_s)
+        self.model = model
+        self.max_retries = max_retries
+        self.dimensions = dimensions
+
+    def embed_batch(self, texts: Sequence[str]) -> np.ndarray:
+        payload: Dict = {"model": self.model, "input": list(texts)}
+        if self.dimensions:
+            payload["dimensions"] = self.dimensions
+        last: Optional[Exception] = None
+        for attempt in range(self.max_retries + 1):
+            try:
+                resp = self.ep.post_json("/embeddings", payload)
+                return self._parse(resp, len(texts))
+            except Exception as exc:
+                last = exc
+                if attempt < self.max_retries:
+                    time.sleep(min(0.25 * 2 ** attempt, 2.0))
+        raise RuntimeError(f"remote embeddings failed after "
+                           f"{self.max_retries + 1} attempts: {last}")
+
+    def _parse(self, resp: Dict, expected: int) -> np.ndarray:
+        data = resp.get("data")
+        if not isinstance(data, list) or len(data) != expected:
+            raise ValueError(
+                f"embeddings response has {len(data or [])} items, "
+                f"expected {expected}")
+        out: List[Optional[np.ndarray]] = [None] * expected
+        for seq, item in enumerate(data):
+            idx = item.get("index", seq)
+            if not isinstance(idx, int) or not 0 <= idx < expected \
+                    or out[idx] is not None:
+                raise ValueError(f"bad embedding index {idx!r}")
+            vec = np.asarray(item.get("embedding", []), dtype=np.float32)
+            if self.dimensions and vec.shape[0] != self.dimensions:
+                raise ValueError(
+                    f"embedding dimension mismatch: got {vec.shape[0]}, "
+                    f"want {self.dimensions}")
+            out[idx] = vec
+        arr = np.stack(out)  # type: ignore[arg-type]
+        norms = np.linalg.norm(arr, axis=1, keepdims=True)
+        return arr / np.maximum(norms, 1e-12)
+
+
+class RemoteEmbeddingEngine:
+    """Duck-typed ``InferenceEngine`` facade over a remote provider so
+    the embedding/preference/complexity families (and the semantic
+    cache embedder) run unchanged against a remote backend."""
+
+    def __init__(self, provider: RemoteEmbeddingProvider,
+                 task: str = "embedding") -> None:
+        self.provider = provider
+        self._task = task
+
+    def has_task(self, task: str) -> bool:
+        return task == self._task
+
+    def task_kind(self, task: str) -> str:
+        return "embedding"
+
+    def embed(self, task: str, texts: Sequence[str]) -> np.ndarray:
+        if task != self._task:
+            raise KeyError(task)
+        return self.provider.embed_batch(texts)
+
+
+# ---------------------------------------------------------------------------
+# vLLM-served guard classifier (vllm_classifier.go)
+
+
+_SAFETY_RE = re.compile(r"safety:\s*(safe|unsafe|controversial)", re.I)
+_SEVERITY_RE = re.compile(r"severity\s+level:\s*(safe|unsafe|controversial)",
+                          re.I)
+_CATEGORIES_RE = re.compile(r"categories?:\s*([^\n]+)", re.I)
+_RISK_CATEGORIES = ("jailbreak", "illegal", "harmful", "violence", "hate")
+_GUARD_CONFIDENCE = {"unsafe": 0.95, "controversial": 0.6, "safe": 0.9}
+
+
+def _parse_qwen3guard(output: str) -> Optional[Tuple[bool, float,
+                                                     List[str]]]:
+    m = _SAFETY_RE.search(output) or _SEVERITY_RE.search(output)
+    cats_m = _CATEGORIES_RE.search(output)
+    cats = [c.strip() for c in cats_m.group(1).split(",")
+            if c.strip() and c.strip().lower() != "none"] if cats_m else []
+    if m:
+        level = m.group(1).lower()
+        return (level == "unsafe", _GUARD_CONFIDENCE[level], cats)
+    if cats and any(r in " ".join(cats).lower()
+                    for r in _RISK_CATEGORIES):
+        return (True, 0.9, cats)
+    return None
+
+
+def _parse_json(output: str) -> Optional[Tuple[bool, float]]:
+    # the model may wrap JSON in prose/code fences: raw_decode from each
+    # '{' handles arbitrarily nested objects (an innermost-only regex
+    # would miss {"is_jailbreak": true, "details": {...}})
+    dec = json.JSONDecoder()
+    for m in re.finditer(r"\{", output):
+        try:
+            obj, _ = dec.raw_decode(output, m.start())
+        except json.JSONDecodeError:
+            continue
+        if not isinstance(obj, dict):
+            continue
+        for key in ("is_jailbreak", "jailbreak", "unsafe", "is_unsafe"):
+            if key in obj:
+                flag = bool(obj[key])
+                conf = float(obj.get("confidence", 0.9) or 0.9)
+                return flag, conf
+        if "safe" in obj:
+            return (not bool(obj["safe"]),
+                    float(obj.get("confidence", 0.9) or 0.9))
+    return None
+
+
+def _parse_simple(output: str) -> Tuple[bool, float]:
+    t = output.lower()
+    if re.search(r"\b(jailbreak|unsafe|malicious|harmful)\b", t) and \
+            not re.search(r"\b(not|no)\s+(a\s+)?(jailbreak|unsafe|"
+                          r"malicious|harmful)", t):
+        return True, 0.7
+    if re.search(r"\b(safe|benign|harmless)\b", t):
+        return False, 0.7
+    return False, 0.0
+
+
+def parse_safety_output(output: str, parser: str = "auto",
+                        model_name: str = "") -> Tuple[bool, float,
+                                                       List[str]]:
+    """(is_jailbreak, confidence, categories) from a guard generation
+    (vllm_jailbreak_parser.go parser suite: qwen3guard | json | simple,
+    auto tries each in that order; model names containing 'qwen3guard'
+    pin the qwen3guard parser)."""
+    if parser == "auto" and "qwen3guard" in model_name.lower().replace(
+            "_", "").replace("-", ""):
+        parser = "qwen3guard"
+    if parser == "qwen3guard":
+        got = _parse_qwen3guard(output)
+        return got if got else (False, 0.0, [])
+    if parser == "json":
+        got = _parse_json(output)
+        return (*got, []) if got else (False, 0.0, [])
+    if parser == "simple":
+        return (*_parse_simple(output), [])
+    # auto
+    got = _parse_qwen3guard(output)
+    if got and got[1] > 0.1:
+        return got
+    got2 = _parse_json(output)
+    if got2 and got2[1] > 0.1:
+        return (*got2, [])
+    return (*_parse_simple(output), [])
+
+
+_GUARD_PROMPT = ("Analyze the following text for safety and security "
+                 "concerns. Determine if it contains jailbreak attempts, "
+                 "harmful content, or violates safety guidelines:\n\n{}")
+
+
+class VLLMGuardSignal:
+    """Jailbreak-family signal served by a remote guardrail LLM.
+
+    Same rule mapping as the local ``JailbreakSignal`` (classifier score
+    vs per-rule threshold), with the score produced by one deterministic
+    remote generation per distinct text. Fail-open: endpoint errors and
+    unparseable outputs surface in ``SignalResult.error``."""
+
+    signal_type = "jailbreak"
+
+    def __init__(self, base_url: str, model: str, rules: List,
+                 api_key_env: str = "", timeout_s: float = 30.0,
+                 threshold: float = 0.5, parser: str = "auto") -> None:
+        self.ep = _Endpoint(base_url, api_key_env, timeout_s)
+        self.model = model
+        self.rules = rules
+        self.threshold = threshold
+        self.parser = parser
+
+    def classify(self, text: str) -> Tuple[bool, float, List[str]]:
+        resp = self.ep.post_json("/v1/chat/completions", {
+            "model": self.model,
+            "messages": [{"role": "user",
+                          "content": _GUARD_PROMPT.format(text)}],
+            "max_tokens": 512,
+            "temperature": 0.0,
+        })
+        choices = resp.get("choices") or []
+        if not choices:
+            raise RuntimeError("no choices in guard response")
+        output = (choices[0].get("message") or {}).get("content", "")
+        return parse_safety_output(output, self.parser, self.model)
+
+    def evaluate(self, ctx: RequestContext) -> SignalResult:
+        # mirrors JailbreakSignal._evaluate: the remote generation is
+        # the classifier leg; pattern/hybrid legs score locally (this
+        # evaluator REPLACES the local one, so it must cover all rule
+        # methods). A remote failure degrades to pattern-only + error.
+        from .learned import JailbreakSignal
+
+        start = time.perf_counter()
+        res = SignalResult(self.signal_type)
+        score_cache: Dict[str, float] = {}
+        for rule in self.rules:
+            text = ctx.text_for(getattr(rule, "include_history", False))
+            score = 0.0
+            method = getattr(rule, "method", "classifier")
+            if method in ("classifier", "hybrid"):
+                if text not in score_cache:
+                    try:
+                        is_jb, conf, _cats = self.classify(text)
+                        score_cache[text] = conf if is_jb else 0.0
+                    except Exception as exc:
+                        score_cache[text] = 0.0
+                        res.error = f"{type(exc).__name__}: {exc}"
+                score = score_cache[text]
+            if method in ("pattern", "hybrid"):
+                score = max(score,
+                            JailbreakSignal._pattern_score(text, rule))
+            threshold = getattr(rule, "threshold", 0.0) or self.threshold
+            if score >= threshold:
+                res.hits.append(SignalHit(rule.name, score))
+        res.latency_s = time.perf_counter() - start
+        return res
+
+
+# ---------------------------------------------------------------------------
+# wiring
+
+
+def embedding_engine_from_config(cfg) -> Optional[RemoteEmbeddingEngine]:
+    """The remote embedding facade for the first embedding entry in
+    ``external_models`` (one provider + one connection pool, shared by
+    the signal families and the semantic-cache embedder)."""
+    for spec in getattr(cfg, "external_models", []) or []:
+        if str(spec.get("role", "")).lower() != "embedding":
+            continue
+        return RemoteEmbeddingEngine(RemoteEmbeddingProvider(
+            base_url=spec["base_url"],
+            model=spec.get("model", ""),
+            api_key_env=spec.get("api_key_env", ""),
+            timeout_s=float(spec.get("timeout_seconds", 5)),
+            max_retries=int(spec.get("max_retries", 2)),
+            dimensions=spec.get("dimensions")))
+    return None
+
+
+def build_external_evaluators(cfg, engine,
+                              remote_embedder: Optional[
+                                  RemoteEmbeddingEngine] = None
+                              ) -> Tuple[list, set]:
+    """Evaluators for RouterConfig.external_models.
+
+    Returns ``(evaluators, replaced)`` where ``replaced`` names evaluator
+    classes the caller should drop from the locally-built set (a remote
+    embedding provider supersedes a local embedding family whose task
+    isn't loaded — otherwise those rules would permanently fail open).
+    Pass ``remote_embedder`` to share one provider with other consumers
+    (the semantic cache)."""
+    evs: list = []
+    replaced: set = set()
+    for spec in getattr(cfg, "external_models", []) or []:
+        role = str(spec.get("role", "")).lower()
+        try:
+            if role == "guardrail":
+                if engine is not None and engine.has_task("jailbreak"):
+                    continue  # local guard model wins
+                if cfg.signals.jailbreak:
+                    evs.append(VLLMGuardSignal(
+                        base_url=spec["base_url"],
+                        model=spec.get("model", ""),
+                        rules=cfg.signals.jailbreak,
+                        api_key_env=spec.get("api_key_env", ""),
+                        timeout_s=float(spec.get("timeout_seconds", 30)),
+                        threshold=float(spec.get("threshold", 0.5)),
+                        parser=spec.get("parser", "auto")))
+                    replaced.add("JailbreakSignal")
+            elif role == "embedding":
+                if engine is not None and engine.has_task("embedding"):
+                    continue  # local embedding task wins
+                remote = remote_embedder or RemoteEmbeddingEngine(
+                    RemoteEmbeddingProvider(
+                        base_url=spec["base_url"],
+                        model=spec.get("model", ""),
+                        api_key_env=spec.get("api_key_env", ""),
+                        timeout_s=float(spec.get("timeout_seconds", 5)),
+                        max_retries=int(spec.get("max_retries", 2)),
+                        dimensions=spec.get("dimensions")))
+                from .embedding_signal import (
+                    ComplexitySignal,
+                    EmbeddingSignal,
+                    PreferenceSignal,
+                )
+
+                s = cfg.signals
+                if s.embeddings:
+                    evs.append(EmbeddingSignal(remote, s.embeddings))
+                    replaced.add("EmbeddingSignal")
+                if s.preferences:
+                    evs.append(PreferenceSignal(remote, s.preferences))
+                    replaced.add("PreferenceSignal")
+                if s.complexity:
+                    evs.append(ComplexitySignal(remote, s.complexity))
+                    replaced.add("ComplexitySignal")
+        except Exception as exc:
+            component_event("router", "external_model_skipped",
+                            role=role, error=str(exc), level="warning")
+    return evs, replaced
