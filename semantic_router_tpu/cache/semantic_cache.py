@@ -262,5 +262,31 @@ def build_cache(cfg, embed_fn: Callable[[str], np.ndarray]) -> Optional[CacheBac
             similarity_threshold=cfg.similarity_threshold,
             ttl_seconds=cfg.ttl_seconds,
         )
+    if cfg.backend_type == "qdrant":
+        from .ann_cache import QdrantSemanticCache
+
+        bc = cfg.backend_config or {}
+        return QdrantSemanticCache(
+            embed_fn,
+            base_url=bc.get("base_url", "http://127.0.0.1:6333"),
+            api_key=str(bc.get("api_key", "")),
+            collection=bc.get("collection", "vsr_cache"),
+            similarity_threshold=cfg.similarity_threshold,
+            ttl_seconds=cfg.ttl_seconds,
+        )
+    if cfg.backend_type == "milvus":
+        from .ann_cache import MilvusSemanticCache
+
+        bc = cfg.backend_config or {}
+        return MilvusSemanticCache(
+            embed_fn,
+            base_url=bc.get("base_url", "http://127.0.0.1:19530"),
+            token=str(bc.get("token", "")),
+            db_name=bc.get("db_name", "default"),
+            collection=bc.get("collection", "vsr_cache"),
+            similarity_threshold=cfg.similarity_threshold,
+            ttl_seconds=cfg.ttl_seconds,
+        )
     raise ValueError(f"unsupported cache backend {cfg.backend_type!r} "
-                     f"(backends: memory|hnsw|hybrid|redis|valkey)")
+                     f"(backends: memory|hnsw|hybrid|redis|valkey|"
+                     f"qdrant|milvus)")

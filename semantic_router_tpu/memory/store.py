@@ -125,6 +125,18 @@ class MemoryExtractor:
 _WORD = re.compile(r"\w+", re.UNICODE)
 
 
+def keyword_score(query: str, text: str) -> float:
+    """Hybrid keyword leg shared by every backend: 0.3 + 0.7 * Jaccard
+    over word tokens when any overlap exists, else 0 — one formula so
+    rankings can't drift between in-proc and external stores."""
+    q = set(w.lower() for w in _WORD.findall(query))
+    t = set(w.lower() for w in _WORD.findall(text))
+    if not q or not t:
+        return 0.0
+    overlap = len(q & t) / len(q | t)
+    return 0.3 + 0.7 * overlap if overlap > 0 else 0.0
+
+
 class InMemoryMemoryStore:
     """Embedding + keyword hybrid store."""
 
@@ -182,13 +194,9 @@ class InMemoryMemoryStore:
                 if item.embedding is not None:
                     scores[i] = float(item.embedding @ q)
         if hybrid or self.embed_fn is None:
-            q_words = set(w.lower() for w in _WORD.findall(query))
             for i, item in enumerate(items):
-                words = set(w.lower() for w in _WORD.findall(item.text))
-                if q_words and words:
-                    overlap = len(q_words & words) / len(q_words | words)
-                    scores[i] = max(scores[i], 0.3 + 0.7 * overlap) \
-                        if overlap > 0 else scores[i]
+                scores[i] = max(scores[i],
+                                keyword_score(query, item.text))
         order = np.argsort(-scores)
         out = []
         for i in order[:limit]:

@@ -219,12 +219,47 @@ def build_router(cfg: RouterConfig, engine=None,
             router.replay_store = carry_from.replay_store
         return router
 
-    # memory backend (pkg/memory external stores role)
+    # memory backend (pkg/memory external stores role; the reference's
+    # default memory store is Milvus — milvus_store*.go)
     mem_cfg = cfg.memory or {}
-    if mem_cfg.get("backend") == "sqlite" and mem_cfg.get("path"):
+    backend = mem_cfg.get("backend", "")
+    if backend == "sqlite" and mem_cfg.get("path"):
         from ..memory.sqlite_store import SQLiteMemoryStore
 
         router.memory_store = SQLiteMemoryStore(mem_cfg["path"], embed_fn)
+    elif backend in ("qdrant", "milvus"):
+        mem_embed = embed_fn
+        if mem_embed is None:
+            # ANN stores need vectors; the remote embedding provider
+            # (external_models) covers engines without a local task
+            remote = getattr(router, "_remote_embedder_cache", None)
+            if remote is not None:
+                mem_embed = lambda text: remote.embed("embedding",
+                                                      [text])[0]
+        if mem_embed is None:
+            component_event("bootstrap", "memory_backend_fallback",
+                            backend=backend, level="warning",
+                            reason="no embedding source; using in-proc")
+            router.memory_store = InMemoryMemoryStore(embed_fn)
+        elif backend == "qdrant":
+            from ..memory.ann_store import QdrantMemoryStore
+
+            router.memory_store = QdrantMemoryStore(
+                mem_embed,
+                base_url=mem_cfg.get("base_url",
+                                     "http://127.0.0.1:6333"),
+                api_key=str(mem_cfg.get("api_key", "")),
+                collection=mem_cfg.get("collection", "vsr_memory"))
+        else:
+            from ..memory.ann_store import MilvusMemoryStore
+
+            router.memory_store = MilvusMemoryStore(
+                mem_embed,
+                base_url=mem_cfg.get("base_url",
+                                     "http://127.0.0.1:19530"),
+                token=str(mem_cfg.get("token", "")),
+                db_name=mem_cfg.get("db_name", "default"),
+                collection=mem_cfg.get("collection", "vsr_memory"))
     else:
         router.memory_store = InMemoryMemoryStore(embed_fn)
 

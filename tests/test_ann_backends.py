@@ -1,0 +1,230 @@
+"""External ANN backends for the semantic cache and memory store
+(cache/ann_cache.py, memory/ann_store.py; reference pkg/cache/
+{qdrant,milvus}_cache.go and pkg/memory/milvus_store*.go), driven
+against the embedded MiniQdrant/MiniMilvus wire servers."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from semantic_router_tpu.cache.ann_cache import (
+    MilvusSemanticCache,
+    QdrantSemanticCache,
+)
+from semantic_router_tpu.memory.ann_store import (
+    MilvusMemoryStore,
+    QdrantMemoryStore,
+)
+from semantic_router_tpu.memory.store import MemoryItem
+from semantic_router_tpu.state.milvus import MiniMilvus
+from semantic_router_tpu.state.qdrant import MiniQdrant
+
+
+def embed(text: str, dim: int = 16) -> np.ndarray:
+    h = hashlib.sha256(text.encode()).digest()
+    v = np.frombuffer((h * 3)[:dim * 4], dtype=np.uint32).astype(
+        np.float32)
+    v = v - v.mean()  # zero-mean: unrelated texts cosine near 0
+    return v / np.linalg.norm(v)
+
+
+@pytest.fixture()
+def qdrant():
+    s = MiniQdrant()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def milvus():
+    s = MiniMilvus()
+    yield s
+    s.stop()
+
+
+def _cache_roundtrip(make_cache):
+    c = make_cache()
+    c.add("what is the capital of France", "Paris", model="m1",
+          category="geo")
+    # exact hit
+    hit = c.find_similar("what is the capital of France")
+    assert hit is not None and hit.response == "Paris"
+    assert c.stats().exact_hits == 1
+    # similarity hit: identical embedding via same text, different call
+    hit2 = c.find_similar("what is the capital of France",
+                          threshold=0.99)
+    assert hit2 is not None
+    # miss
+    assert c.find_similar("completely unrelated query xyz",
+                          threshold=0.99) is None
+    # invalidate
+    c.invalidate("what is the capital of France")
+    assert c.find_similar("what is the capital of France",
+                          threshold=0.99) is None
+    # restart durability: a NEW backend instance over the same server
+    c.add("durable question", "durable answer")
+    c2 = make_cache()
+    hit3 = c2.find_similar("durable question")
+    assert hit3 is not None and hit3.response == "durable answer"
+
+
+class TestQdrantCache:
+    def test_roundtrip(self, qdrant):
+        _cache_roundtrip(lambda: QdrantSemanticCache(
+            embed, base_url=qdrant.url,
+            similarity_threshold=0.8))
+
+    def test_ttl_expiry(self, qdrant):
+        c = QdrantSemanticCache(
+            embed, base_url=qdrant.url,
+            ttl_seconds=0.0001)
+        c.add("old query", "old answer")
+        import time
+
+        time.sleep(0.01)
+        assert c.find_similar("old query") is None
+
+    def test_fail_open_when_down(self):
+        c = QdrantSemanticCache(embed, base_url="http://127.0.0.1:9",
+                                timeout_s=0.5)
+        c.add("q", "r")  # swallowed
+        assert c.find_similar("q") is None
+        assert c.stats().errors >= 1
+
+
+class TestMilvusCache:
+    def test_roundtrip(self, milvus):
+        _cache_roundtrip(lambda: MilvusSemanticCache(
+            embed, base_url=milvus.url,
+            similarity_threshold=0.8))
+
+    def test_fail_open_when_down(self):
+        c = MilvusSemanticCache(embed, base_url="http://127.0.0.1:9",
+                                timeout_s=0.5)
+        c.add("q", "r")
+        assert c.find_similar("q") is None
+        assert c.stats().errors >= 1
+
+
+def _memory_roundtrip(make_store):
+    s = make_store()
+    item = s.remember("alice", "my email is bob@example.com and I "
+                               "work at Initech")
+    assert "<EMAIL>" in s.find_by_id(item.id).text  # sanitized
+    s.remember("alice", "prefers tabs over spaces")
+    s.remember("carol", "lives in Lyon")
+    # user scoping
+    assert len(s.list("alice")) == 2
+    assert len(s.list("carol")) == 1
+    # search finds the right memory
+    hits = s.search("alice", "tabs or spaces preference", limit=3)
+    assert hits and "tabs" in hits[0].text
+    # dedup: near-duplicate refreshes, not inserts
+    s.remember("alice", "prefers tabs over spaces")
+    assert len(s.list("alice")) == 2
+    # delete
+    assert s.delete("alice", item.id) is True
+    assert s.find_by_id(item.id) is None
+    assert s.delete("alice", "nonexistent") is False
+    # restart durability
+    s2 = make_store()
+    assert len(s2.list("alice")) == 1
+
+
+class TestQdrantMemory:
+    def test_roundtrip(self, qdrant):
+        _memory_roundtrip(lambda: QdrantMemoryStore(
+            embed, base_url=qdrant.url))
+
+    def test_auto_store(self, qdrant):
+        s = QdrantMemoryStore(
+            embed, base_url=qdrant.url)
+        n = s.auto_store("dave", [
+            {"role": "user", "content": "my name is Dave and I live in "
+                                        "Lisbon"},
+            {"role": "assistant", "content": "Hi Dave!"}])
+        assert n >= 1
+        assert any("Lisbon" in i.text for i in s.list("dave"))
+
+
+class TestMilvusMemory:
+    def test_roundtrip(self, milvus):
+        _memory_roundtrip(lambda: MilvusMemoryStore(
+            embed, base_url=milvus.url))
+
+
+class TestParitySemantics:
+    """Backend-swap parity: semantics that must match the in-proc
+    store (review findings r3)."""
+
+    def test_cross_user_delete_rejected(self, qdrant):
+        s = QdrantMemoryStore(embed, base_url=qdrant.url)
+        item = s.remember("alice", "private fact about alice")
+        assert s.delete("mallory", item.id) is False
+        assert s.find_by_id(item.id) is not None
+        assert s.delete("alice", item.id) is True
+
+    def test_metadata_round_trip(self, qdrant, milvus):
+        for store in (QdrantMemoryStore(embed, base_url=qdrant.url),
+                      MilvusMemoryStore(embed, base_url=milvus.url)):
+            item = store.remember("u", "fact with provenance",
+                                  source="crm", priority="high")
+            got = store.find_by_id(item.id)
+            assert got.metadata == {"source": "crm",
+                                    "priority": "high"}
+
+    def test_consolidation_refreshes_access_stats(self, qdrant):
+        s = QdrantMemoryStore(embed, base_url=qdrant.url)
+        s.remember("u", "prefers dark mode")
+        before = s.list("u")[0]
+        s.remember("u", "prefers dark mode")  # near-duplicate
+        after = s.list("u")
+        assert len(after) == 1
+        assert after[0].access_count == before.access_count + 1
+
+    def test_exact_hit_category_scoped(self, qdrant):
+        c = QdrantSemanticCache(embed, base_url=qdrant.url)
+        c.add("integrate x squared", "x^3/3", category="math")
+        assert c.find_similar("integrate x squared",
+                              category="code", threshold=1.01) is None
+        assert c.find_similar("integrate x squared",
+                              category="math") is not None
+        # uncategorized lookup still matches (in-proc semantics)
+        assert c.find_similar("integrate x squared") is not None
+
+
+class TestFactoryWiring:
+    def test_cache_factory_builds_ann_backends(self, qdrant, milvus):
+        from semantic_router_tpu.cache.semantic_cache import build_cache
+        from semantic_router_tpu.config.schema import SemanticCacheConfig
+
+        q = build_cache(SemanticCacheConfig.from_dict({
+            "enabled": True, "backend_type": "qdrant",
+            "backend_config": {
+                "base_url": qdrant.url}}), embed)
+        assert isinstance(q, QdrantSemanticCache)
+        m = build_cache(SemanticCacheConfig.from_dict({
+            "enabled": True, "backend_type": "milvus",
+            "backend_config": {
+                "base_url": milvus.url}}), embed)
+        assert isinstance(m, MilvusSemanticCache)
+
+    def test_memory_factory_builds_ann_store(self, qdrant,
+                                             fixture_config_path):
+        from semantic_router_tpu.config import load_config
+        from semantic_router_tpu.engine.testing import (
+            make_embedding_engine,
+        )
+        from semantic_router_tpu.runtime.bootstrap import build_router
+
+        cfg = load_config(fixture_config_path)
+        cfg.memory = {"backend": "qdrant",
+                      "base_url": qdrant.url}
+        engine = make_embedding_engine()
+        router = build_router(cfg, engine)
+        assert isinstance(router.memory_store, QdrantMemoryStore)
+        router.memory_store.remember("u1", "likes espresso")
+        assert router.memory_store.search("u1", "espresso coffee")
+        router.shutdown()
+        engine.shutdown()
