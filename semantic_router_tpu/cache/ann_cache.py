@@ -69,6 +69,18 @@ class _AnnCacheBase:
     def stats(self) -> CacheStats:
         return self._stats
 
+    @staticmethod
+    def _entry(payload: Dict, emb=None) -> CacheEntry:
+        return CacheEntry(
+            request_id=0,
+            query=payload.get("query", ""),
+            response=payload.get("response", ""),
+            model=payload.get("model", ""),
+            category=payload.get("category", ""),
+            embedding=emb,
+            created_t=float(payload.get("created_t", 0.0)),
+            hit_count=1)
+
     # template methods -------------------------------------------------
 
     def _ensure(self, dim: int) -> None:
@@ -153,23 +165,15 @@ class QdrantSemanticCache(_AnnCacheBase):
                         "category": category,
                         "created_t": time.time()}}])
 
-    @staticmethod
-    def _entry(payload: Dict, emb=None) -> CacheEntry:
-        return CacheEntry(
-            request_id=0,
-            query=payload.get("query", ""),
-            response=payload.get("response", ""),
-            model=payload.get("model", ""),
-            category=payload.get("category", ""),
-            embedding=emb,
-            created_t=float(payload.get("created_t", 0.0)),
-            hit_count=1)
-
     def _exact_lookup(self, qh: str) -> Optional[CacheEntry]:
         from ..state.qdrant import match_filter
 
-        if not self.client.collection_exists(self.collection):
-            return None
+        # one existence probe, then remembered — the exact path runs on
+        # every routed request and must not pay an extra round trip
+        if not self._ready:
+            if not self.client.collection_exists(self.collection):
+                return None
+            self._ready = True
         pts = self.client.scroll(self.collection, limit=1,
                                  query_filter=match_filter("query_hash",
                                                            qh))
@@ -180,7 +184,13 @@ class QdrantSemanticCache(_AnnCacheBase):
     def _search(self, emb, threshold, category, limit=5):
         from ..state.qdrant import match_filter
 
-        flt = match_filter("category", category) if category else None
+        # in-memory semantics: an entry is excluded only when BOTH
+        # sides carry a category and they differ — uncategorized
+        # entries match any categorized lookup
+        from ..state.qdrant import any_of_filter
+
+        flt = any_of_filter("category", [category, ""]) \
+            if category else None
         hits = self.client.search(self.collection, emb, limit=limit,
                                   score_threshold=threshold,
                                   query_filter=flt)
@@ -243,23 +253,13 @@ class MilvusSemanticCache(_AnnCacheBase):
             "model": model, "category": category,
             "created_t": time.time()}])
 
-    @staticmethod
-    def _entry(row: Dict, emb=None) -> CacheEntry:
-        return CacheEntry(
-            request_id=0,
-            query=row.get("query", ""),
-            response=row.get("response", ""),
-            model=row.get("model", ""),
-            category=row.get("category", ""),
-            embedding=emb,
-            created_t=float(row.get("created_t", 0.0)),
-            hit_count=1)
-
     def _exact_lookup(self, qh: str) -> Optional[CacheEntry]:
         from ..state.milvus import escape_filter_value
 
-        if not self.client.has_collection(self.collection):
-            return None
+        if not self._ready:
+            if not self.client.has_collection(self.collection):
+                return None
+            self._ready = True
         rows = self.client.query(
             self.collection,
             flt=f'query_hash == "{escape_filter_value(qh)}"', limit=1)
@@ -268,8 +268,8 @@ class MilvusSemanticCache(_AnnCacheBase):
     def _search(self, emb, threshold, category, limit=5):
         from ..state.milvus import escape_filter_value
 
-        flt = f'category == "{escape_filter_value(category)}"' \
-            if category else ""
+        flt = (f'category == "{escape_filter_value(category)}" '
+               f'or category == ""') if category else ""
         hits = self.client.search(self.collection, emb, limit=limit,
                                   flt=flt)
         out = []

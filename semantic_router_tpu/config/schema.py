@@ -1011,6 +1011,10 @@ class RouterConfig:
     # (pkg/classification/vllm_classifier.go) and remote OpenAI-compatible
     # embedding provider (pkg/embedding)
     external_models: List[Dict[str, Any]] = field(default_factory=list)
+    # router learning (pkg/extproc/router_learning*.go): {enabled,
+    # store: {backend, ...}, adaptation: {mode, candidate_set},
+    # protection: {scope, identity.headers, tuning}}
+    learning: Dict[str, Any] = field(default_factory=dict)
     raw: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
@@ -1045,6 +1049,8 @@ class RouterConfig:
                              or []],
             mcp=dict(d.get("mcp", {}) or {}),
             external_models=list(d.get("external_models", []) or []),
+            learning=dict(routing.get("learning",
+                                      d.get("learning", {})) or {}),
             raw=d,
         )
 

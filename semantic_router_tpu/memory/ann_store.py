@@ -73,7 +73,11 @@ class _AnnMemoryBase:
             existing = near[0][0]
             existing.last_access_t = time.time()
             existing.access_count += 1
-            self._replace(existing, self._embed(existing.text))
+            # the stored vector (or the near-identical new one) — never
+            # a fresh embedding forward pass just to rewrite stats
+            vec = existing.embedding if existing.embedding is not None \
+                else emb
+            self._replace(existing, np.asarray(vec, np.float32))
             return
         self._upsert(item, emb)
 
@@ -103,8 +107,17 @@ class _AnnMemoryBase:
                     scored[item.id] = (item, max(
                         ks, prev[1] if prev else 0.0))
         ranked = sorted(scored.values(), key=lambda t: -t[1])
-        return [item for item, score in ranked[:limit]
-                if score >= threshold]
+        out = [item for item, score in ranked[:limit]
+               if score >= threshold]
+        now = time.time()
+        for item in out:
+            item.last_access_t = now
+            item.access_count += 1
+        try:
+            self._touch(out)
+        except Exception:
+            pass  # stats write-back is best-effort
+        return out
 
     def list(self, user_id: str) -> List[MemoryItem]:
         return self._list_user(user_id, max_rows=10_000)
@@ -163,9 +176,15 @@ class QdrantMemoryStore(_AnnMemoryBase):
             return []
         hits = self.client.search(
             self.collection, emb, limit=limit,
-            query_filter=match_filter("user_id", user_id))
-        return [(self._item(h.get("payload", {})),
-                 float(h.get("score", 0.0))) for h in hits]
+            query_filter=match_filter("user_id", user_id),
+            with_vectors=True)
+        out = []
+        for h in hits:
+            item = self._item(h.get("payload", {}))
+            if h.get("vector") is not None:
+                item.embedding = np.asarray(h["vector"], np.float32)
+            out.append((item, float(h.get("score", 0.0))))
+        return out
 
     def _list_user(self, user_id: str,
                    max_rows: int) -> List[MemoryItem]:
@@ -178,6 +197,14 @@ class QdrantMemoryStore(_AnnMemoryBase):
                                                            user_id),
                                  max_total=max_rows)
         return [self._item(p.get("payload", {})) for p in pts]
+
+    def _touch(self, items) -> None:
+        for item in items:
+            self.client.set_payload(
+                self.collection,
+                {"last_access_t": item.last_access_t,
+                 "access_count": item.access_count},
+                [str(uuid.uuid5(uuid.NAMESPACE_OID, item.id))])
 
     def delete(self, user_id: str, memory_id: str) -> bool:
         from ..state.qdrant import match_filter
@@ -253,10 +280,16 @@ class MilvusMemoryStore(_AnnMemoryBase):
             return []
         hits = self.client.search(
             self.collection, emb, limit=limit,
-            flt=f'user_id == "{escape_filter_value(user_id)}"')
-        return [(self._item(h),
-                 float(h.get("distance", h.get("score", 0.0))))
-                for h in hits]
+            flt=f'user_id == "{escape_filter_value(user_id)}"',
+            output_fields=["*", "vector"])
+        out = []
+        for h in hits:
+            item = self._item(h)
+            if h.get("vector") is not None:
+                item.embedding = np.asarray(h["vector"], np.float32)
+            out.append((item,
+                        float(h.get("distance", h.get("score", 0.0)))))
+        return out
 
     def _list_user(self, user_id: str,
                    max_rows: int) -> List[MemoryItem]:
@@ -269,6 +302,11 @@ class MilvusMemoryStore(_AnnMemoryBase):
             flt=f'user_id == "{escape_filter_value(user_id)}"',
             limit=min(max_rows, self.client.MAX_QUERY_LIMIT))
         return [self._item(r) for r in rows]
+
+    def _touch(self, items) -> None:
+        for item in items:
+            if item.embedding is not None:
+                self._replace(item, item.embedding)
 
     def delete(self, user_id: str, memory_id: str) -> bool:
         from ..state.milvus import escape_filter_value

@@ -189,6 +189,22 @@ class Router:
             self.cache = None
 
         self.model_cards = {m.name: m for m in cfg.model_cards}
+
+        # router learning (pkg/extproc/router_learning*.go): outcome-
+        # driven adaptation over the decision's candidates + session
+        # protection; disabled unless configured
+        self.learning = None
+        if (cfg.learning or {}).get("enabled"):
+            from ..learning import RouterLearning
+
+            self.learning = RouterLearning(
+                cfg.learning,
+                model_costs={m.name: float(
+                    (m.pricing or {}).get("prompt", 0.0))
+                    for m in cfg.model_cards},
+                quality_seeds={m.name: m.quality_score
+                               for m in cfg.model_cards
+                               if m.quality_score > 0})
         # operator-configured tools database for auto-selection; its
         # description embeddings are static config → computed once on
         # first use, not per request
@@ -297,6 +313,23 @@ class Router:
 
         # -- selection --------------------------------------------------
         ref, reason = self._select_model(decision, ctx, signals)
+        if self.learning is not None and decision.model_refs:
+            # outcome-driven adaptation may propose a different
+            # candidate (applyRouterLearning role); unknown proposals
+            # never escape the decision's own candidate set
+            adaptations = dict(
+                (decision.extra or {}).get("adaptations", {}) or {})
+            learned = self.learning.apply(
+                decision.name,
+                [r.model for r in decision.model_refs],
+                ref.model, headers=ctx.headers, tier=decision.tier,
+                mode=adaptations.get("mode"))
+            if learned != ref.model:
+                new_ref = next((r for r in decision.model_refs
+                                if r.model == learned), None)
+                if new_ref is not None:
+                    ref = new_ref
+                    reason = f"{reason} → learning:{learned}"
         result.model = ref.model
         result.selection_reason = reason
 
@@ -745,11 +778,19 @@ class Router:
 
     def record_feedback(self, route: RouteResult, success: bool = True,
                         quality: float = 0.0, latency_ms: float = 0.0,
-                        ttft_ms: float = 0.0) -> None:
-        """Feed outcome back to the decision's selector (router learning
-        outcome loop, router_learning_outcome.go role)."""
+                        ttft_ms: float = 0.0, verdict: str = "") -> None:
+        """Feed outcome back to the decision's selector AND the learning
+        experience ledgers (router_learning_outcome.go role). ``verdict``
+        is one of good_fit/underpowered/overprovisioned/failed; empty
+        derives from ``success``."""
         if route.decision is None:
             return
+        if self.learning is not None:
+            self.learning.record_outcome(
+                route.decision.decision.name, route.model,
+                verdict=verdict, success=success,
+                latency_ms=latency_ms,
+                tier=route.decision.decision.tier)
         selector = self._selectors.get(route.decision.decision.name)
         if selector is None:
             return
@@ -780,3 +821,5 @@ class Router:
 
     def shutdown(self) -> None:
         self.dispatcher.shutdown()
+        if self.learning is not None:
+            self.learning.close()

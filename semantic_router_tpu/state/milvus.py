@@ -225,9 +225,7 @@ class MiniMilvus:
         self._collections: Dict[str, Dict] = {}
         self._lock = threading.Lock()
 
-        def eval_filter(flt: str, row: Dict) -> bool:
-            if not flt:
-                return True
+        def eval_one(flt: str, row: Dict) -> bool:
             m = re.match(r'\s*(\w+)\s*(==|!=)\s*"((?:[^"\\]|\\.)*)"\s*$',
                          flt)
             if not m:
@@ -238,6 +236,14 @@ class MiniMilvus:
             value = value.replace('\\"', '"').replace("\\\\", "\\")
             got = str(row.get(field, ""))
             return (got == value) if op == "==" else (got != value)
+
+        def eval_filter(flt: str, row: Dict) -> bool:
+            if not flt:
+                return True
+            # top-level OR of equality clauses (the subset the backends
+            # emit, e.g. category == "x" or category == "")
+            return any(eval_one(part, row)
+                       for part in re.split(r"\s+or\s+", flt))
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):
@@ -289,8 +295,12 @@ class MiniMilvus:
                                 continue
                             v = np.asarray(row["vector"], np.float32)
                             s = float((v / (np.linalg.norm(v) or 1.0)) @ qn)
+                            # real Milvus returns the vector only when
+                            # explicitly named in outputFields
+                            want_vec = "vector" in (
+                                body.get("outputFields") or [])
                             out_row = {k: val for k, val in row.items()
-                                       if k != "vector"}
+                                       if k != "vector" or want_vec}
                             out_row["distance"] = s
                             scored.append((s, out_row))
                         scored.sort(key=lambda t: -t[0])

@@ -86,9 +86,12 @@ class QdrantClient:
 
     def search(self, collection: str, vector: Sequence[float],
                limit: int = 5, score_threshold: float = 0.0,
-               query_filter: Optional[Dict] = None) -> List[Dict]:
+               query_filter: Optional[Dict] = None,
+               with_vectors: bool = False) -> List[Dict]:
         body: Dict[str, Any] = {"vector": list(map(float, vector)),
                                 "limit": limit, "with_payload": True}
+        if with_vectors:
+            body["with_vector"] = True
         if score_threshold:
             body["score_threshold"] = score_threshold
         if query_filter:
@@ -97,6 +100,14 @@ class QdrantClient:
                             f"/collections/{collection}/points/search",
                             body)
         return out.get("result", [])
+
+    def set_payload(self, collection: str, payload: Dict,
+                    ids: List) -> None:
+        """Merge payload fields into existing points (no vector write —
+        the cheap access-stat update path)."""
+        self._request("POST",
+                      f"/collections/{collection}/points/payload",
+                      {"payload": payload, "points": ids})
 
     def delete_points(self, collection: str,
                       ids: Optional[List] = None,
@@ -131,6 +142,13 @@ class QdrantClient:
             if offset is None or not page:
                 break
         return points
+
+
+def any_of_filter(field: str, values) -> Dict:
+    """Filter matching any of ``values`` for ``field`` (Qdrant
+    ``should`` clause — used for 'this category OR uncategorized')."""
+    return {"should": [{"key": field, "match": {"value": v}}
+                       for v in values]}
 
 
 def match_filter(field: str, value) -> Dict:
@@ -209,6 +227,12 @@ class MiniQdrant:
                     want = (cond.get("match") or {}).get("value")
                     if payload.get(key) != want:
                         return False
+                should = (qfilter or {}).get("should", [])
+                if should:
+                    if not any(payload.get(c.get("key"))
+                               == (c.get("match") or {}).get("value")
+                               for c in should):
+                        return False
                 return True
 
             def do_POST(self):
@@ -236,10 +260,15 @@ class MiniQdrant:
                             scored.append((score, p))
                         scored.sort(key=lambda t: -t[0])
                         thresh = body.get("score_threshold", -1e9)
-                        out = [{"id": p["id"], "score": s,
-                                "payload": p.get("payload", {})}
-                               for s, p in scored[:body.get("limit", 5)]
-                               if s >= thresh]
+                        out = []
+                        for s, p in scored[:body.get("limit", 5)]:
+                            if s < thresh:
+                                continue
+                            hit = {"id": p["id"], "score": s,
+                                   "payload": p.get("payload", {})}
+                            if body.get("with_vector"):
+                                hit["vector"] = p["vector"]
+                            out.append(hit)
                         self._reply(200, out)
                     elif op == "delete":
                         ids = set(map(str, body.get("points", []) or []))
@@ -251,6 +280,16 @@ class MiniQdrant:
                         for pid in drop:
                             del col["points"][pid]
                         self._reply(200, {"deleted": len(drop)})
+                    elif op == "payload":
+                        # merge payload fields into the given points
+                        ids = set(map(str, body.get("points", []) or []))
+                        updated = 0
+                        for pid, p in col["points"].items():
+                            if pid in ids:
+                                p.setdefault("payload", {}).update(
+                                    body.get("payload", {}) or {})
+                                updated += 1
+                        self._reply(200, {"updated": updated})
                     elif op == "scroll":
                         qfilter = body.get("filter")
                         out = [{"id": p["id"],

@@ -183,6 +183,22 @@ class TestParitySemantics:
         assert len(after) == 1
         assert after[0].access_count == before.access_count + 1
 
+    def test_uncategorized_entries_match_categorized_lookup(
+            self, qdrant, milvus):
+        for c in (QdrantSemanticCache(embed, base_url=qdrant.url),
+                  MilvusSemanticCache(embed, base_url=milvus.url)):
+            c.add("plain question", "plain answer")  # no category
+            hit = c.find_similar("plain question", category="math")
+            assert hit is not None, type(c).__name__
+
+    def test_search_bumps_access_stats(self, qdrant):
+        s = QdrantMemoryStore(embed, base_url=qdrant.url)
+        s.remember("u", "enjoys cycling on weekends")
+        hits = s.search("u", "cycling weekends hobby")
+        assert hits and hits[0].access_count == 1
+        listed = s.list("u")[0]
+        assert listed.access_count == 1  # persisted, not just in-proc
+
     def test_exact_hit_category_scoped(self, qdrant):
         c = QdrantSemanticCache(embed, base_url=qdrant.url)
         c.add("integrate x squared", "x^3/3", category="math")

@@ -1,0 +1,233 @@
+"""Router learning subsystem (learning/; reference
+pkg/extproc/router_learning*.go): experience ledgers with durable
+backends, routing-sampling adaptation, session protection, and the
+pipeline e2e where repeated outcomes measurably shift routing."""
+
+import random
+
+import pytest
+
+from semantic_router_tpu.learning import (
+    ExperienceStore,
+    RouterLearning,
+    SessionProtection,
+    adapt,
+)
+
+
+class TestExperienceStore:
+    def test_fail_open_default(self):
+        s = ExperienceStore()
+        exp = s.snapshot("d", 0, "never-seen")
+        assert exp.quality_seed == 0.5 and exp.total == 0
+
+    def test_record_and_rollups(self):
+        s = ExperienceStore()
+        s.record("deci", 2, "m1", "good_fit")
+        assert s.snapshot("deci", 2, "m1").good_fit == 1
+        # decision-agnostic roll-up serves other decisions
+        assert s.snapshot("other", 2, "m1").good_fit == 1
+        assert s.snapshot("other", 0, "m1").good_fit == 1
+
+    def test_ewma_updates(self):
+        s = ExperienceStore()
+        s.record("d", 0, "m", "good_fit", latency_norm=1.0,
+                 cache_hit=True)
+        exp = s.snapshot("d", 0, "m")
+        assert 0 < exp.latency_ewma <= 0.2 + 1e-9
+        assert 0 < exp.cache_hit_ewma <= 0.2 + 1e-9
+
+    def test_sqlite_durability(self, tmp_path):
+        path = str(tmp_path / "exp.db")
+        s1 = ExperienceStore({"backend": "sqlite", "path": path})
+        for _ in range(5):
+            s1.record("d", 0, "m1", "failed")
+        s1.close()
+        s2 = ExperienceStore({"backend": "sqlite", "path": path})
+        assert s2.snapshot("d", 0, "m1").failed == 5
+        s2.close()
+
+    def test_redis_durability_across_instances(self):
+        from semantic_router_tpu.state.resp import MiniRedis
+
+        mini = MiniRedis().start()
+        try:
+            be = {"backend": "redis", "port": mini.port}
+            s1 = ExperienceStore(be)
+            s1.record("d", 0, "m1", "good_fit", count=3)
+            # a DIFFERENT replica sees the learned state (lazy hydrate)
+            s2 = ExperienceStore(be)
+            assert s2.snapshot("d", 0, "m1").good_fit == 3
+        finally:
+            mini.stop()
+
+
+class TestAdaptation:
+    def test_failed_outcomes_shift_winner(self):
+        s = ExperienceStore()
+        rng = random.Random(7)
+        # m1 keeps failing; m2 keeps succeeding
+        for _ in range(12):
+            s.record("d", 0, "m1", "failed")
+            s.record("d", 0, "m1", "underpowered")
+            s.record("d", 0, "m2", "good_fit")
+        out = adapt(s, "d", 0, ["m1", "m2"], "m1", rng=rng)
+        assert out.model == "m2" and out.action == "propose_switch"
+
+    def test_observe_mode_never_switches(self):
+        s = ExperienceStore()
+        for _ in range(12):
+            s.record("d", 0, "m1", "failed")
+            s.record("d", 0, "m2", "good_fit")
+        out = adapt(s, "d", 0, ["m1", "m2"], "m1", mode="observe",
+                    rng=random.Random(7))
+        assert out.model == "m1" and out.action == "keep_base"
+        assert out.scores  # diagnostics still computed
+
+    def test_bypass_mode(self):
+        out = adapt(ExperienceStore(), "d", 0, ["m1", "m2"], "m1",
+                    mode="bypass")
+        assert out.model == "m1" and out.action == "bypass"
+
+    def test_no_evidence_keeps_base(self):
+        # equal priors: the margin keeps the base model
+        out = adapt(ExperienceStore(), "d", 0, ["m1", "m2"], "m1",
+                    use_sampling=False)
+        assert out.model == "m1"
+
+    def test_reliability_penalty_beats_cost(self):
+        s = ExperienceStore()
+        for _ in range(10):
+            s.record("d", 0, "cheap", "failed")
+            s.record("d", 0, "pricey", "good_fit")
+        out = adapt(s, "d", 0, ["cheap", "pricey"], "cheap",
+                    costs={"cheap": 1.0, "pricey": 10.0},
+                    use_sampling=False)
+        assert out.model == "pricey"
+
+
+class TestProtection:
+    def test_warm_session_pins_model(self):
+        s = ExperienceStore()
+        for _ in range(12):
+            s.record("d", 0, "m1", "good_fit")
+            s.record("d", 0, "m2", "good_fit")
+        p = SessionProtection(min_turns_before_switch=3)
+        h = {"x-session-id": "s1", "x-conversation-id": "c1"}
+        dec = adapt(s, "d", 0, ["m1", "m2"], "m1", use_sampling=False)
+        v1 = p.apply(h, dec, "m1")
+        assert v1.action == "cold_start" and v1.final_model == "m1"
+        # a later proposal for m2 with thin evidence is pinned back
+        dec2 = adapt(s, "d", 0, ["m1", "m2"], "m2", use_sampling=False)
+        v2 = p.apply(h, dec2, "m2")
+        assert v2.final_model == "m1" and v2.action == "warm_keep"
+
+    def test_switch_allowed_with_margin_and_turns(self):
+        s = ExperienceStore()
+        for _ in range(20):
+            s.record("d", 0, "m1", "failed")
+            s.record("d", 0, "m2", "good_fit")
+        p = SessionProtection(min_turns_before_switch=2,
+                              switch_margin=0.05)
+        h = {"x-session-id": "s1", "x-conversation-id": "c1"}
+        # cold-start the session on m1 (no evidence yet -> base kept)
+        neutral = adapt(ExperienceStore(), "d", 0, ["m1", "m2"], "m1",
+                        use_sampling=False)
+        assert neutral.model == "m1"
+        p.apply(h, neutral, "m1")  # turn 1: cold start on m1
+        p.apply(h, neutral, "m1")  # turn 2
+        # now the evidence-backed proposal for m2 clears the margin
+        dec = adapt(s, "d", 0, ["m1", "m2"], "m1", use_sampling=False)
+        assert dec.model == "m2"
+        v = p.apply(h, dec, "m1")
+        assert v.final_model == "m2" and v.action == "warm_switch"
+
+    def test_no_identity_no_protection(self):
+        p = SessionProtection()
+        assert p.preflight({}).action == "no_identity"
+
+
+def _learning_cfg(tmp_path, enabled=True):
+    return {
+        "model_cards": [{"name": "m-small", "quality_score": 0.5},
+                        {"name": "m-large", "quality_score": 0.5}],
+        "default_model": "m-small",
+        "decisions": [{
+            "name": "flaky_route", "priority": 10,
+            "rules": {"operator": "OR", "conditions": [
+                {"type": "keyword", "name": "task_kw"}]},
+            "modelRefs": [{"model": "m-small", "weight": 100},
+                          {"model": "m-large", "weight": 1}],
+        }],
+        "signals": {"keywords": [{
+            "name": "task_kw", "operator": "OR", "method": "exact",
+            "keywords": ["transpile"]}]},
+        "learning": {
+            "enabled": enabled,
+            "store": {"backend": "sqlite",
+                      "path": str(tmp_path / "learn.db")},
+            "adaptation": {"candidate_set": "decision"},
+            "protection": {"enabled": False},
+        },
+    }
+
+
+class TestPipelineE2E:
+    def test_repeated_failures_shift_routing(self, tmp_path):
+        """The VERDICT item 6 'done' condition: repeated outcomes
+        measurably shift a routing decision, and restart preserves the
+        learned state."""
+        from semantic_router_tpu.config.schema import RouterConfig
+        from semantic_router_tpu.router import Router
+
+        cfg = RouterConfig.from_dict(_learning_cfg(tmp_path))
+        router = Router(cfg, engine=None)
+        router.learning.rng = random.Random(11)
+        body = {"model": "auto", "messages": [
+            {"role": "user", "content": "transpile this module"}]}
+
+        # teach: m-small keeps failing, m-large keeps succeeding
+        for _ in range(15):
+            res = router.route(body)
+            ok = res.model == "m-large"
+            router.record_feedback(res, success=ok, latency_ms=100)
+
+        picks = [router.route(body).model for _ in range(10)]
+        assert picks.count("m-large") >= 8, picks
+        router.shutdown()
+
+        # restart: a fresh router over the same sqlite store keeps the
+        # learned preference without any new outcomes
+        cfg2 = RouterConfig.from_dict(_learning_cfg(tmp_path))
+        router2 = Router(cfg2, engine=None)
+        router2.learning.rng = random.Random(13)
+        picks2 = [router2.route(body).model for _ in range(10)]
+        assert picks2.count("m-large") >= 8, picks2
+        router2.shutdown()
+
+    def test_disabled_learning_never_interferes(self, tmp_path):
+        from semantic_router_tpu.config.schema import RouterConfig
+        from semantic_router_tpu.router import Router
+
+        cfg = RouterConfig.from_dict(
+            _learning_cfg(tmp_path, enabled=False))
+        router = Router(cfg, engine=None)
+        assert router.learning is None
+        body = {"model": "auto", "messages": [
+            {"role": "user", "content": "transpile this module"}]}
+        assert router.route(body).model == "m-small"
+        router.shutdown()
+
+    def test_explicit_verdicts_via_record_feedback(self, tmp_path):
+        from semantic_router_tpu.config.schema import RouterConfig
+        from semantic_router_tpu.router import Router
+
+        cfg = RouterConfig.from_dict(_learning_cfg(tmp_path))
+        router = Router(cfg, engine=None)
+        body = {"model": "auto", "messages": [
+            {"role": "user", "content": "transpile this module"}]}
+        res = router.route(body)
+        router.record_feedback(res, verdict="underpowered")
+        exp = router.learning.store.snapshot("flaky_route", 0, res.model)
+        assert exp.underpowered == 1
+        router.shutdown()
