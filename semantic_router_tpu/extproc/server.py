@@ -86,14 +86,13 @@ class _StreamState:
     """Per-Process-stream request context (reference RequestContext,
     processor_core.go:86)."""
 
-    __slots__ = ("headers", "body_chunks", "body_bytes", "route",
+    __slots__ = ("headers", "route",
+                 "streamed_handler",
                  "response_status", "is_sse", "response_chunks",
                  "t_start", "inflight_token", "passthrough")
 
     def __init__(self) -> None:
         self.headers: Dict[str, str] = {}
-        self.body_chunks: list[bytes] = []
-        self.body_bytes = 0
         self.route: Optional[RouteResult] = None
         self.response_status = 200
         self.is_sse = False
@@ -101,6 +100,7 @@ class _StreamState:
         self.t_start = 0.0
         self.inflight_token: Optional[int] = None
         self.passthrough = False  # skip-processing: no accumulation
+        self.streamed_handler = None  # chunk-wise state machine
 
 
 class ExtProcService:
@@ -110,10 +110,19 @@ class ExtProcService:
     # bound on accumulated request bodies (Envoy's default per-connection
     # buffer is 50 MiB — an unbounded accumulator would be a memory DoS)
     MAX_BODY_BYTES = 50 * 1024 * 1024
+    # a streamed body that trickles longer than this 408s (the
+    # reference's StreamedBodyTimeoutSec guard); 0 disables
+    STREAMED_DEADLINE_S = 120.0
 
     def __init__(self, router: Router,
                  looper_execute=None) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+
         self.router = router
+        # signal-prefetch workers for streamed bodies (early detection
+        # overlaps classification with body arrival)
+        self._prefetch_pool = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="extproc-prefetch")
         # optional callable(route, headers) -> (model, response_body);
         # when set, looper decisions execute inside the filter and return
         # an ImmediateResponse (the reference's looper path re-enters the
@@ -177,31 +186,41 @@ class ExtProcService:
             return pb.ProcessingResponse(request_body=pb.BodyResponse(
                 response=pb.CommonResponse(
                     status=pb.CommonResponse.CONTINUE)))
-        state.body_chunks.append(bytes(msg.body))
-        state.body_bytes += len(msg.body)
-        if state.body_bytes > self.MAX_BODY_BYTES:
-            state.body_chunks = []
-            state.body_bytes = 0
-            return _immediate(413, {"error": {
-                "message": "request body exceeds the router's "
-                           f"{self.MAX_BODY_BYTES} byte buffer limit",
-                "type": "payload_too_large"}}, {})
-        if not msg.end_of_stream:
-            # STREAMED chunk (empty mid-stream frames are protocol-legal):
-            # acknowledge and keep accumulating until end_of_stream
+        if state.streamed_handler is None:
+            from .streamed import StreamedBodyHandler
+
+            state.streamed_handler = StreamedBodyHandler(
+                self.router, state.headers,
+                prefetch_pool=self._prefetch_pool,
+                max_bytes=self.MAX_BODY_BYTES,
+                deadline_s=self.STREAMED_DEADLINE_S)
+        handler = state.streamed_handler
+        action, payload = handler.handle_chunk(bytes(msg.body),
+                                               msg.end_of_stream)
+        if action == "continue":
+            # STREAMED chunk (empty mid-stream frames are protocol-
+            # legal): eat it; model detection / signal prefetch already
+            # ran inside the handler
             return pb.ProcessingResponse(request_body=pb.BodyResponse(
                 response=pb.CommonResponse(
                     status=pb.CommonResponse.CONTINUE)))
-        raw = b"".join(state.body_chunks)
-        state.body_chunks = []
-        state.body_bytes = 0
+        state.streamed_handler = None
+        if action == "error":
+            status, err_body = payload
+            return _immediate(status, err_body, {})
+        if handler.prefetch_started_at is not None:
+            component_event(
+                "extproc", "streamed_early_detection",
+                model_detected_at_chunk=handler.model_detected_at,
+                prefetch_started_at_chunk=handler.prefetch_started_at,
+                chunks=handler.chunks_seen)
+        if action == "passthrough":
+            body, precomputed = payload, None
+        else:
+            body, precomputed = payload
         try:
-            body = json.loads(raw or b"{}")
-        except json.JSONDecodeError:
-            return _immediate(400, {"error": {"message": "invalid JSON"}},
-                              {})
-        try:
-            route = self.router.route(body, state.headers)
+            route = self.router.route(body, state.headers,
+                                      precomputed_signals=precomputed)
         except Exception as exc:  # fail open: continue unmodified
             component_event("extproc", "route_error", error=str(exc))
             return pb.ProcessingResponse(request_body=pb.BodyResponse(
@@ -439,3 +458,5 @@ class ExtProcServer:
 
     def stop(self, grace: float = 0.5) -> None:
         self._server.stop(grace).wait()
+        self.service._prefetch_pool.shutdown(wait=False,
+                                             cancel_futures=True)

@@ -230,8 +230,28 @@ class Router:
     # request path
     # ------------------------------------------------------------------
 
+    def evaluate_signals(self, body: Dict[str, Any],
+                         headers: Optional[Dict[str, str]] = None):
+        """Signal extraction EXACTLY as route() performs it (compression
+        + operator skip config) — the overlap-prefetch seam for streamed
+        frontends: a chunked body whose messages array is complete can
+        start classification while the rest of the body arrives
+        (processor_req_body_streamed.go early-detection role)."""
+        headers = {k.lower(): v for k, v in (headers or {}).items()}
+        ctx = RequestContext.from_openai_body(body, headers)
+        if self.compressor is not None \
+                and ctx.approx_token_count() >= self.pc_min_tokens:
+            ctx._user_text = self.compressor.compress(ctx.user_text).text
+        skip = list(self._skip_signals_cfg)
+        if self._skip_enabled and self._allow_skip_signals_header:
+            skip += [s.strip() for s in
+                     headers.get("x-vsr-skip-signals", "").split(",")
+                     if s.strip()]
+        return self.dispatcher.evaluate(ctx, skip_signals=skip)
+
     def route(self, body: Dict[str, Any],
-              headers: Optional[Dict[str, str]] = None) -> RouteResult:
+              headers: Optional[Dict[str, str]] = None,
+              precomputed_signals=None) -> RouteResult:
         start = time.perf_counter()
         headers = {k.lower(): v for k, v in (headers or {}).items()}
         request_id = headers.get(H.REQUEST_ID, uuid.uuid4().hex[:16])
@@ -271,8 +291,15 @@ class Router:
             skip += [s.strip() for s in
                      headers.get("x-vsr-skip-signals", "").split(",")
                      if s.strip()]
-        with default_tracer.span("signals.evaluate", request_id=request_id):
-            signals, report = self.dispatcher.evaluate(ctx, skip_signals=skip)
+        if precomputed_signals is not None:
+            # streamed-frontend overlap: signals were evaluated while
+            # the body was still arriving (same text, same skip config)
+            signals, report = precomputed_signals
+        else:
+            with default_tracer.span("signals.evaluate",
+                                     request_id=request_id):
+                signals, report = self.dispatcher.evaluate(
+                    ctx, skip_signals=skip)
         for family, res in report.results.items():
             M.signal_latency.observe(res.latency_s, family=family)
 

@@ -1,0 +1,283 @@
+"""STREAMED partial processing (extproc/streamed.py; reference
+processor_req_body_streamed.go): partial-JSON top-level scanner, the
+early-detection state machine, guards, and the e2e proving the routing
+work happens BEFORE end_of_stream on a chunked body."""
+
+import json
+import time
+
+import pytest
+
+from semantic_router_tpu.extproc.streamed import (
+    StreamedBodyHandler,
+    partial_top_level_fields,
+)
+
+
+class TestPartialScanner:
+    def test_complete_fields(self):
+        buf = b'{"model": "auto", "stream": true, "messages": [' \
+              b'{"role": "user", "content": "hi"}], "n": 1}'
+        f = partial_top_level_fields(buf)
+        assert f["model"] == b'"auto"'
+        assert f["stream"] == b"true"
+        assert json.loads(f["messages"]) == [
+            {"role": "user", "content": "hi"}]
+        assert f["n"] == b"1"
+
+    def test_truncated_value_excluded(self):
+        buf = b'{"model": "auto", "messages": [{"role": "user", "con'
+        f = partial_top_level_fields(buf)
+        assert f["model"] == b'"auto"'
+        assert "messages" not in f
+
+    def test_nested_model_key_not_matched(self):
+        # the string 'model' inside message content must not be read as
+        # the top-level model field
+        buf = (b'{"messages": [{"role": "user", "content": '
+               b'"set \\"model\\": \\"gpt-9\\" please"}], '
+               b'"model": "auto"}')
+        f = partial_top_level_fields(buf)
+        assert f["model"] == b'"auto"'
+
+    def test_escapes_and_unicode(self):
+        buf = ('{"model": "m\\"x", "messages": [{"role": "user", '
+               '"content": "héllo \\\\ wörld"}]}').encode()
+        f = partial_top_level_fields(buf)
+        assert json.loads(f["model"]) == 'm"x'
+        assert "messages" in f
+
+    def test_truncated_scalar_excluded(self):
+        f = partial_top_level_fields(b'{"stream": tru')
+        assert "stream" not in f
+        f2 = partial_top_level_fields(b'{"stream": true,')
+        assert f2["stream"] == b"true"
+
+    def test_not_an_object(self):
+        assert partial_top_level_fields(b"[1, 2]") == {}
+        assert partial_top_level_fields(b"") == {}
+
+
+class _SpyRouter:
+    def __init__(self):
+        self.evaluated = []
+
+    def evaluate_signals(self, body, headers):
+        self.evaluated.append(body)
+        return ("SIGNALS", "REPORT")
+
+
+class TestHandlerStateMachine:
+    def test_pinned_model_goes_passthrough(self):
+        h = StreamedBodyHandler(_SpyRouter(), {})
+        raw = json.dumps({"model": "gpt-x", "messages": [
+            {"role": "user", "content": "hello"}]}).encode()
+        assert h.handle_chunk(raw[:18], False) == ("continue", None)
+        assert h.model == "gpt-x"
+        assert h.model_detected_at == 1  # before end_of_stream
+        action, body = h.handle_chunk(raw[18:], True)
+        assert action == "passthrough"
+        assert body["model"] == "gpt-x"
+
+    def test_auto_model_prefetches_signals_before_eos(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        spy = _SpyRouter()
+        pool = ThreadPoolExecutor(max_workers=1)
+        h = StreamedBodyHandler(spy, {"x-a": "b"}, prefetch_pool=pool)
+        body = {"model": "auto",
+                "messages": [{"role": "user", "content": "classify me"}],
+                "metadata": {"k": "v" * 400}}  # inert trailing field
+        raw = json.dumps(body).encode()
+        # chunk 1 carries model+messages complete; metadata arriving
+        cut = raw.index(b'"metadata"')
+        assert h.handle_chunk(raw[:cut], False) == ("continue", None)
+        assert h.prefetch_started_at == 1  # kicked BEFORE end_of_stream
+        action, (final, signals) = h.handle_chunk(raw[cut:], True)
+        assert action == "route"
+        assert signals == ("SIGNALS", "REPORT")
+        assert final == body
+        assert spy.evaluated[0]["messages"] == body["messages"]
+        pool.shutdown()
+
+    def test_late_tools_restart_prefetch_and_stay_reusable(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        spy = _SpyRouter()
+        pool = ThreadPoolExecutor(max_workers=2)
+        h = StreamedBodyHandler(spy, {}, prefetch_pool=pool)
+        body = {"model": "auto",
+                "messages": [{"role": "user", "content": "hi"}],
+                "tools": [{"type": "function",
+                           "function": {"name": "t"}}],
+                "metadata": {"pad": "x" * 500}}
+        raw = json.dumps(body).encode()
+        c1 = raw.index(b'"tools"')       # messages complete here
+        c2 = raw.index(b'"metadata"')    # tools complete here
+        h.handle_chunk(raw[:c1], False)
+        assert h.prefetch_started_at == 1
+        h.handle_chunk(raw[c1:c2], False)
+        # tools completed mid-stream: prefetch restarted with tools
+        assert h.prefetch_started_at == 2
+        action, (final, signals) = h.handle_chunk(raw[c2:], True)
+        assert action == "route"
+        assert signals == ("SIGNALS", "REPORT")
+        assert spy.evaluated[-1]["tools"] == body["tools"]
+        pool.shutdown()
+
+    def test_tools_completing_at_eos_falls_back_inline(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        spy = _SpyRouter()
+        pool = ThreadPoolExecutor(max_workers=1)
+        h = StreamedBodyHandler(spy, {}, prefetch_pool=pool)
+        body = {"model": "auto",
+                "messages": [{"role": "user", "content": "hi"}],
+                "tools": [{"type": "function",
+                           "function": {"name": "t" * 500}}]}
+        raw = json.dumps(body).encode()
+        cut = raw.index(b'"tools"')
+        h.handle_chunk(raw[:cut], False)
+        assert h.prefetch_started_at == 1  # without tools
+        action, (final, signals) = h.handle_chunk(raw[cut:], True)
+        # tools only completed AT eos: the prefetch saw a different
+        # signal view, so it must NOT be reused
+        assert action == "route" and signals is None
+        pool.shutdown()
+
+    def test_no_pool_still_routes(self):
+        h = StreamedBodyHandler(_SpyRouter(), {})
+        raw = json.dumps({"model": "auto", "messages": []}).encode()
+        action, (body, signals) = h.handle_chunk(raw, True)
+        assert action == "route" and signals is None
+
+    def test_max_bytes_guard_413(self):
+        h = StreamedBodyHandler(_SpyRouter(), {}, max_bytes=64)
+        action, (status, payload) = h.handle_chunk(b"x" * 100, False)
+        assert action == "error" and status == 413
+
+    def test_deadline_guard_408(self):
+        h = StreamedBodyHandler(_SpyRouter(), {}, deadline_s=0.01)
+        assert h.handle_chunk(b'{"model"', False)[0] == "continue"
+        time.sleep(0.03)
+        action, (status, _) = h.handle_chunk(b': "auto"', False)
+        assert action == "error" and status == 408
+
+    def test_invalid_json_400(self):
+        action, (status, _) = StreamedBodyHandler(
+            _SpyRouter(), {}).handle_chunk(b"{nope", True)
+        assert action == "error" and status == 400
+
+
+class TestExtProcStreamedE2E:
+    def _call(self, router):
+        import grpc
+
+        from semantic_router_tpu.extproc import (
+            SERVICE_NAME,
+            ExtProcServer,
+        )
+        from semantic_router_tpu.extproc import (
+            external_processor_pb2 as pb,
+        )
+
+        server = ExtProcServer(router, port=0).start()
+        channel = grpc.insecure_channel(server.address)
+        call = channel.stream_stream(
+            f"/{SERVICE_NAME}/Process",
+            request_serializer=pb.ProcessingRequest.SerializeToString,
+            response_deserializer=pb.ProcessingResponse.FromString)
+        return server, channel, call, pb
+
+    def test_first_chunk_routing_before_eos_on_large_body(
+            self, fixture_config_path):
+        """VERDICT item 7 'done': with a slow signal evaluator and a
+        trickled large body, the classify work overlaps body arrival —
+        total time ~= body time, NOT body time + classify time."""
+        from semantic_router_tpu.config import load_config
+        from semantic_router_tpu.router import Router
+
+        cfg = load_config(fixture_config_path)
+        router = Router(cfg, engine=None)
+
+        # make the keyword family deliberately slow so classify cost
+        # is visible in wall-clock
+        orig = router.dispatcher.evaluators["keyword"]
+
+        class SlowKeyword:
+            signal_type = "keyword"
+
+            def evaluate(self, ctx):
+                time.sleep(0.6)
+                return orig.evaluate(ctx)
+
+        router.dispatcher.evaluators["keyword"] = SlowKeyword()
+        server, channel, call, pb = self._call(router)
+        try:
+            big = {"model": "auto", "messages": [
+                {"role": "user",
+                 "content": "urgent asap: " + "ctx " * 2000}],
+                # large signal-inert trailing field: the prefetch view
+                # stays valid while it arrives
+                "metadata": {"trace": "d" * 30000}}
+            raw = json.dumps(big).encode()
+            cut = raw.index(b'"metadata"')
+
+            def msgs():
+                yield pb.ProcessingRequest(
+                    request_headers=pb.HttpHeaders(end_of_stream=False))
+                # chunk 1: model + full messages (classify text known)
+                yield pb.ProcessingRequest(request_body=pb.HttpBody(
+                    body=raw[:cut], end_of_stream=False))
+                # body keeps trickling for ~0.7 s while classify runs
+                step = max(1, (len(raw) - cut) // 7)
+                for i in range(cut, len(raw), step):
+                    time.sleep(0.1)
+                    yield pb.ProcessingRequest(request_body=pb.HttpBody(
+                        body=raw[i:i + step],
+                        end_of_stream=i + step >= len(raw)))
+
+            t0 = time.perf_counter()
+            resps = list(call(msgs()))
+            total = time.perf_counter() - t0
+            final = resps[-1]
+            assert final.WhichOneof("response") == "request_body"
+            mutated = json.loads(
+                final.request_body.response.body_mutation.body)
+            assert mutated["model"] == "qwen3-8b"
+            # serial would be >= 0.7 (body) + 0.6 (classify) = 1.3 s;
+            # overlapped stays near the body time
+            assert total < 1.15, f"no overlap: {total:.2f}s"
+        finally:
+            channel.close()
+            server.stop()
+            router.shutdown()
+
+    def test_accumulate_semantics_unchanged_for_small_bodies(
+            self, fixture_config_path):
+        from semantic_router_tpu.config import load_config
+        from semantic_router_tpu.router import Router
+
+        cfg = load_config(fixture_config_path)
+        router = Router(cfg, engine=None)
+        server, channel, call, pb = self._call(router)
+        try:
+            raw = json.dumps({"model": "auto", "messages": [
+                {"role": "user",
+                 "content": "this is urgent, fix asap"}]}).encode()
+            msgs = [
+                pb.ProcessingRequest(
+                    request_headers=pb.HttpHeaders(end_of_stream=False)),
+                pb.ProcessingRequest(request_body=pb.HttpBody(
+                    body=raw[:20], end_of_stream=False)),
+                pb.ProcessingRequest(request_body=pb.HttpBody(
+                    body=raw[20:], end_of_stream=True)),
+            ]
+            resps = list(call(iter(msgs)))
+            mutated = json.loads(
+                resps[-1].request_body.response.body_mutation.body)
+            assert mutated["model"] == "qwen3-8b"
+        finally:
+            channel.close()
+            server.stop()
+            router.shutdown()
