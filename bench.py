@@ -129,8 +129,10 @@ def _run_bench(platform: str) -> None:
     import jax.numpy as jnp
 
     # On a CPU host (no accelerator) scale down so the smoke run finishes;
-    # the driver's real run executes on the TPU chip at full size.
+    # the driver's real run executes on the TPU chip at full size.  CPU XLA
+    # has no fast bf16 matmul path — f32 there, bf16 (MXU-native) on TPU.
     batch, measure_iters = (8, 2) if platform == "cpu" else (32, 10)
+    bench_dtype = "float32" if platform == "cpu" else "bfloat16"
 
     from semantic_router_tpu.models.modernbert import (
         ModernBertConfig,
@@ -142,31 +144,48 @@ def _run_bench(platform: str) -> None:
         max_position_embeddings=32768,
         rope_scaling={"rope_type": "yarn", "factor": 4.0,
                       "original_max_position_embeddings": 8192},
-        dtype=jnp.bfloat16,
+        dtype=jnp.dtype(bench_dtype),
     )
     model = ModernBertForSequenceClassification(cfg)
     rng = np.random.default_rng(0)
     ids = jnp.asarray(rng.integers(3, cfg.vocab_size, (batch, SEQ)), jnp.int32)
     mask = jnp.ones((batch, SEQ), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), ids[:1, :8])
-    params = jax.tree_util.tree_map(
-        lambda x: x.astype(jnp.bfloat16)
-        if x.dtype == jnp.float32 else x, params)
+    if bench_dtype == "bfloat16":
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if x.dtype == jnp.float32 else x, params)
 
     fn = jax.jit(model.apply)
+    # jax.device_get (not block_until_ready) is the sync primitive here:
+    # over the tunneled axon backend block_until_ready has been observed to
+    # return before the computation finishes (r2 recorded an 800x-inflated
+    # number because of it); fetching the result bytes cannot lie.
     for _ in range(WARMUP_ITERS):
-        fn(params, ids, mask).block_until_ready()
+        jax.device_get(fn(params, ids, mask))
 
     t0 = time.perf_counter()
     for _ in range(measure_iters):
         out = fn(params, ids, mask)
-    out.block_until_ready()
+    jax.device_get(out)
     elapsed = time.perf_counter() - t0
 
     signals_per_s = (batch * measure_iters) / elapsed
+    # ~2*P*T forward FLOPs; ModernBERT-base ~149M params.
+    achieved_tflops = 2 * 149e6 * SEQ * batch * measure_iters / elapsed / 1e12
+    sys.stderr.write(
+        f"bench: {elapsed * 1e3 / measure_iters:.1f} ms/batch, "
+        f"~{achieved_tflops:.1f} TFLOPs achieved\n")
+    # On a CPU fallback the host geometry is the whole story (this image
+    # exposes ONE 2.1GHz core — ~0.09 TFLOPs f32 roofline — while the
+    # reference's CPU baseline ran many-core), so record it in the metric.
+    plat_desc = platform if platform != "cpu" else \
+        f"cpu:{os.cpu_count()}core"
     print(json.dumps({
         "metric": "mmBERT-32K intent classify throughput "
-                  f"(512 tok, b={batch}, bf16, {platform})",
+                  f"(512 tok, b={batch}, "
+                  f"{'bf16' if bench_dtype == 'bfloat16' else 'f32'}, "
+                  f"{plat_desc})",
         "value": round(signals_per_s, 2),
         "unit": "signals/s",
         "vs_baseline": round(signals_per_s / GPU_BASELINE_SIGNALS_PER_S, 3),

@@ -37,6 +37,7 @@ from .modernbert import (
     ModernBertPredictionHead,
 )
 from ..ops.attention import cls_pool, mean_pool
+from ..ops.matryoshka import truncate_normalize
 
 
 @dataclasses.dataclass(frozen=True)
@@ -241,6 +242,38 @@ class LoRAModernBertForTokenClassification(nn.Module):
         hidden = ModernBertPredictionHead(cfg, name="head")(hidden)
         return nn.Dense(self.num_labels, use_bias=True, name="classifier",
                         dtype=cfg.dtype)(hidden)
+
+
+class LoRAMmBertEmbeddingModel(nn.Module):
+    """LoRA-adapted embedding trunk (cache/domain embedding fine-tunes,
+    reference src/training/model_embeddings/cache_embeddings/lora_trainer.py
+    role): every trunk projection carries a task-stacked adapter; pool →
+    L2-normalize like MmBertEmbeddingModel. Base weights stay frozen under
+    ``lora_param_filter``; the trained artifact is just the adapter stack."""
+
+    config: ModernBertConfig
+    lora: LoRAConfig
+    pooling: str = "mean"
+
+    @nn.compact
+    def __call__(self, input_ids: jnp.ndarray,
+                 attention_mask: Optional[jnp.ndarray] = None,
+                 task_index: jnp.ndarray | int = 0) -> jnp.ndarray:
+        cfg = self.config
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        lora_cfg = self.lora
+
+        def dense_factory(features: int, use_bias: bool, name: str):
+            return LoRADense(features, lora_cfg, use_bias=use_bias,
+                             name=name)
+
+        hidden = ModernBertModel(cfg, name="model",
+                                 dense_factory=dense_factory)(
+            input_ids, attention_mask, task_index=jnp.asarray(task_index))
+        pooled = (cls_pool(hidden) if self.pooling == "cls"
+                  else mean_pool(hidden, attention_mask))
+        return truncate_normalize(pooled, None).astype(cfg.dtype)
 
 
 def lora_param_filter(path: tuple, _leaf) -> bool:

@@ -93,6 +93,25 @@ class KNNSelector:
             self.memory.add(fb.query_embedding, fb.model, reward)
         self._fallback.update(fb)
 
+    # -- trained-artifact round-trip (ml_model_selection train.py role) ----
+
+    def to_json(self) -> str:
+        mat, models, rewards = self.memory.matrix()
+        return json.dumps({
+            "algorithm": "knn", "k": self.k,
+            "embeddings": mat.tolist() if mat is not None else [],
+            "models": models, "rewards": rewards})
+
+    @classmethod
+    def from_json(cls, blob: str, **kwargs) -> "KNNSelector":
+        data = json.loads(blob)
+        sel = cls(k=data.get("k", 8), **kwargs)
+        embs = np.asarray(data.get("embeddings", []), np.float32)
+        for i, (m, r) in enumerate(zip(data.get("models", []),
+                                       data.get("rewards", []))):
+            sel.memory.add(embs[i], m, float(r))
+        return sel
+
 
 class KMeansSelector:
     """Cluster query embeddings; route each cluster to its best-performing
@@ -181,6 +200,31 @@ class KMeansSelector:
                     self._maybe_fit()
         self._fallback.update(fb)
 
+    def to_json(self) -> str:
+        with self._lock:
+            return json.dumps({
+                "algorithm": "kmeans", "n_clusters": self.n_clusters,
+                "refit_every": self.refit_every,
+                "centroids": self.centroids.tolist()
+                if self.centroids is not None else [],
+                "cluster_best": {str(k): v
+                                 for k, v in self.cluster_best.items()}})
+
+    @classmethod
+    def from_json(cls, blob: str, **kwargs) -> "KMeansSelector":
+        data = json.loads(blob)
+        sel = cls(n_clusters=data.get("n_clusters", 8), **kwargs)
+        # a trainer that froze the clusters (refit_every=1<<30) must stay
+        # frozen after restore — refitting from a few fresh points would
+        # orphan every pre-trained cluster→model mapping
+        sel.refit_every = int(data.get("refit_every", sel.refit_every))
+        cents = data.get("centroids", [])
+        if cents:
+            sel.centroids = np.asarray(cents, np.float32)
+            sel.cluster_best = {int(k): v
+                                for k, v in data["cluster_best"].items()}
+        return sel
+
 
 class SVMSelector:
     """Linear one-vs-rest SVM over query embeddings (ml-binding/src/svm.rs
@@ -200,19 +244,14 @@ class SVMSelector:
         self._fallback = registry.create(fallback, **kwargs)
         self._lock = threading.Lock()
 
-    def _fit(self) -> None:
-        mat, models, rewards = self.memory.matrix()
-        if mat is None:
-            return
-        good = [i for i, r in enumerate(rewards) if r > 0.5]
-        if len(good) < 8:
-            return
-        x = np.concatenate([mat[good],
-                            np.ones((len(good), 1), np.float32)], axis=1)
-        labels = [models[i] for i in good]
+    def fit(self, feats: np.ndarray, labels: Sequence[str]) -> None:
+        """One-vs-rest hinge SGD over already-selected samples (public for
+        the offline trainer; the online path filters by reward first)."""
         classes = sorted(set(labels))
         if len(classes) < 2:
             return
+        x = np.concatenate([np.asarray(feats, np.float32),
+                            np.ones((len(feats), 1), np.float32)], axis=1)
         y = np.asarray([[1.0 if l == c else -1.0 for c in classes]
                         for l in labels], np.float32)
         w = np.zeros((len(classes), x.shape[1]), np.float32)
@@ -225,6 +264,31 @@ class SVMSelector:
                 w[mask] += self.lr * y[i][mask, None] * x[i][None, :]
         with self._lock:
             self.weights, self.classes = w, classes
+
+    def _fit(self) -> None:
+        mat, models, rewards = self.memory.matrix()
+        if mat is None:
+            return
+        good = [i for i, r in enumerate(rewards) if r > 0.5]
+        if len(good) < 8:
+            return
+        self.fit(mat[good], [models[i] for i in good])
+
+    def to_json(self) -> str:
+        with self._lock:
+            return json.dumps({
+                "algorithm": "svm", "classes": self.classes,
+                "weights": self.weights.tolist()
+                if self.weights is not None else []})
+
+    @classmethod
+    def from_json(cls, blob: str, **kwargs) -> "SVMSelector":
+        data = json.loads(blob)
+        sel = cls(**kwargs)
+        if data.get("weights"):
+            sel.weights = np.asarray(data["weights"], np.float32)
+            sel.classes = list(data["classes"])
+        return sel
 
     def select(self, candidates: List[ModelRef], ctx: SelectionContext
                ) -> SelectionResult:
@@ -364,6 +428,7 @@ class MLPSelector:
     def to_json(self) -> str:
         with self._lock:
             return json.dumps({
+                "algorithm": "mlp",
                 "hidden": self.hidden,
                 "classes": self.classes,
                 "params": {k: v.tolist() for k, v in (self.params or {}).items()},
@@ -467,6 +532,27 @@ class GMTRouterSelector:
             with self._lock:
                 key = (node, fb.model)
                 self._edge[key] = 0.8 * self._edge.get(key, 0.5) + 0.2 * reward
+
+    # -- offline pre-training artifact (rl_model_selection role: warm-start
+    #    the online graph from historical interactions) --------------------
+
+    def to_json(self) -> str:
+        with self._lock:
+            edges = [[n, m, w] for (n, m), w in self._edge.items()]
+        return json.dumps({
+            "algorithm": "gmtrouter",
+            "kmeans": json.loads(self.kmeans.to_json()),
+            "edges": edges})
+
+    @classmethod
+    def from_json(cls, blob: str, **kwargs) -> "GMTRouterSelector":
+        data = json.loads(blob)
+        km = data.get("kmeans", {})
+        sel = cls(n_nodes=km.get("n_clusters", 16), **kwargs)
+        sel.kmeans = KMeansSelector.from_json(json.dumps(km), **kwargs)
+        for n, m, w in data.get("edges", []):
+            sel._edge[(int(n), m)] = float(w)
+        return sel
 
 
 for _cls in (KNNSelector, KMeansSelector, SVMSelector, MLPSelector,

@@ -1,0 +1,425 @@
+"""Embedding-model training: cache embeddings (MNR) + domain adaptation
+(iterative hard-negative mining).
+
+Reference roles (re-designed for JAX/TPU, not translated):
+  - src/training/model_embeddings/cache_embeddings/lora_trainer.py —
+    LoRA fine-tune of the embedding trunk with Multiple Negatives Ranking
+    loss over (anchor, positive) pairs; the trained artifact is a small
+    adapter stack that specializes the shared base for semantic-cache
+    matching in one domain.
+  - src/training/model_embeddings/domain_adapted_embeddings/train.py —
+    iterative hard-negative mining: embed the corpus with the current
+    model, mine negatives that currently rank too close to the gold
+    document, train with a margin triplet loss, re-mine, repeat.
+
+TPU shape: the whole train step (forward both towers + loss + adapter
+grads) is one jitted program; MNR's in-batch negatives turn a batch of B
+pairs into a BxB similarity matmul — exactly the MXU-friendly formulation
+(no per-pair Python loops, no dynamic shapes: pairs are tokenized to one
+fixed bucket).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.tokenization import HashTokenizer, Tokenizer
+
+# -- synthetic data (zero-egress image: no public triplet sets) -----------
+
+_DOMAIN_TOPICS = {
+    "programming": ["binary search", "hash map", "rest api",
+                    "race condition", "unit test", "garbage collector",
+                    "b-tree index", "coroutine"],
+    "finance": ["compound interest", "balance sheet", "index fund",
+                "amortization", "hedging", "liquidity ratio",
+                "options pricing", "credit spread"],
+    "medical": ["hypertension", "insulin resistance", "mri contrast",
+                "antibiotic resistance", "triage protocol",
+                "clinical trial", "pathogen screening", "dosage titration"],
+}
+
+_PARAPHRASES = [
+    "how does {t} work",
+    "explain {t} to me",
+    "what is {t} and why does it matter",
+    "give me an overview of {t}",
+    "can you describe {t} in simple terms",
+    "i need help understanding {t}",
+]
+
+_DOC_TEMPLATES = [
+    "{t} is a core concept: it is defined by its mechanism and its "
+    "typical failure modes, and practitioners rely on it daily.",
+    "reference notes on {t}: definition, common pitfalls, and three "
+    "worked examples with step-by-step reasoning.",
+]
+
+
+@dataclasses.dataclass
+class PairSet:
+    """(anchor, positive) pairs plus a retrieval corpus for mining/eval."""
+
+    anchors: List[str]
+    positives: List[str]
+    corpus: List[str]          # positives live in here too
+    gold: List[int]            # corpus index of each anchor's gold doc
+
+
+def synthetic_pair_dataset(domain: str = "programming", n: int = 96,
+                           seed: int = 0) -> PairSet:
+    """Deterministic paraphrase pairs: two phrasings of the same topic are
+    a positive pair; every other topic's docs are (hard-ish) negatives."""
+    topics = _DOMAIN_TOPICS.get(domain, _DOMAIN_TOPICS["programming"])
+    rng = np.random.default_rng(seed)
+    corpus = []
+    topic_doc = {}
+    for t in topics:
+        topic_doc[t] = len(corpus)
+        corpus.append(_DOC_TEMPLATES[0].format(t=t))
+        corpus.append(_DOC_TEMPLATES[1].format(t=t))
+    anchors, positives, gold = [], [], []
+    for i in range(n):
+        t = topics[i % len(topics)]
+        a, b = rng.choice(len(_PARAPHRASES), size=2, replace=False)
+        anchors.append(_PARAPHRASES[a].format(t=t))
+        positives.append(_PARAPHRASES[b].format(t=t))
+        gold.append(topic_doc[t])
+    return PairSet(anchors, positives, corpus, gold)
+
+
+def load_pairs_jsonl(path: str) -> PairSet:
+    """Rows: {"anchor": ..., "positive": ..., ["negative": ...]} —
+    the triplets.jsonl shape of the reference's generate_training_data."""
+    anchors, positives, corpus, gold = [], [], [], []
+    seen: Dict[str, int] = {}
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            row = json.loads(line)
+            anchors.append(row["anchor"])
+            pos = row["positive"]
+            if pos not in seen:
+                seen[pos] = len(corpus)
+                corpus.append(pos)
+            positives.append(pos)
+            gold.append(seen[pos])
+            neg = row.get("negative")
+            if neg and neg not in seen:
+                seen[neg] = len(corpus)
+                corpus.append(neg)
+    return PairSet(anchors, positives, corpus, gold)
+
+
+# -- losses ---------------------------------------------------------------
+
+
+def mnr_loss(emb_a, emb_p, temperature: float = 0.05):
+    """Multiple Negatives Ranking: for L2-normalized towers the BxB cosine
+    matrix's diagonal is the positive; every off-diagonal entry is an
+    in-batch negative. Cross-entropy toward the diagonal."""
+    import jax.numpy as jnp
+    import jax
+
+    sims = (emb_a @ emb_p.T) / temperature          # [B, B]
+    labels = jnp.arange(sims.shape[0])
+    logp = jax.nn.log_softmax(sims, axis=-1)
+    return -jnp.take_along_axis(logp, labels[:, None], 1).mean()
+
+
+def triplet_margin_loss(emb_a, emb_p, emb_n, margin: float = 0.1):
+    """Cosine triplet loss with the reference's small margin (its README
+    warns the sentence-transformers default of 5.0 performs poorly)."""
+    import jax.numpy as jnp
+
+    pos = (emb_a * emb_p).sum(-1)
+    neg = (emb_a * emb_n).sum(-1)
+    return jnp.maximum(0.0, margin - pos + neg).mean()
+
+
+# -- training -------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class EmbedTrainConfig:
+    seq_len: int = 64
+    batch_size: int = 16
+    steps: int = 60
+    learning_rate: float = 5e-4
+    lora_rank: int = 8
+    temperature: float = 0.05
+    margin: float = 0.1
+    iterations: int = 2           # domain adaptation mining rounds
+    hard_neg_rank: int = 3        # mine negatives ranked at/after this
+    seed: int = 0
+
+
+def _tokenize_batch(tok: Tokenizer, texts: Sequence[str], seq_len: int
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    ids = np.zeros((len(texts), seq_len), np.int32)
+    mask = np.zeros((len(texts), seq_len), np.int32)
+    for i, t in enumerate(texts):
+        enc = tok.encode(t, max_length=seq_len)
+        n = min(len(enc.ids), seq_len)
+        ids[i, :n] = enc.ids[:n]
+        mask[i, :n] = 1
+    return ids, mask
+
+
+def _make_lora_embedder(cfg: EmbedTrainConfig, model_cfg=None):
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.lora import LoRAConfig, LoRAMmBertEmbeddingModel
+    from ..models.modernbert import ModernBertConfig
+
+    mcfg = model_cfg or ModernBertConfig(
+        hidden_size=128, intermediate_size=256, num_hidden_layers=2,
+        num_attention_heads=4, vocab_size=2048, pad_token_id=0)
+    module = LoRAMmBertEmbeddingModel(
+        mcfg, LoRAConfig(rank=cfg.lora_rank, num_tasks=1))
+    params = module.init(jax.random.PRNGKey(cfg.seed),
+                         jnp.ones((1, 8), jnp.int32))
+    return module, params, mcfg
+
+
+def _train(module, params, batches: Callable[[int], Tuple],
+           cfg: EmbedTrainConfig, loss_kind: str
+           ) -> Tuple[dict, List[Dict[str, float]]]:
+    """Adapter-only optimization; one jitted step for the whole tower
+    forward + loss. ``batches(step)`` yields numpy (ids/mask tuples)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ..models.lora import lora_param_filter
+    from flax.traverse_util import flatten_dict, unflatten_dict
+
+    flat = flatten_dict(params)
+    trainable_mask = unflatten_dict(
+        {k: lora_param_filter(k, v) for k, v in flat.items()})
+    opt = optax.multi_transform(
+        {True: optax.adam(cfg.learning_rate), False: optax.set_to_zero()},
+        trainable_mask)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step_pair(params, opt_state, ia, ma, ip, mp):
+        def loss_fn(p):
+            ea = module.apply(p, ia, ma).astype(jnp.float32)
+            ep = module.apply(p, ip, mp).astype(jnp.float32)
+            return mnr_loss(ea, ep, cfg.temperature)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    @jax.jit
+    def step_triplet(params, opt_state, ia, ma, ip, mp, in_, mn):
+        def loss_fn(p):
+            ea = module.apply(p, ia, ma).astype(jnp.float32)
+            ep = module.apply(p, ip, mp).astype(jnp.float32)
+            en = module.apply(p, in_, mn).astype(jnp.float32)
+            return triplet_margin_loss(ea, ep, en, cfg.margin)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    history: List[Dict[str, float]] = []
+    for i in range(cfg.steps):
+        arrs = batches(i)
+        if loss_kind == "pair":
+            params, opt_state, loss = step_pair(params, opt_state, *arrs)
+        else:
+            params, opt_state, loss = step_triplet(params, opt_state, *arrs)
+        if (i + 1) % 20 == 0 or i == cfg.steps - 1:
+            history.append({"step": i + 1, "loss": float(loss)})
+    return params, history
+
+
+def finetune_cache_embeddings(pairs: PairSet,
+                              cfg: Optional[EmbedTrainConfig] = None,
+                              tokenizer: Optional[Tokenizer] = None,
+                              module=None, params=None, model_cfg=None):
+    """LoRA + MNR cache-embedding fine-tune. Returns (module, params,
+    history); adapters are the only updated leaves."""
+    cfg = cfg or EmbedTrainConfig()
+    tok = tokenizer or HashTokenizer(vocab_size=2048)
+    if module is None:
+        module, params, model_cfg = _make_lora_embedder(cfg, model_cfg)
+    rng = np.random.default_rng(cfg.seed)
+    n = len(pairs.anchors)
+
+    def batches(step: int):
+        idx = rng.choice(n, size=min(cfg.batch_size, n), replace=False)
+        ia, ma = _tokenize_batch(tok, [pairs.anchors[i] for i in idx],
+                                 cfg.seq_len)
+        ip, mp = _tokenize_batch(tok, [pairs.positives[i] for i in idx],
+                                 cfg.seq_len)
+        return ia, ma, ip, mp
+
+    params, history = _train(module, params, batches, cfg, "pair")
+    return module, params, history
+
+
+# Bounded LRU of jitted apply fns: the bound method pins its module (and
+# compiled executables) alive, so an unbounded id-keyed dict would leak one
+# model per trained domain in a long-lived process.
+_EMBED_JIT: "OrderedDict[int, Callable]" = OrderedDict()
+_EMBED_JIT_MAX = 8
+
+
+def embed_texts(module, params, tok: Tokenizer, texts: Sequence[str],
+                seq_len: int, batch: int = 32) -> np.ndarray:
+    """Batched jitted embedding; the last batch pads up to the fixed
+    ``batch`` shape so every call hits the one compiled program (mining
+    re-embeds the corpus every round — eager dispatch there dominates
+    wall-clock on an accelerator)."""
+    import jax
+
+    fn = _EMBED_JIT.get(id(module))
+    if fn is None:
+        fn = jax.jit(module.apply)
+        _EMBED_JIT[id(module)] = fn
+        if len(_EMBED_JIT) > _EMBED_JIT_MAX:
+            _EMBED_JIT.popitem(last=False)
+    else:
+        _EMBED_JIT.move_to_end(id(module))
+    out = []
+    for i in range(0, len(texts), batch):
+        chunk = list(texts[i:i + batch])
+        n = len(chunk)
+        chunk += [""] * (batch - n)
+        ids, mask = _tokenize_batch(tok, chunk, seq_len)
+        out.append(np.asarray(fn(params, ids, mask), np.float32)[:n])
+    return np.concatenate(out, axis=0)
+
+
+def mine_hard_negatives(module, params, tok: Tokenizer, pairs: PairSet,
+                        cfg: EmbedTrainConfig) -> List[int]:
+    """For each anchor: rank the corpus with the CURRENT model; the hard
+    negative is the best-ranked non-gold document at/after
+    ``hard_neg_rank`` (documents the model currently confuses with
+    gold — the reference's iterative mining signal)."""
+    qa = embed_texts(module, params, tok, pairs.anchors, cfg.seq_len)
+    dc = embed_texts(module, params, tok, pairs.corpus, cfg.seq_len)
+    sims = qa @ dc.T
+    negs = []
+    for qi in range(len(pairs.anchors)):
+        order = np.argsort(-sims[qi])
+        non_gold = [int(d) for d in order if int(d) != pairs.gold[qi]]
+        if not non_gold:
+            raise ValueError(
+                "cannot mine hard negatives: corpus has no non-gold "
+                f"document for anchor {qi} ({pairs.anchors[qi]!r}) — "
+                "add negatives or more corpus documents")
+        pick = non_gold[min(cfg.hard_neg_rank - 1, len(non_gold) - 1)]
+        negs.append(pick)
+    return negs
+
+
+def finetune_domain_embeddings(pairs: PairSet,
+                               cfg: Optional[EmbedTrainConfig] = None,
+                               tokenizer: Optional[Tokenizer] = None):
+    """Iterative hard-negative-mined domain adaptation: mine → triplet
+    train → re-mine, ``cfg.iterations`` rounds. Returns (module, params,
+    per-round history)."""
+    cfg = cfg or EmbedTrainConfig()
+    tok = tokenizer or HashTokenizer(vocab_size=2048)
+    module, params, model_cfg = _make_lora_embedder(cfg)
+    rng = np.random.default_rng(cfg.seed)
+    n = len(pairs.anchors)
+    all_history: List[Dict[str, float]] = []
+    gold_texts = [pairs.corpus[g] for g in pairs.gold]
+
+    for rnd in range(cfg.iterations):
+        negs = mine_hard_negatives(module, params, tok, pairs, cfg)
+        neg_texts = [pairs.corpus[j] for j in negs]
+
+        def batches(step: int):
+            idx = rng.choice(n, size=min(cfg.batch_size, n), replace=False)
+            ia, ma = _tokenize_batch(tok, [pairs.anchors[i] for i in idx],
+                                     cfg.seq_len)
+            ip, mp = _tokenize_batch(tok, [gold_texts[i] for i in idx],
+                                     cfg.seq_len)
+            in_, mn = _tokenize_batch(tok, [neg_texts[i] for i in idx],
+                                      cfg.seq_len)
+            return ia, ma, ip, mp, in_, mn
+
+        params, history = _train(module, params, batches, cfg, "triplet")
+        for h in history:
+            h["round"] = rnd
+        all_history.extend(history)
+    return module, params, all_history
+
+
+def evaluate_retrieval_mrr(module, params, tok: Tokenizer, pairs: PairSet,
+                           seq_len: int, k: int = 5) -> float:
+    """MRR@k over the pair set's corpus (the reference reports MRR@5)."""
+    qa = embed_texts(module, params, tok, pairs.anchors, seq_len)
+    dc = embed_texts(module, params, tok, pairs.corpus, seq_len)
+    sims = qa @ dc.T
+    rr = 0.0
+    for qi in range(len(pairs.anchors)):
+        order = np.argsort(-sims[qi])[:k]
+        hits = np.where(order == pairs.gold[qi])[0]
+        if hits.size:
+            rr += 1.0 / (1 + int(hits[0]))
+    return rr / len(pairs.anchors)
+
+
+def save_embedding_adapters(params: dict, path: str) -> None:
+    from .finetune import save_adapters
+
+    save_adapters(params, path)
+
+
+def load_embedding_adapters(params: dict, path: str) -> dict:
+    from .finetune import load_adapters
+
+    return load_adapters(params, path)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    ap = argparse.ArgumentParser(
+        description="cache / domain embedding fine-tune")
+    ap.add_argument("--mode", choices=["cache", "domain"], default="cache")
+    ap.add_argument("--domain", default="programming")
+    ap.add_argument("--train-data", default="",
+                    help="triplets.jsonl (anchor/positive[/negative])")
+    ap.add_argument("--output", default="models/cache-lora")
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--iterations", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    pairs = (load_pairs_jsonl(args.train_data) if args.train_data
+             else synthetic_pair_dataset(args.domain))
+    cfg = EmbedTrainConfig(steps=args.steps, iterations=args.iterations)
+    tok = HashTokenizer(vocab_size=2048)
+    if args.mode == "cache":
+        module, params, history = finetune_cache_embeddings(
+            pairs, cfg, tokenizer=tok)
+    else:
+        module, params, history = finetune_domain_embeddings(
+            pairs, cfg, tokenizer=tok)
+    mrr = evaluate_retrieval_mrr(module, params, tok, pairs, cfg.seq_len)
+    os.makedirs(args.output, exist_ok=True)
+    save_embedding_adapters(params, os.path.join(args.output,
+                                                 "adapters.npz"))
+    with open(os.path.join(args.output, "history.json"), "w") as f:
+        json.dump({"history": history, "mrr": mrr}, f, indent=2)
+    print(json.dumps({"mode": args.mode, "mrr": round(mrr, 4),
+                      "final_loss": history[-1]["loss"] if history else None}))
+
+
+if __name__ == "__main__":
+    main()

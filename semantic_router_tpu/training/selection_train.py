@@ -1,0 +1,377 @@
+"""Offline ML/RL model-selection training.
+
+Reference roles (re-designed, not translated):
+  - src/training/model_selection/ml_model_selection/{train,models,
+    data_loader}.py — train KNN / KMeans / SVM / MLP routers from a
+    routing-benchmark corpus of (query, category, model, quality,
+    latency) records; feature vector = query embedding + category
+    one-hot; label = best model per query (quality first, latency
+    tie-break). Artifacts are JSON, loadable by the serving selectors.
+  - src/training/model_selection/rl_model_selection/train_gmtrouter.py —
+    offline pre-training of the preference graph that the online
+    gmtrouter selector keeps learning from (cold-start warm-up; the
+    serving side stays online-learning either way).
+
+The artifacts round-trip into ``selection/ml.py`` selectors via each
+class's ``to_json``/``from_json`` — the same contract the reference uses
+between its Python trainers and Rust inference (models.py "saved in JSON
+format compatible with the Rust inference code").
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+CATEGORIES = ["business", "law", "psychology", "biology", "chemistry",
+              "history", "other", "health", "economics", "math",
+              "physics", "computer science", "philosophy", "engineering"]
+
+
+@dataclasses.dataclass
+class RoutingRecord:
+    """One benchmark observation: how ``model`` did on ``query``."""
+
+    query: str
+    category: str
+    model: str
+    quality: float            # [0, 1]
+    latency_ms: float
+
+
+def load_routing_jsonl(path: str) -> List[RoutingRecord]:
+    rows = []
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            r = json.loads(line)
+            rows.append(RoutingRecord(
+                query=r["query"], category=r.get("category", "other"),
+                model=r["model"], quality=float(r.get("quality", 0.0)),
+                latency_ms=float(r.get("latency_ms", 0.0))))
+    return rows
+
+
+_QUERY_TEMPLATES = {
+    "computer science": ["implement {x} in python", "debug the {x} module",
+                         "optimize {x} complexity"],
+    "math": ["prove the {x} theorem", "solve the {x} equation",
+             "integrate {x} by parts"],
+    "law": ["is {x} enforceable under contract law",
+            "summarize the {x} statute"],
+    "health": ["what are symptoms of {x}", "treatment options for {x}"],
+    "business": ["draft a {x} business plan", "analyze the {x} market"],
+    "other": ["tell me about {x}", "write a short note on {x}"],
+}
+_FILLERS = ["alpha", "beta", "gamma", "delta", "omega", "sigma",
+            "lambda", "kappa"]
+
+
+def synthetic_routing_dataset(n_queries: int = 120, seed: int = 0,
+                              models: Sequence[str] = (
+                                  "code-7b", "general-7b", "premium-70b"),
+                              ) -> List[RoutingRecord]:
+    """Deterministic corpus with a learnable structure: code-7b wins CS
+    and math, premium-70b wins law/health (but slower), general-7b wins
+    the rest — so a correct trainer must beat a static choice."""
+    rng = np.random.default_rng(seed)
+    cats = list(_QUERY_TEMPLATES)
+    rows: List[RoutingRecord] = []
+    for i in range(n_queries):
+        cat = cats[i % len(cats)]
+        tpl = _QUERY_TEMPLATES[cat][i % len(_QUERY_TEMPLATES[cat])]
+        q = tpl.format(x=_FILLERS[i % len(_FILLERS)]) + f" case {i}"
+        for m in models:
+            if m == "code-7b":
+                base = 0.9 if cat in ("computer science", "math") else 0.45
+                lat = 800
+            elif m == "premium-70b":
+                base = 0.9 if cat in ("law", "health") else 0.75
+                lat = 3000
+            else:
+                base = 0.8 if cat in ("business", "other") else 0.55
+                lat = 900
+            rows.append(RoutingRecord(
+                q, cat, m,
+                float(np.clip(base + rng.normal(0, 0.05), 0, 1)),
+                lat * float(rng.uniform(0.8, 1.2))))
+    return rows
+
+
+# -- featurization --------------------------------------------------------
+
+
+def hash_embed(texts: Sequence[str], dim: int = 64,
+               seed: int = 0) -> np.ndarray:
+    """Deterministic feature-hash embedding (token n-gram buckets, signed,
+    L2-normalized) — the trainer's zero-model fallback. Production passes
+    ``embed_fn`` backed by the real embedding task instead. crc32, NOT the
+    builtin hash(): artifacts must mean the same thing in a different
+    process (PYTHONHASHSEED salts str hashing per interpreter)."""
+    import zlib
+
+    out = np.zeros((len(texts), dim), np.float32)
+    for i, t in enumerate(texts):
+        toks = t.lower().split()
+        for g in toks + [" ".join(p) for p in zip(toks, toks[1:])]:
+            h = zlib.crc32(g.encode("utf-8")) ^ seed
+            out[i, h % dim] += 1.0 if (h >> 1) % 2 else -1.0
+    norms = np.linalg.norm(out, axis=1, keepdims=True)
+    return out / np.maximum(norms, 1e-9)
+
+
+# The category block is scaled so that cross-category distance dominates
+# within-category embedding noise — distance-based algorithms (kmeans,
+# gmtrouter's node assignment) then cluster by category first and refine
+# by content, which is the reference's intent in concatenating the
+# one-hot onto the embedding.
+CATEGORY_SCALE = 2.0
+
+
+def category_onehot(cat: str) -> np.ndarray:
+    v = np.zeros((len(CATEGORIES),), np.float32)
+    try:
+        v[CATEGORIES.index(cat)] = CATEGORY_SCALE
+    except ValueError:
+        v[CATEGORIES.index("other")] = CATEGORY_SCALE
+    return v
+
+
+def featurize(records: Sequence[RoutingRecord],
+              embed_fn: Optional[Callable[[Sequence[str]], np.ndarray]]
+              = None) -> Tuple[np.ndarray, List[str], Dict[str, int]]:
+    """Group records per query → (features [N, d+14], best-model labels).
+
+    Best model per query = highest quality; ties within 0.02 go to the
+    lower-latency model (the reference's quality-first, efficiency
+    tie-break)."""
+    by_q: Dict[str, List[RoutingRecord]] = {}
+    for r in records:
+        by_q.setdefault(r.query, []).append(r)
+    queries = list(by_q)
+    embed_fn = embed_fn or hash_embed
+    embs = np.asarray(embed_fn(queries), np.float32)
+    feats, labels = [], []
+    for qi, q in enumerate(queries):
+        rs = by_q[q]
+        best = max(rs, key=lambda r: (round(r.quality / 0.02),
+                                      -r.latency_ms))
+        feats.append(np.concatenate([embs[qi],
+                                     category_onehot(rs[0].category)]))
+        labels.append(best.model)
+    counts: Dict[str, int] = {}
+    for l in labels:
+        counts[l] = counts.get(l, 0) + 1
+    return np.stack(feats), labels, counts
+
+
+class CategoryFeatureSelector:
+    """Serving adapter for artifacts trained on embedding ⊕ category
+    one-hot features. The serving pipeline's ``ctx.embedding()`` yields
+    the RAW query embedding; this wrapper appends the scaled one-hot from
+    ``ctx.category`` / ``fb.category`` before the inner selector sees it,
+    so the feature space the weights were trained in actually exists at
+    serving time."""
+
+    def __init__(self, inner) -> None:
+        self.inner = inner
+        self.name = getattr(inner, "name", "trained")
+
+    @staticmethod
+    def _augment_ctx(ctx):
+        base_fn = ctx.embed_fn
+        if base_fn is None:
+            return ctx
+        cat = ctx.category
+
+        def embed_fn(q):
+            e = np.asarray(base_fn(q), np.float32)
+            return np.concatenate([e, category_onehot(cat)])
+
+        return dataclasses.replace(ctx, embed_fn=embed_fn,
+                                   _embedding=None)
+
+    def select(self, candidates, ctx):
+        return self.inner.select(candidates, self._augment_ctx(ctx))
+
+    def update(self, fb) -> None:
+        if fb.query_embedding is not None:
+            fb = dataclasses.replace(fb, query_embedding=np.concatenate(
+                [np.asarray(fb.query_embedding, np.float32),
+                 category_onehot(fb.category)]))
+        self.inner.update(fb)
+
+
+# -- trainers -------------------------------------------------------------
+
+
+def _tag_features(blob: str, feats: np.ndarray) -> str:
+    """Record the feature recipe in the artifact so the loader can
+    reconstruct it at serving time."""
+    data = json.loads(blob)
+    data["features"] = {"category_onehot": True,
+                        "category_scale": CATEGORY_SCALE,
+                        "embedding_dim": int(feats.shape[1])
+                        - len(CATEGORIES)}
+    return json.dumps(data)
+
+
+def train_selector(algorithm: str, feats: np.ndarray,
+                   labels: Sequence[str],
+                   records: Optional[Sequence[RoutingRecord]] = None,
+                   embed_fn=None, **kwargs) -> str:
+    """Fit one algorithm; return its JSON artifact."""
+    from ..selection.ml import (
+        GMTRouterSelector,
+        KMeansSelector,
+        KNNSelector,
+        MLPSelector,
+        SVMSelector,
+    )
+
+    if algorithm == "mlp":
+        sel = MLPSelector(**kwargs)
+        sel.fit(feats, labels)
+        return _tag_features(sel.to_json(), feats)
+    if algorithm == "svm":
+        sel = SVMSelector(**kwargs)
+        sel.fit(feats, labels)
+        return _tag_features(sel.to_json(), feats)
+    if algorithm == "knn":
+        sel = KNNSelector(**kwargs)
+        for f, l in zip(feats, labels):
+            sel.memory.add(f, l, 1.0)
+        return _tag_features(sel.to_json(), feats)
+    if algorithm == "kmeans":
+        sel = KMeansSelector(
+            n_clusters=kwargs.pop("n_clusters", 8), **kwargs)
+        for f, l in zip(feats, labels):
+            sel.memory.add(f, l, 1.0)
+        sel._maybe_fit()
+        # freeze: a restored artifact has centroids but an empty memory;
+        # an online refit from ~64 fresh points would orphan the trained
+        # cluster→model mapping (refit_every round-trips via to_json)
+        sel.refit_every = 1 << 30
+        return _tag_features(sel.to_json(), feats)
+    if algorithm == "gmtrouter":
+        # RL-style offline pre-training: replay the historical
+        # interactions through the online learner (every record, not just
+        # winners). Rewards are ADVANTAGE-normalized per query (quality
+        # minus the query's mean across candidates): a model that is good
+        # everywhere must not win every cluster edge — only where it
+        # beats the alternatives.
+        from ..selection.base import Feedback
+
+        sel = GMTRouterSelector(n_nodes=kwargs.pop("n_nodes", 8), **kwargs)
+        assert records is not None
+        queries = sorted({r.query for r in records})
+        embs = np.asarray((embed_fn or hash_embed)(queries), np.float32)
+        emb_by_q = {q: embs[i] for i, q in enumerate(queries)}
+        cat_by_q = {r.query: r.category for r in records}
+        # pass 1: fit the node clusters on the full query-feature set and
+        # FREEZE them — edges learned against moving centroids end up
+        # attributed to the wrong node.
+        for f, l in zip(feats, labels):
+            sel.kmeans.memory.add(np.asarray(f, np.float32), l, 1.0)
+        sel.kmeans._maybe_fit()
+        sel.kmeans.refit_every = 1 << 30
+        # pass 2: replay outcomes onto the frozen graph.
+        mean_q: Dict[str, List[float]] = {}
+        for r in records:
+            mean_q.setdefault(r.query, []).append(r.quality)
+        for r in records:
+            adv = r.quality - float(np.mean(mean_q[r.query]))
+            feat = np.concatenate([emb_by_q[r.query],
+                                   category_onehot(cat_by_q[r.query])])
+            sel.update(Feedback(model=r.model, success=adv > 0,
+                                quality=float(np.clip(0.5 + 2 * adv, 0, 1)),
+                                latency_ms=r.latency_ms,
+                                query_embedding=feat))
+        return _tag_features(sel.to_json(), feats)
+    raise ValueError(f"unknown algorithm {algorithm!r}")
+
+
+def load_selector(path: str):
+    """Load a trained artifact back into its serving selector, wrapped so
+    it consumes the raw embeddings the serving pipeline produces."""
+    from ..selection.ml import (
+        GMTRouterSelector,
+        KMeansSelector,
+        KNNSelector,
+        MLPSelector,
+        SVMSelector,
+    )
+
+    with open(path) as f:
+        blob = f.read()
+    data = json.loads(blob)
+    algo = data["algorithm"]
+    cls = {"knn": KNNSelector, "kmeans": KMeansSelector,
+           "svm": SVMSelector, "mlp": MLPSelector,
+           "gmtrouter": GMTRouterSelector}[algo]
+    sel = cls.from_json(blob)
+    if data.get("features", {}).get("category_onehot"):
+        return CategoryFeatureSelector(sel)
+    return sel
+
+
+def evaluate_artifact(path: str, records: Sequence[RoutingRecord],
+                      embed_fn=None) -> float:
+    """Routing accuracy of a trained artifact on a record set: fraction
+    of queries where the selector picks the best model. Drives the
+    SERVING contract — raw query embedding via ``ctx.embed_fn`` plus
+    ``ctx.category`` — not the trainer's internal feature rows."""
+    from ..config.schema import ModelRef
+    from ..selection.base import SelectionContext
+
+    sel = load_selector(path)
+    _, labels, _ = featurize(records, embed_fn)
+    by_q: Dict[str, RoutingRecord] = {}
+    for r in records:
+        by_q.setdefault(r.query, r)
+    queries = list(by_q)
+    embs = np.asarray((embed_fn or hash_embed)(queries), np.float32)
+    models = sorted({r.model for r in records})
+    cands = [ModelRef(model=m) for m in models]
+    hits = 0
+    for qi, (q, gold) in enumerate(zip(queries, labels)):
+        ctx = SelectionContext(query=q, category=by_q[q].category,
+                               embed_fn=lambda _q, e=embs[qi]: e)
+        got = sel.select(cands, ctx)
+        hits += int(got.ref.model == gold)
+    return hits / max(len(labels), 1)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    ap = argparse.ArgumentParser(description="ML/RL selection training")
+    ap.add_argument("--data-file", default="",
+                    help="routing-benchmark JSONL (default: synthetic)")
+    ap.add_argument("--output-dir", default="models/selection")
+    ap.add_argument("--algorithms", default="knn,kmeans,svm,mlp,gmtrouter")
+    args = ap.parse_args(argv)
+
+    records = (load_routing_jsonl(args.data_file) if args.data_file
+               else synthetic_routing_dataset())
+    feats, labels, counts = featurize(records)
+    os.makedirs(args.output_dir, exist_ok=True)
+    report = {"queries": len(labels), "label_counts": counts}
+    for algo in args.algorithms.split(","):
+        algo = algo.strip()
+        blob = train_selector(algo, feats, labels, records=records)
+        path = os.path.join(args.output_dir, f"{algo}.json")
+        with open(path, "w") as f:
+            f.write(blob)
+        report[algo] = {"artifact": path,
+                        "accuracy": round(evaluate_artifact(path, records),
+                                          4)}
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()
