@@ -302,9 +302,38 @@ class StreamedBodyHandler:
         if self.pool is None or "messages" not in fields:
             return
         proj = {k: fields.get(k) for k in _SIGNAL_FIELDS}
+        if proj == self._prefetch_proj:
+            # same signal view as the last decision — whether that was a
+            # running prefetch or a rate-limit decline, it stands (the
+            # decline cache matters: without it an over-limit client
+            # would force a full field re-parse on every chunk)
+            return
+        # rate-limit preview BEFORE any parsing or speculative
+        # classification: route() checks the limiter before signal work,
+        # and the prefetch must not hand an over-limit client a way to
+        # burn classifier capacity (or parse CPU) that route() would
+        # never have spent. peek consumes nothing; the authoritative
+        # check still happens in route(). The user is taken from the
+        # trusted header first, else the body's ``user`` field if it has
+        # already arrived — if it arrives later, the proj changes and
+        # this re-runs with the real identity.
+        limiter = getattr(self.router, "rate_limiter", None)
+        if limiter is not None:
+            user = ""
+            for k, v in self.headers.items():
+                if k.lower() == "x-authz-user-id":
+                    user = v
+                    break
+            if not user and fields.get("user") is not None:
+                try:
+                    user = str(json.loads(fields["user"]))
+                except ValueError:
+                    user = ""
+            if not limiter.peek(user, self.model or "auto"):
+                self._cancel_prefetch()
+                self._prefetch_proj = proj  # cache the decline
+                return
         if self._prefetch is not None:
-            if proj == self._prefetch_proj:
-                return  # same signal view: the running prefetch stands
             # a signal-relevant field completed after kickoff (e.g. a
             # tools array that followed messages): restart with the
             # richer view so the result stays reusable
@@ -320,13 +349,19 @@ class StreamedBodyHandler:
         if not isinstance(body.get("messages"), list):
             return
         body.setdefault("model", self.model or "auto")
+        headers = dict(self.headers)
         self._prefetch_body = body
         self._prefetch_proj = proj
         self.prefetch_started_at = self.chunks_seen
-        headers = dict(self.headers)
         router = self.router
         self._prefetch = self.pool.submit(
             router.evaluate_signals, dict(body), headers)
+
+    def _cancel_prefetch(self) -> None:
+        if self._prefetch is not None:
+            self._prefetch.cancel()
+            self._prefetch = None
+            self._prefetch_body = None
 
     def _finish(self):
         raw = bytes(self.buf)
@@ -341,10 +376,18 @@ class StreamedBodyHandler:
         if self._prefetch is not None:
             pre = self._prefetch_body or {}
             if all(pre.get(k) == body.get(k) for k in _SIGNAL_FIELDS):
-                try:
-                    signals = self._prefetch.result(timeout=30)
-                except Exception:
+                # cancel-first: if the future is still QUEUED behind other
+                # streams' work (shared small pool), cancel() succeeds and
+                # route() evaluates inline immediately — waiting on an
+                # unstarted future would add queueing delay on top of the
+                # inline work it doesn't save
+                if self._prefetch.cancel():
                     signals = None
+                else:
+                    try:
+                        signals = self._prefetch.result(timeout=30)
+                    except Exception:
+                        signals = None
             else:
                 # the final body's signal view differs from what the
                 # prefetch saw (late field, duplicate key): inline

@@ -230,6 +230,26 @@ class Router:
     # request path
     # ------------------------------------------------------------------
 
+    def _prepare_signal_view(self, ctx, headers: Dict[str, str]
+                             ) -> List[str]:
+        """The ONE place that decides what reaches the classifiers:
+        applies prompt compression to ``ctx`` in-place and returns the
+        skip-signals list. route() and evaluate_signals() both call this —
+        the streamed prefetch's signal reuse is only sound if the two
+        paths can never drift."""
+        if self.compressor is not None \
+                and ctx.approx_token_count() >= self.pc_min_tokens:
+            ctx._user_text = self.compressor.compress(ctx.user_text).text
+        # Signal families are dropped from operator config; the request
+        # header is honored only behind the same opt-in (a client must not
+        # be able to empty e.g. the pii family and dodge the block policy).
+        skip = list(self._skip_signals_cfg)
+        if self._skip_enabled and self._allow_skip_signals_header:
+            skip += [s.strip() for s in
+                     headers.get("x-vsr-skip-signals", "").split(",")
+                     if s.strip()]
+        return skip
+
     def evaluate_signals(self, body: Dict[str, Any],
                          headers: Optional[Dict[str, str]] = None):
         """Signal extraction EXACTLY as route() performs it (compression
@@ -239,14 +259,7 @@ class Router:
         (processor_req_body_streamed.go early-detection role)."""
         headers = {k.lower(): v for k, v in (headers or {}).items()}
         ctx = RequestContext.from_openai_body(body, headers)
-        if self.compressor is not None \
-                and ctx.approx_token_count() >= self.pc_min_tokens:
-            ctx._user_text = self.compressor.compress(ctx.user_text).text
-        skip = list(self._skip_signals_cfg)
-        if self._skip_enabled and self._allow_skip_signals_header:
-            skip += [s.strip() for s in
-                     headers.get("x-vsr-skip-signals", "").split(",")
-                     if s.strip()]
+        skip = self._prepare_signal_view(ctx, headers)
         return self.dispatcher.evaluate(ctx, skip_signals=skip)
 
     def route(self, body: Dict[str, Any],
@@ -277,20 +290,12 @@ class Router:
             return RouteResult(kind="passthrough", body=body,
                                request_id=request_id)
 
-        # prompt compression bounds what reaches the classifiers
-        if self.compressor is not None \
-                and ctx.approx_token_count() >= self.pc_min_tokens:
-            compressed = self.compressor.compress(ctx.user_text)
-            ctx._user_text = compressed.text
-
-        # Signal families are dropped from operator config; the request
-        # header is honored only behind the same opt-in (a client must not
-        # be able to empty e.g. the pii family and dodge the block policy).
-        skip = list(self._skip_signals_cfg)
-        if self._skip_enabled and self._allow_skip_signals_header:
-            skip += [s.strip() for s in
-                     headers.get("x-vsr-skip-signals", "").split(",")
-                     if s.strip()]
+        # compression + skip config — shared with evaluate_signals() so a
+        # prefetched view and the inline view can never diverge. The
+        # compression side-effect on ctx is needed even when signals were
+        # prefetched: cache lookup / selection / memory all read
+        # ctx.user_text downstream.
+        skip = self._prepare_signal_view(ctx, headers)
         if precomputed_signals is not None:
             # streamed-frontend overlap: signals were evaluated while
             # the body was still arriving (same text, same skip config)

@@ -30,17 +30,28 @@ class TokenBucket:
         self.last = time.monotonic()
         self._lock = threading.Lock()
 
+    def _refill(self) -> None:
+        """Clock-refresh + token top-up (callers hold the lock) — the ONE
+        refill definition so take() and peek() can never disagree."""
+        now = time.monotonic()
+        self.tokens = min(self.burst,
+                          self.tokens + (now - self.last) * self.rate)
+        self.last = now
+
     def take(self, n: float = 1.0) -> Tuple[bool, float]:
         with self._lock:
-            now = time.monotonic()
-            self.tokens = min(self.burst,
-                              self.tokens + (now - self.last) * self.rate)
-            self.last = now
+            self._refill()
             if self.tokens >= n:
                 self.tokens -= n
                 return True, 0.0
             needed = (n - self.tokens) / self.rate if self.rate > 0 else 60.0
             return False, needed
+
+    def peek(self, n: float = 1.0) -> bool:
+        """Would ``take(n)`` succeed right now? Consumes nothing."""
+        with self._lock:
+            self._refill()
+            return self.tokens >= n
 
 
 class RateLimiter:
@@ -102,3 +113,18 @@ class RateLimiter:
                 self._buckets[key] = bucket
         ok, wait = bucket.take()
         return RateLimitDecision(ok, source="local", retry_after_s=wait)
+
+    def peek(self, user: str = "", model: str = "") -> bool:
+        """Non-consuming local-bucket preview: False only when the bucket
+        for (user, model) is currently empty. Remote RLS is NOT consulted
+        (a remote check may itself count against the budget) — this is a
+        cheap guard for speculative work (signal prefetch), not an
+        enforcement point: route() still runs the real check()."""
+        rpm = self._rpm_for(user, model)
+        if rpm <= 0:
+            return True
+        with self._lock:
+            bucket = self._buckets.get((user, model))
+        if bucket is None:
+            return True  # nothing consumed yet → first take will pass
+        return bucket.peek()

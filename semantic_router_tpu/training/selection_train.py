@@ -143,28 +143,36 @@ def category_onehot(cat: str) -> np.ndarray:
     return v
 
 
-def featurize(records: Sequence[RoutingRecord],
-              embed_fn: Optional[Callable[[Sequence[str]], np.ndarray]]
-              = None) -> Tuple[np.ndarray, List[str], Dict[str, int]]:
-    """Group records per query → (features [N, d+14], best-model labels).
-
-    Best model per query = highest quality; ties within 0.02 go to the
+def group_best(records: Sequence[RoutingRecord]
+               ) -> Tuple[List[str], List[str], List[str]]:
+    """Per unique query (first-seen order): (queries, best-model labels,
+    categories). Best = highest quality; ties within 0.02 go to the
     lower-latency model (the reference's quality-first, efficiency
-    tie-break)."""
+    tie-break). No embedding work happens here."""
     by_q: Dict[str, List[RoutingRecord]] = {}
     for r in records:
         by_q.setdefault(r.query, []).append(r)
     queries = list(by_q)
-    embed_fn = embed_fn or hash_embed
-    embs = np.asarray(embed_fn(queries), np.float32)
-    feats, labels = [], []
-    for qi, q in enumerate(queries):
+    labels, cats = [], []
+    for q in queries:
         rs = by_q[q]
         best = max(rs, key=lambda r: (round(r.quality / 0.02),
                                       -r.latency_ms))
-        feats.append(np.concatenate([embs[qi],
-                                     category_onehot(rs[0].category)]))
         labels.append(best.model)
+        cats.append(rs[0].category)
+    return queries, labels, cats
+
+
+def featurize(records: Sequence[RoutingRecord],
+              embed_fn: Optional[Callable[[Sequence[str]], np.ndarray]]
+              = None) -> Tuple[np.ndarray, List[str], Dict[str, int]]:
+    """Group records per query → (features [N, d+14], best-model labels,
+    label counts). One embedding pass over the unique queries."""
+    queries, labels, cats = group_best(records)
+    embed_fn = embed_fn or hash_embed
+    embs = np.asarray(embed_fn(queries), np.float32)
+    feats = [np.concatenate([embs[qi], category_onehot(c)])
+             for qi, c in enumerate(cats)]
     counts: Dict[str, int] = {}
     for l in labels:
         counts[l] = counts.get(l, 0) + 1
@@ -269,7 +277,10 @@ def train_selector(algorithm: str, feats: np.ndarray,
         from ..selection.base import Feedback
 
         sel = GMTRouterSelector(n_nodes=kwargs.pop("n_nodes", 8), **kwargs)
-        assert records is not None
+        if records is None:
+            raise ValueError(
+                "gmtrouter pre-training requires the full records (it "
+                "replays every outcome, not just per-query winners)")
         queries = sorted({r.query for r in records})
         embs = np.asarray((embed_fn or hash_embed)(queries), np.float32)
         emb_by_q = {q: embs[i] for i, q in enumerate(queries)}
@@ -322,26 +333,27 @@ def load_selector(path: str):
 
 
 def evaluate_artifact(path: str, records: Sequence[RoutingRecord],
-                      embed_fn=None) -> float:
+                      embed_fn=None,
+                      embeddings: Optional[np.ndarray] = None) -> float:
     """Routing accuracy of a trained artifact on a record set: fraction
     of queries where the selector picks the best model. Drives the
     SERVING contract — raw query embedding via ``ctx.embed_fn`` plus
-    ``ctx.category`` — not the trainer's internal feature rows."""
+    ``ctx.category`` — not the trainer's internal feature rows.
+    Pass ``embeddings`` (aligned with the unique-query order of
+    ``group_best``) to reuse an existing embedding pass — with a real
+    embedding model the corpus pass is the expensive part."""
     from ..config.schema import ModelRef
     from ..selection.base import SelectionContext
 
     sel = load_selector(path)
-    _, labels, _ = featurize(records, embed_fn)
-    by_q: Dict[str, RoutingRecord] = {}
-    for r in records:
-        by_q.setdefault(r.query, r)
-    queries = list(by_q)
-    embs = np.asarray((embed_fn or hash_embed)(queries), np.float32)
+    queries, labels, cats = group_best(records)
+    embs = (np.asarray(embeddings, np.float32) if embeddings is not None
+            else np.asarray((embed_fn or hash_embed)(queries), np.float32))
     models = sorted({r.model for r in records})
     cands = [ModelRef(model=m) for m in models]
     hits = 0
-    for qi, (q, gold) in enumerate(zip(queries, labels)):
-        ctx = SelectionContext(query=q, category=by_q[q].category,
+    for qi, (q, gold, cat) in enumerate(zip(queries, labels, cats)):
+        ctx = SelectionContext(query=q, category=cat,
                                embed_fn=lambda _q, e=embs[qi]: e)
         got = sel.select(cands, ctx)
         hits += int(got.ref.model == gold)
@@ -359,6 +371,9 @@ def main(argv: Optional[List[str]] = None) -> None:
     records = (load_routing_jsonl(args.data_file) if args.data_file
                else synthetic_routing_dataset())
     feats, labels, counts = featurize(records)
+    # ONE embedding pass serves every algorithm's evaluation (features
+    # above already embedded once; feats = embs ⊕ one-hot, slice back)
+    embs = feats[:, :feats.shape[1] - len(CATEGORIES)]
     os.makedirs(args.output_dir, exist_ok=True)
     report = {"queries": len(labels), "label_counts": counts}
     for algo in args.algorithms.split(","):
@@ -368,8 +383,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         with open(path, "w") as f:
             f.write(blob)
         report[algo] = {"artifact": path,
-                        "accuracy": round(evaluate_artifact(path, records),
-                                          4)}
+                        "accuracy": round(evaluate_artifact(
+                            path, records, embeddings=embs), 4)}
     print(json.dumps(report))
 
 
