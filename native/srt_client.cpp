@@ -1,0 +1,429 @@
+// srt_client.cpp — C ABI wire client for the semantic-router-tpu engine.
+// See srt_client.h for the design note (reference:
+// candle-binding/semantic-router.go:27-550 extern surface).
+//
+// Zero dependencies beyond POSIX sockets and the C++17 standard library:
+// a blocking HTTP/1.1 client plus a small recursive-descent JSON reader
+// covering exactly the value shapes the management API returns.
+
+#include "srt_client.h"
+
+#include <arpa/inet.h>
+#include <locale.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace {
+
+// -- global endpoint (set once by srt_init) -----------------------------
+
+std::string g_host;
+int g_port = 0;
+std::string g_api_key;
+bool g_inited = false;
+
+// -- minimal JSON value --------------------------------------------------
+
+struct JValue {
+  enum Kind { Null, Bool, Num, Str, Arr, Obj } kind = Null;
+  bool b = false;
+  double num = 0.0;
+  std::string str;
+  std::vector<JValue> arr;
+  std::map<std::string, JValue> obj;
+
+  const JValue* get(const std::string& k) const {
+    if (kind != Obj) return nullptr;
+    auto it = obj.find(k);
+    return it == obj.end() ? nullptr : &it->second;
+  }
+};
+
+struct JParser {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  explicit JParser(const std::string& s)
+      : p(s.data()), end(s.data() + s.size()) {}
+
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+  bool lit(const char* s, size_t n) {
+    if (size_t(end - p) < n || memcmp(p, s, n) != 0) return ok = false;
+    p += n;
+    return true;
+  }
+
+  JValue parse() {
+    JValue v = value();
+    ws();
+    if (p != end) ok = false;
+    return v;
+  }
+
+  JValue value() {
+    ws();
+    if (p >= end) { ok = false; return {}; }
+    switch (*p) {
+      case '{': return object();
+      case '[': return array();
+      case '"': { JValue v; v.kind = JValue::Str; v.str = string(); return v; }
+      case 't': { JValue v; v.kind = JValue::Bool; v.b = true; lit("true", 4); return v; }
+      case 'f': { JValue v; v.kind = JValue::Bool; v.b = false; lit("false", 5); return v; }
+      case 'n': { lit("null", 4); return {}; }
+      default:  return number();
+    }
+  }
+
+  JValue object() {
+    JValue v; v.kind = JValue::Obj;
+    ++p;  // '{'
+    ws();
+    if (p < end && *p == '}') { ++p; return v; }
+    while (ok && p < end) {
+      ws();
+      if (p >= end || *p != '"') { ok = false; break; }
+      std::string key = string();
+      ws();
+      if (p >= end || *p != ':') { ok = false; break; }
+      ++p;
+      v.obj[key] = value();
+      ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == '}') { ++p; break; }
+      ok = false; break;
+    }
+    return v;
+  }
+
+  JValue array() {
+    JValue v; v.kind = JValue::Arr;
+    ++p;  // '['
+    ws();
+    if (p < end && *p == ']') { ++p; return v; }
+    while (ok && p < end) {
+      v.arr.push_back(value());
+      ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == ']') { ++p; break; }
+      ok = false; break;
+    }
+    return v;
+  }
+
+  std::string string() {
+    std::string out;
+    ++p;  // opening quote
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        ++p;
+        switch (*p) {
+          case 'n': out += '\n'; break;
+          case 't': out += '\t'; break;
+          case 'r': out += '\r'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'u': {
+            if (end - p < 5) { ok = false; return out; }
+            unsigned cp = 0;
+            sscanf(p + 1, "%4x", &cp);
+            p += 4;
+            // surrogate pair: the server json.dumps's ensure_ascii
+            // escapes non-BMP text (emoji in echoed user input) as
+            // \uD800-\uDBFF + \uDC00-\uDFFF — combine, or fold a lone
+            // surrogate to U+FFFD rather than emit invalid UTF-8
+            if (cp >= 0xD800 && cp <= 0xDBFF) {
+              if (end - p >= 7 && p[1] == '\\' && p[2] == 'u') {
+                unsigned lo = 0;
+                sscanf(p + 3, "%4x", &lo);
+                if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                  cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                  p += 6;
+                } else {
+                  cp = 0xFFFD;
+                }
+              } else {
+                cp = 0xFFFD;
+              }
+            } else if (cp >= 0xDC00 && cp <= 0xDFFF) {
+              cp = 0xFFFD;  // lone low surrogate
+            }
+            if (cp < 0x80) out += char(cp);
+            else if (cp < 0x800) {
+              out += char(0xC0 | (cp >> 6));
+              out += char(0x80 | (cp & 0x3F));
+            } else if (cp < 0x10000) {
+              out += char(0xE0 | (cp >> 12));
+              out += char(0x80 | ((cp >> 6) & 0x3F));
+              out += char(0x80 | (cp & 0x3F));
+            } else {
+              out += char(0xF0 | (cp >> 18));
+              out += char(0x80 | ((cp >> 12) & 0x3F));
+              out += char(0x80 | ((cp >> 6) & 0x3F));
+              out += char(0x80 | (cp & 0x3F));
+            }
+            break;
+          }
+          default: out += *p;
+        }
+      } else {
+        out += *p;
+      }
+      ++p;
+    }
+    if (p >= end) { ok = false; return out; }
+    ++p;  // closing quote
+    return out;
+  }
+
+  JValue number() {
+    JValue v; v.kind = JValue::Num;
+    char* stop = nullptr;
+    // strtod_l with a pinned C locale: the host process embedding this
+    // library may have set a comma-decimal locale (setlocale in a GUI
+    // toolkit), which would make plain strtod stop at the '.' of every
+    // wire float.
+    static locale_t c_loc = newlocale(LC_ALL_MASK, "C", nullptr);
+    v.num = strtod_l(p, &stop, c_loc);
+    if (stop == p) { ok = false; return v; }
+    p = stop;
+    return v;
+  }
+};
+
+std::string json_escape(const char* s) {
+  std::string out;
+  for (const char* c = s; *c; ++c) {
+    switch (*c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if ((unsigned char)*c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", *c);
+          out += buf;
+        } else {
+          out += *c;
+        }
+    }
+  }
+  return out;
+}
+
+// -- blocking HTTP/1.1 over a fresh localhost connection -----------------
+
+int dial(const std::string& host, int port) {
+  addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  char portbuf[16];
+  snprintf(portbuf, sizeof portbuf, "%d", port);
+  if (getaddrinfo(host.c_str(), portbuf, &hints, &res) != 0) return -1;
+  int fd = -1;
+  for (addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    timeval tv{30, 0};  // the engine may be cold-compiling a bucket
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  return fd;
+}
+
+bool send_all(int fd, const std::string& data) {
+  size_t off = 0;
+  while (off < data.size()) {
+    ssize_t n = send(fd, data.data() + off, data.size() - off, 0);
+    if (n <= 0) return false;
+    off += size_t(n);
+  }
+  return true;
+}
+
+// Returns HTTP status, fills body. Handles Content-Length and
+// connection-close framing (the router replies Content-Length).
+int http_request(const std::string& method, const std::string& path,
+                 const std::string& body, std::string* out_body) {
+  if (g_host.empty()) return -1;
+  int fd = dial(g_host, g_port);
+  if (fd < 0) return -1;
+  std::string req = method + " " + path + " HTTP/1.1\r\n" +
+                    "Host: " + g_host + "\r\n" +
+                    "Connection: close\r\n" +
+                    "Content-Type: application/json\r\n";
+  if (!g_api_key.empty())
+    req += "Authorization: Bearer " + g_api_key + "\r\n";
+  req += "Content-Length: " + std::to_string(body.size()) + "\r\n\r\n";
+  req += body;
+  if (!send_all(fd, req)) { close(fd); return -1; }
+  std::string resp;
+  char buf[8192];
+  ssize_t n;
+  while ((n = recv(fd, buf, sizeof buf, 0)) > 0) resp.append(buf, size_t(n));
+  close(fd);
+  size_t hdr_end = resp.find("\r\n\r\n");
+  if (hdr_end == std::string::npos) return -1;
+  int status = 0;
+  if (sscanf(resp.c_str(), "HTTP/1.%*d %d", &status) != 1) return -1;
+  *out_body = resp.substr(hdr_end + 4);
+  return status;
+}
+
+bool post_json(const std::string& path, const std::string& body,
+               JValue* out) {
+  std::string resp;
+  int status = http_request("POST", path, body, &resp);
+  if (status != 200) return false;
+  JParser parser(resp);
+  *out = parser.parse();
+  return parser.ok;
+}
+
+char* dup_cstr(const std::string& s) {
+  char* out = static_cast<char*>(malloc(s.size() + 1));
+  if (out) memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+}  // namespace
+
+// -- C ABI ---------------------------------------------------------------
+
+extern "C" {
+
+bool srt_init(const char* host, int port, const char* api_key) {
+  g_host = host ? host : "127.0.0.1";
+  g_port = port;
+  g_api_key = api_key ? api_key : "";
+  std::string resp;
+  int status = http_request("GET", "/health", "", &resp);
+  g_inited = (status == 200);
+  return g_inited;
+}
+
+bool srt_is_initialized(void) {
+  if (!g_inited) return false;
+  std::string resp;
+  return http_request("GET", "/health", "", &resp) == 200;
+}
+
+SrtClassResult srt_classify_text(const char* task, const char* text) {
+  SrtClassResult r{nullptr, -1.0f, -1};
+  if (!task || !text) return r;
+  JValue v;
+  std::string body = std::string("{\"text\": \"") + json_escape(text) +
+                     "\"}";
+  if (!post_json(std::string("/api/v1/classify/") + task, body, &v))
+    return r;
+  const JValue* label = v.get("label");
+  const JValue* conf = v.get("confidence");
+  if (!label || label->kind != JValue::Str) return r;
+  r.label = dup_cstr(label->str);
+  r.confidence = conf && conf->kind == JValue::Num ? float(conf->num)
+                                                   : 0.0f;
+  // class_idx stays -1 (the documented error/unknown value) when the
+  // server predates the field — 0 would silently mean "class 0"
+  const JValue* idx = v.get("class_idx");
+  if (idx && idx->kind == JValue::Num) r.class_idx = int(idx->num);
+  return r;
+}
+
+void srt_free_class_result(SrtClassResult r) { free(r.label); }
+
+SrtTokenResult srt_classify_pii_tokens(const char* text) {
+  SrtTokenResult r{nullptr, -1};
+  if (!text) return r;
+  JValue v;
+  std::string body = std::string("{\"text\": \"") + json_escape(text) +
+                     "\"}";
+  if (!post_json("/api/v1/classify/pii", body, &v)) return r;
+  const JValue* ents = v.get("entities");
+  if (!ents || ents->kind != JValue::Arr) return r;
+  r.num_entities = int(ents->arr.size());
+  if (r.num_entities == 0) return r;
+  r.entities = static_cast<SrtTokenEntity*>(
+      calloc(size_t(r.num_entities), sizeof(SrtTokenEntity)));
+  for (int i = 0; i < r.num_entities; ++i) {
+    const JValue& e = ents->arr[size_t(i)];
+    // the server serializes EntitySpan.__dict__: keys are "type" and
+    // "score" (engine/classify.py EntitySpan); accept the long
+    // spellings too for forward compatibility
+    const JValue* et = e.get("type");
+    if (!et) et = e.get("entity_type");
+    const JValue* tx = e.get("text");
+    const JValue* st = e.get("start");
+    const JValue* en = e.get("end");
+    const JValue* cf = e.get("score");
+    if (!cf) cf = e.get("confidence");
+    r.entities[i].entity_type =
+        dup_cstr(et && et->kind == JValue::Str ? et->str : "");
+    r.entities[i].text =
+        dup_cstr(tx && tx->kind == JValue::Str ? tx->str : "");
+    r.entities[i].start = st && st->kind == JValue::Num ? int(st->num) : 0;
+    r.entities[i].end = en && en->kind == JValue::Num ? int(en->num) : 0;
+    r.entities[i].confidence =
+        cf && cf->kind == JValue::Num ? float(cf->num) : 0.0f;
+  }
+  return r;
+}
+
+void srt_free_token_result(SrtTokenResult r) {
+  for (int i = 0; i < r.num_entities && r.entities; ++i) {
+    free(r.entities[i].entity_type);
+    free(r.entities[i].text);
+  }
+  free(r.entities);
+}
+
+SrtEmbedding srt_get_embedding(const char* text, int dim) {
+  SrtEmbedding out{nullptr, -1};
+  if (!text) return out;
+  JValue v;
+  std::string body = std::string("{\"input\": \"") + json_escape(text) +
+                     "\"";
+  if (dim > 0) body += ", \"dimensions\": " + std::to_string(dim);
+  body += "}";
+  if (!post_json("/api/v1/embeddings", body, &v)) return out;
+  const JValue* data = v.get("data");
+  if (!data || data->kind != JValue::Arr || data->arr.empty()) return out;
+  const JValue* emb = data->arr[0].get("embedding");
+  if (!emb || emb->kind != JValue::Arr) return out;
+  out.dim = int(emb->arr.size());
+  out.data = static_cast<float*>(malloc(sizeof(float) * size_t(out.dim)));
+  for (int i = 0; i < out.dim; ++i)
+    out.data[i] = float(emb->arr[size_t(i)].num);
+  return out;
+}
+
+void srt_free_embedding(SrtEmbedding e) { free(e.data); }
+
+float srt_calculate_similarity(const char* text1, const char* text2) {
+  if (!text1 || !text2) return -1.0f;
+  JValue v;
+  std::string body = std::string("{\"text_a\": \"") + json_escape(text1) +
+                     "\", \"text_b\": \"" + json_escape(text2) + "\"}";
+  if (!post_json("/api/v1/similarity", body, &v)) return -1.0f;
+  const JValue* sim = v.get("similarity");
+  return sim && sim->kind == JValue::Num ? float(sim->num) : -1.0f;
+}
+
+}  // extern "C"

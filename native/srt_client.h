@@ -1,0 +1,87 @@
+/* srt_client.h — C ABI for embedding the semantic-router-tpu engine in
+ * non-Python data planes (Go/cgo, Rust/bindgen, C++).
+ *
+ * Reference role: candle-binding/semantic-router.go:27-550 — the 116-extern
+ * FFI surface a Go data plane links against. The TPU re-design keeps the
+ * init_* / classify_* / free_* call shapes but the implementation is a thin
+ * wire client: the engine lives in the router process (XLA programs are not
+ * embeddable the way candle graphs are), and this library speaks to its
+ * management API over a local socket. That preserves the reference's
+ * process model where it matters (one shared classifier bank, many data
+ * planes) while staying TPU-native.
+ *
+ * Thread-safety: every call opens its own connection; no shared mutable
+ * state beyond the init-time endpoint (set once, read-only afterwards).
+ * All returned heap memory is owned by the caller and released via the
+ * matching srt_free_* function.
+ */
+#ifndef SRT_CLIENT_H
+#define SRT_CLIENT_H
+
+#include <stdbool.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* -- lifecycle (init_* family) ------------------------------------------ */
+
+/* Point the client at a router management endpoint. api_key may be NULL
+ * when the server runs without RBAC. Returns true when /health answers. */
+bool srt_init(const char* host, int port, const char* api_key);
+
+/* is_*_initialized family: true after a successful srt_init and while the
+ * server still answers /health. */
+bool srt_is_initialized(void);
+
+/* -- sequence classification (classify_text family) --------------------- */
+
+typedef struct {
+  char* label;      /* owned; NULL on error */
+  float confidence; /* -1.0 on error */
+  int   class_idx;  /* index into the task's label set; -1 on error */
+} SrtClassResult;
+
+/* task: engine task name ("intent", "security", "fact-check", ...) mapped
+ * onto POST /api/v1/classify/<task>. */
+SrtClassResult srt_classify_text(const char* task, const char* text);
+void srt_free_class_result(SrtClassResult r);
+
+/* -- token classification (classify_modernbert_pii_tokens family) ------- */
+
+typedef struct {
+  char* entity_type; /* owned */
+  int   start;       /* byte offsets into the input text */
+  int   end;
+  char* text;        /* owned */
+  float confidence;
+} SrtTokenEntity;
+
+typedef struct {
+  SrtTokenEntity* entities; /* owned array */
+  int num_entities;         /* -1 on error */
+} SrtTokenResult;
+
+SrtTokenResult srt_classify_pii_tokens(const char* text);
+void srt_free_token_result(SrtTokenResult r);
+
+/* -- embeddings + similarity (get_text_embedding / calculate_similarity) */
+
+typedef struct {
+  float* data; /* owned; NULL on error */
+  int    dim;  /* -1 on error */
+} SrtEmbedding;
+
+/* dim <= 0 requests the task's full output dimension (Matryoshka
+ * truncation happens server-side when dim is given). */
+SrtEmbedding srt_get_embedding(const char* text, int dim);
+void srt_free_embedding(SrtEmbedding e);
+
+/* Cosine similarity via POST /api/v1/similarity; -1.0 on error. */
+float srt_calculate_similarity(const char* text1, const char* text2);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* SRT_CLIENT_H */

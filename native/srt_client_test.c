@@ -1,0 +1,76 @@
+/* srt_client_test.c — a plain-C data plane classifying through the
+ * srt_client ABI (the "Go/Rust data plane could link" proof for the
+ * reference's candle-binding extern surface). Usage:
+ *   srt_client_test <host> <port> [api_key]
+ * Prints one status line per exercised call; exits 0 only when every
+ * call round-trips. */
+#include "srt_client.h"
+
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+static int failures = 0;
+
+static void check(int ok, const char* what) {
+  printf("%s %s\n", ok ? "OK" : "FAIL", what);
+  if (!ok) ++failures;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <host> <port> [api_key]\n", argv[0]);
+    return 2;
+  }
+  const char* host = argv[1];
+  int port = atoi(argv[2]);
+  const char* key = argc > 3 ? argv[3] : NULL;
+
+  check(srt_init(host, port, key), "srt_init");
+  check(srt_is_initialized(), "srt_is_initialized");
+
+  SrtClassResult c = srt_classify_text(
+      "intent", "please review this contract clause for enforceability");
+  check(c.label != NULL && c.confidence >= 0.0f, "srt_classify_text");
+  check(c.class_idx >= 0, "class_idx populated");
+  if (c.label) printf("  intent label=%s idx=%d conf=%.3f\n", c.label,
+                      c.class_idx, (double)c.confidence);
+  srt_free_class_result(c);
+
+  SrtTokenResult t = srt_classify_pii_tokens(
+      "contact me at alice@example.com about the invoice");
+  check(t.num_entities >= 0, "srt_classify_pii_tokens");
+  for (int i = 0; i < t.num_entities; ++i)
+    printf("  pii %s [%d,%d) %s\n", t.entities[i].entity_type,
+           t.entities[i].start, t.entities[i].end, t.entities[i].text);
+  srt_free_token_result(t);
+
+  SrtEmbedding e = srt_get_embedding("hello embedding world", 0);
+  check(e.dim > 0 && e.data != NULL, "srt_get_embedding");
+  if (e.dim > 0) {
+    double norm = 0.0;
+    for (int i = 0; i < e.dim; ++i) norm += (double)e.data[i] * e.data[i];
+    printf("  embedding dim=%d norm=%.4f\n", e.dim, sqrt(norm));
+    check(fabs(sqrt(norm) - 1.0) < 0.05, "embedding normalized");
+  }
+  srt_free_embedding(e);
+
+  float self = srt_calculate_similarity("the cache is broken",
+                                        "the cache is broken");
+  float cross = srt_calculate_similarity("the cache is broken",
+                                         "write a poem about spring");
+  printf("  similarity self=%.4f cross=%.4f\n", (double)self,
+         (double)cross);
+  check(self > -1.0f && cross > -1.0f, "srt_calculate_similarity");
+  check(self > 0.99f, "self similarity ~1");
+
+  /* error paths stay errors, not crashes */
+  SrtClassResult bad = srt_classify_text("no-such-task", "text");
+  check(bad.label == NULL && bad.confidence < 0.0f,
+        "unknown task returns error result");
+  srt_free_class_result(bad);
+
+  printf(failures ? "FAILURES %d\n" : "ALL OK\n", failures);
+  return failures ? 1 : 0;
+}

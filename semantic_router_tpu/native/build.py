@@ -1,4 +1,7 @@
-"""Build the native lexical library: python -m semantic_router_tpu.native.build"""
+"""Build the native libraries:
+python -m semantic_router_tpu.native.build            (lexical kernels)
+python -m semantic_router_tpu.native.build client     (C-ABI engine client)
+"""
 
 from __future__ import annotations
 
@@ -7,8 +10,13 @@ import subprocess
 import sys
 
 HERE = os.path.dirname(__file__)
-SRC = os.path.join(HERE, "..", "..", "native", "lexical.cpp")
+NATIVE = os.path.abspath(os.path.join(HERE, "..", "..", "native"))
+SRC = os.path.join(NATIVE, "lexical.cpp")
 OUT = os.path.join(HERE, "_lexical.so")
+CLIENT_SRC = os.path.join(NATIVE, "srt_client.cpp")
+CLIENT_OUT = os.path.join(HERE, "libsrt_client.so")
+CLIENT_TEST_SRC = os.path.join(NATIVE, "srt_client_test.c")
+CLIENT_TEST_OUT = os.path.join(HERE, "srt_client_test")
 
 
 def build(verbose: bool = True) -> str:
@@ -20,7 +28,29 @@ def build(verbose: bool = True) -> str:
     return OUT
 
 
+def build_client(verbose: bool = True, with_test: bool = True) -> str:
+    """libsrt_client.so (the C ABI of srt_client.h) and, optionally, the
+    plain-C test data plane linked against it."""
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+           CLIENT_SRC, "-o", CLIENT_OUT]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True)
+    if with_test:
+        cmd = ["gcc", "-O2", "-std=c11", "-I", NATIVE, CLIENT_TEST_SRC,
+               "-o", CLIENT_TEST_OUT, "-L", HERE, "-lsrt_client", "-lm",
+               f"-Wl,-rpath,{HERE}"]
+        if verbose:
+            print(" ".join(cmd))
+        subprocess.run(cmd, check=True)
+    return CLIENT_OUT
+
+
 if __name__ == "__main__":
-    build()
-    print(f"built {OUT}")
+    if len(sys.argv) > 1 and sys.argv[1] == "client":
+        build_client()
+        print(f"built {CLIENT_OUT} and {CLIENT_TEST_OUT}")
+    else:
+        build()
+        print(f"built {OUT}")
     sys.exit(0)

@@ -1677,6 +1677,7 @@ class RouterServer:
                             else:
                                 r = eng.classify(engine_task, text)
                                 out[api_name] = {"label": r.label,
+                                                 "class_idx": r.index,
                                                  "confidence": r.confidence}
                     self._json(200, out)
                     return
@@ -1692,6 +1693,7 @@ class RouterServer:
                 else:
                     r = eng.classify(engine_task, text)
                     self._json(200, {"label": r.label,
+                                     "class_idx": r.index,
                                      "confidence": r.confidence,
                                      "probs": r.probs})
 
