@@ -37,7 +37,69 @@ def main(argv=None) -> int:
     val_p = sub.add_parser("validate", help="validate a config file")
     val_p.add_argument("--config", required=True)
 
+    mig_p = sub.add_parser(
+        "migrate-config",
+        help="migrate a flat config to the canonical v0.3 contract "
+             "(src/vllm-sr/cli/config_migration.py role)")
+    mig_p.add_argument("--config", required=True)
+    mig_p.add_argument("--out", default="-",
+                       help="output path; '-' for stdout")
+    mig_p.add_argument("--check", action="store_true",
+                       help="verify the migrated config loads to "
+                            "equivalent routing behavior")
+
+    comp_p = sub.add_parser(
+        "compose", help="render a docker-compose deployment "
+                        "(router + Envoy + mock backend) for a config")
+    comp_p.add_argument("--config", required=True)
+    comp_p.add_argument("--out-dir", required=True)
+    comp_p.add_argument("--envoy-image", default="envoyproxy/envoy:v1.31-latest")
+    comp_p.add_argument("--router-image", default="semantic-router-tpu:latest")
+
     args = ap.parse_args(argv)
+
+    if args.command == "migrate-config":
+        import yaml
+
+        from .config import (
+            export_canonical,
+            is_canonical,
+            load_config,
+            loads_config,
+        )
+
+        cfg = load_config(args.config)
+        canonical = export_canonical(cfg)
+        text = yaml.safe_dump(canonical, sort_keys=False)
+        if args.check:
+            cfg2 = loads_config(text)
+            same = (sorted(d.name for d in cfg2.decisions)
+                    == sorted(d.name for d in cfg.decisions)
+                    and cfg2.used_signal_types() == cfg.used_signal_types()
+                    and cfg2.default_model == cfg.default_model)
+            if not same:
+                print(json.dumps({"migrated": False,
+                                  "error": "behavior mismatch"}),
+                      file=sys.stderr)
+                return 1
+        if args.out == "-":
+            print(text)
+        else:
+            with open(args.out, "w") as f:
+                f.write(text)
+            print(json.dumps({"migrated": True, "out": args.out,
+                              "was_canonical": is_canonical(
+                                  cfg.raw or {})}))
+        return 0
+
+    if args.command == "compose":
+        from .runtime.compose import render_compose
+
+        paths = render_compose(args.config, args.out_dir,
+                               envoy_image=args.envoy_image,
+                               router_image=args.router_image)
+        print(json.dumps({"rendered": sorted(paths)}))
+        return 0
 
     if args.command == "validate":
         from .config import load_config, validate_config

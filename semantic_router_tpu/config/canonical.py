@@ -1,0 +1,92 @@
+"""Canonical v0.3 config contract: detection, export, migration.
+
+Reference: pkg/config/canonical_config.go (the public contract:
+version / listeners / providers / routing / entrypoints / recipes /
+global), canonical_export.go (re-serialize live state into the contract),
+and src/vllm-sr/cli/config_migration.py (flat → canonical migration).
+
+Our loader is natively canonical-tolerant (``RouterConfig.from_dict``
+reads the ``routing:`` block and lifts ``global:``), so this module's job
+is the other direction — organizing a loaded/raw config INTO the contract
+layout — plus the recipe-aware read helpers live on RouterConfig itself
+(schema.py recipe_by_name / recipe_for_request_model).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from .schema import RouterConfig
+
+# keys that belong to the canonical top level (everything else is runtime
+# config and moves under `global:`)
+_CANONICAL_TOP = {"version", "listeners", "providers", "routing",
+                  "entrypoints", "recipes", "global"}
+
+# flat top-level keys that the routing surface owns (canonical_config.go
+# CanonicalRouting + the flat spellings our loader accepts)
+_ROUTING_KEYS = {"modelCards", "model_cards", "signals", "projections",
+                 "decisions", "strategy", "learning", "knowledge_bases"}
+
+# flat keys that map onto canonical provider defaults rather than global
+_PROVIDER_KEYS = {"default_model"}
+
+
+def is_canonical(raw: Dict[str, Any]) -> bool:
+    """The reference's detection rule (canonical_config.go:76-80):
+    a `routing:` or `global:` block marks the canonical contract."""
+    return "routing" in raw or "global" in raw
+
+
+def export_canonical(cfg: RouterConfig) -> Dict[str, Any]:
+    """Serialize a loaded config into the canonical v0.3 layout
+    (canonical_export.go role). The raw dict is the source of truth for
+    rule bodies — it preserves the exact wire spellings — and the typed
+    fields fill in what raw lacks. loads_config(yaml.dump(result))
+    round-trips to equivalent routing behavior (tested)."""
+    raw = dict(cfg.raw or {})
+    routing_raw = dict(raw.get("routing") or {})
+    for key in _ROUTING_KEYS:
+        if key in raw and key not in routing_raw:
+            routing_raw[key] = raw[key]
+    routing_raw.setdefault("strategy", cfg.strategy)
+    if "modelCards" not in routing_raw and "model_cards" in routing_raw:
+        routing_raw["modelCards"] = routing_raw.pop("model_cards")
+
+    providers = dict(cfg.providers or {})
+    defaults = dict(providers.get("defaults") or {})
+    if cfg.default_model and "default_model" not in defaults:
+        defaults["default_model"] = cfg.default_model
+    if defaults:
+        providers["defaults"] = defaults
+
+    global_block = dict(raw.get("global") or {})
+    for key, value in raw.items():
+        if key in _CANONICAL_TOP or key in _ROUTING_KEYS \
+                or key in _PROVIDER_KEYS:
+            continue
+        global_block.setdefault(key, value)
+
+    out: Dict[str, Any] = {"version": cfg.version or "v0.3"}
+    if cfg.listeners:
+        out["listeners"] = list(cfg.listeners)
+    if providers:
+        out["providers"] = providers
+    out["routing"] = routing_raw
+    if cfg.entrypoints:
+        out["entrypoints"] = [
+            {"model_names": list(e.model_names), "recipe": e.recipe}
+            for e in cfg.entrypoints]
+    if raw.get("recipes"):
+        out["recipes"] = raw["recipes"]
+    if global_block:
+        out["global"] = global_block
+    return out
+
+
+def migrate_flat(raw: Dict[str, Any]) -> Dict[str, Any]:
+    """Flat legacy dict → canonical dict without loading/validating —
+    the config-migration CLI path (src/vllm-sr/cli/config_migration.py
+    role): comments are lost, semantics are not."""
+    cfg = RouterConfig.from_dict(raw)
+    return export_canonical(cfg)

@@ -969,6 +969,57 @@ class InferenceEngineConfig:
         return out
 
 
+DEFAULT_RECIPE_NAME = "default"
+
+
+@dataclass
+class RoutingRecipe:
+    """One named routing profile (reference RoutingRecipe,
+    pkg/config/recipes.go:17-22 + canonical_recipes.go:19-23): the same
+    profile shape as the top-level routing block, minus modelCards — the
+    model catalog stays shared across recipes."""
+
+    name: str
+    description: str = ""
+    signals: "SignalsConfig" = field(default_factory=lambda: SignalsConfig())
+    projections: "ProjectionsConfig" = field(
+        default_factory=lambda: ProjectionsConfig())
+    decisions: List["Decision"] = field(default_factory=list)
+    strategy: str = "priority"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "RoutingRecipe":
+        d = d or {}
+        routing = d.get("routing", d) or {}
+        return cls(
+            name=str(d.get("name", "")),
+            description=str(d.get("description", "")),
+            signals=SignalsConfig.from_dict(routing.get("signals", {})),
+            projections=ProjectionsConfig.from_dict(
+                routing.get("projections", {})),
+            decisions=[Decision.from_dict(x)
+                       for x in routing.get("decisions", []) or []],
+            strategy=str(routing.get("strategy", "priority")),
+        )
+
+
+@dataclass
+class Entrypoint:
+    """Virtual request model names → recipe binding (reference
+    EntrypointMapping, recipes.go:24-29): the virtual names never reach a
+    backend; they only select which routing profile evaluates."""
+
+    model_names: List[str] = field(default_factory=list)
+    recipe: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Entrypoint":
+        d = d or {}
+        return cls(
+            model_names=[str(m) for m in d.get("model_names", []) or []],
+            recipe=str(d.get("recipe", "")))
+
+
 @dataclass
 class RouterConfig:
     """The root configuration object (reference RouterConfig,
@@ -1015,11 +1066,24 @@ class RouterConfig:
     # store: {backend, ...}, adaptation: {mode, candidate_set},
     # protection: {scope, identity.headers, tuning}}
     learning: Dict[str, Any] = field(default_factory=dict)
+    # canonical v0.3 contract surface (canonical_config.go): named routing
+    # profiles + virtual-model entrypoints + deployment listeners/providers
+    recipes: List[RoutingRecipe] = field(default_factory=list)
+    entrypoints: List[Entrypoint] = field(default_factory=list)
+    listeners: List[Dict[str, Any]] = field(default_factory=list)
+    providers: Dict[str, Any] = field(default_factory=dict)
+    version: str = ""
     raw: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "RouterConfig":
         d = d or {}
+        # canonical `global:` block (canonical_global.go): runtime config
+        # grouped away from the routing surface — normalize by lifting its
+        # keys to the top level (explicit top-level keys win)
+        if isinstance(d.get("global"), dict):
+            d = {**d["global"], **{k: v for k, v in d.items()
+                                   if k != "global"}}
         routing = d.get("routing", {}) or {}
         return cls(
             model_cards=[ModelCard.from_dict(m) for m in routing.get("modelCards", d.get("model_cards", []))],
@@ -1027,7 +1091,10 @@ class RouterConfig:
             projections=ProjectionsConfig.from_dict(routing.get("projections", d.get("projections", {}))),
             decisions=[Decision.from_dict(x) for x in routing.get("decisions", d.get("decisions", []))],
             strategy=routing.get("strategy", d.get("strategy", "priority")),
-            default_model=d.get("default_model", routing.get("default_model", "")),
+            default_model=d.get("default_model", routing.get(
+                "default_model",
+                ((d.get("providers") or {}).get("defaults") or {})
+                .get("default_model", ""))),
             semantic_cache=SemanticCacheConfig.from_dict(d.get("semantic_cache", {})),
             engine=InferenceEngineConfig.from_dict(d.get("engine", d.get("inference_engine", {}))),
             classifier_models=dict(d.get("classifier_models", {}) or {}),
@@ -1051,6 +1118,13 @@ class RouterConfig:
             external_models=list(d.get("external_models", []) or []),
             learning=dict(routing.get("learning",
                                       d.get("learning", {})) or {}),
+            recipes=[RoutingRecipe.from_dict(r)
+                     for r in d.get("recipes", []) or []],
+            entrypoints=[Entrypoint.from_dict(e)
+                         for e in d.get("entrypoints", []) or []],
+            listeners=list(d.get("listeners", []) or []),
+            providers=dict(d.get("providers", {}) or {}),
+            version=str(d.get("version", "")),
             raw=d,
         )
 
@@ -1058,6 +1132,36 @@ class RouterConfig:
         for m in self.model_cards:
             if m.name == name:
                 return m
+        return None
+
+    # -- recipes (pkg/config/recipes.go) -----------------------------------
+
+    def recipe_by_name(self, name: str) -> Optional[RoutingRecipe]:
+        """Named recipe lookup; DEFAULT_RECIPE_NAME always resolves to a
+        recipe mirroring the flat routing fields (recipes.go:31-52), so
+        single-profile and recipe-aware read sites observe the same
+        default behavior."""
+        for r in self.recipes:
+            if r.name == name:
+                return r
+        if name == DEFAULT_RECIPE_NAME:
+            return RoutingRecipe(
+                name=DEFAULT_RECIPE_NAME, signals=self.signals,
+                projections=self.projections, decisions=self.decisions,
+                strategy=self.strategy)
+        return None
+
+    def recipe_for_request_model(self, model: str
+                                 ) -> Optional[RoutingRecipe]:
+        """Resolve a request model name through the entrypoint table
+        (recipes.go:55-73); None when no entrypoint matches — callers
+        fall back to auto/specified-model handling."""
+        model = (model or "").strip()
+        if not model:
+            return None
+        for ep in self.entrypoints:
+            if model in ep.model_names:
+                return self.recipe_by_name(ep.recipe)
         return None
 
     def used_signal_types(self) -> List[str]:

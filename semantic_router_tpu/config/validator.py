@@ -100,6 +100,48 @@ def validate_config(cfg: RouterConfig) -> List[ValidationError]:
     # -- uniqueness
     _check_dupes([m.name for m in cfg.model_cards], "routing.modelCards", errors)
     _check_dupes([d.name for d in cfg.decisions], "routing.decisions", errors)
+    _check_dupes([r.name for r in cfg.recipes], "recipes", errors)
+
+    # -- recipes/entrypoints contract (canonical_recipes.go validation:
+    # entrypoints must name existing recipes; virtual model names must not
+    # shadow the real model catalog)
+    recipe_names = {r.name for r in cfg.recipes} | {"default"}
+    card_names = {m.name for m in cfg.model_cards}
+    # each recipe is a full routing profile: its decisions/signals/
+    # projections get the SAME deep validation as the top-level profile
+    # (a bad model ref inside a recipe routes to a nonexistent backend
+    # just as surely as one outside)
+    import dataclasses as _dc
+
+    for rec in cfg.recipes:
+        if rec.strategy not in ("priority", "confidence"):
+            errors.append(ValidationError(
+                f"recipes.{rec.name}",
+                f"strategy must be priority|confidence, "
+                f"got {rec.strategy!r}"))
+        sub = _dc.replace(cfg, signals=rec.signals,
+                          projections=rec.projections,
+                          decisions=rec.decisions, strategy=rec.strategy,
+                          recipes=[], entrypoints=[])
+        for e in validate_config(sub):
+            errors.append(ValidationError(
+                f"recipes.{rec.name}.{e.path}", e.message,
+                fatal=e.fatal))
+    for ep in cfg.entrypoints:
+        if ep.recipe not in recipe_names:
+            errors.append(ValidationError(
+                "entrypoints", f"unknown recipe {ep.recipe!r} "
+                f"(known: {sorted(recipe_names)})"))
+        if not ep.model_names:
+            errors.append(ValidationError(
+                "entrypoints", f"entrypoint for recipe {ep.recipe!r} "
+                "has no model_names"))
+        for vname in ep.model_names:
+            if vname in card_names:
+                errors.append(ValidationError(
+                    "entrypoints",
+                    f"virtual model name {vname!r} shadows a real model "
+                    "card — entrypoint names must never reach a backend"))
     for family in (
         "keywords", "embeddings", "domains", "fact_check", "user_feedbacks",
         "reasks", "preferences", "language", "context", "structure",

@@ -141,6 +141,162 @@ class InferenceEngine:
             self._tasks[name] = _Task(name, kind, list(labels), tokenizer,
                                       apply_fn, params, max_len, pad_id)
 
+    def register_stacked_bank(self, module, params, tokenizer: Tokenizer,
+                              max_seq_len: int = 0, pad_id: int = 0,
+                              strategy: str = "adaptive") -> None:
+        """Register the fused multi-task LoRA bank
+        (models.lora.MultiTaskLoRAClassifier) as the SECOND execution
+        path for its sequence tasks: one trunk pass serves every task.
+        Each covered task must also be registered as a traditional task
+        (register_task) — that pairing is the dual-path premise
+        (routing.rs:14-90): both paths can serve, the chooser picks.
+        ``strategy``: adaptive | latency | confidence | traditional |
+        stacked (the last two pin the path — operator override)."""
+        from .pathing import DualPathChooser
+
+        seq_tasks = [t for t in module.task_names
+                     if module.task_kinds.get(t, "sequence") == "sequence"]
+        for t in seq_tasks:
+            if not self.has_task(t):
+                raise ValueError(
+                    f"stacked bank task {t!r} has no traditional "
+                    "registration — register_task it first (dual-path "
+                    "needs both)")
+        if self.mesh is not None:
+            from ..parallel import shard_params
+
+            params = shard_params(params, self.mesh)
+        self._stacked = {
+            "apply_fn": jax.jit(module.apply),
+            "params": params,
+            "tokenizer": tokenizer,
+            "tasks": seq_tasks,
+            "max_seq_len": max_seq_len or self.cfg.seq_len_buckets[-1],
+            "pad_id": pad_id,
+        }
+        self.path_chooser = DualPathChooser(strategy=strategy)
+        self.last_path_selection = None
+
+    def classify_multi(self, tasks: Sequence[str], texts: Sequence[str],
+                       timeout: float = 30.0,
+                       requirements=None) -> Dict[str, List[ClassResult]]:
+        """Classify the same texts under several sequence tasks — the
+        signal fan-out shape. With a stacked bank registered, the
+        dual-path chooser decides between one fused pass and per-task
+        batcher submits, learning from its own outcome records; without
+        one it is per-task classify_batch."""
+        from .pathing import (
+            STACKED,
+            TRADITIONAL,
+            PathMetrics,
+            PathSelection,
+            ProcessingRequirements,
+        )
+
+        tasks = list(tasks)
+        for t in tasks:
+            self._require(t, kind="sequence")
+        stacked = getattr(self, "_stacked", None)
+        eligible = stacked is not None and len(tasks) > 0 and \
+            all(t in stacked["tasks"] for t in tasks)
+        req = requirements or ProcessingRequirements(
+            tasks=tasks, batch_size=len(texts))
+        if eligible:
+            sel = self.path_chooser.choose(req)
+        else:
+            sel = PathSelection(TRADITIONAL, 1.0,
+                                "no stacked bank covers these tasks",
+                                PathMetrics())
+        self.last_path_selection = sel
+
+        if sel.selected_path == STACKED:
+            t0 = time.perf_counter()
+            try:
+                out = self._stacked_run(tasks, texts)
+            except Exception:
+                self.path_chooser.record(
+                    STACKED, tasks, len(texts),
+                    time.perf_counter() - t0, 0.0, ok=False)
+                sel = PathSelection(TRADITIONAL, 1.0,
+                                    "stacked pass failed — fail-open to "
+                                    "traditional", PathMetrics())
+                self.last_path_selection = sel
+            else:
+                conf = float(np.mean([r.confidence
+                                      for rs in out.values()
+                                      for r in rs])) if texts else 0.0
+                self.path_chooser.record(
+                    STACKED, tasks, len(texts),
+                    time.perf_counter() - t0, conf)
+                return out
+
+        t0 = time.perf_counter()
+        out = {t: self.classify_batch(t, texts, timeout=timeout)
+               for t in tasks}
+        if eligible:
+            conf = float(np.mean([r.confidence for rs in out.values()
+                                  for r in rs])) if texts else 0.0
+            self.path_chooser.record(TRADITIONAL, tasks, len(texts),
+                                     time.perf_counter() - t0, conf)
+        return out
+
+    def _stacked_run(self, tasks: Sequence[str], texts: Sequence[str]
+                     ) -> Dict[str, List[ClassResult]]:
+        """One fused pass: tokenize once, pad to (pow2 batch, bucket),
+        run the bank, decode each requested task with ITS registered
+        label set — identical decode semantics to the traditional path."""
+        st = self._stacked
+        n = len(texts)
+        encs = [st["tokenizer"].encode(t, max_length=st["max_seq_len"])
+                for t in texts]
+        bucket = pick_bucket(max((len(e) for e in encs), default=1),
+                             self.cfg.seq_len_buckets)
+        padded_n = pow2_batch(n, self.cfg.max_batch_size)
+        if self.mesh is not None:
+            dp = self.mesh.shape.get("dp", 1)
+            padded_n = max(dp, ((padded_n + dp - 1) // dp) * dp)
+        ids = np.full((padded_n, bucket), st["pad_id"], dtype=np.int32)
+        mask = np.zeros((padded_n, bucket), dtype=np.int32)
+        for i, enc in enumerate(encs):
+            L = min(len(enc), bucket)
+            ids[i, :L] = enc.ids[:L]
+            mask[i, :L] = enc.attention_mask[:L]
+        if self.mesh is not None:
+            from ..parallel import batch_sharding
+
+            sh = batch_sharding(self.mesh)
+            ids_dev = jax.device_put(ids, sh)
+            mask_dev = jax.device_put(mask, sh)
+        else:
+            ids_dev = jnp.asarray(ids)
+            mask_dev = jnp.asarray(mask)
+        from ..observability.profiler import trace_span
+
+        with trace_span("engine.classify_multi.stacked"):
+            logits_by_task = st["apply_fn"](st["params"], ids_dev,
+                                            mask_dev)
+            logits_by_task = {k: np.asarray(jax.device_get(v), np.float32)
+                              for k, v in logits_by_task.items()}
+        out: Dict[str, List[ClassResult]] = {}
+        for task in tasks:
+            labels = self._tasks[task].labels
+            probs = _softmax(logits_by_task[task][:n])
+            results = []
+            for i in range(n):
+                idx = int(np.argmax(probs[i]))
+                # width-tolerant decode like the traditional path: a
+                # labels/head-width mismatch names classes positionally
+                # instead of raising (which would silently disable the
+                # stacked path via the fail-open record)
+                results.append(ClassResult(
+                    label=labels[idx] if idx < len(labels) else str(idx),
+                    index=idx, confidence=float(probs[i, idx]),
+                    probs={(labels[j] if j < len(labels) else str(j)):
+                           float(probs[i, j])
+                           for j in range(probs.shape[-1])}))
+            out[task] = results
+        return out
+
     def register_multimodal(self, name: str, embedder) -> None:
         """Register a shared text/image embedding space task
         (multimodal_embedding.rs role; embedder = models.siglip
@@ -385,15 +541,22 @@ class InferenceEngine:
             ids_dev = jnp.asarray(ids)
             mask_dev = jnp.asarray(mask)
 
+        # named profiler regions: the XLA timeline lines up with router
+        # semantics when a trace is being captured (observability.profiler)
+        from ..observability.profiler import trace_span
+
         if t.kind == "embedding":
             p = items[0].payload
-            emb = t.apply_fn(t.params, ids_dev, mask_dev,
-                             exit_layer=p.exit_layer, output_dim=p.output_dim)
-            emb = np.asarray(jax.device_get(emb), dtype=np.float32)
+            with trace_span(f"engine.embed.{t.name}"):
+                emb = t.apply_fn(t.params, ids_dev, mask_dev,
+                                 exit_layer=p.exit_layer,
+                                 output_dim=p.output_dim)
+                emb = np.asarray(jax.device_get(emb), dtype=np.float32)
             return [emb[i] for i in range(n)]
 
-        logits = t.apply_fn(t.params, ids_dev, mask_dev)
-        logits = np.asarray(jax.device_get(logits), dtype=np.float32)
+        with trace_span(f"engine.classify.{t.name}"):
+            logits = t.apply_fn(t.params, ids_dev, mask_dev)
+            logits = np.asarray(jax.device_get(logits), dtype=np.float32)
 
         now = time.perf_counter()
         if t.kind == "sequence":

@@ -1,0 +1,108 @@
+"""JAX profiler + XLA dump hooks (SURVEY §5 tracing/profiling).
+
+Reference role: the reference wires pprof/trace endpoints into its Go
+runtime; the TPU-native equivalent is the JAX/XLA toolchain —
+``jax.profiler`` traces (viewable in TensorBoard/Perfetto, includes XLA
+op timelines and TPU HLO steps) and ``--xla_dump_to`` HLO dumps. This
+module owns the process-wide profiler state; the management API exposes
+it at /debug/profiler/* (write-gated).
+
+XLA dump caveat: XLA reads XLA_FLAGS once at backend init, so a dump
+directory can only be enabled for the NEXT process start —
+``configure_xla_dump`` therefore reports whether it took effect live or
+must be exported before relaunch.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+
+class ProfilerControl:
+    """Serialized start/stop around the process-global jax.profiler."""
+
+    def __init__(self, base_dir: str = "/tmp/srt-profiles") -> None:
+        self.base_dir = base_dir
+        self._lock = threading.Lock()
+        self._active_dir: Optional[str] = None
+        self._started_at = 0.0
+
+    def start(self, log_dir: str = "") -> Dict[str, Any]:
+        with self._lock:
+            if self._active_dir is not None:
+                return {"error": "profiler already running",
+                        "dir": self._active_dir, "status": 409}
+            target = log_dir or os.path.join(
+                self.base_dir, time.strftime("%Y%m%d-%H%M%S"))
+            os.makedirs(target, exist_ok=True)
+            import jax
+
+            jax.profiler.start_trace(target)
+            self._active_dir = target
+            self._started_at = time.time()
+            return {"started": True, "dir": target}
+
+    def stop(self) -> Dict[str, Any]:
+        with self._lock:
+            if self._active_dir is None:
+                return {"error": "profiler not running", "status": 409}
+            import jax
+
+            jax.profiler.stop_trace()
+            target, self._active_dir = self._active_dir, None
+            files = sorted(
+                os.path.relpath(p, target)
+                for p in glob.glob(os.path.join(target, "**", "*"),
+                                   recursive=True) if os.path.isfile(p))
+            return {"stopped": True, "dir": target, "files": files,
+                    "duration_s": round(time.time() - self._started_at, 3)}
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "running": self._active_dir is not None,
+                "dir": self._active_dir,
+                "elapsed_s": round(time.time() - self._started_at, 3)
+                if self._active_dir else 0.0,
+                "xla_dump": _current_xla_dump(),
+            }
+
+
+def _current_xla_dump() -> Optional[str]:
+    for part in os.environ.get("XLA_FLAGS", "").split():
+        if part.startswith("--xla_dump_to="):
+            return part.split("=", 1)[1]
+    return None
+
+
+def configure_xla_dump(dump_dir: str) -> Dict[str, Any]:
+    """Add --xla_dump_to to XLA_FLAGS. Effective immediately only for
+    NOT-yet-compiled programs in a NOT-yet-initialized backend; once a
+    backend exists the setting applies to the next process start, and the
+    response says so rather than pretending."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = " ".join(p for p in flags.split()
+                     if not p.startswith("--xla_dump_to="))
+    os.environ["XLA_FLAGS"] = (flags + f" --xla_dump_to={dump_dir}").strip()
+    os.makedirs(dump_dir, exist_ok=True)
+    import jax
+
+    live = not jax._src.xla_bridge._backends  # type: ignore[attr-defined]
+    return {"configured": True, "dir": dump_dir,
+            "effective": "now" if live else "next process start"}
+
+
+def trace_span(name: str):
+    """Named region in the profiler timeline: engine hot paths annotate
+    with ``with trace_span('classify.intent'): ...`` so the XLA trace
+    lines up with router semantics."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+default_profiler = ProfilerControl()

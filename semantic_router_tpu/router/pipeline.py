@@ -155,6 +155,21 @@ class Router:
             extra += remote_evs
         self.dispatcher = build_heuristic_dispatcher(cfg, extra=extra)
         self.decision_engine = DecisionEngine(cfg.decisions, cfg.strategy)
+        # recipe-aware routing (pkg/config/recipes.go + canonical
+        # entrypoints): each named profile gets its own dispatcher and
+        # decision engine at construction time; per-request resolution is
+        # a dict lookup, never a rebuild
+        self._recipe_engines: Dict[str, tuple] = {}
+        if cfg.recipes:
+            import dataclasses as _dc
+
+            for rec in cfg.recipes:
+                sub_cfg = _dc.replace(
+                    cfg, signals=rec.signals, projections=rec.projections,
+                    decisions=rec.decisions, strategy=rec.strategy)
+                self._recipe_engines[rec.name] = (
+                    build_heuristic_dispatcher(sub_cfg, extra=extra),
+                    DecisionEngine(rec.decisions, rec.strategy))
         self.rate_limiter = RateLimiter.from_config(cfg.ratelimit)
         sp_cfg = cfg.skip_processing or {}
         self._skip_enabled = bool(sp_cfg.get("enabled", False))
@@ -230,6 +245,22 @@ class Router:
     # request path
     # ------------------------------------------------------------------
 
+    def _engines_for_model(self, model: str):
+        """(dispatcher, decision_engine, via_entrypoint) for a request
+        model name: an entrypoint's virtual name selects its recipe's
+        engines (recipes.go RecipeForRequestModel); everything else uses
+        the default profile. evaluate_signals() resolves through the SAME
+        table so a streamed prefetch can never evaluate under a different
+        profile than route()."""
+        if self._recipe_engines or self.cfg.entrypoints:
+            rec = self.cfg.recipe_for_request_model(model)
+            if rec is not None:
+                pair = self._recipe_engines.get(rec.name)
+                if pair is not None:
+                    return pair[0], pair[1], True
+                return self.dispatcher, self.decision_engine, True
+        return self.dispatcher, self.decision_engine, False
+
     def _prepare_signal_view(self, ctx, headers: Dict[str, str]
                              ) -> List[str]:
         """The ONE place that decides what reaches the classifiers:
@@ -260,7 +291,8 @@ class Router:
         headers = {k.lower(): v for k, v in (headers or {}).items()}
         ctx = RequestContext.from_openai_body(body, headers)
         skip = self._prepare_signal_view(ctx, headers)
-        return self.dispatcher.evaluate(ctx, skip_signals=skip)
+        dispatcher, _, _ = self._engines_for_model(ctx.model)
+        return dispatcher.evaluate(ctx, skip_signals=skip)
 
     def route(self, body: Dict[str, Any],
               headers: Optional[Dict[str, str]] = None,
@@ -296,29 +328,38 @@ class Router:
         # prefetched: cache lookup / selection / memory all read
         # ctx.user_text downstream.
         skip = self._prepare_signal_view(ctx, headers)
+        dispatcher, decision_engine, via_entrypoint = \
+            self._engines_for_model(ctx.model)
         if precomputed_signals is not None:
             # streamed-frontend overlap: signals were evaluated while
-            # the body was still arriving (same text, same skip config)
+            # the body was still arriving (same text, same skip config,
+            # same recipe — _engines_for_model on both paths)
             signals, report = precomputed_signals
         else:
             with default_tracer.span("signals.evaluate",
                                      request_id=request_id):
-                signals, report = self.dispatcher.evaluate(
+                signals, report = dispatcher.evaluate(
                     ctx, skip_signals=skip)
         for family, res in report.results.items():
             M.signal_latency.observe(res.latency_s, family=family)
 
         with default_tracer.decision_span():
-            decision_res = self.decision_engine.evaluate(signals)
-        M.decision_latency.observe(self.decision_engine.last_eval_latency_s)
+            decision_res = decision_engine.evaluate(signals)
+        M.decision_latency.observe(decision_engine.last_eval_latency_s)
 
         result = RouteResult(
             kind="route", request_id=request_id, signals=signals,
             report=report, decision=decision_res, body=dict(body))
 
         if decision_res is None:
-            # fall back to the configured default model
-            result.model = self.cfg.default_model or ctx.model
+            # fall back to the configured default model; an entrypoint's
+            # virtual name must never reach a backend (recipes.go:24-29),
+            # so the recipe path falls to the model catalog instead
+            if via_entrypoint and not self.cfg.default_model:
+                result.model = (self.cfg.model_cards[0].name
+                                if self.cfg.model_cards else ctx.model)
+            else:
+                result.model = self.cfg.default_model or ctx.model
             result.headers = {H.SCHEMA: H.SCHEMA_VERSION,
                               H.MODEL: result.model,
                               H.REQUEST_ID: request_id}

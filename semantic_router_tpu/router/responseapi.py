@@ -118,6 +118,18 @@ def build_response_store(cfg: Dict[str, Any]):
     (cache_factory.go-style backend selection)."""
     cfg = cfg or {}
     backend = cfg.get("backend", "memory")
+    if backend in ("redis-cluster", "valkey-cluster"):
+        from ..state.rediscluster import RedisClusterClient
+
+        nodes = [(str(n.get("host", "127.0.0.1")), int(n.get("port")))
+                 for n in cfg.get("nodes", []) or []]
+        client = RedisClusterClient(nodes,
+                                    password=str(cfg.get("password", "")))
+        client.refresh_slots()
+        return RedisResponseStore(
+            key_prefix=cfg.get("key_prefix", "vsr:resp"),
+            ttl_seconds=float(cfg.get("ttl_seconds", 86_400.0)),
+            client=client)
     if backend in ("redis", "valkey"):
         return RedisResponseStore(
             host=cfg.get("host", "127.0.0.1"),

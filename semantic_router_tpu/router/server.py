@@ -79,6 +79,10 @@ API_CATALOG = {
         {"path": "/api/v1/embeddings", "method": "POST"},
         {"path": "/api/v1/similarity", "method": "POST"},
         {"path": "/api/v1/similarity/batch", "method": "POST"},
+        {"path": "/debug/profiler", "method": "GET"},
+        {"path": "/debug/profiler/start", "method": "POST"},
+        {"path": "/debug/profiler/stop", "method": "POST"},
+        {"path": "/debug/profiler/xla-dump", "method": "POST"},
         {"path": "/info/models", "method": "GET"},
         {"path": "/config/router", "method": "GET"},
         {"path": "/config/router", "method": "PATCH"},
@@ -532,6 +536,10 @@ class RouterServer:
                     return
                 if path == "/api/v1":
                     self._json(200, API_CATALOG)
+                elif path == "/debug/profiler":
+                    from ..observability.profiler import default_profiler
+
+                    self._json(200, default_profiler.status())
                 elif path == "/config/router":
                     # secrets masked unless the key holds secret_view
                     # (management_api.go:67)
@@ -650,6 +658,30 @@ class RouterServer:
                         if self._authorize() is None:
                             return
                         self._nli(body)
+                    elif path.startswith("/debug/profiler/"):
+                        # profiling perturbs the serving process: edit-
+                        # gated + audited like config mutations
+                        if self._authorize(write=True,
+                                           action="profiler") is None:
+                            return
+                        from ..observability.profiler import (
+                            configure_xla_dump,
+                            default_profiler,
+                        )
+
+                        action = path.rsplit("/", 1)[1]
+                        if action == "start":
+                            out = default_profiler.start(
+                                str(body.get("dir", "")))
+                        elif action == "stop":
+                            out = default_profiler.stop()
+                        elif action == "xla-dump":
+                            out = configure_xla_dump(str(body.get(
+                                "dir", "/tmp/srt-xla-dump")))
+                        else:
+                            out = {"error": f"unknown action {action!r}",
+                                   "status": 404}
+                        self._json(out.pop("status", 200), out)
                     elif path == "/config/router/rollback":
                         if self._authorize(write=True,
                                            action="config_rollback") is None:

@@ -1,0 +1,183 @@
+"""Compose orchestration: render a runnable docker-compose deployment.
+
+Reference role: src/vllm-sr/cli (compose up/down orchestration + config
+generation) — `vllm-sr` renders the Envoy + router + backend topology
+from one router config. Here the same idea, TPU-shaped: the router
+container runs the ExtProc gRPC filter (`serve-extproc`), Envoy fronts
+it with the committed fail-open filter chain, and each model card with a
+backend ref becomes an upstream cluster/service.
+
+Rendering is deterministic and dependency-free (string templates, no
+docker invocation): the artifact set is what an operator `docker compose
+up`s, and what the e2e profile tests assert on.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List
+
+import yaml
+
+from ..config import load_config
+
+
+def _sanitize(name: str, sep: str = "-") -> str:
+    """Model card name → DNS/compose-safe token (HF-style 'org/model'
+    names carry '/', which is illegal in service names and hostnames)."""
+    import re
+
+    return re.sub(r"[^a-zA-Z0-9]+", sep, name).strip(sep).lower()
+
+
+def _envoy_config(cfg, extproc_host: str = "router",
+                  listen_port: int = 8801) -> Dict:
+    """Envoy bootstrap mirroring deploy/envoy.yaml (reference
+    deploy/local/envoy.yaml:80-118): ext_proc BUFFERED, fail-open,
+    header-based cluster selection, one cluster per backend model."""
+    routes: List[Dict] = []
+    clusters: List[Dict] = []
+    backends = {}
+    for card in cfg.model_cards:
+        host = (card.extra or {}).get("backend_host") if hasattr(
+            card, "extra") else None
+        backends[card.name] = {
+            "cluster": "vllm_" + _sanitize(card.name, "_"),
+            "host": host or f"backend-{_sanitize(card.name)}",
+            "port": 8000,
+        }
+    for name, b in backends.items():
+        # exact match, not prefix: with N generated routes a model name
+        # that prefixes another ("llama-3" / "llama-3-70b") would
+        # silently capture the longer name's traffic
+        routes.append({
+            "match": {"prefix": "/", "headers": [
+                {"name": "x-vsr-selected-model",
+                 "string_match": {"exact": name}}]},
+            "route": {"cluster": b["cluster"], "timeout": "300s"}})
+        clusters.append({
+            "name": b["cluster"],
+            "type": "STRICT_DNS",
+            "connect_timeout": "5s",
+            "load_assignment": {
+                "cluster_name": b["cluster"],
+                "endpoints": [{"lb_endpoints": [{"endpoint": {"address": {
+                    "socket_address": {"address": b["host"],
+                                       "port_value": b["port"]}}}}]}]}})
+    default_cluster = (clusters[0]["name"] if clusters else "vllm_default")
+    routes.append({"match": {"prefix": "/"},
+                   "route": {"cluster": default_cluster,
+                             "timeout": "300s"}})
+    return {
+        "static_resources": {
+            "listeners": [{
+                "name": "main",
+                "address": {"socket_address": {
+                    "address": "0.0.0.0", "port_value": listen_port}},
+                "filter_chains": [{"filters": [{
+                    "name": "envoy.filters.network.http_connection_manager",
+                    "typed_config": {
+                        "@type": "type.googleapis.com/envoy.extensions."
+                                 "filters.network.http_connection_manager"
+                                 ".v3.HttpConnectionManager",
+                        "stat_prefix": "ingress_http",
+                        "route_config": {
+                            "name": "local_route",
+                            "virtual_hosts": [{
+                                "name": "backend", "domains": ["*"],
+                                "routes": routes}]},
+                        "http_filters": [
+                            {"name": "envoy.filters.http.ext_proc",
+                             "typed_config": {
+                                 "@type": "type.googleapis.com/envoy."
+                                          "extensions.filters.http."
+                                          "ext_proc.v3.ExternalProcessor",
+                                 "failure_mode_allow": True,
+                                 "processing_mode": {
+                                     "request_body_mode": "BUFFERED",
+                                     "response_body_mode": "NONE",
+                                     "request_header_mode": "SEND",
+                                     "response_header_mode": "SKIP"},
+                                 "grpc_service": {"envoy_grpc": {
+                                     "cluster_name": "extproc"},
+                                     "timeout": "30s"}}},
+                            {"name": "envoy.filters.http.router",
+                             "typed_config": {
+                                 "@type": "type.googleapis.com/envoy."
+                                          "extensions.filters.http."
+                                          "router.v3.Router"}}]}}]}]}],
+            "clusters": clusters + [{
+                "name": "extproc",
+                "type": "STRICT_DNS",
+                "connect_timeout": "5s",
+                "typed_extension_protocol_options": {
+                    "envoy.extensions.upstreams.http.v3."
+                    "HttpProtocolOptions": {
+                        "@type": "type.googleapis.com/envoy.extensions."
+                                 "upstreams.http.v3.HttpProtocolOptions",
+                        "explicit_http_config": {"http2_protocol_options":
+                                                 {}}}},
+                "load_assignment": {
+                    "cluster_name": "extproc",
+                    "endpoints": [{"lb_endpoints": [{"endpoint": {
+                        "address": {"socket_address": {
+                            "address": extproc_host,
+                            "port_value": 50051}}}}]}]}}]},
+    }
+
+
+def render_compose(config_path: str, out_dir: str,
+                   envoy_image: str = "envoyproxy/envoy:v1.31-latest",
+                   router_image: str = "semantic-router-tpu:latest",
+                   with_mock_backends: bool = True) -> List[str]:
+    """Write docker-compose.yaml + envoy.yaml + the router config into
+    ``out_dir``; returns the rendered file names."""
+    cfg = load_config(config_path)
+    os.makedirs(out_dir, exist_ok=True)
+
+    services: Dict[str, Dict] = {
+        "router": {
+            "image": router_image,
+            "command": ["python", "-m", "semantic_router_tpu",
+                        "serve-extproc", "--config",
+                        "/etc/vsr/config.yaml", "--port", "50051"],
+            "volumes": ["./config.yaml:/etc/vsr/config.yaml:ro"],
+            "expose": ["50051"],
+        },
+        "envoy": {
+            "image": envoy_image,
+            "command": ["envoy", "-c", "/etc/envoy/envoy.yaml"],
+            "volumes": ["./envoy.yaml:/etc/envoy/envoy.yaml:ro"],
+            "ports": ["8801:8801"],
+            "depends_on": ["router"],
+        },
+    }
+    if with_mock_backends:
+        for card in cfg.model_cards:
+            services[f"backend-{_sanitize(card.name)}"] = {
+                "image": router_image,
+                "command": ["python", "-c",
+                            "from semantic_router_tpu.router import "
+                            "MockVLLMServer; import time; "
+                            "MockVLLMServer(port=8000).start(); "
+                            "time.sleep(10**9)"],
+                "expose": ["8000"],
+            }
+            services["envoy"]["depends_on"].append(
+                f"backend-{_sanitize(card.name)}")
+
+    compose = {"services": services}
+    with open(config_path) as f:
+        config_text = f.read()
+
+    written = []
+    for name, payload in (
+            ("docker-compose.yaml", yaml.safe_dump(compose,
+                                                   sort_keys=False)),
+            ("envoy.yaml", yaml.safe_dump(_envoy_config(cfg),
+                                          sort_keys=False)),
+            ("config.yaml", config_text)):
+        with open(os.path.join(out_dir, name), "w") as f:
+            f.write(payload)
+        written.append(name)
+    return written

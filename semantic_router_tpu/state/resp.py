@@ -371,7 +371,12 @@ class MiniRedis:
                 try:
                     reply = self._dispatch(name, cmd[1:])
                 except RespError as e:
-                    conn.sendall(b"-ERR " + str(e).encode() + b"\r\n")
+                    msg = str(e)
+                    # cluster redirects are their own error codes on the
+                    # wire (-MOVED / -ASK), not -ERR
+                    first = msg.split(" ", 1)[0]
+                    prefix = b"-" if first in ("MOVED", "ASK") else b"-ERR "
+                    conn.sendall(prefix + msg.encode() + b"\r\n")
                     continue
                 except Exception as e:  # malformed args must not kill the
                     # connection silently — real Redis replies with -ERR

@@ -196,7 +196,25 @@ class VectorStoreManager:
                 token=self.backend_config.get("token", ""))
         return self._milvus
 
+    def _llamastack_client(self):
+        if getattr(self, "_llamastack", None) is None:
+            from ..state.llamastack import LlamaStackClient
+
+            self._llamastack = LlamaStackClient(
+                self.backend_config.get("url", "http://127.0.0.1:8321"),
+                api_key=self.backend_config.get("api_key", ""))
+        return self._llamastack
+
     def _new_store(self, name: str, **kwargs) -> InMemoryVectorStore:
+        if self.backend == "llamastack":
+            from ..state.llamastack import LlamaStackVectorStore
+
+            prefix = self.backend_config.get("collection_prefix", "vsr-")
+            return LlamaStackVectorStore(
+                self._llamastack_client(), f"{prefix}{name}",
+                embed_fn=self.embed_fn,
+                search_type=self.backend_config.get("search_type",
+                                                    "vector"), **kwargs)
         if self.backend == "sqlite":
             import os
 
@@ -250,7 +268,8 @@ class VectorStoreManager:
                 store = self._new_store(name)  # re-attach persisted store
                 self._stores[name] = store
             if store is not None or self.backend not in ("qdrant",
-                                                         "milvus"):
+                                                         "milvus",
+                                                         "llamastack"):
                 return store
         # remote probes are network round-trips: NEVER hold the manager
         # lock across them (a slow server would stall every store op)
@@ -260,6 +279,11 @@ class VectorStoreManager:
                                                  "vsr-")
                 exists = self._qdrant_client().collection_exists(
                     f"{prefix}{name}")
+            elif self.backend == "llamastack":
+                prefix = self.backend_config.get("collection_prefix",
+                                                 "vsr-")
+                exists = self._llamastack_client().resolve_store_id(
+                    f"{prefix}{name}") is not None
             else:
                 prefix = self.backend_config.get("collection_prefix",
                                                  "vsr_")
@@ -318,6 +342,16 @@ class VectorStoreManager:
                         f"{prefix}{name}"):
                     self._milvus_client().drop_collection(
                         f"{prefix}{name}")
+                    return True
+            except Exception:
+                pass
+        elif self.backend == "llamastack":
+            prefix = self.backend_config.get("collection_prefix", "vsr-")
+            try:
+                sid = self._llamastack_client().resolve_store_id(
+                    f"{prefix}{name}")
+                if sid:
+                    self._llamastack_client().delete_store(sid)
                     return True
             except Exception:
                 pass

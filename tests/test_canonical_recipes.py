@@ -1,0 +1,225 @@
+"""Canonical config contract: recipes, entrypoints, export/migration,
+compose rendering.
+
+Reference: pkg/config/recipes.go + canonical_*.go (named routing
+profiles selected by virtual entrypoint model names; the canonical v0.3
+layout), src/vllm-sr/cli/config_migration.py (flat → canonical), and the
+vllm-sr compose orchestration.
+"""
+
+import json
+
+import pytest
+import yaml
+
+from semantic_router_tpu.config import (
+    export_canonical,
+    is_canonical,
+    load_config,
+    loads_config,
+    migrate_flat,
+    validate_config,
+)
+
+RECIPE_YAML = """
+default_model: base-model
+
+routing:
+  strategy: priority
+  modelCards:
+    - name: base-model
+    - name: support-model
+  signals:
+    keywords:
+      - name: code_kw
+        operator: OR
+        method: exact
+        keywords: ["debug", "function"]
+  decisions:
+    - name: code_route
+      priority: 10
+      rules: {type: keyword, name: code_kw}
+      modelRefs: [{model: base-model}]
+
+recipes:
+  - name: support
+    description: support-desk profile
+    routing:
+      signals:
+        keywords:
+          - name: refund_kw
+            operator: OR
+            method: exact
+            keywords: ["refund", "chargeback"]
+      decisions:
+        - name: refund_route
+          priority: 5
+          rules: {type: keyword, name: refund_kw}
+          modelRefs: [{model: support-model}]
+
+entrypoints:
+  - model_names: [support-router, helpdesk]
+    recipe: support
+  - model_names: [vsr-default]
+    recipe: default
+"""
+
+
+class TestRecipes:
+    def test_parse_and_lookup(self):
+        cfg = loads_config(RECIPE_YAML)
+        assert [r.name for r in cfg.recipes] == ["support"]
+        rec = cfg.recipe_by_name("support")
+        assert rec.description == "support-desk profile"
+        assert [d.name for d in rec.decisions] == ["refund_route"]
+        # the default name always resolves, mirroring the flat fields
+        default = cfg.recipe_by_name("default")
+        assert [d.name for d in default.decisions] == ["code_route"]
+        assert cfg.recipe_by_name("nope") is None
+
+    def test_entrypoint_resolution(self):
+        cfg = loads_config(RECIPE_YAML)
+        assert cfg.recipe_for_request_model("support-router").name == \
+            "support"
+        assert cfg.recipe_for_request_model("helpdesk").name == "support"
+        assert cfg.recipe_for_request_model("vsr-default").name == "default"
+        assert cfg.recipe_for_request_model("base-model") is None
+        assert cfg.recipe_for_request_model("") is None
+
+    def test_router_routes_by_recipe(self):
+        from semantic_router_tpu.router import Router
+
+        cfg = loads_config(RECIPE_YAML)
+        router = Router(cfg, engine=None)
+        try:
+            # virtual entrypoint model → support recipe's decision set
+            res = router.route({"model": "support-router", "messages": [
+                {"role": "user", "content": "I want a refund now"}]})
+            assert res.decision and res.decision.decision.name == \
+                "refund_route"
+            assert res.model == "support-model"
+            # same text through the default profile: no refund_kw there
+            res2 = router.route({"model": "auto", "messages": [
+                {"role": "user", "content": "I want a refund now"}]})
+            assert res2.decision is None
+            # and the default profile still fires its own decision
+            res3 = router.route({"model": "auto", "messages": [
+                {"role": "user", "content": "debug my function"}]})
+            assert res3.decision.decision.name == "code_route"
+        finally:
+            router.shutdown()
+
+    def test_virtual_name_never_reaches_backend(self):
+        from semantic_router_tpu.router import Router
+
+        cfg = loads_config(RECIPE_YAML)
+        router = Router(cfg, engine=None)
+        try:
+            # no recipe decision matches → fallback must not be the
+            # virtual name (recipes.go: entrypoint names never reach a
+            # backend)
+            res = router.route({"model": "helpdesk", "messages": [
+                {"role": "user", "content": "unrelated question"}]})
+            assert res.model != "helpdesk"
+            assert res.model == "base-model"
+        finally:
+            router.shutdown()
+
+    def test_validation_contract(self):
+        bad = RECIPE_YAML.replace("recipe: support", "recipe: missing")
+        with pytest.raises(Exception):
+            loads_config(bad)
+        shadowing = RECIPE_YAML.replace(
+            "model_names: [support-router, helpdesk]",
+            "model_names: [base-model]")
+        with pytest.raises(Exception):
+            loads_config(shadowing)
+
+
+class TestCanonicalExport:
+    def test_flat_fixture_round_trips(self, fixture_config_path):
+        cfg = load_config(fixture_config_path)
+        canonical = export_canonical(cfg)
+        assert canonical["version"]
+        assert "routing" in canonical
+        cfg2 = loads_config(yaml.safe_dump(canonical, sort_keys=False))
+        assert sorted(d.name for d in cfg2.decisions) == \
+            sorted(d.name for d in cfg.decisions)
+        assert cfg2.used_signal_types() == cfg.used_signal_types()
+        assert cfg2.default_model == cfg.default_model
+        assert sorted(m.name for m in cfg2.model_cards) == \
+            sorted(m.name for m in cfg.model_cards)
+
+    def test_global_block_lifts(self):
+        cfg = loads_config("""
+routing:
+  decisions: []
+global:
+  default_model: gm
+  ratelimit: {requests_per_minute: 7}
+""", validate=False)
+        assert cfg.default_model == "gm"
+        assert cfg.ratelimit["requests_per_minute"] == 7
+
+    def test_migrate_flat_produces_canonical(self):
+        flat = {"default_model": "m1",
+                "model_cards": [{"name": "m1"}],
+                "decisions": [], "ratelimit": {"requests_per_minute": 3}}
+        out = migrate_flat(flat)
+        assert is_canonical(out)
+        assert out["routing"]["modelCards"][0]["name"] == "m1"
+        assert out["global"]["ratelimit"]["requests_per_minute"] == 3
+        assert out["providers"]["defaults"]["default_model"] == "m1"
+
+    def test_migrate_cli_check(self, fixture_config_path, tmp_path,
+                               capsys):
+        from semantic_router_tpu.__main__ import main
+
+        out_path = str(tmp_path / "canonical.yaml")
+        rc = main(["migrate-config", "--config", fixture_config_path,
+                   "--out", out_path, "--check"])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out.strip())
+        assert report["migrated"] is True
+        cfg2 = load_config(out_path)
+        assert cfg2.decisions
+
+
+class TestComposeRender:
+    def test_render_topology(self, fixture_config_path, tmp_path):
+        from semantic_router_tpu.runtime.compose import render_compose
+
+        files = render_compose(fixture_config_path, str(tmp_path))
+        assert set(files) == {"docker-compose.yaml", "envoy.yaml",
+                              "config.yaml"}
+        compose = yaml.safe_load((tmp_path / "docker-compose.yaml")
+                                 .read_text())
+        services = compose["services"]
+        assert "router" in services and "envoy" in services
+        assert any(s.startswith("backend-") for s in services)
+        assert "serve-extproc" in " ".join(services["router"]["command"])
+        envoy = yaml.safe_load((tmp_path / "envoy.yaml").read_text())
+        clusters = {c["name"]: c
+                    for c in envoy["static_resources"]["clusters"]}
+        assert "extproc" in clusters
+        # ext_proc filter present, fail-open, BUFFERED (the committed
+        # deploy/envoy.yaml semantics)
+        listener = envoy["static_resources"]["listeners"][0]
+        hcm = listener["filter_chains"][0]["filters"][0]["typed_config"]
+        ext = next(f for f in hcm["http_filters"]
+                   if f["name"] == "envoy.filters.http.ext_proc")
+        assert ext["typed_config"]["failure_mode_allow"] is True
+        assert ext["typed_config"]["processing_mode"][
+            "request_body_mode"] == "BUFFERED"
+        # every model card gets a header-matched route
+        routes = hcm["route_config"]["virtual_hosts"][0]["routes"]
+        assert len(routes) >= 2
+
+    def test_cli_compose(self, fixture_config_path, tmp_path, capsys):
+        from semantic_router_tpu.__main__ import main
+
+        rc = main(["compose", "--config", fixture_config_path,
+                   "--out-dir", str(tmp_path / "dep")])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out.strip())
+        assert "docker-compose.yaml" in report["rendered"]

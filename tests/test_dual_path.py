@@ -1,0 +1,197 @@
+"""Dual-path execution: performance-history chooser + stacked serving.
+
+Reference: candle-binding/src/model_architectures/routing.rs:14-90
+(DualPathRouter / PerformanceHistory / ProcessingRequirements).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from semantic_router_tpu.config.schema import InferenceEngineConfig
+from semantic_router_tpu.engine.classify import InferenceEngine
+from semantic_router_tpu.engine.pathing import (
+    STACKED,
+    TRADITIONAL,
+    DualPathChooser,
+    ProcessingRequirements,
+)
+from semantic_router_tpu.models.lora import (
+    LoRAConfig,
+    MultiTaskLoRAClassifier,
+)
+from semantic_router_tpu.models.modernbert import (
+    ModernBertConfig,
+    ModernBertForSequenceClassification,
+)
+from semantic_router_tpu.utils.tokenization import HashTokenizer
+
+
+class TestChooser:
+    def test_cold_start_prior(self):
+        c = DualPathChooser()
+        multi = c.choose(ProcessingRequirements(tasks=["a", "b"],
+                                                batch_size=4))
+        assert multi.selected_path == STACKED
+        single = c.choose(ProcessingRequirements(tasks=["a"],
+                                                 batch_size=4))
+        assert single.selected_path == TRADITIONAL
+        assert "cold start" in multi.reasoning
+
+    def test_pinned_strategy(self):
+        assert DualPathChooser("traditional").choose(
+            ProcessingRequirements(tasks=["a", "b"])
+        ).selected_path == TRADITIONAL
+        assert DualPathChooser("stacked").choose(
+            ProcessingRequirements(tasks=["a"])
+        ).selected_path == STACKED
+        with pytest.raises(ValueError):
+            DualPathChooser("nope")
+
+    def test_history_latency_wins(self):
+        c = DualPathChooser(min_history=4)
+        for _ in range(6):
+            c.record(TRADITIONAL, ["a", "b"], 4, 0.050, 0.9)
+            c.record(STACKED, ["a", "b"], 4, 0.020, 0.9)
+        sel = c.choose(ProcessingRequirements(tasks=["a", "b"],
+                                              batch_size=4))
+        assert sel.selected_path == STACKED
+        assert "faster" in sel.reasoning
+        # flip the history → flip the choice
+        c2 = DualPathChooser(min_history=4)
+        for _ in range(6):
+            c2.record(TRADITIONAL, ["a", "b"], 4, 0.010, 0.9)
+            c2.record(STACKED, ["a", "b"], 4, 0.080, 0.9)
+        assert c2.choose(ProcessingRequirements(
+            tasks=["a", "b"], batch_size=4)).selected_path == TRADITIONAL
+
+    def test_reliability_override(self):
+        c = DualPathChooser(min_history=4)
+        for _ in range(6):
+            c.record(TRADITIONAL, ["a"], 4, 0.050, 0.9, ok=True)
+            c.record(STACKED, ["a"], 4, 0.010, 0.9, ok=False)
+        sel = c.choose(ProcessingRequirements(tasks=["a"], batch_size=4))
+        assert sel.selected_path == TRADITIONAL
+        assert "reliability" in sel.reasoning
+
+    def test_confidence_threshold_gates(self):
+        c = DualPathChooser(min_history=4)
+        for _ in range(6):
+            c.record(TRADITIONAL, ["a"], 4, 0.050, 0.95)
+            c.record(STACKED, ["a"], 4, 0.010, 0.60)
+        sel = c.choose(ProcessingRequirements(
+            tasks=["a"], batch_size=4, confidence_threshold=0.9))
+        assert sel.selected_path == TRADITIONAL
+        assert "confidence" in sel.reasoning
+        # no threshold → latency wins again
+        sel2 = c.choose(ProcessingRequirements(tasks=["a"], batch_size=4))
+        assert sel2.selected_path == STACKED
+
+
+def _build_engine():
+    cfg = ModernBertConfig(hidden_size=64, intermediate_size=128,
+                           num_hidden_layers=2, num_attention_heads=4,
+                           vocab_size=512, pad_token_id=0)
+    tok = HashTokenizer(vocab_size=512)
+    eng = InferenceEngine(InferenceEngineConfig(
+        max_batch_size=8, max_wait_ms=1.0, seq_len_buckets=[32]))
+    key = jax.random.PRNGKey(0)
+    ids = jnp.ones((1, 8), jnp.int32)
+    labels = {"intent": ["a", "b", "c"], "security": ["safe", "unsafe"]}
+    for i, (name, labs) in enumerate(labels.items()):
+        mcfg = ModernBertConfig(hidden_size=64, intermediate_size=128,
+                                num_hidden_layers=2, num_attention_heads=4,
+                                vocab_size=512, pad_token_id=0,
+                                num_labels=len(labs))
+        m = ModernBertForSequenceClassification(mcfg)
+        eng.register_task(name, "sequence", m,
+                          m.init(jax.random.fold_in(key, i), ids), tok,
+                          labs, max_seq_len=32)
+    bank = MultiTaskLoRAClassifier(
+        cfg, LoRAConfig(rank=4, num_tasks=2),
+        task_names=["intent", "security"],
+        task_labels={"intent": 3, "security": 2},
+        task_kinds={"intent": "sequence", "security": "sequence"})
+    bank_params = bank.init(jax.random.fold_in(key, 9), ids)
+    eng.register_stacked_bank(bank, bank_params, tok, max_seq_len=32)
+    return eng
+
+
+class TestClassifyMulti:
+    def test_stacked_pass_serves_all_tasks(self):
+        eng = _build_engine()
+        try:
+            texts = ["hello routing", "debug this function now"]
+            out = eng.classify_multi(["intent", "security"], texts)
+            assert set(out) == {"intent", "security"}
+            assert eng.last_path_selection.selected_path == STACKED
+            for task, results in out.items():
+                assert len(results) == 2
+                for r in results:
+                    assert r.label in eng.task_labels(task)
+                    assert 0.0 < r.confidence <= 1.0
+                    assert abs(sum(r.probs.values()) - 1.0) < 1e-3
+        finally:
+            eng.shutdown()
+
+    def test_single_task_goes_traditional_and_matches_batch(self):
+        eng = _build_engine()
+        try:
+            texts = ["alpha beta", "gamma delta"]
+            out = eng.classify_multi(["intent"], texts)
+            assert eng.last_path_selection.selected_path == TRADITIONAL
+            direct = eng.classify_batch("intent", texts)
+            for got, want in zip(out["intent"], direct):
+                assert got.label == want.label
+                assert got.confidence == pytest.approx(want.confidence,
+                                                       abs=1e-5)
+        finally:
+            eng.shutdown()
+
+    def test_stacked_failure_fails_open(self):
+        eng = _build_engine()
+        try:
+            def boom(*a, **k):
+                raise RuntimeError("stacked path down")
+
+            eng._stacked["apply_fn"] = boom
+            out = eng.classify_multi(["intent", "security"], ["text"])
+            assert set(out) == {"intent", "security"}  # served anyway
+            assert eng.last_path_selection.selected_path == TRADITIONAL
+            assert "fail-open" in eng.last_path_selection.reasoning
+            m = eng.path_chooser.history.metrics(STACKED)
+            assert m.total == 1 and m.success_rate == 0.0
+        finally:
+            eng.shutdown()
+
+    def test_requires_both_registrations(self):
+        eng = _build_engine()
+        try:
+            bank = MultiTaskLoRAClassifier(
+                ModernBertConfig(hidden_size=64, intermediate_size=128,
+                                 num_hidden_layers=2,
+                                 num_attention_heads=4, vocab_size=512,
+                                 pad_token_id=0),
+                LoRAConfig(rank=4, num_tasks=1),
+                task_names=["unregistered"],
+                task_labels={"unregistered": 2},
+                task_kinds={"unregistered": "sequence"})
+            params = bank.init(jax.random.PRNGKey(1),
+                               jnp.ones((1, 8), jnp.int32))
+            with pytest.raises(ValueError):
+                eng.register_stacked_bank(bank, params,
+                                          HashTokenizer(vocab_size=512))
+        finally:
+            eng.shutdown()
+
+    def test_without_bank_is_per_task(self):
+        eng = _build_engine()
+        try:
+            eng._stacked = None
+            out = eng.classify_multi(["intent", "security"], ["one text"])
+            assert set(out) == {"intent", "security"}
+            assert eng.last_path_selection.selected_path == TRADITIONAL
+            assert "no stacked bank" in eng.last_path_selection.reasoning
+        finally:
+            eng.shutdown()
