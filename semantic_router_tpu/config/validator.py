@@ -121,9 +121,16 @@ def validate_config(cfg: RouterConfig) -> List[ValidationError]:
                 f"got {rec.strategy!r}"))
         sub = _dc.replace(cfg, signals=rec.signals,
                           projections=rec.projections,
-                          decisions=rec.decisions, strategy=rec.strategy,
+                          decisions=rec.decisions,
+                          strategy="priority",  # checked above, our way
                           recipes=[], entrypoints=[])
         for e in validate_config(sub):
+            # model cards are SHARED across recipes (canonical contract)
+            # and unchanged in the sub-config — re-reporting their errors
+            # under a recipes.* path would send operators chasing phantom
+            # per-recipe bugs
+            if e.path.startswith("routing.modelCards"):
+                continue
             errors.append(ValidationError(
                 f"recipes.{rec.name}.{e.path}", e.message,
                 fatal=e.fatal))

@@ -174,6 +174,13 @@ class InferenceEngine:
             "max_seq_len": max_seq_len or self.cfg.seq_len_buckets[-1],
             "pad_id": pad_id,
         }
+        # one worker: classify_multi waits on it WITH the caller's
+        # timeout; an abandoned (cold-compiling) run keeps going and
+        # warms the jit cache for the next attempt
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._stacked_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="stacked-bank")
         self.path_chooser = DualPathChooser(strategy=strategy)
         self.last_path_selection = None
 
@@ -210,9 +217,23 @@ class InferenceEngine:
         self.last_path_selection = sel
 
         if sel.selected_path == STACKED:
+            from concurrent.futures import TimeoutError as FutTimeout
+
             t0 = time.perf_counter()
             try:
-                out = self._stacked_run(tasks, texts)
+                # the fused jit has no internal deadline; waiting on the
+                # dedicated worker honors the caller's timeout (a cold
+                # compile keeps running and warms the cache for later)
+                out = self._stacked_pool.submit(
+                    self._stacked_run, tasks, texts).result(timeout)
+            except FutTimeout:
+                self.path_chooser.record(
+                    STACKED, tasks, len(texts), timeout, 0.0, ok=True)
+                sel = PathSelection(TRADITIONAL, 1.0,
+                                    f"stacked pass exceeded {timeout}s "
+                                    "budget — serving traditional",
+                                    PathMetrics())
+                self.last_path_selection = sel
             except Exception:
                 self.path_chooser.record(
                     STACKED, tasks, len(texts),
@@ -479,6 +500,9 @@ class InferenceEngine:
 
     def shutdown(self) -> None:
         self.batcher.shutdown()
+        pool = getattr(self, "_stacked_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     # -- internals ---------------------------------------------------------
 

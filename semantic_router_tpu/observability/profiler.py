@@ -52,8 +52,11 @@ class ProfilerControl:
                 return {"error": "profiler not running", "status": 409}
             import jax
 
-            jax.profiler.stop_trace()
+            # clear BEFORE stop_trace: if the stop itself raises (full
+            # disk, profiler-internal error) the control must not wedge
+            # with every future start() answering 409 until restart
             target, self._active_dir = self._active_dir, None
+            jax.profiler.stop_trace()
             files = sorted(
                 os.path.relpath(p, target)
                 for p in glob.glob(os.path.join(target, "**", "*"),
@@ -89,9 +92,15 @@ def configure_xla_dump(dump_dir: str) -> Dict[str, Any]:
                      if not p.startswith("--xla_dump_to="))
     os.environ["XLA_FLAGS"] = (flags + f" --xla_dump_to={dump_dir}").strip()
     os.makedirs(dump_dir, exist_ok=True)
-    import jax
+    # private-API probe guarded: jax._src carries no stability promise,
+    # and a half-applied endpoint (flags mutated, then AttributeError →
+    # 500) would be worse than the conservative answer
+    try:
+        import jax
 
-    live = not jax._src.xla_bridge._backends  # type: ignore[attr-defined]
+        live = not jax._src.xla_bridge._backends  # type: ignore
+    except Exception:
+        live = False
     return {"configured": True, "dir": dump_dir,
             "effective": "now" if live else "next process start"}
 

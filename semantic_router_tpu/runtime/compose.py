@@ -38,12 +38,22 @@ def _envoy_config(cfg, extproc_host: str = "router",
     routes: List[Dict] = []
     clusters: List[Dict] = []
     backends = {}
+    seen_tokens: Dict[str, str] = {}
     for card in cfg.model_cards:
+        token = _sanitize(card.name)
+        if token in seen_tokens:
+            # two distinct names collapsing to one service/cluster name
+            # would silently overwrite each other's topology
+            raise ValueError(
+                f"model cards {seen_tokens[token]!r} and {card.name!r} "
+                f"sanitize to the same service token {token!r} — rename "
+                "one")
+        seen_tokens[token] = card.name
         host = (card.extra or {}).get("backend_host") if hasattr(
             card, "extra") else None
         backends[card.name] = {
             "cluster": "vllm_" + _sanitize(card.name, "_"),
-            "host": host or f"backend-{_sanitize(card.name)}",
+            "host": host or f"backend-{token}",
             "port": 8000,
         }
     for name, b in backends.items():

@@ -120,6 +120,9 @@ class LlamaStackVectorStore:
                  overlap_sentences: int = 1) -> None:
         self.client = client
         self.name = name
+        # accepted for VectorStore-protocol symmetry; llama-stack owns
+        # embeddings server-side so this never computes vectors here
+        self.embed_fn = embed_fn
         self.search_type = search_type
         self.chunk_sentences = chunk_sentences
         self.overlap_sentences = overlap_sentences
@@ -151,15 +154,19 @@ class LlamaStackVectorStore:
                hybrid: bool = True):
         from ..vectorstore.store import Chunk, SearchHit
 
+        # hybrid requires BOTH the store to be configured for it (the
+        # server needs an RRF-capable provider) and the caller to ask —
+        # hybrid=False on a hybrid store degrades to vector search with
+        # normal cosine thresholding, matching the other backends
+        use_hybrid = self.search_type == "hybrid" and hybrid
         hits = self.client.search(
-            self.store_id, query, top_k=top_k,
-            hybrid=self.search_type == "hybrid")
+            self.store_id, query, top_k=top_k, hybrid=use_hybrid)
         out = []
         for h in hits:
             score = float(h.get("score", 0.0))
             # RRF scores are not cosine-comparable — only threshold in
             # pure vector mode (llama_stack_search.go:58-66)
-            if self.search_type != "hybrid" and score < threshold:
+            if not use_hybrid and score < threshold:
                 continue
             meta = dict(h.get("metadata", h.get("attributes", {})) or {})
             chunk = Chunk(

@@ -217,6 +217,9 @@ class MiniRedisClusterNode(MiniRedis):
         self.slot_range = slot_range
         self.peers: Dict[int, str] = {}       # slot → "host:port"
         self.migrating: Dict[int, str] = {}
+        # ASKING is per-CONNECTION in real Redis; connections here are
+        # thread-per-conn, so thread-local scoping matches the wire
+        self._asking_state = threading.local()
 
     def owns(self, slot: int) -> bool:
         return self.slot_range[0] <= slot <= self.slot_range[1]
@@ -230,14 +233,14 @@ class MiniRedisClusterNode(MiniRedis):
                 self._arr([self._bulk(self.host.encode()),
                            self._int(self.port)])])])
         if name == "ASKING":
-            self._asking = True
+            self._asking_state.flag = True
             return b"+OK\r\n"
         ki = _KEY_INDEX.get(name)
         if ki is not None and len(args) > ki:
             key = args[ki].decode()
             slot = hash_slot(key)
-            asking = getattr(self, "_asking", False)
-            self._asking = False
+            asking = getattr(self._asking_state, "flag", False)
+            self._asking_state.flag = False
             if not self.owns(slot) and not asking:
                 target = self.peers.get(slot)
                 if target:
