@@ -176,9 +176,14 @@ class InferenceEngine:
         }
         # one worker: classify_multi waits on it WITH the caller's
         # timeout; an abandoned (cold-compiling) run keeps going and
-        # warms the jit cache for the next attempt
+        # warms the jit cache for the next attempt. Re-registration
+        # (bank hot-reload) retires the old pool instead of leaking its
+        # worker thread.
         from concurrent.futures import ThreadPoolExecutor
 
+        old_pool = getattr(self, "_stacked_pool", None)
+        if old_pool is not None:
+            old_pool.shutdown(wait=False)
         self._stacked_pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="stacked-bank")
         self.path_chooser = DualPathChooser(strategy=strategy)
@@ -216,6 +221,14 @@ class InferenceEngine:
                                 PathMetrics())
         self.last_path_selection = sel
 
+        # one deadline covers the WHOLE call: a stacked attempt that
+        # burns budget leaves only the remainder for the traditional
+        # fallback — never (1 + n_tasks) stacked timeouts
+        deadline = time.perf_counter() + timeout
+
+        def remaining() -> float:
+            return max(0.05, deadline - time.perf_counter())
+
         if sel.selected_path == STACKED:
             from concurrent.futures import TimeoutError as FutTimeout
 
@@ -223,14 +236,16 @@ class InferenceEngine:
             try:
                 # the fused jit has no internal deadline; waiting on the
                 # dedicated worker honors the caller's timeout (a cold
-                # compile keeps running and warms the cache for later)
+                # compile keeps going and warms the cache for later).
+                # Half the budget at most: the fallback needs room too.
                 out = self._stacked_pool.submit(
-                    self._stacked_run, tasks, texts).result(timeout)
+                    self._stacked_run, tasks, texts).result(timeout / 2)
             except FutTimeout:
                 self.path_chooser.record(
-                    STACKED, tasks, len(texts), timeout, 0.0, ok=True)
+                    STACKED, tasks, len(texts), timeout / 2, 0.0,
+                    ok=True)
                 sel = PathSelection(TRADITIONAL, 1.0,
-                                    f"stacked pass exceeded {timeout}s "
+                                    f"stacked pass exceeded {timeout / 2:g}s "
                                     "budget — serving traditional",
                                     PathMetrics())
                 self.last_path_selection = sel
@@ -252,7 +267,7 @@ class InferenceEngine:
                 return out
 
         t0 = time.perf_counter()
-        out = {t: self.classify_batch(t, texts, timeout=timeout)
+        out = {t: self.classify_batch(t, texts, timeout=remaining())
                for t in tasks}
         if eligible:
             conf = float(np.mean([r.confidence for rs in out.values()

@@ -52,11 +52,17 @@ class ProfilerControl:
                 return {"error": "profiler not running", "status": 409}
             import jax
 
-            # clear BEFORE stop_trace: if the stop itself raises (full
-            # disk, profiler-internal error) the control must not wedge
-            # with every future start() answering 409 until restart
+            # a failed stop (full disk, profiler-internal error) keeps
+            # the session marked active so the operator can RETRY stop()
+            # — jax still holds its one-profile session either way, and
+            # clearing here would leave no code path that releases it
+            try:
+                jax.profiler.stop_trace()
+            except Exception as exc:
+                return {"error": f"stop_trace failed: {exc}"[:300],
+                        "dir": self._active_dir, "retryable": True,
+                        "status": 500}
             target, self._active_dir = self._active_dir, None
-            jax.profiler.stop_trace()
             files = sorted(
                 os.path.relpath(p, target)
                 for p in glob.glob(os.path.join(target, "**", "*"),

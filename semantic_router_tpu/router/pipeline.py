@@ -506,10 +506,27 @@ class Router:
         if selector is None:
             kwargs = {k: v for k, v in algo.items() if k != "type"}
             kwargs.pop("on_error", None)
-            try:
-                selector = selectors.create(algo_type, **kwargs)
-            except (KeyError, TypeError):
-                selector = selectors.create("static")
+            artifact = kwargs.pop("artifact", "")
+            if artifact:
+                # offline-trained artifact (training/selection_train.py →
+                # pkg/modelselection persistence role): the JSON file
+                # cold-starts the selector; online learning continues on
+                # top. A missing/corrupt artifact falls back to the
+                # untrained algorithm rather than failing the request.
+                try:
+                    from ..training.selection_train import load_selector
+
+                    selector = load_selector(str(artifact))
+                except Exception as exc:
+                    component_event(
+                        "selection", "artifact_load_failed",
+                        decision=decision.name, artifact=str(artifact),
+                        error=str(exc), level="warning")
+            if selector is None:
+                try:
+                    selector = selectors.create(algo_type, **kwargs)
+                except (KeyError, TypeError):
+                    selector = selectors.create("static")
             self._selectors[decision.name] = selector
         embed_fn = None
         if self.engine is not None and self.engine.has_task(self.embedding_task):

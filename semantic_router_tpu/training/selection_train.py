@@ -187,19 +187,49 @@ class CategoryFeatureSelector:
     so the feature space the weights were trained in actually exists at
     serving time."""
 
-    def __init__(self, inner) -> None:
+    def __init__(self, inner, embedding_dim: int = 0,
+                 hash_fallback: bool = False) -> None:
         self.inner = inner
         self.name = getattr(inner, "name", "trained")
+        self.embedding_dim = embedding_dim
+        self.hash_fallback = hash_fallback
+        self._dim_warned = False
 
-    @staticmethod
-    def _augment_ctx(ctx):
+    def _check_dim(self, e: np.ndarray) -> None:
+        """A live embedder whose width differs from the trained space
+        must fail LOUDLY (once in the logs, every time to the caller) —
+        silently scoring foreign features routes wrong with no signal."""
+        if self.embedding_dim and e.shape[-1] != self.embedding_dim:
+            if not self._dim_warned:
+                self._dim_warned = True
+                try:
+                    from ..observability.logging import component_event
+
+                    component_event(
+                        "selection", "artifact_dim_mismatch",
+                        expected=self.embedding_dim,
+                        got=int(e.shape[-1]), level="warning")
+                except Exception:
+                    pass
+            raise ValueError(
+                f"embedding dim {e.shape[-1]} != artifact's trained "
+                f"dim {self.embedding_dim}")
+
+    def _augment_ctx(self, ctx):
         base_fn = ctx.embed_fn
-        if base_fn is None:
+        if self.hash_fallback and self.embedding_dim:
+            # the artifact's recipe IS the crc32 hash space: use it even
+            # when a live embedder exists — engine embeddings would be a
+            # different space that merely shares (or doesn't) the width
+            dim = self.embedding_dim
+            base_fn = lambda q: hash_embed([q], dim=dim)[0]  # noqa: E731
+        elif base_fn is None:
             return ctx
         cat = ctx.category
 
-        def embed_fn(q):
+        def embed_fn(q, base_fn=base_fn):
             e = np.asarray(base_fn(q), np.float32)
+            self._check_dim(e)
             return np.concatenate([e, category_onehot(cat)])
 
         return dataclasses.replace(ctx, embed_fn=embed_fn,
@@ -219,12 +249,17 @@ class CategoryFeatureSelector:
 # -- trainers -------------------------------------------------------------
 
 
-def _tag_features(blob: str, feats: np.ndarray) -> str:
+def _tag_features(blob: str, feats: np.ndarray,
+                  embed_kind: str = "crc32-hash-v1") -> str:
     """Record the feature recipe in the artifact so the loader can
-    reconstruct it at serving time."""
+    reconstruct it at serving time. ``embed_kind`` names the embedding
+    the trainer used; "crc32-hash-v1" (the built-in fallback) is
+    self-contained, so an engine-less serving process can still produce
+    the trained feature space."""
     data = json.loads(blob)
     data["features"] = {"category_onehot": True,
                         "category_scale": CATEGORY_SCALE,
+                        "embed": embed_kind,
                         "embedding_dim": int(feats.shape[1])
                         - len(CATEGORIES)}
     return json.dumps(data)
@@ -233,8 +268,16 @@ def _tag_features(blob: str, feats: np.ndarray) -> str:
 def train_selector(algorithm: str, feats: np.ndarray,
                    labels: Sequence[str],
                    records: Optional[Sequence[RoutingRecord]] = None,
-                   embed_fn=None, **kwargs) -> str:
-    """Fit one algorithm; return its JSON artifact."""
+                   embed_fn=None, embed_kind: Optional[str] = None,
+                   **kwargs) -> str:
+    """Fit one algorithm; return its JSON artifact. ``embed_kind`` names
+    the embedding the FEATURES were built with; it defaults to the
+    self-contained crc32 hash only when no custom ``embed_fn`` is in
+    play — an artifact trained on real engine embeddings must NOT be
+    tagged hash-reproducible (the serving fallback would fabricate a
+    different feature space that happens to have the right width)."""
+    if embed_kind is None:
+        embed_kind = "crc32-hash-v1" if embed_fn is None else "external"
     from ..selection.ml import (
         GMTRouterSelector,
         KMeansSelector,
@@ -246,16 +289,16 @@ def train_selector(algorithm: str, feats: np.ndarray,
     if algorithm == "mlp":
         sel = MLPSelector(**kwargs)
         sel.fit(feats, labels)
-        return _tag_features(sel.to_json(), feats)
+        return _tag_features(sel.to_json(), feats, embed_kind)
     if algorithm == "svm":
         sel = SVMSelector(**kwargs)
         sel.fit(feats, labels)
-        return _tag_features(sel.to_json(), feats)
+        return _tag_features(sel.to_json(), feats, embed_kind)
     if algorithm == "knn":
         sel = KNNSelector(**kwargs)
         for f, l in zip(feats, labels):
             sel.memory.add(f, l, 1.0)
-        return _tag_features(sel.to_json(), feats)
+        return _tag_features(sel.to_json(), feats, embed_kind)
     if algorithm == "kmeans":
         sel = KMeansSelector(
             n_clusters=kwargs.pop("n_clusters", 8), **kwargs)
@@ -266,7 +309,7 @@ def train_selector(algorithm: str, feats: np.ndarray,
         # an online refit from ~64 fresh points would orphan the trained
         # cluster→model mapping (refit_every round-trips via to_json)
         sel.refit_every = 1 << 30
-        return _tag_features(sel.to_json(), feats)
+        return _tag_features(sel.to_json(), feats, embed_kind)
     if algorithm == "gmtrouter":
         # RL-style offline pre-training: replay the historical
         # interactions through the online learner (every record, not just
@@ -304,7 +347,7 @@ def train_selector(algorithm: str, feats: np.ndarray,
                                 quality=float(np.clip(0.5 + 2 * adv, 0, 1)),
                                 latency_ms=r.latency_ms,
                                 query_embedding=feat))
-        return _tag_features(sel.to_json(), feats)
+        return _tag_features(sel.to_json(), feats, embed_kind)
     raise ValueError(f"unknown algorithm {algorithm!r}")
 
 
@@ -327,8 +370,11 @@ def load_selector(path: str):
            "svm": SVMSelector, "mlp": MLPSelector,
            "gmtrouter": GMTRouterSelector}[algo]
     sel = cls.from_json(blob)
-    if data.get("features", {}).get("category_onehot"):
-        return CategoryFeatureSelector(sel)
+    feats = data.get("features", {})
+    if feats.get("category_onehot"):
+        return CategoryFeatureSelector(
+            sel, embedding_dim=int(feats.get("embedding_dim", 0)),
+            hash_fallback=feats.get("embed") == "crc32-hash-v1")
     return sel
 
 
