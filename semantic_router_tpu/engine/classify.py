@@ -233,19 +233,25 @@ class InferenceEngine:
             from concurrent.futures import TimeoutError as FutTimeout
 
             t0 = time.perf_counter()
+            # the fused jit has no internal deadline; waiting on the
+            # dedicated worker honors the caller's timeout (a cold
+            # compile keeps going and warms the cache for later).
+            # When a traditional fallback is in play it needs room, so
+            # the stacked attempt gets half the budget — but a PINNED
+            # stacked strategy is an operator override with no fallback
+            # intent and keeps the whole budget.
+            pinned = self.path_chooser.strategy == STACKED
+            stacked_budget = timeout if pinned else timeout / 2
             try:
-                # the fused jit has no internal deadline; waiting on the
-                # dedicated worker honors the caller's timeout (a cold
-                # compile keeps going and warms the cache for later).
-                # Half the budget at most: the fallback needs room too.
                 out = self._stacked_pool.submit(
-                    self._stacked_run, tasks, texts).result(timeout / 2)
+                    self._stacked_run, tasks, texts).result(stacked_budget)
             except FutTimeout:
                 self.path_chooser.record(
-                    STACKED, tasks, len(texts), timeout / 2, 0.0,
+                    STACKED, tasks, len(texts), stacked_budget, 0.0,
                     ok=True)
                 sel = PathSelection(TRADITIONAL, 1.0,
-                                    f"stacked pass exceeded {timeout / 2:g}s "
+                                    f"stacked pass exceeded "
+                                    f"{stacked_budget:g}s "
                                     "budget — serving traditional",
                                     PathMetrics())
                 self.last_path_selection = sel

@@ -46,21 +46,33 @@ class ProfilerControl:
             self._started_at = time.time()
             return {"started": True, "dir": target}
 
-    def stop(self) -> Dict[str, Any]:
+    def stop(self, force: bool = False) -> Dict[str, Any]:
         with self._lock:
             if self._active_dir is None:
                 return {"error": "profiler not running", "status": 409}
             import jax
 
             # a failed stop (full disk, profiler-internal error) keeps
-            # the session marked active so the operator can RETRY stop()
-            # — jax still holds its one-profile session either way, and
-            # clearing here would leave no code path that releases it
+            # the session marked active so the operator can RETRY stop().
+            # But when jax's own session is already gone (stop_trace got
+            # far enough to terminate it before raising), a retry can
+            # never succeed — detect that, or accept force=True, and
+            # clear the marker so the profiler doesn't wedge permanently.
             try:
                 jax.profiler.stop_trace()
             except Exception as exc:
+                msg = str(exc).lower()
+                session_gone = ("no profile" in msg or "not started" in msg
+                                or "no active" in msg
+                                or "not running" in msg)
+                if force or session_gone:
+                    target, self._active_dir = self._active_dir, None
+                    return {"error": f"stop_trace failed: {exc}"[:300],
+                            "dir": target, "cleared": True,
+                            "status": 500}
                 return {"error": f"stop_trace failed: {exc}"[:300],
                         "dir": self._active_dir, "retryable": True,
+                        "hint": "retry stop, or stop?force=1 to clear",
                         "status": 500}
             target, self._active_dir = self._active_dir, None
             files = sorted(

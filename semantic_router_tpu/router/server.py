@@ -674,7 +674,8 @@ class RouterServer:
                             out = default_profiler.start(
                                 str(body.get("dir", "")))
                         elif action == "stop":
-                            out = default_profiler.stop()
+                            out = default_profiler.stop(
+                                force=bool(body.get("force")))
                         elif action == "xla-dump":
                             out = configure_xla_dump(str(body.get(
                                 "dir", "/tmp/srt-xla-dump")))
@@ -978,6 +979,7 @@ class RouterServer:
                 doc = store.ingest(str(body.get("name", "file")),
                                    str(body.get("text", "")),
                                    metadata=body.get("metadata"))
+                mgr.record_file(name, doc)
                 self._json(200, {"id": doc.id, "chunks":
                                  len(doc.chunk_ids)})
 

@@ -265,10 +265,31 @@ def build_router(cfg: RouterConfig, engine=None,
 
     # vectorstore backend (pkg/vectorstore registry role)
     vs_cfg = cfg.vectorstore or {}
+    registry = None
+    reg_cfg = vs_cfg.get("registry") or {}
+    if reg_cfg.get("backend") == "postgres":
+        from ..vectorstore.pg_registry import PostgresMetadataRegistry
+
+        try:
+            registry = PostgresMetadataRegistry(
+                host=reg_cfg.get("host", "127.0.0.1"),
+                port=int(reg_cfg.get("port", 5432)),
+                user=reg_cfg.get("user", "postgres"),
+                database=reg_cfg.get("database", "postgres"),
+                password=str(reg_cfg.get("password", "")))
+        except Exception as exc:
+            component_event("bootstrap", "vectorstore_registry_failed",
+                            level="warning", error=str(exc)[:200])
     router.vectorstores = VectorStoreManager(
         embed_fn, backend=vs_cfg.get("backend", "memory"),
         base_path=vs_cfg.get("path"),
-        backend_config=vs_cfg.get("backend_config"))
+        backend_config=vs_cfg.get("backend_config"),
+        registry=registry)
+    if registry is not None:
+        attached = router.vectorstores.load_from_registry()
+        if attached:
+            component_event("bootstrap", "vectorstore_registry_attach",
+                            stores=attached)
 
     replay_cfg = cfg.router_replay or {}
     if replay_cfg.get("enabled", True):
@@ -278,6 +299,16 @@ def build_router(cfg: RouterConfig, engine=None,
 
             store = SQLiteReplayStore(
                 replay_path or replay_cfg["path"],
+                max_records=int(replay_cfg.get("max_records", 100_000)))
+        elif replay_cfg.get("backend") == "postgres":
+            from ..replay.postgres_store import PostgresReplayStore
+
+            store = PostgresReplayStore(
+                host=replay_cfg.get("host", "127.0.0.1"),
+                port=int(replay_cfg.get("port", 5432)),
+                user=replay_cfg.get("user", "postgres"),
+                database=replay_cfg.get("database", "postgres"),
+                password=str(replay_cfg.get("password", "")),
                 max_records=int(replay_cfg.get("max_records", 100_000)))
         else:
             store = ReplayStore(

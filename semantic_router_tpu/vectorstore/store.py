@@ -169,11 +169,17 @@ class VectorStoreManager:
     def __init__(self, embed_fn: Optional[Callable] = None,
                  backend: str = "memory",
                  base_path: Optional[str] = None,
-                 backend_config: Optional[Dict] = None) -> None:
+                 backend_config: Optional[Dict] = None,
+                 registry=None) -> None:
         self.embed_fn = embed_fn
         self.backend = backend
         self.base_path = base_path
         self.backend_config = dict(backend_config or {})
+        # optional durable metadata registry (reference:
+        # metadata_registry_postgres.go); registry failures never block
+        # store operations — the registry is recovery metadata, not the
+        # data path
+        self.registry = registry
         self._stores: Dict[str, InMemoryVectorStore] = {}
         self._lock = threading.Lock()
         self._qdrant = None
@@ -256,7 +262,8 @@ class VectorStoreManager:
                 raise ValueError(f"store {name!r} exists")
             store = self._new_store(name, **kwargs)
             self._stores[name] = store
-            return store
+        self._registry_register(name)
+        return store
 
     def get(self, name: str) -> Optional[InMemoryVectorStore]:
         import os
@@ -305,7 +312,71 @@ class VectorStoreManager:
         # the lock (same invariant get() documents), publish under it
         store = self._new_store(name)
         with self._lock:
-            return self._stores.setdefault(name, store)
+            store = self._stores.setdefault(name, store)
+        self._registry_register(name)
+        return store
+
+    def _registry_register(self, name: str) -> None:
+        if self.registry is None:
+            return
+        try:
+            self.registry.register_store(name, backend=self.backend,
+                                         config=self.backend_config)
+        except Exception:
+            return  # fail-open: registry is recovery metadata only
+        # registry I/O runs outside the manager lock, so a concurrent
+        # delete() may have already unregistered this name — compensate
+        # rather than leave a ghost row that resurrects at next boot
+        with self._lock:
+            still_present = name in self._stores
+        if not still_present:
+            try:
+                self.registry.unregister_store(name)
+            except Exception:
+                pass
+
+    def record_file(self, store_name: str, doc) -> None:
+        """Register an ingested document in the durable file registry
+        (file_registry table role)."""
+        if self.registry is None:
+            return
+        try:
+            self.registry.register_file(
+                store_name, doc.id, name=doc.name,
+                chunks=len(getattr(doc, "chunk_ids", []) or []),
+                metadata=dict(getattr(doc, "metadata", {}) or {}))
+        except Exception:
+            pass
+
+    def load_from_registry(self) -> List[str]:
+        """Boot-time re-attach of every registered store (LoadFromRegistry
+        role, SURVEY.md §5: registry rows loaded at boot)."""
+        if self.registry is None:
+            return []
+        try:
+            names = self.registry.list_stores()
+        except Exception:
+            return []
+        if names and self.backend == "memory":
+            # in-memory stores cannot replay their contents from the
+            # registry (file_registry records names/ids, not text) —
+            # re-attach restores NAMES ONLY; say so instead of silently
+            # serving empty stores
+            from ..observability.logging import component_event
+
+            component_event(
+                "vectorstore", "registry_reattach_names_only",
+                level="warning", backend=self.backend, stores=names,
+                reason="memory backend holds no durable contents; "
+                       "re-attached stores start empty")
+        attached = []
+        for name in names:
+            try:
+                if self.get_or_create(name) is not None:
+                    attached.append(name)
+            except Exception:
+                continue
+        return attached
 
     def list(self) -> List[str]:
         with self._lock:
@@ -318,6 +389,11 @@ class VectorStoreManager:
             store = self._stores.pop(name, None)
         if store is not None and hasattr(store, "close"):
             store.close()
+        if self.registry is not None:
+            try:
+                self.registry.unregister_store(name)
+            except Exception:
+                pass
         # durable cleanup runs OUTSIDE the lock (file IO / network)
         if self.backend == "sqlite" \
                 and os.path.exists(self._db_path(name)):
