@@ -48,6 +48,12 @@ def main(argv=None) -> int:
                        help="verify the migrated config loads to "
                             "equivalent routing behavior")
 
+    graf_p = sub.add_parser(
+        "grafana", help="render provisioning-ready Grafana dashboards "
+                        "from the metric catalog "
+                        "(src/vllm-sr/cli/templates/grafana_*.py role)")
+    graf_p.add_argument("--out-dir", required=True)
+
     comp_p = sub.add_parser(
         "compose", help="render a docker-compose deployment "
                         "(router + Envoy + mock backend) for a config")
@@ -90,6 +96,13 @@ def main(argv=None) -> int:
             print(json.dumps({"migrated": True, "out": args.out,
                               "was_canonical": is_canonical(
                                   cfg.raw or {})}))
+        return 0
+
+    if args.command == "grafana":
+        from .observability.grafana import render_all
+
+        paths = render_all(args.out_dir)
+        print(json.dumps({"rendered": sorted(paths)}))
         return 0
 
     if args.command == "compose":

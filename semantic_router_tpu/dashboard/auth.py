@@ -1,0 +1,73 @@
+"""Dashboard session tokens (the reference dashboard/backend's
+SQLite+JWT auth role).
+
+HMAC-SHA256 signed tokens in the JWT compact shape
+(``base64url(header).base64url(payload).base64url(sig)``), hand-framed —
+the claim set is tiny (roles, exp, iat) and a dependency-free HS256
+implementation keeps the image's zero-install rule. Tokens are issued
+in exchange for a configured management API key (POST
+/dashboard/api/login) so the browser never stores the long-lived key;
+the signing secret is per-process random — restart invalidates
+sessions, matching the reference's dashboard session behavior.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import time
+from typing import List, Optional, Set
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _unb64url(text: str) -> bytes:
+    pad = "=" * (-len(text) % 4)
+    return base64.urlsafe_b64decode(text + pad)
+
+
+class TokenIssuer:
+    def __init__(self, secret: Optional[bytes] = None,
+                 ttl_s: float = 8 * 3600.0) -> None:
+        self.secret = secret or os.urandom(32)
+        self.ttl_s = ttl_s
+
+    def _sign(self, signing_input: bytes) -> str:
+        return _b64url(hmac.new(self.secret, signing_input,
+                                hashlib.sha256).digest())
+
+    def issue(self, roles: Set[str], ttl_s: Optional[float] = None) -> str:
+        now = time.time()
+        header = _b64url(json.dumps(
+            {"alg": "HS256", "typ": "JWT"},
+            separators=(",", ":")).encode())
+        payload = _b64url(json.dumps(
+            {"roles": sorted(roles), "iat": int(now),
+             "exp": int(now + (ttl_s or self.ttl_s))},
+            separators=(",", ":")).encode())
+        signing_input = f"{header}.{payload}".encode()
+        return f"{header}.{payload}.{self._sign(signing_input)}"
+
+    def verify(self, token: str) -> Optional[Set[str]]:
+        """Roles for a valid unexpired token; None otherwise."""
+        parts = token.split(".")
+        if len(parts) != 3:
+            return None
+        signing_input = f"{parts[0]}.{parts[1]}".encode()
+        if not hmac.compare_digest(self._sign(signing_input), parts[2]):
+            return None
+        try:
+            payload = json.loads(_unb64url(parts[1]))
+        except (ValueError, UnicodeDecodeError):
+            return None
+        if float(payload.get("exp", 0)) < time.time():
+            return None
+        roles = payload.get("roles")
+        if not isinstance(roles, list):
+            return None
+        return set(str(r) for r in roles)

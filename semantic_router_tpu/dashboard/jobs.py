@@ -1,0 +1,165 @@
+"""Durable dashboard job runner (reference dashboard/backend's ML
+pipeline jobs / evaluation runner / workflowstore role).
+
+Jobs run in a daemon worker thread; state is persisted per transition
+(SQLite when a path is given, in-memory otherwise) so finished history
+survives restarts and an interrupted RUN is visible as such after a
+crash ("running" jobs found at startup are marked interrupted — the
+thread died with the process; the reference's workflowstore does the
+same on boot).
+
+Kinds are a registry: the server wires `selection_benchmark`
+(modelselection.BenchmarkRunner → trainer artifacts) and `accuracy_eval`
+(replay a query set through the live router, report the decision/model
+distribution); anything else can register.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+import uuid
+from dataclasses import asdict, dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+PENDING, RUNNING, DONE, FAILED, INTERRUPTED = (
+    "pending", "running", "done", "failed", "interrupted")
+
+
+@dataclass
+class Job:
+    job_id: str
+    kind: str
+    params: Dict[str, Any] = field(default_factory=dict)
+    status: str = PENDING
+    created_t: float = 0.0
+    started_t: float = 0.0
+    finished_t: float = 0.0
+    result: Optional[Dict[str, Any]] = None
+    error: str = ""
+
+    def public(self) -> Dict[str, Any]:
+        d = asdict(self)
+        return d
+
+
+class JobStore:
+    """Persistence: one row per job, updated per transition."""
+
+    _SCHEMA = """
+    CREATE TABLE IF NOT EXISTS dashboard_jobs (
+        job_id   TEXT PRIMARY KEY,
+        kind     TEXT NOT NULL,
+        status   TEXT NOT NULL,
+        created  REAL NOT NULL,
+        payload  TEXT NOT NULL
+    )"""
+
+    def __init__(self, path: str = "") -> None:
+        self._conn = sqlite3.connect(path or ":memory:",
+                                     check_same_thread=False)
+        self._lock = threading.Lock()
+        self._closed = False
+        with self._lock:
+            self._conn.execute(self._SCHEMA)
+            # a "running" row at open time belonged to a dead process
+            self._conn.execute(
+                "UPDATE dashboard_jobs SET status = ? WHERE status = ?",
+                (INTERRUPTED, RUNNING))
+            self._conn.commit()
+
+    def put(self, job: Job) -> None:
+        with self._lock:
+            if self._closed:
+                # shutdown raced an in-flight job's terminal write: the
+                # job will honestly read as "interrupted" after reopen
+                # (the process was going down); don't crash its thread
+                return
+            self._conn.execute(
+                "INSERT OR REPLACE INTO dashboard_jobs "
+                "(job_id, kind, status, created, payload) "
+                "VALUES (?,?,?,?,?)",
+                (job.job_id, job.kind, job.status, job.created_t,
+                 json.dumps(job.public())))
+            self._conn.commit()
+
+    def get(self, job_id: str) -> Optional[Job]:
+        with self._lock:
+            if self._closed:
+                return None
+            row = self._conn.execute(
+                "SELECT payload, status FROM dashboard_jobs "
+                "WHERE job_id = ?", (job_id,)).fetchone()
+        if row is None:
+            return None
+        d = json.loads(row[0])
+        d["status"] = row[1]  # boot-time interruption marking wins
+        return Job(**d)
+
+    def list(self, limit: int = 50) -> List[Job]:
+        with self._lock:
+            if self._closed:
+                return []
+            rows = self._conn.execute(
+                "SELECT payload, status FROM dashboard_jobs "
+                "ORDER BY created DESC LIMIT ?", (limit,)).fetchall()
+        out = []
+        for payload, status in rows:
+            d = json.loads(payload)
+            d["status"] = status
+            out.append(Job(**d))
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._conn.close()
+
+
+class JobRunner:
+    def __init__(self, store: Optional[JobStore] = None,
+                 max_workers: int = 2) -> None:
+        self.store = store or JobStore()
+        self._kinds: Dict[str, Callable[[Dict[str, Any]],
+                                        Dict[str, Any]]] = {}
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers,
+            thread_name_prefix="dashboard-job")
+
+    def register(self, kind: str,
+                 fn: Callable[[Dict[str, Any]], Dict[str, Any]]) -> None:
+        self._kinds[kind] = fn
+
+    def kinds(self) -> List[str]:
+        return sorted(self._kinds)
+
+    def submit(self, kind: str,
+               params: Optional[Dict[str, Any]] = None) -> Job:
+        if kind not in self._kinds:
+            raise KeyError(f"unknown job kind {kind!r}")
+        job = Job(job_id=uuid.uuid4().hex[:12], kind=kind,
+                  params=dict(params or {}), created_t=time.time())
+        self.store.put(job)
+        self._pool.submit(self._run, job)
+        return job
+
+    def _run(self, job: Job) -> None:
+        job.status = RUNNING
+        job.started_t = time.time()
+        self.store.put(job)
+        try:
+            job.result = self._kinds[job.kind](job.params)
+            job.status = DONE
+        except Exception as exc:
+            job.status = FAILED
+            job.error = f"{type(exc).__name__}: {exc}"[:500]
+        job.finished_t = time.time()
+        self.store.put(job)
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)
+        self.store.close()

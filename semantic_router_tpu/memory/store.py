@@ -211,6 +211,16 @@ class InMemoryMemoryStore:
         with self._lock:
             return list(self._items.get(user_id, ()))
 
+    def list_all(self, limit: int = 5000) -> List[MemoryItem]:
+        """Every user's items (dashboard embedding-map population)."""
+        out: List[MemoryItem] = []
+        with self._lock:
+            for items in self._items.values():
+                out.extend(items)
+                if len(out) >= limit:
+                    break
+        return out[:limit]
+
     def delete(self, user_id: str, memory_id: str) -> bool:
         with self._lock:
             items = self._items.get(user_id, [])

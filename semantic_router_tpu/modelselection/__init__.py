@@ -1,0 +1,16 @@
+"""In-router offline ML-selection harness (pkg/modelselection role).
+
+The reference's pkg/modelselection closes the loop the serving-side
+selectors need: generate a routing-benchmark corpus by driving real
+candidate endpoints (benchmark_runner.go), derive the candidate set from
+the router config (config_analyzer.go), and persist/evaluate trained
+artifacts (trainer.go, persistence.go). The heavy training math lives in
+``training/selection_train.py`` (the src/training twin); this package is
+the data/benchmark half.
+"""
+
+from .analyzer import CandidateModel, candidates_from_config
+from .benchmark import BenchmarkRunner, keyword_scorer
+
+__all__ = ["BenchmarkRunner", "keyword_scorer", "CandidateModel",
+           "candidates_from_config"]

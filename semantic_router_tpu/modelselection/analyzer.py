@@ -1,0 +1,58 @@
+"""Candidate-model analysis from the router config.
+
+Reference role: pkg/modelselection/config_analyzer.go — inspect the
+loaded RouterConfig and derive the LLM candidate set (names, pricing,
+quality hints, decision membership) the benchmark runner drives and the
+trainers label against. No network; pure config introspection.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class CandidateModel:
+    name: str
+    quality_score: float = 0.0
+    modality: str = "text"
+    tags: List[str] = field(default_factory=list)
+    price_per_1m_in: float = 0.0
+    price_per_1m_out: float = 0.0
+    decisions: List[str] = field(default_factory=list)  # decision names
+    #                                                     referencing it
+
+
+def candidates_from_config(cfg) -> List[CandidateModel]:
+    """Every model a decision can route to, with its card metadata and
+    the decisions that reference it; models no decision references are
+    still included (the selector may fall back to them)."""
+    by_name: Dict[str, CandidateModel] = {}
+    for card in getattr(cfg, "model_cards", []) or []:
+        pricing = getattr(card, "pricing", None) or {}
+        by_name[card.name] = CandidateModel(
+            name=card.name,
+            quality_score=float(getattr(card, "quality_score", 0.0)
+                                or 0.0),
+            modality=getattr(card, "modality", "ar") or "ar",
+            tags=list(getattr(card, "tags", []) or []),
+            price_per_1m_in=float(pricing.get(
+                "prompt", pricing.get("input", 0.0)) or 0.0)
+            if isinstance(pricing, dict) else 0.0,
+            price_per_1m_out=float(pricing.get(
+                "completion", pricing.get("output", 0.0)) or 0.0)
+            if isinstance(pricing, dict) else 0.0,
+        )
+    for dec in getattr(cfg, "decisions", []) or []:
+        for ref in getattr(dec, "model_refs", []) or []:
+            name = getattr(ref, "model", None) or getattr(ref, "name", "")
+            if not name:
+                continue
+            cand = by_name.setdefault(name, CandidateModel(name=name))
+            if dec.name not in cand.decisions:
+                cand.decisions.append(dec.name)
+    default = getattr(cfg, "default_model", "")
+    if default and default not in by_name:
+        by_name[default] = CandidateModel(name=default)
+    return [by_name[k] for k in sorted(by_name)]

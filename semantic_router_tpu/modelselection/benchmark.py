@@ -1,0 +1,211 @@
+"""Routing-benchmark runner: generate the ML-selection training corpus.
+
+Reference role: pkg/modelselection/benchmark_runner.go — drive every
+candidate model with a query set over OpenAI-compatible HTTP, score each
+answer, and persist (query, category, model, quality, latency) JSONL
+records in exactly the schema ``training/selection_train.py`` loads
+(its ``load_routing_jsonl``). The reference leaves the dataset
+deployment-specific (its README ships none); likewise the built-in
+corpus here is synthetic and the scorer is pluggable.
+
+Quality scoring: when a query carries ``expected`` (reference answers),
+the default scorer is keyword recall against it; with none, the fallback
+scores structural answer quality (non-empty, on-topic token overlap).
+Both are deterministic — benchmark runs must be reproducible.
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import os
+import re
+import sys
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..looper.looper import HTTPLLMClient
+
+_WORD = re.compile(r"[a-z0-9]{2,}")
+
+
+@dataclass
+class BenchmarkQuery:
+    query: str
+    category: str = "other"
+    expected: str = ""          # reference answer text ('' = none)
+
+
+@dataclass
+class BenchmarkResult:
+    query: str
+    category: str
+    model: str
+    quality: float
+    latency_ms: float
+    answer: str = ""
+    error: str = ""
+
+
+def keyword_scorer(answer: str, query: BenchmarkQuery) -> float:
+    """Deterministic recall-style score in [0, 1]."""
+    a_words = set(_WORD.findall(answer.lower()))
+    if not a_words:
+        return 0.0
+    target = query.expected or query.query
+    t_words = set(_WORD.findall(target.lower()))
+    if not t_words:
+        return 0.5
+    recall = len(a_words & t_words) / len(t_words)
+    if query.expected:
+        return round(recall, 4)
+    # no reference answer: on-topic overlap, floored for a non-empty
+    # answer so "answered at all" separates from an error/empty reply
+    return round(0.2 + 0.8 * min(recall, 1.0), 4)
+
+
+class BenchmarkRunner:
+    """Drives queries × candidates; records results as RoutingRecord
+    JSONL (the trainer's input schema)."""
+
+    def __init__(self, resolve: Callable[[str], str],
+                 scorer: Callable[[str, BenchmarkQuery], float]
+                 = keyword_scorer,
+                 timeout_s: float = 60.0, concurrency: int = 4) -> None:
+        self.client = HTTPLLMClient(resolve, timeout_s=timeout_s)
+        self.scorer = scorer
+        self.concurrency = max(1, concurrency)
+
+    def run_one(self, q: BenchmarkQuery, model: str) -> BenchmarkResult:
+        body = {"messages": [{"role": "user", "content": q.query}]}
+        t0 = time.perf_counter()
+        try:
+            resp = self.client.complete(body, model)
+            latency = (time.perf_counter() - t0) * 1e3
+            answer = ""
+            choices = resp.get("choices") or []
+            if choices:
+                answer = str((choices[0].get("message") or {})
+                             .get("content", ""))
+            return BenchmarkResult(
+                query=q.query, category=q.category, model=model,
+                quality=self.scorer(answer, q),
+                latency_ms=round(latency, 3), answer=answer[:500])
+        except Exception as exc:
+            # failures are DATA (quality 0), not aborts: a flaky model
+            # must look bad to the trainer, not crash the benchmark
+            return BenchmarkResult(
+                query=q.query, category=q.category, model=model,
+                quality=0.0,
+                latency_ms=round((time.perf_counter() - t0) * 1e3, 3),
+                error=f"{type(exc).__name__}: {exc}"[:200])
+
+    def run(self, queries: Sequence[BenchmarkQuery],
+            models: Sequence[str],
+            progress: Optional[Callable[[int, int], None]] = None
+            ) -> List[BenchmarkResult]:
+        jobs = [(q, m) for q in queries for m in models]
+        results: List[Optional[BenchmarkResult]] = [None] * len(jobs)
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=self.concurrency) as pool:
+            futs = {pool.submit(self.run_one, q, m): i
+                    for i, (q, m) in enumerate(jobs)}
+            done = 0
+            for fut in concurrent.futures.as_completed(futs):
+                results[futs[fut]] = fut.result()
+                done += 1
+                if progress:
+                    progress(done, len(jobs))
+        return [r for r in results if r is not None]
+
+    @staticmethod
+    def write_jsonl(results: Sequence[BenchmarkResult],
+                    path: str) -> int:
+        """RoutingRecord schema (training/selection_train.py
+        load_routing_jsonl): query/category/model/quality/latency_ms."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        n = 0
+        with open(path, "w") as f:
+            for r in results:
+                f.write(json.dumps({
+                    "query": r.query, "category": r.category,
+                    "model": r.model, "quality": r.quality,
+                    "latency_ms": r.latency_ms,
+                }) + "\n")
+                n += 1
+        return n
+
+
+def load_queries(path: str) -> List[BenchmarkQuery]:
+    """JSONL: {"query": ..., "category": ..., "expected": ...}."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            d = json.loads(line)
+            out.append(BenchmarkQuery(
+                query=d["query"], category=d.get("category", "other"),
+                expected=d.get("expected", "")))
+    return out
+
+
+def synthetic_queries(n: int = 40) -> List[BenchmarkQuery]:
+    cats = {
+        "computer science": "explain how a {} hash table resolves "
+                            "collisions",
+        "math": "compute the derivative of x**{} + 3x",
+        "health": "what are early symptoms of {} deficiency",
+        "business": "draft a {}-quarter revenue summary outline",
+    }
+    out = []
+    keys = list(cats)
+    for i in range(n):
+        cat = keys[i % len(keys)]
+        out.append(BenchmarkQuery(query=cats[cat].format(i),
+                                  category=cat))
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="semantic_router_tpu.modelselection.benchmark")
+    ap.add_argument("--endpoint", required=True,
+                    help="OpenAI-compatible base URL all candidates "
+                         "share, or model=url pairs (repeatable via "
+                         "commas)")
+    ap.add_argument("--models", required=True,
+                    help="comma-separated candidate model names")
+    ap.add_argument("--queries", default="",
+                    help="JSONL query file (default: synthetic corpus)")
+    ap.add_argument("--n", type=int, default=40)
+    ap.add_argument("--out", required=True,
+                    help="output RoutingRecord JSONL")
+    ap.add_argument("--concurrency", type=int, default=4)
+    args = ap.parse_args(argv)
+
+    if "=" in args.endpoint:
+        table = dict(pair.split("=", 1)
+                     for pair in args.endpoint.split(","))
+        resolve = lambda m: table.get(m, "")
+    else:
+        resolve = lambda m: args.endpoint
+    models = [m for m in args.models.split(",") if m]
+    queries = load_queries(args.queries) if args.queries else \
+        synthetic_queries(args.n)
+    runner = BenchmarkRunner(resolve, concurrency=args.concurrency)
+    results = runner.run(
+        queries, models,
+        progress=lambda d, t: sys.stderr.write(f"\r{d}/{t}"))
+    sys.stderr.write("\n")
+    n = runner.write_jsonl(results, args.out)
+    errs = sum(1 for r in results if r.error)
+    print(json.dumps({"records": n, "errors": errs, "out": args.out,
+                      "models": models}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,244 @@
+"""Grafana dashboard generation from the metric catalog.
+
+Reference role: src/vllm-sr/cli/templates/grafana_*.py — the CLI renders
+provisioning-ready Grafana dashboard JSON so operators monitor the
+router without hand-building panels. Here the dashboards are generated
+from the live metric registry (observability/metrics.py ``families()``)
+plus a curated panel catalog for the canonical series, so a metric added
+to the registry automatically appears on the "catalog" dashboard.
+
+Output: one JSON file per dashboard + a provisioning provider file,
+layout compatible with Grafana's dashboard provisioning directory
+(`grafana/provisioning/dashboards/`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from .metrics import default_registry
+
+_DATASOURCE = {"type": "prometheus", "uid": "${DS_PROMETHEUS}"}
+
+
+def _panel(title: str, exprs: List[str], *, unit: str = "short",
+           panel_id: int = 1, x: int = 0, y: int = 0, w: int = 12,
+           h: int = 8, legends: Optional[List[str]] = None) -> Dict:
+    targets = []
+    for i, expr in enumerate(exprs):
+        t = {"expr": expr, "refId": chr(ord("A") + i),
+             "datasource": _DATASOURCE}
+        if legends and i < len(legends):
+            t["legendFormat"] = legends[i]
+        targets.append(t)
+    return {
+        "id": panel_id,
+        "title": title,
+        "type": "timeseries",
+        "datasource": _DATASOURCE,
+        "gridPos": {"x": x, "y": y, "w": w, "h": h},
+        "fieldConfig": {"defaults": {"unit": unit}, "overrides": []},
+        "targets": targets,
+    }
+
+
+def _stat(title: str, expr: str, *, unit: str = "short", panel_id: int = 1,
+          x: int = 0, y: int = 0, w: int = 6, h: int = 4) -> Dict:
+    return {
+        "id": panel_id, "title": title, "type": "stat",
+        "datasource": _DATASOURCE,
+        "gridPos": {"x": x, "y": y, "w": w, "h": h},
+        "fieldConfig": {"defaults": {"unit": unit}, "overrides": []},
+        "targets": [{"expr": expr, "refId": "A",
+                     "datasource": _DATASOURCE}],
+    }
+
+
+def _dashboard(uid: str, title: str, panels: List[Dict],
+               tags: Optional[List[str]] = None) -> Dict:
+    return {
+        "uid": uid,
+        "title": title,
+        "tags": ["semantic-router-tpu"] + (tags or []),
+        "timezone": "browser",
+        "schemaVersion": 39,
+        "version": 1,
+        "refresh": "10s",
+        "time": {"from": "now-1h", "to": "now"},
+        "templating": {"list": [{
+            "name": "DS_PROMETHEUS", "type": "datasource",
+            "query": "prometheus", "label": "Prometheus",
+        }]},
+        "panels": panels,
+    }
+
+
+def _hist_quantiles(name: str, by: str = "") -> List[str]:
+    grp = f", {by}" if by else ""
+    return [f"histogram_quantile({q}, sum(rate({name}_bucket[5m])) "
+            f"by (le{grp}))" for q in (0.5, 0.95, 0.99)]
+
+
+def router_overview() -> Dict:
+    p = [
+        _stat("Requests / s",
+              "sum(rate(llm_model_requests_total[5m]))", panel_id=1,
+              x=0, y=0),
+        _stat("Cost / h (USD)",
+              "sum(rate(llm_model_cost_total[5m])) * 3600",
+              unit="currencyUSD", panel_id=2, x=6, y=0),
+        _stat("Cache hit ratio",
+              'sum(rate(llm_cache_lookups_total{outcome="hit"}[5m])) / '
+              "sum(rate(llm_cache_lookups_total[5m]))",
+              unit="percentunit", panel_id=3, x=12, y=0),
+        _stat("Blocked / s",
+              "sum(rate(llm_jailbreak_blocked_total[5m])) + "
+              "sum(rate(llm_pii_violations_total[5m]))", panel_id=4,
+              x=18, y=0),
+        _panel("Requests by model",
+               ["sum(rate(llm_model_requests_total[5m])) by (model)"],
+               panel_id=5, x=0, y=4, legends=["{{model}}"]),
+        _panel("Added routing latency",
+               _hist_quantiles("llm_model_routing_latency_seconds"),
+               unit="s", panel_id=6, x=12, y=4,
+               legends=["p50", "p95", "p99"]),
+        _panel("Completion latency by model",
+               ["histogram_quantile(0.95, sum(rate("
+                "llm_model_completion_latency_seconds_bucket[5m])) "
+                "by (le, model))"],
+               unit="s", panel_id=7, x=0, y=12,
+               legends=["p95 {{model}}"]),
+        _panel("Cost by model",
+               ["sum(rate(llm_model_cost_total[5m])) by (model)"],
+               unit="currencyUSD", panel_id=8, x=12, y=12,
+               legends=["{{model}}"]),
+    ]
+    return _dashboard("srt-overview", "Semantic Router — Overview", p)
+
+
+def signals_decisions() -> Dict:
+    p = [
+        _panel("Signal latency by family (p95)",
+               ["histogram_quantile(0.95, sum(rate("
+                "llm_signal_latency_seconds_bucket[5m])) "
+                "by (le, family))"],
+               unit="s", panel_id=1, x=0, y=0,
+               legends=["{{family}}"]),
+        _panel("Decision matches",
+               ["sum(rate(llm_decision_matches_total[5m])) by (decision)"],
+               panel_id=2, x=12, y=0, legends=["{{decision}}"]),
+        _panel("Decision engine latency",
+               _hist_quantiles("llm_decision_evaluation_seconds"),
+               unit="s", panel_id=3, x=0, y=8,
+               legends=["p50", "p95", "p99"]),
+        _panel("Device batch sizes",
+               _hist_quantiles("llm_classifier_batch_size"),
+               panel_id=4, x=12, y=8, legends=["p50", "p95", "p99"]),
+    ]
+    return _dashboard("srt-signals", "Semantic Router — Signals & "
+                      "Decisions", p, tags=["signals"])
+
+
+def safety() -> Dict:
+    p = [
+        _panel("PII violations",
+               ["sum(rate(llm_pii_violations_total[5m])) by (policy)"],
+               panel_id=1, x=0, y=0, legends=["{{policy}}"]),
+        _panel("Jailbreak blocks",
+               ["sum(rate(llm_jailbreak_blocked_total[5m]))"],
+               panel_id=2, x=12, y=0),
+        _panel("Hallucination detection latency",
+               _hist_quantiles(
+                   "llm_hallucination_detection_latency_seconds"),
+               unit="s", panel_id=3, x=0, y=8,
+               legends=["p50", "p95", "p99"]),
+    ]
+    return _dashboard("srt-safety", "Semantic Router — Safety", p,
+                      tags=["safety"])
+
+
+def serving() -> Dict:
+    p = [
+        _panel("TTFT", _hist_quantiles("llm_model_ttft_seconds",
+                                       by="model"),
+               unit="s", panel_id=1, x=0, y=0),
+        _panel("TPOT", _hist_quantiles("llm_model_tpot_seconds",
+                                       by="model"),
+               unit="s", panel_id=2, x=12, y=0),
+        _panel("Cache lookups by outcome",
+               ["sum(rate(llm_cache_lookups_total[5m])) by (outcome)"],
+               panel_id=3, x=0, y=8, legends=["{{outcome}}"]),
+    ]
+    return _dashboard("srt-serving", "Semantic Router — Serving", p,
+                      tags=["serving"])
+
+
+def catalog(registry=None) -> Dict:
+    """Auto-generated dashboard: one panel per registered series —
+    anything new in the registry shows up here without template edits."""
+    registry = registry or default_registry
+    panels = []
+    pid = 0
+    x = y = 0
+    for name, kind, help_ in registry.families():
+        pid += 1
+        if kind == "histogram":
+            exprs = _hist_quantiles(name)
+            legends = ["p50", "p95", "p99"]
+        elif kind == "gauge":
+            exprs = [f"sum({name})"]
+            legends = [name]
+        else:
+            exprs = [f"sum(rate({name}[5m]))"]
+            legends = [name]
+        panels.append(_panel(help_ or name, exprs, panel_id=pid, x=x,
+                             y=y, legends=legends))
+        x = 12 - x
+        if x == 0:
+            y += 8
+    return _dashboard("srt-catalog", "Semantic Router — Metric Catalog",
+                      panels, tags=["catalog"])
+
+
+_PROVIDER = {
+    "apiVersion": 1,
+    "providers": [{
+        "name": "semantic-router-tpu",
+        "orgId": 1,
+        "folder": "Semantic Router",
+        "type": "file",
+        "disableDeletion": False,
+        "updateIntervalSeconds": 30,
+        "options": {"path": "/var/lib/grafana/dashboards/semantic-router"},
+    }],
+}
+
+
+def render_all(out_dir: str, registry=None) -> List[str]:
+    """Write every dashboard + the provisioning provider; returns the
+    written paths (CLI surface)."""
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+    dashboards = {
+        "router_overview.json": router_overview(),
+        "signals_decisions.json": signals_decisions(),
+        "safety.json": safety(),
+        "serving.json": serving(),
+        "metric_catalog.json": catalog(registry),
+    }
+    for fname, dash in dashboards.items():
+        path = os.path.join(out_dir, fname)
+        with open(path, "w") as f:
+            json.dump(dash, f, indent=2, sort_keys=True)
+        written.append(path)
+    prov = os.path.join(out_dir, "provider.yaml")
+    # YAML provider file: render via json-compatible YAML (flow-style
+    # free) without importing yaml at module import time
+    import yaml
+
+    with open(prov, "w") as f:
+        yaml.safe_dump(_PROVIDER, f, sort_keys=False)
+    written.append(prov)
+    return written

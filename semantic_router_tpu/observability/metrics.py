@@ -203,6 +203,16 @@ class MetricsRegistry:
             lines.extend(m.expose())  # type: ignore[attr-defined]
         return "\n".join(lines) + "\n"
 
+    def families(self) -> List[Tuple[str, str, str]]:
+        """(name, kind, help) for every registered series — the catalog
+        the Grafana dashboard generator renders from."""
+        kinds = {Counter: "counter", Gauge: "gauge",
+                 Histogram: "histogram"}
+        with self._lock:
+            return [(name, kinds.get(type(m), "counter"),
+                     getattr(m, "help", ""))
+                    for name, m in sorted(self._metrics.items())]
+
 
 # process-global default registry (reference: the prometheus default
 # registry behind :9190)

@@ -25,6 +25,7 @@ forward over HTTP with credential/trace headers injected
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 import urllib.request
@@ -104,6 +105,13 @@ API_CATALOG = {
         {"path": "/v1/vector_stores/{id}/files", "method": "POST"},
         {"path": "/v1/vector_stores/{id}/files/{file_id}",
          "method": "DELETE"},
+        {"path": "/dashboard/embedmap", "method": "GET"},
+        {"path": "/dashboard/api/embedmap", "method": "GET"},
+        {"path": "/dashboard/api/login", "method": "POST"},
+        {"path": "/dashboard/api/jobs", "method": "GET"},
+        {"path": "/dashboard/api/jobs", "method": "POST"},
+        {"path": "/dashboard/api/jobs/{id}", "method": "GET"},
+        {"path": "/dashboard/api/playground", "method": "POST"},
     ],
 }
 
@@ -208,6 +216,17 @@ class RouterServer:
         self.response_store = build_response_store(
             getattr(cfg, "response_store", {}))
 
+        # dashboard session tokens + durable job runner (reference
+        # dashboard/backend: JWT auth, eval runner, ML pipeline jobs)
+        from ..dashboard.auth import TokenIssuer
+        from ..dashboard.jobs import JobRunner, JobStore
+
+        dash_cfg = (cfg.raw or {}).get("dashboard", {}) or {}
+        self.token_issuer = TokenIssuer(
+            ttl_s=float(dash_cfg.get("session_ttl_s", 8 * 3600)))
+        self.jobs = JobRunner(JobStore(dash_cfg.get("jobs_path", "")))
+        self._register_job_kinds()
+
         from .httpclient import UpstreamPool
         from .httpserver import PooledHTTPServer
 
@@ -218,6 +237,99 @@ class RouterServer:
                                       max_workers=workers)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    def _register_job_kinds(self) -> None:
+        """Dashboard job registry: the evaluation runner and the ML
+        selection pipeline (reference dashboard/backend job kinds)."""
+
+        def selection_benchmark(params: Dict[str, Any]) -> Dict[str, Any]:
+            import tempfile
+
+            from ..modelselection import (
+                BenchmarkRunner,
+                candidates_from_config,
+            )
+            from ..modelselection.benchmark import synthetic_queries
+            from ..training.selection_train import (
+                featurize,
+                load_routing_jsonl,
+                train_selector,
+            )
+
+            models = params.get("models") or [
+                c.name for c in candidates_from_config(self.cfg)]
+            endpoint = params.get("endpoint", "")
+            resolve = (lambda m: endpoint) if endpoint \
+                else self.resolver.resolve
+            runner = BenchmarkRunner(
+                resolve, concurrency=int(params.get("concurrency", 2)),
+                timeout_s=float(params.get("timeout_s", 30.0)))
+            queries = synthetic_queries(int(params.get("n", 16)))
+            results = runner.run(queries, models)
+            out_dir = params.get("out_dir") or tempfile.mkdtemp(
+                prefix="srt-selection-")
+            data_path = os.path.join(out_dir, "routing.jsonl")
+            runner.write_jsonl(results, data_path)
+            records = load_routing_jsonl(data_path)
+            feats, labels, counts = featurize(records)
+            artifacts = {}
+            for algo in params.get("algorithms", ["knn"]):
+                blob = train_selector(algo, feats, labels,
+                                      records=records)
+                path = os.path.join(out_dir, f"{algo}.json")
+                with open(path, "w") as f:
+                    f.write(blob)
+                artifacts[algo] = path
+            return {"records": len(records),
+                    "errors": sum(1 for r in results if r.error),
+                    "label_counts": counts, "data": data_path,
+                    "artifacts": artifacts}
+
+        def accuracy_eval(params: Dict[str, Any]) -> Dict[str, Any]:
+            cases = params.get("cases") or []
+            if not cases:
+                raise ValueError("cases required: "
+                                 "[{query, expected_decision?}]")
+            decisions: Dict[str, int] = {}
+            models: Dict[str, int] = {}
+            correct = scored = 0
+            for case in cases:
+                res = self.router.route({"model": "auto", "messages": [
+                    {"role": "user", "content": str(case["query"])}]})
+                dec = res.decision.decision.name if res.decision else ""
+                decisions[dec or "default"] = \
+                    decisions.get(dec or "default", 0) + 1
+                model = res.model or ""
+                if model:
+                    models[model] = models.get(model, 0) + 1
+                expected = case.get("expected_decision")
+                if expected is not None:
+                    scored += 1
+                    correct += int(dec == expected)
+            out = {"cases": len(cases), "decisions": decisions,
+                   "models": models}
+            if scored:
+                out["decision_accuracy"] = round(correct / scored, 4)
+            return out
+
+        self.jobs.register("selection_benchmark", selection_benchmark)
+        self.jobs.register("accuracy_eval", accuracy_eval)
+
+    def roles_for_key(self, presented: str) -> Optional[set]:
+        """Constant-time scan of the configured API keys (the ONE place
+        this comparison lives — _roles and the dashboard login both use
+        it). Bytes + surrogateescape: compare_digest raises TypeError on
+        non-ASCII str, and header values arrive latin-1-decoded."""
+        import hmac as _hmac
+
+        presented_b = presented.encode("utf-8", "surrogateescape")
+        found = None
+        for configured, roles in self.api_keys.items():
+            if _hmac.compare_digest(
+                    configured.encode("utf-8", "surrogateescape"),
+                    presented_b):
+                found = roles
+        return found
 
     def _imagegen_backend(self, decision_name: str, conf: Dict[str, Any]):
         from .imagegen import build_backend
@@ -249,6 +361,7 @@ class RouterServer:
         self.httpd.server_close()
         self.upstream_pool.close()
         self.looper_pool.shutdown(wait=False, cancel_futures=True)
+        self.jobs.shutdown()
         exporter = getattr(self, "otlp_exporter", None)
         if exporter is not None:  # a leaked sink would double-export
             from ..observability.tracing import default_tracer
@@ -437,20 +550,15 @@ class RouterServer:
                 auth = h.get("authorization", "")
                 if not key and auth.lower().startswith("bearer "):
                     key = auth[7:].strip()
-                # constant-time scan over every configured key so the
-                # lookup can't leak which prefixes exist via timing.
-                # Compare as bytes: compare_digest raises TypeError on
-                # non-ASCII str, and header values arrive latin-1-decoded
-                import hmac as _hmac
-
-                key_b = key.encode("utf-8", "surrogateescape")
-                found = None
-                for configured, roles in server.api_keys.items():
-                    if _hmac.compare_digest(
-                            configured.encode("utf-8", "surrogateescape"),
-                            key_b):
-                        found = roles
-                return found
+                # dashboard session tokens verify by signature; a failed
+                # verify FALLS THROUGH to the key table — a configured
+                # API key that happens to contain two dots must keep
+                # working
+                if key.count(".") == 2:
+                    roles = server.token_issuer.verify(key)
+                    if roles is not None:
+                        return roles
+                return server.roles_for_key(key)
 
             def _authorize(self, write: bool = False,
                            action: str = "") -> Optional[set]:
@@ -519,6 +627,13 @@ class RouterServer:
                             self._text(200, f.read(), "text/html")
                     except (OSError, ValueError):
                         self._json(404, {"error": "dashboard not bundled"})
+                elif path == "/dashboard/embedmap":
+                    # static canvas page (wizmap role); data comes from
+                    # /dashboard/api/embedmap behind the RBAC gate
+                    from ..dashboard.embedmap import render_page
+
+                    self._text(200, render_page(self._embedmap_sources()),
+                               "text/html")
                 elif path == "/startup-status":
                     if server.startup is not None:
                         self._json(200, server.startup.snapshot())
@@ -658,6 +773,26 @@ class RouterServer:
                         if self._authorize() is None:
                             return
                         self._nli(body)
+                    elif path == "/dashboard/api/login":
+                        self._dashboard_login(body)
+                    elif path == "/dashboard/api/jobs":
+                        if self._authorize(write=True,
+                                           action="dashboard_job") is None:
+                            return
+                        try:
+                            job = server.jobs.submit(
+                                str(body.get("kind", "")),
+                                body.get("params") or {})
+                        except KeyError as exc:
+                            self._json(400, {"error": str(exc),
+                                             "kinds":
+                                             server.jobs.kinds()})
+                            return
+                        self._json(202, job.public())
+                    elif path == "/dashboard/api/playground":
+                        if self._authorize() is None:
+                            return
+                        self._playground(body)
                     elif path.startswith("/debug/profiler/"):
                         # profiling perturbs the serving process: edit-
                         # gated + audited like config mutations
@@ -725,6 +860,10 @@ class RouterServer:
             def _dashboard(self, path: str) -> None:
                 from ..observability import metrics as M
 
+                # view-gated like every management read: embedmap/replay
+                # expose request texts (open only in keyless dev mode)
+                if self._authorize() is None:
+                    return
                 sub = path[len("/dashboard/api/"):]
                 if sub == "overview":
                     cache_stats = {}
@@ -779,6 +918,19 @@ class RouterServer:
                          "latency_ms": r.routing_latency_ms,
                          "matched_rules": r.matched_rules}
                         for r in store.list(limit=limit)]})
+                elif sub == "embedmap":
+                    self._embedmap()
+                elif sub == "jobs":
+                    self._json(200, {
+                        "kinds": server.jobs.kinds(),
+                        "jobs": [j.public() for j in
+                                 server.jobs.store.list()]})
+                elif sub.startswith("jobs/"):
+                    job = server.jobs.store.get(sub.split("/", 1)[1])
+                    if job is None:
+                        self._json(404, {"error": "no such job"})
+                    else:
+                        self._json(200, job.public())
                 elif sub == "config":
                     from ..config.schema import redact_config
                     from ..config.versions import config_hash
@@ -794,6 +946,100 @@ class RouterServer:
                     })
                 else:
                     self._json(404, {"error": "not found"})
+
+            def _dashboard_login(self, body: Dict[str, Any]) -> None:
+                """API key → short-lived session token (dashboard JWT
+                role). The browser keeps the token; the long-lived key
+                is typed once."""
+                if not server.api_keys:
+                    self._json(200, {"token": "", "open": True,
+                                     "roles": []})
+                    return
+                found = server.roles_for_key(str(body.get("api_key", "")))
+                if found is None:
+                    self._json(401, {"error": "invalid API key"})
+                    return
+                self._json(200, {
+                    "token": server.token_issuer.issue(found),
+                    "roles": sorted(found),
+                    "expires_in_s": server.token_issuer.ttl_s})
+
+            def _playground(self, body: Dict[str, Any]) -> None:
+                """Routing trace without forwarding: what the router
+                WOULD do with this request (dashboard playground role)."""
+                req = dict(body)
+                req.setdefault("model", "auto")
+                res = server.router.route(req)
+                signals = {}
+                if res.signals is not None:
+                    signals = {
+                        family: {
+                            "matches": list(names)[:8],
+                            "confidences": {
+                                n: round(res.signals.confidences.get(
+                                    f"{family}:{n}", 1.0), 4)
+                                for n in list(names)[:8]},
+                        }
+                        for family, names in res.signals.matches.items()}
+                self._json(200, {
+                    "kind": res.kind,
+                    "model": res.model,
+                    "decision": (res.decision.decision.name
+                                 if res.decision else ""),
+                    "matched_rules": (list(res.decision.matched_rules)
+                                      if res.decision else []),
+                    "selection_reason": res.selection_reason,
+                    "looper_algorithm": res.looper_algorithm,
+                    "signals": signals,
+                    "headers": res.headers,
+                    "routing_latency_ms":
+                        round(res.routing_latency_s * 1e3, 3),
+                })
+
+            def _embedmap_sources(self) -> list:
+                sources = ["cache", "memory"]
+                mgr = server.router.vectorstores
+                if mgr is not None:
+                    sources += [f"vectorstore:{n}" for n in mgr.list()]
+                return sources
+
+            def _embedmap(self) -> None:
+                """wizmap role: 2-D map of an embedding population."""
+                from ..dashboard.embedmap import build_map
+
+                source = self._query().get("source", "cache")
+                items = []
+                if source == "cache":
+                    cache = server.router.cache
+                    entries = getattr(cache, "_entries", {}) if cache \
+                        else {}
+                    items = [(e.query, e.embedding)
+                             for e in list(entries.values())]
+                elif source == "memory":
+                    store = server.router.memory_store
+                    if store is not None:
+                        try:
+                            # cross-user population; stores without
+                            # list_all (external ANN) degrade to empty
+                            listing = store.list_all() if hasattr(
+                                store, "list_all") else []
+                            items = [(m.text, m.embedding)
+                                     for m in listing]
+                        except Exception:
+                            items = []
+                elif source.startswith("vectorstore:"):
+                    mgr = server.router.vectorstores
+                    store = mgr.get(source.split(":", 1)[1]) \
+                        if mgr is not None else None
+                    chunks = getattr(store, "chunks", {}) if store \
+                        else {}
+                    items = [(c.text, c.embedding)
+                             for c in list(chunks.values())]
+                else:
+                    self._json(400, {"error": f"unknown source "
+                                              f"{source!r}"})
+                    return
+                self._json(200, build_map(items))
 
             # -- management handlers ----------------------------------
 

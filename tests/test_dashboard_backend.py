@@ -1,0 +1,215 @@
+"""Dashboard backend breadth: session tokens, durable job runner,
+playground trace (reference dashboard/backend role)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from semantic_router_tpu.dashboard.auth import TokenIssuer
+from semantic_router_tpu.dashboard.jobs import JobRunner, JobStore
+
+
+class TestTokenIssuer:
+    def test_roundtrip(self):
+        iss = TokenIssuer()
+        tok = iss.issue({"view", "edit"})
+        assert iss.verify(tok) == {"view", "edit"}
+
+    def test_tamper_rejected(self):
+        iss = TokenIssuer()
+        tok = iss.issue({"view"})
+        h, p, s = tok.split(".")
+        import base64
+
+        payload = json.loads(base64.urlsafe_b64decode(
+            p + "=" * (-len(p) % 4)))
+        payload["roles"] = ["admin"]
+        forged = base64.urlsafe_b64encode(
+            json.dumps(payload).encode()).rstrip(b"=").decode()
+        assert iss.verify(f"{h}.{forged}.{s}") is None
+
+    def test_expiry(self):
+        iss = TokenIssuer(ttl_s=0.05)
+        tok = iss.issue({"view"})
+        time.sleep(0.1)
+        assert iss.verify(tok) is None
+
+    def test_cross_process_secret(self):
+        a, b = TokenIssuer(), TokenIssuer()
+        assert b.verify(a.issue({"view"})) is None
+
+
+class TestJobRunner:
+    def test_lifecycle_and_failure(self):
+        runner = JobRunner()
+        runner.register("ok", lambda p: {"doubled": p["x"] * 2})
+        runner.register("boom", lambda p: 1 / 0)
+        j1 = runner.submit("ok", {"x": 21})
+        j2 = runner.submit("boom")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            a, b = runner.store.get(j1.job_id), runner.store.get(j2.job_id)
+            if a.status in ("done", "failed") and \
+                    b.status in ("done", "failed"):
+                break
+            time.sleep(0.02)
+        assert runner.store.get(j1.job_id).status == "done"
+        assert runner.store.get(j1.job_id).result == {"doubled": 42}
+        failed = runner.store.get(j2.job_id)
+        assert failed.status == "failed"
+        assert "ZeroDivisionError" in failed.error
+        with pytest.raises(KeyError):
+            runner.submit("nope")
+        runner.shutdown()
+
+    def test_interrupted_marking_on_restart(self, tmp_path):
+        """A 'running' row from a dead process reads as interrupted
+        after reopen (reference workflowstore boot behavior)."""
+        db = str(tmp_path / "jobs.db")
+        store = JobStore(db)
+        from semantic_router_tpu.dashboard.jobs import RUNNING, Job
+
+        store.put(Job(job_id="j1", kind="x", status=RUNNING,
+                      created_t=time.time()))
+        store.close()
+        store2 = JobStore(db)
+        assert store2.get("j1").status == "interrupted"
+        store2.close()
+
+
+@pytest.fixture(scope="module")
+def live():
+    import yaml
+
+    from semantic_router_tpu.config import loads_config
+    from semantic_router_tpu.router import MockVLLMServer, RouterServer
+    from semantic_router_tpu.runtime.bootstrap import build_router
+
+    base = yaml.safe_load(open("tests/fixtures/router_config.yaml"))
+    base.setdefault("api_server", {})["api_keys"] = [
+        {"key": "admin-key", "roles": ["admin"]},
+        {"key": "viewer-key", "roles": ["view"]},
+    ]
+    cfg = loads_config(yaml.safe_dump(base))
+    router = build_router(cfg, None)
+    backend = MockVLLMServer().start()
+    server = RouterServer(router, cfg, default_backend=backend.url).start()
+    yield server
+    server.stop()
+    backend.stop()
+    router.shutdown()
+
+
+def _post(url, body, token=""):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"content-type": "application/json"})
+    if token:
+        req.add_header("authorization", f"Bearer {token}")
+    resp = urllib.request.urlopen(req, timeout=30)
+    return resp.status, json.loads(resp.read())
+
+
+def _get(url, token=""):
+    req = urllib.request.Request(url)
+    if token:
+        req.add_header("authorization", f"Bearer {token}")
+    resp = urllib.request.urlopen(req, timeout=30)
+    return resp.status, json.loads(resp.read())
+
+
+class TestDashboardHTTP:
+    def test_login_and_token_auth(self, live):
+        u = live.url
+        status, out = _post(f"{u}/dashboard/api/login",
+                            {"api_key": "viewer-key"})
+        assert status == 200 and out["roles"] == ["view"]
+        token = out["token"]
+        assert token.count(".") == 2
+        # the session token works where the API key would
+        status, data = _get(f"{u}/dashboard/api/overview", token)
+        assert status == 200 and "requests_total" in data
+        # bad key rejected
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(f"{u}/dashboard/api/login", {"api_key": "wrong"})
+        assert ei.value.code == 401
+        # forged token rejected
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{u}/dashboard/api/overview", token[:-2] + "zz")
+        assert ei.value.code == 401
+
+    def test_view_token_cannot_submit_jobs(self, live):
+        u = live.url
+        _, out = _post(f"{u}/dashboard/api/login",
+                       {"api_key": "viewer-key"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(f"{u}/dashboard/api/jobs",
+                  {"kind": "accuracy_eval"}, out["token"])
+        assert ei.value.code == 403
+
+    def test_accuracy_eval_job(self, live):
+        u = live.url
+        _, admin = _post(f"{u}/dashboard/api/login",
+                         {"api_key": "admin-key"})
+        tok = admin["token"]
+        status, job = _post(f"{u}/dashboard/api/jobs", {
+            "kind": "accuracy_eval",
+            "params": {"cases": [
+                {"query": "urgent: prod is down",
+                 "expected_decision": "urgent_route"},
+                {"query": "please debug this python function",
+                 "expected_decision": "code_route"},
+            ]}}, tok)
+        assert status == 202
+        jid = job["job_id"]
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            _, j = _get(f"{u}/dashboard/api/jobs/{jid}", tok)
+            if j["status"] in ("done", "failed"):
+                break
+            time.sleep(0.1)
+        assert j["status"] == "done", j
+        assert j["result"]["cases"] == 2
+        assert j["result"]["decision_accuracy"] == 1.0
+        # listing shows it
+        _, listing = _get(f"{u}/dashboard/api/jobs", tok)
+        assert any(x["job_id"] == jid for x in listing["jobs"])
+        assert "selection_benchmark" in listing["kinds"]
+
+    def test_selection_benchmark_job(self, live, tmp_path):
+        u = live.url
+        _, admin = _post(f"{u}/dashboard/api/login",
+                         {"api_key": "admin-key"})
+        tok = admin["token"]
+        _, job = _post(f"{u}/dashboard/api/jobs", {
+            "kind": "selection_benchmark",
+            "params": {"n": 4, "models": ["m-a", "m-b"],
+                       "algorithms": ["knn"],
+                       "out_dir": str(tmp_path)}}, tok)
+        jid = job["job_id"]
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            _, j = _get(f"{u}/dashboard/api/jobs/{jid}", tok)
+            if j["status"] in ("done", "failed"):
+                break
+            time.sleep(0.1)
+        assert j["status"] == "done", j
+        assert j["result"]["records"] == 8
+        assert "knn" in j["result"]["artifacts"]
+
+    def test_playground_trace(self, live):
+        u = live.url
+        _, out = _post(f"{u}/dashboard/api/login",
+                       {"api_key": "viewer-key"})
+        status, trace = _post(f"{u}/dashboard/api/playground", {
+            "messages": [{"role": "user",
+                          "content": "urgent: the prod cache is down"}]},
+            out["token"])
+        assert status == 200
+        assert trace["decision"] == "urgent_route"
+        assert trace["model"]
+        assert trace["signals"]
+        assert trace["routing_latency_ms"] >= 0

@@ -1,0 +1,137 @@
+"""Grafana dashboard generation + embedding-map (wizmap role)."""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+
+class TestGrafana:
+    def test_render_all_writes_valid_dashboards(self, tmp_path):
+        from semantic_router_tpu.observability.grafana import render_all
+
+        paths = render_all(str(tmp_path))
+        names = {os.path.basename(p) for p in paths}
+        assert {"router_overview.json", "signals_decisions.json",
+                "safety.json", "serving.json", "metric_catalog.json",
+                "provider.yaml"} <= names
+        for p in paths:
+            if p.endswith(".json"):
+                dash = json.load(open(p))
+                assert dash["uid"].startswith("srt-")
+                assert dash["panels"], f"{p} has no panels"
+                for panel in dash["panels"]:
+                    for t in panel["targets"]:
+                        assert t["expr"]
+
+    def test_catalog_tracks_registry(self, tmp_path):
+        """A newly registered metric appears on the catalog dashboard
+        without template edits."""
+        from semantic_router_tpu.observability.grafana import catalog
+        from semantic_router_tpu.observability.metrics import (
+            MetricsRegistry,
+        )
+
+        reg = MetricsRegistry()
+        reg.counter("my_custom_total", "Custom things")
+        reg.histogram("my_latency_seconds", "Custom latency")
+        dash = catalog(reg)
+        exprs = [t["expr"] for p in dash["panels"]
+                 for t in p["targets"]]
+        assert any("my_custom_total" in e for e in exprs)
+        assert any("histogram_quantile" in e and "my_latency_seconds" in e
+                   for e in exprs)
+
+    def test_cli_grafana(self, tmp_path, capsys):
+        from semantic_router_tpu.__main__ import main
+
+        rc = main(["grafana", "--out-dir", str(tmp_path / "g")])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert len(out["rendered"]) == 6
+
+
+class TestEmbedMap:
+    def test_project_2d_shapes(self):
+        from semantic_router_tpu.dashboard.embedmap import project_2d
+
+        assert project_2d(np.zeros((0, 8))).shape == (0, 2)
+        assert project_2d(np.ones((1, 8))).shape == (1, 2)
+        coords = project_2d(np.random.default_rng(0)
+                            .standard_normal((50, 16)))
+        assert coords.shape == (50, 2)
+        assert np.abs(coords).max() <= 1.0 + 1e-5
+
+    def test_build_map_separates_clusters(self):
+        """Two well-separated embedding clusters land in different
+        regions of the map and surface distinct labels."""
+        from semantic_router_tpu.dashboard.embedmap import build_map
+
+        rng = np.random.default_rng(1)
+        items = []
+        for i in range(30):
+            v = np.zeros(32)
+            v[0] = 10.0
+            items.append((f"python debugging traceback {i}",
+                          v + rng.normal(0, 0.1, 32)))
+        for i in range(30):
+            v = np.zeros(32)
+            v[0] = -10.0
+            items.append((f"medical diagnosis symptoms {i}",
+                          v + rng.normal(0, 0.1, 32)))
+        m = build_map(items, grid=8)
+        assert len(m["points"]) == 60
+        xs = np.array([p[0] for p in m["points"]])
+        # the first-axis separation must survive projection
+        assert (xs[:30].mean() > 0.5) != (xs[30:].mean() > 0.5)
+        all_words = {w for words in m["regions"].values()
+                     for w in words}
+        assert "python" in all_words or "debugging" in all_words
+        assert "medical" in all_words or "diagnosis" in all_words
+
+    def test_build_map_drops_missing_vectors(self):
+        from semantic_router_tpu.dashboard.embedmap import build_map
+
+        m = build_map([("a", np.ones(4)), ("b", None),
+                       ("c", np.array([np.nan, 1, 2, 3]))])
+        assert len(m["points"]) == 1
+        assert m["dropped"] == 2
+
+    def test_server_endpoints(self):
+        """/dashboard/embedmap page + /dashboard/api/embedmap JSON over
+        the live server, cache source populated via routing."""
+        from semantic_router_tpu.config import load_config
+        from semantic_router_tpu.router import (
+            MockVLLMServer,
+            RouterServer,
+        )
+        from semantic_router_tpu.runtime.bootstrap import build_router
+
+        cfg = load_config("tests/fixtures/router_config.yaml")
+        router = build_router(cfg, None)
+        backend = MockVLLMServer().start()
+        server = RouterServer(router, cfg,
+                              default_backend=backend.url).start()
+        try:
+            page = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/dashboard/embedmap",
+                timeout=10).read().decode()
+            assert "<canvas" in page and "embedmap" in page
+            data = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/dashboard/api/"
+                "embedmap?source=cache", timeout=10).read())
+            assert "points" in data and "regions" in data
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/dashboard/api/"
+                "embedmap?source=memory", timeout=10)
+            assert resp.status == 200
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/dashboard/api/"
+                    "embedmap?source=nope", timeout=10)
+        finally:
+            server.stop()
+            backend.stop()
+            router.shutdown()

@@ -1,0 +1,108 @@
+"""modelselection package: benchmark runner + config analyzer +
+trainer round-trip (pkg/modelselection role)."""
+
+import json
+
+import pytest
+
+from semantic_router_tpu.modelselection import (
+    BenchmarkRunner,
+    candidates_from_config,
+    keyword_scorer,
+)
+from semantic_router_tpu.modelselection.benchmark import (
+    BenchmarkQuery,
+    synthetic_queries,
+)
+
+
+class TestAnalyzer:
+    def test_candidates_from_fixture_config(self):
+        from semantic_router_tpu.config import load_config
+
+        cfg = load_config("tests/fixtures/router_config.yaml")
+        cands = candidates_from_config(cfg)
+        names = [c.name for c in cands]
+        assert cfg.default_model in names
+        referenced = {ref.model for d in cfg.decisions
+                      for ref in d.model_refs}
+        assert referenced <= set(names)
+        by_name = {c.name: c for c in cands}
+        for d in cfg.decisions:
+            for ref in d.model_refs:
+                assert d.name in by_name[ref.model].decisions
+
+
+class TestScorer:
+    def test_expected_recall(self):
+        q = BenchmarkQuery("what is 2+2", expected="the answer is four")
+        assert keyword_scorer("four, the answer", q) > 0.5
+        assert keyword_scorer("", q) == 0.0
+        assert keyword_scorer("unrelated text entirely", q) < 0.3
+
+    def test_no_expected_floors_nonempty(self):
+        q = BenchmarkQuery("explain hash tables")
+        assert keyword_scorer("a hash tables overview", q) >= 0.2
+
+
+class TestRunner:
+    @pytest.fixture()
+    def backend(self):
+        from semantic_router_tpu.router import MockVLLMServer
+
+        b = MockVLLMServer().start()
+        yield b
+        b.stop()
+
+    def test_benchmark_to_training_roundtrip(self, backend, tmp_path):
+        """Full loop: benchmark 2 candidates -> JSONL -> trainer ->
+        serving selector artifact (the e2e the reference's
+        ml-model-selection profile exercises)."""
+        runner = BenchmarkRunner(lambda m: backend.url, concurrency=2)
+        queries = synthetic_queries(8)
+        results = runner.run(queries, ["model-a", "model-b"])
+        assert len(results) == 16
+        assert all(r.error == "" for r in results)
+        assert all(0.0 <= r.quality <= 1.0 for r in results)
+        out = str(tmp_path / "routing.jsonl")
+        n = runner.write_jsonl(results, out)
+        assert n == 16
+
+        from semantic_router_tpu.training.selection_train import (
+            featurize,
+            load_routing_jsonl,
+            load_selector,
+            train_selector,
+        )
+
+        records = load_routing_jsonl(out)
+        assert len(records) == 16
+        feats, labels, counts = featurize(records)
+        assert feats.shape[0] == 8  # one row per unique query
+        blob = train_selector("knn", feats, labels)
+        art = str(tmp_path / "knn.json")
+        with open(art, "w") as f:
+            f.write(blob)
+        sel = load_selector(art)
+        assert sel is not None
+
+    def test_failures_become_zero_quality_records(self, tmp_path):
+        runner = BenchmarkRunner(lambda m: "http://127.0.0.1:1",
+                                 timeout_s=0.5)
+        results = runner.run([BenchmarkQuery("hi")], ["m"])
+        assert len(results) == 1
+        assert results[0].quality == 0.0
+        assert results[0].error
+
+    def test_cli(self, backend, tmp_path, capsys):
+        from semantic_router_tpu.modelselection.benchmark import main
+
+        out = str(tmp_path / "bench.jsonl")
+        rc = main(["--endpoint", backend.url, "--models", "a,b",
+                   "--n", "4", "--out", out, "--concurrency", "2"])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["records"] == 8
+        lines = [json.loads(l) for l in open(out) if l.strip()]
+        assert {l["model"] for l in lines} == {"a", "b"}
+        assert all("quality" in l and "latency_ms" in l for l in lines)
