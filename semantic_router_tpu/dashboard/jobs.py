@@ -64,10 +64,13 @@ class JobStore:
         self._closed = False
         with self._lock:
             self._conn.execute(self._SCHEMA)
-            # a "running" row at open time belonged to a dead process
+            # running/pending rows at open time belonged to a dead
+            # process (jobs are not re-queued on restart): both read as
+            # interrupted, never eternally in-flight
             self._conn.execute(
-                "UPDATE dashboard_jobs SET status = ? WHERE status = ?",
-                (INTERRUPTED, RUNNING))
+                "UPDATE dashboard_jobs SET status = ? "
+                "WHERE status IN (?, ?)",
+                (INTERRUPTED, RUNNING, PENDING))
             self._conn.commit()
 
     def put(self, job: Job) -> None:
@@ -158,7 +161,18 @@ class JobRunner:
             job.status = FAILED
             job.error = f"{type(exc).__name__}: {exc}"[:500]
         job.finished_t = time.time()
-        self.store.put(job)
+        try:
+            self.store.put(job)
+        except Exception as exc:
+            # a result that won't serialize (np scalars etc.) must not
+            # leave the row 'running' forever — record the failure
+            job.status = FAILED
+            job.result = None
+            job.error = f"result not persistable: {exc}"[:500]
+            try:
+                self.store.put(job)
+            except Exception:
+                pass
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)

@@ -94,9 +94,13 @@ def router_overview() -> Dict:
               "sum(rate(llm_cache_lookups_total[5m]))",
               unit="percentunit", panel_id=3, x=12, y=0),
         _stat("Blocked / s",
-              "sum(rate(llm_jailbreak_blocked_total[5m])) + "
-              "sum(rate(llm_pii_violations_total[5m]))", panel_id=4,
-              x=18, y=0),
+              # `or vector(0)`: counters expose no samples before their
+              # first increment, and a binary op with an empty operand
+              # yields an empty vector ("No data" despite real blocks)
+              "(sum(rate(llm_jailbreak_blocked_total[5m])) or vector(0))"
+              " + "
+              "(sum(rate(llm_pii_violations_total[5m])) or vector(0))",
+              panel_id=4, x=18, y=0),
         _panel("Requests by model",
                ["sum(rate(llm_model_requests_total[5m])) by (model)"],
                panel_id=5, x=0, y=4, legends=["{{model}}"]),

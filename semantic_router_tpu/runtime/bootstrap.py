@@ -364,6 +364,33 @@ def serve(config_path: str, port: int = 8801,
     server.otlp_exporter = build_exporter_from_config(
         cfg.observability, default_tracer)
 
+    # startKubernetesControllerIfNeeded (cmd/main.go:50): live CRD watch
+    # regenerating the config file the ConfigWatcher below hot-swaps
+    server.kube_operator = None
+    k8s_cfg = (cfg.raw or {}).get("kubernetes", {}) or {}
+    if k8s_cfg.get("enabled"):
+        from .kubewatch import KubeClient, KubeOperator
+
+        try:
+            if k8s_cfg.get("api_url"):
+                client = KubeClient(
+                    k8s_cfg["api_url"],
+                    token=str(k8s_cfg.get("token", "")),
+                    namespace=k8s_cfg.get("namespace", "default"),
+                    ca_file=k8s_cfg.get("ca_file", ""))
+            else:
+                client = KubeClient.in_cluster()
+            server.kube_operator = KubeOperator(
+                client, config_path).start()
+            component_event("bootstrap", "kube_operator_started",
+                            namespace=client.namespace)
+        except Exception as exc:
+            # fail-open: a cluster problem must not block serving the
+            # on-disk config (the reference's controller is optional too)
+            component_event("bootstrap", "kube_operator_failed",
+                            level="warning",
+                            error=f"{type(exc).__name__}: {exc}"[:200])
+
     watcher = None
     if watch_config:
         def on_reload(new_cfg: RouterConfig) -> None:
@@ -393,5 +420,7 @@ def serve(config_path: str, port: int = 8801,
         finally:
             if watcher:
                 watcher.stop()
+            if server.kube_operator is not None:
+                server.kube_operator.stop()
             server.stop()
     return server, tracker

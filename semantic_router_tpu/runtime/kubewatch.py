@@ -1,0 +1,437 @@
+"""Live Kubernetes watch controller for the router CRDs.
+
+Reference role: pkg/k8s (the in-router controller watching
+IntelligentPool/IntelligentRoute and regenerating config dynamically —
+the dynamic-config e2e profile) and the operator's controller loop
+(deploy/operator/semanticrouter_controller.go). The image bakes no
+kubernetes client, so this is a dependency-free client for the two API
+verbs a controller needs:
+
+  - LIST  GET /apis/{group}/{version}/namespaces/{ns}/{plural}
+  - WATCH same + ``?watch=1&resourceVersion=N`` — a chunked stream of
+    newline-delimited JSON events {"type": ADDED|MODIFIED|DELETED|
+    BOOKMARK|ERROR, "object": {...}}
+
+The controller follows the standard informer discipline: list to seed
+state + resourceVersion, watch from there, reconcile (debounced) on
+every relevant event, re-list on 410 Gone (history compaction), and
+reconnect with backoff on stream death. In-cluster config reads the
+conventional serviceaccount token/CA mounts.
+
+``MiniKubeAPI`` is the embedded stand-in (same role as MiniRedis/
+MiniPostgres): real list/watch wire shapes over HTTP so the controller
+is e2e-testable without a cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import ssl
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..observability.logging import component_event
+from .operator import reconcile
+
+GROUP, VERSION = "srt.tpu.dev", "v1alpha1"
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class KubeClient:
+    """Minimal typed client: list + watch for one namespace."""
+
+    def __init__(self, base_url: str, token: str = "",
+                 namespace: str = "default",
+                 ca_file: str = "", timeout_s: float = 30.0) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.namespace = namespace
+        self.timeout_s = timeout_s
+        self._ssl_ctx: Optional[ssl.SSLContext] = None
+        if base_url.startswith("https"):
+            self._ssl_ctx = ssl.create_default_context(
+                cafile=ca_file or None)
+
+    @classmethod
+    def in_cluster(cls) -> "KubeClient":
+        """Conventional in-cluster config: serviceaccount mounts +
+        KUBERNETES_SERVICE_HOST/PORT."""
+        import os
+
+        host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        with open(f"{_SA_DIR}/token") as f:
+            token = f.read().strip()
+        try:
+            with open(f"{_SA_DIR}/namespace") as f:
+                namespace = f.read().strip()
+        except OSError:
+            namespace = "default"
+        return cls(f"https://{host}:{port}", token=token,
+                   namespace=namespace, ca_file=f"{_SA_DIR}/ca.crt")
+
+    def _path(self, plural: str) -> str:
+        return (f"{self.base_url}/apis/{GROUP}/{VERSION}/namespaces/"
+                f"{self.namespace}/{plural}")
+
+    def _request(self, url: str, timeout: Optional[float] = None):
+        req = urllib.request.Request(url)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        kwargs: Dict[str, Any] = {"timeout": timeout or self.timeout_s}
+        if self._ssl_ctx is not None:
+            kwargs["context"] = self._ssl_ctx
+        return urllib.request.urlopen(req, **kwargs)
+
+    def list(self, plural: str) -> Tuple[List[Dict], str]:
+        """(items, resourceVersion)."""
+        with self._request(self._path(plural)) as resp:
+            body = json.loads(resp.read())
+        return (body.get("items", []) or [],
+                str((body.get("metadata") or {}).get(
+                    "resourceVersion", "0")))
+
+    def watch(self, plural: str, resource_version: str,
+              on_event: Callable[[str, Dict], None],
+              stop: threading.Event,
+              timeout_s: float = 300.0) -> None:
+        """Stream events to ``on_event(type, object)`` until the server
+        closes the stream or ``stop`` is set. Raises HTTPError(410) when
+        the resourceVersion is too old — caller must re-list."""
+        url = (f"{self._path(plural)}?watch=1"
+               f"&resourceVersion={resource_version}"
+               f"&timeoutSeconds={int(timeout_s)}")
+        with self._request(url, timeout=timeout_s + 10) as resp:
+            buf = b""
+            while not stop.is_set():
+                chunk = resp.read1(65536)
+                if not chunk:
+                    return  # server closed (watch window expired)
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if not line.strip():
+                        continue
+                    event = json.loads(line)
+                    etype = event.get("type", "")
+                    obj = event.get("object", {}) or {}
+                    if etype == "ERROR":
+                        code = int((obj.get("code") or 0))
+                        if code == 410:
+                            raise urllib.error.HTTPError(
+                                url, 410, "Gone", None, None)
+                        component_event("kubewatch", "watch_error",
+                                        level="warning",
+                                        reason=str(obj)[:200])
+                        continue
+                    if etype != "BOOKMARK":
+                        on_event(etype, obj)
+
+
+class KubeOperator:
+    """Informer-style controller: state from list+watch, debounced
+    reconcile into the live config file (which the router's config
+    watcher hot-swaps)."""
+
+    PLURALS = ("intelligentpools", "intelligentroutes")
+
+    def __init__(self, client: KubeClient, config_path: str,
+                 debounce_s: float = 0.2,
+                 backoff_s: float = 1.0) -> None:
+        self.client = client
+        self.config_path = config_path
+        self.debounce_s = debounce_s
+        self.backoff_s = backoff_s
+        self._state: Dict[str, Dict[str, Dict]] = {
+            p: {} for p in self.PLURALS}
+        self._state_lock = threading.Lock()
+        self._dirty = threading.Event()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self.last_status = ""
+        self.reconcile_count = 0
+
+    # -- state ---------------------------------------------------------
+
+    def _key(self, obj: Dict) -> str:
+        meta = obj.get("metadata", {}) or {}
+        return f"{meta.get('namespace', '')}/{meta.get('name', '')}"
+
+    def _apply_event(self, plural: str, etype: str, obj: Dict) -> None:
+        with self._state_lock:
+            if etype == "DELETED":
+                self._state[plural].pop(self._key(obj), None)
+            else:  # ADDED | MODIFIED
+                self._state[plural][self._key(obj)] = obj
+        self._dirty.set()
+
+    def reconcile_once(self) -> str:
+        with self._state_lock:
+            pools = list(self._state["intelligentpools"].values())
+            routes = list(self._state["intelligentroutes"].values())
+        if not pools:
+            self.last_status = "no IntelligentPool found"
+            return self.last_status
+        pool = sorted(pools, key=self._key)[0]
+        changed, status = reconcile(pool, sorted(routes, key=self._key),
+                                    self.config_path)
+        self.last_status = status
+        self.reconcile_count += 1
+        return status
+
+    # -- loops ---------------------------------------------------------
+
+    def _watch_loop(self, plural: str) -> None:
+        backoff = self.backoff_s
+        while not self._stop.is_set():
+            try:
+                items, rv = self.client.list(plural)
+                with self._state_lock:
+                    self._state[plural] = {
+                        self._key(o): o for o in items}
+                self._dirty.set()
+                while not self._stop.is_set():
+                    self.client.watch(
+                        plural, rv,
+                        lambda t, o, p=plural: self._apply_event(p, t, o),
+                        self._stop)
+                    # clean stream end: watch again from the freshest
+                    # object we hold (bookmark-less servers)
+                    with self._state_lock:
+                        rvs = [int((o.get("metadata") or {}).get(
+                            "resourceVersion", "0") or 0)
+                            for o in self._state[plural].values()]
+                    rv = str(max(rvs + [int(rv) if rv.isdigit() else 0]))
+                backoff = self.backoff_s
+            except urllib.error.HTTPError as exc:
+                if exc.code == 410:  # compacted: re-list immediately
+                    continue
+                component_event("kubewatch", "watch_http_error",
+                                level="warning", plural=plural,
+                                code=exc.code)
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2, 30.0)
+            except Exception as exc:
+                if self._stop.is_set():
+                    return
+                component_event("kubewatch", "watch_reconnect",
+                                level="warning", plural=plural,
+                                error=f"{type(exc).__name__}: {exc}"[:200])
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2, 30.0)
+
+    def _reconcile_loop(self) -> None:
+        while not self._stop.is_set():
+            if not self._dirty.wait(timeout=0.5):
+                continue
+            # debounce: absorb the event burst of a kubectl apply
+            time.sleep(self.debounce_s)
+            self._dirty.clear()
+            try:
+                self.reconcile_once()
+            except Exception as exc:
+                component_event("kubewatch", "reconcile_error",
+                                level="warning",
+                                error=f"{type(exc).__name__}: {exc}"[:200])
+
+    def start(self) -> "KubeOperator":
+        for plural in self.PLURALS:
+            t = threading.Thread(target=self._watch_loop, args=(plural,),
+                                 daemon=True, name=f"kubewatch-{plural}")
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(target=self._reconcile_loop, daemon=True,
+                             name="kubewatch-reconcile")
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._dirty.set()
+
+
+# ---------------------------------------------------------------------------
+# MiniKubeAPI — embedded stand-in
+
+
+class MiniKubeAPI:
+    """List/watch wire shapes over HTTP + a test-side apply/delete API.
+    One global resourceVersion counter, per-connection watch streams fed
+    from a broadcast queue (the shape kube-apiserver serves)."""
+
+    def __init__(self, port: int = 0, token: str = "") -> None:
+        self.token = token
+        self._objects: Dict[str, Dict[str, Dict]] = {}
+        self._rv = 0
+        self._lock = threading.Lock()
+        self._watchers: List[Tuple[str, "_Queue"]] = []
+
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if api.token:
+                    auth = self.headers.get("Authorization", "")
+                    if auth != f"Bearer {api.token}":
+                        self.send_response(401)
+                        self.end_headers()
+                        return
+                path, _, query = self.path.partition("?")
+                parts = path.strip("/").split("/")
+                # apis/{group}/{version}/namespaces/{ns}/{plural}
+                if len(parts) != 6 or parts[0] != "apis":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                plural = parts[5]
+                params = dict(kv.split("=", 1)
+                              for kv in query.split("&") if "=" in kv)
+                if params.get("watch") == "1":
+                    self._serve_watch(plural, params)
+                else:
+                    with api._lock:
+                        items = list(api._objects.get(plural,
+                                                      {}).values())
+                        rv = api._rv
+                    body = json.dumps({
+                        "apiVersion": f"{GROUP}/{VERSION}",
+                        "kind": "List",
+                        "metadata": {"resourceVersion": str(rv)},
+                        "items": items}).encode()
+                    self.send_response(200)
+                    self.send_header("content-type", "application/json")
+                    self.send_header("content-length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+            def _serve_watch(self, plural, params):
+                q = _Queue()
+                since = int(params.get("resourceVersion", "0") or 0)
+                with api._lock:
+                    if since and since < api._compacted_before():
+                        # history gone: the real server sends an ERROR
+                        # event with a 410 status object
+                        self.send_response(200)
+                        self.send_header("content-type",
+                                         "application/json")
+                        self.end_headers()
+                        self.wfile.write(json.dumps({
+                            "type": "ERROR",
+                            "object": {"kind": "Status", "code": 410,
+                                       "reason": "Expired"}
+                        }).encode() + b"\n")
+                        return
+                    # replay history after the caller's resourceVersion
+                    # (real watch semantics: list→watch must not lose
+                    # the events in between), then stream live
+                    for obj in api._objects.get(plural, {}).values():
+                        orv = int((obj.get("metadata") or {}).get(
+                            "resourceVersion", "0") or 0)
+                        if orv > since:
+                            q.put({"type": "ADDED", "object": obj})
+                    api._watchers.append((plural, q))
+                self.send_response(200)
+                self.send_header("content-type", "application/json")
+                self.send_header("transfer-encoding", "chunked")
+                self.end_headers()
+                deadline = time.time() + float(
+                    params.get("timeoutSeconds", "300"))
+                try:
+                    while time.time() < deadline:
+                        ev = q.get(timeout=0.25)
+                        if ev is None:
+                            continue
+                        data = json.dumps(ev).encode() + b"\n"
+                        self.wfile.write(
+                            f"{len(data):x}\r\n".encode() + data +
+                            b"\r\n")
+                        self.wfile.flush()
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
+                finally:
+                    with api._lock:
+                        try:
+                            api._watchers.remove((plural, q))
+                        except ValueError:
+                            pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+
+    def _compacted_before(self) -> int:
+        return 0  # compaction simulated via expire_history()
+
+    def expire_history(self) -> None:
+        """Test hook: make every future watch-from-old-rv answer 410."""
+        with self._lock:
+            current = self._rv
+        self._compacted_before = lambda: current + 1  # type: ignore
+
+    # -- test-side mutation API ---------------------------------------
+
+    def apply(self, plural: str, obj: Dict) -> Dict:
+        with self._lock:
+            self._rv += 1
+            meta = obj.setdefault("metadata", {})
+            meta.setdefault("namespace", "default")
+            meta["resourceVersion"] = str(self._rv)
+            key = f"{meta.get('namespace')}/{meta.get('name')}"
+            existed = key in self._objects.setdefault(plural, {})
+            self._objects[plural][key] = obj
+            etype = "MODIFIED" if existed else "ADDED"
+            self._broadcast(plural, {"type": etype, "object": obj})
+        return obj
+
+    def delete(self, plural: str, name: str,
+               namespace: str = "default") -> bool:
+        with self._lock:
+            key = f"{namespace}/{name}"
+            obj = self._objects.get(plural, {}).pop(key, None)
+            if obj is None:
+                return False
+            self._rv += 1
+            obj["metadata"]["resourceVersion"] = str(self._rv)
+            self._broadcast(plural, {"type": "DELETED", "object": obj})
+            return True
+
+    def _broadcast(self, plural: str, event: Dict) -> None:
+        for p, q in self._watchers:
+            if p == plural:
+                q.put(event)
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class _Queue:
+    """Tiny blocking queue (queue.Queue with a None-on-timeout get)."""
+
+    def __init__(self) -> None:
+        import queue
+
+        self._q: "queue.Queue" = queue.Queue()
+
+    def put(self, item) -> None:
+        self._q.put(item)
+
+    def get(self, timeout: float):
+        import queue
+
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
