@@ -186,12 +186,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--concurrency", type=int, default=4)
     args = ap.parse_args(argv)
 
-    # model=url table ONLY when every comma part maps a name to a URL —
-    # a single shared URL with a query string ('?api_key=x') must not be
-    # misparsed into a table that resolves nothing
+    # model=url table ONLY when every comma part maps a bare model NAME
+    # to a URL — the key side must not itself look like a URL, or a
+    # single shared endpoint with a URL-valued query param
+    # ('?proxy=https://upstream') gets misparsed into a table that
+    # resolves nothing
     parts = args.endpoint.split(",")
-    is_table = all("=" in p and "://" in p.split("=", 1)[1]
-                   for p in parts)
+    is_table = all(
+        "=" in p
+        and "://" in p.split("=", 1)[1]
+        and "://" not in p.split("=", 1)[0]
+        and "?" not in p.split("=", 1)[0]
+        for p in parts)
     if is_table:
         table = dict(pair.split("=", 1) for pair in parts)
         resolve = lambda m: table.get(m, "")

@@ -37,7 +37,6 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from ..config.schema import RouterConfig
-from ..observability.metrics import default_registry
 from ..observability.tracing import default_tracer
 from . import headers as H
 from .anthropic import (
@@ -149,9 +148,15 @@ class RouterServer:
     def __init__(self, router: Router, cfg: RouterConfig,
                  default_backend: str = "", port: int = 0,
                  forward_timeout_s: float = 300.0,
-                 config_path: str = "") -> None:
+                 config_path: str = "", registry=None) -> None:
         self.router = router
         self.cfg = cfg
+        # runtime service registry (pkg/routerruntime role): the server
+        # reads its observability sinks through it, so embedding several
+        # routers in one process isolates their state
+        from ..runtime.registry import RuntimeRegistry
+
+        self.registry = registry or RuntimeRegistry.with_defaults()
         self.resolver = BackendResolver(cfg, default_backend)
         self.forward_timeout_s = forward_timeout_s
         self.started_t = time.time()
@@ -184,9 +189,7 @@ class RouterServer:
         self._imagegen_backends: Dict[str, Any] = {}
         self._imagegen_lock = threading.Lock()
 
-        from ..observability.session import default_session_telemetry
-
-        self.sessions = default_session_telemetry
+        self.sessions = self.registry.sessions
 
         # shared looper plumbing (client is stateless; pool shared across
         # requests — a per-request Looper wraps them with request state)
@@ -602,7 +605,7 @@ class RouterServer:
                                 "uptime_s": round(time.time()
                                                   - server.started_t, 1)})
                 elif path == "/metrics":
-                    self._text(200, default_registry.expose(),
+                    self._text(200, server.registry.metrics.expose(),
                                "text/plain; version=0.0.4")
                 elif path == "/v1/models":
                     self._json(200, {"object": "list", "data": [
@@ -652,9 +655,7 @@ class RouterServer:
                 if path == "/api/v1":
                     self._json(200, API_CATALOG)
                 elif path == "/debug/profiler":
-                    from ..observability.profiler import default_profiler
-
-                    self._json(200, default_profiler.status())
+                    self._json(200, server.registry.profiler.status())
                 elif path == "/config/router":
                     # secrets masked unless the key holds secret_view
                     # (management_api.go:67)
@@ -801,15 +802,15 @@ class RouterServer:
                             return
                         from ..observability.profiler import (
                             configure_xla_dump,
-                            default_profiler,
                         )
 
+                        profiler = server.registry.profiler
                         action = path.rsplit("/", 1)[1]
                         if action == "start":
-                            out = default_profiler.start(
+                            out = profiler.start(
                                 str(body.get("dir", "")))
                         elif action == "stop":
-                            out = default_profiler.stop(
+                            out = profiler.stop(
                                 force=bool(body.get("force")))
                         elif action == "xla-dump":
                             out = configure_xla_dump(str(body.get(
@@ -920,6 +921,18 @@ class RouterServer:
                         for r in store.list(limit=limit)]})
                 elif sub == "embedmap":
                     self._embedmap()
+                elif sub == "events":
+                    bus = server.registry.events
+
+                    try:
+                        limit = int(self._query().get("limit", "50"))
+                    except ValueError:
+                        self._json(400, {"error": "limit must be an "
+                                                  "integer"})
+                        return
+                    self._json(200, {"events": [
+                        e.public() for e in bus.recent(
+                            limit, self._query().get("stage", ""))]})
                 elif sub == "jobs":
                     self._json(200, {
                         "kinds": server.jobs.kinds(),

@@ -56,11 +56,32 @@ def build_engine(cfg: RouterConfig, mock: bool = False):
     # the task's signals fail open)
     from .modeldownload import ModelDownloader
 
+    from .events import (
+        DOWNLOAD_DONE,
+        DOWNLOAD_FAILED,
+        DOWNLOAD_STARTED,
+        ENGINE_READY,
+        default_bus,
+    )
+
     downloader = ModelDownloader()
     missing = {t: s for t, s in specs.items()
                if s.get("checkpoint")
                and not os.path.exists(s["checkpoint"])}
-    resolved_paths = downloader.ensure_all(missing) if missing else {}
+    resolved_paths = {}
+    if missing:
+        default_bus.emit(DOWNLOAD_STARTED, tasks=sorted(missing))
+        try:
+            resolved_paths = downloader.ensure_all(missing)
+        except Exception as exc:
+            # per-task soft-skips happen INSIDE ensure_all; anything
+            # escaping it is a downloader/host fault that must keep
+            # failing startup fast (pre-events behavior), not leave the
+            # router serving with zero checkpoints
+            default_bus.emit(DOWNLOAD_FAILED,
+                             error=f"{type(exc).__name__}: {exc}"[:200])
+            raise
+        default_bus.emit(DOWNLOAD_DONE, resolved=sorted(resolved_paths))
 
     engine = InferenceEngine(cfg.engine)
     for task, spec in specs.items():
@@ -192,6 +213,8 @@ def build_engine(cfg: RouterConfig, mock: bool = False):
         engine.register_task(task, kind, module, params, tok, labels,
                              max_seq_len=int(spec.get("max_seq_len", 0)))
         component_event("bootstrap", "model_loaded", task=task, kind=kind)
+    default_bus.emit(ENGINE_READY, tasks=sorted(engine.tasks()),
+                     mesh=bool(engine.mesh))
     return engine
 
 
@@ -354,7 +377,15 @@ def serve(config_path: str, port: int = 8801,
 
     tracker.advance("warming")
     if engine is not None:
-        threading.Thread(target=engine.warmup, daemon=True,
+        from .events import WARMUP_DONE, WARMUP_STARTED, default_bus
+
+        def _warm() -> None:
+            default_bus.emit(WARMUP_STARTED,
+                             tasks=sorted(engine.tasks()))
+            engine.warmup()
+            default_bus.emit(WARMUP_DONE)
+
+        threading.Thread(target=_warm, daemon=True,
                          name="warmup").start()
 
     # OTLP span export when configured (observability.tracing.otlp_endpoint)
@@ -404,6 +435,10 @@ def serve(config_path: str, port: int = 8801,
             # requests already inside old.route() finish their fan-out
             threading.Timer(30.0, old.dispatcher.shutdown).start()
             component_event("bootstrap", "config_reloaded")
+            from .events import CONFIG_RELOADED, default_bus
+
+            default_bus.emit(CONFIG_RELOADED,
+                             decisions=len(new_cfg.decisions))
 
         watcher = ConfigWatcher(config_path, on_reload)
         watcher.start()

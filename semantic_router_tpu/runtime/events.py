@@ -1,0 +1,121 @@
+"""Model-runtime lifecycle event model.
+
+Reference role: pkg/modelruntime's embedding-runtime lifecycle events/
+state (SURVEY §2.2: "Embedding-runtime lifecycle events/state (used at
+startup; cmd/runtime_bootstrap.go:300-331)"). A tiny process-local bus:
+components emit typed lifecycle events (model download, task
+registration, warmup, engine failure, hot-reload), subscribers react
+(startup tracker, dashboard feed, tests), and a bounded ring keeps
+recent history for `/dashboard/api/events`.
+
+Emission must never hurt the emitter: subscriber exceptions are
+swallowed and logged; the bus is lock-protected and the ring bounded.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import asdict, dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+# canonical lifecycle stages (modelruntime state machine role)
+DOWNLOAD_STARTED = "download_started"
+DOWNLOAD_DONE = "download_done"
+DOWNLOAD_FAILED = "download_failed"
+TASK_REGISTERED = "task_registered"
+WARMUP_STARTED = "warmup_started"
+WARMUP_DONE = "warmup_done"
+ENGINE_READY = "engine_ready"
+ENGINE_FAILED = "engine_failed"
+CONFIG_RELOADED = "config_reloaded"
+
+
+@dataclass
+class RuntimeEvent:
+    stage: str
+    detail: Dict[str, Any] = field(default_factory=dict)
+    ts: float = 0.0
+    event_id: str = ""
+
+    def public(self) -> Dict[str, Any]:
+        return asdict(self)
+
+
+class EventBus:
+    def __init__(self, history: int = 256) -> None:
+        self._subs: List[Callable[[RuntimeEvent], None]] = []
+        self._ring: List[RuntimeEvent] = []
+        self._history = history
+        self._lock = threading.Lock()
+
+    def subscribe(self, fn: Callable[[RuntimeEvent], None]
+                  ) -> Callable[[], None]:
+        """Register; returns an unsubscribe callable."""
+        with self._lock:
+            self._subs.append(fn)
+
+        def unsubscribe() -> None:
+            with self._lock:
+                try:
+                    self._subs.remove(fn)
+                except ValueError:
+                    pass
+
+        return unsubscribe
+
+    def emit(self, stage: str, **detail: Any) -> RuntimeEvent:
+        ev = RuntimeEvent(stage=stage, detail=detail, ts=time.time(),
+                          event_id=uuid.uuid4().hex[:10])
+        with self._lock:
+            self._ring.append(ev)
+            if len(self._ring) > self._history:
+                del self._ring[: len(self._ring) - self._history]
+            subs = list(self._subs)
+        for fn in subs:
+            try:
+                fn(ev)
+            except Exception:
+                from ..observability.logging import component_event
+
+                component_event("modelruntime", "subscriber_error",
+                                level="warning", stage=stage)
+        return ev
+
+    def recent(self, limit: int = 50,
+               stage: str = "") -> List[RuntimeEvent]:
+        with self._lock:
+            evs = list(self._ring)
+        if stage:
+            evs = [e for e in evs if e.stage == stage]
+        return evs[-limit:][::-1]
+
+    def wait_for(self, stage: str, timeout: float = 10.0
+                 ) -> Optional[RuntimeEvent]:
+        """Block until an event with ``stage`` arrives (or is already in
+        history) — the startup-sequencing primitive."""
+        got: List[RuntimeEvent] = []
+        cond = threading.Event()
+
+        def on(ev: RuntimeEvent) -> None:
+            if ev.stage == stage:
+                got.append(ev)
+                cond.set()
+
+        unsub = self.subscribe(on)
+        try:
+            with self._lock:
+                for ev in reversed(self._ring):
+                    if ev.stage == stage:
+                        return ev
+            if cond.wait(timeout):
+                return got[0]
+            return None
+        finally:
+            unsub()
+
+
+# process-default bus (the reference keeps one runtime state machine per
+# process; tests construct their own)
+default_bus = EventBus()

@@ -1,0 +1,110 @@
+"""Runtime service registry (pkg/routerruntime role).
+
+The reference moved request paths off package globals onto a runtime
+registry owned at the composition root (router.go:61-63; the
+state-taxonomy doc's "runtime registry" rows), so two router instances
+in one process don't share mutable state and a hot reload swaps services
+atomically. Same move here: the registry owns the per-instance service
+set — observability sinks (metrics registry, tracer, session telemetry,
+profiler, event bus) and the stateful subsystems (engine, cache, memory,
+vectorstores, replay) — with lock-protected atomic ``swap``.
+
+``RuntimeRegistry.with_defaults()`` binds the process-default singletons
+(the dev/single-instance posture, exactly what the bare constructor used
+to hard-code); an isolated instance gets fresh sinks. Consumers read
+services through the registry at request time, so a swap takes effect
+atomically on the next access.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+_SLOTS = ("metrics", "tracer", "sessions", "profiler", "events",
+          "engine", "cache", "memory_store", "vectorstores",
+          "replay_store")
+
+
+class RuntimeRegistry:
+    def __init__(self, **services: Any) -> None:
+        unknown = set(services) - set(_SLOTS)
+        if unknown:
+            raise ValueError(f"unknown services: {sorted(unknown)}")
+        self._services: Dict[str, Any] = {s: services.get(s)
+                                          for s in _SLOTS}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def with_defaults(cls, **overrides: Any) -> "RuntimeRegistry":
+        """Process-default sinks (shared across instances — the
+        single-router posture); stateful stores stay per-instance."""
+        from ..observability.metrics import default_registry
+        from ..observability.profiler import default_profiler
+        from ..observability.session import default_session_telemetry
+        from ..observability.tracing import default_tracer
+        from .events import default_bus
+
+        base: Dict[str, Any] = {
+            "metrics": default_registry,
+            "tracer": default_tracer,
+            "sessions": default_session_telemetry,
+            "profiler": default_profiler,
+            "events": default_bus,
+        }
+        base.update(overrides)
+        return cls(**base)
+
+    @classmethod
+    def isolated(cls, **overrides: Any) -> "RuntimeRegistry":
+        """Per-instance state for the services whose WRITE side goes
+        through the registry today: session telemetry and the profiler
+        control. Metrics, tracing, and lifecycle events still bind the
+        process defaults — their emitters (the canonical series in
+        observability/metrics.py, span helpers, engine/bootstrap event
+        emits) write to module singletons, so handing out fresh sinks
+        here would expose empty /metrics and /dashboard/api/events while
+        traffic silently feeds the globals. Pass explicit overrides once
+        an emitter is registry-routed; until then isolation covers
+        sessions + profiler (honestly)."""
+        from ..observability.profiler import ProfilerControl
+        from ..observability.session import SessionTelemetry
+
+        base: Dict[str, Any] = {
+            "sessions": SessionTelemetry(),
+            "profiler": ProfilerControl(),
+        }
+        defaults = cls.with_defaults().snapshot()
+        for slot in ("metrics", "tracer", "events"):
+            base.setdefault(slot, defaults[slot])
+        base.update(overrides)
+        return cls(**base)
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        services = object.__getattribute__(self, "_services")
+        if name in services:
+            with object.__getattribute__(self, "_lock"):
+                return services[name]
+        raise AttributeError(f"no service {name!r} "
+                             f"(slots: {', '.join(_SLOTS)})")
+
+    def get(self, name: str, default: Any = None) -> Any:
+        with self._lock:
+            return self._services.get(name, default)
+
+    def swap(self, **services: Any) -> Dict[str, Any]:
+        """Atomically replace the named services; returns the replaced
+        ones (RouterService.Swap semantics — callers retire them)."""
+        unknown = set(services) - set(_SLOTS)
+        if unknown:
+            raise ValueError(f"unknown services: {sorted(unknown)}")
+        with self._lock:
+            old = {k: self._services[k] for k in services}
+            self._services.update(services)
+            return old
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._services)

@@ -1,0 +1,310 @@
+"""Typed CRDs + validating admission webhook (apis row) and the
+model-runtime lifecycle event bus (modelruntime row)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+import yaml
+
+from semantic_router_tpu.runtime.crds import (
+    AdmissionWebhook,
+    IntelligentPool,
+    IntelligentRoute,
+    parse_cr,
+    validate_admission,
+)
+from semantic_router_tpu.runtime.events import (
+    TASK_REGISTERED,
+    WARMUP_DONE,
+    EventBus,
+)
+
+POOL_YAML = """
+apiVersion: srt.tpu.dev/v1alpha1
+kind: IntelligentPool
+metadata: {name: pool, namespace: prod, labels: {team: ml}}
+spec:
+  defaultModel: m-default
+  models:
+    - name: m-default
+      qualityScore: 0.8
+      pricing: {currency: USD, promptPerM: 1.5}
+      customField: kept
+  futureField: {nested: true}
+"""
+
+ROUTE_YAML = """
+apiVersion: srt.tpu.dev/v1alpha1
+kind: IntelligentRoute
+metadata: {name: route}
+spec:
+  signals:
+    keywords:
+      - {name: code, operator: OR, keywords: [debug, function]}
+  decisions:
+    - name: code_route
+      priority: 10
+      rules: {type: keyword, name: code}
+      modelRefs: [{model: m-code}]
+"""
+
+
+class TestTypedRoundTrip:
+    def test_pool_round_trip_preserves_unknown_fields(self):
+        doc = yaml.safe_load(POOL_YAML)
+        pool = parse_cr(doc)
+        assert isinstance(pool, IntelligentPool)
+        assert pool.namespace == "prod"
+        assert pool.models[0].quality_score == 0.8
+        out = pool.to_dict()
+        # unknown fields at both spec and model level survive
+        assert out["spec"]["futureField"] == {"nested": True}
+        assert out["spec"]["models"][0]["customField"] == "kept"
+        assert out["metadata"]["labels"] == {"team": "ml"}
+        # full round-trip stability
+        assert parse_cr(out).to_dict() == out
+
+    def test_route_round_trip(self):
+        doc = yaml.safe_load(ROUTE_YAML)
+        route = parse_cr(doc)
+        assert isinstance(route, IntelligentRoute)
+        assert route.decisions[0]["name"] == "code_route"
+        out = route.to_dict()
+        assert parse_cr(out).to_dict() == out
+
+    def test_unknown_kind_raises(self):
+        with pytest.raises(ValueError, match="unknown CR kind"):
+            parse_cr({"kind": "Gadget"})
+
+
+class TestAdmission:
+    def test_valid_pool_and_route_allowed(self):
+        ok, msg = validate_admission(yaml.safe_load(POOL_YAML))
+        assert ok, msg
+        ok, msg = validate_admission(yaml.safe_load(ROUTE_YAML))
+        assert ok, msg
+
+    def test_invalid_route_denied_with_reason(self):
+        doc = yaml.safe_load(ROUTE_YAML)
+        doc["spec"]["decisions"][0].pop("rules")
+        ok, msg = validate_admission(doc)
+        assert not ok and "rules" in msg
+
+    def test_empty_pool_denied(self):
+        ok, msg = validate_admission({
+            "kind": "IntelligentPool", "metadata": {"name": "x"},
+            "spec": {}})
+        assert not ok
+
+    def test_webhook_http_admissionreview(self):
+        hook = AdmissionWebhook()
+        try:
+            review = {"apiVersion": "admission.k8s.io/v1",
+                      "kind": "AdmissionReview",
+                      "request": {"uid": "u-1", "operation": "CREATE",
+                                  "object": yaml.safe_load(ROUTE_YAML)}}
+            req = urllib.request.Request(
+                hook.url + "/validate",
+                data=json.dumps(review).encode(),
+                headers={"content-type": "application/json"})
+            out = json.loads(urllib.request.urlopen(req,
+                                                    timeout=10).read())
+            assert out["kind"] == "AdmissionReview"
+            assert out["response"]["uid"] == "u-1"
+            assert out["response"]["allowed"] is True
+
+            bad = yaml.safe_load(ROUTE_YAML)
+            bad["spec"]["decisions"][0].pop("rules")
+            review["request"]["object"] = bad
+            review["request"]["uid"] = "u-2"
+            req = urllib.request.Request(
+                hook.url + "/validate",
+                data=json.dumps(review).encode(),
+                headers={"content-type": "application/json"})
+            out = json.loads(urllib.request.urlopen(req,
+                                                    timeout=10).read())
+            assert out["response"]["allowed"] is False
+            assert out["response"]["status"]["code"] == 422
+
+            # DELETE always allowed
+            review["request"].update(operation="DELETE", uid="u-3",
+                                     object={})
+            req = urllib.request.Request(
+                hook.url + "/validate",
+                data=json.dumps(review).encode(),
+                headers={"content-type": "application/json"})
+            out = json.loads(urllib.request.urlopen(req,
+                                                    timeout=10).read())
+            assert out["response"]["allowed"] is True
+        finally:
+            hook.close()
+
+
+class TestEventBus:
+    def test_emit_subscribe_recent(self):
+        bus = EventBus(history=4)
+        seen = []
+        unsub = bus.subscribe(lambda e: seen.append(e.stage))
+        for i in range(6):
+            bus.emit("stage_a", i=i)
+        bus.emit("stage_b")
+        assert seen.count("stage_a") == 6
+        # ring bounded at 4, newest first
+        recent = bus.recent()
+        assert len(recent) == 4
+        assert recent[0].stage == "stage_b"
+        assert [e.stage for e in bus.recent(stage="stage_b")] == \
+            ["stage_b"]
+        unsub()
+        bus.emit("stage_c")
+        assert "stage_c" not in seen
+
+    def test_subscriber_error_does_not_break_emit(self):
+        bus = EventBus()
+        bus.subscribe(lambda e: 1 / 0)
+        got = []
+        bus.subscribe(lambda e: got.append(e))
+        bus.emit("x")
+        assert len(got) == 1
+
+    def test_wait_for_past_and_future(self):
+        bus = EventBus()
+        bus.emit("already")
+        assert bus.wait_for("already", timeout=0.1) is not None
+        t = threading.Timer(0.1, lambda: bus.emit("later"))
+        t.start()
+        ev = bus.wait_for("later", timeout=5.0)
+        assert ev is not None and ev.stage == "later"
+        assert bus.wait_for("never", timeout=0.05) is None
+
+    def test_engine_emits_task_registered(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from semantic_router_tpu.config.schema import (
+            InferenceEngineConfig,
+        )
+        from semantic_router_tpu.engine.classify import InferenceEngine
+        from semantic_router_tpu.models.modernbert import (
+            ModernBertConfig,
+            ModernBertForSequenceClassification,
+        )
+        from semantic_router_tpu.runtime.events import default_bus
+        from semantic_router_tpu.utils.tokenization import HashTokenizer
+
+        mcfg = ModernBertConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=48,
+            num_hidden_layers=1, num_attention_heads=2,
+            max_position_embeddings=64, local_attention=8, num_labels=2)
+        model = ModernBertForSequenceClassification(mcfg)
+        ids = jnp.asarray(np.ones((1, 8)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)
+        eng = InferenceEngine(InferenceEngineConfig(
+            seq_len_buckets=[32]))
+        before = len(default_bus.recent(limit=256,
+                                        stage=TASK_REGISTERED))
+        eng.register_task("ev-task", "sequence", model, params,
+                          HashTokenizer(vocab_size=128), ["a", "b"])
+        evs = default_bus.recent(limit=256, stage=TASK_REGISTERED)
+        assert len(evs) == before + 1
+        assert evs[0].detail["task"] == "ev-task"
+        eng.shutdown()
+
+    def test_events_endpoint(self):
+        from semantic_router_tpu.config import load_config
+        from semantic_router_tpu.router import (
+            MockVLLMServer,
+            RouterServer,
+        )
+        from semantic_router_tpu.runtime.bootstrap import build_router
+        from semantic_router_tpu.runtime.events import default_bus
+
+        default_bus.emit("test_endpoint_stage", marker=True)
+        cfg = load_config("tests/fixtures/router_config.yaml")
+        router = build_router(cfg, None)
+        backend = MockVLLMServer().start()
+        server = RouterServer(router, cfg,
+                              default_backend=backend.url).start()
+        try:
+            out = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/dashboard/api/events"
+                "?stage=test_endpoint_stage", timeout=10).read())
+            assert any(e["detail"].get("marker")
+                       for e in out["events"])
+        finally:
+            server.stop()
+            backend.stop()
+            router.shutdown()
+
+
+class TestRuntimeRegistry:
+    def test_slots_defaults_and_swap(self):
+        from semantic_router_tpu.observability.metrics import (
+            default_registry,
+        )
+        from semantic_router_tpu.runtime.registry import RuntimeRegistry
+
+        reg = RuntimeRegistry.with_defaults()
+        assert reg.metrics is default_registry
+        iso = RuntimeRegistry.isolated()
+        # metrics/tracer/events emitters are module-level today, so
+        # isolated() honestly binds the process defaults for them and
+        # isolates only the registry-written services
+        assert iso.metrics is default_registry
+        assert iso.sessions is not reg.sessions
+        assert iso.profiler is not reg.profiler
+        from semantic_router_tpu.observability.metrics import (
+            MetricsRegistry,
+        )
+
+        fresh = MetricsRegistry()
+        old = iso.swap(metrics=fresh)
+        assert iso.metrics is fresh
+        assert old["metrics"] is default_registry
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            iso.swap(nonsense=1)
+        with _pytest.raises(AttributeError):
+            iso.not_a_slot
+
+    def test_two_servers_isolated_sessions(self):
+        """pkg/routerruntime's point: two routers in one process must
+        not share mutable telemetry state."""
+        from semantic_router_tpu.config import load_config
+        from semantic_router_tpu.router import (
+            MockVLLMServer,
+            RouterServer,
+        )
+        from semantic_router_tpu.runtime.bootstrap import build_router
+        from semantic_router_tpu.runtime.registry import RuntimeRegistry
+
+        cfg = load_config("tests/fixtures/router_config.yaml")
+        backend = MockVLLMServer().start()
+        r1, r2 = build_router(cfg, None), build_router(cfg, None)
+        s1 = RouterServer(r1, cfg, default_backend=backend.url,
+                          registry=RuntimeRegistry.isolated()).start()
+        s2 = RouterServer(r2, cfg, default_backend=backend.url,
+                          registry=RuntimeRegistry.isolated()).start()
+        try:
+            body = json.dumps({
+                "model": "auto", "session_id": "sess-1",
+                "messages": [{"role": "user", "content": "hi"}],
+            }).encode()
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{s1.port}/v1/chat/completions",
+                data=body,
+                headers={"content-type": "application/json"}),
+                timeout=30).read()
+            assert s1.sessions is not s2.sessions
+            assert s2.sessions.count() == 0
+        finally:
+            s1.stop()
+            s2.stop()
+            backend.stop()
+            r1.shutdown()
+            r2.shutdown()
