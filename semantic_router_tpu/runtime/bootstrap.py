@@ -377,12 +377,25 @@ def serve(config_path: str, port: int = 8801,
 
     tracker.advance("warming")
     if engine is not None:
-        from .events import WARMUP_DONE, WARMUP_STARTED, default_bus
+        from .events import (
+            ENGINE_FAILED,
+            WARMUP_DONE,
+            WARMUP_STARTED,
+            default_bus,
+        )
 
         def _warm() -> None:
             default_bus.emit(WARMUP_STARTED,
                              tasks=sorted(engine.tasks()))
-            engine.warmup()
+            try:
+                engine.warmup()
+            except Exception as exc:
+                # a dead warmup thread must leave a terminal stage, not
+                # an eternal warmup_started (wait_for sequencers hang)
+                default_bus.emit(
+                    ENGINE_FAILED, during="warmup",
+                    error=f"{type(exc).__name__}: {exc}"[:200])
+                return
             default_bus.emit(WARMUP_DONE)
 
         threading.Thread(target=_warm, daemon=True,

@@ -173,17 +173,26 @@ def validate_admission(obj: Dict[str, Any]) -> Tuple[bool, str]:
             return False, ("IntelligentRoute needs decisions and/or "
                            "signals")
         # validate against a permissive placeholder pool: every model
-        # the route references exists (webhooks see one object at a
-        # time; cross-object checks belong to reconcile)
+        # (and every lora) the route references exists — webhooks see
+        # ONE object at a time, so anything another object could supply
+        # must not fail here; cross-object checks belong to reconcile
         referenced = sorted({ref.get("model", "")
                              for d in cr.decisions
                              for ref in d.get("modelRefs", []) or []
                              if ref.get("model")})
+        loras_by_model: Dict[str, List[Dict[str, str]]] = {}
+        for d in cr.decisions:
+            for ref in d.get("modelRefs", []) or []:
+                if ref.get("model") and ref.get("lora_name"):
+                    loras_by_model.setdefault(ref["model"], []).append(
+                        {"name": ref["lora_name"]})
         pool_dict = {"kind": "IntelligentPool",
                      "metadata": {"name": "placeholder"},
                      "spec": {"defaultModel": referenced[0]
                               if referenced else "placeholder-model",
-                              "models": [{"name": m}
+                              "models": [{"name": m,
+                                          "loras":
+                                              loras_by_model.get(m, [])}
                                          for m in referenced] or
                               [{"name": "placeholder-model"}]}}
         routes = [obj]
@@ -193,9 +202,24 @@ def validate_admission(obj: Dict[str, Any]) -> Tuple[bool, str]:
         fatal = [str(e) for e in validate_config(cfg) if e.fatal]
     except Exception as exc:
         return False, f"render failed: {exc}"
+    if kind == IntelligentRoute.KIND:
+        fatal = [e for e in fatal if not _cross_object(e)]
     if fatal:
         return False, "; ".join(fatal[:3])
     return True, ""
+
+
+_CROSS_OBJECT_MARKERS = (
+    # references another route/pool may satisfy — reconcile-time checks,
+    # not single-object admission failures
+    "not produced by any mapping/partition",
+    "not configured",
+    "signals are configured",
+)
+
+
+def _cross_object(error_text: str) -> bool:
+    return any(m in error_text for m in _CROSS_OBJECT_MARKERS)
 
 
 class AdmissionWebhook:

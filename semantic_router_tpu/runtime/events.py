@@ -85,6 +85,8 @@ class EventBus:
 
     def recent(self, limit: int = 50,
                stage: str = "") -> List[RuntimeEvent]:
+        if limit <= 0:
+            return []  # evs[-0:] would be the WHOLE ring, not none
         with self._lock:
             evs = list(self._ring)
         if stage:

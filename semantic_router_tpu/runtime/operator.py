@@ -62,6 +62,12 @@ def render_config(pool: Dict[str, Any],
             sig = routing.setdefault("signals", {})
             for fam, rules in spec["signals"].items():
                 sig.setdefault(fam, []).extend(rules)
+        if spec.get("projections"):
+            # projections is a dict of lists (partitions/scores/
+            # mappings/threshold bands) — merge per key across routes
+            proj = routing.setdefault("projections", {})
+            for pk, pv in spec["projections"].items():
+                proj.setdefault(pk, []).extend(pv or [])
         knowledge_bases.extend(spec.get("knowledgeBases", []) or [])
         routing["decisions"].extend(spec.get("decisions", []) or [])
 

@@ -93,6 +93,22 @@ class TestAdmission:
         ok, msg = validate_admission(doc)
         assert not ok and "rules" in msg
 
+    def test_route_with_lora_ref_allowed(self):
+        """Single-object admission must not reject refs another object
+        satisfies: lora_name on a modelRef (fixture shape) and signal
+        rules defined in a sibling route."""
+        doc = yaml.safe_load(ROUTE_YAML)
+        doc["spec"]["decisions"][0]["modelRefs"] = [
+            {"model": "qwen3-32b", "lora_name": "cs-expert"}]
+        ok, msg = validate_admission(doc)
+        assert ok, msg
+        # decision referencing a rule THIS route doesn't define
+        # (cross-route) still admits; reconcile checks the merged view
+        doc2 = yaml.safe_load(ROUTE_YAML)
+        doc2["spec"]["signals"] = {}
+        ok, msg = validate_admission(doc2)
+        assert ok, msg
+
     def test_empty_pool_denied(self):
         ok, msg = validate_admission({
             "kind": "IntelligentPool", "metadata": {"name": "x"},

@@ -272,12 +272,57 @@ class _RAGLlamaStackProfile(_HTTPProfile):
         return url
 
 
+class _DynamicConfigProfile(_HTTPProfile):
+    """Live CRD-driven config (reference dynamic-config profile): the
+    router's config file is WRITTEN by the kube watch controller from
+    IntelligentPool/IntelligentRoute CRs served by MiniKubeAPI."""
+
+    name = "dynamic-config"
+
+    def build_cfg(self, fixture_path, tmp_path, services):
+        import time as _time
+
+        from semantic_router_tpu.runtime.kubewatch import (
+            KubeClient,
+            KubeOperator,
+            MiniKubeAPI,
+        )
+
+        base = load_config(fixture_path)
+        routing = (base.raw or {}).get("routing", {}) or {}
+        api = MiniKubeAPI()
+        api.stop = api.close  # harness teardown convention
+        services["kubeapi"] = api
+        api.apply("intelligentpools", {
+            "kind": "IntelligentPool", "metadata": {"name": "pool"},
+            "spec": {"defaultModel": base.default_model,
+                     "models": [{"name": m.name,
+                                 "qualityScore": m.quality_score,
+                                 "loras": [{"name": lr.name}
+                                           for lr in m.loras]}
+                                for m in base.model_cards]}})
+        api.apply("intelligentroutes", {
+            "kind": "IntelligentRoute", "metadata": {"name": "fixture"},
+            "spec": {"signals": routing.get("signals", {}),
+                     "projections": routing.get("projections", {}),
+                     "decisions": routing.get("decisions", [])}})
+        cfg_path = str(tmp_path / "dynamic.yaml")
+        op = KubeOperator(KubeClient(api.url), cfg_path,
+                          debounce_s=0.05).start()
+        services["operator"] = op  # KubeOperator.stop fits the harness
+        deadline = _time.time() + 15
+        while _time.time() < deadline and op.last_status != "applied":
+            _time.sleep(0.05)
+        assert op.last_status == "applied", op.last_status
+        return load_config(cfg_path)
+
+
 PROFILES = [_HTTPProfile, _DurableProfile, _EngineProfile,
             _SecuredProfile, _RecipesProfile, _ResponseAPIProfile,
                          _ResponseAPIRedisProfile, _ResponseAPIClusterProfile,
                          _StreamingProfile, _AnthropicShimProfile,
                          _AuthzRateProfile, _MLSelectionProfile,
-                         _RAGLlamaStackProfile]
+                         _RAGLlamaStackProfile, _DynamicConfigProfile]
 
 
 @pytest.mark.parametrize("profile_cls", PROFILES,
