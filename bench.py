@@ -178,7 +178,10 @@ def _run_bench(platform: str) -> None:
                 out = fn(params, ids, mask)
             jax.device_get(out)
             elapsed = time.perf_counter() - t0
-        except Exception as exc:  # OOM at a large batch: keep smaller
+        except Exception as exc:
+            if best is None:
+                raise  # first batch failed: surface the REAL error
+            # OOM at a larger batch: keep the smaller batch's number
             sys.stderr.write(f"bench: b={batch} failed "
                              f"({type(exc).__name__}); keeping best\n")
             break

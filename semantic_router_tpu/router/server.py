@@ -111,6 +111,8 @@ API_CATALOG = {
         {"path": "/dashboard/api/jobs", "method": "POST"},
         {"path": "/dashboard/api/jobs/{id}", "method": "GET"},
         {"path": "/dashboard/api/playground", "method": "POST"},
+        {"path": "/dashboard/api/dsl/compile", "method": "POST"},
+        {"path": "/dashboard/api/dsl/decompile", "method": "POST"},
     ],
 }
 
@@ -794,6 +796,54 @@ class RouterServer:
                         if self._authorize() is None:
                             return
                         self._playground(body)
+                    elif path == "/dashboard/api/dsl/compile":
+                        # the DSL editor backend (reference: the WASM
+                        # browser build of the compiler, cmd/wasm —
+                        # signalCompile/signalValidate exports; this
+                        # image has no WASM toolchain, so the compiler
+                        # serves over HTTP to the same editor role)
+                        if self._authorize() is None:
+                            return
+                        from ..dsl.compiler import (
+                            DSLCompileError,
+                            compile_dsl,
+                            emit_yaml,
+                        )
+                        from ..dsl.parser import DSLSyntaxError
+
+                        try:
+                            compiled = compile_dsl(
+                                str(body.get("dsl", "")),
+                                validate=not body.get("skip_validate"))
+                        except (DSLCompileError, DSLSyntaxError,
+                                ValueError) as exc:
+                            self._json(422, {"ok": False,
+                                             "error": str(exc)[:500]})
+                            return
+                        self._json(200, {
+                            "ok": True,
+                            "yaml": emit_yaml(compiled),
+                            "decisions": [d.name for d in
+                                          compiled.decisions],
+                            "signal_families":
+                                compiled.used_signal_types()})
+                    elif path == "/dashboard/api/dsl/decompile":
+                        if self._authorize() is None:
+                            return
+                        from ..config.schema import RouterConfig
+                        from ..dsl.compiler import decompile
+
+                        try:
+                            # from_dict directly: a YAML round-trip
+                            # would re-run env substitution and mutate
+                            # literal ${VAR} strings in the config
+                            cfg2 = RouterConfig.from_dict(
+                                body.get("config") or {})
+                            self._json(200, {"ok": True,
+                                             "dsl": decompile(cfg2)})
+                        except Exception as exc:
+                            self._json(422, {"ok": False,
+                                             "error": str(exc)[:500]})
                     elif path.startswith("/debug/profiler/"):
                         # profiling perturbs the serving process: edit-
                         # gated + audited like config mutations

@@ -213,3 +213,40 @@ class TestDashboardHTTP:
         assert trace["model"]
         assert trace["signals"]
         assert trace["routing_latency_ms"] >= 0
+
+
+class TestDSLEditorEndpoints:
+    def test_compile_and_decompile(self, live):
+        u = live.url
+        _, admin = _post(f"{u}/dashboard/api/login",
+                         {"api_key": "admin-key"})
+        tok = admin["token"]
+        dsl = ('model "m-8b" { quality_score: 0.8 }\n'
+               'signal keyword urgent_kw { keywords: ["urgent"] }\n'
+               'decision fast priority 10 { when keyword(urgent_kw) '
+               'route to "m-8b" }\n')
+        status, out = _post(f"{u}/dashboard/api/dsl/compile",
+                            {"dsl": dsl}, tok)
+        assert status == 200 and out["ok"]
+        assert out["decisions"] == ["fast"]
+        assert "urgent_kw" in out["yaml"]
+
+        # syntax error -> 422 with a message, not a 500
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(f"{u}/dashboard/api/dsl/compile",
+                  {"dsl": "decision { nope"}, tok)
+        assert ei.value.code == 422
+
+        # decompile the live config -> a DSL program that recompiles
+        import urllib.request as _rq
+        import json as _json
+
+        req = _rq.Request(f"{u}/dashboard/api/config",
+                          headers={"authorization": f"Bearer {tok}"})
+        cfg = _json.loads(_rq.urlopen(req, timeout=30).read())
+        status, out = _post(f"{u}/dashboard/api/dsl/decompile",
+                            {"config": cfg["config"]}, tok)
+        assert status == 200 and out["ok"] and "decision" in out["dsl"]
+        status, out2 = _post(f"{u}/dashboard/api/dsl/compile",
+                             {"dsl": out["dsl"]}, tok)
+        assert status == 200 and out2["ok"]
