@@ -59,7 +59,12 @@ class TokenIssuer:
         if len(parts) != 3:
             return None
         signing_input = f"{parts[0]}.{parts[1]}".encode()
-        if not hmac.compare_digest(self._sign(signing_input), parts[2]):
+        # compare as BYTES: compare_digest on str demands ASCII, and a
+        # presented signature segment from a latin-1-decoded header can
+        # carry non-ASCII — that must be a clean None, not a TypeError
+        presented = parts[2].encode("utf-8", "surrogateescape")
+        if not hmac.compare_digest(self._sign(signing_input).encode(),
+                                   presented):
             return None
         try:
             payload = json.loads(_unb64url(parts[1]))

@@ -201,16 +201,41 @@ cv.onmousemove = (e) => {{
     tip.textContent = data.labels[best];
   }} else tip.style.display = 'none';
 }};
-async function load() {{
-  const src = document.getElementById('src').value;
+function authHeaders() {{
   // same credential convention as the bundled dashboard page: key typed
   // once, kept in sessionStorage, sent as x-api-key
   const keyEl = document.getElementById('apikey');
   const key = keyEl.value || sessionStorage.getItem('srt-key') || '';
   if (keyEl.value) sessionStorage.setItem('srt-key', key);
-  const headers = key ? {{'x-api-key': key}} : {{}};
+  return key ? {{'x-api-key': key}} : {{}};
+}}
+async function loadSources() {{
+  // the page ships with an EMPTY dropdown — store names are data and
+  // stay behind the same auth gate as the vectors themselves
+  const resp = await fetch('/dashboard/api/embedmap/sources',
+                           {{headers: authHeaders()}});
+  if (!resp.ok) {{
+    document.getElementById('meta').textContent =
+      resp.status === 401 || resp.status === 403 ?
+        'enter API key to list sources' : ('HTTP ' + resp.status);
+    return false;
+  }}
+  const body = await resp.json();
+  const sel = document.getElementById('src'), prev = sel.value;
+  sel.innerHTML = '';
+  for (const s of (body.sources || [])) {{
+    const o = document.createElement('option');
+    o.value = s; o.textContent = s; sel.appendChild(o);
+  }}
+  if (prev) sel.value = prev;
+  return true;
+}}
+async function load() {{
+  const src = document.getElementById('src').value;
+  if (!src) {{ if (!(await loadSources())) return; }}
   const resp = await fetch('/dashboard/api/embedmap?source=' +
-                           encodeURIComponent(src), {{headers}});
+      encodeURIComponent(document.getElementById('src').value || 'cache'),
+      {{headers: authHeaders()}});
   const body = await resp.json();
   if (!resp.ok || !body.points) {{
     data = null; draw();
@@ -225,19 +250,17 @@ async function load() {{
   draw();
 }}
 document.getElementById('src').onchange = load;
-document.getElementById('apikey').onchange = load;
-load();
+document.getElementById('apikey').onchange =
+  async () => {{ if (await loadSources()) load(); }};
+(async () => {{ if (await loadSources()) load(); }})();
 </script></body></html>
 """
 
 
-def render_page(sources: Sequence[str]) -> str:
-    import html
-
-    # store names are user-controlled (POST /v1/vector_stores) and this
-    # page is unauthenticated — escape them or a hostile store name is
-    # stored XSS against whoever opens the map
-    options = "".join(
-        '<option value="{0}">{0}</option>'.format(html.escape(s, quote=True))
-        for s in sources)
-    return _PAGE.format(options=options)
+def render_page(sources: Sequence[str] = ()) -> str:
+    """The page ships with an EMPTY dropdown: store names are data and
+    arrive client-side from the auth-gated
+    ``/dashboard/api/embedmap/sources`` endpoint (a hostile store name
+    is inserted via DOM ``textContent``, so it cannot become markup).
+    ``sources`` is accepted for compatibility but ignored."""
+    return _PAGE.format(options="")

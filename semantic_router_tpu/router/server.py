@@ -106,6 +106,7 @@ API_CATALOG = {
          "method": "DELETE"},
         {"path": "/dashboard/embedmap", "method": "GET"},
         {"path": "/dashboard/api/embedmap", "method": "GET"},
+        {"path": "/dashboard/api/embedmap/sources", "method": "GET"},
         {"path": "/dashboard/api/login", "method": "POST"},
         {"path": "/dashboard/api/jobs", "method": "GET"},
         {"path": "/dashboard/api/jobs", "method": "POST"},
@@ -633,12 +634,14 @@ class RouterServer:
                     except (OSError, ValueError):
                         self._json(404, {"error": "dashboard not bundled"})
                 elif path == "/dashboard/embedmap":
-                    # static canvas page (wizmap role); data comes from
-                    # /dashboard/api/embedmap behind the RBAC gate
+                    # static canvas page (wizmap role); the page is
+                    # served EMPTY — store names and data both come from
+                    # /dashboard/api/embedmap* behind the RBAC gate, so
+                    # an unauthenticated fetch of this page leaks
+                    # nothing (ADVICE r3)
                     from ..dashboard.embedmap import render_page
 
-                    self._text(200, render_page(self._embedmap_sources()),
-                               "text/html")
+                    self._text(200, render_page(()), "text/html")
                 elif path == "/startup-status":
                     if server.startup is not None:
                         self._json(200, server.startup.snapshot())
@@ -971,6 +974,11 @@ class RouterServer:
                         for r in store.list(limit=limit)]})
                 elif sub == "embedmap":
                     self._embedmap()
+                elif sub == "embedmap/sources":
+                    # dropdown population for the static page — behind
+                    # the same gate as the data (the page itself ships
+                    # no store names; ADVICE r3)
+                    self._json(200, {"sources": self._embedmap_sources()})
                 elif sub == "events":
                     bus = server.registry.events
 

@@ -149,6 +149,7 @@ class KubeOperator:
         self.backoff_s = backoff_s
         self._state: Dict[str, Dict[str, Dict]] = {
             p: {} for p in self.PLURALS}
+        self._last_rv: Dict[str, int] = {}
         self._state_lock = threading.Lock()
         self._dirty = threading.Event()
         self._stop = threading.Event()
@@ -164,6 +165,16 @@ class KubeOperator:
 
     def _apply_event(self, plural: str, etype: str, obj: Dict) -> None:
         with self._state_lock:
+            # remember the newest rv seen on the stream — a DELETED
+            # event carries the freshest rv while REMOVING its object,
+            # so deriving the resume point from surviving objects would
+            # rewind and replay already-applied events on re-watch
+            try:
+                rv = int((obj.get("metadata") or {}).get(
+                    "resourceVersion", "0") or 0)
+            except (TypeError, ValueError):
+                rv = 0
+            self._last_rv[plural] = max(self._last_rv.get(plural, 0), rv)
             if etype == "DELETED":
                 self._state[plural].pop(self._key(obj), None)
             else:  # ADDED | MODIFIED
@@ -200,13 +211,14 @@ class KubeOperator:
                         plural, rv,
                         lambda t, o, p=plural: self._apply_event(p, t, o),
                         self._stop)
-                    # clean stream end: watch again from the freshest
-                    # object we hold (bookmark-less servers)
+                    # clean stream end: resume from the newest rv the
+                    # stream DELIVERED (tracked in _apply_event) — not
+                    # from surviving objects, which lose the rv of a
+                    # trailing DELETED event
                     with self._state_lock:
-                        rvs = [int((o.get("metadata") or {}).get(
-                            "resourceVersion", "0") or 0)
-                            for o in self._state[plural].values()]
-                    rv = str(max(rvs + [int(rv) if rv.isdigit() else 0]))
+                        seen = self._last_rv.get(plural, 0)
+                    rv = str(max(seen,
+                                 int(rv) if rv.isdigit() else 0))
                 backoff = self.backoff_s
             except urllib.error.HTTPError as exc:
                 if exc.code == 410:  # compacted: re-list immediately

@@ -234,17 +234,37 @@ class PostgresClient:
             # 's' PortalSuspended, 'I' EmptyQueryResponse, 'N' notices:
             # no client action needed
 
+    def _send_retriable(self, sock: socket.socket,
+                        packet: bytes) -> socket.socket:
+        """Send ``packet``; reconnect + resend ONLY when zero bytes
+        reached the old socket.  A partial write followed by a blind
+        resend could double-execute a non-idempotent statement, so a
+        mid-stream failure surfaces to the caller instead."""
+        sent = 0
+        try:
+            while sent < len(packet):
+                sent += sock.send(packet[sent:])
+            return sock
+        except OSError:
+            self._sock = None
+            if sent:
+                raise
+            fresh = self._connect()
+            try:
+                fresh.sendall(packet)
+            except OSError:
+                # the resend itself may partially write; never cache a
+                # socket holding a truncated frame
+                fresh.close()
+                raise
+            self._sock = fresh
+            return fresh
+
     def query(self, sql: str) -> PGResult:
         """Simple-query protocol — DDL / fixed statements."""
         with self._lock:
-            sock = self._ensure()
-            try:
-                sock.sendall(_msg(b"Q", sql.encode() + b"\x00"))
-            except OSError:
-                # written nothing that reached the server: reconnect once
-                self._sock = self._connect()
-                sock = self._sock
-                sock.sendall(_msg(b"Q", sql.encode() + b"\x00"))
+            sock = self._send_retriable(
+                self._ensure(), _msg(b"Q", sql.encode() + b"\x00"))
             try:
                 return self._collect(sock)
             except (OSError, ConnectionError):
@@ -278,13 +298,7 @@ class PostgresClient:
         sync = _msg(b"S", b"")
         packet = parse + bind + describe + execute + sync
         with self._lock:
-            sock = self._ensure()
-            try:
-                sock.sendall(packet)
-            except OSError:
-                self._sock = self._connect()
-                sock = self._sock
-                sock.sendall(packet)
+            sock = self._send_retriable(self._ensure(), packet)
             try:
                 return self._collect(sock)
             except (OSError, ConnectionError):
@@ -300,6 +314,47 @@ class PostgresClient:
 
 # ---------------------------------------------------------------------------
 # MiniPostgres — embedded stand-in
+
+
+def _split_statements(sql: str) -> List[str]:
+    """Split a simple-query string on TOP-LEVEL semicolons only — a
+    ``;`` inside a ``'...'`` literal (with ``''`` escapes), a ``"..."``
+    identifier, or a ``--`` line comment is data, not a statement
+    boundary (the naive ``sql.split(';')`` corrupted such statements)."""
+    stmts: List[str] = []
+    buf: List[str] = []
+    i = 0
+    while i < len(sql):
+        ch = sql[i]
+        if ch in ("'", '"'):
+            q = ch
+            j = i + 1
+            while j < len(sql):
+                if sql[j] == q:
+                    if j + 1 < len(sql) and sql[j + 1] == q:
+                        j += 2  # doubled quote: escaped, keep scanning
+                        continue
+                    break
+                j += 1
+            buf.append(sql[i:j + 1])
+            i = j + 1
+            continue
+        if ch == "-" and sql[i:i + 2] == "--":
+            j = sql.find("\n", i)
+            j = len(sql) if j < 0 else j
+            buf.append(sql[i:j])
+            i = j
+            continue
+        if ch == ";":
+            stmts.append("".join(buf))
+            buf = []
+            i += 1
+            continue
+        buf.append(ch)
+        i += 1
+    if buf:
+        stmts.append("".join(buf))
+    return [s for s in stmts if s.strip()]
 
 
 def _translate_placeholders(sql: str) -> str:
@@ -499,8 +554,7 @@ class MiniPostgres:
                         if not sql.strip():
                             conn.sendall(_msg(b"I", b""))
                         else:
-                            for stmt in [s for s in sql.split(";")
-                                         if s.strip()]:
+                            for stmt in _split_statements(sql):
                                 self._run_sql(conn, stmt)
                         conn.sendall(_msg(b"Z", b"I"))
                     elif mtype == b"P":

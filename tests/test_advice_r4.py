@@ -1,0 +1,184 @@
+"""Regression tests for the round-4 advisor fixes (ADVICE.md round 3):
+
+1. dashboard token verify must 401 (return None), not TypeError, on a
+   presented signature with non-ASCII bytes (latin-1-decoded headers).
+2. PostgresClient must NOT resend a statement when the send failed
+   mid-stream (partial write) — only when zero bytes reached the wire.
+3. Kubewatch resumes from the newest resourceVersion DELIVERED on the
+   stream, including a trailing DELETED event's rv.
+4. MiniPostgres simple-query splitting respects semicolons inside
+   string literals.
+5. The embedmap static page leaks no store names; sources come from an
+   authenticated endpoint.
+"""
+
+import socket
+import struct
+import threading
+
+import pytest
+
+from semantic_router_tpu.dashboard.auth import TokenIssuer
+from semantic_router_tpu.state.postgres import (
+    MiniPostgres,
+    PostgresClient,
+    _split_statements,
+)
+
+
+class TestTokenVerifyNonAscii:
+    def test_non_ascii_signature_returns_none(self):
+        issuer = TokenIssuer()
+        token = issuer.issue({"viewer"})
+        head, payload, _sig = token.split(".")
+        # a latin-1-decoded header can hand verify() arbitrary chars;
+        # str compare_digest raises TypeError on non-ASCII — must be None
+        assert issuer.verify(f"{head}.{payload}.\xfc\xfe") is None
+        assert issuer.verify("a.b.\xfc") is None
+
+    def test_valid_token_still_verifies(self):
+        issuer = TokenIssuer()
+        assert issuer.verify(issuer.issue({"admin"})) == {"admin"}
+
+
+class TestPostgresPartialWriteNoResend:
+    def test_mid_stream_send_failure_surfaces(self):
+        """A socket that dies AFTER accepting bytes must not trigger a
+        blind resend (double-execution risk for non-idempotent SQL)."""
+        srv = MiniPostgres()
+        try:
+            client = PostgresClient(port=srv.port)
+            client.query("CREATE TABLE IF NOT EXISTS t (n INTEGER)")
+
+            sent = {"n": 0}
+
+            class OneByteThenDie:
+                """Accepts one byte, then raises — simulating a partial
+                write onto a half-dead connection."""
+
+                def send(self, data):
+                    if sent["n"] == 0:
+                        sent["n"] = 1
+                        return 1
+                    raise OSError("connection reset mid-write")
+
+                def sendall(self, data):
+                    raise AssertionError("resend after partial write")
+
+            with pytest.raises(OSError):
+                client._send_retriable(OneByteThenDie(), b"INSERT...")
+            # the cached socket must be dropped so the next call opens
+            # a fresh connection rather than writing to the dead one
+            assert client._sock is None
+            # and the client recovers on the next call
+            client.query("INSERT INTO t (n) VALUES (1)")
+            assert client.query("SELECT count(*) FROM t").scalar() == "1"
+        finally:
+            srv.close()
+
+    def test_zero_byte_failure_still_retries(self):
+        srv = MiniPostgres()
+        try:
+            client = PostgresClient(port=srv.port)
+            client.query("SELECT 1")
+            # kill the cached socket so the first send() raises with
+            # zero bytes delivered -> reconnect + resend is safe
+            client._sock.shutdown(socket.SHUT_RDWR)
+            assert client.query("SELECT 41 + 1").scalar() == "42"
+        finally:
+            srv.close()
+
+
+class TestMiniPostgresLiteralSemicolons:
+    def test_split_respects_literals(self):
+        assert _split_statements(
+            "INSERT INTO t VALUES ('a;b'); SELECT 1") == \
+            ["INSERT INTO t VALUES ('a;b')", " SELECT 1"]
+        assert _split_statements("SELECT 'it''s; fine'") == \
+            ["SELECT 'it''s; fine'"]
+        assert _split_statements(";;") == []
+        # '--' line comments and double-quoted identifiers hide ';' too
+        assert _split_statements(
+            "SELECT 1; -- trailing; comment\nSELECT 2") == \
+            ["SELECT 1", " -- trailing; comment\nSELECT 2"]
+        assert _split_statements('CREATE TABLE "a;b" (n INTEGER)') == \
+            ['CREATE TABLE "a;b" (n INTEGER)']
+
+    def test_round_trip_semicolon_in_string(self):
+        srv = MiniPostgres()
+        try:
+            client = PostgresClient(port=srv.port)
+            client.query("CREATE TABLE s (v TEXT); "
+                         "INSERT INTO s VALUES ('x;y;z')")
+            assert client.query("SELECT v FROM s").scalar() == "x;y;z"
+        finally:
+            srv.close()
+
+
+class TestKubewatchResumeRv:
+    def test_deleted_event_advances_resume_rv(self):
+        from semantic_router_tpu.runtime.kubewatch import KubeOperator
+
+        w = KubeOperator.__new__(KubeOperator)
+        w._state = {"intelligentpools": {}, "intelligentroutes": {}}
+        w._last_rv = {}
+        w._state_lock = threading.Lock()
+        w._dirty = threading.Event()
+        obj = {"metadata": {"namespace": "d", "name": "p",
+                            "resourceVersion": "7"}}
+        w._apply_event("intelligentpools", "ADDED", obj)
+        assert w._last_rv["intelligentpools"] == 7
+        gone = {"metadata": {"namespace": "d", "name": "p",
+                             "resourceVersion": "12"}}
+        w._apply_event("intelligentpools", "DELETED", gone)
+        # the object is gone from state but its rv must survive as the
+        # resume point — else re-watch replays events 8..12
+        assert w._state["intelligentpools"] == {}
+        assert w._last_rv["intelligentpools"] == 12
+
+
+class TestEmbedmapPageLeak:
+    def test_page_renders_without_sources(self):
+        from semantic_router_tpu.dashboard.embedmap import render_page
+
+        page = render_page(())
+        assert "<option" not in page
+        assert "/dashboard/api/embedmap/sources" in page
+
+    def test_sources_endpoint_is_gated(self, tmp_path, fixture_config_path):
+        import json
+        import urllib.error
+        import urllib.request
+
+        import yaml
+
+        from semantic_router_tpu.config import load_config
+        from semantic_router_tpu.router import Router, RouterServer
+
+        with open(fixture_config_path) as f:
+            raw = yaml.safe_load(f)
+        raw["api_server"] = {"api_keys": [
+            {"key": "sek", "roles": ["admin"]}]}
+        cfg_path = str(tmp_path / "router.yaml")
+        with open(cfg_path, "w") as f:
+            yaml.safe_dump(raw, f)
+        cfg = load_config(cfg_path)
+        router = Router(cfg, engine=None)
+        srv = RouterServer(router, cfg).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            page = urllib.request.urlopen(
+                f"{base}/dashboard/embedmap").read().decode()
+            assert "vectorstore:" not in page and "<option" not in page
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"{base}/dashboard/api/embedmap/sources")
+            assert ei.value.code in (401, 403)
+            req = urllib.request.Request(
+                f"{base}/dashboard/api/embedmap/sources",
+                headers={"x-api-key": "sek"})
+            body = json.loads(urllib.request.urlopen(req).read())
+            assert "cache" in body["sources"]
+        finally:
+            srv.stop()
+            router.shutdown()
