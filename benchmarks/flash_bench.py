@@ -291,12 +291,30 @@ def main() -> int:
     skip = set(args.skip.split(",")) if args.skip else set()
 
     if args.probe_first:
-        sys.path.insert(0, os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
-        import bench as _bench
+        # watchdogged child probe: 120s of claim patience, then refuse
+        # to attach (an init hang here would wedge THIS process too)
+        import subprocess
 
-        plat = _bench._probe_tpu(retries=1)
-        if plat is None or plat == "cpu":
+        src = ("import os,sys,threading\n"
+               "t=threading.Timer(120.0,lambda:os._exit(3))\n"
+               "t.daemon=True;t.start()\n"
+               "import jax\n"
+               "print(jax.devices()[0].platform);os._exit(0)\n")
+        proc = subprocess.Popen([sys.executable, "-u", "-c", src],
+                                stdout=subprocess.PIPE, text=True)
+        try:
+            out, _ = proc.communicate(timeout=150)
+        except subprocess.TimeoutExpired:
+            # SIGTERM only — SIGKILL on a claim-holder wedges the
+            # tunnel; the child's own timer is the real backstop
+            proc.terminate()
+            try:
+                proc.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+            out = ""
+        plat = out.strip() if proc.returncode == 0 else None
+        if plat in (None, "", "cpu"):
             sys.stderr.write("flash_bench: no healthy TPU backend; "
                              "refusing to attach\n")
             return 3

@@ -247,6 +247,10 @@ class PostgresClient:
             return sock
         except OSError:
             self._sock = None
+            try:
+                sock.close()  # don't leak the dead fd until GC
+            except OSError:
+                pass
             if sent:
                 raise
             fresh = self._connect()
