@@ -117,6 +117,33 @@ def _child_main() -> None:
     os._exit(0)
 
 
+def probe_backend(patience_s: float = 120.0) -> "str | None":
+    """Health-check the ambient backend in a watchdogged CHILD: returns
+    the platform name, or None if init didn't finish within patience.
+    The child self-destructs (os._exit) and the parent only ever
+    SIGTERMs — SIGKILL on a claim-holding process wedges the tunnel.
+    For callers that need a probe WITHOUT benching (flash_bench
+    --probe-first); bench itself claims and benches in one child."""
+    src = (
+        "import os,sys,threading\n"
+        f"t=threading.Timer({patience_s!r},lambda:os._exit(3))\n"
+        "t.daemon=True;t.start()\n"
+        "import jax\n"
+        "print(jax.devices()[0].platform);os._exit(0)\n")
+    proc = subprocess.Popen([sys.executable, "-u", "-c", src],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        out, _ = proc.communicate(timeout=patience_s + 30)
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        return None
+    return out.strip() or None if proc.returncode == 0 else None
+
+
 # ---------------------------------------------------------------------------
 # parent: attempt loop + CPU fallback
 

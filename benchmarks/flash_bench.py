@@ -291,30 +291,13 @@ def main() -> int:
     skip = set(args.skip.split(",")) if args.skip else set()
 
     if args.probe_first:
-        # watchdogged child probe: 120s of claim patience, then refuse
-        # to attach (an init hang here would wedge THIS process too)
-        import subprocess
+        # shared watchdogged child probe (bench.probe_backend): refuse
+        # to attach if init doesn't finish — an init hang here would
+        # wedge THIS process too
+        from bench import probe_backend
 
-        src = ("import os,sys,threading\n"
-               "t=threading.Timer(120.0,lambda:os._exit(3))\n"
-               "t.daemon=True;t.start()\n"
-               "import jax\n"
-               "print(jax.devices()[0].platform);os._exit(0)\n")
-        proc = subprocess.Popen([sys.executable, "-u", "-c", src],
-                                stdout=subprocess.PIPE, text=True)
-        try:
-            out, _ = proc.communicate(timeout=150)
-        except subprocess.TimeoutExpired:
-            # SIGTERM only — SIGKILL on a claim-holder wedges the
-            # tunnel; the child's own timer is the real backstop
-            proc.terminate()
-            try:
-                proc.communicate(timeout=10)
-            except subprocess.TimeoutExpired:
-                pass
-            out = ""
-        plat = out.strip() if proc.returncode == 0 else None
-        if plat in (None, "", "cpu"):
+        plat = probe_backend(120.0)
+        if plat in (None, "cpu"):
             sys.stderr.write("flash_bench: no healthy TPU backend; "
                              "refusing to attach\n")
             return 3

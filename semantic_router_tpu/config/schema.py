@@ -951,6 +951,9 @@ class InferenceEngineConfig:
     use_flash_attention: bool = True
     matryoshka_layers: List[int] = field(default_factory=list)
     matryoshka_dims: List[int] = field(default_factory=list)
+    # concurrent batch-dispatch workers: a cold XLA compile of one
+    # (task, bucket) shape must not park live traffic on warm shapes
+    dispatch_workers: int = 4
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "InferenceEngineConfig":
@@ -963,6 +966,7 @@ class InferenceEngineConfig:
             use_flash_attention=bool(d.get("use_flash_attention", True)),
             matryoshka_layers=list(d.get("matryoshka_layers", [])),
             matryoshka_dims=list(d.get("matryoshka_dims", [])),
+            dispatch_workers=int(d.get("dispatch_workers", 4)),
         )
         if d.get("seq_len_buckets"):
             out.seq_len_buckets = [int(x) for x in d["seq_len_buckets"]]

@@ -112,6 +112,7 @@ class InferenceEngine:
             max_batch_size=self.cfg.max_batch_size,
             max_wait_ms=self.cfg.max_wait_ms,
             name="tpu-engine-batcher",
+            dispatch_workers=self.cfg.dispatch_workers,
         )
         # generative decode mutates per-generator jit/cache state; one
         # generation runs on-device at a time (decode steps saturate the
