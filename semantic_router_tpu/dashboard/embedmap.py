@@ -236,11 +236,12 @@ async function load() {{
   const resp = await fetch('/dashboard/api/embedmap?source=' +
       encodeURIComponent(document.getElementById('src').value || 'cache'),
       {{headers: authHeaders()}});
-  const body = await resp.json();
-  if (!resp.ok || !body.points) {{
+  let body = null;
+  try {{ body = await resp.json(); }} catch (e) {{ body = null; }}
+  if (!resp.ok || !body || !body.points) {{
     data = null; draw();
     document.getElementById('meta').textContent =
-      body.error || ('HTTP ' + resp.status);
+      (body && body.error) || ('HTTP ' + resp.status);
     return;
   }}
   data = body;

@@ -76,6 +76,10 @@ class _DispatchPool:
     def __init__(self, workers: int, name: str) -> None:
         self._q: queue.SimpleQueue = queue.SimpleQueue()
         self._stopped = False
+        # serialises submit's check+put against shutdown's flag+drain:
+        # without it an item enqueued between the drain and the last
+        # worker's exit would neither run nor cancel (futures hang)
+        self._guard = threading.Lock()
         self._threads = []
         for i in range(max(1, workers)):
             t = threading.Thread(target=self._work, daemon=True,
@@ -84,9 +88,10 @@ class _DispatchPool:
             self._threads.append(t)
 
     def submit(self, run: Callable, cancel: Callable, *args: Any) -> None:
-        if self._stopped:
-            raise RuntimeError("dispatch pool stopped")
-        self._q.put((run, cancel, args))
+        with self._guard:
+            if self._stopped:
+                raise RuntimeError("dispatch pool stopped")
+            self._q.put((run, cancel, args))
 
     def _work(self) -> None:
         while True:
@@ -97,19 +102,28 @@ class _DispatchPool:
             (cancel if self._stopped else run)(*args)
 
     def shutdown(self) -> None:
-        self._stopped = True
-        for _ in self._threads:
-            self._q.put(None)
+        with self._guard:
+            self._stopped = True
+            for _ in self._threads:
+                self._q.put(None)
         # drain-and-cancel whatever is still queued; a worker that grabs
-        # an item after the flag also cancels, so nothing runs late
+        # an item after the flag also cancels, so nothing runs late.  The
+        # drain races the parked workers for the None sentinels above —
+        # count any it steals and re-put them, or an idle worker could
+        # block in q.get() forever.
+        stolen = 0
         while True:
             try:
                 item = self._q.get_nowait()
             except queue.Empty:
                 break
-            if item is not None:
+            if item is None:
+                stolen += 1
+            else:
                 _, cancel, args = item
                 cancel(*args)
+        for _ in range(stolen):
+            self._q.put(None)
 
 
 class DynamicBatcher:

@@ -249,3 +249,9 @@ decision_latency = default_registry.histogram(
 batch_size = default_registry.histogram(
     "llm_classifier_batch_size", "Device batch sizes",
     buckets=(1, 2, 4, 8, 16, 32, 64))
+truncated_inputs = default_registry.counter(
+    "llm_tokenizer_truncated_inputs_total",
+    "Inputs whose tail was dropped at the task's max_seq_len, by task")
+backend_failovers = default_registry.counter(
+    "llm_backend_failovers_total",
+    "Requests shed from an unreachable endpoint to a surviving one")

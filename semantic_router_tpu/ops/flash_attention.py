@@ -98,9 +98,15 @@ def flash_attention_pallas(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                            block_q: int = DEFAULT_BLOCK_Q,
                            block_k: int = DEFAULT_BLOCK_K,
                            scale: Optional[float] = None,
-                           interpret: bool = False) -> jnp.ndarray:
+                           interpret: Optional[bool] = None) -> jnp.ndarray:
     """q/k/v: [B, H, S, D]; key_padding_mask: [B, S] (1 = real token).
-    ``window``: ModernBERT-style full window width (0 = global)."""
+    ``window``: ModernBERT-style full window width (0 = global).
+    ``interpret``: None = auto (Pallas interpret mode off-TPU so the same
+    call site runs everywhere; compiled kernel on the chip).  The tunneled
+    chip registers as platform 'axon', not 'tpu' — treat both as real
+    hardware or every on-chip number would measure the interpreter."""
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
     B, H, S, D = q.shape
     if scale is None:
         scale = D ** -0.5
@@ -150,10 +156,11 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     key_padding_mask: Optional[jnp.ndarray] = None,
                     window: int = 0, causal: bool = False,
                     scale: Optional[float] = None) -> jnp.ndarray:
-    """Dispatch: Pallas kernel on TPU; JAX fallback elsewhere."""
+    """Dispatch: Pallas kernel on TPU; JAX fallback elsewhere.  The
+    tunneled chip registers as platform 'axon', not 'tpu'."""
     platform = q.devices().pop().platform if hasattr(q, "devices") else \
         jax.default_backend()
-    if platform == "tpu":
+    if platform in ("tpu", "axon"):
         return flash_attention_pallas(q, k, v, key_padding_mask,
                                       window=window, causal=causal,
                                       scale=scale)

@@ -167,5 +167,11 @@ class UpstreamPool:
                     # the server closed it idle before reading anything
                     # (the inherent keep-alive close race) — known
                     # unprocessed, safe to retry once fresh.
+                    # Callers doing endpoint failover need the same
+                    # at-most-once distinction, so it rides the exception.
+                    exc.request_delivered = True  # type: ignore[attr-defined]
                     raise
-        raise last_exc  # both attempts failed in the send phase
+        # both attempts failed in the send phase: the backend never saw a
+        # complete frame — safe for the caller to replay elsewhere
+        last_exc.request_delivered = False  # type: ignore[attr-defined]
+        raise last_exc

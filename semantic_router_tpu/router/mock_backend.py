@@ -67,6 +67,8 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path != "/v1/chat/completions":
             self._json(404, {"error": "not found"})
             return
+        with self.server.hits_lock:
+            self.server.hits += 1
         content = json.dumps(_echo_payload(body))
         usage = {"prompt_tokens": 17, "completion_tokens": 23,
                  "total_tokens": 40}
@@ -130,12 +132,22 @@ class MockVLLMServer:
         self.httpd = PooledHTTPServer(("127.0.0.1", port), _Handler,
                                       max_workers=64)
         self.httpd.model_name = model_name  # type: ignore[attr-defined]
+        # completion-request counter: weighted-endpoint/failover e2e
+        # profiles assert on traffic distribution per replica
+        self.httpd.hits = 0  # type: ignore[attr-defined]
+        self.httpd.hits_lock = threading.Lock()  # type: ignore[attr-defined]
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
     @property
     def url(self) -> str:
         return f"http://127.0.0.1:{self.port}"
+
+    @property
+    def hits(self) -> int:
+        """Completion requests this replica has served."""
+        with self.httpd.hits_lock:  # type: ignore[attr-defined]
+            return self.httpd.hits  # type: ignore[attr-defined]
 
     def start(self) -> "MockVLLMServer":
         self._thread = threading.Thread(target=self.httpd.serve_forever,

@@ -63,6 +63,8 @@ API_CATALOG = {
         {"path": "/startup-status", "method": "GET"},
         {"path": "/metrics", "method": "GET"},
         {"path": "/api/v1", "method": "GET"},
+        {"path": "/openapi.json", "method": "GET"},
+        {"path": "/docs", "method": "GET"},
         {"path": "/v1/chat/completions", "method": "POST"},
         {"path": "/v1/messages", "method": "POST"},
         {"path": "/v1/responses", "method": "POST"},
@@ -137,14 +139,32 @@ class BackendResolver:
         self._rng = np.random.default_rng(0)
 
     def resolve(self, model: str) -> str:
+        candidates = self.resolve_candidates(model)
+        return candidates[0] if candidates else ""
+
+    def resolve_candidates(self, model: str) -> list:
+        """Ordered endpoint candidates: a weighted pick first, then every
+        other configured endpoint as failover targets (the reference's
+        multi-endpoint profile pairs weighted selection with failover —
+        e2e/README.md production-stack rows; a dead replica must shed its
+        traffic to the surviving ones, not 502 its share)."""
         refs = self._by_model.get(model)
         if not refs:
-            return self.default_backend
+            return [self.default_backend] if self.default_backend else []
         if len(refs) == 1:
-            return refs[0][0]
+            return [refs[0][0]]
         weights = np.asarray([w for _, w in refs])
-        probs = weights / weights.sum()
-        return refs[int(self._rng.choice(len(refs), p=probs))][0]
+        total = weights.sum()
+        if total <= 0:
+            order = list(range(len(refs)))
+        else:
+            first = int(self._rng.choice(len(refs), p=weights / total))
+            rest = [i for i in range(len(refs)) if i != first]
+            # failover order: remaining endpoints by weight, heaviest
+            # first — deterministic, so retry behavior is predictable
+            rest.sort(key=lambda i: -refs[i][1])
+            order = [first] + rest
+        return [refs[i][0] for i in order]
 
 
 class RouterServer:
@@ -193,6 +213,10 @@ class RouterServer:
         self._imagegen_lock = threading.Lock()
 
         self.sessions = self.registry.sessions
+
+        # OpenAPI document, built once from the live catalog (lazy: the
+        # builder walks the whole _META table)
+        self._openapi_cache: Optional[Dict[str, Any]] = None
 
         # shared looper plumbing (client is stateless; pool shared across
         # requests — a per-request Looper wraps them with request state)
@@ -337,6 +361,16 @@ class RouterServer:
                 found = roles
         return found
 
+    def openapi_spec(self) -> Dict[str, Any]:
+        """OpenAPI 3.0 document derived from API_CATALOG (the dispatch
+        source of truth), built once and cached (routes_catalog.go:8-300
+        serves the same pairing of catalog + Swagger)."""
+        if self._openapi_cache is None:
+            from .openapi import build_spec
+
+            self._openapi_cache = build_spec(API_CATALOG)
+        return self._openapi_cache
+
     def _imagegen_backend(self, decision_name: str, conf: Dict[str, Any]):
         from .imagegen import build_backend
 
@@ -396,11 +430,7 @@ class RouterServer:
                  headers: Dict[str, str]) -> tuple[int, Dict[str, Any]]:
         import http.client as _hc
 
-        data = json.dumps(body).encode()
-        hdrs = {"content-type": "application/json"}
-        for k, v in headers.items():
-            if k.lower() not in _HOP_BY_HOP:
-                hdrs[k] = v
+        data, hdrs = self._prep_forward(body, headers)
         try:
             status, _, raw = self.upstream_pool.request(
                 "POST", url + "/v1/chat/completions", data, hdrs,
@@ -408,11 +438,68 @@ class RouterServer:
         except (_hc.HTTPException, TimeoutError, OSError) as e:
             return 502, {"error": {"message": f"backend unreachable: {e}",
                                    "type": "backend_error"}}
+        return self._parse_upstream(status, raw)
+
+    def _prep_forward(self, body: Dict[str, Any],
+                      headers: Dict[str, str]):
+        data = json.dumps(body).encode()
+        hdrs = {"content-type": "application/json"}
+        for k, v in headers.items():
+            if k.lower() not in _HOP_BY_HOP:
+                hdrs[k] = v
+        return data, hdrs
+
+    @staticmethod
+    def _parse_upstream(status: int, raw: bytes):
         try:
             return status, json.loads(raw or b"{}")
         except json.JSONDecodeError:
             return status, {"error": {
                 "message": raw[:300].decode(errors="replace")}}
+
+    def _forward_failover(self, model: str, body: Dict[str, Any],
+                          headers: Dict[str, str]):
+        """Forward with endpoint failover: try each candidate in the
+        resolver's order; an endpoint the request could NOT be delivered
+        to (connect refused / send-phase failure — the pool's at-most-once
+        marker, httpclient.py request_delivered) sheds to the next.
+        Response-phase failures (read timeout, reset mid-response) and
+        application-level errors do NOT fail over — the backend may have
+        executed the request, and replaying it is the caller's call, not
+        the proxy's.
+
+        Returns (status, resp, endpoint) — endpoint is "" when no
+        candidates exist."""
+        import http.client as _hc
+
+        candidates = self.resolver.resolve_candidates(model)
+        if not candidates:
+            return 502, {"error": {
+                "message": f"no backend for model {model!r}",
+                "type": "backend_error"}}, ""
+        data, hdrs = self._prep_forward(body, headers)
+        last = None
+        for i, url in enumerate(candidates):
+            try:
+                status, _, raw = self.upstream_pool.request(
+                    "POST", url + "/v1/chat/completions", data, hdrs,
+                    self.forward_timeout_s)
+            except (_hc.HTTPException, TimeoutError, OSError) as e:
+                last = (502, {"error": {
+                    "message": f"backend unreachable: {e}",
+                    "type": "backend_error"}}, url)
+                # absent marker = assume delivered (conservative: never
+                # double-execute an LLM call on ambiguity)
+                if getattr(e, "request_delivered", True):
+                    return last
+                continue
+            if i > 0:
+                from ..observability import metrics as M
+
+                M.backend_failovers.inc(model=model)
+            status, resp = self._parse_upstream(status, raw)
+            return status, resp, url
+        return last
 
     def _make_handler(self):
         server = self
@@ -650,6 +737,15 @@ class RouterServer:
                                          "uptime_s": round(
                                              time.time()
                                              - server.started_t, 1)})
+                elif path == "/openapi.json":
+                    # open like the reference's Swagger surface
+                    # (routes_catalog.go:8-300): the spec describes the
+                    # API, it holds no config or data
+                    self._json(200, server.openapi_spec())
+                elif path == "/docs":
+                    from .openapi import DOCS_HTML
+
+                    self._text(200, DOCS_HTML, "text/html")
                 else:
                     self._management_get(path)
 
@@ -1381,12 +1477,6 @@ class RouterServer:
                                            anthropic, headers)
                     return
 
-                backend = server.resolver.resolve(route.model)
-                if not backend:
-                    self._json(502, {"error": {
-                        "message": f"no backend for model {route.model!r}",
-                        "type": "backend_error"}}, route.headers)
-                    return
                 fwd_headers = dict(headers)
                 trace_id, _ = default_tracer.extract(headers)
                 default_tracer.inject(trace_id, route.request_id[:16].ljust(16, "0"),
@@ -1402,6 +1492,16 @@ class RouterServer:
                     return
 
                 if route.body.get("stream"):
+                    # streaming pins one endpoint (no mid-stream
+                    # failover); non-stream resolution lives inside
+                    # _forward_failover
+                    backend = server.resolver.resolve(route.model)
+                    if not backend:
+                        self._json(502, {"error": {
+                            "message":
+                                f"no backend for model {route.model!r}",
+                            "type": "backend_error"}}, route.headers)
+                        return
                     from ..observability.inflight import default_tracker
 
                     tok = default_tracker.begin(route.model)
@@ -1417,8 +1517,8 @@ class RouterServer:
                 t0 = time.perf_counter()
                 tok = default_tracker.begin(route.model)
                 try:
-                    status, resp = server._forward(backend, route.body,
-                                                   fwd_headers)
+                    status, resp, _ = server._forward_failover(
+                        route.model, route.body, fwd_headers)
                 finally:
                     default_tracker.end(route.model, tok)
                 latency_ms = (time.perf_counter() - t0) * 1e3
@@ -1568,12 +1668,6 @@ class RouterServer:
                     self._looper_chat(route, headers, anthropic=False,
                                       responses_request=body)
                     return
-                backend = server.resolver.resolve(route.model)
-                if not backend:
-                    self._json(502, {"error": {
-                        "message": f"no backend for model {route.model!r}",
-                        "type": "backend_error"}}, route.headers)
-                    return
                 fwd = dict(headers)
                 trace_id, _ = default_tracer.extract(headers)
                 default_tracer.inject(
@@ -1587,10 +1681,20 @@ class RouterServer:
                                route.headers)
                     return
                 if body.get("stream"):
+                    # streaming pins one endpoint; non-stream resolution
+                    # lives inside _forward_failover
+                    backend = server.resolver.resolve(route.model)
+                    if not backend:
+                        self._json(502, {"error": {
+                            "message":
+                                f"no backend for model {route.model!r}",
+                            "type": "backend_error"}}, route.headers)
+                        return
                     self._stream_responses(route, backend, fwd, body)
                     return
                 t0 = time.perf_counter()
-                status, resp = server._forward(backend, route.body, fwd)
+                status, resp, _ = server._forward_failover(
+                    route.model, route.body, fwd)
                 latency_ms = (time.perf_counter() - t0) * 1e3
                 if status == 200:
                     processed = server.router.process_response(route, resp)
@@ -2013,7 +2117,8 @@ class RouterServer:
                     results = eng.classify_batch(
                         body.get("task", "intent"), texts)
                     self._json(200, {"results": [
-                        {"label": r.label, "confidence": r.confidence}
+                        dict({"label": r.label, "confidence": r.confidence},
+                             **({"truncated": True} if r.truncated else {}))
                         for r in results]})
                     return
                 if task == "combined":
@@ -2039,14 +2144,22 @@ class RouterServer:
                 text = body.get("text", "")
                 if engine_task == "pii":
                     r = eng.token_classify(engine_task, text)
-                    self._json(200, {"entities": [e.__dict__
-                                                  for e in r.entities]})
+                    resp = {"entities": [e.__dict__ for e in r.entities]}
+                    if r.truncated:
+                        # entity scan stopped at max_seq_len: PII past
+                        # that point was NOT screened — a consumer that
+                        # treats "no entities" as "clean" must see this
+                        resp["truncated"] = True
+                    self._json(200, resp)
                 else:
                     r = eng.classify(engine_task, text)
-                    self._json(200, {"label": r.label,
-                                     "class_idx": r.index,
-                                     "confidence": r.confidence,
-                                     "probs": r.probs})
+                    resp = {"label": r.label,
+                            "class_idx": r.index,
+                            "confidence": r.confidence,
+                            "probs": r.probs}
+                    if r.truncated:
+                        resp["truncated"] = True
+                    self._json(200, resp)
 
             def _embeddings(self, body: Dict[str, Any]) -> None:
                 eng = server.router.engine

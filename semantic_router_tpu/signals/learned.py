@@ -82,8 +82,12 @@ class DomainSignal(_EngineSignal):
         out = self.engine.classify(self.task, ctx.user_text)
         rule = self._by_name.get(out.label.lower())
         if rule is not None and out.confidence >= self.threshold:
-            res.hits.append(SignalHit(rule.name, out.confidence,
-                                      {"label": out.label}))
+            detail = {"label": out.label}
+            if out.truncated:
+                # the classifier never saw the input's tail — flag the
+                # hit so downstream consumers can weigh it accordingly
+                detail["truncated"] = True
+            res.hits.append(SignalHit(rule.name, out.confidence, detail))
 
 
 class JailbreakSignal(_EngineSignal):

@@ -32,6 +32,7 @@ code in the loop.
 from __future__ import annotations
 
 import hashlib
+import re
 import socket
 import sqlite3
 import struct
@@ -249,8 +250,8 @@ class PostgresClient:
             self._sock = None
             try:
                 sock.close()  # don't leak the dead fd until GC
-            except OSError:
-                pass
+            except Exception:
+                pass  # best-effort: never mask the send failure below
             if sent:
                 raise
             fresh = self._connect()
@@ -323,13 +324,40 @@ class PostgresClient:
 def _split_statements(sql: str) -> List[str]:
     """Split a simple-query string on TOP-LEVEL semicolons only — a
     ``;`` inside a ``'...'`` literal (with ``''`` escapes), a ``"..."``
-    identifier, or a ``--`` line comment is data, not a statement
+    identifier, a ``--`` line comment, a ``/* ... */`` block comment, or
+    a ``$tag$ ... $tag$`` dollar-quoted literal is data, not a statement
     boundary (the naive ``sql.split(';')`` corrupted such statements)."""
     stmts: List[str] = []
     buf: List[str] = []
     i = 0
     while i < len(sql):
         ch = sql[i]
+        if ch == "$":
+            # dollar quoting: $$...$$ or $tag$...$tag$ (tag = word chars)
+            m = re.match(r"\$\w*\$", sql[i:])
+            if m:
+                tag = m.group(0)
+                j = sql.find(tag, i + len(tag))
+                j = len(sql) if j < 0 else j + len(tag)
+                buf.append(sql[i:j])
+                i = j
+                continue
+        if ch == "/" and sql[i:i + 2] == "/*":
+            # PG block comments NEST: scan with a depth counter
+            depth = 1
+            j = i + 2
+            while j < len(sql) and depth:
+                if sql[j:j + 2] == "/*":
+                    depth += 1
+                    j += 2
+                elif sql[j:j + 2] == "*/":
+                    depth -= 1
+                    j += 2
+                else:
+                    j += 1
+            buf.append(sql[i:j])
+            i = j
+            continue
         if ch in ("'", '"'):
             q = ch
             j = i + 1

@@ -65,6 +65,9 @@ class TestPostgresPartialWriteNoResend:
                 def sendall(self, data):
                     raise AssertionError("resend after partial write")
 
+                def close(self):
+                    pass
+
             with pytest.raises(OSError):
                 client._send_retriable(OneByteThenDie(), b"INSERT...")
             # the cached socket must be dropped so the next call opens
@@ -103,6 +106,24 @@ class TestMiniPostgresLiteralSemicolons:
             ["SELECT 1", " -- trailing; comment\nSELECT 2"]
         assert _split_statements('CREATE TABLE "a;b" (n INTEGER)') == \
             ['CREATE TABLE "a;b" (n INTEGER)']
+
+    def test_split_respects_dollar_quotes_and_block_comments(self):
+        # round-5 advisor fix: $$...$$ / $tag$...$tag$ and /* */ hide ';'
+        assert _split_statements("SELECT $$a;b$$; SELECT 1") == \
+            ["SELECT $$a;b$$", " SELECT 1"]
+        assert _split_statements("SELECT $fn$x; y$fn$") == \
+            ["SELECT $fn$x; y$fn$"]
+        assert _split_statements("SELECT 1 /* mid; comment */; SELECT 2") \
+            == ["SELECT 1 /* mid; comment */", " SELECT 2"]
+        # nested block comments (PG-specific) hide ';' at every depth
+        assert _split_statements(
+            "SELECT 1 /* a /* b */ ; still comment */; SELECT 2") == \
+            ["SELECT 1 /* a /* b */ ; still comment */", " SELECT 2"]
+        # unterminated constructs consume to EOF rather than mis-split
+        assert _split_statements("SELECT /* open; forever") == \
+            ["SELECT /* open; forever"]
+        assert _split_statements("SELECT $$never closed; here") == \
+            ["SELECT $$never closed; here"]
 
     def test_round_trip_semicolon_in_string(self):
         srv = MiniPostgres()

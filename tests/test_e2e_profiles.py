@@ -317,12 +317,94 @@ class _DynamicConfigProfile(_HTTPProfile):
         return load_config(cfg_path)
 
 
+class _MultiEndpointProfile(_HTTPProfile):
+    """multi-endpoint weighted backends (reference e2e/README.md
+    production-stack rows): one model card served by TWO replicas with
+    weights; traffic distributes, and a dead replica sheds its share to
+    the survivor instead of 502ing it."""
+
+    name = "multi-endpoint"
+
+    def start(self, fixture_path, tmp_path):
+        self.services = {}
+        self.replica_a = MockVLLMServer().start()
+        self.replica_b = MockVLLMServer().start()
+        self.services["replica-a"] = self.replica_a
+        self.services["replica-b"] = self.replica_b
+        cfg = load_config(fixture_path)
+        for card in cfg.model_cards:
+            if card.name == "qwen3-8b":
+                card.backend_refs = [
+                    {"endpoint": self.replica_a.url, "weight": 70},
+                    {"endpoint": self.replica_b.url, "weight": 30}]
+        router = build_router(cfg, engine=self.engine())
+        server = RouterServer(router, cfg).start()
+        self.router, self.server = router, server
+        return server.url
+
+
+class _ProductionStackProfile(_HTTPProfile):
+    """production-stack: TWO router instances over SHARED durable state
+    (one MiniRedis response store + one SQLite replay DB + one backend).
+    The matrix drives instance A; the failover specific kills A
+    mid-conversation and proves B serves the same threads/state
+    (reference e2e/README.md:24-52 production-stack profile)."""
+
+    name = "production-stack"
+
+    def start(self, fixture_path, tmp_path):
+        from semantic_router_tpu.state.resp import MiniRedis
+
+        self.services = {}
+        backend = MockVLLMServer().start()
+        self.services["backend"] = backend
+        redis = MiniRedis().start()
+        self.services["redis"] = redis
+
+        def make_cfg():
+            cfg = load_config(fixture_path)
+            cfg.router_replay = {"enabled": True, "backend": "sqlite",
+                                 "path": str(tmp_path / "replay.db")}
+            cfg.response_store = {"backend": "redis", "port": redis.port}
+            return cfg
+
+        self._make_cfg = make_cfg
+        self._backend = backend
+        cfg_a, cfg_b = make_cfg(), make_cfg()
+        self.router_a = build_router(cfg_a, engine=None)
+        self.router_b = build_router(cfg_b, engine=None)
+        self.server_a = RouterServer(self.router_a, cfg_a,
+                                     default_backend=backend.url).start()
+        self.server_b = RouterServer(self.router_b, cfg_b,
+                                     default_backend=backend.url).start()
+        # matrix traffic drives instance A
+        self.router, self.server = self.router_a, self.server_a
+        self._a_stopped = False
+        return self.server_a.url
+
+    def kill_a(self):
+        """Simulate losing instance A mid-traffic."""
+        self.server_a.stop()
+        self.router_a.shutdown()
+        self._a_stopped = True
+
+    def stop(self):
+        if not self._a_stopped:
+            self.server_a.stop()
+            self.router_a.shutdown()
+        self.server_b.stop()
+        self.router_b.shutdown()
+        for svc in self.services.values():
+            svc.stop()
+
+
 PROFILES = [_HTTPProfile, _DurableProfile, _EngineProfile,
             _SecuredProfile, _RecipesProfile, _ResponseAPIProfile,
                          _ResponseAPIRedisProfile, _ResponseAPIClusterProfile,
                          _StreamingProfile, _AnthropicShimProfile,
                          _AuthzRateProfile, _MLSelectionProfile,
-                         _RAGLlamaStackProfile, _DynamicConfigProfile]
+                         _RAGLlamaStackProfile, _DynamicConfigProfile,
+                         _MultiEndpointProfile, _ProductionStackProfile]
 
 
 @pytest.mark.parametrize("profile_cls", PROFILES,
@@ -554,6 +636,159 @@ class TestMLSelectionProfileSpecifics:
                 assert status == 200
                 assert headers["x-vsr-selected-decision"] == "code_route"
                 assert headers["x-vsr-selected-model"]  # fallback serves
+        finally:
+            p.stop()
+
+
+class TestMultiEndpointProfileSpecifics:
+    def test_weighted_distribution_across_replicas(self,
+                                                   fixture_config_path,
+                                                   tmp_path):
+        p = _MultiEndpointProfile()
+        p.start(fixture_config_path, tmp_path)
+        try:
+            for _ in range(40):
+                status, _, headers = p.chat("this is urgent, fix asap")
+                assert status == 200
+                assert headers["x-vsr-selected-model"] == "qwen3-8b"
+            a, b = p.replica_a.hits, p.replica_b.hits
+            assert a + b == 40
+            # 70/30 weighting: both replicas see traffic, heavier sees
+            # more (binomial p=0.3, n=40: P(b >= a) < 1e-6)
+            assert a > b > 0, (a, b)
+        finally:
+            p.stop()
+
+    def test_dead_replica_sheds_to_survivor(self, fixture_config_path,
+                                            tmp_path):
+        from semantic_router_tpu.observability import metrics as M
+
+        p = _MultiEndpointProfile()
+        p.start(fixture_config_path, tmp_path)
+        try:
+            before = M.backend_failovers.get(model="qwen3-8b")
+            p.replica_a.stop()  # the heavier replica dies
+            for _ in range(8):
+                status, body, headers = p.chat("this is urgent, fix asap")
+                assert status == 200, body  # shed, not 502
+            assert p.replica_b.hits == 8
+            assert M.backend_failovers.get(model="qwen3-8b") > before
+        finally:
+            del p.services["replica-a"]  # already stopped
+            p.stop()
+
+    def test_response_phase_failure_is_not_replayed(self,
+                                                    fixture_config_path,
+                                                    tmp_path):
+        """At-most-once: a backend that ACCEPTED the request (then died
+        mid-response) may have executed it — the proxy must surface the
+        502, never replay the completion on another replica (double LLM
+        cost / double tool side effects)."""
+        import socket
+        import threading
+
+        # replica A: accepts the connection, reads the request, closes
+        # without answering — a response-phase failure, not connect-fail
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(8)
+
+        def _run():
+            while True:
+                try:
+                    c, _ = srv.accept()
+                except OSError:
+                    return
+                try:
+                    c.recv(65536)
+                finally:
+                    c.close()
+
+        threading.Thread(target=_run, daemon=True).start()
+
+        p = _MultiEndpointProfile()
+        p.start(fixture_config_path, tmp_path)
+        try:
+            # re-point the resolver: A = the half-dead socket (always
+            # picked first via weight), B = the healthy replica
+            from semantic_router_tpu.router.server import BackendResolver
+
+            cfg = p.server.cfg
+            for card in cfg.model_cards:
+                if card.name == "qwen3-8b":
+                    card.backend_refs = [
+                        {"endpoint":
+                         f"http://127.0.0.1:{srv.getsockname()[1]}",
+                         "weight": 100},
+                        {"endpoint": p.replica_b.url, "weight": 0}]
+            p.server.resolver = BackendResolver(cfg)
+            before_b = p.replica_b.hits
+            status, body, _ = p.chat("this is urgent, fix asap")
+            assert status == 502, body
+            assert "unreachable" in body["error"]["message"]
+            assert p.replica_b.hits == before_b  # never replayed
+        finally:
+            srv.close()
+            p.stop()
+
+    def test_all_replicas_dead_surfaces_502(self, fixture_config_path,
+                                            tmp_path):
+        p = _MultiEndpointProfile()
+        p.start(fixture_config_path, tmp_path)
+        try:
+            p.replica_a.stop()
+            p.replica_b.stop()
+            status, body, _ = p.chat("this is urgent, fix asap")
+            assert status == 502
+            assert body["error"]["type"] == "backend_error"
+        finally:
+            p.services.clear()
+            p.stop()
+
+
+class TestProductionStackSpecifics:
+    def test_failover_mid_conversation_keeps_durable_state(
+            self, fixture_config_path, tmp_path):
+        """The reference's production-stack e2e: two routers over shared
+        state; killing one mid-traffic must not lose conversations or
+        replay history (e2e/README.md:24-52)."""
+        p = _ProductionStackProfile()
+        p.start(fixture_config_path, tmp_path)
+        try:
+            # start a response-API conversation on instance A
+            status, first, _ = http(p.server_a.url + "/v1/responses",
+                                    "POST", {"model": "auto",
+                                             "input": "remember: green"})
+            assert status == 200
+            # some routed traffic through A lands replay records
+            s, _, _ = http(p.server_a.url + "/v1/chat/completions", "POST",
+                           {"model": "auto", "messages": [
+                               {"role": "user",
+                                "content": "this is urgent, fix asap"}]})
+            assert s == 200
+            replay_n = len(p.router_a.replay_store)
+            assert replay_n >= 1
+
+            p.kill_a()  # instance A dies mid-conversation
+
+            # the conversation CONTINUES on instance B: the thread lives
+            # in the shared redis response store, not in A's memory
+            status, second, _ = http(
+                p.server_b.url + "/v1/responses", "POST",
+                {"model": "auto", "input": "what color?",
+                 "previous_response_id": first["id"]})
+            assert status == 200
+            echoed = json.loads(second["output"][0]["content"][0]["text"])
+            assert echoed["n_messages"] >= 3  # prior turns reached backend
+            # replay history survives too (shared sqlite)
+            assert len(p.router_b.replay_store) >= replay_n
+            # and B serves fresh traffic normally
+            s, _, hdrs = http(p.server_b.url + "/v1/chat/completions",
+                              "POST", {"model": "auto", "messages": [
+                                  {"role": "user",
+                                   "content": "this is urgent, fix asap"}]})
+            assert s == 200
+            assert hdrs["x-vsr-selected-decision"] == "urgent_route"
         finally:
             p.stop()
 

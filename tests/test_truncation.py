@@ -1,0 +1,176 @@
+"""Tokenizer truncation honesty (VERDICT r4 item 6 / weak 7).
+
+Reference: candle-binding core/tokenization.rs treats long-input handling
+as a hard part (stride/overflow modes); the failure mode being killed here
+is SILENT tail-drop — a classifier that never saw the input's tail
+reporting an unflagged result, and a PII scan that stopped at max_seq_len
+reading as "clean".
+"""
+
+import numpy as np
+import pytest
+
+from semantic_router_tpu.engine.classify import InferenceEngine
+from semantic_router_tpu.config.schema import InferenceEngineConfig
+from semantic_router_tpu.observability import metrics as M
+from semantic_router_tpu.utils.tokenization import (
+    Encoding,
+    HashTokenizer,
+    encode_windows,
+)
+
+
+class TestEncodingFlag:
+    def test_short_input_not_truncated(self):
+        enc = HashTokenizer().encode("hello world", max_length=128)
+        assert not enc.truncated
+        assert enc.n_total == len(enc)
+
+    def test_clipped_input_flagged_with_total(self):
+        text = " ".join(f"w{i}" for i in range(100))
+        enc = HashTokenizer().encode(text, max_length=16)
+        assert enc.truncated
+        assert len(enc) == 16  # 14 words + CLS + SEP
+        assert enc.n_total == 102  # 100 words + specials
+
+    def test_no_max_length_never_truncates(self):
+        text = " ".join(f"w{i}" for i in range(100))
+        enc = HashTokenizer().encode(text)
+        assert not enc.truncated
+        assert len(enc) == 102
+
+
+class TestEncodeWindows:
+    def test_short_text_single_window(self):
+        wins = encode_windows(HashTokenizer(), "a b c", 128, stride=16)
+        assert len(wins) == 1
+        assert not wins[0].truncated
+
+    def test_windows_cover_whole_text_with_overlap(self):
+        tok = HashTokenizer()
+        text = " ".join(f"w{i}" for i in range(200))
+        wins = encode_windows(tok, text, max_length=64, stride=16)
+        full = tok.encode(text)
+        body = full.ids[1:-1]  # content between CLS and SEP
+        assert all(len(w) <= 64 for w in wins)
+        assert all(w.total_tokens == len(full) for w in wins)
+        # every window is a VALID model input: CLS first, SEP last
+        # (a cls-pooled classifier must read a real [CLS] state)
+        for w in wins:
+            assert w.ids[0] == HashTokenizer.CLS
+            assert w.ids[-1] == HashTokenizer.SEP
+        # the windows' content tiles the full body in order with the
+        # requested overlap
+        step = (64 - 2) - 16  # budget minus stride
+        covered = set()
+        for k, w in enumerate(wins):
+            start = k * step
+            content = w.ids[1:-1]
+            assert content == body[start:start + len(content)]
+            covered.update(range(start, start + len(content)))
+        assert covered == set(range(len(body)))
+        # consecutive windows overlap by exactly the stride
+        assert wins[1].ids[1:17] == wins[0].ids[-17:-1]
+
+    def test_offsets_stay_absolute(self):
+        tok = HashTokenizer()
+        text = " ".join(f"w{i}" for i in range(100))
+        wins = encode_windows(tok, text, max_length=32, stride=8)
+        for w in wins[1:]:
+            real = [o for o in w.offsets if o != (0, 0)]
+            for start, end in real:
+                assert text[start:end].startswith("w")
+
+    def test_bad_stride_rejected(self):
+        long = " ".join(f"w{i}" for i in range(100))
+        with pytest.raises(ValueError):
+            encode_windows(HashTokenizer(), long, 32, stride=32)
+        # stride must leave room inside the special-token frame too
+        with pytest.raises(ValueError):
+            encode_windows(HashTokenizer(), long, 32, stride=30)
+
+
+def _tiny_engine(max_seq_len=32):
+    """Real engine + trivial mean-embedding classifier head."""
+    import jax.numpy as jnp
+    import flax.linen as nn
+
+    class Head(nn.Module):
+        n: int = 3
+
+        @nn.compact
+        def __call__(self, ids, mask):
+            emb = nn.Embed(1024, 16)(ids)
+            pooled = (emb * mask[..., None]).sum(1) / \
+                jnp.maximum(mask.sum(1, keepdims=True), 1)
+            return nn.Dense(self.n)(pooled)
+
+    import jax
+
+    eng = InferenceEngine(InferenceEngineConfig(
+        seq_len_buckets=[16, 32], max_batch_size=8, max_wait_ms=1))
+    mod = Head()
+    params = mod.init(jax.random.PRNGKey(0),
+                      jnp.ones((1, 8), jnp.int32), jnp.ones((1, 8), jnp.int32))
+    eng.register_task("intent", "sequence", mod, params,
+                      HashTokenizer(), ["a", "b", "c"],
+                      max_seq_len=max_seq_len)
+    return eng
+
+
+class TestEngineSurfacing:
+    def test_long_input_produces_flagged_result_and_metric(self):
+        """The acceptance case: a ~40K-char input classifies flagged."""
+        eng = _tiny_engine(max_seq_len=32)
+        try:
+            before = M.truncated_inputs.get(task="intent")
+            text = " ".join(f"word{i}" for i in range(5000))  # ~44K chars
+            assert len(text) > 40_000
+            out = eng.classify("intent", text)
+            assert out.truncated is True
+            assert out.label in ("a", "b", "c")
+            assert M.truncated_inputs.get(task="intent") == before + 1
+        finally:
+            eng.shutdown()
+
+    def test_short_input_unflagged_and_uncounted(self):
+        eng = _tiny_engine(max_seq_len=32)
+        try:
+            before = M.truncated_inputs.get(task="intent")
+            out = eng.classify("intent", "short request")
+            assert out.truncated is False
+            assert M.truncated_inputs.get(task="intent") == before
+        finally:
+            eng.shutdown()
+
+    def test_metric_exposed_with_reference_name(self):
+        text = M.default_registry.expose()
+        assert "llm_tokenizer_truncated_inputs_total" in text
+
+
+class TestSignalSurfacing:
+    def test_domain_hit_carries_truncated_detail(self):
+        from semantic_router_tpu.signals.base import RequestContext
+        from semantic_router_tpu.signals.learned import DomainSignal
+        from semantic_router_tpu.config.schema import DomainRule
+
+        eng = _tiny_engine(max_seq_len=32)
+        try:
+            sig = DomainSignal(eng, [DomainRule(name=l)
+                                     for l in ("a", "b", "c")],
+                               task="intent")
+            long_text = " ".join(f"word{i}" for i in range(2000))
+            ctx = RequestContext.from_openai_body({"messages": [
+                {"role": "user", "content": long_text}]})
+            res = sig.evaluate(ctx)
+            assert res.error is None
+            assert res.hits and res.hits[0].detail.get("truncated") is True
+
+            ctx2 = RequestContext.from_openai_body({"messages": [
+                {"role": "user", "content": "short"}]})
+            res2 = sig.evaluate(ctx2)
+            assert res2.error is None
+            if res2.hits:
+                assert "truncated" not in res2.hits[0].detail
+        finally:
+            eng.shutdown()
