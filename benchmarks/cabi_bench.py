@@ -1,0 +1,139 @@
+"""C-ABI seam microbenchmark driver (VERDICT r4 item 8).
+
+The reference's FFI surface is in-proc C structs over CGo
+(candle-binding/semantic-router.go:27-550) — a function call.  Our ABI
+(native/srt_client.{h,cpp}) is a localhost TCP hop into the router's
+management API; this driver measures what that hop actually costs:
+
+  * transport-only round trip (srt_is_initialized -> GET /health)
+  * full classify round trip (srt_classify_text -> POST classify/intent)
+
+at 1/8/32 concurrent C callers, from a compiled C harness
+(native/srt_client_bench.c) whose process contains no Python.  Results
+land in benchmarks/results/cabi_latest.json; the question the numbers
+answer: does the seam fit inside the reference's <=2 ms added-p99 budget
+(bench/cpu-vs-gpu/README.md:94-100)?
+
+Run: python benchmarks/cabi_bench.py  (CPU is fine — the seam under test
+is host-side transport, not device math).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RESULTS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
+
+
+def _tiny_engine():
+    import jax
+    import jax.numpy as jnp
+
+    from semantic_router_tpu.config.schema import InferenceEngineConfig
+    from semantic_router_tpu.engine.classify import InferenceEngine
+    from semantic_router_tpu.models.modernbert import (
+        ModernBertConfig,
+        ModernBertForSequenceClassification,
+    )
+    from semantic_router_tpu.utils.tokenization import HashTokenizer
+
+    mcfg = ModernBertConfig(hidden_size=64, intermediate_size=128,
+                            num_hidden_layers=2, num_attention_heads=4,
+                            vocab_size=1024, pad_token_id=0, num_labels=4)
+    eng = InferenceEngine(InferenceEngineConfig(
+        max_batch_size=32, max_wait_ms=1.0, seq_len_buckets=[32]))
+    seq = ModernBertForSequenceClassification(mcfg)
+    eng.register_task("intent", "sequence", seq,
+                      seq.init(jax.random.PRNGKey(0),
+                               jnp.ones((1, 8), jnp.int32)),
+                      HashTokenizer(vocab_size=1024),
+                      ["law", "code", "health", "other"], max_seq_len=32)
+    return eng
+
+
+def main() -> int:
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+    from semantic_router_tpu.config import load_config
+    from semantic_router_tpu.native.build import (
+        CLIENT_BENCH_OUT,
+        build_client_bench,
+    )
+    from semantic_router_tpu.router import Router, RouterServer
+
+    build_client_bench(verbose=False)
+
+    fixture = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "fixtures",
+        "router_config.yaml")
+    cfg = load_config(fixture)
+    engine = _tiny_engine()
+    router = Router(cfg, engine=engine)
+    server = RouterServer(router, cfg).start()
+    report = {
+        "what": "C-ABI seam round-trip cost (srt_client TCP hop vs the "
+                "reference's in-proc CGo structs, semantic-router.go:27-550)",
+        "engine": "2-layer/64-dim ModernBERT intent head on CPU "
+                  "(the seam under test is transport, not device math)",
+        "caveat": f"host has {os.cpu_count()} CPU core(s): the "
+                  "high-concurrency rows measure core saturation/queuing "
+                  "on top of the seam, not the seam itself — the "
+                  "single-caller transport row is the seam's cost",
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "rows": [],
+    }
+    # pre-compile every pow2 batch shape concurrency can produce: the
+    # seam bench measures TRANSPORT, and a one-time XLA compile landing
+    # inside a measured call would report as a ~1s p99 that no warm
+    # deployment ever sees
+    for n in (1, 2, 4, 8, 16, 32):
+        engine.classify_batch("intent", ["warm the batch shapes"] * n)
+    try:
+        for mode, iters in (("health", 300), ("classify", 150)):
+            for threads in (1, 8, 32):
+                out = subprocess.run(
+                    [CLIENT_BENCH_OUT, "127.0.0.1", str(server.port),
+                     mode, str(threads), str(iters)],
+                    capture_output=True, text=True, timeout=600)
+                if out.returncode != 0:
+                    sys.stderr.write(f"bench {mode}/{threads} failed: "
+                                     f"{out.stderr}\n")
+                    return 1
+                row = json.loads(out.stdout.strip())
+                report["rows"].append(row)
+                sys.stderr.write(f"{mode} t={threads}: p50={row['p50_us']:.0f}us "
+                                 f"p99={row['p99_us']:.0f}us "
+                                 f"{row['calls_per_s']:.0f}/s\n")
+    finally:
+        server.stop()
+        router.shutdown()
+        engine.shutdown()
+
+    # the verdict's question, answered in the artifact itself
+    transport = [r for r in report["rows"] if r["mode"] == "health"]
+    p99_1 = next(r["p99_us"] for r in transport if r["threads"] == 1)
+    report["seam_summary"] = {
+        "transport_p99_us_single_caller": p99_1,
+        "fits_2ms_added_p99_budget": p99_1 < 2000.0,
+    }
+    os.makedirs(RESULTS, exist_ok=True)
+    path = os.path.join(RESULTS, "cabi_latest.json")
+    with open(path, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report["seam_summary"]))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

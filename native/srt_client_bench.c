@@ -1,0 +1,123 @@
+/* srt_client_bench.c — microbenchmark of the C-ABI seam's round-trip
+ * cost (VERDICT r4 item 8).
+ *
+ * The reference's FFI is in-proc C structs over CGo
+ * (candle-binding/semantic-router.go:27-550 — a function call, no
+ * transport). This shim is a localhost TCP hop (srt_client.h explains
+ * why that is the TPU-correct process model); this harness puts a NUMBER
+ * on that design decision: per-call p50/p99 at 1/8/32 concurrent C
+ * threads, for both the pure transport (GET /health — srt_is_initialized)
+ * and a real classify (POST /api/v1/classify/<task>).
+ *
+ * Usage: srt_client_bench HOST PORT MODE THREADS ITERS
+ *   MODE = health | classify
+ * Prints one JSON line with latency percentiles + aggregate throughput.
+ */
+#define _POSIX_C_SOURCE 200809L /* clock_gettime under -std=c11 */
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+#include "srt_client.h"
+
+typedef struct {
+  int iters;
+  int is_classify;
+  double* lat_us; /* [iters] */
+} worker_arg;
+
+static double now_us(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1e6 + ts.tv_nsec * 1e-3;
+}
+
+static void* worker(void* argp) {
+  worker_arg* a = (worker_arg*)argp;
+  for (int i = 0; i < a->iters; i++) {
+    double t0 = now_us();
+    if (a->is_classify) {
+      SrtClassResult r =
+          srt_classify_text("intent", "benchmark the ffi seam latency");
+      if (r.class_idx < 0) {
+        fprintf(stderr, "classify error at iter %d\n", i);
+        exit(2);
+      }
+      srt_free_class_result(r);
+    } else {
+      if (!srt_is_initialized()) {
+        fprintf(stderr, "health error at iter %d\n", i);
+        exit(2);
+      }
+    }
+    a->lat_us[i] = now_us() - t0;
+  }
+  return NULL;
+}
+
+static int cmp_double(const void* x, const void* y) {
+  double a = *(const double*)x, b = *(const double*)y;
+  return (a > b) - (a < b);
+}
+
+static double pct(double* sorted, int n, double p) {
+  int idx = (int)(p * (n - 1) + 0.5);
+  if (idx < 0) idx = 0;
+  if (idx >= n) idx = n - 1;
+  return sorted[idx];
+}
+
+int main(int argc, char** argv) {
+  if (argc != 6) {
+    fprintf(stderr, "usage: %s HOST PORT health|classify THREADS ITERS\n",
+            argv[0]);
+    return 1;
+  }
+  const char* host = argv[1];
+  int port = atoi(argv[2]);
+  int is_classify = strcmp(argv[3], "classify") == 0;
+  int threads = atoi(argv[4]);
+  int iters = atoi(argv[5]);
+  if (threads < 1 || iters < 1) return 1;
+
+  if (!srt_init(host, port, NULL)) {
+    fprintf(stderr, "srt_init failed\n");
+    return 1;
+  }
+  /* warmup: first calls pay jit compile / connection setup */
+  for (int i = 0; i < 3; i++) {
+    if (is_classify) {
+      SrtClassResult r = srt_classify_text("intent", "warmup");
+      srt_free_class_result(r);
+    } else {
+      srt_is_initialized();
+    }
+  }
+
+  pthread_t* tids = malloc(sizeof(pthread_t) * threads);
+  worker_arg* args = malloc(sizeof(worker_arg) * threads);
+  double t_start = now_us();
+  for (int t = 0; t < threads; t++) {
+    args[t].iters = iters;
+    args[t].is_classify = is_classify;
+    args[t].lat_us = malloc(sizeof(double) * iters);
+    pthread_create(&tids[t], NULL, worker, &args[t]);
+  }
+  for (int t = 0; t < threads; t++) pthread_join(tids[t], NULL);
+  double wall_s = (now_us() - t_start) * 1e-6;
+
+  int n = threads * iters;
+  double* all = malloc(sizeof(double) * n);
+  for (int t = 0; t < threads; t++)
+    memcpy(all + t * iters, args[t].lat_us, sizeof(double) * iters);
+  qsort(all, n, sizeof(double), cmp_double);
+
+  printf("{\"mode\": \"%s\", \"threads\": %d, \"iters_per_thread\": %d, "
+         "\"p50_us\": %.1f, \"p90_us\": %.1f, \"p99_us\": %.1f, "
+         "\"max_us\": %.1f, \"calls_per_s\": %.1f}\n",
+         argv[3], threads, iters, pct(all, n, 0.50), pct(all, n, 0.90),
+         pct(all, n, 0.99), all[n - 1], n / wall_s);
+  return 0;
+}

@@ -17,6 +17,8 @@ CLIENT_SRC = os.path.join(NATIVE, "srt_client.cpp")
 CLIENT_OUT = os.path.join(HERE, "libsrt_client.so")
 CLIENT_TEST_SRC = os.path.join(NATIVE, "srt_client_test.c")
 CLIENT_TEST_OUT = os.path.join(HERE, "srt_client_test")
+CLIENT_BENCH_SRC = os.path.join(NATIVE, "srt_client_bench.c")
+CLIENT_BENCH_OUT = os.path.join(HERE, "srt_client_bench")
 
 
 def build(verbose: bool = True) -> str:
@@ -44,6 +46,19 @@ def build_client(verbose: bool = True, with_test: bool = True) -> str:
             print(" ".join(cmd))
         subprocess.run(cmd, check=True)
     return CLIENT_OUT
+
+
+def build_client_bench(verbose: bool = True) -> str:
+    """The C microbenchmark of the ABI's round-trip cost (the seam the
+    reference implements as in-proc CGo structs)."""
+    build_client(verbose=verbose, with_test=False)
+    cmd = ["gcc", "-O2", "-std=c11", "-I", NATIVE, CLIENT_BENCH_SRC,
+           "-o", CLIENT_BENCH_OUT, "-L", HERE, "-lsrt_client",
+           "-lpthread", "-lm", f"-Wl,-rpath,{HERE}"]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True)
+    return CLIENT_BENCH_OUT
 
 
 if __name__ == "__main__":
