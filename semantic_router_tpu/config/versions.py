@@ -109,6 +109,16 @@ class ConfigVersionStore:
             yaml.safe_dump(raw, f, sort_keys=False)
         os.replace(tmp, self.config_path)
 
+    def write_live_text(self, text: str) -> None:
+        """Atomic VERBATIM write: the dashboard editor deploys the
+        operator's exact text — re-serializing through safe_dump would
+        strip every comment and reorder keys, and each snapshot after
+        that would propagate the stripped file."""
+        tmp = self.config_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, self.config_path)
+
     def rollback(self, version_id: str) -> bool:
         text = self.get(version_id)
         if text is None:

@@ -296,6 +296,22 @@ _META: Dict[tuple, Dict[str, Any]] = {
         "tag": "dashboard",
         "summary": "Trace one request through the full pipeline without "
                    "forwarding it."},
+    ("GET", "/dashboard/api/config/raw"): {
+        "tag": "dashboard",
+        "summary": "The on-disk config YAML + stored versions (the "
+                   "editor's source of truth; env placeholders "
+                   "unresolved)."},
+    ("POST", "/dashboard/api/config/validate"): {
+        "tag": "dashboard",
+        "summary": "Server-side dry validation of editor YAML — parse, "
+                   "schema, semantic checks; nothing written."},
+    ("POST", "/dashboard/api/config/deploy"): {
+        "tag": "dashboard",
+        "summary": "Deploy editor YAML through the same "
+                   "snapshot-then-write path as PUT /config/router."},
+    ("GET", "/dashboard/static/{asset}"): {
+        "tag": "dashboard", "summary": "Dashboard page assets (js/css).",
+        "open": True, "html": True},
 }
 
 _TAG_ORDER = ["inference", "classify", "embeddings", "config", "memory",

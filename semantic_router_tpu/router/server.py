@@ -116,6 +116,10 @@ API_CATALOG = {
         {"path": "/dashboard/api/playground", "method": "POST"},
         {"path": "/dashboard/api/dsl/compile", "method": "POST"},
         {"path": "/dashboard/api/dsl/decompile", "method": "POST"},
+        {"path": "/dashboard/api/config/raw", "method": "GET"},
+        {"path": "/dashboard/api/config/validate", "method": "POST"},
+        {"path": "/dashboard/api/config/deploy", "method": "POST"},
+        {"path": "/dashboard/static/{asset}", "method": "GET"},
     ],
 }
 
@@ -720,6 +724,27 @@ class RouterServer:
                             self._text(200, f.read(), "text/html")
                     except (OSError, ValueError):
                         self._json(404, {"error": "dashboard not bundled"})
+                elif path.startswith("/dashboard/static/"):
+                    # page assets (split out of index.html): OPEN like
+                    # the page itself — they hold code, not data.
+                    # basename() + extension allowlist kills traversal.
+                    import os
+
+                    name = os.path.basename(path)
+                    ext = os.path.splitext(name)[1]
+                    ctypes_by_ext = {".js": "text/javascript",
+                                     ".css": "text/css"}
+                    if ext not in ctypes_by_ext:
+                        self._json(404, {"error": "not found"})
+                        return
+                    asset = os.path.join(os.path.dirname(
+                        os.path.dirname(os.path.abspath(__file__))),
+                        "dashboard", "static", name)
+                    try:
+                        with open(asset, encoding="utf-8") as f:
+                            self._text(200, f.read(), ctypes_by_ext[ext])
+                    except (OSError, ValueError):
+                        self._json(404, {"error": "not found"})
                 elif path == "/dashboard/embedmap":
                     # static canvas page (wizmap role); the page is
                     # served EMPTY — store names and data both come from
@@ -895,6 +920,34 @@ class RouterServer:
                         if self._authorize() is None:
                             return
                         self._playground(body)
+                    elif path == "/dashboard/api/config/validate":
+                        # dry validation for the editor: parse + schema +
+                        # semantic checks, NOTHING written (the deploy
+                        # button goes through _config_apply's snapshot
+                        # path). View-gated: it inspects nothing live.
+                        if self._authorize() is None:
+                            return
+                        self._config_validate(body)
+                    elif path == "/dashboard/api/config/deploy":
+                        # same gate + same apply path as PUT
+                        # /config/router — the editor adds YAML-in
+                        # convenience, not a second write path
+                        if self._authorize(write=True,
+                                           action="config_put") is None:
+                            return
+                        if server.version_store is None:
+                            self._json(503, {"error": "no config path "
+                                                      "configured"})
+                            return
+                        text = str(body.get("yaml", ""))
+                        doc, err = self._parse_yaml_mapping(text)
+                        if err is not None:
+                            self._json(400, {"error": {"message": err}})
+                            return
+                        # raw_text: the operator's exact YAML lands on
+                        # disk — comments and ordering survive
+                        self._config_apply(doc, merge=False,
+                                           raw_text=text)
                     elif path == "/dashboard/api/dsl/compile":
                         # the DSL editor backend (reference: the WASM
                         # browser build of the compiler, cmd/wasm —
@@ -1111,6 +1164,41 @@ class RouterServer:
                             server.cfg.used_signal_types(),
                         "config": redact_config(server.cfg.raw),
                     })
+                elif sub == "config/raw":
+                    # the editor's source of truth: the ON-DISK document
+                    # (env placeholders unresolved — never the live
+                    # cfg.raw, whose ${VAR}s are resolved secrets).
+                    # The raw file can hold INLINE secrets the redacted
+                    # view masks, so this carries the same secret_view
+                    # gate as GET /config/router's unredacted path —
+                    # write access alone must not downgrade it.
+                    raw_roles = self._authorize(write=True,
+                                                action="config_raw")
+                    if raw_roles is None:
+                        return
+                    if server.api_keys and not (
+                            {"secret_view", "admin"} & raw_roles):
+                        self._json(403, {"error":
+                                         "config_raw requires the "
+                                         "secret_view role"})
+                        return
+                    if server.version_store is None:
+                        self._json(503, {"error": "no config path "
+                                                  "configured"})
+                        return
+                    try:
+                        with open(server.version_store.config_path) as f:
+                            text = f.read()
+                    except OSError as exc:
+                        self._json(500, {"error": str(exc)})
+                        return
+                    self._json(200, {
+                        "yaml": text,
+                        "path": server.version_store.config_path,
+                        "versions": [
+                            {"id": v.version_id, "created": v.created_t,
+                             "hash": v.hash}
+                            for v in server.version_store.list()]})
                 else:
                     self._json(404, {"error": "not found"})
 
@@ -1233,11 +1321,50 @@ class RouterServer:
                 except json.JSONDecodeError:
                     self._json(400, {"error": {"message": "invalid JSON"}})
                     return
+                self._config_apply(patch, merge)
+
+            @staticmethod
+            def _parse_yaml_mapping(text: str):
+                """(doc, error): the ONE place editor/deploy YAML text
+                becomes a config mapping."""
+                import yaml as _yaml
+
+                try:
+                    doc = _yaml.safe_load(text) or {}
+                except _yaml.YAMLError as exc:
+                    return None, f"YAML: {exc}"[:500]
+                if not isinstance(doc, dict):
+                    return None, "config must be a mapping"
+                return doc, None
+
+            @staticmethod
+            def _resolve_and_validate(doc: Dict[str, Any], env=None):
+                """(candidate, fatal, warnings) — the ONE resolve →
+                schema → semantic-check sequence (raises on parse/schema
+                failure; callers surface it)."""
                 import yaml as _yaml
 
                 from ..config.loader import substitute_env
                 from ..config.schema import RouterConfig as RC
                 from ..config.validator import validate_config
+
+                resolved = _yaml.safe_load(substitute_env(
+                    _yaml.safe_dump(doc), env)) or {}
+                candidate = RC.from_dict(resolved)
+                findings = validate_config(candidate)
+                return (candidate,
+                        [str(e) for e in findings if e.fatal],
+                        [str(e) for e in findings if not e.fatal])
+
+            def _config_apply(self, patch: Dict[str, Any], merge: bool,
+                              raw_text: "Optional[str]" = None) -> None:
+                """Validate-snapshot-write a config document (shared by
+                PATCH/PUT /config/router and the dashboard editor's
+                deploy).  raw_text (deploy only, with merge=False): the
+                operator's exact YAML text, written verbatim so comments
+                and key order survive the round trip."""
+                import yaml as _yaml
+
                 from ..config.versions import config_hash, deep_merge
 
                 # CRITICAL: merge into the ON-DISK (pre-env-substitution)
@@ -1260,11 +1387,7 @@ class RouterServer:
                     try:
                         # validate the config as it will actually load
                         # (env placeholders substituted)
-                        resolved = _yaml.safe_load(substitute_env(
-                            _yaml.safe_dump(new_raw))) or {}
-                        candidate = RC.from_dict(resolved)
-                        fatal = [str(e) for e in validate_config(candidate)
-                                 if e.fatal]
+                        _, fatal, _w = self._resolve_and_validate(new_raw)
                     except Exception as exc:
                         self._json(400, {"error": {
                             "message": f"invalid config: {exc}"}})
@@ -1275,13 +1398,52 @@ class RouterServer:
                             "details": fatal}})
                         return
                     version = server.version_store.snapshot()
-                    server.version_store.write_live(new_raw)
+                    if raw_text is not None and not merge:
+                        server.version_store.write_live_text(raw_text)
+                    else:
+                        server.version_store.write_live(new_raw)
                 self._json(200, {"applied": True,
                                  "backup_version": version.version_id,
                                  "hash": config_hash(new_raw),
                                  "note": "hot-reload watcher applies the "
                                          "new config within its poll "
                                          "interval"})
+
+            def _config_validate(self, body: Dict[str, Any]) -> None:
+                """Server-side dry validation of editor YAML: the same
+                parse → substitute → schema → semantic-check sequence
+                _config_apply runs, minus the write.
+
+                SECURITY: substitution runs against an EMPTY environment
+                (${VAR} → its default, else "") — the real os.environ
+                holds secrets, and a validate response that echoed
+                resolved values (decision/model names, error messages)
+                would hand them to any view-role key, bypassing the
+                secret_view gate on GET /config/router.  Deploy still
+                resolves the real env inside _config_apply."""
+                from ..config.versions import config_hash
+
+                doc, err = self._parse_yaml_mapping(
+                    str(body.get("yaml", "")))
+                if err is not None:
+                    self._json(200, {"ok": False, "errors": [err]})
+                    return
+                try:
+                    candidate, fatal, warnings = \
+                        self._resolve_and_validate(doc, env={})
+                except Exception as exc:
+                    self._json(200, {"ok": False, "errors":
+                                     [f"{type(exc).__name__}: {exc}"
+                                      [:500]]})
+                    return
+                self._json(200, {
+                    "ok": not fatal,
+                    "errors": fatal,
+                    "warnings": warnings,
+                    "hash": config_hash(doc),
+                    "decisions": [d.name for d in candidate.decisions],
+                    "models": [m.name for m in candidate.model_cards],
+                })
 
             def _config_rollback(self, body: Dict[str, Any]) -> None:
                 if server.version_store is None:
