@@ -91,10 +91,15 @@ class _Payload:
 class InferenceEngine:
     """Owner of all TPU-served classifier tasks + the batching shim."""
 
-    def __init__(self, cfg: Optional[InferenceEngineConfig] = None) -> None:
+    def __init__(self, cfg: Optional[InferenceEngineConfig] = None,
+                 metrics=None, events=None) -> None:
         self.cfg = cfg or InferenceEngineConfig()
         self._tasks: Dict[str, _Task] = {}
         self._lock = threading.Lock()
+        # instance-routable observability (pkg/routerruntime decoupling):
+        # None = the process defaults (single-engine posture)
+        self._metrics = metrics
+        self._events = events
 
         # serving-side sharded classifier bank (SURVEY §2.4 north-star
         # layout: pjit-sharded bank over a slice): engine.mesh_shape
@@ -354,8 +359,9 @@ class InferenceEngine:
         """Model-runtime lifecycle event (pkg/modelruntime role)."""
         from ..runtime.events import TASK_REGISTERED, default_bus
 
-        default_bus.emit(TASK_REGISTERED, task=name, kind=kind,
-                         sharded=self.mesh is not None)
+        bus = self._events if self._events is not None else default_bus
+        bus.emit(TASK_REGISTERED, task=name, kind=kind,
+                 sharded=self.mesh is not None)
 
     def _shard_generator_params(self, generator) -> None:
         """Generator-backed tasks (generative KV decode, multimodal
@@ -382,9 +388,11 @@ class InferenceEngine:
                 None, None, 0, generator=embedder)
         self._emit_registered(name, "multimodal")
 
-    def embed_multimodal(self, task: str, texts=None,
-                         images=None) -> Dict[str, np.ndarray]:
+    def embed_multimodal(self, task: str, texts=None, images=None,
+                         image_refs=None) -> Dict[str, np.ndarray]:
         """Embed texts and/or images into the task's shared space.
+        ``images`` are preprocessed float arrays; ``image_refs`` are
+        wire-format references (data URIs / base64) decoded host-side.
         Returns {"text": [n, d], "image": [m, d]} (present keys only);
         cross-modal similarity is the dot product."""
         t = self._require(task, kind="multimodal")
@@ -393,6 +401,8 @@ class InferenceEngine:
             out["text"] = t.generator.embed_text(list(texts))
         if images is not None and len(images):
             out["image"] = t.generator.embed_image(images)
+        elif image_refs:
+            out["image"] = t.generator.embed_image_refs(list(image_refs))
         return out
 
     def register_generative(self, name: str, generator,
@@ -579,14 +589,16 @@ class InferenceEngine:
                 f"task {task!r} is a {t.kind} task; use {right_call}()")
         return t
 
-    @staticmethod
-    def _note_truncation(task: str, enc: Encoding) -> None:
+    def _note_truncation(self, task: str, enc: Encoding) -> None:
         """Count every clipped input (llm_tokenizer_truncated_inputs_total)
         so tail-drop is an operator-visible rate, not a silent default."""
         if enc.truncated:
-            from ..observability import metrics as M
+            series = self._metrics
+            if series is None:
+                from ..observability import metrics as M
 
-            M.truncated_inputs.inc(task=task)
+                series = M.default_series
+            series.truncated_inputs.inc(task=task)
 
     def _submit_texts(self, task: str, texts: Sequence[str]):
         t = self._require(task, kind="sequence")

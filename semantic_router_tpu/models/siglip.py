@@ -285,6 +285,52 @@ class SiglipEmbedder:
         return np.asarray(jax.device_get(
             self._embed_image(self.params, px)), np.float32)
 
+    def embed_image_refs(self, refs) -> np.ndarray:
+        """Wire-format image references (data URIs / base64, the shapes
+        OpenAI image_url parts carry) → embeddings: decode, preprocess
+        to this tower's resolution, embed.  The image-modality routing
+        path (reference multimodal-routing e2e profile) enters here."""
+        imgs = np.stack([
+            preprocess_image(decode_image_ref(r),
+                             self.vision_config.image_size)
+            for r in refs])
+        return self.embed_image(imgs)
+
+
+def decode_image_ref(ref: str) -> np.ndarray:
+    """Decode a wire image reference into a uint8 HWC array.
+
+    Accepts ``data:image/<fmt>;base64,<payload>`` URIs (the in-band
+    shape OpenAI multimodal messages carry) and bare base64 payloads.
+    Remote http(s) URLs are refused: the router runs with no egress
+    assumption, and fetching attacker-controlled URLs from the routing
+    hot path would be SSRF (the reference's multimodal profile feeds
+    data URIs for the same reason)."""
+    import base64
+    import io
+
+    if ref.startswith("http://") or ref.startswith("https://"):
+        raise ValueError("remote image URLs are not fetched by the "
+                         "router; send a data: URI")
+    if ref.startswith("data:"):
+        head, sep, payload = ref.partition(",")
+        if not sep:
+            raise ValueError("malformed data: URI (no comma before the "
+                             "payload)")
+        if "base64" in head:
+            raw = base64.b64decode(payload, validate=False)
+        else:
+            # RFC 2397 non-base64 data URIs carry percent-encoded bytes
+            from urllib.parse import unquote_to_bytes
+
+            raw = unquote_to_bytes(payload)
+    else:
+        raw = base64.b64decode(ref, validate=False)
+    from PIL import Image
+
+    with Image.open(io.BytesIO(raw)) as im:
+        return np.asarray(im.convert("RGB"), np.uint8)
+
 
 def preprocess_image(img: np.ndarray, image_size: int,
                      mean: float = 0.5, std: float = 0.5) -> np.ndarray:

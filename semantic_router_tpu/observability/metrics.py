@@ -218,40 +218,84 @@ class MetricsRegistry:
 # registry behind :9190)
 default_registry = MetricsRegistry()
 
-# canonical series (names match the reference's metrics.go)
-model_requests = default_registry.counter(
-    "llm_model_requests_total", "Requests routed per model")
-model_cost = default_registry.counter(
-    "llm_model_cost_total", "Accumulated cost per model (USD)")
-completion_latency = default_registry.histogram(
-    "llm_model_completion_latency_seconds", "End-to-end completion latency")
-ttft = default_registry.histogram(
-    "llm_model_ttft_seconds", "Time to first token")
-tpot = default_registry.histogram(
-    "llm_model_tpot_seconds", "Time per output token")
-routing_latency = default_registry.histogram(
-    "llm_model_routing_latency_seconds", "Added routing latency")
-pii_violations = default_registry.counter(
-    "llm_pii_violations_total", "PII policy violations detected")
-jailbreak_blocks = default_registry.counter(
-    "llm_jailbreak_blocked_total", "Requests blocked by jailbreak screen")
-hallucination_latency = default_registry.histogram(
-    "llm_hallucination_detection_latency_seconds",
-    "Hallucination detection latency")
-cache_lookups = default_registry.counter(
-    "llm_cache_lookups_total", "Semantic cache lookups by outcome")
-signal_latency = default_registry.histogram(
-    "llm_signal_latency_seconds", "Per-family signal extraction latency")
-decision_matches = default_registry.counter(
-    "llm_decision_matches_total", "Decision matches by name")
-decision_latency = default_registry.histogram(
-    "llm_decision_evaluation_seconds", "Decision engine latency")
-batch_size = default_registry.histogram(
-    "llm_classifier_batch_size", "Device batch sizes",
-    buckets=(1, 2, 4, 8, 16, 32, 64))
-truncated_inputs = default_registry.counter(
-    "llm_tokenizer_truncated_inputs_total",
-    "Inputs whose tail was dropped at the task's max_seq_len, by task")
-backend_failovers = default_registry.counter(
-    "llm_backend_failovers_total",
-    "Requests shed from an unreachable endpoint to a surviving one")
+
+class MetricSeries:
+    """The canonical series (names match the reference's metrics.go)
+    bound to ONE registry.
+
+    pkg/routerruntime decoupling: the in-process emitters (Router via
+    its ``metrics`` param, the engine via InferenceEngine(metrics=...))
+    take a MetricSeries instead of writing to module singletons, so two
+    router instances embedded in one process can each bind their own
+    registry — traffic through A never shows in B's /metrics.  The
+    extproc gRPC front is one-per-process by design and still counts on
+    the default registry.  Construction is idempotent per registry
+    (get-or-create by name); ``default_series`` is the single-router/dev
+    posture."""
+
+    def __init__(self, registry: MetricsRegistry) -> None:
+        self.registry = registry
+        self.model_requests = registry.counter(
+            "llm_model_requests_total", "Requests routed per model")
+        self.model_cost = registry.counter(
+            "llm_model_cost_total", "Accumulated cost per model (USD)")
+        self.completion_latency = registry.histogram(
+            "llm_model_completion_latency_seconds",
+            "End-to-end completion latency")
+        self.ttft = registry.histogram(
+            "llm_model_ttft_seconds", "Time to first token")
+        self.tpot = registry.histogram(
+            "llm_model_tpot_seconds", "Time per output token")
+        self.routing_latency = registry.histogram(
+            "llm_model_routing_latency_seconds", "Added routing latency")
+        self.pii_violations = registry.counter(
+            "llm_pii_violations_total", "PII policy violations detected")
+        self.jailbreak_blocks = registry.counter(
+            "llm_jailbreak_blocked_total",
+            "Requests blocked by jailbreak screen")
+        self.hallucination_latency = registry.histogram(
+            "llm_hallucination_detection_latency_seconds",
+            "Hallucination detection latency")
+        self.cache_lookups = registry.counter(
+            "llm_cache_lookups_total",
+            "Semantic cache lookups by outcome")
+        self.signal_latency = registry.histogram(
+            "llm_signal_latency_seconds",
+            "Per-family signal extraction latency")
+        self.decision_matches = registry.counter(
+            "llm_decision_matches_total", "Decision matches by name")
+        self.decision_latency = registry.histogram(
+            "llm_decision_evaluation_seconds", "Decision engine latency")
+        self.batch_size = registry.histogram(
+            "llm_classifier_batch_size", "Device batch sizes",
+            buckets=(1, 2, 4, 8, 16, 32, 64))
+        self.truncated_inputs = registry.counter(
+            "llm_tokenizer_truncated_inputs_total",
+            "Inputs whose tail was dropped at the task's max_seq_len, "
+            "by task")
+        self.backend_failovers = registry.counter(
+            "llm_backend_failovers_total",
+            "Requests shed from an unreachable endpoint to a surviving "
+            "one")
+
+
+default_series = MetricSeries(default_registry)
+
+# module-level aliases: the single-router posture and back-compat for
+# existing `M.<series>` reads (same objects as default_series.<name>)
+model_requests = default_series.model_requests
+model_cost = default_series.model_cost
+completion_latency = default_series.completion_latency
+ttft = default_series.ttft
+tpot = default_series.tpot
+routing_latency = default_series.routing_latency
+pii_violations = default_series.pii_violations
+jailbreak_blocks = default_series.jailbreak_blocks
+hallucination_latency = default_series.hallucination_latency
+cache_lookups = default_series.cache_lookups
+signal_latency = default_series.signal_latency
+decision_matches = default_series.decision_matches
+decision_latency = default_series.decision_latency
+batch_size = default_series.batch_size
+truncated_inputs = default_series.truncated_inputs
+backend_failovers = default_series.backend_failovers

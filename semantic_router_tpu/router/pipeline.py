@@ -104,10 +104,17 @@ class Router:
     def __init__(self, cfg: RouterConfig,
                  engine: Optional[InferenceEngine] = None,
                  cache: Optional[CacheBackend] = None,
-                 embedding_task: str = "embedding") -> None:
+                 embedding_task: str = "embedding",
+                 metrics: "Optional[M.MetricSeries]" = None,
+                 tracer=None) -> None:
         self.cfg = cfg
         self.engine = engine
         self.embedding_task = embedding_task
+        # instance-bound observability (pkg/routerruntime decoupling):
+        # an embedded second router binds its own registry/tracer
+        # instead of feeding the process globals
+        self.M = metrics or M.default_series
+        self.tracer = tracer or default_tracer
 
         extra = []
         if engine is not None:
@@ -336,16 +343,16 @@ class Router:
             # same recipe — _engines_for_model on both paths)
             signals, report = precomputed_signals
         else:
-            with default_tracer.span("signals.evaluate",
+            with self.tracer.span("signals.evaluate",
                                      request_id=request_id):
                 signals, report = dispatcher.evaluate(
                     ctx, skip_signals=skip)
         for family, res in report.results.items():
-            M.signal_latency.observe(res.latency_s, family=family)
+            self.M.signal_latency.observe(res.latency_s, family=family)
 
-        with default_tracer.decision_span():
+        with self.tracer.decision_span():
             decision_res = decision_engine.evaluate(signals)
-        M.decision_latency.observe(decision_engine.last_eval_latency_s)
+        self.M.decision_latency.observe(decision_engine.last_eval_latency_s)
 
         result = RouteResult(
             kind="route", request_id=request_id, signals=signals,
@@ -365,23 +372,23 @@ class Router:
                               H.REQUEST_ID: request_id}
             self._finalize_body(result, ctx, None)
             result.routing_latency_s = time.perf_counter() - start
-            M.routing_latency.observe(result.routing_latency_s)
+            self.M.routing_latency.observe(result.routing_latency_s)
             return result
 
         decision = decision_res.decision
-        M.decision_matches.inc(name=decision.name)
+        self.M.decision_matches.inc(name=decision.name)
 
         # -- pre-routing plugins ---------------------------------------
         blocked = self._apply_policy_plugins(decision, signals, ctx, result)
         if blocked is not None:
             blocked.routing_latency_s = time.perf_counter() - start
-            M.routing_latency.observe(blocked.routing_latency_s)
+            self.M.routing_latency.observe(blocked.routing_latency_s)
             return blocked
 
         cache_hit = self._check_cache(decision, ctx, result)
         if cache_hit is not None:
             cache_hit.routing_latency_s = time.perf_counter() - start
-            M.routing_latency.observe(cache_hit.routing_latency_s)
+            self.M.routing_latency.observe(cache_hit.routing_latency_s)
             return cache_hit
 
         # -- selection --------------------------------------------------
@@ -422,9 +429,9 @@ class Router:
             matched_rules=decision_res.matched_rules))
         result.headers[H.REQUEST_ID] = request_id
 
-        M.model_requests.inc(model=ref.model, decision=decision.name)
+        self.M.model_requests.inc(model=ref.model, decision=decision.name)
         result.routing_latency_s = time.perf_counter() - start
-        M.routing_latency.observe(result.routing_latency_s)
+        self.M.routing_latency.observe(result.routing_latency_s)
         component_event("router", "routed", request_id=request_id,
                         decision=decision.name, model=ref.model,
                         latency_ms=round(result.routing_latency_s * 1e3, 2))
@@ -439,7 +446,7 @@ class Router:
         if fast is not None and fast.enabled:
             content = fast.configuration.get(
                 "response", "Request handled by policy.")
-            M.jailbreak_blocks.inc(decision=decision.name)
+            self.M.jailbreak_blocks.inc(decision=decision.name)
             return RouteResult(
                 kind="blocked", status=200, request_id=result.request_id,
                 decision=result.decision, signals=signals,
@@ -450,7 +457,7 @@ class Router:
         pii_plugin = decision.plugin("pii")
         pii_hits = signals.matches.get("pii", [])
         if pii_hits:
-            M.pii_violations.inc(decision=decision.name)
+            self.M.pii_violations.inc(decision=decision.name)
             action = (pii_plugin.configuration.get("action", "header")
                       if pii_plugin else "header")
             if action == "block":
@@ -475,12 +482,12 @@ class Router:
                 ctx.user_text,
                 threshold=float(threshold) if threshold else None)
         except Exception:
-            M.cache_lookups.inc(outcome="error")
+            self.M.cache_lookups.inc(outcome="error")
             return None
         if hit is None:
-            M.cache_lookups.inc(outcome="miss")
+            self.M.cache_lookups.inc(outcome="miss")
             return None
-        M.cache_lookups.inc(outcome="hit")
+        self.M.cache_lookups.inc(outcome="hit")
         return RouteResult(
             kind="cache_hit", request_id=result.request_id,
             decision=result.decision, signals=result.signals,
@@ -777,7 +784,7 @@ class Router:
                             "hallucination_spans"] = spans
             except Exception:
                 out.headers[H.UNVERIFIED_FACTUAL] = "true"
-            M.hallucination_latency.observe(time.perf_counter() - t0)
+            self.M.hallucination_latency.observe(time.perf_counter() - t0)
 
         if out.warnings:
             out.headers[H.WARNINGS] = ",".join(out.warnings)
@@ -798,7 +805,7 @@ class Router:
         if usage and route.model:
             card = self.model_cards.get(route.model)
             if card and card.pricing:
-                M.model_cost.inc(usage_cost(usage, card.pricing),
+                self.M.model_cost.inc(usage_cost(usage, card.pricing),
                                  model=route.model)
 
         # memory auto-store after a successful exchange
@@ -905,9 +912,9 @@ class Router:
             query=query, query_embedding=emb,
             session_id=(route.body or {}).get("user", "")))
         if latency_ms:
-            M.completion_latency.observe(latency_ms / 1e3, model=route.model)
+            self.M.completion_latency.observe(latency_ms / 1e3, model=route.model)
         if ttft_ms:
-            M.ttft.observe(ttft_ms / 1e3, model=route.model)
+            self.M.ttft.observe(ttft_ms / 1e3, model=route.model)
 
     def shutdown(self) -> None:
         self.dispatcher.shutdown()

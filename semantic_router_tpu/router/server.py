@@ -37,7 +37,6 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from ..config.schema import RouterConfig
-from ..observability.tracing import default_tracer
 from . import headers as H
 from .anthropic import (
     anthropic_to_openai,
@@ -408,9 +407,7 @@ class RouterServer:
         self.jobs.shutdown()
         exporter = getattr(self, "otlp_exporter", None)
         if exporter is not None:  # a leaked sink would double-export
-            from ..observability.tracing import default_tracer
-
-            exporter.detach(default_tracer)
+            exporter.detach(self.registry.tracer)
         self.router.shutdown()
 
     # ------------------------------------------------------------------
@@ -498,9 +495,9 @@ class RouterServer:
                     return last
                 continue
             if i > 0:
-                from ..observability import metrics as M
-
-                M.backend_failovers.inc(model=model)
+                # the ROUTER's series, not the module global: an
+                # embedded second router reports its own failovers
+                self.router.M.backend_failovers.inc(model=model)
             status, resp = self._parse_upstream(status, raw)
             return status, resp, url
         return last
@@ -1061,7 +1058,10 @@ class RouterServer:
             # aggregate router state as JSON for a UI) -----------------
 
             def _dashboard(self, path: str) -> None:
-                from ..observability import metrics as M
+                # the ROUTER's series (registry-bound), not the module
+                # globals: an isolated embedded instance dashboards its
+                # own traffic
+                M = server.router.M
 
                 # view-gated like every management read: embedmap/replay
                 # expose request texts (open only in keyless dev mode)
@@ -1640,8 +1640,8 @@ class RouterServer:
                     return
 
                 fwd_headers = dict(headers)
-                trace_id, _ = default_tracer.extract(headers)
-                default_tracer.inject(trace_id, route.request_id[:16].ljust(16, "0"),
+                trace_id, _ = server.registry.tracer.extract(headers)
+                server.registry.tracer.inject(trace_id, route.request_id[:16].ljust(16, "0"),
                                       fwd_headers)
                 fwd_headers.update(route.headers)
                 try:
@@ -1831,8 +1831,8 @@ class RouterServer:
                                       responses_request=body)
                     return
                 fwd = dict(headers)
-                trace_id, _ = default_tracer.extract(headers)
-                default_tracer.inject(
+                trace_id, _ = server.registry.tracer.extract(headers)
+                server.registry.tracer.inject(
                     trace_id, route.request_id[:16].ljust(16, "0"), fwd)
                 fwd.update(route.headers)
                 try:

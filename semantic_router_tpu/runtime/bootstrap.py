@@ -57,10 +57,12 @@ def select_attention_impl(engine_cfg, max_seq_len: int,
     return "dense"
 
 
-def build_engine(cfg: RouterConfig, mock: bool = False):
+def build_engine(cfg: RouterConfig, mock: bool = False, registry=None):
     """Engine from config (or the mock seam). Returns None when no
     classifier models are configured — the router then runs heuristics-only
-    (fail-open posture)."""
+    (fail-open posture).  ``registry`` (a RuntimeRegistry) routes the
+    engine's metrics + lifecycle events to that registry's sinks instead
+    of the process globals (pkg/routerruntime isolation)."""
     if mock:
         from ..engine.testing import make_embedding_engine
 
@@ -113,7 +115,10 @@ def build_engine(cfg: RouterConfig, mock: bool = False):
             raise
         default_bus.emit(DOWNLOAD_DONE, resolved=sorted(resolved_paths))
 
-    engine = InferenceEngine(cfg.engine)
+    engine = InferenceEngine(
+        cfg.engine,
+        metrics=registry.metric_series() if registry is not None else None,
+        events=registry.events if registry is not None else None)
     for task, spec in specs.items():
         path = spec.get("checkpoint", "")
         if path and not os.path.exists(path):
@@ -143,6 +148,33 @@ def build_engine(cfg: RouterConfig, mock: bool = False):
         eff_max_seq = int(spec.get("max_seq_len", 0)) or \
             int(hf_cfg.get("max_position_embeddings", 8192))
         eff_max_seq = min(eff_max_seq, max(buckets))
+        if spec.get("kind") == "multimodal":
+            # SigLIP shared text/image space (N5 multimodal; the
+            # multimodal-routing e2e profile's embedder) — its HF config
+            # nests per-tower configs, so it never reaches the
+            # ModernBERT path below
+            from types import SimpleNamespace
+
+            from ..models.siglip import (
+                SiglipEmbedder,
+                SiglipTowerConfig,
+                siglip_params_from_state_dict,
+            )
+
+            text_tc = SiglipTowerConfig.from_hf(
+                SimpleNamespace(**hf_cfg["text_config"]))
+            vis_tc = SiglipTowerConfig.from_hf(
+                SimpleNamespace(**hf_cfg["vision_config"]))
+            tok = HFTokenizer.from_pretrained_dir(
+                spec.get("tokenizer", path if os.path.isdir(path)
+                         else os.path.dirname(path)))
+            engine.register_multimodal(
+                task, SiglipEmbedder(
+                    text_tc, vis_tc,
+                    siglip_params_from_state_dict(state), tokenizer=tok))
+            component_event("bootstrap", "model_loaded", task=task,
+                            kind="multimodal", architecture="siglip")
+            continue
         attn_impl = select_attention_impl(cfg.engine, eff_max_seq)
         mcfg = ModernBertConfig(
             vocab_size=hf_cfg["vocab_size"],
@@ -261,13 +293,20 @@ def build_engine(cfg: RouterConfig, mock: bool = False):
 
 def build_router(cfg: RouterConfig, engine=None,
                  replay_path: Optional[str] = None,
-                 carry_from: Optional[Router] = None) -> Router:
+                 carry_from: Optional[Router] = None,
+                 registry=None) -> Router:
     """Build a router; ``carry_from`` transplants the stateful subsystems
     (semantic cache, memory, vectorstores, replay store/hooks) from a
     previous router so a config hot-reload keeps accumulated state
-    (RouterService.Swap semantics — swap routing logic, keep state)."""
+    (RouterService.Swap semantics — swap routing logic, keep state).
+    ``registry`` (a RuntimeRegistry) binds the router's metric series to
+    that registry's sinks — pass RuntimeRegistry.isolated() to embed a
+    second router with fully independent observability."""
     router = Router(cfg, engine=engine,
-                    cache=carry_from.cache if carry_from is not None else None)
+                    cache=carry_from.cache if carry_from is not None else None,
+                    metrics=registry.metric_series()
+                    if registry is not None else None,
+                    tracer=registry.tracer if registry is not None else None)
     from ..memory import InMemoryMemoryStore
     from ..vectorstore import VectorStoreManager
 
@@ -443,11 +482,12 @@ def serve(config_path: str, port: int = 8801,
                          name="warmup").start()
 
     # OTLP span export when configured (observability.tracing.otlp_endpoint)
+    # — attached to the SERVER's tracer (registry slot), so an embedded
+    # second router's spans go to its own exporter
     from ..observability.otlp import build_exporter_from_config
-    from ..observability.tracing import default_tracer
 
     server.otlp_exporter = build_exporter_from_config(
-        cfg.observability, default_tracer)
+        cfg.observability, server.registry.tracer)
 
     # startKubernetesControllerIfNeeded (cmd/main.go:50): live CRD watch
     # regenerating the config file the ConfigWatcher below hot-swaps

@@ -57,28 +57,38 @@ class RuntimeRegistry:
 
     @classmethod
     def isolated(cls, **overrides: Any) -> "RuntimeRegistry":
-        """Per-instance state for the services whose WRITE side goes
-        through the registry today: session telemetry and the profiler
-        control. Metrics, tracing, and lifecycle events still bind the
-        process defaults — their emitters (the canonical series in
-        observability/metrics.py, span helpers, engine/bootstrap event
-        emits) write to module singletons, so handing out fresh sinks
-        here would expose empty /metrics and /dashboard/api/events while
-        traffic silently feeds the globals. Pass explicit overrides once
-        an emitter is registry-routed; until then isolation covers
-        sessions + profiler (honestly)."""
+        """Fully per-instance sinks: fresh metrics registry, tracer,
+        event bus, session telemetry, and profiler control.  The request
+        -path emitters are registry-routed (Router carries a
+        MetricSeries, the server resolves its tracer through this
+        registry, the engine takes metrics/events params), so two
+        embedded routers with isolated() registries share NO
+        observability state — traffic through one never shows in the
+        other's /metrics, spans, or event feed.  Wire the emitters with
+        ``build_router(cfg, registry=...)`` /
+        ``RouterServer(..., registry=...)``."""
+        from ..observability.metrics import MetricsRegistry
         from ..observability.profiler import ProfilerControl
         from ..observability.session import SessionTelemetry
+        from ..observability.tracing import Tracer
+        from .events import EventBus
 
         base: Dict[str, Any] = {
+            "metrics": MetricsRegistry(),
+            "tracer": Tracer(),
+            "events": EventBus(),
             "sessions": SessionTelemetry(),
             "profiler": ProfilerControl(),
         }
-        defaults = cls.with_defaults().snapshot()
-        for slot in ("metrics", "tracer", "events"):
-            base.setdefault(slot, defaults[slot])
         base.update(overrides)
         return cls(**base)
+
+    def metric_series(self):
+        """The canonical series bound to THIS registry's metrics slot
+        (idempotent — get-or-create by name)."""
+        from ..observability.metrics import MetricSeries
+
+        return MetricSeries(self.metrics)
 
     def __getattr__(self, name: str) -> Any:
         if name.startswith("_"):

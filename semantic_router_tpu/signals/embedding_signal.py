@@ -39,12 +39,19 @@ class _PrototypeBank:
         self._cache: Dict[str, np.ndarray] = {}
         self._lock = threading.Lock()
 
-    def get(self, key: str, texts: List[str]) -> np.ndarray:
+    def get(self, key: str, texts: List[str],
+            embed_fn=None) -> np.ndarray:
+        """Get-or-create candidate embeddings.  ``embed_fn`` overrides
+        the embedder (the image-modality rules embed their candidate
+        texts through the multimodal SHARED space, not the text-only
+        model) — the lock/check/embed/store sequence stays in ONE
+        place either way."""
         with self._lock:
             hit = self._cache.get(key)
         if hit is not None:
             return hit
-        emb = self.engine.embed(self.task, texts)
+        emb = embed_fn(texts) if embed_fn is not None \
+            else self.engine.embed(self.task, texts)
         with self._lock:
             self._cache[key] = emb
         return emb
@@ -78,36 +85,95 @@ def _aggregate(sims: np.ndarray, method: str, threshold: float
 
 
 class EmbeddingSignal:
+    """Similarity routing over candidate prototypes.
+
+    Text rules embed the query text with the ``task`` embedding model.
+    Rules with ``query_modality: image`` (reference multimodal-routing
+    e2e profile; EmbeddingRule schema.py query_modality) embed the
+    request's FIRST image through the ``multimodal_task`` shared text/
+    image space (SigLIP, N5) and score it against the rule's candidate
+    TEXTS embedded in that same space — a picture of an invoice matches
+    the "billing documents" prototypes with no caption needed."""
+
     signal_type = "embedding"
 
     def __init__(self, engine: InferenceEngine, rules: List[EmbeddingRule],
-                 task: str = "embedding") -> None:
+                 task: str = "embedding",
+                 multimodal_task: str = "multimodal") -> None:
         self.rules = rules
         self.bank = _PrototypeBank(engine, task)
         self.engine = engine
         self.task = task
+        self.multimodal_task = multimodal_task
+
+    def _image_query(self, ctx: RequestContext) -> np.ndarray:
+        """First request image → shared-space embedding, memoized per
+        request (several image rules share one forward pass)."""
+        key = ("query_img_emb", self.multimodal_task)
+        if key in ctx.ext:
+            return ctx.ext[key]
+        ref = next(ref for m in ctx.messages for ref in m.images)
+        emb = self.engine.embed_multimodal(
+            self.multimodal_task, image_refs=[ref])["image"][0]
+        ctx.ext[key] = emb
+        return emb
+
+    def _mm_candidates(self, rule: EmbeddingRule) -> np.ndarray:
+        """Candidate texts embedded in the SHARED space (mm text tower,
+        not the text-only embedding model), cached in the bank."""
+        return self.bank.get(
+            f"mm_cands:{rule.name}", rule.candidates,
+            embed_fn=lambda texts: self.engine.embed_multimodal(
+                self.multimodal_task, texts=texts)["text"])
 
     def evaluate(self, ctx: RequestContext) -> SignalResult:
         start = time.perf_counter()
         res = SignalResult(self.signal_type)
+        text_rules = [r for r in self.rules
+                      if r.query_modality != "image"]
+        image_rules = [r for r in self.rules
+                       if r.query_modality == "image"]
+        # the two modality branches fail INDEPENDENTLY: a malformed
+        # image must not void the text rules' hits (and vice versa) —
+        # fail-open stays per-branch, not per-family
         try:
-            if not self.engine.has_task(self.task):
-                res.error = f"task {self.task!r} not loaded"
-                return res
-            query = self.bank.embed_query(ctx.user_text, ctx)
-            for rule in self.rules:
-                if not rule.candidates:
-                    continue
-                cands = self.bank.get(f"emb:{rule.name}", rule.candidates)
-                sims = cands @ query
-                matched, score = _aggregate(sims, rule.aggregation_method,
-                                            rule.threshold)
-                if matched:
-                    res.hits.append(SignalHit(rule.name, score))
+            if text_rules:
+                if not self.engine.has_task(self.task):
+                    res.error = f"task {self.task!r} not loaded"
+                else:
+                    query = self.bank.embed_query(ctx.user_text, ctx)
+                    for rule in text_rules:
+                        if not rule.candidates:
+                            continue
+                        cands = self.bank.get(f"emb:{rule.name}",
+                                              rule.candidates)
+                        sims = cands @ query
+                        matched, score = _aggregate(
+                            sims, rule.aggregation_method, rule.threshold)
+                        if matched:
+                            res.hits.append(SignalHit(rule.name, score))
         except Exception as exc:
             res.error = f"{type(exc).__name__}: {exc}"
-        finally:
-            res.latency_s = time.perf_counter() - start
+        try:
+            if image_rules and ctx.has_images():
+                if not self.engine.has_task(self.multimodal_task):
+                    res.error = (f"task {self.multimodal_task!r} "
+                                 f"not loaded")
+                else:
+                    img_q = self._image_query(ctx)
+                    for rule in image_rules:
+                        if not rule.candidates:
+                            continue
+                        sims = self._mm_candidates(rule) @ img_q
+                        matched, score = _aggregate(
+                            sims, rule.aggregation_method, rule.threshold)
+                        if matched:
+                            res.hits.append(SignalHit(
+                                rule.name, score,
+                                {"modality": "image"}))
+        except Exception as exc:
+            res.error = f"image: {type(exc).__name__}: {exc}"
+        res.latency_s = time.perf_counter() - start
         return res
 
 

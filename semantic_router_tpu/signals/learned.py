@@ -257,7 +257,12 @@ def build_learned_evaluators(engine: InferenceEngine, cfg) -> list:
 
         evs.append(KBSignal(engine, s.kb, cfg.knowledge_bases))
     if s.embeddings:
-        evs.append(EmbeddingSignal(engine, s.embeddings))
+        # image-modality rules route through the engine's multimodal
+        # (SigLIP shared-space) task when one is registered
+        mm = next((t for t in engine.tasks()
+                   if engine.task_kind(t) == "multimodal"), "multimodal")
+        evs.append(EmbeddingSignal(engine, s.embeddings,
+                                   multimodal_task=mm))
     if s.preferences:
         evs.append(PreferenceSignal(engine, s.preferences))
     if s.complexity:

@@ -267,20 +267,28 @@ class TestRuntimeRegistry:
         reg = RuntimeRegistry.with_defaults()
         assert reg.metrics is default_registry
         iso = RuntimeRegistry.isolated()
-        # metrics/tracer/events emitters are module-level today, so
-        # isolated() honestly binds the process defaults for them and
-        # isolates only the registry-written services
-        assert iso.metrics is default_registry
+        # r5: the emitters are registry-routed (Router.M, engine
+        # metrics/events params, server tracer through the registry), so
+        # isolated() now hands FRESH sinks for every slot — see
+        # test_runtime_isolation.py for the end-to-end proof
+        assert iso.metrics is not default_registry
+        assert iso.tracer is not reg.tracer
+        assert iso.events is not reg.events
         assert iso.sessions is not reg.sessions
         assert iso.profiler is not reg.profiler
+        # the series helper binds the canonical names to the fresh sink
+        series = iso.metric_series()
+        series.model_requests.inc(model="m")
+        assert "llm_model_requests_total" in iso.metrics.expose()
         from semantic_router_tpu.observability.metrics import (
             MetricsRegistry,
         )
 
         fresh = MetricsRegistry()
+        prev = iso.metrics
         old = iso.swap(metrics=fresh)
         assert iso.metrics is fresh
-        assert old["metrics"] is default_registry
+        assert old["metrics"] is prev
         import pytest as _pytest
 
         with _pytest.raises(ValueError):

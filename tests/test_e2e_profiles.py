@@ -398,13 +398,174 @@ class _ProductionStackProfile(_HTTPProfile):
             svc.stop()
 
 
+class _RemoteEmbeddingProfile(_HTTPProfile):
+    """remote-embedding (reference e2e/README.md): an OpenAI-compatible
+    remote /v1/embeddings provider backs the embedding-similarity
+    family; routing still works with NO local embedding model."""
+
+    name = "remote-embedding"
+
+    def build_cfg(self, fixture_path, tmp_path, services):
+        import hashlib
+        import http.server
+        import socketserver
+        import threading
+
+        import numpy as np
+        import yaml
+
+        def det_vec(text, dim):
+            h = hashlib.sha256(text.encode()).digest()
+            v = np.frombuffer((h * ((dim * 4) // len(h) + 1))[:dim * 4],
+                              dtype=np.uint32).astype(np.float64)
+            # centered: unrelated texts land near sim 0 (uncentered
+            # all-positive components put EVERY pair at ~0.75, which
+            # would shift the fixture's projection bands)
+            v = v - v.mean()
+            return (v / np.linalg.norm(v)).tolist()
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                body = json.loads(self.rfile.read(
+                    int(self.headers["content-length"])))
+                if not self.path.endswith("/embeddings"):
+                    raw = json.dumps({"error": "nope"}).encode()
+                    self.send_response(404)
+                else:
+                    dim = body.get("dimensions") or 8
+                    raw = json.dumps({"object": "list", "data": [
+                        {"index": i, "object": "embedding",
+                         "embedding": det_vec(t, dim)}
+                        for i, t in enumerate(body["input"])]}).encode()
+                    self.send_response(200)
+                self.send_header("content-type", "application/json")
+                self.send_header("content-length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+        httpd = socketserver.ThreadingTCPServer(("127.0.0.1", 0), Handler)
+        httpd.daemon_threads = True
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        httpd.stop = lambda: (httpd.shutdown(), httpd.server_close())
+        services["embedding-provider"] = httpd
+
+        with open(fixture_path) as f:
+            raw = yaml.safe_load(f)
+        raw["external_models"] = [{
+            "role": "embedding",
+            "base_url": f"http://127.0.0.1:{httpd.server_address[1]}/v1",
+            "model": "bge-m3-mock", "dimensions": 8,
+            "timeout_seconds": 5}]
+        raw["routing"]["signals"].setdefault("embeddings", []).append({
+            "name": "billing_query",
+            # deterministic hash embeddings: only the EXACT text reaches
+            # sim 1.0, so the rule fires iff the provider served it
+            "candidates": ["please refund my duplicate invoice"],
+            "threshold": 0.999})
+        # above every fixture decision (top fixture priority is 300):
+        # the profile asserts the REMOTE-backed rule wins when it hits
+        raw["routing"]["decisions"].append({
+            "name": "billing_route", "priority": 400,
+            "rules": {"type": "embedding", "name": "billing_query"},
+            "modelRefs": [{"model": "qwen3-32b"}]})
+        from semantic_router_tpu.config import loads_config
+
+        return loads_config(yaml.safe_dump(raw))
+
+
+class _DetMultimodalEmbedder:
+    """Deterministic shared-space embedder for the multimodal profile.
+
+    The SigLIP model itself (towers, projections, engine integration) is
+    parity-tested in test_models_deberta_siglip; what THIS profile must
+    prove is the routing plumbing — OpenAI image_url part → data-URI
+    decode (the real ``decode_image_ref``/``preprocess_image`` wire
+    path) → shared-space embed → image-modality rule hit → decision.  A
+    randomly-initialized SigLIP's similarities carry no signal to
+    assert on, so the shared space here is a deterministic one: images
+    land on the "visual" axis, texts mentioning photos/screenshots land
+    on the same axis, everything else is orthogonal."""
+
+    tokenizer = None
+
+    def embed_text(self, texts):
+        import numpy as np
+
+        out = np.zeros((len(texts), 8), np.float32)
+        for i, t in enumerate(texts):
+            has_visual = "photo" in t.lower() or "screenshot" in t.lower()
+            out[i, 0 if has_visual else 1] = 1.0
+        return out
+
+    def embed_image(self, images):
+        import numpy as np
+
+        out = np.zeros((len(images), 8), np.float32)
+        out[:, 0] = 1.0
+        return out
+
+    def embed_image_refs(self, refs):
+        from semantic_router_tpu.models.siglip import (
+            decode_image_ref,
+            preprocess_image,
+        )
+
+        # the REAL wire path: data-URI decode + resize/normalize — a
+        # malformed or remote-URL ref raises here, exactly as in prod
+        return self.embed_image([preprocess_image(decode_image_ref(r), 24)
+                                 for r in refs])
+
+
+class _MultimodalProfile(_HTTPProfile):
+    """multimodal-routing (reference e2e/README.md): image-modality
+    EmbeddingSignal rules route requests carrying images through a
+    multimodal shared text/image space."""
+
+    name = "multimodal-routing"
+
+    def engine(self):
+        from semantic_router_tpu.engine.classify import InferenceEngine
+
+        self._engine = InferenceEngine()
+        self._engine.register_multimodal("multimodal",
+                                         _DetMultimodalEmbedder())
+        return self._engine
+
+    def build_cfg(self, fixture_path, tmp_path, services):
+        import yaml
+
+        with open(fixture_path) as f:
+            raw = yaml.safe_load(f)
+        raw["routing"]["signals"].setdefault("embeddings", []).append({
+            "name": "visual_request", "query_modality": "image",
+            "candidates": ["a photo or screenshot"],
+            "threshold": 0.9})
+        raw["routing"]["decisions"].append({
+            "name": "vision_route", "priority": 99,
+            "rules": {"type": "embedding", "name": "visual_request"},
+            "modelRefs": [{"model": "qwen3-32b"}]})
+        from semantic_router_tpu.config import loads_config
+
+        return loads_config(yaml.safe_dump(raw))
+
+    def stop(self):
+        super().stop()
+        self._engine.shutdown()
+
+
 PROFILES = [_HTTPProfile, _DurableProfile, _EngineProfile,
             _SecuredProfile, _RecipesProfile, _ResponseAPIProfile,
                          _ResponseAPIRedisProfile, _ResponseAPIClusterProfile,
                          _StreamingProfile, _AnthropicShimProfile,
                          _AuthzRateProfile, _MLSelectionProfile,
                          _RAGLlamaStackProfile, _DynamicConfigProfile,
-                         _MultiEndpointProfile, _ProductionStackProfile]
+                         _MultiEndpointProfile, _ProductionStackProfile,
+                         _RemoteEmbeddingProfile, _MultimodalProfile]
 
 
 @pytest.mark.parametrize("profile_cls", PROFILES,
@@ -791,6 +952,86 @@ class TestProductionStackSpecifics:
             assert hdrs["x-vsr-selected-decision"] == "urgent_route"
         finally:
             p.stop()
+
+
+class TestRemoteEmbeddingProfileSpecifics:
+    def test_remote_provider_backs_embedding_routing(
+            self, fixture_config_path, tmp_path):
+        p = _RemoteEmbeddingProfile()
+        p.start(fixture_config_path, tmp_path)
+        try:
+            # the exact candidate text: deterministic remote embedding
+            # puts it at sim 1.0 -> billing_route
+            status, _, headers = p.chat(
+                "please refund my duplicate invoice")
+            assert status == 200
+            assert headers["x-vsr-selected-decision"] == "billing_route"
+            assert headers["x-vsr-selected-model"] == "qwen3-32b"
+            # unrelated text stays off the rule
+            status, _, headers = p.chat("this is urgent, fix asap")
+            assert status == 200
+            assert headers["x-vsr-selected-decision"] == "urgent_route"
+        finally:
+            p.stop()
+
+    def test_provider_down_fails_open(self, fixture_config_path,
+                                      tmp_path):
+        p = _RemoteEmbeddingProfile()
+        p.start(fixture_config_path, tmp_path)
+        try:
+            p.services["embedding-provider"].stop()
+            del p.services["embedding-provider"]
+            # embedding family errors out -> fail open, traffic routes
+            status, _, headers = p.chat(
+                "please refund my duplicate invoice")
+            assert status == 200
+            assert headers["x-vsr-selected-decision"] != "billing_route"
+        finally:
+            p.stop()
+
+
+class TestMultimodalProfileSpecifics:
+    @staticmethod
+    def _data_uri():
+        import base64
+        import io
+
+        from PIL import Image
+
+        buf = io.BytesIO()
+        Image.new("RGB", (32, 32), (200, 40, 40)).save(buf, format="PNG")
+        return ("data:image/png;base64,"
+                + base64.b64encode(buf.getvalue()).decode())
+
+    def test_image_request_routes_through_vision_decision(
+            self, fixture_config_path, tmp_path):
+        p = _MultimodalProfile()
+        p.start(fixture_config_path, tmp_path)
+        try:
+            status, _, headers = http(
+                p.server.url + "/v1/chat/completions", "POST",
+                {"model": "auto", "messages": [{
+                    "role": "user", "content": [
+                        {"type": "text", "text": "what is in this?"},
+                        {"type": "image_url",
+                         "image_url": {"url": self._data_uri()}}]}]})
+            assert status == 200
+            assert headers["x-vsr-selected-decision"] == "vision_route"
+            assert headers["x-vsr-selected-model"] == "qwen3-32b"
+            # the SAME stack without an image never hits the image rule
+            status, _, headers = p.chat("what is in this?")
+            assert status == 200
+            assert headers.get("x-vsr-selected-decision") != \
+                "vision_route"
+        finally:
+            p.stop()
+
+    def test_remote_image_urls_refused_not_fetched(self):
+        """SSRF guard: the router must never fetch attacker URLs."""
+        from semantic_router_tpu.models.siglip import decode_image_ref
+
+        with pytest.raises(ValueError):
+            decode_image_ref("http://169.254.169.254/latest/meta-data")
 
 
 class TestRAGLlamaStackProfileSpecifics:

@@ -69,6 +69,111 @@ class TestEmbeddingSignal:
         assert res.hits == [] and "not loaded" in res.error
 
 
+class TestImageModalityRules:
+    """query_modality: image rules (multimodal-routing profile role)."""
+
+    class _MM:
+        """Deterministic shared-space stub registered as a multimodal
+        task: texts with 'photo' and every image land on axis 0."""
+
+        tokenizer = None
+
+        def embed_text(self, texts):
+            out = np.zeros((len(texts), 4), np.float32)
+            for i, t in enumerate(texts):
+                out[i, 0 if "photo" in t else 1] = 1.0
+            return out
+
+        def embed_image(self, images):
+            out = np.zeros((len(images), 4), np.float32)
+            out[:, 0] = 1.0
+            return out
+
+        def embed_image_refs(self, refs):
+            for r in refs:
+                if r == "bad":
+                    raise ValueError("unreadable image")
+            return self.embed_image(refs)
+
+    @staticmethod
+    def _img_ctx(text, image):
+        return RequestContext(messages=[
+            Message("user", text, images=[image])])
+
+    def test_image_rule_hits_only_with_image(self, engine):
+        engine.register_multimodal("mm", self._MM())
+        rules = [EmbeddingRule(name="visual", threshold=0.9,
+                               query_modality="image",
+                               candidates=["a photo"])]
+        sig = EmbeddingSignal(engine, rules, multimodal_task="mm")
+        res = sig.evaluate(self._img_ctx("look", "data-uri-stub"))
+        assert res.error is None
+        assert [h.rule for h in res.hits] == ["visual"]
+        assert res.hits[0].detail["modality"] == "image"
+        # no image in the request: the rule stays silent, no error
+        res2 = sig.evaluate(ctx("look"))
+        assert res2.hits == [] and res2.error is None
+
+    def test_bad_image_does_not_void_text_rules(self, engine):
+        """Per-branch fail-open: a malformed image errors the IMAGE leg
+        but the text rules' hits stand."""
+        engine.register_multimodal("mm", self._MM())
+        rules = [
+            EmbeddingRule(name="support", threshold=0.99,
+                          candidates=["how to configure the system"]),
+            EmbeddingRule(name="visual", threshold=0.9,
+                          query_modality="image",
+                          candidates=["a photo"]),
+        ]
+        sig = EmbeddingSignal(engine, rules, multimodal_task="mm")
+        res = sig.evaluate(self._img_ctx("how to configure the system",
+                                         "bad"))
+        assert [h.rule for h in res.hits] == ["support"]
+        assert res.error is not None and "image" in res.error
+
+
+class TestDecodeImageRef:
+    def test_base64_data_uri_roundtrip(self):
+        import base64
+        import io
+
+        from PIL import Image
+
+        from semantic_router_tpu.models.siglip import decode_image_ref
+
+        buf = io.BytesIO()
+        Image.new("RGB", (4, 4), (10, 200, 30)).save(buf, format="PNG")
+        uri = ("data:image/png;base64,"
+               + base64.b64encode(buf.getvalue()).decode())
+        arr = decode_image_ref(uri)
+        assert arr.shape == (4, 4, 3) and arr.dtype == np.uint8
+        assert tuple(arr[0, 0]) == (10, 200, 30)
+        # bare base64 works too
+        assert decode_image_ref(
+            base64.b64encode(buf.getvalue()).decode()).shape == (4, 4, 3)
+
+    def test_non_base64_data_uri_percent_decoded(self):
+        import io
+        from urllib.parse import quote_from_bytes
+
+        from PIL import Image
+
+        from semantic_router_tpu.models.siglip import decode_image_ref
+
+        buf = io.BytesIO()
+        Image.new("RGB", (2, 2), (1, 2, 3)).save(buf, format="PNG")
+        uri = "data:image/png," + quote_from_bytes(buf.getvalue())
+        assert decode_image_ref(uri).shape == (2, 2, 3)
+
+    def test_malformed_and_remote_refused(self):
+        from semantic_router_tpu.models.siglip import decode_image_ref
+
+        with pytest.raises(ValueError):
+            decode_image_ref("data:image/png;base64")  # no comma
+        with pytest.raises(ValueError):
+            decode_image_ref("https://example.com/x.png")
+
+
 class TestPreferenceSignal:
     def test_example_match(self, engine):
         rules = [PreferenceRule(name="terse", threshold=0.99,
