@@ -68,8 +68,14 @@ class ModernBertConfig:
     classifier_activation: str = "gelu"
     num_labels: int = 2
     rope_scaling: Optional[Dict[str, Any]] = None  # {"rope_type": "yarn", ...}
-    attention_impl: str = "dense"  # dense | chunked | flash (pallas on TPU)
+    # dense | chunked | flash (pallas on TPU) | ring (sequence-parallel
+    # exact attention over mesh[ring_seq_axis] — ops.ring_attention)
+    attention_impl: str = "dense"
     chunk_block_size: int = 512
+    mesh: Any = None  # required for attention_impl="ring"
+    ring_seq_axis: str = "sp"
+    ring_batch_axis: str = "dp"
+    ring_head_axis: Optional[str] = "tp"
     dtype: Any = jnp.float32
 
     @property
@@ -210,6 +216,20 @@ class ModernBertAttention(nn.Module):
             out = chunked_sdpa(q, k, v, key_padding_mask=attention_mask,
                                window=window,
                                block_size=cfg.chunk_block_size)
+        elif cfg.attention_impl == "ring":
+            # sequence-parallel exact attention: S shards over the
+            # mesh's sp axis, K/V blocks rotate on the ICI ring — the
+            # long-context path when one chip's HBM is not enough
+            from ..ops.ring_attention import ring_attention
+
+            if cfg.mesh is None:
+                raise ValueError("attention_impl='ring' needs cfg.mesh")
+            out = ring_attention(q, k, v, cfg.mesh,
+                                 key_padding_mask=attention_mask,
+                                 window=window,
+                                 seq_axis=cfg.ring_seq_axis,
+                                 batch_axis=cfg.ring_batch_axis,
+                                 head_axis=cfg.ring_head_axis)
         else:
             bias = padding_bias(attention_mask)
             if window > 0:
