@@ -111,12 +111,17 @@ class InferenceEngine:
 
             self.mesh = create_mesh(dict(self.cfg.mesh_shape))
             if self.mesh.shape.get("sp", 1) > 1:
-                # the serving bank shards batch (dp) and weights (tp);
-                # an sp axis would replicate all work — fail loudly
-                # instead of silently wasting half the slice
-                raise ValueError(
-                    "serving mesh_shape supports dp/tp only; fold sp "
-                    "into dp for the classifier bank")
+                # an sp axis is only useful when attention actually
+                # shards the sequence: ring-attention tasks serve with
+                # inputs sharded (dp, sp); any non-ring task registered
+                # on this mesh would silently replicate its sequence
+                # work across sp — register_task refuses that instead
+                sp = self.mesh.shape["sp"]
+                bad = [b for b in self.cfg.seq_len_buckets if b % sp]
+                if bad:
+                    raise ValueError(
+                        f"seq_len_buckets {bad} not divisible by sp={sp}"
+                        f" (ring attention shards S over sp)")
         self.batcher = DynamicBatcher(
             self._run_batch,
             max_batch_size=self.cfg.max_batch_size,
@@ -131,11 +136,26 @@ class InferenceEngine:
 
     # -- registration ------------------------------------------------------
 
+    @staticmethod
+    def _is_ring(module) -> bool:
+        cfg = getattr(module, "config", None)
+        return getattr(cfg, "attention_impl", "") == "ring"
+
     def register_task(self, name: str, kind: str, module, params,
                       tokenizer: Tokenizer, labels: List[str],
                       max_seq_len: int = 0, pad_id: int = 0) -> None:
         if kind not in ("sequence", "token", "embedding"):
             raise ValueError(f"unknown task kind {kind!r}")
+        if self.mesh is not None and self.mesh.shape.get("sp", 1) > 1 \
+                and not self._is_ring(module):
+            # a non-ring model under an sp mesh would replicate its
+            # whole sequence computation across the sp devices — half
+            # the slice doing duplicate work looks healthy and is pure
+            # waste; fail loudly at registration instead
+            raise ValueError(
+                f"task {name!r}: serving mesh has sp>1 but the model's "
+                f"attention_impl is not 'ring' — sequence-parallel "
+                f"serving needs ring attention (or fold sp into dp)")
         if kind == "embedding":
             # exit_layer/output_dim are static Matryoshka knobs: each
             # configured (exit, dim) pair is its own compiled program
@@ -321,7 +341,7 @@ class InferenceEngine:
         if self.mesh is not None:
             from ..parallel import batch_sharding
 
-            sh = batch_sharding(self.mesh)
+            sh = batch_sharding(self.mesh, shard_seq=self.mesh.shape.get('sp', 1) > 1)
             ids_dev = jax.device_put(ids, sh)
             mask_dev = jax.device_put(mask, sh)
         else:
@@ -556,7 +576,7 @@ class InferenceEngine:
                     if self.mesh is not None:
                         from ..parallel import batch_sharding
 
-                        sh = batch_sharding(self.mesh)
+                        sh = batch_sharding(self.mesh, shard_seq=self.mesh.shape.get('sp', 1) > 1)
                         ids_dev = jax.device_put(ids, sh)
                         mask_dev = jax.device_put(mask, sh)
                     else:
@@ -639,7 +659,7 @@ class InferenceEngine:
             # device_put the HOST arrays directly: each device receives
             # only its shard (asarray-then-reshard would stage the full
             # batch on device 0 first — double transfer on the hot path)
-            sharding = batch_sharding(self.mesh)
+            sharding = batch_sharding(self.mesh, shard_seq=self.mesh.shape.get('sp', 1) > 1)
             ids_dev = jax.device_put(ids, sharding)
             mask_dev = jax.device_put(mask, sharding)
         else:

@@ -34,17 +34,22 @@ LONG_SEQ_DENSE_LIMIT = 4096
 
 
 def select_attention_impl(engine_cfg, max_seq_len: int,
-                          platform: Optional[str] = None) -> str:
+                          platform: Optional[str] = None,
+                          mesh=None) -> str:
     """Map the engine config's ``use_flash_attention`` knob onto a model's
     ``attention_impl`` (VERDICT r4 weak 3: the knob previously had no
     reader, so serving was dense-only at every length).
 
+    - serving mesh with an sp axis -> 'ring' (sequence-parallel exact
+      attention, ops.ring_attention — the sequence outgrew one chip);
     - real chip ('tpu' / 'axon', the tunneled TPU) + knob on -> 'flash'
       (the Pallas online-softmax kernel, O(S) memory);
     - long context anywhere else -> 'chunked' (streamed query blocks,
       O(S) memory, bit-identical oracle);
     - short sequences -> 'dense' (XLA's fused SDPA wins at small S).
     """
+    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        return "ring"
     if platform is None:
         import jax
 
@@ -175,7 +180,8 @@ def build_engine(cfg: RouterConfig, mock: bool = False, registry=None):
             component_event("bootstrap", "model_loaded", task=task,
                             kind="multimodal", architecture="siglip")
             continue
-        attn_impl = select_attention_impl(cfg.engine, eff_max_seq)
+        attn_impl = select_attention_impl(cfg.engine, eff_max_seq,
+                                          mesh=engine.mesh)
         mcfg = ModernBertConfig(
             vocab_size=hf_cfg["vocab_size"],
             hidden_size=hf_cfg["hidden_size"],
@@ -188,6 +194,7 @@ def build_engine(cfg: RouterConfig, mock: bool = False, registry=None):
             num_labels=max(len(labels), 2),
             classifier_pooling=hf_cfg.get("classifier_pooling", "cls"),
             attention_impl=attn_impl,
+            mesh=engine.mesh if attn_impl == "ring" else None,
         )
         component_event("bootstrap", "attention_impl", task=task,
                         impl=attn_impl, max_seq=eff_max_seq)

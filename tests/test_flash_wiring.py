@@ -56,6 +56,21 @@ class TestSelectAttentionImpl:
 
     def test_short_seq_off_chip_is_dense(self):
         assert select_attention_impl(_cfg(True), 512, "cpu") == "dense"
+
+    def test_sp_mesh_selects_ring_over_everything(self):
+        """A serving mesh with an sp axis means the sequence outgrew one
+        chip: ring attention wins regardless of platform or knob."""
+        from semantic_router_tpu.parallel import create_mesh
+
+        mesh = create_mesh({"dp": 2, "tp": 2, "sp": 2})
+        assert select_attention_impl(_cfg(True), 32768, "axon",
+                                     mesh=mesh) == "ring"
+        assert select_attention_impl(_cfg(False), 512, "cpu",
+                                     mesh=mesh) == "ring"
+        # sp=1 mesh: ring buys nothing — the normal policy applies
+        mesh1 = create_mesh({"dp": 4, "tp": 2, "sp": 1})
+        assert select_attention_impl(_cfg(True), 512, "axon",
+                                     mesh=mesh1) == "flash"
         assert select_attention_impl(
             _cfg(True), LONG_SEQ_DENSE_LIMIT, "cpu") == "dense"
 
@@ -150,7 +165,8 @@ class TestBuildEngineWiring:
         real = bs.select_attention_impl
         monkeypatch.setattr(
             bs, "select_attention_impl",
-            lambda ecfg, mx, platform=None: real(ecfg, mx, "axon"))
+            lambda ecfg, mx, platform=None, mesh=None:
+                real(ecfg, mx, "axon", mesh=mesh))
         engine = build_engine(_router_cfg(checkpoint_dir, flash_knob=True))
         try:
             assert engine._tasks["intent"].module.config.attention_impl \

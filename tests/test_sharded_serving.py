@@ -67,6 +67,62 @@ class TestShardedServingBank:
             plain.shutdown()
             sharded.shutdown()
 
+    def test_sequence_parallel_serving_with_ring_attention(self):
+        """Long-context serving leg: an sp axis on the SERVING mesh with
+        ring-attention models — inputs shard (dp, sp), K/V rotate on the
+        ring, results match the unsharded dense engine exactly."""
+        from semantic_router_tpu.parallel import create_mesh
+
+        tok = HashTokenizer(vocab_size=512)
+        labels = ["a", "b", "c", "d"]
+        texts = [" ".join(f"tok{j}" for j in range(i * 7 + 3))
+                 for i in range(5)]
+
+        dense_model, params = make_model_and_params()
+        plain = InferenceEngine(InferenceEngineConfig(
+            seq_len_buckets=[32, 128]))
+        plain.register_task("intent", "sequence", dense_model, params,
+                            tok, labels)
+
+        sp_engine = InferenceEngine(InferenceEngineConfig(
+            seq_len_buckets=[32, 128],
+            mesh_shape={"dp": 2, "tp": 2, "sp": 2}))
+        ring_cfg = ModernBertConfig(**TINY, attention_impl="ring",
+                                    mesh=sp_engine.mesh)
+        ring_model = ModernBertForSequenceClassification(ring_cfg)
+        sp_engine.register_task("intent", "sequence", ring_model, params,
+                                tok, labels)
+        try:
+            ref = plain.classify_batch("intent", texts)
+            got = sp_engine.classify_batch("intent", texts)
+            for r, g in zip(ref, got):
+                assert g.label == r.label
+                np.testing.assert_allclose(
+                    [g.probs[l] for l in labels],
+                    [r.probs[l] for l in labels], atol=1e-4, rtol=1e-3)
+        finally:
+            plain.shutdown()
+            sp_engine.shutdown()
+
+    def test_sp_mesh_refuses_non_ring_models(self):
+        """A dense model on an sp mesh would replicate its sequence work
+        across the sp devices — refused at registration, not silently
+        wasted."""
+        model, params = make_model_and_params()
+        eng = InferenceEngine(InferenceEngineConfig(
+            seq_len_buckets=[32], mesh_shape={"dp": 2, "sp": 4}))
+        try:
+            with pytest.raises(ValueError, match="ring"):
+                eng.register_task("intent", "sequence", model, params,
+                                  HashTokenizer(512), ["a", "b"])
+        finally:
+            eng.shutdown()
+
+    def test_sp_mesh_refuses_indivisible_buckets(self):
+        with pytest.raises(ValueError, match="divisible"):
+            InferenceEngine(InferenceEngineConfig(
+                seq_len_buckets=[50], mesh_shape={"dp": 2, "sp": 4}))
+
     def test_generative_task_serves_sharded(self):
         """VERDICT r2 weak #7: generator-backed tasks must shard under
         the serving mesh, not silently bypass it — and produce the same
