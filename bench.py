@@ -344,6 +344,16 @@ def _run_bench(platform: str) -> None:
             out = fn(params, ids, mask)
         jax.device_get(out)
         elapsed = time.perf_counter() - t0
+        # device-vs-dispatch split (MFU analysis, VERDICT r4 item 10):
+        # async dispatch returns before the device finishes — the gap
+        # between dispatch return and result arrival is device time the
+        # host could overlap; a dispatch share near 100% means the HOST
+        # is the bottleneck, not the MXU
+        t_d = time.perf_counter()
+        fut = fn(params, ids, mask)
+        dispatch_s = time.perf_counter() - t_d
+        jax.device_get(fut)
+        total_s = time.perf_counter() - t_d
         signals_per_s = (batch * measure_iters) / elapsed
         # ~2*P*T forward FLOPs; ModernBERT-base ~149M params.
         achieved_tflops = (2 * 149e6 * SEQ * batch * measure_iters
@@ -352,11 +362,14 @@ def _run_bench(platform: str) -> None:
             f"bench: impl={impl} b={batch} "
             f"{elapsed * 1e3 / measure_iters:.1f} ms/batch, "
             f"{signals_per_s:.1f} signals/s, "
-            f"~{achieved_tflops:.1f} TFLOPs achieved\n")
+            f"~{achieved_tflops:.1f} TFLOPs achieved, "
+            f"dispatch {dispatch_s * 1e3:.1f}/{total_s * 1e3:.1f} ms\n")
         return {"impl": impl, "batch": batch,
                 "ms_per_batch": round(elapsed * 1e3 / measure_iters, 2),
                 "signals_per_s": round(signals_per_s, 1),
-                "achieved_tflops": round(achieved_tflops, 1)}
+                "achieved_tflops": round(achieved_tflops, 1),
+                "dispatch_ms": round(dispatch_s * 1e3, 2),
+                "dispatch_plus_device_ms": round(total_s * 1e3, 2)}
 
     fn = jax.jit(model.apply)
     best = None
@@ -374,6 +387,27 @@ def _run_bench(platform: str) -> None:
         sweep.append(row)
         if best is None or row["signals_per_s"] > best[1]:
             best = (batch, row["signals_per_s"], "dense")
+
+    # one profiled window at the best batch (MFU analysis item 10): a
+    # small JAX profiler trace splitting XLA op time — harvested from
+    # benchmarks/results/profile_tpu by the analysis step
+    if platform != "cpu" and best is not None:
+        try:
+            prof_dir = os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "benchmarks", "results",
+                "profile_tpu")
+            os.makedirs(prof_dir, exist_ok=True)
+            ids = jnp.asarray(rng.integers(3, cfg.vocab_size,
+                                           (best[0], SEQ)), jnp.int32)
+            mask = jnp.ones((best[0], SEQ), jnp.int32)
+            jax.profiler.start_trace(prof_dir)
+            for _ in range(2):
+                jax.device_get(fn(params, ids, mask))
+            jax.profiler.stop_trace()
+            sys.stderr.write(f"bench: profiler trace -> {prof_dir}\n")
+        except Exception as exc:
+            sys.stderr.write(f"bench: profiler capture skipped "
+                             f"({type(exc).__name__}: {exc})\n")
 
     # flash arm (VERDICT r4 item 3 / weak 4): the Pallas kernel next to
     # dense at the dense-best batch.  Skipped on CPU, where "flash" is

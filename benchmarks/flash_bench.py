@@ -211,9 +211,12 @@ def run_block_tuning(report, out_path, S=8192):
         _flush(report, out_path)
 
 
-def run_classifier_sweep(report, out_path, seqs):
-    """End-to-end mmBERT-32K-geometry classify latency, b=1, flash vs dense
-    attention impl, vs the MI300X FP16 reference (evaluation.tex:50-57)."""
+def run_classifier_sweep(report, out_path, seqs,
+                         impls=("flash", "dense")):
+    """End-to-end mmBERT-32K-geometry classify latency, b=1, comparing
+    attention impls, vs the MI300X FP16 reference (evaluation.tex:50-57).
+    On TPU the pair is flash vs dense; a CPU evidence run passes
+    ("chunked", "dense") — interpret-mode flash is a non-number there."""
     import jax
     import jax.numpy as jnp
 
@@ -227,12 +230,16 @@ def run_classifier_sweep(report, out_path, seqs):
                   8192: 9656.0}
     rows = []
     params_cache = {}
-    for impl in ("flash", "dense"):
+    # bf16 is the MXU-native dtype; CPU XLA has no fast bf16 matmul, so
+    # an off-chip evidence run measures f32 (and says so in the label)
+    dtype = jnp.bfloat16 if jax.default_backend() != "cpu" \
+        else jnp.float32
+    for impl in impls:
         cfg = ModernBertConfig(
             num_labels=14, max_position_embeddings=32768,
             rope_scaling={"rope_type": "yarn", "factor": 4.0,
                           "original_max_position_embeddings": 8192},
-            attention_impl=impl, dtype=jnp.bfloat16)
+            attention_impl=impl, dtype=dtype)
         model = ModernBertForSequenceClassification(cfg)
         if "p" not in params_cache:
             rng = np.random.default_rng(0)
@@ -240,7 +247,7 @@ def run_classifier_sweep(report, out_path, seqs):
                                jnp.int32)
             p = model.init(jax.random.PRNGKey(0), ids0)
             params_cache["p"] = jax.tree_util.tree_map(
-                lambda x: x.astype(jnp.bfloat16)
+                lambda x: x.astype(dtype)
                 if x.dtype == jnp.float32 else x, p)
         params = params_cache["p"]
         fn = jax.jit(lambda p, i, m: model.apply(p, i, m).sum())
@@ -264,7 +271,8 @@ def run_classifier_sweep(report, out_path, seqs):
             sys.stderr.write(f"classifier sweep {row}\n")
             rows.append(row)
             report["classifier_sweep"] = {
-                "model": "ModernBERT-base geometry, YaRN 32K, bf16, b=1",
+                "model": f"ModernBERT-base geometry, YaRN 32K, "
+                         f"{jnp.dtype(dtype).name}, b=1",
                 "reference": "MI300X ORT FP16 SDPA, evaluation.tex:50-57",
                 "rows": rows,
             }
