@@ -124,8 +124,13 @@ def _parse_args():
                          "(the default mode supervises retries in fresh "
                          "children — JAX caches a failed backend init "
                          "for the life of the process)")
-    ap.add_argument("--attempt-budget", type=float, default=600.0,
-                    help="per-attempt claim watchdog in the child")
+    ap.add_argument("--attempt-budget", type=float, default=1800.0,
+                    help="per-attempt claim watchdog in the child.  The "
+                         "claim BLOCKS in a queue when the pool is busy "
+                         "(r5 observed both modes); killing a queued "
+                         "claim may forfeit its position, so the budget "
+                         "errs long — the supervisor still recycles a "
+                         "truly wedged child")
     return ap.parse_args()
 
 
