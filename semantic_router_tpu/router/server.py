@@ -2314,7 +2314,14 @@ class RouterServer:
                         resp["truncated"] = True
                     self._json(200, resp)
                 else:
-                    r = eng.classify(engine_task, text)
+                    if body.get("windowed"):
+                        # stride windows cover the WHOLE input instead
+                        # of flagged tail-drop (engine.classify_windowed)
+                        r = eng.classify_windowed(
+                            engine_task, text,
+                            stride=int(body.get("stride", 64)))
+                    else:
+                        r = eng.classify(engine_task, text)
                     resp = {"label": r.label,
                             "class_idx": r.index,
                             "confidence": r.confidence,

@@ -148,6 +148,79 @@ class TestEngineSurfacing:
         assert "llm_tokenizer_truncated_inputs_total" in text
 
 
+class TestClassifyWindowed:
+    def test_covers_whole_input_unflagged(self):
+        """The stride alternative to flagged tail-drop: a 40K-char input
+        classifies over windows covering ALL of it — result unflagged."""
+        eng = _tiny_engine(max_seq_len=32)
+        try:
+            text = " ".join(f"word{i}" for i in range(5000))
+            out = eng.classify_windowed("intent", text, stride=8)
+            assert out.truncated is False
+            assert out.label in ("a", "b", "c")
+            assert abs(sum(out.probs.values()) - 1.0) < 1e-5
+            # same engine, plain classify: flagged tail-drop
+            assert eng.classify("intent", text).truncated is True
+        finally:
+            eng.shutdown()
+
+    def test_short_input_delegates_to_plain_path(self):
+        eng = _tiny_engine(max_seq_len=32)
+        try:
+            plain = eng.classify("intent", "short request")
+            windowed = eng.classify_windowed("intent", "short request")
+            assert windowed.label == plain.label
+            assert windowed.probs == pytest.approx(plain.probs)
+        finally:
+            eng.shutdown()
+
+    def test_window_consensus_weights_by_content(self):
+        """Windows agree → same label as any single window; the combined
+        confidence is a convex mix of the window probs."""
+        eng = _tiny_engine(max_seq_len=16)
+        try:
+            text = " ".join("alpha" for _ in range(200))  # uniform text
+            out = eng.classify_windowed("intent", text, stride=4)
+            single = eng.classify("intent", "alpha " * 10)
+            assert out.label == single.label
+        finally:
+            eng.shutdown()
+
+
+class TestWindowedOverHTTP:
+    def test_classify_endpoint_windowed_flag(self, fixture_config_path):
+        import json as _json
+        import urllib.request
+
+        from semantic_router_tpu.config import load_config
+        from semantic_router_tpu.router import Router, RouterServer
+
+        eng = _tiny_engine(max_seq_len=32)
+        cfg = load_config(fixture_config_path)
+        router = Router(cfg, engine=eng)
+        server = RouterServer(router, cfg).start()
+        try:
+            text = " ".join(f"word{i}" for i in range(2000))
+
+            def post(body):
+                req = urllib.request.Request(
+                    f"{server.url}/api/v1/classify/intent",
+                    data=_json.dumps(body).encode(),
+                    headers={"content-type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    return _json.loads(resp.read())
+
+            flagged = post({"text": text})
+            assert flagged.get("truncated") is True
+            whole = post({"text": text, "windowed": True, "stride": 8})
+            assert "truncated" not in whole
+            assert whole["label"] in ("a", "b", "c")
+        finally:
+            server.stop()
+            router.shutdown()
+            eng.shutdown()
+
+
 class TestSignalSurfacing:
     def test_domain_hit_carries_truncated_detail(self):
         from semantic_router_tpu.signals.base import RequestContext
