@@ -622,10 +622,33 @@ class InferenceEngine:
                     else:
                         ids_dev = jnp.asarray(ids)
                         mask_dev = jnp.asarray(mask)
-                    out = t.apply_fn(t.params, ids_dev, mask_dev)
-                    jax.block_until_ready(out)
+                    if t.kind == "embedding":
+                        # every configured Matryoshka variant is its own
+                        # XLA program (static exit/dim): warm them ALL —
+                        # engine.matryoshka_layers/dims declare which
+                        # (layer, dim) pairs this deployment serves
+                        for el, od in self._matryoshka_variants():
+                            out = t.apply_fn(t.params, ids_dev, mask_dev,
+                                             exit_layer=el, output_dim=od)
+                            jax.block_until_ready(out)
+                    else:
+                        out = t.apply_fn(t.params, ids_dev, mask_dev)
+                        jax.block_until_ready(out)
                 except Exception:
                     pass
+
+    def _matryoshka_variants(self):
+        """(exit_layer, output_dim) pairs to pre-compile: the full model
+        plus every configured 2D-Matryoshka combination."""
+        variants = [(None, None)]
+        for el in (self.cfg.matryoshka_layers or []):
+            variants.append((int(el), None))
+        for od in (self.cfg.matryoshka_dims or []):
+            variants.append((None, int(od)))
+        for el in (self.cfg.matryoshka_layers or []):
+            for od in (self.cfg.matryoshka_dims or []):
+                variants.append((int(el), int(od)))
+        return variants
 
     def shutdown(self) -> None:
         self.batcher.shutdown()

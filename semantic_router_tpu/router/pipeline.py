@@ -191,13 +191,17 @@ class Router:
         ) if pc_cfg.get("enabled") else None
         self.pc_min_tokens = int(pc_cfg.get("min_tokens", 512))
 
+        # semantic_cache.embedding_model selects WHICH embedding task
+        # backs the cache (a cheaper/smaller model than the signal
+        # families'); empty = the router's default embedding task
+        cache_task = cfg.semantic_cache.embedding_model or embedding_task
         if cache is not None:
             self.cache = cache
         elif cfg.semantic_cache.enabled and engine is not None \
-                and engine.has_task(embedding_task):
+                and engine.has_task(cache_task):
             self.cache = build_cache(
                 cfg.semantic_cache,
-                lambda text: engine.embed(embedding_task, [text])[0])
+                lambda text: engine.embed(cache_task, [text])[0])
         elif cfg.semantic_cache.enabled \
                 and self._remote_embedder_cache is not None:
             # no local embedding task, but a remote provider is

@@ -1,0 +1,114 @@
+"""Every declared config knob has a reader (the r4 verdict's dead-knob
+class: a parsed-but-unread field silently lies to operators).
+
+Covers the two knobs a field-vs-reader scan found dead after
+use_flash_attention was wired: semantic_cache.embedding_model and
+engine.matryoshka_layers/dims.
+"""
+
+import numpy as np
+import pytest
+
+from semantic_router_tpu.config import load_config
+from semantic_router_tpu.config.schema import InferenceEngineConfig
+from semantic_router_tpu.engine.testing import make_test_engine
+
+
+class TestCacheEmbeddingModelKnob:
+    def test_cache_uses_the_configured_task(self, fixture_config_path):
+        from semantic_router_tpu.router import Router
+
+        calls = []
+
+        class SpyEngine:
+            def has_task(self, name):
+                return name in ("embedding", "cheap_embed")
+
+            def task_kind(self, name):
+                return "embedding" if self.has_task(name) else ""
+
+            def embed(self, task, texts, **kw):
+                calls.append(task)
+                out = np.zeros((len(texts), 8), np.float32)
+                out[:, hash(texts[0]) % 8] = 1.0
+                return out
+
+            def tasks(self):
+                return ["embedding", "cheap_embed"]
+
+            def shutdown(self):
+                pass
+
+        cfg = load_config(fixture_config_path)
+        cfg.semantic_cache.enabled = True
+        cfg.semantic_cache.embedding_model = "cheap_embed"
+        router = Router(cfg, engine=SpyEngine())
+        try:
+            assert router.cache is not None
+            router.cache.find_similar("hello there")
+            assert calls and all(c == "cheap_embed" for c in calls)
+        finally:
+            router.shutdown()
+
+    def test_unset_knob_keeps_default_task(self, fixture_config_path):
+        from semantic_router_tpu.router import Router
+
+        calls = []
+
+        class SpyEngine:
+            def has_task(self, name):
+                return name == "embedding"
+
+            def task_kind(self, name):
+                return "embedding" if name == "embedding" else ""
+
+            def embed(self, task, texts, **kw):
+                calls.append(task)
+                return np.zeros((len(texts), 8), np.float32)
+
+            def tasks(self):
+                return ["embedding"]
+
+            def shutdown(self):
+                pass
+
+        cfg = load_config(fixture_config_path)
+        cfg.semantic_cache.enabled = True
+        router = Router(cfg, engine=SpyEngine())
+        try:
+            assert router.cache is not None
+            router.cache.find_similar("hi")
+            assert calls and all(c == "embedding" for c in calls)
+        finally:
+            router.shutdown()
+
+
+class TestMatryoshkaWarmupKnobs:
+    def test_variants_enumerated(self):
+        eng = make_test_engine(tasks=[], engine_cfg=InferenceEngineConfig(
+            matryoshka_layers=[2], matryoshka_dims=[16, 32]))
+        try:
+            got = eng._matryoshka_variants()
+            assert (None, None) in got
+            assert (2, None) in got
+            assert (None, 16) in got and (None, 32) in got
+            assert (2, 16) in got and (2, 32) in got
+        finally:
+            eng.shutdown()
+
+    def test_warmup_precompiles_and_variants_serve(self):
+        from semantic_router_tpu.engine.testing import (
+            make_embedding_engine,
+        )
+
+        eng = make_embedding_engine(engine_cfg=InferenceEngineConfig(
+            seq_len_buckets=[16], max_batch_size=4, max_wait_ms=1,
+            matryoshka_dims=[8]))
+        try:
+            eng.warmup(tasks=["embedding"])
+            out = eng.embed("embedding", ["hello"], output_dim=8)
+            assert out.shape[-1] == 8
+            full = eng.embed("embedding", ["hello"])
+            assert full.shape[-1] > 8
+        finally:
+            eng.shutdown()
