@@ -6,6 +6,11 @@ from .mesh import (
     create_mesh,
     replicated,
 )
+from .multihost import (
+    init_multihost,
+    process_local_batch,
+    replicated_from_host,
+)
 from .sharding import param_shardings, shard_params
 from .train_step import (
     TrainState,
@@ -16,6 +21,8 @@ from .train_step import (
 
 __all__ = [
     "AXIS_DATA", "AXIS_SEQ", "AXIS_TENSOR", "TrainState", "batch_sharding",
-    "create_mesh", "cross_entropy_loss", "make_lora_optimizer",
-    "make_train_step", "param_shardings", "replicated", "shard_params",
+    "create_mesh", "cross_entropy_loss", "init_multihost",
+    "make_lora_optimizer", "make_train_step", "param_shardings",
+    "process_local_batch", "replicated", "replicated_from_host",
+    "shard_params",
 ]

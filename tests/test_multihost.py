@@ -1,0 +1,153 @@
+"""Multi-host distributed runtime (parallel.multihost — the DCN leg):
+TWO real OS processes join one distributed runtime, build one global
+(dp, tp) mesh, and run the SPMD LoRA training step — each host feeding
+only its own batch slice — and must reproduce the single-process loss.
+
+The reference's analog is its NCCL/MPI multi-node training path; here
+the cross-process collectives ride jax's distributed CPU backend (gloo
+over TCP — the DCN stand-in this image can actually exercise).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r'''
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+sys.path.insert(0, %(repo)r)
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from semantic_router_tpu.parallel import (
+    create_mesh, init_multihost, make_lora_optimizer, make_train_step,
+    process_local_batch, replicated_from_host,
+)
+
+pid = int(sys.argv[1]); port = sys.argv[2]
+assert init_multihost(f"127.0.0.1:{port}", 2, pid)
+assert len(jax.devices()) == 4, jax.devices()
+assert len(jax.local_devices()) == 2
+
+import jax.numpy as jnp
+import numpy as np
+from semantic_router_tpu.models.lora import (
+    LoRAConfig, LoRAModernBertForSequenceClassification,
+)
+from semantic_router_tpu.models.modernbert import ModernBertConfig
+
+cfg = ModernBertConfig(vocab_size=512, hidden_size=64,
+                       intermediate_size=96, num_hidden_layers=2,
+                       num_attention_heads=4,
+                       max_position_embeddings=64, local_attention=8,
+                       num_labels=3)
+lora = LoRAConfig(rank=4, alpha=8.0, num_tasks=2)
+model = LoRAModernBertForSequenceClassification(cfg, lora, num_labels=3)
+
+# dp outermost spans the hosts; tp pairs stay intra-host
+mesh = create_mesh({"dp": 2, "tp": 2})
+
+rng = np.random.default_rng(0)
+GB, S = 8, 16  # global batch; every host derives the SAME full batch...
+ids = rng.integers(3, 512, (GB, S)).astype(np.int32)
+mask = np.ones((GB, S), np.int32)
+labels = rng.integers(0, 3, (GB,)).astype(np.int32)
+params = model.init(jax.random.PRNGKey(0), jnp.asarray(ids[:1]),
+                    jnp.asarray(mask[:1]))
+
+def apply_fn(p, i, m):
+    return model.apply(p, i, m, task_index=0)
+
+opt = make_lora_optimizer(learning_rate=1e-3)
+init_state, step = make_train_step(apply_fn, opt, mesh)
+
+half = GB // 2
+with mesh:
+    state = init_state(params)
+    # ...but FEEDS only its own half (the multi-host input contract)
+    g_ids = process_local_batch(mesh, ids[pid * half:(pid + 1) * half], GB)
+    g_mask = process_local_batch(mesh, mask[pid * half:(pid + 1) * half], GB)
+    g_labels = process_local_batch(mesh, labels[pid * half:(pid + 1) * half], GB)
+    state, metrics = step(state, g_ids, g_mask, g_labels)
+    print("RESULT " + json.dumps({"pid": pid,
+                                  "loss": float(metrics["loss"]),
+                                  "step": int(state.step)}), flush=True)
+'''
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_training_step_matches_single_process(tmp_path):
+    port = _free_port()
+    child_text = CHILD % {"repo": REPO}
+    script = tmp_path / "child.py"
+    script.write_text(child_text)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(i), str(port)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=420)
+        outs.append(out)
+        assert p.returncode == 0, out[-2000:]
+    losses = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                rec = json.loads(line[len("RESULT "):])
+                losses[rec["pid"]] = rec["loss"]
+                assert rec["step"] == 1
+    assert set(losses) == {0, 1}
+    # both hosts computed the SAME global loss (the dp psum crossed
+    # processes)
+    assert losses[0] == pytest.approx(losses[1], abs=1e-6)
+
+    # single-process oracle: same seeds, full batch, 4 local devices
+    oracle_text = (
+        child_text
+        .replace('assert init_multihost(f"127.0.0.1:{port}", 2, pid)',
+                 "pass")
+        .replace("--xla_force_host_platform_device_count=2",
+                 "--xla_force_host_platform_device_count=4")
+        .replace("assert len(jax.local_devices()) == 2", "pass")
+        .replace("half = GB // 2", "half = GB")
+        .replace("ids[pid * half:(pid + 1) * half]", "ids")
+        .replace("mask[pid * half:(pid + 1) * half]", "mask")
+        .replace("labels[pid * half:(pid + 1) * half]", "labels"))
+    oracle = tmp_path / "oracle.py"
+    oracle.write_text(oracle_text)
+    p = subprocess.run([sys.executable, str(oracle), "0", str(port)],
+                       env=env, capture_output=True, text=True,
+                       timeout=420)
+    assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
+    ref = None
+    for line in p.stdout.splitlines():
+        if line.startswith("RESULT "):
+            ref = json.loads(line[len("RESULT "):])["loss"]
+    assert ref is not None
+    assert losses[0] == pytest.approx(ref, abs=1e-5)
+
+
+def test_init_multihost_noop_without_coordinator(monkeypatch):
+    from semantic_router_tpu.parallel import init_multihost
+
+    monkeypatch.delenv("SRT_COORDINATOR", raising=False)
+    assert init_multihost() is False
+    assert init_multihost("127.0.0.1:1", num_processes=1) is False
