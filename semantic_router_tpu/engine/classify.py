@@ -187,6 +187,13 @@ class InferenceEngine:
         stacked (the last two pin the path — operator override)."""
         from .pathing import DualPathChooser
 
+        if self.mesh is not None and self.mesh.shape.get("sp", 1) > 1 \
+                and not self._is_ring(module):
+            # same rule as register_task: sp devices must shard the
+            # sequence, not replicate it
+            raise ValueError(
+                "stacked bank: serving mesh has sp>1 but the bank "
+                "model's attention_impl is not 'ring'")
         seq_tasks = [t for t in module.task_names
                      if module.task_kinds.get(t, "sequence") == "sequence"]
         for t in seq_tasks:
@@ -488,6 +495,25 @@ class InferenceEngine:
 
     def tasks(self) -> List[str]:
         return list(self._tasks)
+
+    def task_info(self, name: str) -> Dict[str, Any]:
+        """Serving metadata for the management API (/info/models):
+        kind, labels, max_seq_len, attention impl, mesh placement."""
+        t = self._tasks.get(name)
+        if t is None:
+            return {}
+        impl = getattr(getattr(t.module, "config", None),
+                       "attention_impl", None)
+        info: Dict[str, Any] = {
+            "task": name, "kind": t.kind,
+            "max_seq_len": t.max_seq_len,
+        }
+        if impl:
+            info["attention_impl"] = impl
+        if self.mesh is not None:
+            info["mesh"] = {k: int(v) for k, v in
+                            self.mesh.shape.items() if v > 1}
+        return info
 
     # -- public inference --------------------------------------------------
 

@@ -806,11 +806,20 @@ class RouterServer:
                     eng = server.router.engine
                     tasks = []
                     if eng is not None:
-                        tasks = [{"task": t, "kind": eng.task_kind(t),
-                                  "labels": (eng.task_labels(t)
-                                             if eng.task_kind(t) in
-                                             ("sequence", "token") else [])}
-                                 for t in eng.tasks()]
+                        for t in eng.tasks():
+                            row = {"task": t, "kind": eng.task_kind(t),
+                                   "labels": (eng.task_labels(t)
+                                              if eng.task_kind(t) in
+                                              ("sequence", "token")
+                                              else [])}
+                            # serving metadata (attention impl, seq cap,
+                            # mesh placement) when the engine exposes it
+                            # (test stand-in engines may not)
+                            info = getattr(eng, "task_info",
+                                           lambda _n: {})(t)
+                            row.update({k: v for k, v in info.items()
+                                        if k not in row})
+                            tasks.append(row)
                     self._json(200, {"tasks": tasks})
                 elif path.startswith("/dashboard/api/"):
                     self._dashboard(path)
