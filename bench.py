@@ -298,7 +298,9 @@ def _run_bench(platform: str) -> None:
     # has no fast bf16 matmul path — f32 there, bf16 (MXU-native) on TPU.
     # On TPU, sweep batch sizes and report the best sustained rate: larger
     # batches fill the MXU better.
-    batches = [8] if platform == "cpu" else [32, 64, 128]
+    # the sweep keeps climbing while throughput improves; an OOM at a
+    # larger batch keeps the best smaller-batch number (guard below)
+    batches = [8] if platform == "cpu" else [32, 64, 128, 256]
     measure_iters = 2 if platform == "cpu" else 8
     bench_dtype = "float32" if platform == "cpu" else "bfloat16"
 

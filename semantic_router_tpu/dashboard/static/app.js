@@ -157,6 +157,14 @@ async function refresh() {
       `<td>${esc(e.stage)}</td>` +
       `<td>${esc(JSON.stringify(e.detail).slice(0, 60))}</td></tr>`
     ).join("");
+
+    const im = await api("/info/models");
+    $("tasks").innerHTML = (im.tasks || []).map(t =>
+      `<tr><td>${esc(t.task)}</td><td>${esc(t.kind)}</td>` +
+      `<td>${esc(t.attention_impl || "—")}</td>` +
+      `<td>${esc(t.max_seq_len || "—")}</td>` +
+      `<td>${esc(t.mesh ? JSON.stringify(t.mesh) : "—")}</td></tr>`
+    ).join("");
   } catch (e) {
     $("error").textContent = e.message;
     $("livedot").style.background = "var(--serious)";
