@@ -1,0 +1,147 @@
+"""Resilience under injected backend faults (reference
+bench/openai_fault_proxy.py role): the router's behavior against a
+misbehaving backend is MEASURED through router.fault_proxy, not assumed.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from semantic_router_tpu.config import load_config
+from semantic_router_tpu.router import MockVLLMServer, Router, RouterServer
+from semantic_router_tpu.router.fault_proxy import FaultProxy
+
+
+def _chat(url, text):
+    req = urllib.request.Request(
+        f"{url}/v1/chat/completions",
+        data=json.dumps({"model": "auto", "messages": [
+            {"role": "user", "content": text}]}).encode(),
+        headers={"content-type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+@pytest.fixture()
+def backend():
+    b = MockVLLMServer().start()
+    yield b
+    b.stop()
+
+
+class TestProxyFaultModes:
+    def test_clean_proxy_is_transparent(self, backend,
+                                        fixture_config_path):
+        proxy = FaultProxy(backend.url).start()
+        cfg = load_config(fixture_config_path)
+        router = Router(cfg, engine=None)
+        server = RouterServer(router, cfg,
+                              default_backend=proxy.url).start()
+        try:
+            status, body, headers = _chat(server.url,
+                                          "this is urgent, fix asap")
+            assert status == 200
+            assert headers["x-vsr-selected-decision"] == "urgent_route"
+            echoed = json.loads(body["choices"][0]["message"]["content"])
+            assert echoed["model"] == "qwen3-8b"  # rewrite survived proxy
+            assert proxy.stats["ok"] == 1
+        finally:
+            server.stop()
+            router.shutdown()
+            proxy.stop()
+
+    def test_backend_5xx_surfaces_not_500s_the_router(
+            self, backend, fixture_config_path):
+        """A backend 503 must come back AS the backend's error (the
+        router stays healthy), with routing still recorded."""
+        proxy = FaultProxy(backend.url, plan=["error"]).start()
+        cfg = load_config(fixture_config_path)
+        router = Router(cfg, engine=None)
+        server = RouterServer(router, cfg,
+                              default_backend=proxy.url).start()
+        try:
+            status, body, _ = _chat(server.url, "hello")
+            assert status == 503
+            assert body["error"]["type"] == "fault_proxy"
+            # router itself still healthy
+            with urllib.request.urlopen(f"{server.url}/health",
+                                        timeout=10) as resp:
+                assert resp.status == 200
+        finally:
+            server.stop()
+            router.shutdown()
+            proxy.stop()
+
+    def test_disconnect_after_read_never_replayed(
+            self, backend, fixture_config_path):
+        """close-after-read (backend may have executed the request): the
+        router surfaces 502 and must NOT replay — at-most-once, the same
+        contract test_e2e_profiles pins for multi-endpoint."""
+        proxy = FaultProxy(backend.url, plan=["disconnect"]).start()
+        cfg = load_config(fixture_config_path)
+        router = Router(cfg, engine=None)
+        server = RouterServer(router, cfg,
+                              default_backend=proxy.url).start()
+        try:
+            before = backend.hits
+            status, body, _ = _chat(server.url, "hello")
+            assert status == 502
+            assert "unreachable" in body["error"]["message"]
+            assert backend.hits == before  # nothing reached the backend
+        finally:
+            server.stop()
+            router.shutdown()
+            proxy.stop()
+
+    def test_intermittent_faults_with_cache_fail_soft(
+            self, backend, fixture_config_path):
+        """Deterministic alternating ok/error plan: successful turns
+        populate the semantic cache, and cache hits keep serving the
+        SAME question even on turns where the backend errors."""
+        from semantic_router_tpu.engine.testing import (
+            make_embedding_engine,
+        )
+
+        proxy = FaultProxy(backend.url, plan=["ok", "error"]).start()
+        cfg = load_config(fixture_config_path)
+        eng = make_embedding_engine()
+        router = Router(cfg, engine=eng)
+        server = RouterServer(router, cfg,
+                              default_backend=proxy.url).start()
+        try:
+            q = "please debug the resilience cache function"
+            first = _chat(server.url, q)
+            assert first[0] == 200  # plan slot: ok → cached
+            second = _chat(server.url, q)  # plan slot: error — but...
+            assert second[0] == 200  # ...the cache answers
+            assert second[2].get("x-vsr-cache-hit") == "true"
+        finally:
+            server.stop()
+            router.shutdown()
+            eng.shutdown()
+            proxy.stop()
+
+    def test_latency_injection_measured(self, backend,
+                                        fixture_config_path):
+        import time
+
+        proxy = FaultProxy(backend.url, latency_ms=150).start()
+        cfg = load_config(fixture_config_path)
+        router = Router(cfg, engine=None)
+        server = RouterServer(router, cfg,
+                              default_backend=proxy.url).start()
+        try:
+            t0 = time.perf_counter()
+            status, _, _ = _chat(server.url, "hello")
+            dt = time.perf_counter() - t0
+            assert status == 200
+            assert dt >= 0.15
+        finally:
+            server.stop()
+            router.shutdown()
+            proxy.stop()
