@@ -290,8 +290,17 @@ int http_request(const std::string& method, const std::string& path,
 
 bool post_json(const std::string& path, const std::string& body,
                JValue* out) {
+  // classify/embed POSTs are idempotent reads of the engine, so one
+  // retry on a transport-level failure (status < 0: connect/timeout on
+  // a FRESH connection, never an HTTP error) is safe and absorbs the
+  // transient refusals a loaded single-core host produces.
   std::string resp;
   int status = http_request("POST", path, body, &resp);
+  if (status < 0) {
+    usleep(50 * 1000);
+    resp.clear();
+    status = http_request("POST", path, body, &resp);
+  }
   if (status != 200) return false;
   JParser parser(resp);
   *out = parser.parse();
