@@ -152,6 +152,41 @@ def flash_attention_pallas(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return out.reshape(B, H, Sp, D)[:, :, :S, :]
 
 
+_TUNED_BLOCKS: "Optional[tuple]" = None
+
+
+def tuned_blocks() -> tuple:
+    """(block_q, block_k) for the Pallas kernel: explicit env override
+    (SRT_FLASH_BLOCK_Q/K) > the best row of a recorded on-chip
+    block-tuning sweep (benchmarks/results/flash_tpu_latest.json,
+    written by tpu_session/flash_bench; path overridable via
+    SRT_FLASH_TUNING_PATH) > the defaults.  Read once per process —
+    the measure→record→serve feedback loop, closed."""
+    global _TUNED_BLOCKS
+    if _TUNED_BLOCKS is None:
+        import json
+        import os
+
+        bq = int(os.environ.get("SRT_FLASH_BLOCK_Q", "0") or 0)
+        bk = int(os.environ.get("SRT_FLASH_BLOCK_K", "0") or 0)
+        if not (bq and bk):
+            path = os.environ.get("SRT_FLASH_TUNING_PATH") or os.path.join(
+                os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))),
+                "benchmarks", "results", "flash_tpu_latest.json")
+            try:
+                with open(path) as f:
+                    rows = json.load(f)["block_tuning"]["rows"]
+                best = min((r for r in rows if r.get("ms")),
+                           key=lambda r: r["ms"])
+                bq = bq or int(best["block_q"])
+                bk = bk or int(best["block_k"])
+            except (OSError, KeyError, ValueError, TypeError):
+                pass
+        _TUNED_BLOCKS = (bq or DEFAULT_BLOCK_Q, bk or DEFAULT_BLOCK_K)
+    return _TUNED_BLOCKS
+
+
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     key_padding_mask: Optional[jnp.ndarray] = None,
                     window: int = 0, causal: bool = False,
@@ -161,8 +196,10 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     platform = q.devices().pop().platform if hasattr(q, "devices") else \
         jax.default_backend()
     if platform in ("tpu", "axon"):
+        bq, bk = tuned_blocks()
         return flash_attention_pallas(q, k, v, key_padding_mask,
                                       window=window, causal=causal,
+                                      block_q=bq, block_k=bk,
                                       scale=scale)
     if causal:
         S = q.shape[2]

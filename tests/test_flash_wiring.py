@@ -196,3 +196,49 @@ class TestBuildEngineWiring:
             assert abs(sum(res.probs.values()) - 1.0) < 1e-3
         finally:
             engine.shutdown()
+
+
+class TestTunedBlocks:
+    """The measure→record→serve loop: a recorded on-chip block-tuning
+    sweep drives the serving kernel's block sizes."""
+
+    def _reset(self):
+        import semantic_router_tpu.ops.flash_attention as fa
+
+        fa._TUNED_BLOCKS = None
+        return fa
+
+    def test_best_recorded_row_wins(self, tmp_path, monkeypatch):
+        import json
+
+        fa = self._reset()
+        rec = {"block_tuning": {"seq": 8192, "rows": [
+            {"block_q": 128, "block_k": 128, "ms": 9.0},
+            {"block_q": 256, "block_k": 512, "ms": 4.5},
+            {"block_q": 512, "block_k": 512, "ms": None,
+             "error": "RESOURCE_EXHAUSTED"},
+        ]}}
+        p = tmp_path / "flash_tpu_latest.json"
+        p.write_text(json.dumps(rec))
+        monkeypatch.setenv("SRT_FLASH_TUNING_PATH", str(p))
+        monkeypatch.delenv("SRT_FLASH_BLOCK_Q", raising=False)
+        monkeypatch.delenv("SRT_FLASH_BLOCK_K", raising=False)
+        assert fa.tuned_blocks() == (256, 512)
+        self._reset()
+
+    def test_env_override_beats_recording(self, tmp_path, monkeypatch):
+        fa = self._reset()
+        monkeypatch.setenv("SRT_FLASH_BLOCK_Q", "512")
+        monkeypatch.setenv("SRT_FLASH_BLOCK_K", "128")
+        assert fa.tuned_blocks() == (512, 128)
+        self._reset()
+
+    def test_defaults_without_recording(self, tmp_path, monkeypatch):
+        fa = self._reset()
+        monkeypatch.setenv("SRT_FLASH_TUNING_PATH",
+                           str(tmp_path / "missing.json"))
+        monkeypatch.delenv("SRT_FLASH_BLOCK_Q", raising=False)
+        monkeypatch.delenv("SRT_FLASH_BLOCK_K", raising=False)
+        assert fa.tuned_blocks() == (fa.DEFAULT_BLOCK_Q,
+                                     fa.DEFAULT_BLOCK_K)
+        self._reset()
