@@ -232,10 +232,31 @@ def main() -> int:
         with open(marker, "w") as f:
             json.dump(summary, f, indent=1)
 
+    def stage_flagship_tuned() -> None:
+        # the first flagship pass ran its flash arm with DEFAULT block
+        # sizes (tuning didn't exist yet).  Now that stage 2 recorded
+        # the block-tuning sweep, drop the in-process cache and re-run —
+        # the headline keeps whichever capture is best, and serving
+        # picks the same tuned blocks via ops.flash_attention
+        import shutil
+
+        from semantic_router_tpu.ops import flash_attention as fa
+
+        first = os.path.join(RESULTS, "bench_tpu_latest.json")
+        if os.path.exists(first):  # both captures persist
+            shutil.copy(first,
+                        os.path.join(RESULTS, "bench_tpu_firstpass.json"))
+        fa._TUNED_BLOCKS = None
+        stage_flagship(summary["stages"])
+        # both passes stay visible in the summary: "flagship" = the
+        # default-blocks first pass, "flagship_tuned" = this one
+        summary["stages"]["flagship_tuned"] = "ok"
+
     stages = [
         ("flagship", lambda: stage_flagship(summary["stages"])),
         ("flash", lambda: stage_flash(summary["stages"], args.seqs,
                                       args.cls_seqs, args.block_s)),
+        ("flagship_tuned", stage_flagship_tuned),
         ("replay", lambda: stage_replay(summary["stages"], args.replay_n,
                                         args.replay_concurrency)),
     ]
