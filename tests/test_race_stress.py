@@ -171,6 +171,64 @@ class TestEventBusUnderContention:
         assert len(got) == n_threads * per
 
 
+class TestKubewatchUnderChurn:
+    def test_concurrent_cr_applies_converge_to_last_state(self, tmp_path):
+        """Hammer the operator with concurrent CR applies from several
+        threads: the debounced reconcile must neither crash nor wedge,
+        and the on-disk config must converge to the FINAL CR state (no
+        torn render, no lost final update)."""
+        import time as _time
+
+        import yaml as _yaml
+
+        from semantic_router_tpu.runtime.kubewatch import (
+            KubeClient,
+            KubeOperator,
+            MiniKubeAPI,
+        )
+
+        api = MiniKubeAPI()
+        cfg_path = str(tmp_path / "router.yaml")
+        op = KubeOperator(KubeClient(api.url), cfg_path,
+                          debounce_s=0.02).start()
+        try:
+            base_pool = {"kind": "IntelligentPool",
+                         "metadata": {"name": "pool"},
+                         "spec": {"defaultModel": "m0",
+                                  "models": [{"name": f"m{i}"}
+                                             for i in range(8)]}}
+            api.apply("intelligentpools", json.loads(
+                json.dumps(base_pool)))
+
+            def churn(t):
+                for i in range(10):
+                    p = json.loads(json.dumps(base_pool))
+                    p["spec"]["defaultModel"] = f"m{(t * 10 + i) % 8}"
+                    api.apply("intelligentpools", p)
+
+            with ThreadPoolExecutor(max_workers=4) as pool:
+                list(pool.map(churn, range(4)))
+            # the authoritative final state is whatever the API holds
+            final = api._objects["intelligentpools"]["default/pool"][
+                "spec"]["defaultModel"]
+            deadline = _time.time() + 15
+            seen = None
+            while _time.time() < deadline:
+                try:
+                    seen = _yaml.safe_load(open(cfg_path))[
+                        "default_model"]
+                    if seen == final:
+                        break
+                except Exception:
+                    pass
+                _time.sleep(0.05)
+            assert seen == final, (seen, final)
+            assert op.last_status == "applied"
+        finally:
+            op.stop()
+            api.close()
+
+
 class TestTokenIssuerUnderContention:
     def test_parallel_issue_verify(self):
         from semantic_router_tpu.dashboard.auth import TokenIssuer
