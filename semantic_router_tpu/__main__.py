@@ -62,7 +62,18 @@ def main(argv=None) -> int:
     comp_p.add_argument("--envoy-image", default="envoyproxy/envoy:v1.31-latest")
     comp_p.add_argument("--router-image", default="semantic-router-tpu:latest")
 
+    sub.add_parser(
+        "openapi", help="print the management-API OpenAPI 3.0 document "
+                        "(same generator that serves GET /openapi.json)")
+
     args = ap.parse_args(argv)
+
+    if args.command == "openapi":
+        from .router.openapi import build_spec
+        from .router.server import API_CATALOG
+
+        print(json.dumps(build_spec(API_CATALOG), indent=1))
+        return 0
 
     if args.command == "migrate-config":
         import yaml

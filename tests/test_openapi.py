@@ -101,3 +101,14 @@ class TestServedDocument:
             assert page == DOCS_HTML
         finally:
             server.stop()
+
+
+class TestCLIExport:
+    def test_openapi_subcommand_prints_valid_spec(self, capsys):
+        from semantic_router_tpu.__main__ import main
+
+        rc = main(["openapi"])
+        assert rc == 0
+        spec = json.loads(capsys.readouterr().out)
+        assert validate_spec(spec) == []
+        assert spec["openapi"].startswith("3.")
