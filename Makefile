@@ -1,6 +1,7 @@
 PY ?= python
+SHELL := /bin/bash
 
-.PHONY: test test-fast native bench bench-replay perf perf-record \
+.PHONY: test test-fast tier1 native bench bench-replay perf perf-record \
 	serve-mock clean
 
 bench-replay:
@@ -12,6 +13,11 @@ test:
 
 test-fast:
 	$(PY) -m pytest tests/ -q -m "not slow"
+
+# the EXACT tier-1 verify the ROADMAP pins (CPU-forced, bounded, dot
+# count emitted) — what the driver runs after every PR
+tier1:
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 native:
 	$(PY) -m semantic_router_tpu.native.build

@@ -49,6 +49,25 @@ INIT_WATCHDOG_S = float(os.environ.get("SRT_BENCH_INIT_WATCHDOG", "150"))
 CLAIM_DEADLINE_S = float(os.environ.get("SRT_BENCH_CLAIM_DEADLINE", "1800"))
 # Once init succeeds, the child gets this long to compile + measure.
 BENCH_WATCHDOG_S = float(os.environ.get("SRT_BENCH_WATCHDOG", "1200"))
+# Hard wall for the WHOLE bench process, with a reserved tail for the
+# CPU-fallback JSON line.  r05 postmortem: the claim loop checked its
+# deadline only at attempt START, so a last attempt could overshoot by
+# init+bench watchdogs (~24 min) and the outer harness killed the parent
+# (rc=124, parsed: null) before the promised always-emits-JSON fallback
+# ever ran.  Now no attempt starts unless it can finish — watchdogs
+# clamped to the remaining room — with the CPU reserve still intact.
+TOTAL_BUDGET_S = float(os.environ.get("SRT_BENCH_TOTAL_BUDGET", "2700"))
+CPU_RESERVE_S = float(os.environ.get("SRT_BENCH_CPU_RESERVE", "600"))
+# fused classifier-bank arm width (engine TrunkGroup path): one trunk
+# forward fanning out to this many stacked heads
+BANK_TASKS = int(os.environ.get("SRT_BENCH_BANK_TASKS", "6"))
+
+_START_T = time.time()
+
+
+def _hard_stop() -> float:
+    """Unix time after which only the CPU-fallback reserve remains."""
+    return _START_T + TOTAL_BUDGET_S - CPU_RESERVE_S
 
 _RC_INIT_TIMEOUT = 3
 _RC_BENCH_FAILED = 4
@@ -164,17 +183,30 @@ def _try_tpu() -> bool:
     """Launch claim+bench children until one prints the JSON line or the
     claim deadline expires.  True = a child succeeded (its stdout line
     was forwarded)."""
-    deadline = time.time() + CLAIM_DEADLINE_S
+    deadline = min(time.time() + CLAIM_DEADLINE_S, _hard_stop())
     attempt = 0
     bench_failures = 0
     while time.time() < deadline:
         attempt += 1
+        remaining = deadline - time.time()
+        # tail-time reservation: never START an attempt that cannot
+        # finish inside the room left before the CPU-fallback reserve —
+        # a truncated attempt emits nothing and eats the fallback's time
+        room = _hard_stop() - time.time()
+        if room < INIT_WATCHDOG_S + 60:
+            sys.stderr.write(
+                f"bench: {room:.0f}s room left < one attempt; stopping "
+                f"claims to protect the CPU-fallback reserve\n")
+            return False
+        child_bench_watchdog = max(
+            60.0, min(BENCH_WATCHDOG_S, room - INIT_WATCHDOG_S - 60))
         env = dict(os.environ)
         env["SRT_BENCH_CHILD"] = "1"
-        remaining = deadline - time.time()
+        env["SRT_BENCH_WATCHDOG"] = str(child_bench_watchdog)
         sys.stderr.write(
             f"bench: claim attempt {attempt} "
-            f"({remaining:.0f}s of claim budget left)\n")
+            f"({remaining:.0f}s of claim budget left, "
+            f"{room:.0f}s before CPU reserve)\n")
         proc = subprocess.Popen(
             [sys.executable, "-u", os.path.abspath(__file__)],
             stdout=subprocess.PIPE, stderr=None, env=env, text=True)
@@ -183,7 +215,7 @@ def _try_tpu() -> bool:
             # timeout is a belt-and-braces margin, and on expiry we only
             # ever SIGTERM (SIGKILL on a claim-holder wedges the tunnel)
             out, _ = proc.communicate(
-                timeout=INIT_WATCHDOG_S + BENCH_WATCHDOG_S + 60)
+                timeout=INIT_WATCHDOG_S + child_bench_watchdog + 60)
         except subprocess.TimeoutExpired:
             sys.stderr.write("bench: child exceeded outer timeout; "
                              "SIGTERM\n")
@@ -439,6 +471,83 @@ def _run_bench(platform: str) -> None:
                              f"({type(exc).__name__}: {exc}); "
                              f"dense number stands\n")
 
+    # fused classifier-bank arm (engine TrunkGroup path): the SAME trunk
+    # forward fans out to BANK_TASKS stacked heads (one batched matmul,
+    # models.lora.apply_head_bank) — each sequence yields BANK_TASKS
+    # signals.  Reported alongside the single-task number: the bank
+    # multiplies signals/s by ~the task count because head FLOPs are
+    # noise next to the trunk's.
+    fused_row = None
+    if best is not None:
+        try:
+            from semantic_router_tpu.models.lora import apply_head_bank
+            from semantic_router_tpu.models.modernbert import (
+                ModernBertModel,
+                activation,
+            )
+            from semantic_router_tpu.ops.attention import cls_pool, mean_pool
+
+            # same attention impl as the winning single-task arm — the
+            # fused-vs-single multiplier must compare like with like
+            fused_cfg = cfg if best[2] == "dense" else make_model(best[2])[0]
+            trunk = ModernBertModel(fused_cfg)
+            trunk_params = params["params"]["model"]
+            D = cfg.hidden_size
+            dt = jnp.dtype(bench_dtype)
+            rngb = np.random.default_rng(1)
+            bank = {
+                "dense_kernel": jnp.asarray(
+                    0.02 * rngb.standard_normal((BANK_TASKS, D, D)), dt),
+                "norm_scale": jnp.ones((BANK_TASKS, D), dt),
+                "cls_kernel": jnp.asarray(
+                    0.02 * rngb.standard_normal((BANK_TASKS, D, 14)), dt),
+                "cls_bias": jnp.zeros((BANK_TASKS, 14), dt),
+                "scale": jnp.full((BANK_TASKS,), 2.0, dt),
+                "lora_A": jnp.asarray(
+                    0.02 * rngb.standard_normal((BANK_TASKS, D, 8)), dt),
+                "lora_B": jnp.asarray(
+                    0.02 * rngb.standard_normal((BANK_TASKS, 8, D)), dt),
+            }
+            act = activation(cfg.classifier_activation)
+            use_mean = cfg.classifier_pooling == "mean"
+
+            def fused(p, bank, ids, mask):
+                hidden = trunk.apply({"params": p}, ids, mask)
+                pooled = (mean_pool(hidden, mask) if use_mean
+                          else cls_pool(hidden))
+                return apply_head_bank(bank, pooled, act, cfg.norm_eps)
+
+            ffn = jax.jit(fused)
+            fb = best[0]
+            ids = jnp.asarray(rng.integers(3, cfg.vocab_size, (fb, SEQ)),
+                              jnp.int32)
+            mask = jnp.ones((fb, SEQ), jnp.int32)
+            fused_warmup = 1 if platform == "cpu" else WARMUP_ITERS
+            fused_iters = 1 if platform == "cpu" else measure_iters
+            for _ in range(fused_warmup):
+                jax.device_get(ffn(trunk_params, bank, ids, mask))
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(fused_iters):
+                out = ffn(trunk_params, bank, ids, mask)
+            jax.device_get(out)
+            elapsed = time.perf_counter() - t0
+            fused_signals_per_s = fb * BANK_TASKS * fused_iters / elapsed
+            fused_row = {
+                "impl": f"fused-bank/{best[2]}", "batch": fb,
+                "tasks": BANK_TASKS,
+                "ms_per_batch": round(elapsed * 1e3 / fused_iters, 2),
+                "signals_per_s": round(fused_signals_per_s, 1)}
+            sweep.append(fused_row)
+            sys.stderr.write(
+                f"bench: fused-bank b={fb} T={BANK_TASKS} "
+                f"{elapsed * 1e3 / fused_iters:.1f} ms/batch, "
+                f"{fused_signals_per_s:.1f} signals/s\n")
+        except Exception as exc:
+            sys.stderr.write(f"bench: fused-bank arm failed "
+                             f"({type(exc).__name__}: {exc}); "
+                             f"single-task number stands\n")
+
     batch, signals_per_s, best_impl = best
     # On a CPU fallback the host geometry is the whole story (this image
     # exposes ONE 2.1GHz core — ~0.09 TFLOPs f32 roofline — while the
@@ -454,6 +563,9 @@ def _run_bench(platform: str) -> None:
         "unit": "signals/s",
         "vs_baseline": round(signals_per_s / GPU_BASELINE_SIGNALS_PER_S, 3),
     }
+    if fused_row is not None:
+        record["fused_bank_signals_per_s"] = fused_row["signals_per_s"]
+        record["fused_bank_tasks"] = BANK_TASKS
     if platform != "cpu":
         # side evidence for the bench README / judge: full sweep detail
         try:

@@ -954,6 +954,12 @@ class InferenceEngineConfig:
     # concurrent batch-dispatch workers: a cold XLA compile of one
     # (task, bucket) shape must not park live traffic on warm shapes
     dispatch_workers: int = 4
+    # fused classifier bank: sequence tasks registered with the same trunk
+    # weights + tokenizer batch as ONE (trunk, bucket) group — a request
+    # fanning out K learned signals pays 1 trunk forward instead of K.
+    # Per-task opt-out via register_task(..., fuse=False) for tasks whose
+    # max_seq_len / tokenizer must diverge from their trunk siblings.
+    fuse_trunks: bool = True
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "InferenceEngineConfig":
@@ -967,6 +973,7 @@ class InferenceEngineConfig:
             matryoshka_layers=list(d.get("matryoshka_layers", [])),
             matryoshka_dims=list(d.get("matryoshka_dims", [])),
             dispatch_workers=int(d.get("dispatch_workers", 4)),
+            fuse_trunks=bool(d.get("fuse_trunks", True)),
         )
         if d.get("seq_len_buckets"):
             out.seq_len_buckets = [int(x) for x in d["seq_len_buckets"]]

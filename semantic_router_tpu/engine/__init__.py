@@ -1,12 +1,15 @@
 from .batcher import BatchItem, DynamicBatcher, pick_bucket, pow2_batch
 from .classify import (
+    TRUNK_KEY,
     ClassResult,
     EntitySpan,
     InferenceEngine,
     TokenClassResult,
+    TrunkGroup,
 )
 
 __all__ = [
     "BatchItem", "ClassResult", "DynamicBatcher", "EntitySpan",
-    "InferenceEngine", "TokenClassResult", "pick_bucket", "pow2_batch",
+    "InferenceEngine", "TRUNK_KEY", "TokenClassResult", "TrunkGroup",
+    "pick_bucket", "pow2_batch",
 ]

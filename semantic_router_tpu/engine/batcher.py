@@ -51,7 +51,12 @@ BatchRunner = Callable[[Hashable, List[BatchItem]], Sequence[Any]]
 
 
 def pow2_batch(n: int, max_batch: int) -> int:
-    """Smallest power of two ≥ n, capped at max_batch."""
+    """Smallest power of two ≥ n, capped at max_batch.
+
+    A non-power-of-two ``max_batch`` is allowed and adds exactly ONE
+    extra compiled shape: batch dims come from {1, 2, 4, …} ∪
+    {max_batch}, so the per-bucket shape count stays ⌈log2(max_batch)⌉+1
+    (shape_census() is the regression surface)."""
     b = 1
     while b < n:
         b <<= 1
@@ -59,6 +64,14 @@ def pow2_batch(n: int, max_batch: int) -> int:
 
 
 def pick_bucket(seq_len: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket that fits ``seq_len``.
+
+    A seq_len past the largest bucket CLAMPS to buckets[-1] — the batch
+    builders then clip the encoding at the bucket edge, tag the item's
+    result ``truncated=True``, and count
+    llm_batcher_bucket_overflow_total; the clamp is never silent (a task
+    registered with max_seq_len > buckets[-1] is the case that hits
+    this)."""
     for b in buckets:
         if seq_len <= b:
             return b
@@ -131,8 +144,9 @@ class DynamicBatcher:
 
     def __init__(self, runner: BatchRunner, max_batch_size: int = 32,
                  max_wait_ms: float = 2.0, name: str = "batcher",
-                 dispatch_workers: int = 4) -> None:
+                 dispatch_workers: int = 4, metrics=None) -> None:
         self.runner = runner
+        self.name = name
         self.max_batch_size = max(1, max_batch_size)
         self.max_wait_s = max_wait_ms / 1000.0
         self._queues: Dict[Hashable, List[BatchItem]] = {}
@@ -142,6 +156,9 @@ class DynamicBatcher:
         self._stop = False
         self._stats = {"batches": 0, "items": 0, "max_batch": 0,
                        "max_inflight": 0}
+        # instance-routable observability like the engine's: None = the
+        # process default series (single-engine posture)
+        self._metrics = metrics
         self._pool = _DispatchPool(dispatch_workers,
                                    name=f"{name}-dispatch")
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -169,9 +186,47 @@ class DynamicBatcher:
             self._wake.notify()
         return [i.future for i in items]
 
+    def _series(self):
+        if self._metrics is not None:
+            return self._metrics
+        from ..observability import metrics as M
+
+        return M.default_series
+
+    def _observe_batch(self, batch: List[BatchItem]) -> None:
+        """Queue-wait + occupancy series per dispatched batch: the fused
+        path's coalescing win must be *visible* (p99 wait vs fill ratio),
+        not inferred from end-to-end latency.  Runs on the single picker
+        thread, so it fails open — an observability error (e.g. a custom
+        metrics object missing these series) must never kill the loop
+        that all serving depends on."""
+        try:
+            s = self._series()
+            now = time.perf_counter()
+            for item in batch:
+                s.batcher_queue_wait.observe(now - item.enqueue_t,
+                                             batcher=self.name)
+            s.batcher_fill_ratio.observe(len(batch) / self.max_batch_size,
+                                         batcher=self.name)
+        except Exception:
+            pass
+
     def stats(self) -> dict:
         with self._lock:
-            return dict(self._stats)
+            out = dict(self._stats)
+        out["fill_ratio_mean"] = (out["items"] / out["batches"]
+                                  / self.max_batch_size
+                                  if out["batches"] else 0.0)
+        try:
+            s = self._series()
+            wait = s.batcher_queue_wait
+            fill = s.batcher_fill_ratio
+            out["queue_wait_p50_s"] = wait.percentile(50, batcher=self.name)
+            out["queue_wait_p99_s"] = wait.percentile(99, batcher=self.name)
+            out["fill_ratio_p50"] = fill.percentile(50, batcher=self.name)
+        except Exception:
+            pass  # base counters still report
+        return out
 
     def shutdown(self, timeout: float = 5.0) -> None:
         with self._wake:
@@ -247,6 +302,7 @@ class DynamicBatcher:
                                                len(batch))
                 self._stats["max_inflight"] = max(
                     self._stats["max_inflight"], len(self._inflight))
+            self._observe_batch(batch)
             try:
                 self._pool.submit(self._dispatch, self._cancel_batch,
                                   key, batch)

@@ -14,13 +14,26 @@ Shape discipline: seq lens come from ``engine.seq_len_buckets``, batch dims
 pad to powers of two, so the jit cache holds ≤ |buckets|·log2(max_batch)
 entries per task — this is what keeps p99 added latency in budget on TPU
 (SURVEY.md hard-part 1/2).
+
+Fused classifier bank (TrunkGroup): sequence tasks registered with the
+SAME backbone weights + tokenizer collapse into one batch group — the
+batcher keys on (trunk, bucket) instead of (task, bucket), one trunk
+forward serves sequences from *different* tasks, and every member head
+applies as one batched matmul (models.lora.apply_head_bank) whose logits
+demux back to each item's own label set.  A request fanning K learned
+signals over one shared trunk pays 1 tokenization and 1 trunk forward
+instead of K, and the jit cache holds ≤ |buckets|·log2(max_batch) shapes
+per TRUNK instead of per task (S-LoRA / Punica BGMV serving shape,
+re-designed for XLA's closed shape sets).  ``engine.fuse_trunks``
+(default on) controls it; ``register_task(..., fuse=False)`` opts a task
+out; docs/FUSED_BANK.md is the operator story.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence
 
 import jax
@@ -30,6 +43,10 @@ import numpy as np
 from ..config.schema import InferenceEngineConfig
 from ..utils.tokenization import Encoding, Tokenizer, decode_entity_spans
 from .batcher import BatchItem, DynamicBatcher, pick_bucket, pow2_batch
+
+# batch-group key prefix for fused trunk groups — the group id, not the
+# task name, is the batching unit (see module docstring)
+TRUNK_KEY = "__trunk__"
 
 
 @dataclass
@@ -85,7 +102,47 @@ class _Payload:
     threshold: float = 0.5
     exit_layer: Optional[int] = None  # embedding: Matryoshka layer exit
     output_dim: Optional[int] = None  # embedding: Matryoshka dim truncation
+    # fused trunk-group items: which member tasks this sequence needs
+    # logits for.  One task → the future resolves a ClassResult; several
+    # (the classify_multi fan-out: one item, K tasks, trunk paid once) →
+    # a {task: ClassResult} dict.
+    tasks: tuple = ()
     submit_t: float = field(default_factory=time.perf_counter)
+
+
+@dataclass
+class TrunkGroup:
+    """Tasks sharing one backbone: the fused classifier-bank unit.
+
+    Grouping key (engine._trunk_fingerprint): identity of the trunk
+    parameter arrays + tokenizer identity + (max_seq_len, pad_id, config
+    sans label count).  Tasks that land in one group batch together under
+    (TRUNK_KEY, gid, bucket); their stacked heads live in ``bank``
+    (models.lora.stack_head_bank) and apply in one batched matmul."""
+
+    gid: str
+    config: Any                # ModernBertConfig shared by every member
+    trunk_module: Any          # bare ModernBertModel over the shared weights
+    trunk_params: Any          # the shared (possibly mesh-sharded) subtree
+    tokenizer: Tokenizer
+    max_seq_len: int
+    pad_id: int
+    members: List[str] = field(default_factory=list)
+    entries: List[dict] = field(default_factory=list)
+    widths: List[int] = field(default_factory=list)  # true label widths
+    row_of: Dict[str, int] = field(default_factory=dict)
+    bank: Any = None
+    apply_fn: Any = None
+    # atomic (bank, row_of, widths) snapshot for the demux: the runner
+    # reads ONE consistent view, so a concurrent re-registration can
+    # never pair new row indices with old logits ordering
+    demux: Any = None
+    # the HOST trunk leaves whose id()s form this group's fingerprint:
+    # retained so those ids can never be freed and recycled by a later
+    # checkpoint load (a stale id-match would silently serve the wrong
+    # trunk).  No-mesh serving aliases the live params (zero cost); mesh
+    # serving keeps one host copy per group alive by design.
+    host_refs: Any = None
 
 
 class InferenceEngine:
@@ -128,7 +185,17 @@ class InferenceEngine:
             max_wait_ms=self.cfg.max_wait_ms,
             name="tpu-engine-batcher",
             dispatch_workers=self.cfg.dispatch_workers,
+            metrics=metrics,
         )
+        # fused classifier bank: trunk fingerprint → TrunkGroup, plus the
+        # task→group and gid→group views the hot path reads
+        self._trunk_groups: Dict[tuple, TrunkGroup] = {}
+        self._task_group: Dict[str, TrunkGroup] = {}
+        self._groups_by_gid: Dict[str, TrunkGroup] = {}
+        self._next_gid = 0  # monotonic: eviction must never recycle a gid
+        # distinct device batch shapes executed per batch group — the
+        # jit-cache-budget regression surface (shape_census())
+        self._shapes: Dict[str, set] = {}
         # generative decode mutates per-generator jit/cache state; one
         # generation runs on-device at a time (decode steps saturate the
         # chip anyway — concurrency comes from the classify batcher)
@@ -143,7 +210,13 @@ class InferenceEngine:
 
     def register_task(self, name: str, kind: str, module, params,
                       tokenizer: Tokenizer, labels: List[str],
-                      max_seq_len: int = 0, pad_id: int = 0) -> None:
+                      max_seq_len: int = 0, pad_id: int = 0,
+                      fuse: Optional[bool] = None) -> None:
+        """``fuse``: join the fused classifier bank when this task's trunk
+        weights + tokenizer match another registered task's (None → the
+        engine.fuse_trunks config default).  Opt out (fuse=False) for
+        tasks whose latency/batching must stay isolated from their trunk
+        siblings."""
         if kind not in ("sequence", "token", "embedding"):
             raise ValueError(f"unknown task kind {kind!r}")
         if self.mesh is not None and self.mesh.shape.get("sp", 1) > 1 \
@@ -164,6 +237,22 @@ class InferenceEngine:
         else:
             apply_fn = jax.jit(module.apply)
         max_len = max_seq_len or self.cfg.seq_len_buckets[-1]
+        # bank-fusability check runs BEFORE sharding: the fingerprint is
+        # the identity of the caller's host arrays (two tasks share a
+        # trunk iff they registered the same trunk arrays), and the head
+        # entry must stack from host copies
+        entry = tkey = host_trunk = None
+        want_fuse = self.cfg.fuse_trunks if fuse is None else bool(fuse)
+        if want_fuse and kind == "sequence":
+            from ..models.lora import head_bank_entry
+
+            entry = head_bank_entry(module, params)
+            if entry is not None:
+                tkey = self._trunk_fingerprint(module, params, tokenizer,
+                                               max_len, pad_id)
+                if tkey is not None:
+                    p = params.get("params", params)
+                    host_trunk = p.get("model")
         if self.mesh is not None:
             from ..parallel import shard_params
 
@@ -172,7 +261,195 @@ class InferenceEngine:
             self._tasks[name] = _Task(name, kind, list(labels), tokenizer,
                                       apply_fn, params, max_len, pad_id,
                                       module=module)
+        if entry is not None and tkey is not None:
+            self._join_trunk_group(tkey, name, module, tokenizer, entry,
+                                   host_trunk)
+        else:
+            # re-registration as non-fusable (fuse=False, new kind, or a
+            # foreign architecture) must not leave a stale fused member
+            with self._lock:
+                self._evict_locked(name)
         self._emit_registered(name, kind)
+
+    # -- fused trunk groups ------------------------------------------------
+
+    @staticmethod
+    def _trunk_fingerprint(module, params, tokenizer: Tokenizer,
+                           max_seq_len: int, pad_id: int
+                           ) -> Optional[tuple]:
+        """Grouping key: tasks registered with the SAME trunk parameter
+        arrays (object identity — no false positives, no content hashing
+        on the hot registration path), the same tokenizer object, and
+        compatible shape discipline share one fused group."""
+        cfg = getattr(module, "config", None)
+        if cfg is None:
+            return None
+        p = params.get("params", params)
+        trunk = p.get("model") if hasattr(p, "get") else None
+        if trunk is None:
+            return None
+        leaf_ids = tuple(id(x) for x in jax.tree_util.tree_leaves(trunk))
+        try:
+            # label width is per-head, never part of the trunk identity
+            cfg_key = repr(replace(cfg, num_labels=0))
+        except TypeError:
+            cfg_key = repr(cfg)
+        return (leaf_ids, id(tokenizer), int(max_seq_len), int(pad_id),
+                cfg_key)
+
+    def _evict_locked(self, name: str) -> None:
+        """Remove a task from its trunk group (caller holds self._lock):
+        re-registration must REPLACE the member, not append a stale
+        duplicate row to the bank.  Registration-time only — like
+        registration itself, not safe concurrent with in-flight fused
+        batches of the same group."""
+        g = self._task_group.pop(name, None)
+        if g is None:
+            return
+        row = g.row_of.pop(name, None)
+        if row is None:
+            return
+        g.members.pop(row)
+        g.entries.pop(row)
+        g.widths.pop(row)
+        for t, r in g.row_of.items():
+            if r > row:
+                g.row_of[t] = r - 1
+        if g.members:
+            self._rebuild_bank(g)
+        else:
+            self._groups_by_gid.pop(g.gid, None)
+            for k, v in list(self._trunk_groups.items()):
+                if v is g:
+                    del self._trunk_groups[k]
+
+    def _join_trunk_group(self, tkey: tuple, name: str, module,
+                          tokenizer: Tokenizer, entry: dict,
+                          host_trunk=None) -> None:
+        from ..models.modernbert import ModernBertModel
+
+        with self._lock:
+            self._evict_locked(name)
+            g = self._trunk_groups.get(tkey)
+            if g is None:
+                t = self._tasks[name]
+                tp = t.params.get("params", t.params)
+                g = TrunkGroup(
+                    gid=f"trunk{self._next_gid}",
+                    config=module.config,
+                    trunk_module=ModernBertModel(module.config),
+                    # first member's (possibly sharded) trunk subtree IS
+                    # the group's — every member registered these same
+                    # arrays, so no second copy lands on device
+                    trunk_params=tp["model"],
+                    tokenizer=tokenizer,
+                    max_seq_len=t.max_seq_len,
+                    pad_id=t.pad_id,
+                    host_refs=host_trunk)
+                self._trunk_groups[tkey] = g
+                self._groups_by_gid[g.gid] = g
+                self._next_gid += 1
+            t = self._tasks[name]
+            p = t.params.get("params", t.params)
+            if hasattr(p, "get") and p.get("model") is not g.trunk_params:
+                # alias the group's (possibly mesh-sharded) trunk into
+                # this member's stored tree: without this, member N's
+                # shard_params copy would keep a duplicate trunk in HBM
+                # that only the rare classify_windowed fallback reads
+                new_p = dict(p)
+                new_p["model"] = g.trunk_params
+                t.params = ({**dict(t.params), "params": new_p}
+                            if "params" in t.params else new_p)
+            g.row_of[name] = len(g.members)
+            g.members.append(name)
+            g.entries.append(entry)
+            g.widths.append(int(np.shape(entry["cls_kernel"])[1]))
+            self._rebuild_bank(g)
+            self._task_group[name] = g
+
+    def _rebuild_bank(self, g: TrunkGroup) -> None:
+        """Re-stack the head/adapter bank after membership changes.  The
+        fused fn takes the bank as an argument, so a new member costs one
+        recompile (the task axis grew) — registration-time, never serving
+        -time."""
+        from ..models.lora import stack_head_bank
+
+        bank = stack_head_bank(g.entries)
+        if self.mesh is not None:
+            from ..parallel import shard_head_bank
+
+            bank = shard_head_bank(bank, self.mesh)
+        else:
+            # commit to device ONCE: a host-numpy bank would re-upload
+            # tens of MB per batch through the jit boundary
+            bank = {k: jnp.asarray(v) for k, v in bank.items()}
+        g.bank = bank
+        # one atomic assignment: the runner's demux view stays consistent
+        g.demux = (bank, dict(g.row_of), list(g.widths))
+        if g.apply_fn is None:
+            g.apply_fn = self._make_fused_fn(g)
+
+    def _make_fused_fn(self, g: TrunkGroup):
+        from ..models.lora import apply_head_bank
+        from ..models.modernbert import activation
+        from ..ops.attention import cls_pool, mean_pool
+
+        cfg = g.config
+        act = activation(cfg.classifier_activation)
+        use_mean = cfg.classifier_pooling == "mean"
+        trunk = g.trunk_module
+
+        def fused(trunk_params, bank, ids, mask):
+            hidden = trunk.apply({"params": trunk_params}, ids, mask)
+            pooled = (mean_pool(hidden, mask) if use_mean
+                      else cls_pool(hidden))
+            return apply_head_bank(bank, pooled, act, cfg.norm_eps)
+
+        return jax.jit(fused)
+
+    def trunk_group_info(self) -> Dict[str, List[str]]:
+        """gid → member task names (management API / tests)."""
+        with self._lock:
+            return {g.gid: list(g.members)
+                    for g in self._groups_by_gid.values()}
+
+    def _common_trunk_group(self, tasks: Sequence[str]
+                            ) -> Optional[TrunkGroup]:
+        """The single TrunkGroup serving every task, or None."""
+        if not tasks:
+            return None
+        g = self._task_group.get(tasks[0])
+        if g is None:
+            return None
+        return g if all(self._task_group.get(t) is g for t in tasks) \
+            else None
+
+    def fused_covers(self, tasks: Sequence[str]) -> bool:
+        """True when one fused execution will actually serve every listed
+        sequence task — the dispatcher's prefetch gate.  A trunk group
+        always qualifies (classify_multi routes it fused); the stacked
+        bank only qualifies when the dual-path chooser would pick it RIGHT
+        NOW — claiming coverage while the chooser serves traditional would
+        turn the prefetch into K *serial* per-task forwards, the exact
+        serialization it exists to avoid.  Best-effort gate: a concurrent
+        history record can still flip classify_multi's own choice between
+        this check and the call — that rare window is bounded by the
+        dispatcher's PREFETCH_TIMEOUT_S and the results are still
+        consumed from the memo, so it degrades, never breaks."""
+        tasks = list(tasks)
+        if not tasks:
+            return False
+        if self._common_trunk_group(tasks) is not None:
+            return True
+        stacked = getattr(self, "_stacked", None)
+        if stacked is None or any(t not in stacked["tasks"]
+                                  for t in tasks):
+            return False
+        from .pathing import STACKED, ProcessingRequirements
+
+        sel = self.path_chooser.choose(
+            ProcessingRequirements(tasks=tasks, batch_size=1))
+        return sel.selected_path == STACKED
 
     def register_stacked_bank(self, module, params, tokenizer: Tokenizer,
                               max_seq_len: int = 0, pad_id: int = 0,
@@ -231,12 +508,15 @@ class InferenceEngine:
 
     def classify_multi(self, tasks: Sequence[str], texts: Sequence[str],
                        timeout: float = 30.0,
-                       requirements=None) -> Dict[str, List[ClassResult]]:
+                       requirements=None,
+                       enc_cache=None) -> Dict[str, List[ClassResult]]:
         """Classify the same texts under several sequence tasks — the
         signal fan-out shape. With a stacked bank registered, the
         dual-path chooser decides between one fused pass and per-task
         batcher submits, learning from its own outcome records; without
-        one it is per-task classify_batch."""
+        one, tasks sharing a fused trunk group ride ONE batched submit
+        (tokenize once, trunk forward once, heads demuxed), and only
+        unrelated tasks fall back to per-task classify_batch."""
         from .pathing import (
             STACKED,
             TRADITIONAL,
@@ -284,7 +564,8 @@ class InferenceEngine:
             stacked_budget = timeout if pinned else timeout / 2
             try:
                 out = self._stacked_pool.submit(
-                    self._stacked_run, tasks, texts).result(stacked_budget)
+                    self._stacked_run, tasks, texts,
+                    enc_cache).result(stacked_budget)
             except FutTimeout:
                 self.path_chooser.record(
                     STACKED, tasks, len(texts), stacked_budget, 0.0,
@@ -313,8 +594,15 @@ class InferenceEngine:
                 return out
 
         t0 = time.perf_counter()
-        out = {t: self.classify_batch(t, texts, timeout=remaining())
-               for t in tasks}
+        group = self._common_trunk_group(tasks)
+        if group is not None:
+            out = self._fused_multi(group, tasks, texts,
+                                    timeout=remaining(),
+                                    enc_cache=enc_cache)
+        else:
+            out = {t: self.classify_batch(t, texts, timeout=remaining(),
+                                          enc_cache=enc_cache)
+                   for t in tasks}
         if eligible:
             conf = float(np.mean([r.confidence for rs in out.values()
                                   for r in rs])) if texts else 0.0
@@ -322,38 +610,65 @@ class InferenceEngine:
                                      time.perf_counter() - t0, conf)
         return out
 
-    def _stacked_run(self, tasks: Sequence[str], texts: Sequence[str]
-                     ) -> Dict[str, List[ClassResult]]:
+    def _fused_multi(self, g: TrunkGroup, tasks: Sequence[str],
+                     texts: Sequence[str], timeout: float = 30.0,
+                     enc_cache=None) -> Dict[str, List[ClassResult]]:
+        """The trunk-group fan-out: each text is ONE batch item carrying
+        every requested task — tokenized once, submitted as one
+        submit_many per bucket (guaranteed coalescing), trunk forward
+        shared, per-task logits demuxed by the fused runner."""
+        deadline = time.perf_counter() + timeout
+        tasks = list(tasks)
+        by_bucket: Dict[int, List[tuple]] = {}
+        for ti, text in enumerate(texts):
+            enc = self._encode_group(g, tasks, text, enc_cache)
+            bucket = pick_bucket(len(enc), self.cfg.seq_len_buckets)
+            by_bucket.setdefault(bucket, []).append(
+                (ti, _Payload(text, enc, tasks=tuple(tasks))))
+        futs: List[tuple] = []
+        for bucket, entries in by_bucket.items():
+            fs = self.batcher.submit_many(
+                (TRUNK_KEY, g.gid, bucket), [p for _, p in entries])
+            futs.extend(zip((ti for ti, _ in entries), fs))
+        results: List[Optional[Dict[str, ClassResult]]] = [None] * len(texts)
+        for ti, f in futs:
+            res = f.result(timeout=max(0.05,
+                                       deadline - time.perf_counter()))
+            if not isinstance(res, dict):  # single-task fused item
+                res = {tasks[0]: res}
+            results[ti] = res
+        return {t: [results[i][t] for i in range(len(texts))]
+                for t in tasks}
+
+    def _stacked_run(self, tasks: Sequence[str], texts: Sequence[str],
+                     enc_cache=None) -> Dict[str, List[ClassResult]]:
         """One fused pass: tokenize once, pad to (pow2 batch, bucket),
         run the bank, decode each requested task with ITS registered
         label set — identical decode semantics to the traditional path."""
         st = self._stacked
         n = len(texts)
-        encs = [st["tokenizer"].encode(t, max_length=st["max_seq_len"])
+        if enc_cache is None:
+            encs = [st["tokenizer"].encode(t, max_length=st["max_seq_len"])
+                    for t in texts]
+            for _ in texts:
+                self._count_tokenization("stacked")
+        else:
+            encs = [enc_cache.get_or_encode(
+                st["tokenizer"], t, st["max_seq_len"],
+                on_miss=lambda: self._count_tokenization("stacked"))
                 for t in texts]
         for enc in encs:
             self._note_truncation("stacked", enc)
         bucket = pick_bucket(max((len(e) for e in encs), default=1),
                              self.cfg.seq_len_buckets)
-        padded_n = pow2_batch(n, self.cfg.max_batch_size)
-        if self.mesh is not None:
-            dp = self.mesh.shape.get("dp", 1)
-            padded_n = max(dp, ((padded_n + dp - 1) // dp) * dp)
+        padded_n = self._padded_batch(n)
         ids = np.full((padded_n, bucket), st["pad_id"], dtype=np.int32)
         mask = np.zeros((padded_n, bucket), dtype=np.int32)
         for i, enc in enumerate(encs):
             L = min(len(enc), bucket)
             ids[i, :L] = enc.ids[:L]
             mask[i, :L] = enc.attention_mask[:L]
-        if self.mesh is not None:
-            from ..parallel import batch_sharding
-
-            sh = batch_sharding(self.mesh, shard_seq=self.mesh.shape.get('sp', 1) > 1)
-            ids_dev = jax.device_put(ids, sh)
-            mask_dev = jax.device_put(mask, sh)
-        else:
-            ids_dev = jnp.asarray(ids)
-            mask_dev = jnp.asarray(mask)
+        ids_dev, mask_dev = self._to_device(ids, mask)
         from ..observability.profiler import trace_span
 
         with trace_span("engine.classify_multi.stacked"):
@@ -361,6 +676,8 @@ class InferenceEngine:
                                             mask_dev)
             logits_by_task = {k: np.asarray(jax.device_get(v), np.float32)
                               for k, v in logits_by_task.items()}
+        self._series().trunk_forwards.inc(group="stacked", path="stacked")
+        self._note_shape("stacked", (padded_n, bucket))
         out: Dict[str, List[ClassResult]] = {}
         for task in tasks:
             labels = self._tasks[task].labels
@@ -513,21 +830,26 @@ class InferenceEngine:
         if self.mesh is not None:
             info["mesh"] = {k: int(v) for k, v in
                             self.mesh.shape.items() if v > 1}
+        g = self._task_group.get(name)
+        if g is not None:
+            info["trunk_group"] = g.gid
         return info
 
     # -- public inference --------------------------------------------------
 
-    def classify(self, task: str, text: str, timeout: float = 30.0
-                 ) -> ClassResult:
-        return self.classify_batch(task, [text], timeout=timeout)[0]
+    def classify(self, task: str, text: str, timeout: float = 30.0,
+                 enc_cache=None) -> ClassResult:
+        return self.classify_batch(task, [text], timeout=timeout,
+                                   enc_cache=enc_cache)[0]
 
     def classify_batch(self, task: str, texts: Sequence[str],
-                       timeout: float = 30.0) -> List[ClassResult]:
-        futures = self._submit_texts(task, texts)
+                       timeout: float = 30.0,
+                       enc_cache=None) -> List[ClassResult]:
+        futures = self._submit_texts(task, texts, enc_cache=enc_cache)
         return [f.result(timeout=timeout) for f in futures]
 
-    def classify_async(self, task: str, text: str):
-        return self._submit_texts(task, [text])[0]
+    def classify_async(self, task: str, text: str, enc_cache=None):
+        return self._submit_texts(task, [text], enc_cache=enc_cache)[0]
 
     def classify_windowed(self, task: str, text: str, stride: int = 64,
                           timeout: float = 30.0) -> ClassResult:
@@ -570,10 +892,10 @@ class InferenceEngine:
         )
 
     def token_classify(self, task: str, text: str, threshold: float = 0.5,
-                       timeout: float = 30.0) -> TokenClassResult:
+                       timeout: float = 30.0,
+                       enc_cache=None) -> TokenClassResult:
         t = self._require(task, kind="token")
-        enc = t.tokenizer.encode(text, max_length=t.max_seq_len)
-        self._note_truncation(task, enc)
+        enc = self._encode(t, text, enc_cache)
         bucket = pick_bucket(len(enc), self.cfg.seq_len_buckets)
         fut = self.batcher.submit((task, bucket),
                                   _Payload(text, enc, threshold))
@@ -597,8 +919,7 @@ class InferenceEngine:
         t = self._require(task, kind="embedding")
         futures = []
         for text in texts:
-            enc = t.tokenizer.encode(text, max_length=t.max_seq_len)
-            self._note_truncation(task, enc)
+            enc = self._encode(t, text)
             bucket = pick_bucket(len(enc), self.cfg.seq_len_buckets)
             # exit/dim participate in the group key: different variants are
             # different XLA programs and must not share a device batch
@@ -631,23 +952,11 @@ class InferenceEngine:
                 if b > t.max_seq_len:
                     continue
                 try:
-                    padded_n = pow2_batch(1, self.cfg.max_batch_size)
-                    if self.mesh is not None:
-                        dp = self.mesh.shape.get("dp", 1)
-                        padded_n = max(dp,
-                                       ((padded_n + dp - 1) // dp) * dp)
+                    padded_n = self._padded_batch(1)
                     ids = np.full((padded_n, b), t.pad_id, np.int32)
                     ids[:, 0] = 1
                     mask = np.ones((padded_n, b), np.int32)
-                    if self.mesh is not None:
-                        from ..parallel import batch_sharding
-
-                        sh = batch_sharding(self.mesh, shard_seq=self.mesh.shape.get('sp', 1) > 1)
-                        ids_dev = jax.device_put(ids, sh)
-                        mask_dev = jax.device_put(mask, sh)
-                    else:
-                        ids_dev = jnp.asarray(ids)
-                        mask_dev = jnp.asarray(mask)
+                    ids_dev, mask_dev = self._to_device(ids, mask)
                     if t.kind == "embedding":
                         # every configured Matryoshka variant is its own
                         # XLA program (static exit/dim): warm them ALL —
@@ -660,6 +969,26 @@ class InferenceEngine:
                     else:
                         out = t.apply_fn(t.params, ids_dev, mask_dev)
                         jax.block_until_ready(out)
+                except Exception:
+                    pass
+        # fused trunk groups compile their OWN programs (trunk + stacked
+        # heads): warm those the same way — one cold fused bucket would
+        # stall the whole bank's traffic, not one task's
+        for g in list(self._groups_by_gid.values()):
+            if tasks and not any(m in tasks for m in g.members):
+                continue
+            for b in buckets or self.cfg.seq_len_buckets:
+                if b > g.max_seq_len:
+                    continue
+                try:
+                    padded_n = self._padded_batch(1)
+                    ids = np.full((padded_n, b), g.pad_id, np.int32)
+                    ids[:, 0] = 1
+                    mask = np.ones((padded_n, b), np.int32)
+                    ids_dev, mask_dev = self._to_device(ids, mask)
+                    out = g.apply_fn(g.trunk_params, g.bank,
+                                     ids_dev, mask_dev)
+                    jax.block_until_ready(out)
                 except Exception:
                     pass
 
@@ -698,62 +1027,141 @@ class InferenceEngine:
                 f"task {task!r} is a {t.kind} task; use {right_call}()")
         return t
 
+    def _series(self):
+        if self._metrics is not None:
+            return self._metrics
+        from ..observability import metrics as M
+
+        return M.default_series
+
     def _note_truncation(self, task: str, enc: Encoding) -> None:
         """Count every clipped input (llm_tokenizer_truncated_inputs_total)
         so tail-drop is an operator-visible rate, not a silent default."""
         if enc.truncated:
-            series = self._metrics
-            if series is None:
-                from ..observability import metrics as M
+            self._series().truncated_inputs.inc(task=task)
 
-                series = M.default_series
-            series.truncated_inputs.inc(task=task)
+    def _count_tokenization(self, task: str) -> None:
+        self._series().tokenizations.inc(task=task)
 
-    def _submit_texts(self, task: str, texts: Sequence[str]):
-        t = self._require(task, kind="sequence")
-        payloads = []
-        buckets = []
-        for text in texts:
-            enc = t.tokenizer.encode(text, max_length=t.max_seq_len)
-            self._note_truncation(task, enc)
-            payloads.append(_Payload(text, enc))
-            buckets.append(pick_bucket(len(enc), self.cfg.seq_len_buckets))
-        futures = []
-        for payload, bucket in zip(payloads, buckets):
-            futures.append(self.batcher.submit((task, bucket), payload))
-        return futures
+    def _note_shape(self, group: str, shape: tuple) -> None:
+        with self._lock:
+            self._shapes.setdefault(group, set()).add(tuple(shape))
 
-    def _run_batch(self, group_key: Hashable,
-                   items: List[BatchItem]) -> Sequence[Any]:
-        task_name, bucket = group_key[0], group_key[1]
-        t = self._require(task_name)
-        n = len(items)
-        padded_n = pow2_batch(n, self.cfg.max_batch_size)
-        if self.mesh is not None:
-            # dp-sharded batches must divide evenly across the data axis
-            dp = self.mesh.shape.get("dp", 1)
-            padded_n = max(dp, ((padded_n + dp - 1) // dp) * dp)
+    def shape_census(self) -> Dict[str, list]:
+        """Distinct (padded_batch, bucket) device shapes executed per
+        batch group — the jit-cache-budget regression surface: a fused
+        trunk stays ≤ |buckets|·log2(max_batch) shapes TOTAL regardless
+        of member count."""
+        with self._lock:
+            return {k: sorted(v) for k, v in self._shapes.items()}
 
-        ids = np.full((padded_n, bucket), t.pad_id, dtype=np.int32)
-        mask = np.zeros((padded_n, bucket), dtype=np.int32)
-        for i, item in enumerate(items):
-            enc: Encoding = item.payload.encoding
-            L = min(len(enc), bucket)
-            ids[i, :L] = enc.ids[:L]
-            mask[i, :L] = enc.attention_mask[:L]
+    def _encode_with(self, tokenizer: Tokenizer, max_seq_len: int,
+                     text: str, enc_cache, tok_tag: str,
+                     trunc_tags: Sequence[str]) -> Encoding:
+        """Tokenize (or reuse the request's shared Encoding): the single
+        tokenize-once seam.  ``tok_tag`` labels the tokenization counter
+        (group id for shared group encodes — the work IS shared);
+        ``trunc_tags`` labels truncation per member TASK, matching the
+        traditional path's per-task attribution so existing dashboards
+        keep reading."""
+        if enc_cache is None:
+            enc = tokenizer.encode(text, max_length=max_seq_len)
+            self._count_tokenization(tok_tag)
+        else:
+            enc = enc_cache.get_or_encode(
+                tokenizer, text, max_seq_len,
+                on_miss=lambda: self._count_tokenization(tok_tag))
+        if enc.truncated:
+            s = self._series()
+            for tag in trunc_tags:
+                s.truncated_inputs.inc(task=tag)
+        return enc
 
+    def _encode(self, t: _Task, text: str, enc_cache=None) -> Encoding:
+        return self._encode_with(t.tokenizer, t.max_seq_len, text,
+                                 enc_cache, t.name, (t.name,))
+
+    def _encode_group(self, g: TrunkGroup, tasks: Sequence[str],
+                      text: str, enc_cache=None) -> Encoding:
+        return self._encode_with(g.tokenizer, g.max_seq_len, text,
+                                 enc_cache, g.gid, tuple(tasks))
+
+    def _to_device(self, ids: np.ndarray, mask: np.ndarray):
+        """Host batch → device, dp/sp-sharded when a mesh serves."""
         if self.mesh is not None:
             from ..parallel import batch_sharding
 
             # device_put the HOST arrays directly: each device receives
             # only its shard (asarray-then-reshard would stage the full
             # batch on device 0 first — double transfer on the hot path)
-            sharding = batch_sharding(self.mesh, shard_seq=self.mesh.shape.get('sp', 1) > 1)
-            ids_dev = jax.device_put(ids, sharding)
-            mask_dev = jax.device_put(mask, sharding)
-        else:
-            ids_dev = jnp.asarray(ids)
-            mask_dev = jnp.asarray(mask)
+            sh = batch_sharding(self.mesh,
+                                shard_seq=self.mesh.shape.get("sp", 1) > 1)
+            return jax.device_put(ids, sh), jax.device_put(mask, sh)
+        return jnp.asarray(ids), jnp.asarray(mask)
+
+    def _submit_texts(self, task: str, texts: Sequence[str],
+                      enc_cache=None):
+        t = self._require(task, kind="sequence")
+        g = self._task_group.get(task)
+        futures = []
+        for text in texts:
+            enc = self._encode(t, text, enc_cache)
+            bucket = pick_bucket(len(enc), self.cfg.seq_len_buckets)
+            if g is not None:
+                # fused member: batch under the TRUNK, so concurrent
+                # requests for sibling tasks coalesce into one forward
+                futures.append(self.batcher.submit(
+                    (TRUNK_KEY, g.gid, bucket),
+                    _Payload(text, enc, tasks=(task,))))
+            else:
+                futures.append(self.batcher.submit((task, bucket),
+                                                   _Payload(text, enc)))
+        return futures
+
+    def _padded_batch(self, n: int) -> int:
+        padded_n = pow2_batch(n, self.cfg.max_batch_size)
+        if self.mesh is not None:
+            # dp-sharded batches must divide evenly across the data axis
+            dp = self.mesh.shape.get("dp", 1)
+            padded_n = max(dp, ((padded_n + dp - 1) // dp) * dp)
+        return padded_n
+
+    def _stack_items(self, items: List[BatchItem], bucket: int,
+                     padded_n: int, pad_id: int,
+                     tag: Optional[str] = None):
+        """Pad item encodings into one (padded_n, bucket) host batch.
+        Returns (ids, mask, clipped): an encoding longer than the bucket
+        clips at the bucket edge — tagged per item (the result reports
+        truncated=True) and counted, never silent (a task whose
+        max_seq_len exceeds the largest bucket hits this).  ``tag`` None
+        = the caller attributes the overflow count itself (the fused
+        runner counts per member task)."""
+        ids = np.full((padded_n, bucket), pad_id, dtype=np.int32)
+        mask = np.zeros((padded_n, bucket), dtype=np.int32)
+        clipped = [False] * len(items)
+        for i, item in enumerate(items):
+            enc: Encoding = item.payload.encoding
+            L = min(len(enc), bucket)
+            clipped[i] = len(enc) > bucket
+            ids[i, :L] = enc.ids[:L]
+            mask[i, :L] = enc.attention_mask[:L]
+        n_clipped = sum(clipped)
+        if n_clipped and tag is not None:
+            self._series().bucket_overflows.inc(n_clipped, task=tag)
+        return ids, mask, clipped
+
+    def _run_batch(self, group_key: Hashable,
+                   items: List[BatchItem]) -> Sequence[Any]:
+        if group_key[0] == TRUNK_KEY:
+            return self._run_fused_batch(group_key[1], group_key[2], items)
+        task_name, bucket = group_key[0], group_key[1]
+        t = self._require(task_name)
+        n = len(items)
+        padded_n = self._padded_batch(n)
+        ids, mask, clipped = self._stack_items(items, bucket, padded_n,
+                                               t.pad_id, task_name)
+        ids_dev, mask_dev = self._to_device(ids, mask)
+        self._note_shape(f"task:{task_name}", (padded_n, bucket))
 
         # named profiler regions: the XLA timeline lines up with router
         # semantics when a trace is being captured (observability.profiler)
@@ -766,11 +1174,15 @@ class InferenceEngine:
                                  exit_layer=p.exit_layer,
                                  output_dim=p.output_dim)
                 emb = np.asarray(jax.device_get(emb), dtype=np.float32)
+            self._series().trunk_forwards.inc(group=task_name,
+                                              path="traditional")
             return [emb[i] for i in range(n)]
 
         with trace_span(f"engine.classify.{t.name}"):
             logits = t.apply_fn(t.params, ids_dev, mask_dev)
             logits = np.asarray(jax.device_get(logits), dtype=np.float32)
+        self._series().trunk_forwards.inc(group=task_name,
+                                          path="traditional")
 
         now = time.perf_counter()
         if t.kind == "sequence":
@@ -786,7 +1198,7 @@ class InferenceEngine:
                     probs={t.labels[j] if j < len(t.labels) else str(j):
                            float(p[j]) for j in range(p.shape[-1])},
                     latency_s=now - item.payload.submit_t,
-                    truncated=item.payload.encoding.truncated,
+                    truncated=item.payload.encoding.truncated or clipped[i],
                 ))
             return out
         # token classification
@@ -806,8 +1218,62 @@ class InferenceEngine:
             out.append(TokenClassResult(
                 entities=[EntitySpan(**s) for s in spans],
                 latency_s=now - item.payload.submit_t,
-                truncated=enc.truncated,
+                truncated=enc.truncated or clipped[i],
             ))
+        return out
+
+    def _run_fused_batch(self, gid: str, bucket: int,
+                         items: List[BatchItem]) -> Sequence[Any]:
+        """One trunk forward for a batch MIXING member tasks: stack the
+        sequences, run trunk + every stacked head
+        (models.lora.apply_head_bank), then demux each item's (row, task)
+        logits against the task's own label set — decode semantics
+        identical to the traditional path."""
+        g = self._groups_by_gid[gid]
+        # ONE consistent (bank, rows, widths) view for this whole batch:
+        # a concurrent re-registration swaps g.demux atomically and can
+        # never pair new row indices with this batch's logits ordering
+        bank, row_of, widths = g.demux
+        n = len(items)
+        padded_n = self._padded_batch(n)
+        ids, mask, clipped = self._stack_items(items, bucket, padded_n,
+                                               g.pad_id)
+        for i, item in enumerate(items):
+            if clipped[i]:
+                for task in item.payload.tasks:
+                    self._series().bucket_overflows.inc(task=task)
+        ids_dev, mask_dev = self._to_device(ids, mask)
+
+        from ..observability.profiler import trace_span
+
+        with trace_span(f"engine.classify.fused.{gid}"):
+            logits = g.apply_fn(g.trunk_params, bank, ids_dev, mask_dev)
+            logits = np.asarray(jax.device_get(logits), dtype=np.float32)
+        self._series().trunk_forwards.inc(group=gid, path="fused")
+        self._note_shape(f"trunk:{gid}", (padded_n, bucket))
+
+        now = time.perf_counter()
+        out: List[Any] = []
+        for i, item in enumerate(items):
+            enc = item.payload.encoding
+            per_task: Dict[str, ClassResult] = {}
+            for task in item.payload.tasks:
+                row = row_of[task]
+                width = widths[row]
+                p = _softmax(logits[i, row, :width][None, :])[0]
+                idx = int(p.argmax())
+                labels = self._tasks[task].labels
+                per_task[task] = ClassResult(
+                    label=labels[idx] if idx < len(labels) else str(idx),
+                    index=idx,
+                    confidence=float(p[idx]),
+                    probs={(labels[j] if j < len(labels) else str(j)):
+                           float(p[j]) for j in range(width)},
+                    latency_s=now - item.payload.submit_t,
+                    truncated=enc.truncated or clipped[i],
+                )
+            out.append(per_task[item.payload.tasks[0]]
+                       if len(item.payload.tasks) == 1 else per_task)
         return out
 
 

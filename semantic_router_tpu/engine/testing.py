@@ -83,6 +83,72 @@ def make_test_engine(
     return engine
 
 
+SHARED_TRUNK_TASKS = [
+    ("intent", ["business", "law", "health", "computer science", "other"]),
+    ("fact_check", ["no_fact_check", "fact_check"]),
+    ("user_feedback", ["none", "positive", "negative"]),
+]
+
+
+def make_shared_trunk_engine(
+    tasks: Optional[Sequence[tuple]] = None,
+    lora_tasks: Sequence[str] = (),
+    engine_cfg: Optional[InferenceEngineConfig] = None,
+    seed: int = 0,
+    fuse: Optional[bool] = None,
+    metrics=None,
+) -> InferenceEngine:
+    """Engine whose sequence tasks share ONE ModernBERT trunk — the fused
+    classifier-bank shape.  The trunk initializes once; every task's param
+    tree splices in the SAME trunk subtree (object identity is the
+    TrunkGroup fingerprint), so with fusion on they batch as one
+    (trunk, bucket) group.
+
+    ``tasks``: iterable of (name, labels) — all sequence kind.
+    ``lora_tasks``: member names built as ModernBertLoRAHeadClassifier
+    (head-LoRA) instead of the plain head, with non-zero adapters — the
+    LoRA / non-LoRA mixed-batch shape.
+    ``fuse``: forwarded to register_task (None → engine config default).
+    """
+    import flax
+
+    from ..models.lora import LoRAConfig, ModernBertLoRAHeadClassifier
+
+    if tasks is None:
+        tasks = SHARED_TRUNK_TASKS
+    cfg = engine_cfg or InferenceEngineConfig(
+        max_batch_size=8, max_wait_ms=1.0, seq_len_buckets=[32, 128, 512])
+    engine = InferenceEngine(cfg, metrics=metrics)
+    tok = HashTokenizer(vocab_size=TINY["vocab_size"])
+    key = jax.random.PRNGKey(seed)
+    dummy = jnp.ones((1, 8), jnp.int32)
+    trunk_params = None
+    for i, (name, labels) in enumerate(tasks):
+        mcfg = tiny_config(len(labels))
+        if name in lora_tasks:
+            module = ModernBertLoRAHeadClassifier(
+                mcfg, LoRAConfig(rank=4, alpha=8.0), len(labels))
+        else:
+            module = ModernBertForSequenceClassification(mcfg)
+        params = flax.core.unfreeze(
+            module.init(jax.random.fold_in(key, i), dummy))
+        if name in lora_tasks:
+            # lora_B inits to zeros (exact no-op delta) — give the test
+            # adapters real weight so the fused path provably applies them
+            shape = params["params"]["lora_B"].shape
+            params["params"]["lora_B"] = 0.3 * jax.random.normal(
+                jax.random.fold_in(key, 1000 + i), shape)
+        if trunk_params is None:
+            trunk_params = params["params"]["model"]
+        else:
+            # the splice that makes the trunk SHARED: same arrays, so the
+            # engine's identity fingerprint groups every task
+            params["params"]["model"] = trunk_params
+        engine.register_task(name, "sequence", module, params, tok,
+                             labels, max_seq_len=512, fuse=fuse)
+    return engine
+
+
 def make_embedding_engine(seed: int = 0,
                           engine_cfg: Optional[InferenceEngineConfig] = None
                           ) -> InferenceEngine:

@@ -33,6 +33,7 @@ import numpy as np
 
 from .modernbert import (
     ModernBertConfig,
+    ModernBertForSequenceClassification,
     ModernBertModel,
     ModernBertPredictionHead,
 )
@@ -84,6 +85,169 @@ def merge_lora_into_base(base_kernel: np.ndarray, lora_A: np.ndarray,
     """Merge one task's adapter into a dense kernel (the reference's
     "merged" deployment path, lora/lora_adapter.rs merge)."""
     return base_kernel + scale * (lora_A @ lora_B)
+
+
+class ModernBertLoRAHeadClassifier(nn.Module):
+    """Single-task classifier with a LoRA-adapted prediction head: frozen
+    shared trunk + (dense + scale·(x A)B) → act → norm → classifier.
+
+    This is the per-task *unit* of the fused classifier bank
+    (engine.classify TrunkGroup): tasks registered with the same trunk
+    parameter arrays share ONE trunk forward; each task's head — including
+    this module's LoRA delta — stacks into the bank via
+    ``head_bank_entry``/``stack_head_bank`` and fans out as one batched
+    matmul.  Standalone ``apply`` computes the same head math (same
+    dtype, within XLA reduction-order rounding) the fused path
+    reproduces, so either execution path serves the task."""
+
+    config: ModernBertConfig
+    lora: LoRAConfig
+    num_labels: int
+
+    @nn.compact
+    def __call__(self, input_ids: jnp.ndarray,
+                 attention_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        from .modernbert import _act
+
+        cfg = self.config
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        hidden = ModernBertModel(cfg, name="model")(input_ids, attention_mask)
+        pooled = (mean_pool(hidden, attention_mask)
+                  if cfg.classifier_pooling == "mean" else cls_pool(hidden))
+        h = nn.Dense(cfg.hidden_size, use_bias=cfg.classifier_bias,
+                     name="head_dense", dtype=cfg.dtype)(pooled)
+        A = self.param("lora_A", nn.initializers.normal(stddev=0.02),
+                       (pooled.shape[-1], self.lora.rank))
+        B = self.param("lora_B", nn.initializers.zeros,
+                       (self.lora.rank, cfg.hidden_size))
+        h = h + self.lora.scale * ((pooled @ A) @ B)
+        h = _act(cfg.classifier_activation)(h)
+        h = nn.LayerNorm(epsilon=cfg.norm_eps, use_bias=cfg.norm_bias,
+                         name="head_norm", dtype=cfg.dtype)(h)
+        return nn.Dense(self.num_labels, use_bias=True, name="classifier",
+                        dtype=cfg.dtype)(h)
+
+
+def head_bank_entry(module, params) -> Optional[Dict[str, Any]]:
+    """Extract the stackable prediction head of a bank-fusable classifier.
+
+    Returns host-side arrays {dense_kernel, dense_bias?, lora_A?, lora_B?,
+    scale, norm_scale, norm_bias?, cls_kernel, cls_bias, num_labels}, or
+    None when the module is not fusable (unknown architecture) — the
+    engine then keeps the task on its traditional per-task path."""
+    p = params.get("params", params)
+    try:
+        if isinstance(module, ModernBertLoRAHeadClassifier):
+            return {
+                "dense_kernel": p["head_dense"]["kernel"],
+                "dense_bias": p["head_dense"].get("bias"),
+                "lora_A": p["lora_A"],
+                "lora_B": p["lora_B"],
+                "scale": float(module.lora.scale),
+                "norm_scale": p["head_norm"]["scale"],
+                "norm_bias": p["head_norm"].get("bias"),
+                "cls_kernel": p["classifier"]["kernel"],
+                "cls_bias": p["classifier"]["bias"],
+            }
+        if isinstance(module, ModernBertForSequenceClassification):
+            head, cls = p["head"], p["classifier"]
+            return {
+                "dense_kernel": head["dense"]["kernel"],
+                "dense_bias": head["dense"].get("bias"),
+                "lora_A": None,
+                "lora_B": None,
+                "scale": 0.0,
+                "norm_scale": head["norm"]["scale"],
+                "norm_bias": head["norm"].get("bias"),
+                "cls_kernel": cls["kernel"],
+                "cls_bias": cls["bias"],
+            }
+    except (KeyError, TypeError):
+        return None
+    return None
+
+
+def stack_head_bank(entries: List[Dict[str, Any]]) -> Dict[str, jnp.ndarray]:
+    """Stack per-task head entries into one gatherable bank of [T, ...]
+    arrays.  Label columns zero-pad to the widest member (padded logits
+    are sliced away before softmax); LoRA ranks zero-pad to the widest
+    adapter, and non-LoRA members get all-zero A/B rows — an exact no-op
+    delta, which is how LoRA and non-LoRA tasks share one batch.
+
+    The bank keeps the members' own dtype (bf16 heads stay bf16): the
+    fused path must reproduce the standalone modules' numerics, not
+    silently upcast them."""
+    D, H = np.shape(entries[0]["dense_kernel"])
+    dt = np.asarray(entries[0]["dense_kernel"]).dtype
+    l_max = max(int(np.shape(e["cls_kernel"])[1]) for e in entries)
+    r_max = max([int(np.shape(e["lora_A"])[1])
+                 for e in entries if e["lora_A"] is not None] or [1])
+
+    def stacked(key, pad_to=None, axis=None):
+        rows = []
+        for e in entries:
+            a = np.asarray(e[key], dtype=dt)
+            if pad_to is not None and a.shape[axis] < pad_to:
+                widths = [(0, 0)] * a.ndim
+                widths[axis] = (0, pad_to - a.shape[axis])
+                a = np.pad(a, widths)
+            rows.append(a)
+        return np.stack(rows)
+
+    bank: Dict[str, Any] = {
+        "dense_kernel": stacked("dense_kernel"),             # [T, D, H]
+        "norm_scale": stacked("norm_scale"),                 # [T, H]
+        "cls_kernel": stacked("cls_kernel", l_max, 1),       # [T, H, L]
+        "cls_bias": stacked("cls_bias", l_max, 0),           # [T, L]
+        "scale": np.asarray([e["scale"] for e in entries], dt),
+    }
+    if entries[0]["dense_bias"] is not None:
+        bank["dense_bias"] = stacked("dense_bias")           # [T, H]
+    if entries[0]["norm_bias"] is not None:
+        bank["norm_bias"] = stacked("norm_bias")             # [T, H]
+    if any(e["lora_A"] is not None for e in entries):
+        bank["lora_A"] = np.stack([
+            np.pad(np.asarray(e["lora_A"], dt),
+                   ((0, 0), (0, r_max - e["lora_A"].shape[1])))
+            if e["lora_A"] is not None else np.zeros((D, r_max), dt)
+            for e in entries])                               # [T, D, r]
+        bank["lora_B"] = np.stack([
+            np.pad(np.asarray(e["lora_B"], dt),
+                   ((0, r_max - e["lora_B"].shape[0]), (0, 0)))
+            if e["lora_B"] is not None else np.zeros((r_max, H), dt)
+            for e in entries])                               # [T, r, H]
+    return bank
+
+
+def apply_head_bank(bank: Dict[str, jnp.ndarray], pooled: jnp.ndarray,
+                    activation, norm_eps: float) -> jnp.ndarray:
+    """Fan pooled trunk features [B, D] out through EVERY stacked head as
+    batched einsums → logits [B, T, L_max].
+
+    At classifier-bank task counts (~18 heads over one ModernBERT trunk)
+    computing all heads for all rows is cheaper than a per-item gather —
+    head FLOPs are ~0.1% of the trunk's — and keeps the jit cache keyed on
+    (batch, seq) only.  The engine demultiplexes each item's (row, task)
+    logits host-side and softmaxes over the task's true label width; a
+    per-item Pallas BGMV gather is the ROADMAP follow-on for much larger
+    banks."""
+    h = jnp.einsum("bd,tdh->bth", pooled, bank["dense_kernel"])
+    if "dense_bias" in bank:
+        h = h + bank["dense_bias"][None]
+    if "lora_A" in bank:
+        low = jnp.einsum("bd,tdr->btr", pooled, bank["lora_A"])
+        h = h + bank["scale"][None, :, None] * jnp.einsum(
+            "btr,trh->bth", low, bank["lora_B"])
+    h = activation(h)
+    mu = h.mean(axis=-1, keepdims=True)
+    var = ((h - mu) ** 2).mean(axis=-1, keepdims=True)
+    h = (h - mu) * jax.lax.rsqrt(var + norm_eps)
+    h = h * bank["norm_scale"][None]
+    if "norm_bias" in bank:
+        h = h + bank["norm_bias"][None]
+    return jnp.einsum("bth,thl->btl", h, bank["cls_kernel"]) \
+        + bank["cls_bias"][None]
 
 
 class MultiTaskLoRAClassifier(nn.Module):

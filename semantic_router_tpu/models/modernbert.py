@@ -126,6 +126,13 @@ def _act(name: str):
     raise ValueError(f"unknown activation {name!r}")
 
 
+def activation(name: str):
+    """The classifier-activation resolver, public: the fused head bank
+    (models.lora.apply_head_bank) reruns the head math outside a Flax
+    module and must apply the exact same nonlinearity."""
+    return _act(name)
+
+
 class ModernBertEmbeddings(nn.Module):
     config: ModernBertConfig
 

@@ -277,6 +277,29 @@ class MetricSeries:
             "llm_backend_failovers_total",
             "Requests shed from an unreachable endpoint to a surviving "
             "one")
+        # fused classifier-bank observability: the coalescing win must be
+        # visible in series, not inferred from latency deltas
+        self.trunk_forwards = registry.counter(
+            "llm_engine_trunk_forwards_total",
+            "Device trunk forwards, by batch group (fused trunk groups "
+            "vs per-task batches)")
+        self.tokenizations = registry.counter(
+            "llm_engine_tokenizations_total",
+            "Host tokenizations actually executed (request-level "
+            "tokenize-once cache hits never count)")
+        self.bucket_overflows = registry.counter(
+            "llm_batcher_bucket_overflow_total",
+            "Inputs longer than the largest seq bucket — clipped at the "
+            "bucket edge and tagged truncated, never silent")
+        self.batcher_queue_wait = registry.histogram(
+            "llm_batcher_queue_wait_seconds",
+            "Time items spend queued before their batch dispatches, "
+            "by batcher")
+        self.batcher_fill_ratio = registry.histogram(
+            "llm_batcher_batch_fill_ratio",
+            "Dispatched batch size / max_batch_size, by batcher",
+            buckets=(0.0625, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75,
+                     0.875, 1.0))
 
 
 default_series = MetricSeries(default_registry)
@@ -299,3 +322,8 @@ decision_latency = default_series.decision_latency
 batch_size = default_series.batch_size
 truncated_inputs = default_series.truncated_inputs
 backend_failovers = default_series.backend_failovers
+trunk_forwards = default_series.trunk_forwards
+tokenizations = default_series.tokenizations
+bucket_overflows = default_series.bucket_overflows
+batcher_queue_wait = default_series.batcher_queue_wait
+batcher_fill_ratio = default_series.batcher_fill_ratio

@@ -11,7 +11,12 @@ from .multihost import (
     process_local_batch,
     replicated_from_host,
 )
-from .sharding import param_shardings, shard_params
+from .sharding import (
+    head_bank_specs,
+    param_shardings,
+    shard_head_bank,
+    shard_params,
+)
 from .train_step import (
     TrainState,
     cross_entropy_loss,
@@ -21,8 +26,8 @@ from .train_step import (
 
 __all__ = [
     "AXIS_DATA", "AXIS_SEQ", "AXIS_TENSOR", "TrainState", "batch_sharding",
-    "create_mesh", "cross_entropy_loss", "init_multihost",
-    "make_lora_optimizer", "make_train_step", "param_shardings",
-    "process_local_batch", "replicated", "replicated_from_host",
-    "shard_params",
+    "create_mesh", "cross_entropy_loss", "head_bank_specs",
+    "init_multihost", "make_lora_optimizer", "make_train_step",
+    "param_shardings", "process_local_batch", "replicated",
+    "replicated_from_host", "shard_head_bank", "shard_params",
 ]

@@ -57,3 +57,38 @@ def shard_params(params: Any, mesh: Mesh) -> Any:
     shardings = param_shardings(params, mesh)
     return jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, s), params, shardings)
+
+
+# -- fused classifier-bank (head/adapter stacks) ---------------------------
+
+
+def head_bank_specs(bank: dict, mesh: Mesh) -> dict:
+    """PartitionSpec per stacked head-bank array (models.lora
+    stack_head_bank output: [T, ...] head kernels/norms/adapters).
+
+    The classifier-bank layout for a v5e slice: the TASK axis lays out
+    over ``tp`` when it divides evenly — each tensor rank holds a slice
+    of the heads and LoRA adapters, and XLA gathers logits across ranks
+    after the fused fan-out.  ``dp`` never shards the bank (it shards
+    request batches); a task count not divisible by tp replicates (the
+    stacks are tiny next to the trunk)."""
+    tp = mesh.shape.get(AXIS_TENSOR, 1)
+    t_axis = {getattr(v, "shape", (0,))[0] for v in bank.values()
+              if getattr(v, "ndim", 0) >= 1}
+    n_tasks = max(t_axis) if t_axis else 0
+    shard_tasks = tp > 1 and n_tasks > 0 and n_tasks % tp == 0
+    out = {}
+    for key, v in bank.items():
+        ndim = getattr(v, "ndim", 0)
+        if shard_tasks and ndim >= 1 and v.shape[0] == n_tasks:
+            out[key] = P(AXIS_TENSOR, *([None] * (ndim - 1)))
+        else:
+            out[key] = P()
+    return out
+
+
+def shard_head_bank(bank: dict, mesh: Mesh) -> dict:
+    """Place a stacked head bank onto the mesh per head_bank_specs."""
+    specs = head_bank_specs(bank, mesh)
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in bank.items()}

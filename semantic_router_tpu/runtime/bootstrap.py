@@ -124,6 +124,31 @@ def build_engine(cfg: RouterConfig, mock: bool = False, registry=None):
         cfg.engine,
         metrics=registry.metric_series() if registry is not None else None,
         events=registry.events if registry is not None else None)
+
+    # Dedup caches: tasks whose specs point at the SAME checkpoint /
+    # tokenizer path must receive the same array and tokenizer OBJECTS —
+    # the engine's fused classifier bank groups by identity, so without
+    # this every task would hold its own trunk copy and the bank could
+    # never form in production.  Only CONVERTED params are cached (raw
+    # safetensors state dicts are loaded per use and dropped — retaining
+    # every checkpoint's raw arrays for the whole loop would raise peak
+    # host RAM from ~one checkpoint to the sum of all of them).
+    # Cross-checkpoint trunk dedup (two files, identical frozen trunk)
+    # is the ROADMAP content-fingerprint follow-on.
+    mb_params_cache: dict = {}
+    tok_cache: dict = {}
+
+    def load_state(p: str):
+        from safetensors.numpy import load_file
+
+        return load_file(os.path.join(p, "model.safetensors")) \
+            if os.path.isdir(p) else load_file(p)
+
+    def tokenizer_for(tok_path: str) -> HFTokenizer:
+        if tok_path not in tok_cache:
+            tok_cache[tok_path] = HFTokenizer.from_pretrained_dir(tok_path)
+        return tok_cache[tok_path]
+
     for task, spec in specs.items():
         path = spec.get("checkpoint", "")
         if path and not os.path.exists(path):
@@ -133,10 +158,6 @@ def build_engine(cfg: RouterConfig, mock: bool = False, registry=None):
                             path=spec.get("checkpoint", ""),
                             level="warning")
             continue
-        from safetensors.numpy import load_file
-
-        state = load_file(os.path.join(path, "model.safetensors")) \
-            if os.path.isdir(path) else load_file(path)
         import json
 
         cfg_path = os.path.join(path, "config.json") if os.path.isdir(path) \
@@ -170,13 +191,14 @@ def build_engine(cfg: RouterConfig, mock: bool = False, registry=None):
                 SimpleNamespace(**hf_cfg["text_config"]))
             vis_tc = SiglipTowerConfig.from_hf(
                 SimpleNamespace(**hf_cfg["vision_config"]))
-            tok = HFTokenizer.from_pretrained_dir(
+            tok = tokenizer_for(
                 spec.get("tokenizer", path if os.path.isdir(path)
                          else os.path.dirname(path)))
             engine.register_multimodal(
                 task, SiglipEmbedder(
                     text_tc, vis_tc,
-                    siglip_params_from_state_dict(state), tokenizer=tok))
+                    siglip_params_from_state_dict(load_state(path)),
+                    tokenizer=tok))
             component_event("bootstrap", "model_loaded", task=task,
                             kind="multimodal", architecture="siglip")
             continue
@@ -218,8 +240,8 @@ def build_engine(cfg: RouterConfig, mock: bool = False, registry=None):
             module = DebertaV3ForTokenClassification(dcfg) \
                 if kind == "token" \
                 else DebertaV3ForSequenceClassification(dcfg)
-            params = deberta_params_from_state_dict(state)
-            tok = HFTokenizer.from_pretrained_dir(
+            params = deberta_params_from_state_dict(load_state(path))
+            tok = tokenizer_for(
                 spec.get("tokenizer", path if os.path.isdir(path) else
                          os.path.dirname(path)))
             engine.register_task(task, kind, module, params, tok, labels,
@@ -260,12 +282,13 @@ def build_engine(cfg: RouterConfig, mock: bool = False, registry=None):
                 rank=int(lora_spec.get("rank", 8)),
                 alpha=float(lora_spec.get("alpha", 16.0)),
                 num_tasks=max(1, len(adapters))) if adapters else None
-            qparams = qwen3_params_from_state_dict(state, wrap="model")
+            qparams = qwen3_params_from_state_dict(load_state(path),
+                                                   wrap="model")
             if lora is not None:
                 from ..models.generate import with_lora_leaves
 
                 qparams = with_lora_leaves(qcfg, lora, qparams)
-            tok = HFTokenizer.from_pretrained_dir(
+            tok = tokenizer_for(
                 spec.get("tokenizer", path if os.path.isdir(path) else
                          os.path.dirname(path)))
             eos_raw = spec.get("eos_token_ids") or \
@@ -286,8 +309,14 @@ def build_engine(cfg: RouterConfig, mock: bool = False, registry=None):
             module = ModernBertForTokenClassification(mcfg)
         else:
             module = ModernBertForSequenceClassification(mcfg)
-        params = modernbert_params_from_state_dict(state)
-        tok = HFTokenizer.from_pretrained_dir(
+        # converted params dedup by path: two tasks served from one
+        # ModernBERT checkpoint share the SAME param arrays, which is
+        # exactly what lets the engine's trunk fingerprint fuse them
+        if path not in mb_params_cache:
+            mb_params_cache[path] = modernbert_params_from_state_dict(
+                load_state(path))
+        params = mb_params_cache[path]
+        tok = tokenizer_for(
             spec.get("tokenizer", path if os.path.isdir(path) else
                      os.path.dirname(path)))
         engine.register_task(task, kind, module, params, tok, labels,

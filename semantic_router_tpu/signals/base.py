@@ -55,8 +55,22 @@ class RequestContext:
     # per-request scratch shared across evaluators (e.g. memoized query
     # embeddings so embedding/preference/complexity share one forward)
     ext: Dict[Any, Any] = field(default_factory=dict)
+    # tokenize-once: learned signals thread this cache into every engine
+    # classify call, so K signals sharing a tokenizer pay ONE encode
+    # (utils.tokenization.EncodingCache; lazy default below)
+    enc_cache: Any = None
+    # (task, text) → ClassResult, seeded by the dispatcher's fused
+    # prefetch (one trunk forward for the whole learned fan-out);
+    # evaluators consult it before touching the engine
+    class_memo: Dict[Any, Any] = field(default_factory=dict)
     _user_text: Optional[str] = None
     _full_text: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        if self.enc_cache is None:
+            from ..utils.tokenization import EncodingCache
+
+            self.enc_cache = EncodingCache()
 
     # -- derived views -----------------------------------------------------
 

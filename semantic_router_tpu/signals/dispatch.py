@@ -21,6 +21,11 @@ from ..decision.projections import ProjectionEvaluator, ProjectionTrace
 from .base import RequestContext, SignalEvaluator, SignalResult
 
 
+# serial prefetch budget: a cold fused compile past this falls back to
+# the parallel per-evaluator path instead of stalling the whole request
+PREFETCH_TIMEOUT_S = 10.0
+
+
 @dataclass
 class DispatchReport:
     results: Dict[str, SignalResult] = field(default_factory=dict)
@@ -63,6 +68,7 @@ class SignalDispatcher:
                                     latency_s=time.perf_counter() - t0,
                                     error=f"{type(exc).__name__}: {exc}")
 
+        self._prefetch_fused(ctx, active)
         if len(active) <= 1:
             results = [run(e) for e in active]
         else:
@@ -112,6 +118,55 @@ class SignalDispatcher:
 
         report.wall_s = time.perf_counter() - start
         return signals, report
+
+    def _prefetch_fused(self, ctx: RequestContext, active: list) -> None:
+        """Tokenize-once + trunk-once for the learned fan-out.
+
+        When ≥2 active engine-backed sequence evaluators target tasks one
+        fused execution can serve (a shared TrunkGroup or the stacked
+        bank), classify the user text for ALL of them in one
+        classify_multi call BEFORE the thread fan-out and seed the
+        request's memo — the per-evaluator classify calls become lookups,
+        so a request activating K learned signals pays exactly one
+        tokenization and one trunk forward.  Unfusable mixes skip this
+        (sequential prefetch would serialize what the fan-out runs in
+        parallel); prefetch errors fall open to per-evaluator calls."""
+        text = ctx.user_text
+        memo = getattr(ctx, "class_memo", None)
+        if not text or memo is None:
+            return
+        by_engine: Dict[int, tuple] = {}
+        for e in active:
+            task = getattr(e, "prefetch_task", "")
+            engine = getattr(e, "engine", None)
+            if not task or engine is None:
+                continue
+            if (id(engine), task, text) in memo:
+                continue
+            if not engine.has_task(task) or \
+                    engine.task_kind(task) != "sequence":
+                continue
+            by_engine.setdefault(id(engine), (engine, []))[1].append(task)
+        for engine, tasks in by_engine.values():
+            tasks = sorted(set(tasks))
+            fused_covers = getattr(engine, "fused_covers", None)
+            if len(tasks) < 2 or fused_covers is None \
+                    or not fused_covers(tasks):
+                continue
+            try:
+                # bounded: the prefetch runs serially BEFORE the fan-out,
+                # so a cold compile must not stall the request for the
+                # engine's full default — on timeout the evaluators fall
+                # back to their own (parallel) classify calls while the
+                # abandoned batch keeps warming the jit cache
+                out = engine.classify_multi(
+                    tasks, [text], timeout=PREFETCH_TIMEOUT_S,
+                    enc_cache=getattr(ctx, "enc_cache", None))
+            except Exception:
+                continue  # evaluators classify individually (fail open)
+            for task, results in out.items():
+                if results:
+                    memo[(id(engine), task, text)] = results[0]
 
     def shutdown(self) -> None:
         self.pool.shutdown(wait=False, cancel_futures=True)

@@ -43,6 +43,30 @@ class _EngineSignal:
     def __init__(self, engine: InferenceEngine, task: str) -> None:
         self.engine = engine
         self.task = task
+        # sequence classification of ctx.user_text the dispatcher may
+        # batch AHEAD of the thread fan-out (one fused trunk forward for
+        # every learned family on a shared trunk); evaluators with other
+        # input shapes (token tasks) blank this out
+        self.prefetch_task = task
+
+    def _classify(self, ctx: RequestContext, text: str):
+        """Engine classify through the request's shared state: the
+        dispatcher-seeded memo first (fused prefetch already paid the
+        forward), else a classify call threading the tokenize-once
+        cache.  Memo keys carry the engine's identity — two engines
+        exposing the same task name must never read each other's
+        results."""
+        memo = getattr(ctx, "class_memo", None)
+        key = (id(self.engine), self.task, text)
+        if memo is not None:
+            hit = memo.get(key)
+            if hit is not None:
+                return hit
+        out = self.engine.classify(
+            self.task, text, enc_cache=getattr(ctx, "enc_cache", None))
+        if memo is not None:
+            memo[key] = out
+        return out
 
     def evaluate(self, ctx: RequestContext) -> SignalResult:
         start = time.perf_counter()
@@ -79,7 +103,7 @@ class DomainSignal(_EngineSignal):
                 self._by_name.setdefault(cat.lower(), r)
 
     def _evaluate(self, ctx: RequestContext, res: SignalResult) -> None:
-        out = self.engine.classify(self.task, ctx.user_text)
+        out = self._classify(ctx, ctx.user_text)
         rule = self._by_name.get(out.label.lower())
         if rule is not None and out.confidence >= self.threshold:
             detail = {"label": out.label}
@@ -121,7 +145,7 @@ class JailbreakSignal(_EngineSignal):
     # Controversial lands at typical rule thresholds, qwen3_guard.rs role)
     GUARD_SCORES = {"Unsafe": 0.95, "Controversial": 0.6, "Safe": 0.0}
 
-    def _classifier_score(self, text: str) -> float:
+    def _classifier_score(self, ctx: RequestContext, text: str) -> float:
         if not self.engine.has_task(self.task):
             return 0.0
         if self.engine.task_kind(self.task) == "generative":
@@ -129,7 +153,7 @@ class JailbreakSignal(_EngineSignal):
             # generation + parse instead of a softmax head
             verdict = self.engine.guard_classify(self.task, text)
             return self.GUARD_SCORES.get(verdict.safety, 0.6)
-        out = self.engine.classify(self.task, text)
+        out = self._classify(ctx, text)
         if out.label.lower() in self.positive:
             return out.confidence
         # positive-class probability even when benign wins
@@ -162,7 +186,7 @@ class JailbreakSignal(_EngineSignal):
                     # surface the disabled guard (pattern leg may still run)
                     res.error = f"task {self.task!r} not loaded"
                 elif text not in cls_cache:
-                    cls_cache[text] = self._classifier_score(text)
+                    cls_cache[text] = self._classifier_score(ctx, text)
                 score = cls_cache.get(text, 0.0)
             if rule.method in ("pattern", "hybrid"):
                 score = max(score, self._pattern_score(text, rule))
@@ -180,6 +204,7 @@ class PIISignal(_EngineSignal):
                  task: str = "pii") -> None:
         super().__init__(engine, task)
         self.rules = rules
+        self.prefetch_task = ""  # token task: not a sequence prefetch
 
     def _evaluate(self, ctx: RequestContext, res: SignalResult) -> None:
         cache: Dict[tuple, list] = {}
@@ -188,7 +213,8 @@ class PIISignal(_EngineSignal):
             if key not in cache:
                 text = ctx.text_for(rule.include_history)
                 out = self.engine.token_classify(
-                    self.task, text, threshold=rule.threshold)
+                    self.task, text, threshold=rule.threshold,
+                    enc_cache=getattr(ctx, "enc_cache", None))
                 cache[key] = out.entities
             entities = cache[key]
             allowed = {t.upper() for t in rule.pii_types_allowed}
@@ -217,7 +243,7 @@ class BinaryTaskSignal(_EngineSignal):
         self._names = {r.name.lower(): r for r in rules}
 
     def _evaluate(self, ctx: RequestContext, res: SignalResult) -> None:
-        out = self.engine.classify(self.task, ctx.user_text)
+        out = self._classify(ctx, ctx.user_text)
         rule = self._names.get(out.label.lower())
         if rule is not None:
             threshold = rule.threshold or 0.0

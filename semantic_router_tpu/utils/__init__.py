@@ -1,10 +1,11 @@
 from .tokenization import (
     Encoding,
+    EncodingCache,
     HashTokenizer,
     HFTokenizer,
     Tokenizer,
     decode_entity_spans,
 )
 
-__all__ = ["Encoding", "HFTokenizer", "HashTokenizer", "Tokenizer",
-           "decode_entity_spans"]
+__all__ = ["Encoding", "EncodingCache", "HFTokenizer", "HashTokenizer",
+           "Tokenizer", "decode_entity_spans"]

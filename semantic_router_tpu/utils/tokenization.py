@@ -18,8 +18,9 @@ from __future__ import annotations
 
 import hashlib
 import re
+import threading
 from dataclasses import dataclass, field
-from typing import List, Optional, Protocol, Tuple
+from typing import Callable, List, Optional, Protocol, Tuple
 
 
 @dataclass
@@ -137,6 +138,60 @@ class HFTokenizer:
 
     def decode(self, ids: List[int]) -> str:
         return self.tok.decode(list(ids), skip_special_tokens=True)
+
+
+class EncodingCache:
+    """Request-scoped tokenize-once cache.
+
+    A request fanning out to K learned signals on one shared trunk used to
+    pay K identical tokenizations; with the cache threaded through the
+    dispatch (signals.base.RequestContext.enc_cache → engine classify
+    calls) the prompt encodes once per (tokenizer, max_length) and every
+    signal shares the Encoding.
+
+    Per-key reservation, not a global encode lock: racing threads on the
+    SAME key dedup (the loser waits on the winner's Future), while
+    distinct keys — different texts, tokenizers, or max lengths across
+    the fan-out — encode in parallel."""
+
+    def __init__(self) -> None:
+        self._entries: dict = {}  # key -> Future[Encoding]
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get_or_encode(self, tokenizer: "Tokenizer", text: str,
+                      max_length: int,
+                      on_miss: Optional[Callable[[], None]] = None
+                      ) -> Encoding:
+        from concurrent.futures import Future
+
+        key = (id(tokenizer), max_length, text)
+        with self._lock:
+            fut = self._entries.get(key)
+            if fut is None:
+                fut = Future()
+                self._entries[key] = fut
+                mine = True
+                self.misses += 1
+            else:
+                mine = False
+                self.hits += 1
+        if not mine:
+            return fut.result()
+        try:
+            enc = tokenizer.encode(text, max_length=max_length)
+        except BaseException as exc:
+            # drop the reservation so a later call can retry; current
+            # waiters see the error
+            with self._lock:
+                self._entries.pop(key, None)
+            fut.set_exception(exc)
+            raise
+        fut.set_result(enc)
+        if on_miss is not None:
+            on_miss()
+        return enc
 
 
 def encode_windows(tokenizer: "Tokenizer", text: str, max_length: int,

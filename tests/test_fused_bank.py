@@ -1,0 +1,431 @@
+"""Fused classifier-bank execution (engine TrunkGroup): trunk grouping,
+fused-vs-traditional equivalence, mixed-task/LoRA batches, the
+tokenize-once + trunk-once fan-out acceptance counters, the jit-cache
+budget, head-bank sharding specs, and the batcher/bucket satellites."""
+
+import math
+import threading
+
+import numpy as np
+import pytest
+
+from semantic_router_tpu.config.schema import (
+    DomainRule,
+    InferenceEngineConfig,
+    NamedRule,
+)
+from semantic_router_tpu.engine import DynamicBatcher, pick_bucket, pow2_batch
+from semantic_router_tpu.engine.testing import (
+    SHARED_TRUNK_TASKS,
+    make_shared_trunk_engine,
+)
+from semantic_router_tpu.observability.metrics import (
+    MetricSeries,
+    MetricsRegistry,
+)
+from semantic_router_tpu.utils.tokenization import EncodingCache, HashTokenizer
+
+TASKS = [name for name, _ in SHARED_TRUNK_TASKS]
+
+
+def fresh_series() -> MetricSeries:
+    return MetricSeries(MetricsRegistry())
+
+
+@pytest.fixture(scope="module")
+def fused_engine():
+    """Shared-trunk engine: 3 sequence tasks, one (fact_check) head-LoRA."""
+    eng = make_shared_trunk_engine(lora_tasks=("fact_check",),
+                                   metrics=fresh_series())
+    yield eng
+    eng.shutdown()
+
+
+@pytest.fixture(scope="module")
+def unfused_engine():
+    """Same tasks/weights, fusion off — the equivalence reference."""
+    eng = make_shared_trunk_engine(lora_tasks=("fact_check",), fuse=False,
+                                   metrics=fresh_series())
+    yield eng
+    eng.shutdown()
+
+
+class TestTrunkGrouping:
+    def test_shared_trunk_forms_one_group(self, fused_engine):
+        groups = fused_engine.trunk_group_info()
+        assert len(groups) == 1
+        (members,) = groups.values()
+        assert sorted(members) == sorted(TASKS)
+
+    def test_distinct_trunks_do_not_group(self):
+        # independent inits → different trunk arrays → separate groups
+        eng = make_shared_trunk_engine(metrics=fresh_series())
+        eng2 = make_shared_trunk_engine(seed=1, metrics=fresh_series())
+        try:
+            a = list(eng.trunk_group_info().values())
+            b = list(eng2.trunk_group_info().values())
+            assert len(a) == 1 and len(b) == 1
+        finally:
+            eng.shutdown()
+            eng2.shutdown()
+
+    def test_opt_out_knob_disables_grouping(self, unfused_engine):
+        assert unfused_engine.trunk_group_info() == {}
+        res = unfused_engine.classify("intent", "plain path still serves")
+        assert res.label in unfused_engine.task_labels("intent")
+
+    def test_config_knob_disables_grouping(self):
+        cfg = InferenceEngineConfig(max_batch_size=8, max_wait_ms=1.0,
+                                    seq_len_buckets=[32, 128, 512],
+                                    fuse_trunks=False)
+        eng = make_shared_trunk_engine(engine_cfg=cfg,
+                                       metrics=fresh_series())
+        try:
+            assert eng.trunk_group_info() == {}
+        finally:
+            eng.shutdown()
+
+    def test_reregistration_replaces_member(self):
+        """Hot-reloading a task must REPLACE its bank row, never append
+        a stale duplicate; re-registering as non-fusable evicts it."""
+        eng = make_shared_trunk_engine(metrics=fresh_series())
+        try:
+            t = eng._tasks["intent"]
+            eng.register_task("intent", "sequence", t.module, t.params,
+                              t.tokenizer, t.labels, max_seq_len=512)
+            (members,) = eng.trunk_group_info().values()
+            assert sorted(members) == sorted(TASKS)  # no duplicate row
+            eng.register_task("intent", "sequence", t.module, t.params,
+                              t.tokenizer, t.labels, max_seq_len=512,
+                              fuse=False)
+            (members,) = eng.trunk_group_info().values()
+            assert sorted(members) == sorted(set(TASKS) - {"intent"})
+            res = eng.classify("intent", "still serves traditionally")
+            assert res.label in eng.task_labels("intent")
+            # remaining members still serve correct fused results
+            res2 = eng.classify("fact_check", "check this")
+            assert res2.label in eng.task_labels("fact_check")
+        finally:
+            eng.shutdown()
+
+    def test_config_knob_parses(self):
+        assert InferenceEngineConfig.from_dict({}).fuse_trunks is True
+        assert InferenceEngineConfig.from_dict(
+            {"fuse_trunks": False}).fuse_trunks is False
+
+
+class TestFusedEquivalence:
+    TEXTS = ["what is the capital of france",
+             "sue them for breach of contract now",
+             "does this medicine interact with alcohol",
+             "segfault in my rust program"]
+
+    def test_classify_matches_traditional(self, fused_engine,
+                                          unfused_engine):
+        """Same inputs through fused vs per-task execution produce
+        identical ClassResults — including the LoRA member."""
+        for task in TASKS:
+            fused = fused_engine.classify_batch(task, self.TEXTS)
+            trad = unfused_engine.classify_batch(task, self.TEXTS)
+            for f, t in zip(fused, trad):
+                assert f.label == t.label
+                assert f.index == t.index
+                assert set(f.probs) == set(t.probs)
+                for k in f.probs:
+                    assert f.probs[k] == pytest.approx(t.probs[k],
+                                                       abs=1e-4)
+
+    def test_classify_multi_matches_traditional(self, fused_engine,
+                                                unfused_engine):
+        """Mixed-task fused batches (one item, K tasks) decode each task
+        with its own label set, matching K separate traditional runs."""
+        out = fused_engine.classify_multi(TASKS, self.TEXTS)
+        for task in TASKS:
+            trad = unfused_engine.classify_batch(task, self.TEXTS)
+            for f, t in zip(out[task], trad):
+                assert f.label == t.label
+                assert f.confidence == pytest.approx(t.confidence,
+                                                     abs=1e-4)
+
+    def test_lora_adapter_actually_applies(self, fused_engine):
+        """The LoRA member's stacked adapter is non-zero in the bank —
+        the fused head math includes the delta, it does not silently run
+        the base head (equivalence above proves it matches module.apply,
+        which applies the delta)."""
+        g = list(fused_engine._groups_by_gid.values())[0]
+        assert "lora_A" in g.bank and "lora_B" in g.bank
+        row = g.row_of["fact_check"]
+        assert float(np.abs(np.asarray(g.bank["lora_B"][row])).max()) > 0
+        # non-LoRA members ride the same batch with exact no-op rows
+        assert float(np.abs(np.asarray(
+            g.bank["lora_B"][g.row_of["intent"]])).max()) == 0.0
+
+    def test_concurrent_mixed_tasks_coalesce(self):
+        """Concurrent classify() calls on DIFFERENT member tasks land in
+        one (trunk, bucket) group — the cross-task coalescing the
+        (task, bucket) keying could never do."""
+        series = fresh_series()
+        cfg = InferenceEngineConfig(max_batch_size=8, max_wait_ms=50.0,
+                                    seq_len_buckets=[32, 128, 512])
+        eng = make_shared_trunk_engine(engine_cfg=cfg, metrics=series)
+        try:
+            results = {}
+
+            def worker(i):
+                task = TASKS[i % len(TASKS)]
+                results[i] = eng.classify(task, f"payload number {i}")
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(12)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert len(results) == 12
+            stats = eng.batcher.stats()
+            # 12 items from 3 different tasks rode FEWER batches than
+            # items — impossible under per-task keys with max_wait high
+            assert stats["max_batch"] >= 2
+            fused = sum(v for k, v in
+                        series.trunk_forwards.values().items()
+                        if ("path", "fused") in k)
+            assert 0 < fused < 12
+        finally:
+            eng.shutdown()
+
+
+class TestFanoutCounters:
+    def _dispatcher(self, eng):
+        from semantic_router_tpu.signals.dispatch import SignalDispatcher
+        from semantic_router_tpu.signals.learned import (
+            BinaryTaskSignal,
+            DomainSignal,
+        )
+
+        return SignalDispatcher([
+            DomainSignal(eng, [DomainRule(name=n)
+                               for n in eng.task_labels("intent")]),
+            BinaryTaskSignal(eng, [NamedRule(name=n) for n in
+                                   eng.task_labels("fact_check")],
+                             "fact_check", "fact_check"),
+            BinaryTaskSignal(eng, [NamedRule(name=n) for n in
+                                   eng.task_labels("user_feedback")],
+                             "user_feedback", "user_feedback"),
+        ])
+
+    def test_k_signals_one_trunk_forward_one_tokenization(self):
+        """Acceptance: a request activating K=3 learned signals on one
+        shared trunk executes exactly 1 trunk forward and 1 tokenization
+        (counter-backed), with outputs matching the unfused engine."""
+        from semantic_router_tpu.signals.base import (
+            Message,
+            RequestContext,
+        )
+
+        series = fresh_series()
+        eng = make_shared_trunk_engine(lora_tasks=("fact_check",),
+                                       metrics=series)
+        disp = self._dispatcher(eng)
+        try:
+            ctx = RequestContext(messages=[
+                Message("user", "please fact check the capital of france")])
+            _, report = disp.evaluate(ctx)
+            assert not any(r.error for r in report.results.values())
+            assert series.trunk_forwards.total() == 1
+            assert series.tokenizations.total() == 1
+            # all three families produced results from that one forward
+            assert set(report.results) == {"domain", "fact_check",
+                                           "user_feedback"}
+            # memo carries the per-task results the evaluators consumed
+            assert len(ctx.class_memo) == 3
+        finally:
+            disp.shutdown()
+            eng.shutdown()
+
+    def test_fanout_matches_unfused_results(self, unfused_engine):
+        """The prefetched fan-out's decisions equal the per-task path's."""
+        from semantic_router_tpu.signals.base import (
+            Message,
+            RequestContext,
+        )
+
+        series = fresh_series()
+        eng = make_shared_trunk_engine(lora_tasks=("fact_check",),
+                                       metrics=series)
+        disp = self._dispatcher(eng)
+        disp_ref = self._dispatcher(unfused_engine)
+        try:
+            msg = "my program crashes with a segmentation fault"
+            a = disp.evaluate(RequestContext(
+                messages=[Message("user", msg)]))[1]
+            b = disp_ref.evaluate(RequestContext(
+                messages=[Message("user", msg)]))[1]
+            for fam in a.results:
+                ha = [(h.rule, round(h.confidence, 4))
+                      for h in a.results[fam].hits]
+                hb = [(h.rule, round(h.confidence, 4))
+                      for h in b.results[fam].hits]
+                assert ha == hb
+        finally:
+            disp.shutdown()
+            disp_ref.shutdown()
+            eng.shutdown()
+
+    def test_tokenize_once_cache(self, fused_engine):
+        cache = EncodingCache()
+        fused_engine.classify("intent", "same text twice",
+                              enc_cache=cache)
+        fused_engine.classify("fact_check", "same text twice",
+                              enc_cache=cache)
+        assert cache.misses == 1
+        assert cache.hits == 1
+
+
+class TestJitCacheBudget:
+    def test_shapes_per_trunk_within_budget(self):
+        """The fused bank's compiled-shape count stays ≤
+        |buckets|·log2(max_batch) per TRUNK — one closed shape set for
+        the whole bank, not one per task (the tentpole's cache story)."""
+        cfg = InferenceEngineConfig(max_batch_size=8, max_wait_ms=1.0,
+                                    seq_len_buckets=[32, 128, 512])
+        series = fresh_series()
+        eng = make_shared_trunk_engine(engine_cfg=cfg, metrics=series)
+        try:
+            short = "short one"
+            medium = "word " * 60
+            long = "word " * 300
+            for task in TASKS:
+                for text in (short, medium, long):
+                    eng.classify(task, text)
+            eng.classify_multi(TASKS, [short, medium, long, short, long])
+            census = eng.shape_census()
+            trunk_keys = [k for k in census if k.startswith("trunk:")]
+            assert len(trunk_keys) == 1
+            budget = len(cfg.seq_len_buckets) * int(
+                math.log2(cfg.max_batch_size))
+            assert len(census[trunk_keys[0]]) <= budget
+            # and NO per-task shapes leaked out of the fused group
+            assert not any(k.startswith("task:") for k in census)
+        finally:
+            eng.shutdown()
+
+
+class TestBucketOverflow:
+    def test_overflow_tagged_and_counted(self):
+        """max_seq_len past the largest bucket: the clamp clips at the
+        bucket edge, tags the result truncated, and counts — never
+        silent."""
+        series = fresh_series()
+        cfg = InferenceEngineConfig(max_batch_size=8, max_wait_ms=1.0,
+                                    seq_len_buckets=[32])
+        eng = make_shared_trunk_engine(engine_cfg=cfg, metrics=series)
+        try:
+            res = eng.classify("intent", "word " * 100)
+            assert res.truncated
+            assert series.bucket_overflows.total() >= 1
+        finally:
+            eng.shutdown()
+
+    def test_pow2_batch_non_pow2_max(self):
+        # batch dims draw from {1,2,4,…} ∪ {max_batch}: one extra shape,
+        # still a closed set
+        assert pow2_batch(1, 12) == 1
+        assert pow2_batch(5, 12) == 8
+        assert pow2_batch(9, 12) == 12
+        assert pow2_batch(13, 12) == 12
+
+    def test_pick_bucket_clamps_documented(self):
+        assert pick_bucket(999, [32, 128]) == 128
+
+
+class TestBatcherHistograms:
+    def test_stats_report_wait_and_fill(self):
+        series = fresh_series()
+
+        def runner(key, items):
+            return [0] * len(items)
+
+        b = DynamicBatcher(runner, max_batch_size=8, max_wait_ms=5.0,
+                           name="histo-test", metrics=series)
+        try:
+            futs = b.submit_many("g", list(range(6)))
+            for f in futs:
+                f.result(timeout=5)
+            stats = b.stats()
+            assert stats["queue_wait_p99_s"] >= 0.0
+            assert 0.0 < stats["fill_ratio_mean"] <= 1.0
+            assert series.batcher_queue_wait.count(
+                batcher="histo-test") == 6
+            # exposition carries the series for /metrics scrapes
+            text = series.registry.expose()
+            assert "llm_batcher_queue_wait_seconds" in text
+            assert "llm_batcher_batch_fill_ratio" in text
+        finally:
+            b.shutdown()
+
+
+class TestBankSharding:
+    def test_head_bank_specs_task_axis_over_tp(self):
+        from jax.sharding import PartitionSpec as P
+
+        from semantic_router_tpu.parallel import (
+            create_mesh,
+            head_bank_specs,
+        )
+
+        mesh = create_mesh({"dp": 4, "tp": 2})
+        bank = {"cls_kernel": np.zeros((4, 16, 5), np.float32),
+                "scale": np.zeros((4,), np.float32)}
+        specs = head_bank_specs(bank, mesh)
+        assert specs["cls_kernel"] == P("tp", None, None)
+        assert specs["scale"] == P("tp")
+        # indivisible task count replicates rather than erroring
+        bank3 = {"cls_kernel": np.zeros((3, 16, 5), np.float32)}
+        assert head_bank_specs(bank3, mesh)["cls_kernel"] == P()
+        # dp-only mesh: bank replicates (dp shards batches, not heads)
+        assert head_bank_specs(bank, create_mesh({"dp": 8}))[
+            "cls_kernel"] == P()
+
+    def test_fused_serving_on_cpu_mesh_matches_unsharded(self):
+        """The classifier-bank sharding story on a CPU mesh: 4 tasks'
+        head bank laid out over tp=2, trunk Megatron-sharded, batches
+        dp-sharded — results equal the unsharded fused engine's."""
+        four = SHARED_TRUNK_TASKS + [("jailbreak", ["benign", "jailbreak"])]
+        mesh_cfg = InferenceEngineConfig(
+            max_batch_size=8, max_wait_ms=1.0,
+            seq_len_buckets=[32, 128, 512],
+            mesh_shape={"dp": 4, "tp": 2})
+        eng_mesh = make_shared_trunk_engine(
+            tasks=four, lora_tasks=("fact_check",), engine_cfg=mesh_cfg,
+            metrics=fresh_series())
+        eng_plain = make_shared_trunk_engine(
+            tasks=four, lora_tasks=("fact_check",),
+            metrics=fresh_series())
+        try:
+            g = list(eng_mesh._groups_by_gid.values())[0]
+            # the spec landed: task axis of the bank is tp-sharded
+            from semantic_router_tpu.parallel import AXIS_TENSOR
+
+            spec = g.bank["cls_kernel"].sharding.spec
+            assert spec[0] == AXIS_TENSOR
+            texts = ["hello mesh world", "fact check this claim today"]
+            out_m = eng_mesh.classify_multi([n for n, _ in four], texts)
+            out_p = eng_plain.classify_multi([n for n, _ in four], texts)
+            for task in out_m:
+                for a, b in zip(out_m[task], out_p[task]):
+                    assert a.label == b.label
+                    assert a.confidence == pytest.approx(b.confidence,
+                                                         abs=1e-3)
+        finally:
+            eng_mesh.shutdown()
+            eng_plain.shutdown()
+
+
+class TestWindowedStillTraditional:
+    def test_classify_windowed_on_fused_task(self, fused_engine):
+        """Stride-window classification bypasses the fused group (per-
+        task windows) and still serves."""
+        res = fused_engine.classify_windowed("intent", "word " * 700,
+                                             stride=16)
+        assert res.label in fused_engine.task_labels("intent")
+        assert res.truncated is False
