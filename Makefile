@@ -1,8 +1,8 @@
 PY ?= python
 SHELL := /bin/bash
 
-.PHONY: test test-fast tier1 native bench bench-replay perf perf-record \
-	serve-mock clean
+.PHONY: test test-fast tier1 trace-smoke native bench bench-replay perf \
+	perf-record serve-mock clean
 
 bench-replay:
 	$(PY) benchmarks/replay_bench.py --n 500 \
@@ -18,6 +18,14 @@ test-fast:
 # count emitted) — what the driver runs after every PR
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
+# trace continuity gate (docs/TRACING.md): boots the pipeline over a fake
+# shared-trunk engine, pushes 50 mixed-signal requests, and asserts every
+# trace carries a batch.ride span linked to its batch.execute step span.
+# The same tests run inside `make tier1` (they are not marked slow).
+trace-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_trace_smoke.py \
+	  tests/test_batchtrace.py -q -p no:cacheprovider
 
 native:
 	$(PY) -m semantic_router_tpu.native.build

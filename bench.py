@@ -325,6 +325,60 @@ def _emit_cached_tpu_result(max_age_s: float = 20 * 3600.0) -> bool:
         return False
 
 
+def _measure_tracing_overhead(platform: str) -> dict:
+    """signals/s through the tiny shared-trunk ENGINE (batcher + fused
+    trunk group — the path batch tracing instruments) under three tracing
+    postures: off (no active span), sampled (10%), full (100%)."""
+    import time as _time
+
+    from semantic_router_tpu.engine.testing import make_shared_trunk_engine
+    from semantic_router_tpu.observability.metrics import (
+        MetricSeries,
+        MetricsRegistry,
+    )
+    from semantic_router_tpu.observability.tracing import Tracer
+
+    tasks = ["intent", "fact_check", "user_feedback"]
+    n_iters = 30 if platform == "cpu" else 150
+    eng = make_shared_trunk_engine(metrics=MetricSeries(MetricsRegistry()))
+    try:
+        texts = [f"benchmark request number {i} about contract law"
+                 for i in range(16)]
+
+        def run(tracer, n):
+            t0 = _time.perf_counter()
+            for i in range(n):
+                if tracer is None:
+                    eng.classify_multi(tasks, [texts[i % len(texts)]])
+                else:
+                    with tracer.span("router.route"):
+                        eng.classify_multi(tasks,
+                                           [texts[i % len(texts)]])
+            elapsed = _time.perf_counter() - t0
+            return len(tasks) * n / elapsed
+
+        # warm BOTH execution paths before any posture measures: the
+        # fused single call (untraced) and the split traced programs —
+        # otherwise the 10%-sampled arm pays the split compiles inside
+        # its measured window (its own warmup traces are rarely sampled)
+        run(None, 3)
+        run(Tracer(capacity=65536, sample_rate=1.0), 3)
+
+        off = run(None, n_iters)
+        # big ring: the measurement must not pay ring-eviction churn
+        sampled = run(Tracer(capacity=65536, sample_rate=0.1), n_iters)
+        full = run(Tracer(capacity=65536, sample_rate=1.0), n_iters)
+        return {
+            "engine_signals_per_s_tracing_off": round(off, 1),
+            "engine_signals_per_s_tracing_sampled_10pct": round(sampled, 1),
+            "engine_signals_per_s_tracing_full": round(full, 1),
+            "sampled_overhead_pct": round(100.0 * (off - sampled) / off, 2),
+            "full_overhead_pct": round(100.0 * (off - full) / off, 2),
+        }
+    finally:
+        eng.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # the measurement (runs inside whichever process owns the backend)
 
@@ -548,6 +602,21 @@ def _run_bench(platform: str) -> None:
                              f"({type(exc).__name__}: {exc}); "
                              f"single-task number stands\n")
 
+    # observability overhead arm (docs/TRACING.md): the ENGINE path
+    # (batcher + fused trunk group) measured with tracing off (no active
+    # span → batchtrace.capture() short-circuits), sampled (10% of traces
+    # pay per-stage device fencing), and 100%.  Emitted into the BENCH
+    # JSON so the perf trajectory catches tracing regressions; the
+    # tracing-off number is the one that must stay within noise of the
+    # uninstrumented engine.
+    obs_row = None
+    try:
+        obs_row = _measure_tracing_overhead(platform)
+        sys.stderr.write(f"bench: tracing overhead {obs_row}\n")
+    except Exception as exc:
+        sys.stderr.write(f"bench: observability arm failed "
+                         f"({type(exc).__name__}: {exc}); skipped\n")
+
     batch, signals_per_s, best_impl = best
     # On a CPU fallback the host geometry is the whole story (this image
     # exposes ONE 2.1GHz core — ~0.09 TFLOPs f32 roofline — while the
@@ -566,6 +635,8 @@ def _run_bench(platform: str) -> None:
     if fused_row is not None:
         record["fused_bank_signals_per_s"] = fused_row["signals_per_s"]
         record["fused_bank_tasks"] = BANK_TASKS
+    if obs_row is not None:
+        record["observability"] = obs_row
     if platform != "cpu":
         # side evidence for the bench README / judge: full sweep detail
         try:

@@ -1145,6 +1145,56 @@ class RouterConfig:
                 return m
         return None
 
+    # -- observability knobs ----------------------------------------------
+    # The observability block is free-form; these accessors are the ONE
+    # place its tracing/metrics/flight-recorder sub-keys are interpreted,
+    # so bootstrap and tests can never drift on defaults:
+    #
+    #   observability:
+    #     tracing:
+    #       otlp_endpoint: http://collector:4318   # OTLP/HTTP JSON export
+    #       sample_rate: 0.1       # fraction of traces with DETAILED
+    #                              # batch tracing (fenced per-stage
+    #                              # device timing); continuity spans
+    #                              # (batch.wait/ride + step links) are
+    #                              # never sampled away.  1.0 = every
+    #                              # trace pays the fences, 0 = none
+    #     metrics:
+    #       exemplars: true        # OpenMetrics trace-id exemplars on
+    #                              # histogram buckets (opt-in)
+    #     flight_recorder:
+    #       slowest_n: 16          # slowest requests retained with full
+    #                              # span trees (/debug/flightrec)
+    #       threshold_ms: 500      # also retain any request slower than
+    #                              # this (0/absent = slowest-N only)
+    #       breach_capacity: 64    # bounded ring for threshold breaches
+
+    def tracing_config(self) -> Dict[str, Any]:
+        return dict((self.observability or {}).get("tracing", {}) or {})
+
+    def tracing_sample_rate(self) -> float:
+        try:
+            return float(self.tracing_config().get("sample_rate", 0.1))
+        except (TypeError, ValueError):
+            return 0.1
+
+    def metrics_exemplars_enabled(self) -> bool:
+        m = (self.observability or {}).get("metrics", {}) or {}
+        return bool(m.get("exemplars", False))
+
+    def flight_recorder_config(self) -> Dict[str, Any]:
+        """Normalized FlightRecorder.configure kwargs from the
+        observability.flight_recorder block (ms → s for the threshold)."""
+        fr = (self.observability or {}).get("flight_recorder", {}) or {}
+        out: Dict[str, Any] = {}
+        if "slowest_n" in fr:
+            out["slowest_n"] = int(fr["slowest_n"])
+        if "threshold_ms" in fr:
+            out["threshold_s"] = float(fr["threshold_ms"]) / 1e3
+        if "breach_capacity" in fr:
+            out["breach_capacity"] = int(fr["breach_capacity"])
+        return out
+
     # -- recipes (pkg/config/recipes.go) -----------------------------------
 
     def recipe_by_name(self, name: str) -> Optional[RoutingRecipe]:

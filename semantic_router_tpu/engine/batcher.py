@@ -40,11 +40,28 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence, Set
 
 
+def _capture_trace():
+    """Snapshot the submitting thread's active trace context
+    (observability.batchtrace) so the batch runner — which executes on a
+    dispatch thread where thread-local tracer context is lost — can emit
+    batch.wait/batch.ride spans back into each request's trace.  One
+    thread-local read when no trace is open."""
+    try:
+        from ..observability.batchtrace import capture
+
+        return capture()
+    except Exception:
+        return None
+
+
 @dataclass
 class BatchItem:
     payload: Any  # model-specific (e.g. Encoding)
     future: Future = field(default_factory=Future)
     enqueue_t: float = field(default_factory=time.perf_counter)
+    # the originating request's (tracer, trace_id, span_id, sampled),
+    # captured at enqueue — None on untraced requests
+    trace: Any = field(default_factory=_capture_trace)
 
 
 BatchRunner = Callable[[Hashable, List[BatchItem]], Sequence[Any]]
@@ -204,7 +221,12 @@ class DynamicBatcher:
             s = self._series()
             now = time.perf_counter()
             for item in batch:
+                # exemplar: the waiting request's trace id, so a slow
+                # queue-wait bucket links straight to the trace that
+                # landed there (no-op unless exemplars are enabled)
+                tid = item.trace.trace_id if item.trace is not None else None
                 s.batcher_queue_wait.observe(now - item.enqueue_t,
+                                             exemplar=tid,
                                              batcher=self.name)
             s.batcher_fill_ratio.observe(len(batch) / self.max_batch_size,
                                          batcher=self.name)

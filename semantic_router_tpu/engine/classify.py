@@ -108,6 +108,12 @@ class _Payload:
     # a {task: ClassResult} dict.
     tasks: tuple = ()
     submit_t: float = field(default_factory=time.perf_counter)
+    # host tokenization cost attribution for batch tracing
+    # (observability.batchtrace emits a batch.tokenize span per traced
+    # request): seconds actually spent encoding, and whether the
+    # request-level EncodingCache already held the encoding
+    tok_s: float = 0.0
+    tok_cached: bool = False
 
 
 @dataclass
@@ -137,6 +143,12 @@ class TrunkGroup:
     # reads ONE consistent view, so a concurrent re-registration can
     # never pair new row indices with old logits ordering
     demux: Any = None
+    # (trunk+pool fn, head-bank fn): the SAME math as apply_fn split in
+    # two jit programs so sampled batch traces can time the trunk forward
+    # and the head matmul separately (batchtrace stage fencing); compiles
+    # lazily on the first sampled batch of a shape — the untraced hot
+    # path never runs them
+    traced_fns: Any = None
     # the HOST trunk leaves whose id()s form this group's fingerprint:
     # retained so those ids can never be freed and recycled by a later
     # checkpoint load (a stale id-match would silently serve the wrong
@@ -405,6 +417,16 @@ class InferenceEngine:
                       else cls_pool(hidden))
             return apply_head_bank(bank, pooled, act, cfg.norm_eps)
 
+        def trunk_pool(trunk_params, ids, mask):
+            hidden = trunk.apply({"params": trunk_params}, ids, mask)
+            return mean_pool(hidden, mask) if use_mean else cls_pool(hidden)
+
+        def heads(bank, pooled):
+            return apply_head_bank(bank, pooled, act, cfg.norm_eps)
+
+        # jit() is free until called: sampled batch traces pay the split
+        # programs' compiles, untraced traffic never touches them
+        g.traced_fns = (jax.jit(trunk_pool), jax.jit(heads))
         return jax.jit(fused)
 
     def trunk_group_info(self) -> Dict[str, List[str]]:
@@ -621,10 +643,12 @@ class InferenceEngine:
         tasks = list(tasks)
         by_bucket: Dict[int, List[tuple]] = {}
         for ti, text in enumerate(texts):
-            enc = self._encode_group(g, tasks, text, enc_cache)
+            enc, tok_s, cached = self._encode_group_info(g, tasks, text,
+                                                         enc_cache)
             bucket = pick_bucket(len(enc), self.cfg.seq_len_buckets)
             by_bucket.setdefault(bucket, []).append(
-                (ti, _Payload(text, enc, tasks=tuple(tasks))))
+                (ti, _Payload(text, enc, tasks=tuple(tasks),
+                              tok_s=tok_s, tok_cached=cached)))
         futs: List[tuple] = []
         for bucket, entries in by_bucket.items():
             fs = self.batcher.submit_many(
@@ -895,10 +919,11 @@ class InferenceEngine:
                        timeout: float = 30.0,
                        enc_cache=None) -> TokenClassResult:
         t = self._require(task, kind="token")
-        enc = self._encode(t, text, enc_cache)
+        enc, tok_s, cached = self._encode_info(t, text, enc_cache)
         bucket = pick_bucket(len(enc), self.cfg.seq_len_buckets)
         fut = self.batcher.submit((task, bucket),
-                                  _Payload(text, enc, threshold))
+                                  _Payload(text, enc, threshold,
+                                           tok_s=tok_s, tok_cached=cached))
         return fut.result(timeout=timeout)
 
     def embed(self, task: str, texts: Sequence[str],
@@ -989,6 +1014,17 @@ class InferenceEngine:
                     out = g.apply_fn(g.trunk_params, g.bank,
                                      ids_dev, mask_dev)
                     jax.block_until_ready(out)
+                    if g.traced_fns is not None:
+                        # the split batch-trace programs (batchtrace
+                        # stage fencing) compile on the first SAMPLED
+                        # batch of a shape — warm them here too, or that
+                        # compile lands inline on the batcher's worker
+                        # thread (the exact SLO breach this warmup
+                        # exists to prevent)
+                        trunk_fn, head_fn = g.traced_fns
+                        pooled = trunk_fn(g.trunk_params, ids_dev,
+                                          mask_dev)
+                        jax.block_until_ready(head_fn(g.bank, pooled))
                 except Exception:
                     pass
 
@@ -1057,32 +1093,47 @@ class InferenceEngine:
 
     def _encode_with(self, tokenizer: Tokenizer, max_seq_len: int,
                      text: str, enc_cache, tok_tag: str,
-                     trunc_tags: Sequence[str]) -> Encoding:
+                     trunc_tags: Sequence[str]
+                     ) -> tuple[Encoding, float, bool]:
         """Tokenize (or reuse the request's shared Encoding): the single
         tokenize-once seam.  ``tok_tag`` labels the tokenization counter
         (group id for shared group encodes — the work IS shared);
         ``trunc_tags`` labels truncation per member TASK, matching the
         traditional path's per-task attribution so existing dashboards
-        keep reading."""
+        keep reading.  Returns (encoding, seconds spent encoding,
+        cache-hit) so batch tracing can attribute host tokenization per
+        request."""
+        t0 = time.perf_counter()
+        missed = []
         if enc_cache is None:
             enc = tokenizer.encode(text, max_length=max_seq_len)
             self._count_tokenization(tok_tag)
+            missed.append(True)
         else:
-            enc = enc_cache.get_or_encode(
-                tokenizer, text, max_seq_len,
-                on_miss=lambda: self._count_tokenization(tok_tag))
+            def on_miss():
+                missed.append(True)
+                self._count_tokenization(tok_tag)
+
+            enc = enc_cache.get_or_encode(tokenizer, text, max_seq_len,
+                                          on_miss=on_miss)
+        tok_s = time.perf_counter() - t0
         if enc.truncated:
             s = self._series()
             for tag in trunc_tags:
                 s.truncated_inputs.inc(task=tag)
-        return enc
+        return enc, tok_s, not missed
 
     def _encode(self, t: _Task, text: str, enc_cache=None) -> Encoding:
+        return self._encode_info(t, text, enc_cache)[0]
+
+    def _encode_info(self, t: _Task, text: str, enc_cache=None
+                     ) -> tuple[Encoding, float, bool]:
         return self._encode_with(t.tokenizer, t.max_seq_len, text,
                                  enc_cache, t.name, (t.name,))
 
-    def _encode_group(self, g: TrunkGroup, tasks: Sequence[str],
-                      text: str, enc_cache=None) -> Encoding:
+    def _encode_group_info(self, g: TrunkGroup, tasks: Sequence[str],
+                           text: str, enc_cache=None
+                           ) -> tuple[Encoding, float, bool]:
         return self._encode_with(g.tokenizer, g.max_seq_len, text,
                                  enc_cache, g.gid, tuple(tasks))
 
@@ -1105,17 +1156,19 @@ class InferenceEngine:
         g = self._task_group.get(task)
         futures = []
         for text in texts:
-            enc = self._encode(t, text, enc_cache)
+            enc, tok_s, cached = self._encode_info(t, text, enc_cache)
             bucket = pick_bucket(len(enc), self.cfg.seq_len_buckets)
             if g is not None:
                 # fused member: batch under the TRUNK, so concurrent
                 # requests for sibling tasks coalesce into one forward
                 futures.append(self.batcher.submit(
                     (TRUNK_KEY, g.gid, bucket),
-                    _Payload(text, enc, tasks=(task,))))
+                    _Payload(text, enc, tasks=(task,),
+                             tok_s=tok_s, tok_cached=cached)))
             else:
-                futures.append(self.batcher.submit((task, bucket),
-                                                   _Payload(text, enc)))
+                futures.append(self.batcher.submit(
+                    (task, bucket),
+                    _Payload(text, enc, tok_s=tok_s, tok_cached=cached)))
         return futures
 
     def _padded_batch(self, n: int) -> int:
@@ -1158,69 +1211,100 @@ class InferenceEngine:
         t = self._require(task_name)
         n = len(items)
         padded_n = self._padded_batch(n)
-        ids, mask, clipped = self._stack_items(items, bucket, padded_n,
-                                               t.pad_id, task_name)
-        ids_dev, mask_dev = self._to_device(ids, mask)
-        self._note_shape(f"task:{task_name}", (padded_n, bucket))
 
         # named profiler regions: the XLA timeline lines up with router
         # semantics when a trace is being captured (observability.profiler)
+        from ..observability import batchtrace
         from ..observability.profiler import trace_span
 
-        if t.kind == "embedding":
-            p = items[0].payload
-            with trace_span(f"engine.embed.{t.name}"):
-                emb = t.apply_fn(t.params, ids_dev, mask_dev,
-                                 exit_layer=p.exit_layer,
-                                 output_dim=p.output_dim)
-                emb = np.asarray(jax.device_get(emb), dtype=np.float32)
+        # request-trace continuity across the batching boundary: one
+        # batch.execute step span when any item carries a trace, else
+        # None and the hot path pays a single list scan.  Opened BEFORE
+        # host stacking so the per-request batch.wait span ends where
+        # queue wait actually ends — stacking/H2D time belongs to the
+        # step, not to phantom queue congestion.
+        step = batchtrace.start_step(
+            items, group=f"task:{task_name}", bucket=bucket,
+            max_batch=self.cfg.max_batch_size, padded_rows=padded_n,
+            kind=t.kind)
+        try:
+            # batchtrace.stage() no-ops unless the step's trace is
+            # sampled — non-detailed traced batches still get the step +
+            # ride continuity spans from finish()
+            with batchtrace.stage(step, "stack"):
+                ids, mask, clipped = self._stack_items(
+                    items, bucket, padded_n, t.pad_id, task_name)
+                ids_dev, mask_dev = self._to_device(ids, mask)
+            self._note_shape(f"task:{task_name}", (padded_n, bucket))
+            fwd_cm = batchtrace.stage(step, "trunk_forward")
+
+            if t.kind == "embedding":
+                p = items[0].payload
+                with trace_span(f"engine.embed.{t.name}"), fwd_cm:
+                    emb = t.apply_fn(t.params, ids_dev, mask_dev,
+                                     exit_layer=p.exit_layer,
+                                     output_dim=p.output_dim)
+                    emb = np.asarray(jax.device_get(emb), dtype=np.float32)
+                self._series().trunk_forwards.inc(group=task_name,
+                                                  path="traditional")
+                return [emb[i] for i in range(n)]
+
+            with trace_span(f"engine.classify.{t.name}"), fwd_cm:
+                logits = t.apply_fn(t.params, ids_dev, mask_dev)
+                logits = np.asarray(jax.device_get(logits),
+                                    dtype=np.float32)
             self._series().trunk_forwards.inc(group=task_name,
                                               path="traditional")
-            return [emb[i] for i in range(n)]
 
-        with trace_span(f"engine.classify.{t.name}"):
-            logits = t.apply_fn(t.params, ids_dev, mask_dev)
-            logits = np.asarray(jax.device_get(logits), dtype=np.float32)
-        self._series().trunk_forwards.inc(group=task_name,
-                                          path="traditional")
-
-        now = time.perf_counter()
-        if t.kind == "sequence":
-            probs = _softmax(logits[:n])
-            out = []
-            for i, item in enumerate(items):
-                p = probs[i]
-                idx = int(p.argmax())
-                out.append(ClassResult(
-                    label=t.labels[idx] if idx < len(t.labels) else str(idx),
-                    index=idx,
-                    confidence=float(p[idx]),
-                    probs={t.labels[j] if j < len(t.labels) else str(j):
-                           float(p[j]) for j in range(p.shape[-1])},
-                    latency_s=now - item.payload.submit_t,
-                    truncated=item.payload.encoding.truncated or clipped[i],
-                ))
+            demux_cm = batchtrace.stage(step, "demux")
+            now = time.perf_counter()
+            if t.kind == "sequence":
+                with demux_cm:
+                    probs = _softmax(logits[:n])
+                    out = []
+                    for i, item in enumerate(items):
+                        p = probs[i]
+                        idx = int(p.argmax())
+                        out.append(ClassResult(
+                            label=t.labels[idx] if idx < len(t.labels)
+                            else str(idx),
+                            index=idx,
+                            confidence=float(p[idx]),
+                            probs={t.labels[j] if j < len(t.labels)
+                                   else str(j):
+                                   float(p[j]) for j in range(p.shape[-1])},
+                            latency_s=now - item.payload.submit_t,
+                            truncated=item.payload.encoding.truncated
+                            or clipped[i],
+                        ))
+                return out
+            # token classification
+            with demux_cm:
+                probs = _softmax(logits[:n])  # [n, S, L]
+                out = []
+                for i, item in enumerate(items):
+                    enc = item.payload.encoding
+                    L = min(len(enc), bucket)
+                    tok_probs = probs[i, :L]
+                    pred = tok_probs.argmax(-1)
+                    labels = [t.labels[j] if j < len(t.labels) else str(j)
+                              for j in pred]
+                    scores = [float(tok_probs[k, j])
+                              for k, j in enumerate(pred)]
+                    spans = decode_entity_spans(
+                        item.payload.text, enc.offsets[:L], labels, scores,
+                        threshold=item.payload.threshold)
+                    out.append(TokenClassResult(
+                        entities=[EntitySpan(**s) for s in spans],
+                        latency_s=now - item.payload.submit_t,
+                        truncated=enc.truncated or clipped[i],
+                    ))
             return out
-        # token classification
-        probs = _softmax(logits[:n])  # [n, S, L]
-        out = []
-        for i, item in enumerate(items):
-            enc = item.payload.encoding
-            L = min(len(enc), bucket)
-            tok_probs = probs[i, :L]
-            pred = tok_probs.argmax(-1)
-            labels = [t.labels[j] if j < len(t.labels) else str(j)
-                      for j in pred]
-            scores = [float(tok_probs[k, j]) for k, j in enumerate(pred)]
-            spans = decode_entity_spans(
-                item.payload.text, enc.offsets[:L], labels, scores,
-                threshold=item.payload.threshold)
-            out.append(TokenClassResult(
-                entities=[EntitySpan(**s) for s in spans],
-                latency_s=now - item.payload.submit_t,
-                truncated=enc.truncated or clipped[i],
-            ))
-        return out
+        finally:
+            # failing batches are exactly the ones traces must explain:
+            # the step + ride spans emit even when the forward raised
+            if step is not None:
+                step.finish()
 
     def _run_fused_batch(self, gid: str, bucket: int,
                          items: List[BatchItem]) -> Sequence[Any]:
@@ -1236,45 +1320,87 @@ class InferenceEngine:
         bank, row_of, widths = g.demux
         n = len(items)
         padded_n = self._padded_batch(n)
-        ids, mask, clipped = self._stack_items(items, bucket, padded_n,
-                                               g.pad_id)
-        for i, item in enumerate(items):
-            if clipped[i]:
-                for task in item.payload.tasks:
-                    self._series().bucket_overflows.inc(task=task)
-        ids_dev, mask_dev = self._to_device(ids, mask)
 
+        from ..observability import batchtrace
         from ..observability.profiler import trace_span
 
-        with trace_span(f"engine.classify.fused.{gid}"):
-            logits = g.apply_fn(g.trunk_params, bank, ids_dev, mask_dev)
-            logits = np.asarray(jax.device_get(logits), dtype=np.float32)
-        self._series().trunk_forwards.inc(group=gid, path="fused")
-        self._note_shape(f"trunk:{gid}", (padded_n, bucket))
+        # cross-batch trace propagation (observability.batchtrace): a
+        # traced batch gets one batch.execute step span and each
+        # originating request's trace receives batch.wait/tokenize/ride
+        # spans linked to it; a SAMPLED batch additionally runs the same
+        # math as two fenced jit programs so trunk forward vs head
+        # matmul time attribute separately.  Untraced batches take the
+        # single fused call unchanged.  Opened BEFORE host stacking so
+        # batch.wait measures only queue time, not stacking/H2D.
+        step = batchtrace.start_step(
+            items, group=f"trunk:{gid}", bucket=bucket,
+            max_batch=self.cfg.max_batch_size, padded_rows=padded_n,
+            kind="fused")
+        try:
+            detailed = step is not None and step.detailed \
+                and g.traced_fns is not None
+            with batchtrace.stage(step, "stack"):
+                ids, mask, clipped = self._stack_items(items, bucket,
+                                                       padded_n, g.pad_id)
+                for i, item in enumerate(items):
+                    if clipped[i]:
+                        for task in item.payload.tasks:
+                            self._series().bucket_overflows.inc(task=task)
+                ids_dev, mask_dev = self._to_device(ids, mask)
+            with trace_span(f"engine.classify.fused.{gid}"):
+                if not detailed:
+                    # the default hot path: one fused program, no fences
+                    # (non-detailed traced batches still get step + ride
+                    # continuity spans from finish())
+                    logits = g.apply_fn(g.trunk_params, bank, ids_dev,
+                                        mask_dev)
+                else:
+                    # sampled: the SAME math split in two fenced programs
+                    # so trunk vs head time attribute separately
+                    trunk_fn, head_fn = g.traced_fns
+                    with step.stage("trunk_forward"):
+                        pooled = trunk_fn(g.trunk_params, ids_dev,
+                                          mask_dev)
+                        step.fence(pooled)
+                    with step.stage("head_matmul"):
+                        logits = head_fn(bank, pooled)
+                        step.fence(logits)
+                logits = np.asarray(jax.device_get(logits),
+                                    dtype=np.float32)
+            self._series().trunk_forwards.inc(group=gid, path="fused")
+            self._note_shape(f"trunk:{gid}", (padded_n, bucket))
 
-        now = time.perf_counter()
-        out: List[Any] = []
-        for i, item in enumerate(items):
-            enc = item.payload.encoding
-            per_task: Dict[str, ClassResult] = {}
-            for task in item.payload.tasks:
-                row = row_of[task]
-                width = widths[row]
-                p = _softmax(logits[i, row, :width][None, :])[0]
-                idx = int(p.argmax())
-                labels = self._tasks[task].labels
-                per_task[task] = ClassResult(
-                    label=labels[idx] if idx < len(labels) else str(idx),
-                    index=idx,
-                    confidence=float(p[idx]),
-                    probs={(labels[j] if j < len(labels) else str(j)):
-                           float(p[j]) for j in range(width)},
-                    latency_s=now - item.payload.submit_t,
-                    truncated=enc.truncated or clipped[i],
-                )
-            out.append(per_task[item.payload.tasks[0]]
-                       if len(item.payload.tasks) == 1 else per_task)
-        return out
+            demux_cm = batchtrace.stage(step, "demux")
+            now = time.perf_counter()
+            out: List[Any] = []
+            with demux_cm:
+                for i, item in enumerate(items):
+                    enc = item.payload.encoding
+                    per_task: Dict[str, ClassResult] = {}
+                    for task in item.payload.tasks:
+                        row = row_of[task]
+                        width = widths[row]
+                        p = _softmax(logits[i, row, :width][None, :])[0]
+                        idx = int(p.argmax())
+                        labels = self._tasks[task].labels
+                        per_task[task] = ClassResult(
+                            label=labels[idx] if idx < len(labels)
+                            else str(idx),
+                            index=idx,
+                            confidence=float(p[idx]),
+                            probs={(labels[j] if j < len(labels)
+                                    else str(j)):
+                                   float(p[j]) for j in range(width)},
+                            latency_s=now - item.payload.submit_t,
+                            truncated=enc.truncated or clipped[i],
+                        )
+                    out.append(per_task[item.payload.tasks[0]]
+                               if len(item.payload.tasks) == 1
+                               else per_task)
+            return out
+        finally:
+            if step is not None:
+                step.finish()
 
 
 def _softmax(x: np.ndarray) -> np.ndarray:

@@ -259,6 +259,14 @@ class ExtProcService:
         mutated = json.dumps(route.body).encode()
         set_hdrs = dict(route.headers)
         set_hdrs["content-length"] = str(len(mutated))
+        if getattr(route, "trace_id", "") \
+                and getattr(route, "root_span_id", ""):
+            # forward the request's trace toward the backend: upstream
+            # spans parent under the router.route ROOT span (a real,
+            # recorded span id — a fabricated one would break the trace
+            # tree, and non-hex ids fail W3C parsers outright)
+            self.router.tracer.inject(route.trace_id, route.root_span_id,
+                                      set_hdrs)
         return pb.ProcessingResponse(request_body=pb.BodyResponse(
             response=pb.CommonResponse(
                 status=pb.CommonResponse.CONTINUE,

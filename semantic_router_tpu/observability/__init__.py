@@ -1,7 +1,9 @@
 from . import metrics
+from .flightrec import FlightRecorder, default_flight_recorder
 from .logging import component_event, get_logger
 from .metrics import MetricsRegistry, default_registry
-from .tracing import Span, Tracer, default_tracer
+from .tracing import Span, Tracer, active_span, default_tracer
 
-__all__ = ["MetricsRegistry", "Span", "Tracer", "component_event",
+__all__ = ["FlightRecorder", "MetricsRegistry", "Span", "Tracer",
+           "active_span", "component_event", "default_flight_recorder",
            "default_registry", "default_tracer", "get_logger", "metrics"]

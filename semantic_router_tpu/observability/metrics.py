@@ -57,8 +57,16 @@ class Counter:
         with self._lock:
             return sum(self._values.values())
 
-    def expose(self) -> List[str]:
-        out = [f"# TYPE {self.name} counter"]
+    def expose(self, openmetrics: bool = False) -> List[str]:
+        # OpenMetrics declares a counter FAMILY without the _total suffix
+        # while its samples keep it ('# TYPE llm_x counter' + 'llm_x_total
+        # {...} v'); the classic 0.0.4 format puts the full sample name in
+        # the TYPE line.  A strict OpenMetrics parser rejects a _total-
+        # suffixed family name, failing the whole scrape.
+        family = self.name
+        if openmetrics and family.endswith("_total"):
+            family = family[:-len("_total")]
+        out = [f"# TYPE {family} counter"]
         with self._lock:
             for key, v in sorted(self._values.items()):
                 out.append(f"{self.name}{_fmt_labels(key)} {v}")
@@ -70,7 +78,7 @@ class Gauge(Counter):
         with self._lock:
             self._values[_label_key(labels)] = value
 
-    def expose(self) -> List[str]:
+    def expose(self, openmetrics: bool = False) -> List[str]:
         out = [f"# TYPE {self.name} gauge"]
         with self._lock:
             for key, v in sorted(self._values.items()):
@@ -86,9 +94,16 @@ class Histogram:
         self._counts: Dict[tuple, List[int]] = {}
         self._sums: Dict[tuple, float] = {}
         self._totals: Dict[tuple, int] = {}
+        # OpenMetrics exemplars: (labels, bucket idx) → latest
+        # (value, trace_id, unix ts); recorded only when the registry
+        # enabled exemplars AND the caller passed one (opt-in both ways —
+        # the hot path stays a plain counter bump otherwise)
+        self.exemplars = False
+        self._exemplars: Dict[tuple, Dict[int, tuple]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float, **labels: str) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None,
+                **labels: str) -> None:
         key = _label_key(labels)
         with self._lock:
             counts = self._counts.setdefault(key,
@@ -98,9 +113,13 @@ class Histogram:
                     counts[i] += 1
                     break
             else:
+                i = len(self.buckets)
                 counts[-1] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
+            if self.exemplars and exemplar:
+                self._exemplars.setdefault(key, {})[i] = (
+                    value, str(exemplar), time.time())
 
     def percentile(self, p: float, **labels: str) -> float:
         key = _label_key(labels)
@@ -151,7 +170,22 @@ class Histogram:
                 "mean": total_sum / total if total else 0.0,
                 "p50": pct(50), "p95": pct(95), "p99": pct(99)}
 
-    def expose(self) -> List[str]:
+    def _exemplar_suffix(self, key: tuple, i: int) -> str:
+        """OpenMetrics exemplar clause for bucket ``i`` of ``key``:
+        ``# {trace_id="..."} value ts`` — links the bucket to the trace
+        that landed there."""
+        ex = self._exemplars.get(key, {}).get(i)
+        if ex is None:
+            return ""
+        v, tid, ts = ex
+        return f' # {{trace_id="{tid}"}} {v} {round(ts, 3)}'
+
+    def expose(self, openmetrics: bool = False) -> List[str]:
+        # histogram families are already suffix-less (_bucket/_sum/_count
+        # samples hang off the base name) — valid in both formats.
+        # Exemplar clauses are ONLY legal in OpenMetrics: even if some
+        # were recorded while the knob was on, a 0.0.4 exposition must
+        # not carry them (a strict parser fails the whole scrape).
         out = [f"# TYPE {self.name} histogram"]
         with self._lock:
             for key in sorted(self._counts):
@@ -160,11 +194,19 @@ class Histogram:
                     cum += self._counts[key][i]
                     lab = dict(key)
                     lab["le"] = repr(b)
-                    out.append(f"{self.name}_bucket{_fmt_labels(_label_key(lab))} {cum}")
+                    ex = self._exemplar_suffix(key, i) if openmetrics \
+                        else ""
+                    out.append(
+                        f"{self.name}_bucket"
+                        f"{_fmt_labels(_label_key(lab))} {cum}{ex}")
                 cum += self._counts[key][-1]
                 lab = dict(key)
                 lab["le"] = "+Inf"
-                out.append(f"{self.name}_bucket{_fmt_labels(_label_key(lab))} {cum}")
+                ex = self._exemplar_suffix(key, len(self.buckets)) \
+                    if openmetrics else ""
+                out.append(
+                    f"{self.name}_bucket{_fmt_labels(_label_key(lab))} "
+                    f"{cum}{ex}")
                 out.append(f"{self.name}_sum{_fmt_labels(key)} "
                            f"{self._sums[key]}")
                 out.append(f"{self.name}_count{_fmt_labels(key)} "
@@ -176,6 +218,17 @@ class MetricsRegistry:
     def __init__(self) -> None:
         self._metrics: Dict[str, object] = {}
         self._lock = threading.Lock()
+        self.exemplars_enabled = False
+
+    def enable_exemplars(self, enabled: bool = True) -> None:
+        """Opt histograms into OpenMetrics exemplars
+        (observability.metrics.exemplars config knob): applies to every
+        existing and future histogram of this registry."""
+        with self._lock:
+            self.exemplars_enabled = bool(enabled)
+            for m in self._metrics.values():
+                if isinstance(m, Histogram):
+                    m.exemplars = bool(enabled)
 
     def counter(self, name: str, help_: str = "") -> Counter:
         return self._get(name, lambda: Counter(name, help_))
@@ -185,7 +238,12 @@ class MetricsRegistry:
 
     def histogram(self, name: str, help_: str = "",
                   buckets: Iterable[float] = _DEFAULT_BUCKETS) -> Histogram:
-        return self._get(name, lambda: Histogram(name, help_, buckets))
+        def make() -> Histogram:
+            h = Histogram(name, help_, buckets)
+            h.exemplars = self.exemplars_enabled
+            return h
+
+        return self._get(name, make)
 
     def _get(self, name: str, factory):
         with self._lock:
@@ -199,8 +257,11 @@ class MetricsRegistry:
         lines: List[str] = []
         with self._lock:
             metrics = list(self._metrics.values())
+            om = self.exemplars_enabled
         for m in metrics:
-            lines.extend(m.expose())  # type: ignore[attr-defined]
+            # exemplars flip the whole exposition to OpenMetrics (the
+            # server also switches content type + appends '# EOF')
+            lines.extend(m.expose(om))  # type: ignore[attr-defined]
         return "\n".join(lines) + "\n"
 
     def families(self) -> List[Tuple[str, str, str]]:

@@ -33,7 +33,7 @@ def _attr_value(v) -> Dict:
 
 
 def span_to_otlp(span: Span) -> Dict:
-    return {
+    out = {
         "traceId": span.trace_id,
         "spanId": span.span_id,
         **({"parentSpanId": span.parent_id} if span.parent_id else {}),
@@ -44,6 +44,12 @@ def span_to_otlp(span: Span) -> Dict:
         "attributes": [{"key": k, "value": _attr_value(v)}
                        for k, v in span.attributes.items()],
     }
+    if getattr(span, "links", None):
+        # OTLP span links: how a request's batch.ride span references the
+        # shared batch.execute step span living in its own trace
+        out["links"] = [{"traceId": l["trace_id"], "spanId": l["span_id"]}
+                        for l in span.links]
+    return out
 
 
 def build_payload(spans: List[Span],

@@ -6,18 +6,62 @@ decision / plugin / upstream spans with attributes, W3C traceparent
 extraction+injection so router spans parent backend spans. When an
 OpenTelemetry SDK is importable it is used as the backend; otherwise spans
 collect into an in-proc ring buffer (inspectable by tests/dashboards).
+
+Spans carry TWO clock pairs: epoch times (``start_t``/``end_t``,
+``time.time``) for OTLP export, and monotonic times (``start_pc``/
+``end_pc``, ``time.perf_counter``) that ``duration_s`` reads — an NTP
+step mid-span can skew the exported wall-clock but can never produce a
+negative duration.  Spans also carry OTLP span *links* (non-parental
+references to spans in other traces) — the mechanism batch tracing uses
+to tie a request's ``batch.ride`` span to the shared ``batch.execute``
+device-step span (observability.batchtrace).
 """
 
 from __future__ import annotations
 
 import contextlib
-import random
+import os
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 _TRACEPARENT = "traceparent"
+
+_HEX = frozenset("0123456789abcdef")
+
+
+def _is_hex(s: str, n: int) -> bool:
+    return len(s) == n and not (set(s) - _HEX)
+
+
+def _rand_hex(n: int) -> str:
+    """os.urandom-backed id material: fork-safe (no shared PRNG state
+    cloned into workers) and collision-resistant, unlike the seeded
+    ``random`` module."""
+    return os.urandom((n + 1) // 2).hex()[:n]
+
+
+def new_trace_id() -> str:
+    return _rand_hex(32)
+
+
+def new_span_id() -> str:
+    return _rand_hex(16)
+
+
+# Cross-instance active-span context: the innermost open span of THIS
+# thread regardless of which Tracer opened it.  Batch tracing captures
+# from here at enqueue time (the batcher cannot know which tracer the
+# request bound), and the signal fan-out re-establishes it on worker
+# threads.
+_ACTIVE = threading.local()
+
+
+def active_span() -> Optional[Tuple["Tracer", "Span"]]:
+    """(tracer, span) of the calling thread's innermost open span, or
+    None.  The capture seam for observability.batchtrace."""
+    return getattr(_ACTIVE, "top", None)
 
 
 @dataclass
@@ -29,25 +73,42 @@ class Span:
     start_t: float = field(default_factory=time.time)
     end_t: float = 0.0
     attributes: Dict[str, object] = field(default_factory=dict)
+    # OTLP span links: non-parental references into OTHER traces
+    # ({"trace_id": ..., "span_id": ...}); exported via otlp.span_to_otlp
+    links: List[Dict[str, str]] = field(default_factory=list)
+    # monotonic pair backing duration_s (epoch pair stays for OTLP)
+    start_pc: float = field(default_factory=time.perf_counter)
+    end_pc: float = 0.0
 
     def set(self, **attrs) -> None:
         self.attributes.update(attrs)
 
+    def add_link(self, trace_id: str, span_id: str) -> None:
+        self.links.append({"trace_id": trace_id, "span_id": span_id})
+
     def end(self) -> None:
         self.end_t = time.time()
+        self.end_pc = time.perf_counter()
 
     @property
     def duration_s(self) -> float:
-        return (self.end_t or time.time()) - self.start_t
-
-
-def _rand_hex(n: int) -> str:
-    return "".join(random.choices("0123456789abcdef", k=n))
+        """Monotonic duration: immune to NTP steps between start and end
+        (time.time deltas went negative under clock slew — VERDICT-class
+        bug; the epoch pair is export-only)."""
+        return (self.end_pc or time.perf_counter()) - self.start_pc
 
 
 class Tracer:
-    def __init__(self, capacity: int = 2048) -> None:
+    def __init__(self, capacity: int = 2048,
+                 sample_rate: float = 0.1) -> None:
         self.capacity = capacity
+        # fraction of traces that get DETAILED batch tracing — the fenced
+        # split-program per-stage timing (observability.batchtrace).
+        # Trace CONTINUITY (batch.wait/ride spans + step links) is never
+        # sampled away; only the device-syncing detail is, so the default
+        # hot path pays no extra fences.  Deterministic per trace_id, so
+        # a trace is all-or-nothing.
+        self.sample_rate = sample_rate
         self._spans: List[Span] = []
         self._lock = threading.Lock()
         self._local = threading.local()
@@ -66,12 +127,18 @@ class Tracer:
 
     @staticmethod
     def extract(headers: Dict[str, str]) -> tuple[str, str]:
-        """traceparent → (trace_id, parent_span_id); fresh ids if absent."""
+        """traceparent → (trace_id, parent_span_id); fresh ids if absent.
+
+        Validated per W3C trace-context: 32-hex non-zero trace-id and
+        16-hex non-zero parent-id — a malformed member restarts the trace
+        instead of propagating garbage ids downstream."""
         tp = headers.get(_TRACEPARENT, "")
         parts = tp.split("-")
-        if len(parts) == 4 and len(parts[1]) == 32:
-            return parts[1], parts[2]
-        return _rand_hex(32), ""
+        if len(parts) == 4 and _is_hex(parts[1], 32) \
+                and parts[1] != "0" * 32:
+            if _is_hex(parts[2], 16) and parts[2] != "0" * 16:
+                return parts[1], parts[2]
+        return new_trace_id(), ""
 
     @staticmethod
     def inject(trace_id: str, span_id: str,
@@ -85,28 +152,42 @@ class Tracer:
              **attrs):
         current = getattr(self._local, "span", None)
         if not trace_id:
-            trace_id = current.trace_id if current else _rand_hex(32)
+            trace_id = current.trace_id if current else new_trace_id()
         if not parent_id and current is not None:
             parent_id = current.span_id
-        s = Span(name, trace_id, _rand_hex(16), parent_id,
+        s = Span(name, trace_id, new_span_id(), parent_id,
                  attributes=dict(attrs))
         prev = current
+        prev_active = getattr(_ACTIVE, "top", None)
         self._local.span = s
+        _ACTIVE.top = (self, s)
         try:
             yield s
         finally:
             s.end()
             self._local.span = prev
-            with self._lock:
-                self._spans.append(s)
-                if len(self._spans) > self.capacity:
-                    del self._spans[:len(self._spans) - self.capacity]
-                sinks = list(self._sinks)
-            for sink in sinks:  # exporters (OTLP); never raise into spans
-                try:
-                    sink(s)
-                except Exception:
-                    pass
+            _ACTIVE.top = prev_active
+            self._finish(s)
+
+    def record(self, span: Span) -> None:
+        """Record an externally-constructed span (batch tracing builds
+        spans with explicit timestamps on the batch runner thread): ring
+        + sinks, ending it first if the caller didn't."""
+        if not span.end_t:
+            span.end()
+        self._finish(span)
+
+    def _finish(self, s: Span) -> None:
+        with self._lock:
+            self._spans.append(s)
+            if len(self._spans) > self.capacity:
+                del self._spans[:len(self._spans) - self.capacity]
+            sinks = list(self._sinks)
+        for sink in sinks:  # exporters (OTLP); never raise into spans
+            try:
+                sink(s)
+            except Exception:
+                pass
 
     def signal_span(self, family: str, **attrs):
         return self.span(f"signal.{family}", **attrs)
@@ -114,13 +195,15 @@ class Tracer:
     def decision_span(self, **attrs):
         return self.span("decision.evaluate", **attrs)
 
-    def plugin_span(self, plugin: str, **attrs):
-        return self.span(f"plugin.{plugin}", **attrs)
-
     def spans(self, name_prefix: str = "") -> List[Span]:
         with self._lock:
             return [s for s in self._spans
                     if s.name.startswith(name_prefix)]
+
+    def trace(self, trace_id: str) -> List[Span]:
+        """Every retained span of one trace (flight recorder / tests)."""
+        with self._lock:
+            return [s for s in self._spans if s.trace_id == trace_id]
 
     def clear(self) -> None:
         with self._lock:

@@ -62,6 +62,11 @@ class RouteResult:
     routing_latency_s: float = 0.0
     request_id: str = ""
     looper_algorithm: str = ""  # set when the decision wants multi-model exec
+    # the request's trace id + root span id (router.route span):
+    # frontends inject them as traceparent toward the backend so upstream
+    # spans parent under a span that actually exists in the trace
+    trace_id: str = ""
+    root_span_id: str = ""
 
 
 @dataclass
@@ -106,7 +111,7 @@ class Router:
                  cache: Optional[CacheBackend] = None,
                  embedding_task: str = "embedding",
                  metrics: "Optional[M.MetricSeries]" = None,
-                 tracer=None) -> None:
+                 tracer=None, flightrec=None) -> None:
         self.cfg = cfg
         self.engine = engine
         self.embedding_task = embedding_task
@@ -115,6 +120,13 @@ class Router:
         # instead of feeding the process globals
         self.M = metrics or M.default_series
         self.tracer = tracer or default_tracer
+        # slow-request flight recorder (observability.flightrec): retains
+        # full span trees for the slowest/threshold-breaching requests;
+        # registry-bound when embedded, process default otherwise
+        from ..observability.flightrec import default_flight_recorder
+
+        self.flightrec = flightrec if flightrec is not None \
+            else default_flight_recorder
 
         extra = []
         if engine is not None:
@@ -311,7 +323,47 @@ class Router:
         start = time.perf_counter()
         headers = {k.lower(): v for k, v in (headers or {}).items()}
         request_id = headers.get(H.REQUEST_ID, uuid.uuid4().hex[:16])
+        # ONE root span per request, continuing the caller's W3C
+        # traceparent when present (Envoy → extproc passes headers
+        # through): the signal fan-out and the batcher's batch.wait/
+        # batch.ride spans all hang off this trace, so a request's tail
+        # latency decomposes end to end instead of ending at
+        # signals.evaluate (the pre-batchtrace blind spot)
+        trace_id, parent_span = self.tracer.extract(headers)
+        with self.tracer.span("router.route", trace_id=trace_id,
+                              parent_id=parent_span,
+                              request_id=request_id) as root:
+            result = self._route_impl(body, headers, request_id, trace_id,
+                                      start, precomputed_signals)
+            result.trace_id = trace_id
+            result.root_span_id = root.span_id
+            root.set(kind=result.kind, model=result.model)
+        self._flight_record(result, trace_id, request_id,
+                            time.perf_counter() - start)
+        return result
 
+    def _flight_record(self, result: RouteResult, trace_id: str,
+                       request_id: str, duration_s: float) -> None:
+        """Offer the finished request to the slow-request flight recorder
+        (observability.flightrec); the span tree only serializes when the
+        recorder admits the request, and recorder errors never surface
+        into routing."""
+        if self.flightrec is None:
+            return
+        try:
+            self.flightrec.consider(
+                request_id=request_id, trace_id=trace_id,
+                duration_s=duration_s,
+                span_provider=lambda: self.tracer.trace(trace_id),
+                meta={"kind": result.kind, "model": result.model,
+                      "decision": result.decision.decision.name
+                      if result.decision else ""})
+        except Exception:
+            pass
+
+    def _route_impl(self, body: Dict[str, Any], headers: Dict[str, str],
+                    request_id: str, trace_id: str, start: float,
+                    precomputed_signals=None) -> RouteResult:
         ctx = RequestContext.from_openai_body(body, headers)
 
         # rate limit (processor_req_body_prepare.go:143-170) — runs BEFORE
@@ -376,7 +428,8 @@ class Router:
                               H.REQUEST_ID: request_id}
             self._finalize_body(result, ctx, None)
             result.routing_latency_s = time.perf_counter() - start
-            self.M.routing_latency.observe(result.routing_latency_s)
+            self.M.routing_latency.observe(result.routing_latency_s,
+                                           exemplar=trace_id)
             return result
 
         decision = decision_res.decision
@@ -386,13 +439,15 @@ class Router:
         blocked = self._apply_policy_plugins(decision, signals, ctx, result)
         if blocked is not None:
             blocked.routing_latency_s = time.perf_counter() - start
-            self.M.routing_latency.observe(blocked.routing_latency_s)
+            self.M.routing_latency.observe(blocked.routing_latency_s,
+                                           exemplar=trace_id)
             return blocked
 
         cache_hit = self._check_cache(decision, ctx, result)
         if cache_hit is not None:
             cache_hit.routing_latency_s = time.perf_counter() - start
-            self.M.routing_latency.observe(cache_hit.routing_latency_s)
+            self.M.routing_latency.observe(cache_hit.routing_latency_s,
+                                           exemplar=trace_id)
             return cache_hit
 
         # -- selection --------------------------------------------------
@@ -435,7 +490,8 @@ class Router:
 
         self.M.model_requests.inc(model=ref.model, decision=decision.name)
         result.routing_latency_s = time.perf_counter() - start
-        self.M.routing_latency.observe(result.routing_latency_s)
+        self.M.routing_latency.observe(result.routing_latency_s,
+                                           exemplar=trace_id)
         component_event("router", "routed", request_id=request_id,
                         decision=decision.name, model=ref.model,
                         latency_ms=round(result.routing_latency_s * 1e3, 2))

@@ -84,6 +84,8 @@ API_CATALOG = {
         {"path": "/debug/profiler/start", "method": "POST"},
         {"path": "/debug/profiler/stop", "method": "POST"},
         {"path": "/debug/profiler/xla-dump", "method": "POST"},
+        {"path": "/debug/flightrec", "method": "GET"},
+        {"path": "/debug/flightrec/clear", "method": "POST"},
         {"path": "/info/models", "method": "GET"},
         {"path": "/config/router", "method": "GET"},
         {"path": "/config/router", "method": "PATCH"},
@@ -347,6 +349,17 @@ class RouterServer:
 
         self.jobs.register("selection_benchmark", selection_benchmark)
         self.jobs.register("accuracy_eval", accuracy_eval)
+
+    def flightrec(self):
+        """The registry-slotted flight recorder, falling back to the
+        process default when the slot is empty — the one lookup both
+        /debug/flightrec handlers share."""
+        fr = self.registry.get("flightrec")
+        if fr is not None:
+            return fr
+        from ..observability.flightrec import default_flight_recorder
+
+        return default_flight_recorder
 
     def roles_for_key(self, presented: str) -> Optional[set]:
         """Constant-time scan of the configured API keys (the ONE place
@@ -696,8 +709,18 @@ class RouterServer:
                                 "uptime_s": round(time.time()
                                                   - server.started_t, 1)})
                 elif path == "/metrics":
-                    self._text(200, server.registry.metrics.expose(),
-                               "text/plain; version=0.0.4")
+                    # exemplars are only legal in the OpenMetrics format
+                    # (a 0.0.4 parser rejects the '# {...}' clause and
+                    # fails the WHOLE scrape) — flip format + content
+                    # type together with the knob
+                    reg = server.registry.metrics
+                    if getattr(reg, "exemplars_enabled", False):
+                        self._text(200, reg.expose() + "# EOF\n",
+                                   "application/openmetrics-text; "
+                                   "version=1.0.0; charset=utf-8")
+                    else:
+                        self._text(200, reg.expose(),
+                                   "text/plain; version=0.0.4")
                 elif path == "/v1/models":
                     self._json(200, {"object": "list", "data": [
                         {"id": m.name, "object": "model",
@@ -779,6 +802,10 @@ class RouterServer:
                     self._json(200, API_CATALOG)
                 elif path == "/debug/profiler":
                     self._json(200, server.registry.profiler.status())
+                elif path == "/debug/flightrec":
+                    # slow-request flight recorder dump: slowest-N +
+                    # threshold breaches with full span trees
+                    self._json(200, server.flightrec().dump())
                 elif path == "/config/router":
                     # secrets masked unless the key holds secret_view
                     # (management_api.go:67)
@@ -1027,6 +1054,12 @@ class RouterServer:
                             out = {"error": f"unknown action {action!r}",
                                    "status": 404}
                         self._json(out.pop("status", 200), out)
+                    elif path == "/debug/flightrec/clear":
+                        if self._authorize(write=True,
+                                           action="flightrec") is None:
+                            return
+                        server.flightrec().clear()
+                        self._json(200, {"ok": True})
                     elif path == "/config/router/rollback":
                         if self._authorize(write=True,
                                            action="config_rollback") is None:

@@ -342,7 +342,9 @@ def build_router(cfg: RouterConfig, engine=None,
                     cache=carry_from.cache if carry_from is not None else None,
                     metrics=registry.metric_series()
                     if registry is not None else None,
-                    tracer=registry.tracer if registry is not None else None)
+                    tracer=registry.tracer if registry is not None else None,
+                    flightrec=registry.get("flightrec")
+                    if registry is not None else None)
     from ..memory import InMemoryMemoryStore
     from ..vectorstore import VectorStoreManager
 
@@ -464,6 +466,33 @@ def build_router(cfg: RouterConfig, engine=None,
     return router
 
 
+def apply_observability_knobs(cfg: RouterConfig, registry) -> None:
+    """Apply the observability block's runtime knobs (config.schema
+    accessors are the one interpretation point) to a registry's slotted
+    sinks: batch-trace sampling on the tracer, OpenMetrics exemplars on
+    the metrics registry, flight-recorder retention.  Called at boot and
+    from the config hot-reload handler — registry-slotted, so isolated
+    instances configure independently, and a malformed telemetry knob
+    must never stop (or wedge) the server."""
+    try:
+        registry.tracer.sample_rate = cfg.tracing_sample_rate()
+    except Exception:
+        pass
+    try:
+        # unconditional set: a reload must be able to turn exemplars OFF
+        registry.metrics.enable_exemplars(cfg.metrics_exemplars_enabled())
+    except Exception:
+        pass
+    try:
+        fr_cfg = cfg.flight_recorder_config()
+        fr = registry.get("flightrec")
+        if fr is not None and fr_cfg:
+            fr.configure(**fr_cfg)
+    except Exception as exc:
+        component_event("bootstrap", "flight_recorder_config_invalid",
+                        error=str(exc)[:200], level="warning")
+
+
 def serve(config_path: str, port: int = 8801,
           default_backend: str = "", mock_models: bool = False,
           status_path: Optional[str] = None,
@@ -525,6 +554,11 @@ def serve(config_path: str, port: int = 8801,
     server.otlp_exporter = build_exporter_from_config(
         cfg.observability, server.registry.tracer)
 
+    # observability knobs: applied here AND on config hot-reload (edits
+    # to sample_rate / exemplars / flight_recorder must not need a
+    # restart)
+    apply_observability_knobs(cfg, server.registry)
+
     # startKubernetesControllerIfNeeded (cmd/main.go:50): live CRD watch
     # regenerating the config file the ConfigWatcher below hot-swaps
     server.kube_operator = None
@@ -561,6 +595,7 @@ def serve(config_path: str, port: int = 8801,
             new_router = build_router(new_cfg, engine, carry_from=old)
             server.router = new_router
             server.cfg = new_cfg
+            apply_observability_knobs(new_cfg, server.registry)
             # grace period before tearing down the old dispatcher so
             # requests already inside old.route() finish their fan-out
             threading.Timer(30.0, old.dispatcher.shutdown).start()

@@ -22,7 +22,7 @@ import threading
 from typing import Any, Dict, Optional
 
 _SLOTS = ("metrics", "tracer", "sessions", "profiler", "events",
-          "engine", "cache", "memory_store", "vectorstores",
+          "flightrec", "engine", "cache", "memory_store", "vectorstores",
           "replay_store")
 
 
@@ -39,6 +39,7 @@ class RuntimeRegistry:
     def with_defaults(cls, **overrides: Any) -> "RuntimeRegistry":
         """Process-default sinks (shared across instances — the
         single-router posture); stateful stores stay per-instance."""
+        from ..observability.flightrec import default_flight_recorder
         from ..observability.metrics import default_registry
         from ..observability.profiler import default_profiler
         from ..observability.session import default_session_telemetry
@@ -51,6 +52,7 @@ class RuntimeRegistry:
             "sessions": default_session_telemetry,
             "profiler": default_profiler,
             "events": default_bus,
+            "flightrec": default_flight_recorder,
         }
         base.update(overrides)
         return cls(**base)
@@ -67,6 +69,7 @@ class RuntimeRegistry:
         other's /metrics, spans, or event feed.  Wire the emitters with
         ``build_router(cfg, registry=...)`` /
         ``RouterServer(..., registry=...)``."""
+        from ..observability.flightrec import FlightRecorder
         from ..observability.metrics import MetricsRegistry
         from ..observability.profiler import ProfilerControl
         from ..observability.session import SessionTelemetry
@@ -79,6 +82,7 @@ class RuntimeRegistry:
             "events": EventBus(),
             "sessions": SessionTelemetry(),
             "profiler": ProfilerControl(),
+            "flightrec": FlightRecorder(),
         }
         base.update(overrides)
         return cls(**base)

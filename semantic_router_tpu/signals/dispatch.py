@@ -59,10 +59,22 @@ class SignalDispatcher:
         skip = set(skip_signals or ())
         active = [e for e in self.active_evaluators() if e.signal_type not in skip]
 
+        # Trace propagation across the thread fan-out: the pool workers
+        # have no thread-local span context, so without this every
+        # engine submit under them would detach from the request's trace
+        # (the batcher's batch.ride spans key off the captured context).
+        # Capture once here, re-establish per family as a signal.<type>
+        # child span; no active trace → zero-cost no-op.
+        from ..observability import batchtrace
+
+        parent = batchtrace.capture()
+
         def run(e: SignalEvaluator) -> SignalResult:
             t0 = time.perf_counter()
             try:
-                return e.evaluate(ctx)
+                with batchtrace.activate(parent,
+                                         f"signal.{e.signal_type}"):
+                    return e.evaluate(ctx)
             except Exception as exc:  # fail open per family
                 return SignalResult(signal_type=e.signal_type,
                                     latency_s=time.perf_counter() - t0,

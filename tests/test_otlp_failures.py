@@ -1,0 +1,160 @@
+"""OTLP exporter failure paths (observability.otlp): bounded-retry drop,
+flush-on-buffer-pressure, buffer overflow bounds, and the guarantee that a
+raising sink never propagates into the request path."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from semantic_router_tpu.observability.otlp import OTLPExporter
+from semantic_router_tpu.observability.tracing import Span, Tracer
+
+
+def _span(name="s") -> Span:
+    s = Span(name, "a" * 32, "b" * 16)
+    s.end()
+    return s
+
+
+class _Collector:
+    """Tiny OTLP/HTTP sink with a scriptable failure budget."""
+
+    def __init__(self, fail_first: int = 0):
+        self.fail_remaining = fail_first
+        self.batches = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(
+                    int(self.headers.get("content-length", 0)))
+                if outer.fail_remaining > 0:
+                    outer.fail_remaining -= 1
+                    self.send_response(500)
+                    self.end_headers()
+                    return
+                outer.batches.append(json.loads(body))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = HTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.httpd.server_port}"
+        self.thread = threading.Thread(target=self.httpd.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    def spans_received(self):
+        return [s for payload in self.batches
+                for rs in payload["resourceSpans"]
+                for ss in rs["scopeSpans"]
+                for s in ss["spans"]]
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class TestRetryAndDrop:
+    def test_one_failure_then_success_retries_within_flush(self):
+        c = _Collector(fail_first=1)
+        try:
+            exp = OTLPExporter(c.url, flush_interval_s=60.0, timeout_s=5.0)
+            exp(_span())
+            assert exp.flush() == 1
+            assert exp.exported == 1 and exp.dropped == 0
+            assert len(c.spans_received()) == 1
+        finally:
+            c.close()
+
+    def test_drop_after_bounded_retries(self):
+        c = _Collector(fail_first=99)  # every attempt 500s
+        try:
+            exp = OTLPExporter(c.url, flush_interval_s=60.0, timeout_s=5.0)
+            exp(_span())
+            exp(_span())
+            assert exp.flush() == 0  # both attempts failed → batch dropped
+            assert exp.dropped == 2 and exp.exported == 0
+            # the buffer does NOT retain the dropped batch
+            assert exp.flush() == 0 and exp.dropped == 2
+        finally:
+            c.close()
+
+    def test_unreachable_endpoint_drops_without_raising(self):
+        exp = OTLPExporter("http://127.0.0.1:9", flush_interval_s=60.0,
+                           timeout_s=0.5)
+        exp(_span())
+        assert exp.flush() == 0
+        assert exp.dropped == 1
+
+
+class TestBufferPressure:
+    def test_pressure_wakes_daemon_flusher(self):
+        c = _Collector()
+        try:
+            # flush interval far beyond the test: only the pressure wake
+            # can explain a prompt export
+            exp = OTLPExporter(c.url, flush_interval_s=3600.0,
+                               max_batch=4, timeout_s=5.0)
+            tracer = Tracer()
+            exp.attach(tracer)
+            try:
+                for _ in range(4):
+                    with tracer.span("x"):
+                        pass
+                deadline = time.time() + 10.0
+                while exp.exported < 4 and time.time() < deadline:
+                    time.sleep(0.02)
+                assert exp.exported >= 4, \
+                    "pressure at max_batch did not trigger a flush"
+            finally:
+                exp.detach(tracer)
+        finally:
+            c.close()
+
+    def test_buffer_overflow_drops_oldest_boundedly(self):
+        exp = OTLPExporter("http://127.0.0.1:9", flush_interval_s=3600.0,
+                           max_batch=10**6, max_buffer=8)
+        for i in range(12):
+            exp(_span(f"s{i}"))
+        assert exp.dropped == 4
+        with exp._lock:
+            names = [s.name for s in exp._buffer]
+        assert len(names) == 8 and names[0] == "s4"  # oldest dropped first
+
+
+class TestSinkIsolation:
+    def test_raising_sink_never_reaches_request_path(self):
+        tracer = Tracer()
+
+        def bad_sink(span):
+            raise RuntimeError("collector exploded")
+
+        tracer.add_sink(bad_sink)
+        try:
+            with tracer.span("request"):
+                pass  # must not raise
+            assert tracer.spans("request")
+        finally:
+            tracer.remove_sink(bad_sink)
+
+    def test_raising_sink_does_not_break_record(self):
+        tracer = Tracer()
+        tracer.add_sink(lambda s: (_ for _ in ()).throw(ValueError()))
+        tracer.record(_span("external"))
+        assert tracer.spans("external")
+
+    def test_detach_stops_future_exports(self):
+        tracer = Tracer()
+        exp = OTLPExporter("http://127.0.0.1:9", flush_interval_s=3600.0)
+        exp.attach(tracer)
+        exp.detach(tracer)
+        with tracer.span("after-detach"):
+            pass
+        with exp._lock:
+            assert not exp._buffer

@@ -219,7 +219,13 @@ class TestWireConformance:
         assert res.columns == ["id", "v"]
         assert res.rows == [["abc", None]]
         assert res.command_tag == "SELECT 1"
-        # request on the wire: 'Q' + len + sql + NUL
+        # request on the wire: 'Q' + len + sql + NUL.  The server thread
+        # answers from its pre-authored script BEFORE draining the query
+        # bytes, so query() can return before `received` holds the 'Q'
+        # frame — poll briefly instead of racing the drain loop.
+        deadline = time.time() + 5.0
+        while b"Q" not in srv.received and time.time() < deadline:
+            time.sleep(0.01)
         q = srv.received.split(b"Q", 1)
         assert len(q) == 2
         c.close()
