@@ -1,8 +1,8 @@
 PY ?= python
 SHELL := /bin/bash
 
-.PHONY: test test-fast tier1 trace-smoke native bench bench-replay perf \
-	perf-record serve-mock clean
+.PHONY: test test-fast tier1 trace-smoke metrics-lint native bench \
+	bench-replay perf perf-record serve-mock clean
 
 bench-replay:
 	$(PY) benchmarks/replay_bench.py --n 500 \
@@ -26,6 +26,16 @@ tier1:
 trace-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_trace_smoke.py \
 	  tests/test_batchtrace.py -q -p no:cacheprovider
+
+# exposition grammar gate (docs/OBSERVABILITY.md): scrapes the live
+# /metrics surface in BOTH formats (text 0.0.4 and OpenMetrics with
+# exemplars) and validates HELP/TYPE pairing, histogram bucket
+# monotonicity, counter suffix rules, exemplar legality, and the
+# '# EOF' terminator — dashboard-breaking series regressions fail here,
+# not in Grafana.  Tier-1 (runs inside `make tier1` too).
+metrics-lint:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_metrics_lint.py \
+	  -q -p no:cacheprovider
 
 native:
 	$(PY) -m semantic_router_tpu.native.build

@@ -325,6 +325,87 @@ def _emit_cached_tpu_result(max_age_s: float = 20 * 3600.0) -> bool:
         return False
 
 
+def _measure_runtime_stats_overhead(platform: str) -> dict:
+    """signals/s through the shared-trunk engine with the always-on
+    runtime-stats sampler enabled vs disabled — the <1% acceptance gate
+    for ISSUE 3's continuous device-step profiling.  `enabled = False`
+    short-circuits record_step before its deque append, so the disabled
+    arm measures the true uninstrumented hot path."""
+    import time as _time
+
+    from semantic_router_tpu.engine.testing import make_shared_trunk_engine
+    from semantic_router_tpu.observability.metrics import (
+        MetricSeries,
+        MetricsRegistry,
+    )
+    from semantic_router_tpu.observability.runtimestats import RuntimeStats
+
+    tasks = ["intent", "fact_check", "user_feedback"]
+    n_iters = 120 if platform == "cpu" else 150
+    reg = MetricsRegistry()
+    rs = RuntimeStats(reg)
+    eng = make_shared_trunk_engine(metrics=MetricSeries(reg),
+                                   runtime_stats=rs)
+    try:
+        texts = [f"benchmark request number {i} about contract law"
+                 for i in range(16)]
+
+        def run(enabled: bool, n: int) -> float:
+            rs.enabled = enabled
+            t0 = _time.perf_counter()
+            for i in range(n):
+                eng.classify_multi(tasks, [texts[i % len(texts)]])
+            elapsed = _time.perf_counter() - t0
+            return len(tasks) * n / elapsed
+
+        # the real posture: the sampler thread runs at its production
+        # interval for BOTH arms (it belongs to the process, not the hot
+        # path — the knob being measured is the per-step record_step)
+        rs.start(10.0)
+        run(True, 40)  # warm the jit cache + allocator on both arms
+        # single-core CPU throughput drifts upward for minutes as the
+        # host warms, so sequential A-then-B measurement is biased;
+        # interleave the arms AND alternate their order each round
+        # (whichever arm runs second in a pair inherits the drift), then
+        # compare best-of — the bias cancels instead of accumulating
+        off_rates, on_rates = [], []
+        for i in range(4):
+            order = (False, True) if i % 2 == 0 else (True, False)
+            for enabled in order:
+                (on_rates if enabled else off_rates).append(
+                    run(enabled, n_iters))
+        rs.stop()
+        off, on = max(off_rates), max(on_rates)
+
+        # The e2e delta above sits inside this host's scheduling noise
+        # (single shared core: ±several %), so also measure the hot-path
+        # cost DIRECTLY: time record_step itself and express it as a
+        # fraction of serving time at the measured signal rate assuming
+        # one device step per signal — a conservative upper bound (real
+        # batches amortize one sample over many signals).  This is the
+        # deterministic <1% demonstration.
+        rs.enabled = True
+        t0 = _time.perf_counter()
+        calls = 100_000
+        for i in range(calls):
+            rs.record_step("bench", 128, "fused", 8, 8, 0.001)
+        record_ns = (_time.perf_counter() - t0) / calls * 1e9
+        hot_pct = record_ns * 1e-9 * max(off, on) * 100.0
+        return {
+            "engine_signals_per_s_runtime_stats_off": round(off, 1),
+            "engine_signals_per_s_runtime_stats_on": round(on, 1),
+            "runtime_stats_e2e_delta_pct":
+                round(100.0 * (off - on) / off, 2),
+            "record_step_ns": round(record_ns, 1),
+            "runtime_stats_overhead_pct": round(hot_pct, 3),
+        }
+    finally:
+        # stop() here too: an exception mid-measurement must not leak
+        # the sampler thread + gc callback into the rest of the bench
+        rs.stop()
+        eng.shutdown()
+
+
 def _measure_tracing_overhead(platform: str) -> dict:
     """signals/s through the tiny shared-trunk ENGINE (batcher + fused
     trunk group — the path batch tracing instruments) under three tracing
@@ -617,6 +698,18 @@ def _run_bench(platform: str) -> None:
         sys.stderr.write(f"bench: observability arm failed "
                          f"({type(exc).__name__}: {exc}); skipped\n")
 
+    # runtime-stats overhead arm (docs/OBSERVABILITY.md, ISSUE 3
+    # acceptance): the always-on device-step sampler must cost <1%
+    # engine signals/s vs telemetry disabled — record_step is one
+    # bounded deque append, aggregation runs on the sampler thread.
+    rs_row = None
+    try:
+        rs_row = _measure_runtime_stats_overhead(platform)
+        sys.stderr.write(f"bench: runtime-stats overhead {rs_row}\n")
+    except Exception as exc:
+        sys.stderr.write(f"bench: runtime-stats arm failed "
+                         f"({type(exc).__name__}: {exc}); skipped\n")
+
     batch, signals_per_s, best_impl = best
     # On a CPU fallback the host geometry is the whole story (this image
     # exposes ONE 2.1GHz core — ~0.09 TFLOPs f32 roofline — while the
@@ -637,6 +730,8 @@ def _run_bench(platform: str) -> None:
         record["fused_bank_tasks"] = BANK_TASKS
     if obs_row is not None:
         record["observability"] = obs_row
+    if rs_row is not None:
+        record["runtime_stats"] = rs_row
     if platform != "cpu":
         # side evidence for the bench README / judge: full sweep detail
         try:

@@ -1168,6 +1168,18 @@ class RouterConfig:
     #       threshold_ms: 500      # also retain any request slower than
     #                              # this (0/absent = slowest-N only)
     #       breach_capacity: 64    # bounded ring for threshold breaches
+    #     runtime_stats:
+    #       enabled: true          # always-on device-step sampler +
+    #                              # process gauges (llm_runtime_*)
+    #       interval_s: 10         # sampler flush/gauge period
+    #     slo:
+    #       enabled: true          # in-process burn-rate monitors
+    #       evaluation_interval_s: 10
+    #       objectives:            # compact DSL or explicit dicts
+    #         - routing_latency p99 < 25ms over 5m
+    #         - signal error-rate < 0.1% over 5m
+    #       fast_burn: 14.4        # page pair (w, 12w) threshold
+    #       slow_burn: 6.0         # ticket pair (6w, 72w) threshold
 
     def tracing_config(self) -> Dict[str, Any]:
         return dict((self.observability or {}).get("tracing", {}) or {})
@@ -1194,6 +1206,24 @@ class RouterConfig:
         if "breach_capacity" in fr:
             out["breach_capacity"] = int(fr["breach_capacity"])
         return out
+
+    def runtime_stats_config(self) -> Dict[str, Any]:
+        """Normalized observability.runtime_stats block: the always-on
+        device-step sampler + process gauges (on by default — the whole
+        point is continuous coverage; disable only for overhead A/Bs)."""
+        rs = (self.observability or {}).get("runtime_stats", {}) or {}
+        try:
+            interval = float(rs.get("interval_s", 10.0))
+        except (TypeError, ValueError):
+            interval = 10.0
+        return {"enabled": bool(rs.get("enabled", True)),
+                "interval_s": interval}
+
+    def slo_config(self) -> Dict[str, Any]:
+        """The observability.slo block, passed verbatim to
+        SLOMonitor.configure (which owns parsing + error containment) —
+        absent block = no objectives = monitor disabled."""
+        return dict((self.observability or {}).get("slo", {}) or {})
 
     # -- recipes (pkg/config/recipes.go) -----------------------------------
 

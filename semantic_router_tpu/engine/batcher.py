@@ -110,12 +110,24 @@ class _DispatchPool:
         # without it an item enqueued between the drain and the last
         # worker's exit would neither run nor cancel (futures hang)
         self._guard = threading.Lock()
+        # workers currently inside a batch (saturation gauge); guarded
+        # by its own lock — `self._busy += 1` is LOAD/ADD/STORE, not
+        # atomic, and lost updates would drift the gauge permanently
+        self._busy = 0
+        self._busy_lock = threading.Lock()
         self._threads = []
         for i in range(max(1, workers)):
             t = threading.Thread(target=self._work, daemon=True,
                                  name=f"{name}-{i}")
             t.start()
             self._threads.append(t)
+
+    def stats(self) -> dict:
+        """Saturation snapshot for the runtime-stats gauges: queued
+        batches + busy/total workers (all-busy with a backlog = the
+        dispatch pool is the bottleneck, not the device)."""
+        return {"workers": len(self._threads), "queued": self._q.qsize(),
+                "busy": self._busy}
 
     def submit(self, run: Callable, cancel: Callable, *args: Any) -> None:
         with self._guard:
@@ -129,7 +141,13 @@ class _DispatchPool:
             if item is None:
                 return
             run, cancel, args = item
-            (cancel if self._stopped else run)(*args)
+            with self._busy_lock:
+                self._busy += 1
+            try:
+                (cancel if self._stopped else run)(*args)
+            finally:
+                with self._busy_lock:
+                    self._busy -= 1
 
     def shutdown(self) -> None:
         with self._guard:
@@ -232,6 +250,25 @@ class DynamicBatcher:
                                          batcher=self.name)
         except Exception:
             pass
+
+    def queue_depths(self) -> dict:
+        """Live congestion snapshot for the runtime-stats sampler
+        (llm_dispatcher_queue_depth): queued items/groups, in-flight
+        groups, and the dispatch pool's saturation."""
+        with self._lock:
+            out = {
+                "pending_items": sum(len(v) for v in
+                                     self._queues.values()),
+                "pending_groups": sum(1 for v in self._queues.values()
+                                      if v),
+                "inflight_groups": len(self._inflight),
+            }
+        pool = self._pool.stats()
+        out["pool_queued"] = pool["queued"]
+        out["pool_busy"] = pool["busy"]
+        out["pool_saturation"] = (pool["busy"] / pool["workers"]
+                                  if pool["workers"] else 0.0)
+        return out
 
     def stats(self) -> dict:
         with self._lock:

@@ -161,7 +161,7 @@ class InferenceEngine:
     """Owner of all TPU-served classifier tasks + the batching shim."""
 
     def __init__(self, cfg: Optional[InferenceEngineConfig] = None,
-                 metrics=None, events=None) -> None:
+                 metrics=None, events=None, runtime_stats=None) -> None:
         self.cfg = cfg or InferenceEngineConfig()
         self._tasks: Dict[str, _Task] = {}
         self._lock = threading.Lock()
@@ -169,6 +169,14 @@ class InferenceEngine:
         # None = the process defaults (single-engine posture)
         self._metrics = metrics
         self._events = events
+        # always-on device-step accounting (observability.runtimestats):
+        # the batch runners emit one sample per step — a bounded deque
+        # append, nothing more — and the sampler aggregates off-path
+        if runtime_stats is None:
+            from ..observability.runtimestats import default_runtime_stats
+
+            runtime_stats = default_runtime_stats
+        self._runtime_stats = runtime_stats
 
         # serving-side sharded classifier bank (SURVEY §2.4 north-star
         # layout: pjit-sharded bank over a slice): engine.mesh_shape
@@ -199,6 +207,19 @@ class InferenceEngine:
             dispatch_workers=self.cfg.dispatch_workers,
             metrics=metrics,
         )
+        # queue-depth / pool-saturation gauges ride the runtime-stats
+        # sampler; keyed by batcher name, so a rebuilt engine replaces
+        # the provider and shutdown() unregisters it.  The host instance
+        # and callable are pinned so shutdown removes exactly what THIS
+        # engine registered (never a sibling's live provider, and never
+        # from a later-rebound stats instance).
+        self._rs_provider_host = self._runtime_stats
+        self._rs_provider_fn = self.batcher.queue_depths
+        try:
+            self._rs_provider_host.register_provider(
+                self.batcher.name, self._rs_provider_fn)
+        except Exception:
+            pass
         # fused classifier bank: trunk fingerprint → TrunkGroup, plus the
         # task→group and gid→group views the hot path reads
         self._trunk_groups: Dict[tuple, TrunkGroup] = {}
@@ -208,6 +229,9 @@ class InferenceEngine:
         # distinct device batch shapes executed per batch group — the
         # jit-cache-budget regression surface (shape_census())
         self._shapes: Dict[str, set] = {}
+        # (group, variant, shape) triples already executed — the step
+        # sampler's per-PROGRAM compile detection (_step_fresh)
+        self._compiled_steps: set = set()
         # generative decode mutates per-generator jit/cache state; one
         # generation runs on-device at a time (decode steps saturate the
         # chip anyway — concurrency comes from the classify batcher)
@@ -695,13 +719,17 @@ class InferenceEngine:
         ids_dev, mask_dev = self._to_device(ids, mask)
         from ..observability.profiler import trace_span
 
+        self._note_shape("stacked", (padded_n, bucket))
+        fresh = self._step_fresh("stacked", "stacked", (padded_n, bucket))
+        fwd_t0 = time.perf_counter()
         with trace_span("engine.classify_multi.stacked"):
             logits_by_task = st["apply_fn"](st["params"], ids_dev,
                                             mask_dev)
             logits_by_task = {k: np.asarray(jax.device_get(v), np.float32)
                               for k, v in logits_by_task.items()}
+        self._record_step("stacked", bucket, "stacked", n, padded_n,
+                          time.perf_counter() - fwd_t0, fresh)
         self._series().trunk_forwards.inc(group="stacked", path="stacked")
-        self._note_shape("stacked", (padded_n, bucket))
         out: Dict[str, List[ClassResult]] = {}
         for task in tasks:
             labels = self._tasks[task].labels
@@ -1042,6 +1070,11 @@ class InferenceEngine:
         return variants
 
     def shutdown(self) -> None:
+        try:
+            self._rs_provider_host.unregister_provider(
+                self.batcher.name, self._rs_provider_fn)
+        except Exception:
+            pass
         self.batcher.shutdown()
         pool = getattr(self, "_stacked_pool", None)
         if pool is not None:
@@ -1079,9 +1112,41 @@ class InferenceEngine:
     def _count_tokenization(self, task: str) -> None:
         self._series().tokenizations.inc(task=task)
 
-    def _note_shape(self, group: str, shape: tuple) -> None:
+    def _note_shape(self, group: str, shape: tuple) -> bool:
+        """Record a device shape; returns True the FIRST time this group
+        executes it — a fresh shape is one XLA compilation, which is how
+        the runtime-stats sampler tells cold steps from warm ones."""
+        shape = tuple(shape)
         with self._lock:
-            self._shapes.setdefault(group, set()).add(tuple(shape))
+            seen = self._shapes.setdefault(group, set())
+            fresh = shape not in seen
+            seen.add(shape)
+        return fresh
+
+    def _step_fresh(self, group: str, variant: str, shape: tuple) -> bool:
+        """Compile detection for the step sampler, keyed per (group,
+        VARIANT, shape): the fused, fenced-split, and per-task paths are
+        distinct XLA programs, so a shape first seen by a sampled
+        detailed batch must still count the later fused first-execution
+        as a compile (shape_census stays variant-free — it budgets
+        device shapes, not programs)."""
+        key = (group, variant, *shape)
+        with self._lock:
+            fresh = key not in self._compiled_steps
+            self._compiled_steps.add(key)
+        return fresh
+
+    def _record_step(self, group: str, bucket: int, variant: str,
+                     rows: int, padded_rows: int, seconds: float,
+                     compiled: bool) -> None:
+        """One always-on step sample (observability.runtimestats): a
+        bounded deque append on the hot path; never raises."""
+        try:
+            self._runtime_stats.record_step(
+                group, bucket, variant, rows, padded_rows, seconds,
+                compiled=compiled)
+        except Exception:
+            pass
 
     def shape_census(self) -> Dict[str, list]:
         """Distinct (padded_batch, bucket) device shapes executed per
@@ -1235,24 +1300,36 @@ class InferenceEngine:
                 ids, mask, clipped = self._stack_items(
                     items, bucket, padded_n, t.pad_id, task_name)
                 ids_dev, mask_dev = self._to_device(ids, mask)
+            # fresh (group, variant, shape) == one XLA compile: the
+            # runtime-stats sampler accounts the cold step separately
             self._note_shape(f"task:{task_name}", (padded_n, bucket))
+            fresh = self._step_fresh(f"task:{task_name}", "split",
+                                     (padded_n, bucket))
             fwd_cm = batchtrace.stage(step, "trunk_forward")
 
             if t.kind == "embedding":
                 p = items[0].payload
+                fwd_t0 = time.perf_counter()
                 with trace_span(f"engine.embed.{t.name}"), fwd_cm:
                     emb = t.apply_fn(t.params, ids_dev, mask_dev,
                                      exit_layer=p.exit_layer,
                                      output_dim=p.output_dim)
                     emb = np.asarray(jax.device_get(emb), dtype=np.float32)
+                self._record_step(f"task:{task_name}", bucket, "split",
+                                  n, padded_n,
+                                  time.perf_counter() - fwd_t0, fresh)
                 self._series().trunk_forwards.inc(group=task_name,
                                                   path="traditional")
                 return [emb[i] for i in range(n)]
 
+            fwd_t0 = time.perf_counter()
             with trace_span(f"engine.classify.{t.name}"), fwd_cm:
                 logits = t.apply_fn(t.params, ids_dev, mask_dev)
                 logits = np.asarray(jax.device_get(logits),
                                     dtype=np.float32)
+            self._record_step(f"task:{task_name}", bucket, "split",
+                              n, padded_n,
+                              time.perf_counter() - fwd_t0, fresh)
             self._series().trunk_forwards.inc(group=task_name,
                                               path="traditional")
 
@@ -1347,6 +1424,11 @@ class InferenceEngine:
                         for task in item.payload.tasks:
                             self._series().bucket_overflows.inc(task=task)
                 ids_dev, mask_dev = self._to_device(ids, mask)
+            self._note_shape(f"trunk:{gid}", (padded_n, bucket))
+            variant = "fused_detailed" if detailed else "fused"
+            fresh = self._step_fresh(f"trunk:{gid}", variant,
+                                     (padded_n, bucket))
+            fwd_t0 = time.perf_counter()
             with trace_span(f"engine.classify.fused.{gid}"):
                 if not detailed:
                     # the default hot path: one fused program, no fences
@@ -1367,8 +1449,14 @@ class InferenceEngine:
                         step.fence(logits)
                 logits = np.asarray(jax.device_get(logits),
                                     dtype=np.float32)
+            # detailed (sampled-trace) batches ran the fenced split
+            # programs — slower by construction — so they get their own
+            # variant key instead of polluting the warm-execute EWMA the
+            # dashboards (and the planned path-chooser cost model) read
+            self._record_step(f"trunk:{gid}", bucket, variant,
+                              n, padded_n, time.perf_counter() - fwd_t0,
+                              fresh)
             self._series().trunk_forwards.inc(group=gid, path="fused")
-            self._note_shape(f"trunk:{gid}", (padded_n, bucket))
 
             demux_cm = batchtrace.stage(step, "demux")
             now = time.perf_counter()

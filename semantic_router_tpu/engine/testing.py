@@ -97,6 +97,7 @@ def make_shared_trunk_engine(
     seed: int = 0,
     fuse: Optional[bool] = None,
     metrics=None,
+    runtime_stats=None,
 ) -> InferenceEngine:
     """Engine whose sequence tasks share ONE ModernBERT trunk — the fused
     classifier-bank shape.  The trunk initializes once; every task's param
@@ -118,7 +119,8 @@ def make_shared_trunk_engine(
         tasks = SHARED_TRUNK_TASKS
     cfg = engine_cfg or InferenceEngineConfig(
         max_batch_size=8, max_wait_ms=1.0, seq_len_buckets=[32, 128, 512])
-    engine = InferenceEngine(cfg, metrics=metrics)
+    engine = InferenceEngine(cfg, metrics=metrics,
+                             runtime_stats=runtime_stats)
     tok = HashTokenizer(vocab_size=TINY["vocab_size"])
     key = jax.random.PRNGKey(seed)
     dummy = jnp.ones((1, 8), jnp.int32)

@@ -218,9 +218,16 @@ class ExtProcService:
             body, precomputed = payload, None
         else:
             body, precomputed = payload
+        # the prefetch's pending trace context (when the handler minted
+        # one) makes route() adopt the pre-minted root span ids, so the
+        # early-detection signal spans re-parent under router.route
+        route_kw = {}
+        if getattr(handler, "pending_trace", None) is not None:
+            route_kw["pending_trace"] = handler.pending_trace
         try:
             route = self.router.route(body, state.headers,
-                                      precomputed_signals=precomputed)
+                                      precomputed_signals=precomputed,
+                                      **route_kw)
         except Exception as exc:  # fail open: continue unmodified
             component_event("extproc", "route_error", error=str(exc))
             return pb.ProcessingResponse(request_body=pb.BodyResponse(

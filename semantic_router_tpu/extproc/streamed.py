@@ -247,6 +247,11 @@ class StreamedBodyHandler:
         self._prefetch: Optional[Future] = None
         self._prefetch_body: Optional[Dict] = None
         self._prefetch_proj: Optional[Dict[str, bytes]] = None
+        # pre-minted trace context (router.begin_pending_trace): the
+        # prefetch's signal spans parent under the root span route()
+        # will adopt, instead of orphaning in a throwaway trace.  The
+        # frontend passes it to route(..., pending_trace=...).
+        self.pending_trace = None
 
     # -- guards ----------------------------------------------------------
 
@@ -354,8 +359,23 @@ class StreamedBodyHandler:
         self._prefetch_proj = proj
         self.prefetch_started_at = self.chunks_seen
         router = self.router
-        self._prefetch = self.pool.submit(
-            router.evaluate_signals, dict(body), headers)
+        # capture the pending trace context ONCE at first kickoff (the
+        # trace seam: restarted prefetches stay in the same trace, so an
+        # operator sees discarded speculative evaluations too); routers
+        # without the seam (test stubs) keep the two-arg call
+        begin = getattr(router, "begin_pending_trace", None)
+        if self.pending_trace is None and begin is not None:
+            try:
+                self.pending_trace = begin(headers)
+            except Exception:
+                self.pending_trace = None
+        if self.pending_trace is not None:
+            self._prefetch = self.pool.submit(
+                router.evaluate_signals, dict(body), headers,
+                self.pending_trace)
+        else:
+            self._prefetch = self.pool.submit(
+                router.evaluate_signals, dict(body), headers)
 
     def _cancel_prefetch(self) -> None:
         if self._prefetch is not None:

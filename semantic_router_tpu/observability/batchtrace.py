@@ -71,7 +71,12 @@ class TraceContext:
 def _sampled(tracer: Tracer, trace_id: str) -> bool:
     """Deterministic per-trace sampling from the tracer's sample_rate:
     every span of one trace makes the same choice, so a sampled trace is
-    complete and an unsampled one costs nothing downstream."""
+    complete and an unsampled one costs nothing downstream.  Traces the
+    flight recorder force-kept (tail-based sampling) are always
+    detailed, whatever the rate."""
+    forced = getattr(tracer, "is_force_sampled", None)
+    if forced is not None and forced(trace_id):
+        return True
     rate = float(getattr(tracer, "sample_rate", 1.0))
     if rate >= 1.0:
         return True

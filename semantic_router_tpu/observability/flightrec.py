@@ -56,6 +56,11 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self.considered = 0
         self.retained = 0
+        # tail-based sampling hook: called with the trace id of every
+        # retained request (Router wires it to Tracer.force_sample), so
+        # threshold-breaching / slowest-N traces are force-kept by the
+        # sampler, not just recorded locally
+        self.on_retain: Optional[Callable[[str], None]] = None
 
     def configure(self, slowest_n: Optional[int] = None,
                   threshold_s: Optional[float] = None,
@@ -121,6 +126,11 @@ class FlightRecorder:
                     stored = True
             if stored:
                 self.retained += 1
+        if stored and self.on_retain is not None:
+            try:  # a sampling-hook error must never surface into routing
+                self.on_retain(trace_id)
+            except Exception:
+                pass
         return stored
 
     # -- reading ----------------------------------------------------------

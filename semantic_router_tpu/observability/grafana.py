@@ -56,6 +56,19 @@ def _stat(title: str, expr: str, *, unit: str = "short", panel_id: int = 1,
     }
 
 
+def _text_panel(title: str, markdown: str, *, panel_id: int = 1,
+                x: int = 0, y: int = 0, w: int = 12, h: int = 8) -> Dict:
+    """Markdown text panel — the link surface for in-process debug
+    endpoints (flight recorder, SLO report) that have no Prometheus
+    series to chart."""
+    return {
+        "id": panel_id, "title": title, "type": "text",
+        "gridPos": {"x": x, "y": y, "w": w, "h": h},
+        "options": {"mode": "markdown", "content": markdown},
+        "targets": [],  # text panels query nothing
+    }
+
+
 def _dashboard(uid: str, title: str, panels: List[Dict],
                tags: Optional[List[str]] = None) -> Dict:
     return {
@@ -179,6 +192,78 @@ def serving() -> Dict:
                       tags=["serving"])
 
 
+_FLIGHTREC_MD = """\
+The router keeps its own evidence in-process — no collector required:
+
+- **Flight recorder** — full span trees for the slowest-N requests and
+  every `threshold_ms` breach (tail-kept: retained traces are pinned
+  force-sampled, so their continued activity gets detailed batch
+  tracing):
+  `GET http://<router>/debug/flightrec` · clear with
+  `POST /debug/flightrec/clear`
+- **SLO report** — objectives, per-window burn rates, firing alerts:
+  `GET http://<router>/debug/slo` (the same verdict `/health` summarizes
+  as `degraded`)
+- **Runtime stats** — per-jit-program compile/execute registry,
+  padding-waste accounting, process/device gauges:
+  `GET http://<router>/debug/runtime`
+
+All three are management-API routes (same RBAC gate as `/config/*`).
+See docs/OBSERVABILITY.md.
+"""
+
+
+def runtime_slo() -> Dict:
+    """The "Runtime & SLO" row (ISSUE 3): always-on engine health —
+    step-time quantiles, compile/padding accounting, process/device
+    gauges — next to the in-process SLO burn rates and a link panel
+    into the flight-recorder / SLO / runtime debug dumps."""
+    p = [
+        _panel("SLO burn rate (fast window)",
+               ['sum(llm_slo_burn_rate{window="fast_short"}) '
+                "by (objective)"],
+               panel_id=1, x=0, y=0, legends=["{{objective}}"]),
+        _stat("SLO alerts firing",
+              "sum(llm_slo_alert_firing) or vector(0)",
+              panel_id=2, x=12, y=0),
+        _stat("Good-event ratio (worst objective)",
+              "min(llm_slo_good_ratio)",
+              unit="percentunit", panel_id=3, x=18, y=0),
+        _panel("Device step time by group (p95)",
+               ["histogram_quantile(0.95, sum(rate("
+                "llm_runtime_step_seconds_bucket[5m])) by (le, group))"],
+               unit="s", panel_id=4, x=0, y=8, legends=["{{group}}"]),
+        _panel("XLA compiles / padding waste",
+               ["sum(rate(llm_runtime_program_compiles_total[5m])) "
+                "by (group)",
+                'sum(rate(llm_runtime_step_rows_total{kind="padding"}'
+                "[5m])) / sum(rate(llm_runtime_step_rows_total[5m]))"],
+               panel_id=5, x=12, y=8,
+               legends=["compiles {{group}}", "padding waste ratio"]),
+        _panel("Host RSS / device memory",
+               ["llm_process_rss_bytes",
+                'sum(llm_device_memory_bytes{stat="bytes_in_use"}) '
+                "by (device)"],
+               unit="bytes", panel_id=6, x=0, y=16,
+               legends=["rss", "device {{device}}"]),
+        _panel("Dispatcher queues & pool saturation",
+               ['sum(llm_dispatcher_queue_depth{stat="pending_items"}) '
+                "by (batcher)",
+                'sum(llm_dispatcher_queue_depth{stat="pool_saturation"})'
+                " by (batcher)"],
+               panel_id=7, x=12, y=16,
+               legends=["queued {{batcher}}", "saturation {{batcher}}"]),
+        _panel("GC pauses (p99)",
+               ["histogram_quantile(0.99, sum(rate("
+                "llm_gc_pause_seconds_bucket[5m])) by (le))"],
+               unit="s", panel_id=8, x=0, y=24),
+        _text_panel("Flight recorder & debug dumps", _FLIGHTREC_MD,
+                    panel_id=9, x=12, y=24),
+    ]
+    return _dashboard("srt-runtime-slo", "Semantic Router — Runtime & "
+                      "SLO", p, tags=["runtime", "slo"])
+
+
 def catalog(registry=None) -> Dict:
     """Auto-generated dashboard: one panel per registered series —
     anything new in the registry shows up here without template edits."""
@@ -230,6 +315,7 @@ def render_all(out_dir: str, registry=None) -> List[str]:
         "signals_decisions.json": signals_decisions(),
         "safety.json": safety(),
         "serving.json": serving(),
+        "runtime_slo.json": runtime_slo(),
         "metric_catalog.json": catalog(registry),
     }
     for fname, dash in dashboards.items():

@@ -34,6 +34,16 @@ def _fmt_labels(key: Tuple[Tuple[str, str], ...]) -> str:
     return "{" + inner + "}"
 
 
+def _help_line(family: str, help_: str) -> List[str]:
+    """``# HELP`` line with the format-mandated escaping (backslash and
+    newline); both exposition formats pair HELP with TYPE per family —
+    ``make metrics-lint`` enforces the pairing."""
+    if not help_:
+        return []
+    esc = help_.replace("\\", "\\\\").replace("\n", "\\n")
+    return [f"# HELP {family} {esc}"]
+
+
 class Counter:
     def __init__(self, name: str, help_: str = "") -> None:
         self.name, self.help = name, help_
@@ -66,7 +76,7 @@ class Counter:
         family = self.name
         if openmetrics and family.endswith("_total"):
             family = family[:-len("_total")]
-        out = [f"# TYPE {family} counter"]
+        out = _help_line(family, self.help) + [f"# TYPE {family} counter"]
         with self._lock:
             for key, v in sorted(self._values.items()):
                 out.append(f"{self.name}{_fmt_labels(key)} {v}")
@@ -79,7 +89,8 @@ class Gauge(Counter):
             self._values[_label_key(labels)] = value
 
     def expose(self, openmetrics: bool = False) -> List[str]:
-        out = [f"# TYPE {self.name} gauge"]
+        out = _help_line(self.name, self.help) + \
+            [f"# TYPE {self.name} gauge"]
         with self._lock:
             for key, v in sorted(self._values.items()):
                 out.append(f"{self.name}{_fmt_labels(key)} {v}")
@@ -139,6 +150,20 @@ class Histogram:
     def count(self, **labels: str) -> int:
         return self._totals.get(_label_key(labels), 0)
 
+    def le_total(self, value: float) -> Tuple[int, int]:
+        """(observations ≤ the largest bucket edge not above ``value``,
+        total observations) across ALL label sets — the streaming
+        SLI read the in-process SLO monitor evaluates burn rates from.
+        A threshold between bucket edges rounds DOWN (conservative: some
+        good events count as bad, never the reverse)."""
+        import bisect
+
+        k = bisect.bisect_right(self.buckets, value)  # buckets[:k] ≤ value
+        with self._lock:
+            total = sum(self._totals.values())
+            good = sum(sum(counts[:k]) for counts in self._counts.values())
+        return good, total
+
     def totals(self) -> Dict[tuple, int]:
         """Locked snapshot of per-label observation counts."""
         with self._lock:
@@ -186,7 +211,8 @@ class Histogram:
         # Exemplar clauses are ONLY legal in OpenMetrics: even if some
         # were recorded while the knob was on, a 0.0.4 exposition must
         # not carry them (a strict parser fails the whole scrape).
-        out = [f"# TYPE {self.name} histogram"]
+        out = _help_line(self.name, self.help) + \
+            [f"# TYPE {self.name} histogram"]
         with self._lock:
             for key in sorted(self._counts):
                 cum = 0
@@ -252,6 +278,13 @@ class MetricsRegistry:
                 m = factory()
                 self._metrics[name] = m
             return m
+
+    def find(self, name: str):
+        """Registered metric by series name, or None — the SLO monitor's
+        lookup (it must never CREATE a series of the wrong kind for an
+        objective whose emitter isn't wired yet)."""
+        with self._lock:
+            return self._metrics.get(name)
 
     def expose(self) -> str:
         lines: List[str] = []
@@ -323,6 +356,10 @@ class MetricSeries:
         self.signal_latency = registry.histogram(
             "llm_signal_latency_seconds",
             "Per-family signal extraction latency")
+        self.signal_errors = registry.counter(
+            "llm_signal_errors_total",
+            "Signal evaluations that failed open, by family — the "
+            "numerator of the signal error-rate SLO")
         self.decision_matches = registry.counter(
             "llm_decision_matches_total", "Decision matches by name")
         self.decision_latency = registry.histogram(
@@ -378,6 +415,7 @@ jailbreak_blocks = default_series.jailbreak_blocks
 hallucination_latency = default_series.hallucination_latency
 cache_lookups = default_series.cache_lookups
 signal_latency = default_series.signal_latency
+signal_errors = default_series.signal_errors
 decision_matches = default_series.decision_matches
 decision_latency = default_series.decision_latency
 batch_size = default_series.batch_size

@@ -98,9 +98,26 @@ class Span:
         return (self.end_pc or time.perf_counter()) - self.start_pc
 
 
+@dataclass
+class PendingTrace:
+    """A trace begun BEFORE its root span exists — the streamed-prefetch
+    seam.  The extproc's early signal evaluation runs while the request
+    body is still arriving, i.e. before ``route()`` opens ``router.route``;
+    pre-minting (trace_id, root_span_id) at prefetch enqueue lets those
+    spans parent under the root span the request WILL have: ``route()``
+    later adopts both ids, so the prefetch spans are re-parented under
+    ``router.route`` instead of orphaned in a throwaway trace."""
+
+    tracer: "Tracer"
+    trace_id: str
+    root_span_id: str
+    parent_id: str = ""  # the caller's traceparent member, if any
+
+
 class Tracer:
     def __init__(self, capacity: int = 2048,
-                 sample_rate: float = 0.1) -> None:
+                 sample_rate: float = 0.1,
+                 force_capacity: int = 1024) -> None:
         self.capacity = capacity
         # fraction of traces that get DETAILED batch tracing — the fenced
         # split-program per-stage timing (observability.batchtrace).
@@ -109,10 +126,32 @@ class Tracer:
         # hot path pays no extra fences.  Deterministic per trace_id, so
         # a trace is all-or-nothing.
         self.sample_rate = sample_rate
+        # tail-based keep set: trace ids the flight recorder retained
+        # (threshold breach / slowest-N) are force-sampled from then on —
+        # continued activity on a pathological trace gets the detailed
+        # treatment regardless of sample_rate.  Bounded FIFO so a breach
+        # storm can't grow it unboundedly.
+        self.force_capacity = force_capacity
+        self._forced: Dict[str, None] = {}
         self._spans: List[Span] = []
         self._lock = threading.Lock()
         self._local = threading.local()
         self._sinks: List = []  # callables(span) invoked on span end
+
+    # -- tail-based sampling ----------------------------------------------
+
+    def force_sample(self, trace_id: str) -> None:
+        """Pin a trace as sampled (flight-recorder retention hook): every
+        later sampling decision for this trace id returns True."""
+        if not trace_id:
+            return
+        with self._lock:
+            self._forced[trace_id] = None
+            while len(self._forced) > self.force_capacity:
+                self._forced.pop(next(iter(self._forced)))
+
+    def is_force_sampled(self, trace_id: str) -> bool:
+        return trace_id in self._forced
 
     def add_sink(self, sink) -> None:
         with self._lock:

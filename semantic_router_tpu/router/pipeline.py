@@ -127,6 +127,19 @@ class Router:
 
         self.flightrec = flightrec if flightrec is not None \
             else default_flight_recorder
+        # tail-based sampling: a request the recorder retains (threshold
+        # breach / slowest-N) pins its trace id as force-sampled on THIS
+        # router's tracer — continued activity on that trace gets the
+        # detailed batch tracing regardless of sample_rate.  Only wire
+        # the pair the caller actually configured together: an
+        # explicitly-passed recorder pairs with whatever tracer this
+        # router runs, but the PROCESS-DEFAULT recorder must not get
+        # pinned to a custom tracer (a later default-posture router
+        # would then force-sample onto a tracer it never reads).
+        paired = flightrec is not None or self.tracer is default_tracer
+        if paired and getattr(self.flightrec, "on_retain", None) is None \
+                and hasattr(self.tracer, "force_sample"):
+            self.flightrec.on_retain = self.tracer.force_sample
 
         extra = []
         if engine is not None:
@@ -304,22 +317,45 @@ class Router:
                      if s.strip()]
         return skip
 
+    def begin_pending_trace(self, headers: Optional[Dict[str, str]] = None):
+        """Pre-mint the (trace_id, root_span_id) a future route() call
+        will adopt — the streamed-prefetch trace seam.  The extproc's
+        early signal evaluation runs BEFORE route() opens its root span;
+        a prefetch enqueued with this context parents its spans under
+        the root span the request will actually get, instead of
+        orphaning them in a throwaway trace."""
+        from ..observability.tracing import PendingTrace, new_span_id
+
+        headers = {k.lower(): v for k, v in (headers or {}).items()}
+        trace_id, parent = self.tracer.extract(headers)
+        return PendingTrace(self.tracer, trace_id, new_span_id(), parent)
+
     def evaluate_signals(self, body: Dict[str, Any],
-                         headers: Optional[Dict[str, str]] = None):
+                         headers: Optional[Dict[str, str]] = None,
+                         pending=None):
         """Signal extraction EXACTLY as route() performs it (compression
         + operator skip config) — the overlap-prefetch seam for streamed
         frontends: a chunked body whose messages array is complete can
         start classification while the rest of the body arrives
-        (processor_req_body_streamed.go early-detection role)."""
+        (processor_req_body_streamed.go early-detection role).
+        ``pending`` (begin_pending_trace) parents the evaluation's spans
+        under the request's future router.route root span."""
         headers = {k.lower(): v for k, v in (headers or {}).items()}
         ctx = RequestContext.from_openai_body(body, headers)
         skip = self._prepare_signal_view(ctx, headers)
         dispatcher, _, _ = self._engines_for_model(ctx.model)
-        return dispatcher.evaluate(ctx, skip_signals=skip)
+        if pending is None:
+            return dispatcher.evaluate(ctx, skip_signals=skip)
+        with self.tracer.span("signals.evaluate",
+                              trace_id=pending.trace_id,
+                              parent_id=pending.root_span_id,
+                              prefetch=True):
+            return dispatcher.evaluate(ctx, skip_signals=skip)
 
     def route(self, body: Dict[str, Any],
               headers: Optional[Dict[str, str]] = None,
-              precomputed_signals=None) -> RouteResult:
+              precomputed_signals=None,
+              pending_trace=None) -> RouteResult:
         start = time.perf_counter()
         headers = {k.lower(): v for k, v in (headers or {}).items()}
         request_id = headers.get(H.REQUEST_ID, uuid.uuid4().hex[:16])
@@ -329,10 +365,21 @@ class Router:
         # batch.ride spans all hang off this trace, so a request's tail
         # latency decomposes end to end instead of ending at
         # signals.evaluate (the pre-batchtrace blind spot)
-        trace_id, parent_span = self.tracer.extract(headers)
+        if pending_trace is not None:
+            # streamed prefetch already opened spans under these ids:
+            # adopting both re-parents the early-detection signal spans
+            # under THIS request's root span
+            trace_id, parent_span = pending_trace.trace_id, \
+                pending_trace.parent_id
+        else:
+            trace_id, parent_span = self.tracer.extract(headers)
         with self.tracer.span("router.route", trace_id=trace_id,
                               parent_id=parent_span,
                               request_id=request_id) as root:
+            if pending_trace is not None:
+                # adopt the pre-minted root span id BEFORE any child
+                # opens (children read the parent id at creation time)
+                root.span_id = pending_trace.root_span_id
             result = self._route_impl(body, headers, request_id, trace_id,
                                       start, precomputed_signals)
             result.trace_id = trace_id
@@ -404,7 +451,14 @@ class Router:
                 signals, report = dispatcher.evaluate(
                     ctx, skip_signals=skip)
         for family, res in report.results.items():
-            self.M.signal_latency.observe(res.latency_s, family=family)
+            # trace-id exemplar: a slow signal-latency bucket links to a
+            # trace that landed there (no-op unless exemplars enabled)
+            self.M.signal_latency.observe(res.latency_s, family=family,
+                                          exemplar=trace_id)
+            if res.error:
+                # fail-open families are an SLO input: the in-process
+                # monitor divides this by the evaluation count
+                self.M.signal_errors.inc(family=family)
 
         with self.tracer.decision_span():
             decision_res = decision_engine.evaluate(signals)

@@ -86,6 +86,8 @@ API_CATALOG = {
         {"path": "/debug/profiler/xla-dump", "method": "POST"},
         {"path": "/debug/flightrec", "method": "GET"},
         {"path": "/debug/flightrec/clear", "method": "POST"},
+        {"path": "/debug/slo", "method": "GET"},
+        {"path": "/debug/runtime", "method": "GET"},
         {"path": "/info/models", "method": "GET"},
         {"path": "/config/router", "method": "GET"},
         {"path": "/config/router", "method": "PATCH"},
@@ -701,7 +703,22 @@ class RouterServer:
             def do_GET(self):
                 path = self.path.split("?")[0]
                 if path == "/health":
-                    self._json(200, {"status": "healthy"})
+                    # SLO-aware liveness: a firing burn-rate alert flips
+                    # the body to "degraded" (load balancers and humans
+                    # read it) but stays HTTP 200 — liveness must not
+                    # make orchestrators restart a slow-but-serving pod
+                    breaches = []
+                    slo = server.registry.get("slo")
+                    if slo is not None:
+                        try:
+                            breaches = slo.degraded()
+                        except Exception:
+                            breaches = []
+                    if breaches:
+                        self._json(200, {"status": "degraded",
+                                         "slo_breaches": breaches})
+                    else:
+                        self._json(200, {"status": "healthy"})
                 elif path == "/ready":
                     ok = server.ready.is_set()
                     self._json(200 if ok else 503,
@@ -806,6 +823,22 @@ class RouterServer:
                     # slow-request flight recorder dump: slowest-N +
                     # threshold breaches with full span trees
                     self._json(200, server.flightrec().dump())
+                elif path == "/debug/slo":
+                    # in-process SLO report: objectives, burn rates per
+                    # window, firing alerts (ticks inline — never stale)
+                    slo = server.registry.get("slo")
+                    if slo is None:
+                        self._json(503, {"error": "no SLO monitor"})
+                    else:
+                        self._json(200, slo.report())
+                elif path == "/debug/runtime":
+                    # runtime telemetry snapshot: per-jit-program
+                    # compile/execute registry + process/device gauges
+                    rs = server.registry.get("runtimestats")
+                    if rs is None:
+                        self._json(503, {"error": "no runtime stats"})
+                    else:
+                        self._json(200, rs.report())
                 elif path == "/config/router":
                     # secrets masked unless the key holds secret_view
                     # (management_api.go:67)

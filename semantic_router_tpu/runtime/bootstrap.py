@@ -123,7 +123,9 @@ def build_engine(cfg: RouterConfig, mock: bool = False, registry=None):
     engine = InferenceEngine(
         cfg.engine,
         metrics=registry.metric_series() if registry is not None else None,
-        events=registry.events if registry is not None else None)
+        events=registry.events if registry is not None else None,
+        runtime_stats=registry.get("runtimestats")
+        if registry is not None else None)
 
     # Dedup caches: tasks whose specs point at the SAME checkpoint /
     # tokenizer path must receive the same array and tokenizer OBJECTS —
@@ -488,8 +490,48 @@ def apply_observability_knobs(cfg: RouterConfig, registry) -> None:
         fr = registry.get("flightrec")
         if fr is not None and fr_cfg:
             fr.configure(**fr_cfg)
+        # tail-based sampling: retained (slowest-N / threshold) traces
+        # pin themselves force-sampled on this registry's tracer
+        if fr is not None and getattr(fr, "on_retain", None) is None \
+                and hasattr(registry.tracer, "force_sample"):
+            fr.on_retain = registry.tracer.force_sample
     except Exception as exc:
         component_event("bootstrap", "flight_recorder_config_invalid",
+                        error=str(exc)[:200], level="warning")
+    try:
+        # always-on runtime telemetry: the device-step sampler + process
+        # gauges (observability.runtimestats) start here and retune on
+        # hot reload; disabling stops the thread AND short-circuits the
+        # engine's per-step append (the bench overhead-arm baseline)
+        rs = registry.get("runtimestats")
+        if rs is not None:
+            rs_cfg = cfg.runtime_stats_config()
+            rs.enabled = rs_cfg["enabled"]
+            if rs_cfg["enabled"]:
+                rs.start(rs_cfg["interval_s"])
+            else:
+                rs.stop()
+    except Exception as exc:
+        component_event("bootstrap", "runtime_stats_config_invalid",
+                        error=str(exc)[:200], level="warning")
+    try:
+        # in-process SLO engine (observability.slo): objectives parse
+        # here, burn-rate monitors run on their own thread, /health
+        # reads the degraded flag.  Malformed objectives are skipped and
+        # reported via /debug/slo config_errors — never fatal.
+        slo = registry.get("slo")
+        if slo is not None:
+            slo.configure(cfg.slo_config())
+            if slo.enabled:
+                slo.start(slo.evaluation_interval_s)
+            else:
+                slo.stop()
+            if slo.config_errors:
+                component_event("bootstrap", "slo_objectives_invalid",
+                                errors=slo.config_errors[:5],
+                                level="warning")
+    except Exception as exc:
+        component_event("bootstrap", "slo_config_invalid",
                         error=str(exc)[:200], level="warning")
 
 

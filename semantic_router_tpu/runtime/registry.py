@@ -22,8 +22,8 @@ import threading
 from typing import Any, Dict, Optional
 
 _SLOTS = ("metrics", "tracer", "sessions", "profiler", "events",
-          "flightrec", "engine", "cache", "memory_store", "vectorstores",
-          "replay_store")
+          "flightrec", "runtimestats", "slo", "engine", "cache",
+          "memory_store", "vectorstores", "replay_store")
 
 
 class RuntimeRegistry:
@@ -42,7 +42,9 @@ class RuntimeRegistry:
         from ..observability.flightrec import default_flight_recorder
         from ..observability.metrics import default_registry
         from ..observability.profiler import default_profiler
+        from ..observability.runtimestats import default_runtime_stats
         from ..observability.session import default_session_telemetry
+        from ..observability.slo import default_slo_monitor
         from ..observability.tracing import default_tracer
         from .events import default_bus
 
@@ -53,6 +55,8 @@ class RuntimeRegistry:
             "profiler": default_profiler,
             "events": default_bus,
             "flightrec": default_flight_recorder,
+            "runtimestats": default_runtime_stats,
+            "slo": default_slo_monitor,
         }
         base.update(overrides)
         return cls(**base)
@@ -72,17 +76,25 @@ class RuntimeRegistry:
         from ..observability.flightrec import FlightRecorder
         from ..observability.metrics import MetricsRegistry
         from ..observability.profiler import ProfilerControl
+        from ..observability.runtimestats import RuntimeStats
         from ..observability.session import SessionTelemetry
+        from ..observability.slo import SLOMonitor
         from ..observability.tracing import Tracer
         from .events import EventBus
 
+        metrics = MetricsRegistry()
         base: Dict[str, Any] = {
-            "metrics": MetricsRegistry(),
+            "metrics": metrics,
             "tracer": Tracer(),
             "events": EventBus(),
             "sessions": SessionTelemetry(),
             "profiler": ProfilerControl(),
             "flightrec": FlightRecorder(),
+            # runtime telemetry + SLO engine write into THIS instance's
+            # metrics registry, so embedded routers' llm_runtime_*/
+            # llm_slo_* series stay isolated like everything else
+            "runtimestats": RuntimeStats(metrics),
+            "slo": SLOMonitor(metrics),
         }
         base.update(overrides)
         return cls(**base)

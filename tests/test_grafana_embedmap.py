@@ -50,7 +50,8 @@ class TestGrafana:
         rc = main(["grafana", "--out-dir", str(tmp_path / "g")])
         assert rc == 0
         out = json.loads(capsys.readouterr().out)
-        assert len(out["rendered"]) == 6
+        # 5 curated dashboards (incl. Runtime & SLO) + catalog + provider
+        assert len(out["rendered"]) == 7
 
 
 class TestEmbedMap:

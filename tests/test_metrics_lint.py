@@ -1,0 +1,158 @@
+"""`make metrics-lint`: exposition grammar gate over the LIVE /metrics
+surface in both formats (text 0.0.4 and OpenMetrics), so a series whose
+rendering would fail a strict scraper — blanking every dashboard panel
+that reads it — fails tier-1 instead of production."""
+
+import urllib.request
+
+import pytest
+
+from semantic_router_tpu.observability.metrics import (
+    MetricSeries,
+    MetricsRegistry,
+)
+from semantic_router_tpu.observability.metrics_lint import lint_exposition
+
+
+def _drive(series: MetricSeries) -> None:
+    """Touch every canonical series shape: labeled/unlabeled counters,
+    gauges, histograms with+without exemplars."""
+    series.model_requests.inc(model="m", decision="d")
+    series.signal_errors.inc(family="kb")
+    series.routing_latency.observe(0.012, exemplar="ab" * 16)
+    series.signal_latency.observe(0.004, family="kb",
+                                  exemplar="cd" * 16)
+    series.batcher_queue_wait.observe(0.001, batcher="b")
+    series.batcher_fill_ratio.observe(0.5, batcher="b")
+    series.registry.gauge("llm_test_gauge", "A gauge").set(3.5, slot="x")
+
+
+class TestRegistryExposition:
+    def test_text_format_clean(self):
+        reg = MetricsRegistry()
+        _drive(MetricSeries(reg))
+        errors = lint_exposition(reg.expose(), openmetrics=False)
+        assert errors == []
+
+    def test_openmetrics_format_clean(self):
+        reg = MetricsRegistry()
+        reg.enable_exemplars(True)
+        _drive(MetricSeries(reg))
+        errors = lint_exposition(reg.expose() + "# EOF\n",
+                                 openmetrics=True)
+        assert errors == []
+
+    def test_runtime_and_slo_series_clean(self):
+        from semantic_router_tpu.observability.runtimestats import (
+            RuntimeStats,
+        )
+        from semantic_router_tpu.observability.slo import SLOMonitor
+
+        reg = MetricsRegistry()
+        series = MetricSeries(reg)
+        rs = RuntimeStats(reg)
+        rs.record_step("trunk:g0", 128, "fused", 4, 8, 1.0, compiled=True)
+        rs.record_step("trunk:g0", 128, "fused", 4, 8, 0.01)
+        rs.flush()
+        rs.sample_process()
+        mon = SLOMonitor(reg)
+        mon.configure({"objectives": [
+            "routing_latency p99 < 25ms over 5m"]})
+        mon.tick(now=1.0)
+        series.routing_latency.observe(0.012)
+        mon.tick(now=2.0)
+        assert lint_exposition(reg.expose(), openmetrics=False) == []
+
+    def test_help_type_pairing_emitted(self):
+        reg = MetricsRegistry()
+        _drive(MetricSeries(reg))
+        text = reg.expose()
+        assert "# HELP llm_model_requests_total" in text
+        assert "# TYPE llm_model_requests_total counter" in text
+
+    # -- the linter itself must catch real breakage -----------------------
+
+    def test_catches_exemplar_in_text_format(self):
+        bad = ('# TYPE h histogram\n'
+               'h_bucket{le="+Inf"} 1 # {trace_id="x"} 0.1 1.0\n'
+               'h_sum 0.1\nh_count 1\n')
+        assert any("exemplar" in e for e in
+                   lint_exposition(bad, openmetrics=False))
+
+    def test_catches_total_family_in_openmetrics(self):
+        bad = "# TYPE x_total counter\nx_total 1\n# EOF\n"
+        assert any("_total" in e for e in
+                   lint_exposition(bad, openmetrics=True))
+
+    def test_catches_nonmonotonic_buckets(self):
+        bad = ('# TYPE h histogram\nh_bucket{le="1"} 5\n'
+               'h_bucket{le="+Inf"} 3\nh_sum 1\nh_count 3\n')
+        assert any("cumulative" in e for e in
+                   lint_exposition(bad, openmetrics=False))
+
+    def test_catches_inf_count_mismatch(self):
+        bad = ('# TYPE h histogram\nh_bucket{le="+Inf"} 3\n'
+               'h_sum 1\nh_count 4\n')
+        assert any("_count" in e for e in
+                   lint_exposition(bad, openmetrics=False))
+
+    def test_catches_missing_eof(self):
+        assert any("EOF" in e for e in
+                   lint_exposition("# TYPE g gauge\ng 1\n",
+                                   openmetrics=True))
+
+    def test_catches_undeclared_sample(self):
+        assert any("no TYPE" in e for e in
+                   lint_exposition("mystery_series 1\n",
+                                   openmetrics=False))
+
+
+class TestLiveScrape:
+    """Boot a real server and lint what an actual scraper would read —
+    content type and format must flip together with the exemplar knob."""
+
+    @pytest.fixture()
+    def server(self):
+        from semantic_router_tpu.config.schema import RouterConfig
+        from semantic_router_tpu.router.pipeline import Router
+        from semantic_router_tpu.router.server import RouterServer
+        from semantic_router_tpu.runtime.registry import RuntimeRegistry
+
+        cfg = RouterConfig.from_dict({"default_model": "m"})
+        registry = RuntimeRegistry.isolated()
+        router = Router(cfg, metrics=registry.metric_series(),
+                        tracer=registry.tracer,
+                        flightrec=registry.get("flightrec"))
+        server = RouterServer(router, cfg, registry=registry).start()
+        # real traffic so histograms/counters/exemplars have samples
+        with registry.tracer.span("router.route"):
+            pass
+        for i in range(3):
+            router.route({"model": "auto", "messages": [
+                {"role": "user", "content": f"scrape probe {i}"}]})
+        yield server, registry
+        server.stop()
+
+    def _scrape(self, server):
+        with urllib.request.urlopen(server.url + "/metrics",
+                                    timeout=30) as resp:
+            return resp.headers.get("content-type", ""), \
+                resp.read().decode()
+
+    def test_text_mode_scrape_clean(self, server):
+        srv, registry = server
+        registry.metrics.enable_exemplars(False)
+        ctype, text = self._scrape(srv)
+        assert ctype.startswith("text/plain")
+        assert lint_exposition(text, openmetrics=False) == []
+
+    def test_openmetrics_mode_scrape_clean(self, server):
+        srv, registry = server
+        registry.metrics.enable_exemplars(True)
+        for i in range(3):  # exemplar-carrying observations
+            srv.router.route({"model": "auto", "messages": [
+                {"role": "user", "content": f"exemplar probe {i}"}]})
+        ctype, text = self._scrape(srv)
+        assert ctype.startswith("application/openmetrics-text")
+        assert text.rstrip().endswith("# EOF")
+        assert lint_exposition(text, openmetrics=True) == []

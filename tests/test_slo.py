@@ -1,0 +1,341 @@
+"""In-process SLO engine (observability/slo.py): objective DSL,
+multi-window burn-rate alerting, and the ISSUE 3 acceptance path — a
+synthetic degradation (slow + erroring signal backend) flips the alert
+within the fast window, /debug/slo names the breaching objective,
+/health reports degraded, and removing the injection clears it."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from semantic_router_tpu.observability.metrics import (
+    MetricSeries,
+    MetricsRegistry,
+)
+from semantic_router_tpu.observability.slo import (
+    SLOMonitor,
+    parse_duration_s,
+    parse_objective,
+)
+
+
+class TestObjectiveDSL:
+    def test_latency_expression(self):
+        o = parse_objective("routing_latency p99 < 25ms over 5m")
+        assert o.kind == "latency"
+        assert o.metric == "llm_model_routing_latency_seconds"
+        assert o.budget == pytest.approx(0.01)
+        assert o.threshold_s == pytest.approx(0.025)
+        assert o.window_s == pytest.approx(300.0)
+
+    def test_ratio_expression(self):
+        o = parse_objective("signal error-rate < 0.1% over 5m")
+        assert o.kind == "ratio"
+        assert o.metric == "llm_signal_errors_total"
+        assert o.total_metric == "llm_signal_latency_seconds"
+        assert o.budget == pytest.approx(0.001)
+
+    def test_raw_series_name_accepted(self):
+        o = parse_objective("llm_batcher_queue_wait_seconds p95 < 10ms")
+        assert o.metric == "llm_batcher_queue_wait_seconds"
+        assert o.budget == pytest.approx(0.05)
+        assert o.window_s == pytest.approx(300.0)  # default window
+
+    def test_named_dict_with_expression(self):
+        o = parse_objective({"name": "fast_routing",
+                             "objective": "routing_latency p95 < 50ms"})
+        assert o.name == "fast_routing"
+        assert o.threshold_s == pytest.approx(0.05)
+
+    def test_explicit_dict_ratio(self):
+        o = parse_objective({
+            "name": "cache_errors", "kind": "ratio",
+            "metric": "llm_cache_lookups_total",
+            "total_metric": "llm_cache_lookups_total",
+            "budget": 0.02, "window": "1m"})
+        assert o.kind == "ratio" and o.budget == pytest.approx(0.02)
+        assert o.window_s == pytest.approx(60.0)
+
+    def test_durations(self):
+        assert parse_duration_s("25ms") == pytest.approx(0.025)
+        assert parse_duration_s("5m") == pytest.approx(300.0)
+        assert parse_duration_s("1h") == pytest.approx(3600.0)
+        assert parse_duration_s(7) == pytest.approx(7.0)
+
+    def test_unparseable_raises(self):
+        with pytest.raises(ValueError):
+            parse_objective("latency should be nice")
+        with pytest.raises(ValueError):
+            parse_objective("made_up error-rate < 1%")  # no alias pair
+
+    def test_configure_contains_bad_objectives(self):
+        mon = SLOMonitor(MetricsRegistry())
+        mon.configure({"objectives": [
+            "routing_latency p99 < 25ms over 5m", "nonsense here"]})
+        assert len(mon.objectives) == 1
+        assert mon.config_errors and "nonsense" in mon.config_errors[0]
+        assert mon.enabled  # the valid objective still monitors
+
+    def test_windows_derivation(self):
+        mon = SLOMonitor(MetricsRegistry())
+        o = parse_objective("routing_latency p99 < 25ms over 5m")
+        w = mon.windows_for(o)
+        assert w["fast"] == ((300.0, 3600.0), 14.4)   # 5m / 1h
+        assert w["slow"] == ((1800.0, 21600.0), 6.0)  # 30m / 6h
+
+
+class TestBurnRates:
+    def _monitor(self, window="0.2s"):
+        reg = MetricsRegistry()
+        series = MetricSeries(reg)
+        mon = SLOMonitor(reg)
+        mon.configure({"objectives": [
+            f"routing_latency p99 < 25ms over {window}",
+            f"signal error-rate < 1% over {window}"]})
+        return reg, series, mon
+
+    def test_alert_fires_on_bad_latency(self):
+        _, s, mon = self._monitor()
+        mon.tick(now=100.0)
+        for _ in range(50):
+            s.routing_latency.observe(0.5)
+        mon.tick(now=100.2)
+        assert "routing_latency_p99" in mon.degraded()
+        rep = mon.report(tick=False)
+        row = next(r for r in rep["objectives"]
+                   if r["name"] == "routing_latency_p99")
+        assert row["firing"] and row["severity"] == "fast"
+        assert row["burn_rates"]["fast_short"] > 14.4
+
+    def test_error_rate_objective(self):
+        _, s, mon = self._monitor()
+        mon.tick(now=10.0)
+        for i in range(100):
+            s.signal_latency.observe(0.001, family="kb")
+            if i % 10 == 0:  # 10% errors vs 1% budget = 10x burn > 6
+                s.signal_errors.inc(family="kb")
+        mon.tick(now=10.2)
+        rep = mon.report(tick=False)
+        row = next(r for r in rep["objectives"]
+                   if r["name"] == "signal_error_rate")
+        assert row["burn_rates"]["fast_short"] == pytest.approx(
+            10.0, rel=0.2)
+
+    def test_within_budget_never_fires(self):
+        _, s, mon = self._monitor()
+        mon.tick(now=10.0)
+        for i in range(1000):
+            s.routing_latency.observe(0.001)  # all inside 25ms
+            s.signal_latency.observe(0.001, family="kb")
+        mon.tick(now=10.2)
+        mon.tick(now=12.0)
+        assert mon.degraded() == []
+
+    def test_alert_clears_after_clean_window(self):
+        _, s, mon = self._monitor()
+        mon.tick(now=100.0)
+        for _ in range(50):
+            s.routing_latency.observe(0.5)
+        mon.tick(now=100.2)
+        assert mon.degraded()
+        for t in range(1, 80):  # clean traffic past every window pair
+            for _ in range(20):
+                s.routing_latency.observe(0.001)
+            mon.tick(now=100.2 + t * 0.2)
+        assert mon.degraded() == []
+
+    def test_alert_gauge_clears_old_severity_series(self):
+        """The firing gauge keys on a severity label; clearing must zero
+        the OLD severity's series, not just write a new label set."""
+        reg, s, mon = self._monitor()
+        mon.tick(now=100.0)
+        for _ in range(50):
+            s.routing_latency.observe(0.5)
+        mon.tick(now=100.2)
+        g = mon.alert_gauge
+        assert g.get(objective="routing_latency_p99",
+                     severity="fast") == 1.0
+        for t in range(1, 80):
+            for _ in range(20):
+                s.routing_latency.observe(0.001)
+            mon.tick(now=100.2 + t * 0.2)
+        assert mon.degraded() == []
+        # every severity series reads 0 — nothing latched
+        assert g.get(objective="routing_latency_p99",
+                     severity="fast") == 0.0
+        assert g.get(objective="routing_latency_p99",
+                     severity="slow") == 0.0
+        assert sum(g.values().values()) == 0.0
+
+    def test_renamed_objective_zeroes_old_gauge_series(self):
+        """A hot-reload that renames/removes a FIRING objective must
+        zero the old name's gauge series — the Gauge has no removal
+        API, so a stale 1.0 would page forever."""
+        _, s, mon = self._monitor()
+        mon.tick(now=100.0)
+        for _ in range(50):
+            s.routing_latency.observe(0.5)
+        mon.tick(now=100.2)
+        g = mon.alert_gauge
+        assert g.get(objective="routing_latency_p99",
+                     severity="fast") == 1.0
+        mon.configure({"objectives": [
+            {"name": "renamed",
+             "objective": "routing_latency p99 < 25ms over 0.2s"}]})
+        assert g.get(objective="routing_latency_p99",
+                     severity="fast") == 0.0
+
+    def test_disable_while_firing_clears_degraded(self):
+        """Hot-reloading enabled:false while an alert fires must not
+        latch /health on degraded forever (the monitor never ticks
+        again, so configure() clears the state)."""
+        _, s, mon = self._monitor()
+        mon.tick(now=100.0)
+        for _ in range(50):
+            s.routing_latency.observe(0.5)
+        mon.tick(now=100.2)
+        assert mon.degraded()
+        mon.configure({"enabled": False, "objectives": [
+            "routing_latency p99 < 25ms over 0.2s"]})
+        assert mon.degraded() == []
+        assert sum(mon.alert_gauge.values().values()) == 0.0
+
+    def test_no_traffic_no_burn(self):
+        _, _, mon = self._monitor()
+        mon.tick(now=1.0)
+        mon.tick(now=2.0)
+        assert mon.degraded() == []
+
+    def test_slo_series_exposed(self):
+        reg, s, mon = self._monitor()
+        mon.tick(now=1.0)
+        s.routing_latency.observe(0.001)
+        mon.tick(now=1.2)
+        text = reg.expose()
+        assert "llm_slo_burn_rate" in text
+        assert "llm_slo_alert_firing" in text
+        assert "llm_slo_good_ratio" in text
+
+    def test_missing_series_reads_zero(self):
+        reg = MetricsRegistry()
+        mon = SLOMonitor(reg)
+        mon.configure({"objectives": ["ttft p99 < 1s over 0.2s"]})
+        mon.tick()  # the histogram does not exist yet
+        assert mon.degraded() == []
+
+
+def _get(url, path):
+    with urllib.request.urlopen(url + path, timeout=30) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+class _InjectedSignal:
+    """The synthetic degradation: a signal backend that can be flipped
+    slow + erroring (fail-open → llm_signal_errors_total + inflated
+    routing latency) and back to healthy."""
+
+    signal_type = "synthetic"
+
+    def __init__(self):
+        self.mode = "ok"
+
+    def evaluate(self, ctx):
+        from semantic_router_tpu.signals.base import SignalResult
+
+        if self.mode == "degraded":
+            time.sleep(0.06)  # blows the 25ms routing budget
+            raise RuntimeError("synthetic backend down")
+        return SignalResult(signal_type="synthetic")
+
+
+class TestSyntheticDegradation:
+    """ISSUE 3 acceptance: inject a slow signal backend → the burn-rate
+    alert fires within the fast window, /debug/slo reports the breaching
+    objective, /health shows degraded; removing the injection clears."""
+
+    @pytest.fixture()
+    def stack(self):
+        from semantic_router_tpu.config.schema import RouterConfig
+        from semantic_router_tpu.router.pipeline import Router
+        from semantic_router_tpu.router.server import RouterServer
+        from semantic_router_tpu.runtime.bootstrap import (
+            apply_observability_knobs,
+        )
+        from semantic_router_tpu.runtime.registry import RuntimeRegistry
+
+        cfg = RouterConfig.from_dict({
+            "default_model": "m",
+            "observability": {"slo": {
+                "evaluation_interval_s": 0.05,
+                "objectives": [
+                    "routing_latency p99 < 25ms over 0.2s",
+                    "signal error-rate < 1% over 0.2s",
+                ]}},
+        })
+        registry = RuntimeRegistry.isolated()
+        router = Router(cfg, metrics=registry.metric_series(),
+                        tracer=registry.tracer,
+                        flightrec=registry.get("flightrec"))
+        injected = _InjectedSignal()
+        router.dispatcher.evaluators["synthetic"] = injected
+        server = RouterServer(router, cfg, registry=registry).start()
+        apply_observability_knobs(cfg, registry)
+        yield server, router, injected, registry.get("slo")
+        registry.get("slo").stop()
+        server.stop()
+
+    @staticmethod
+    def _drive_until(router, monitor, predicate, timeout=8.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            router.route({"model": "auto", "messages": [
+                {"role": "user", "content": "probe request"}]})
+            monitor.tick()
+            if predicate():
+                return True
+        return predicate()
+
+    def test_degradation_flips_and_clears(self, stack):
+        server, router, injected, monitor = stack
+
+        # healthy baseline
+        assert self._drive_until(router, monitor, lambda: True)
+        status, body = _get(server.url, "/health")
+        assert status == 200 and body["status"] == "healthy"
+
+        # inject: alert must fire within the fast window
+        injected.mode = "degraded"
+        assert self._drive_until(
+            router, monitor, lambda: monitor.degraded()), \
+            "burn-rate alert never fired under synthetic degradation"
+        breaching = monitor.degraded()
+        assert "routing_latency_p99" in breaching \
+            or "signal_error_rate" in breaching
+
+        status, slo_report = _get(server.url, "/debug/slo")
+        assert status == 200
+        firing = [o for o in slo_report["objectives"] if o["firing"]]
+        assert firing, slo_report
+        assert slo_report["degraded"] == breaching
+
+        status, body = _get(server.url, "/health")
+        assert status == 200  # liveness must NOT flap the pod
+        assert body["status"] == "degraded"
+        assert body["slo_breaches"] == breaching
+
+        # remove the injection: clean traffic ages the windows out
+        injected.mode = "ok"
+        assert self._drive_until(
+            router, monitor, lambda: not monitor.degraded(),
+            timeout=15.0), "alert never cleared after recovery"
+        status, body = _get(server.url, "/health")
+        assert body["status"] == "healthy"
+        assert not _get(server.url, "/debug/slo")[1]["degraded"]
+
+    def test_debug_runtime_endpoint(self, stack):
+        server, router, _, _ = stack
+        status, body = _get(server.url, "/debug/runtime")
+        assert status == 200
+        assert "programs" in body and "process" in body

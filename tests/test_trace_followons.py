@@ -1,0 +1,217 @@
+"""PR 2 follow-on satellites (ISSUE 3): the STREAMED prefetch trace
+seam, tail-based sampling via the flight recorder, and trace-id
+exemplars on llm_signal_latency_seconds."""
+
+import json
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from semantic_router_tpu.config.schema import RouterConfig
+from semantic_router_tpu.observability.flightrec import FlightRecorder
+from semantic_router_tpu.observability.metrics import (
+    MetricSeries,
+    MetricsRegistry,
+)
+from semantic_router_tpu.observability.tracing import Tracer
+from semantic_router_tpu.router.pipeline import Router
+
+
+def _router(**kw):
+    cfg = RouterConfig.from_dict({"default_model": "m"})
+    return Router(cfg, **kw)
+
+
+class TestPrefetchTraceSeam:
+    """evaluate_signals runs BEFORE route()'s root span on the streamed
+    path; the pending trace context re-parents those spans under
+    router.route instead of orphaning them."""
+
+    def test_pending_trace_adopted_by_route(self):
+        tracer = Tracer(capacity=4096, sample_rate=1.0)
+        router = _router(tracer=tracer,
+                         metrics=MetricSeries(MetricsRegistry()))
+        pending = router.begin_pending_trace({})
+        # the prefetch evaluates under the pending context…
+        router.evaluate_signals(
+            {"model": "auto",
+             "messages": [{"role": "user", "content": "early text"}]},
+            {}, pending)
+        # …and route() later adopts the pre-minted ids
+        result = router.route(
+            {"model": "auto",
+             "messages": [{"role": "user", "content": "early text"}]},
+            {}, pending_trace=pending)
+        assert result.trace_id == pending.trace_id
+        assert result.root_span_id == pending.root_span_id
+        spans = tracer.trace(pending.trace_id)
+        roots = [s for s in spans if s.name == "router.route"]
+        assert roots and roots[0].span_id == pending.root_span_id
+        pre = [s for s in spans if s.name == "signals.evaluate"
+               and s.attributes.get("prefetch")]
+        assert pre, "prefetched evaluation span missing from the trace"
+        assert pre[0].parent_id == pending.root_span_id
+
+    def test_pending_trace_continues_caller_traceparent(self):
+        tracer = Tracer(sample_rate=1.0)
+        router = _router(tracer=tracer,
+                         metrics=MetricSeries(MetricsRegistry()))
+        tid, parent = "ab" * 16, "12" * 8
+        pending = router.begin_pending_trace(
+            {"traceparent": f"00-{tid}-{parent}-01"})
+        assert pending.trace_id == tid
+        assert pending.parent_id == parent
+        result = router.route(
+            {"model": "auto",
+             "messages": [{"role": "user", "content": "x"}]},
+            {}, pending_trace=pending)
+        assert result.trace_id == tid
+        root = [s for s in tracer.trace(tid)
+                if s.name == "router.route"][0]
+        assert root.parent_id == parent
+
+    def test_streamed_handler_mints_and_reuses(self):
+        """End-to-end through StreamedBodyHandler: the prefetch kicked
+        off mid-stream lands its spans under the root span the final
+        route() call opens."""
+        from semantic_router_tpu.extproc.streamed import (
+            StreamedBodyHandler,
+        )
+
+        tracer = Tracer(capacity=4096, sample_rate=1.0)
+        router = _router(tracer=tracer,
+                         metrics=MetricSeries(MetricsRegistry()))
+        pool = ThreadPoolExecutor(max_workers=1)
+        try:
+            handler = StreamedBodyHandler(router, {}, prefetch_pool=pool)
+            full = json.dumps({
+                "model": "auto",
+                "messages": [{"role": "user",
+                              "content": "streamed request text"}],
+                "temperature": 0.7}).encode()  # non-signal trailing field
+            cut = full.index(b'"temperature"')
+            action, _ = handler.handle_chunk(full[:cut], eos=False)
+            assert action == "continue"
+            assert handler.pending_trace is not None
+            deadline = time.time() + 5.0  # let the prefetch actually run
+            while time.time() < deadline and handler._prefetch is not None \
+                    and not handler._prefetch.done():
+                time.sleep(0.01)
+            action, payload = handler.handle_chunk(full[cut:], eos=True)
+            assert action == "route"
+            body, signals = payload
+            assert signals is not None, "prefetch result not reused"
+            result = router.route(body, {}, precomputed_signals=signals,
+                                  pending_trace=handler.pending_trace)
+            spans = tracer.trace(result.trace_id)
+            names = {s.name for s in spans}
+            assert "router.route" in names
+            pre = [s for s in spans if s.name == "signals.evaluate"
+                   and s.attributes.get("prefetch")]
+            assert pre and pre[0].parent_id == result.root_span_id
+        finally:
+            pool.shutdown(wait=False)
+
+    def test_stub_router_without_seam_still_works(self):
+        """Routers lacking begin_pending_trace (test stubs) keep the
+        two-arg evaluate_signals call."""
+        from semantic_router_tpu.extproc.streamed import (
+            StreamedBodyHandler,
+        )
+
+        calls = []
+
+        class Stub:
+            def evaluate_signals(self, body, headers):
+                calls.append(body)
+                return ("sig", "report")
+
+        pool = ThreadPoolExecutor(max_workers=1)
+        try:
+            handler = StreamedBodyHandler(Stub(), {}, prefetch_pool=pool)
+            full = json.dumps({"model": "auto", "messages": [
+                {"role": "user", "content": "x"}], "stream": 1}).encode()
+            cut = full.index(b'"stream"')
+            handler.handle_chunk(full[:cut], eos=False)
+            assert handler.pending_trace is None
+            time.sleep(0.1)
+            action, _ = handler.handle_chunk(full[cut:], eos=True)
+            assert action == "route"
+            assert calls  # the prefetch ran through the stub unchanged
+        finally:
+            pool.shutdown(wait=False)
+
+
+class TestTailBasedSampling:
+    def test_force_sample_overrides_rate(self):
+        from semantic_router_tpu.observability.batchtrace import _sampled
+
+        tracer = Tracer(sample_rate=0.0)
+        tid = "ab" * 16
+        assert not _sampled(tracer, tid)
+        tracer.force_sample(tid)
+        assert tracer.is_force_sampled(tid)
+        assert _sampled(tracer, tid)
+
+    def test_force_set_is_bounded(self):
+        tracer = Tracer(force_capacity=4)
+        for i in range(10):
+            tracer.force_sample(f"{i:032x}")
+        assert len(tracer._forced) == 4
+        assert tracer.is_force_sampled(f"{9:032x}")   # newest kept
+        assert not tracer.is_force_sampled(f"{0:032x}")  # oldest evicted
+
+    def test_flightrec_retention_pins_trace(self):
+        """A threshold breach force-keeps the trace: the recorder's
+        on_retain hook (wired by Router) marks it on the tracer, so
+        continued activity gets detailed sampling despite rate=0."""
+        tracer = Tracer(sample_rate=0.0)
+        fr = FlightRecorder(slowest_n=4, threshold_s=0.0)
+        router = _router(tracer=tracer, flightrec=fr,
+                         metrics=MetricSeries(MetricsRegistry()))
+        assert fr.on_retain is not None  # Router wired the hook
+        result = router.route({"model": "auto", "messages": [
+            {"role": "user", "content": "slow request"}]})
+        assert tracer.is_force_sampled(result.trace_id)
+
+    def test_unretained_request_not_pinned(self):
+        tracer = Tracer(sample_rate=0.0)
+        # slowest_n=0 and no threshold: the recorder retains nothing
+        fr = FlightRecorder(slowest_n=0, threshold_s=None)
+        router = _router(tracer=tracer, flightrec=fr,
+                         metrics=MetricSeries(MetricsRegistry()))
+        result = router.route({"model": "auto", "messages": [
+            {"role": "user", "content": "fast request"}]})
+        assert not tracer.is_force_sampled(result.trace_id)
+
+
+class TestSignalTelemetry:
+    def test_signal_latency_carries_exemplars(self):
+        reg = MetricsRegistry()
+        reg.enable_exemplars(True)
+        router = _router(metrics=MetricSeries(reg), tracer=Tracer())
+        result = router.route({"model": "auto", "messages": [
+            {"role": "user", "content": "exemplar probe"}]})
+        text = reg.expose()
+        lines = [l for l in text.split("\n")
+                 if l.startswith("llm_signal_latency_seconds_bucket")
+                 and "trace_id=" in l]
+        assert lines, "no exemplar on any signal-latency bucket"
+        assert any(result.trace_id in l for l in lines)
+
+    def test_signal_errors_counted(self):
+        reg = MetricsRegistry()
+        series = MetricSeries(reg)
+        router = _router(metrics=series, tracer=Tracer())
+
+        class Broken:
+            signal_type = "broken"
+
+            def evaluate(self, ctx):
+                raise RuntimeError("backend down")
+
+        router.dispatcher.evaluators["broken"] = Broken()
+        router.route({"model": "auto", "messages": [
+            {"role": "user", "content": "trigger the broken family"}]})
+        assert series.signal_errors.get(family="broken") == 1.0
