@@ -1,8 +1,8 @@
 PY ?= python
 SHELL := /bin/bash
 
-.PHONY: test test-fast tier1 trace-smoke metrics-lint native bench \
-	bench-replay perf perf-record serve-mock clean
+.PHONY: test test-fast tier1 trace-smoke metrics-lint explain-smoke \
+	native bench bench-replay perf perf-record serve-mock clean
 
 bench-replay:
 	$(PY) benchmarks/replay_bench.py --n 500 \
@@ -35,6 +35,15 @@ trace-smoke:
 # not in Grafana.  Tier-1 (runs inside `make tier1` too).
 metrics-lint:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_metrics_lint.py \
+	  -q -p no:cacheprovider
+
+# decision-explainability gate (docs/OBSERVABILITY.md): boots the
+# pipeline over a fake shared-trunk engine, pushes 50 mixed-signal
+# requests, and asserts every non-passthrough response yields a
+# retrievable, schema-valid decision record whose replay reproduces the
+# identical model choice.  Tier-1 (runs inside `make tier1` too).
+explain-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_explain_smoke.py \
 	  -q -p no:cacheprovider
 
 native:

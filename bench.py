@@ -406,6 +406,112 @@ def _measure_runtime_stats_overhead(platform: str) -> dict:
         eng.shutdown()
 
 
+def _measure_explain_overhead(platform: str) -> dict:
+    """signals/s through the FULL routing pipeline (signal fan-out over
+    the shared-trunk engine → decision engine → selection) with decision
+    recording at sample_rate=1.0 vs disabled — the <1% acceptance gate
+    for ISSUE 4's explainability.  ``enabled = False`` short-circuits
+    DecisionExplainer.begin before any draft allocates, so the disabled
+    arm measures the true unrecorded hot path.  Same interleaved
+    alternate-order best-of protocol as the runtime_stats arm (single
+    shared core: sequential A-then-B inherits warmup drift)."""
+    import time as _time
+
+    from semantic_router_tpu.config.schema import (
+        DomainRule,
+        NamedRule,
+        RouterConfig,
+        SignalsConfig,
+    )
+    from semantic_router_tpu.engine.testing import make_shared_trunk_engine
+    from semantic_router_tpu.observability.explain import DecisionExplainer
+    from semantic_router_tpu.observability.flightrec import FlightRecorder
+    from semantic_router_tpu.observability.metrics import (
+        MetricSeries,
+        MetricsRegistry,
+    )
+    from semantic_router_tpu.observability.tracing import Tracer
+    from semantic_router_tpu.router.pipeline import Router
+
+    n_tasks = 3  # the shared-trunk engine's learned families
+    n_iters = 40 if platform == "cpu" else 100
+    engine = make_shared_trunk_engine(
+        metrics=MetricSeries(MetricsRegistry()))
+    cfg = RouterConfig(
+        default_model="backend-model",
+        signals=SignalsConfig(
+            domains=[DomainRule(name=lbl) for lbl in
+                     ("business", "law", "health", "computer science",
+                      "other")],
+            fact_check=[NamedRule(name="fact_check")],
+            user_feedbacks=[NamedRule(name="positive"),
+                            NamedRule(name="negative")]))
+    explainer = DecisionExplainer(ring_size=256)
+    router = Router(cfg, engine=engine,
+                    metrics=MetricSeries(MetricsRegistry()),
+                    tracer=Tracer(sample_rate=0.0),
+                    flightrec=FlightRecorder(), explain=explainer)
+    try:
+        texts = [f"benchmark request number {i} about contract law"
+                 for i in range(16)]
+
+        def body(i: int) -> dict:
+            return {"model": "auto", "messages": [
+                {"role": "user", "content": texts[i % len(texts)]}]}
+
+        def run(enabled: bool, n: int) -> float:
+            explainer.enabled = enabled
+            explainer.sample_rate = 1.0
+            t0 = _time.perf_counter()
+            for i in range(n):
+                router.route(body(i))
+            return n_tasks * n / (_time.perf_counter() - t0)
+
+        run(True, 10)  # warm jit cache + selector construction
+        off_rates, on_rates = [], []
+        for i in range(4):
+            order = (False, True) if i % 2 == 0 else (True, False)
+            for enabled in order:
+                (on_rates if enabled else off_rates).append(
+                    run(enabled, n_iters))
+        off, on = max(off_rates), max(on_rates)
+
+        # The e2e delta sits inside host scheduling noise, so also time
+        # the record path DIRECTLY on fixed inputs (begin → captures →
+        # finish → commit) and express it as a fraction of serving time
+        # at the measured route rate — the deterministic <1% number.
+        b = body(0)
+        signals, report = router.evaluate_signals(b)
+        trace = []
+        router.decision_engine.evaluate(signals, trace=trace)
+        explainer.enabled = True
+        trace_id = "ab" * 16
+        t0 = _time.perf_counter()
+        calls = 5000
+        for i in range(calls):
+            rec = explainer.begin(trace_id, "req")
+            rec.query = "benchmark request"
+            rec.capture_signals(signals, report, True)
+            rec.capture_rule_trace(trace)
+            record = rec.finish(kind="route", model="backend-model",
+                                latency_ms=1.0, query=rec.query,
+                                redact_pii=True, config_hash="")
+            explainer.commit(record)
+        record_ns = (_time.perf_counter() - t0) / calls * 1e9
+        routes_per_s = max(off, on) / n_tasks
+        hot_pct = record_ns * 1e-9 * routes_per_s * 100.0
+        return {
+            "engine_signals_per_s_explain_off": round(off, 1),
+            "engine_signals_per_s_explain_on": round(on, 1),
+            "explain_e2e_delta_pct": round(100.0 * (off - on) / off, 2),
+            "record_assembly_ns": round(record_ns, 1),
+            "explain_overhead_pct": round(hot_pct, 3),
+        }
+    finally:
+        router.shutdown()
+        engine.shutdown()
+
+
 def _measure_tracing_overhead(platform: str) -> dict:
     """signals/s through the tiny shared-trunk ENGINE (batcher + fused
     trunk group — the path batch tracing instruments) under three tracing
@@ -710,6 +816,18 @@ def _run_bench(platform: str) -> None:
         sys.stderr.write(f"bench: runtime-stats arm failed "
                          f"({type(exc).__name__}: {exc}); skipped\n")
 
+    # decision-record overhead arm (docs/OBSERVABILITY.md, ISSUE 4
+    # acceptance): recording at sample_rate=1.0 must cost <1% of the
+    # routing path — assembly is dict builds on the routing thread, the
+    # ring append is one lock.
+    explain_row = None
+    try:
+        explain_row = _measure_explain_overhead(platform)
+        sys.stderr.write(f"bench: explain overhead {explain_row}\n")
+    except Exception as exc:
+        sys.stderr.write(f"bench: explain arm failed "
+                         f"({type(exc).__name__}: {exc}); skipped\n")
+
     batch, signals_per_s, best_impl = best
     # On a CPU fallback the host geometry is the whole story (this image
     # exposes ONE 2.1GHz core — ~0.09 TFLOPs f32 roofline — while the
@@ -732,6 +850,8 @@ def _run_bench(platform: str) -> None:
         record["observability"] = obs_row
     if rs_row is not None:
         record["runtime_stats"] = rs_row
+    if explain_row is not None:
+        record["explain"] = explain_row
     if platform != "cpu":
         # side evidence for the bench README / judge: full sweep detail
         try:

@@ -1225,6 +1225,33 @@ class RouterConfig:
         absent block = no objectives = monitor disabled."""
         return dict((self.observability or {}).get("slo", {}) or {})
 
+    def decision_explain_config(self) -> Dict[str, Any]:
+        """Normalized observability.decisions block — the per-request
+        decision-record knobs (observability/explain.py):
+
+          observability:
+            decisions:
+              enabled: true      # assemble + ring decision records
+              ring_size: 512     # bounded in-process record ring
+              sample_rate: 1.0   # deterministic per trace id
+              redact_pii: true   # drop query text + pii details
+
+        Malformed values fall back to the defaults (telemetry config is
+        never fatal)."""
+        d = (self.observability or {}).get("decisions", {}) or {}
+        out: Dict[str, Any] = {"enabled": bool(d.get("enabled", True)),
+                               "redact_pii": bool(d.get("redact_pii",
+                                                        True))}
+        try:
+            out["ring_size"] = int(d.get("ring_size", 512))
+        except (TypeError, ValueError):
+            out["ring_size"] = 512
+        try:
+            out["sample_rate"] = float(d.get("sample_rate", 1.0))
+        except (TypeError, ValueError):
+            out["sample_rate"] = 1.0
+        return out
+
     # -- recipes (pkg/config/recipes.go) -----------------------------------
 
     def recipe_by_name(self, name: str) -> Optional[RoutingRecipe]:

@@ -3,6 +3,7 @@ from .engine import (
     DecisionResult,
     DecisionTraceEntry,
     SignalMatches,
+    explain_rule_node,
 )
 from .projections import ProjectionEvaluator, ProjectionTrace
 
@@ -13,4 +14,5 @@ __all__ = [
     "ProjectionEvaluator",
     "ProjectionTrace",
     "SignalMatches",
+    "explain_rule_node",
 ]

@@ -85,6 +85,10 @@ class DecisionTraceEntry:
     matched: bool
     confidence: float
     matched_rules: List[str]
+    # full rule-evaluation tree (explain_rule_node) — every node's
+    # outcome, not just the winner's matched leaves; None when the
+    # caller asked for the cheap trace only
+    tree: Optional[dict] = None
 
 
 def eval_rule_node(node: RuleNode, signals: SignalMatches
@@ -130,6 +134,45 @@ def eval_rule_node(node: RuleNode, signals: SignalMatches
     return matched, best, rules
 
 
+def explain_rule_node(node: RuleNode, signals: SignalMatches) -> dict:
+    """Full-fidelity rule-tree evaluation: same (matched, confidence,
+    matched_rules) result as ``eval_rule_node`` but EVERY node's outcome
+    is captured — including the branches short-circuit evaluation never
+    visits (an AND's remaining children after a miss, a NOT's siblings
+    after a hit).  This is the audit view decision records store: an
+    operator reading "why not decision X" needs the failing leaf, which
+    the winner-only trace can't show."""
+    if node.is_leaf():
+        styp = node.signal_type.lower().strip()
+        matched = signals.matched(styp, node.name)
+        conf = signals.confidence(styp, node.name) if matched else 0.0
+        return {"node": "leaf", "signal": f"{styp}:{node.name}",
+                "matched": matched, "confidence": conf,
+                "matched_rules": [f"{styp}:{node.name}"] if matched
+                else []}
+    op = node.operator.upper()
+    if op not in ("AND", "NOT"):
+        op = "OR"
+    children = [explain_rule_node(c, signals) for c in node.conditions]
+    if op == "AND":
+        matched = bool(children) and all(c["matched"] for c in children)
+        conf = min((c["confidence"] for c in children), default=0.0) \
+            if matched else 0.0
+        rules = [r for c in children for r in c["matched_rules"]] \
+            if matched else []
+    elif op == "NOT":
+        matched = not any(c["matched"] for c in children)
+        conf = 1.0 if matched else 0.0
+        rules = []
+    else:  # OR
+        hit = [c for c in children if c["matched"]]
+        matched = bool(hit)
+        conf = max((c["confidence"] for c in hit), default=0.0)
+        rules = [r for c in hit for r in c["matched_rules"]]
+    return {"node": op.lower(), "matched": matched, "confidence": conf,
+            "matched_rules": rules, "children": children}
+
+
 class DecisionEngine:
     """Evaluates decisions over signal matches (reference engine.go:113)."""
 
@@ -147,9 +190,20 @@ class DecisionEngine:
         try:
             results: List[DecisionResult] = []
             for dec in self.decisions:
-                matched, conf, rules = self._eval_node(dec.rules, signals)
                 if trace is not None:
-                    trace.append(DecisionTraceEntry(dec.name, matched, conf, rules))
+                    # tracing callers get the FULL tree per decision —
+                    # one evaluation, the summary read off the root
+                    # (explain_rule_node matches eval_rule_node's result)
+                    tree = explain_rule_node(dec.rules, signals)
+                    matched, conf, rules = (tree["matched"],
+                                            tree["confidence"],
+                                            tree["matched_rules"])
+                    trace.append(DecisionTraceEntry(dec.name, matched,
+                                                    conf, rules,
+                                                    tree=tree))
+                else:
+                    matched, conf, rules = self._eval_node(dec.rules,
+                                                           signals)
                 if matched:
                     results.append(DecisionResult(dec, conf, rules))
             if not results:

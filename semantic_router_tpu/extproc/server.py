@@ -291,8 +291,19 @@ class ExtProcService:
         except ValueError:
             state.response_status = 200
         state.is_sse = "text/event-stream" in hdrs.get("content-type", "")
+        common = pb.CommonResponse(status=pb.CommonResponse.CONTINUE)
+        record_id = getattr(state.route, "decision_record_id", "") \
+            if state.route is not None else ""
+        if record_id:
+            # echo the routing audit record's id on the RESPONSE so a
+            # caller holding a completion can fetch the full decision
+            # chain at GET /debug/decisions/<id>
+            common = pb.CommonResponse(
+                status=pb.CommonResponse.CONTINUE,
+                header_mutation=_set_headers(
+                    {H.DECISION_RECORD: record_id}))
         resp = pb.ProcessingResponse(response_headers=pb.HeadersResponse(
-            response=pb.CommonResponse(status=pb.CommonResponse.CONTINUE)))
+            response=common))
         if state.is_sse:
             # Buffering an SSE stream would stall the client; switch the
             # response body to streamed pass-through (allow_mode_override)

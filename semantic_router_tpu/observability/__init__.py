@@ -1,9 +1,11 @@
 from . import metrics
+from .explain import DecisionExplainer, default_decision_explainer
 from .flightrec import FlightRecorder, default_flight_recorder
 from .logging import component_event, get_logger
 from .metrics import MetricsRegistry, default_registry
 from .tracing import Span, Tracer, active_span, default_tracer
 
-__all__ = ["FlightRecorder", "MetricsRegistry", "Span", "Tracer",
-           "active_span", "component_event", "default_flight_recorder",
+__all__ = ["DecisionExplainer", "FlightRecorder", "MetricsRegistry",
+           "Span", "Tracer", "active_span", "component_event",
+           "default_decision_explainer", "default_flight_recorder",
            "default_registry", "default_tracer", "get_logger", "metrics"]

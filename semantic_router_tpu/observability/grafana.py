@@ -264,6 +264,57 @@ def runtime_slo() -> Dict:
                       "SLO", p, tags=["runtime", "slo"])
 
 
+_DECISIONS_MD = """\
+Every routed request leaves a **decision record** — signals (value,
+source, latency), projections, the full rule-evaluation tree, the
+per-candidate selector scores, plugin verdicts, and the final model
+with its fallback reason:
+
+- `GET /debug/decisions` — filtered listing (`?model=` / `?decision=` /
+  `?rule=` / `?family=`)
+- `GET /debug/decisions/<id>` — one record, by record id (echoed on
+  responses as `x-vsr-decision-record`) or trace id
+- `POST /debug/decisions/<id>/replay` — deterministically re-drive the
+  decision engine over the stored signals; pass `{"config": {...}}` for
+  the counterfactual ("would config v2 have routed this differently?")
+
+Records cross-link to the flight recorder and batch-trace spans via the
+trace id, and export as OTLP log records when `otlp_endpoint` is set.
+See docs/OBSERVABILITY.md § Decision explainability.
+"""
+
+
+def decisions() -> Dict:
+    """The "Decisions" dashboard (ISSUE 4): routing mix, fallback rate,
+    rule-hit frequencies, record-ring accounting, and a link panel into
+    the decision-record debug endpoints."""
+    p = [
+        _panel("Routing mix (requests by decision)",
+               ["sum(rate(llm_model_requests_total[5m])) by (decision)"],
+               panel_id=1, x=0, y=0, legends=["{{decision}}"]),
+        _panel("Routing mix (requests by model)",
+               ["sum(rate(llm_model_requests_total[5m])) by (model)"],
+               panel_id=2, x=12, y=0, legends=["{{model}}"]),
+        _stat("Fallback rate",
+              "(sum(rate(llm_decision_fallbacks_total[5m])) or vector(0))"
+              " / sum(rate(llm_model_requests_total[5m]))",
+              unit="percentunit", panel_id=3, x=0, y=8),
+        _panel("Fallbacks by reason",
+               ["sum(rate(llm_decision_fallbacks_total[5m])) by (reason)"],
+               panel_id=4, x=6, y=8, w=6, legends=["{{reason}}"]),
+        _panel("Rule-hit frequencies",
+               ["sum(rate(llm_decision_rule_hits_total[5m])) by (rule)"],
+               panel_id=5, x=12, y=8, legends=["{{rule}}"]),
+        _panel("Decision records committed",
+               ["sum(rate(llm_decision_records_total[5m])) by (kind)"],
+               panel_id=6, x=0, y=16, legends=["{{kind}}"]),
+        _text_panel("Decision explainability", _DECISIONS_MD,
+                    panel_id=7, x=12, y=16),
+    ]
+    return _dashboard("srt-decisions", "Semantic Router — Decisions", p,
+                      tags=["decisions", "explainability"])
+
+
 def catalog(registry=None) -> Dict:
     """Auto-generated dashboard: one panel per registered series —
     anything new in the registry shows up here without template edits."""
@@ -316,6 +367,7 @@ def render_all(out_dir: str, registry=None) -> List[str]:
         "safety.json": safety(),
         "serving.json": serving(),
         "runtime_slo.json": runtime_slo(),
+        "decisions.json": decisions(),
         "metric_catalog.json": catalog(registry),
     }
     for fname, dash in dashboards.items():

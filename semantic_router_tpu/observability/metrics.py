@@ -150,18 +150,59 @@ class Histogram:
     def count(self, **labels: str) -> int:
         return self._totals.get(_label_key(labels), 0)
 
-    def le_total(self, value: float) -> Tuple[int, int]:
-        """(observations ≤ the largest bucket edge not above ``value``,
-        total observations) across ALL label sets — the streaming
-        SLI read the in-process SLO monitor evaluates burn rates from.
-        A threshold between bucket edges rounds DOWN (conservative: some
-        good events count as bad, never the reverse)."""
+    def add_bucket_edge(self, edge: float) -> bool:
+        """Insert an exact bucket edge (objective-aware buckets: a
+        ``p99 < 25ms`` SLO gets a 25ms edge instead of rounding down to
+        the nearest existing one).  Past observations in the straddling
+        bucket stay in its upper half (they keep counting as "bad" for a
+        threshold at the new edge — conservative, consistent with
+        ``le_total``'s round-down); only new observations split exactly.
+        Returns True when the edge was inserted, False when it already
+        existed."""
         import bisect
 
-        k = bisect.bisect_right(self.buckets, value)  # buckets[:k] ≤ value
+        edge = float(edge)
         with self._lock:
-            total = sum(self._totals.values())
-            good = sum(sum(counts[:k]) for counts in self._counts.values())
+            if edge in self.buckets:
+                return False
+            i = bisect.bisect_left(self.buckets, edge)
+            self.buckets.insert(i, edge)
+            for counts in self._counts.values():
+                counts.insert(i, 0)
+            # exemplars are keyed by bucket index: shift the ones at or
+            # above the insertion point so they keep matching exposition
+            for per_key in self._exemplars.values():
+                for idx in sorted((x for x in per_key if x >= i),
+                                  reverse=True):
+                    per_key[idx + 1] = per_key.pop(idx)
+            return True
+
+    def le_total(self, value: float,
+                 labels: Optional[Dict[str, str]] = None
+                 ) -> Tuple[int, int]:
+        """(observations ≤ the largest bucket edge not above ``value``,
+        total observations) — the streaming SLI read the in-process SLO
+        monitor evaluates burn rates from.  Sums across ALL label sets
+        by default; ``labels`` restricts to sets carrying every given
+        (k, v) pair (per-model SLO objectives).  A threshold between
+        bucket edges rounds DOWN (conservative: some good events count
+        as bad, never the reverse)."""
+        import bisect
+
+        want = set((labels or {}).items())
+        with self._lock:
+            # index computed INSIDE the lock: add_bucket_edge can
+            # mutate self.buckets concurrently (objective-aware edges)
+            k = bisect.bisect_right(self.buckets, value)  # [:k] ≤ value
+            if not want:
+                total = sum(self._totals.values())
+                good = sum(sum(counts[:k])
+                           for counts in self._counts.values())
+            else:
+                keys = [key for key in self._counts
+                        if want <= set(key)]
+                total = sum(self._totals.get(key, 0) for key in keys)
+                good = sum(sum(self._counts[key][:k]) for key in keys)
         return good, total
 
     def totals(self) -> Dict[tuple, int]:
@@ -364,6 +405,21 @@ class MetricSeries:
             "llm_decision_matches_total", "Decision matches by name")
         self.decision_latency = registry.histogram(
             "llm_decision_evaluation_seconds", "Decision engine latency")
+        # decision explainability (observability/explain.py): the
+        # "Decisions" dashboard row reads these — routing mix comes from
+        # llm_model_requests_total{decision}, these add the fallback and
+        # rule-frequency views plus the record-ring accounting
+        self.decision_fallbacks = registry.counter(
+            "llm_decision_fallbacks_total",
+            "Requests that fell back from the primary routing path, "
+            "by reason (no_decision_matched, selector_error)")
+        self.rule_hits = registry.counter(
+            "llm_decision_rule_hits_total",
+            "Winning-decision matched rules by type:name — the rule-hit "
+            "frequency surface (bounded by configured rules)")
+        self.decision_records = registry.counter(
+            "llm_decision_records_total",
+            "Decision records committed to the explain ring, by kind")
         self.batch_size = registry.histogram(
             "llm_classifier_batch_size", "Device batch sizes",
             buckets=(1, 2, 4, 8, 16, 32, 64))
@@ -418,6 +474,9 @@ signal_latency = default_series.signal_latency
 signal_errors = default_series.signal_errors
 decision_matches = default_series.decision_matches
 decision_latency = default_series.decision_latency
+decision_fallbacks = default_series.decision_fallbacks
+rule_hits = default_series.rule_hits
+decision_records = default_series.decision_records
 batch_size = default_series.batch_size
 truncated_inputs = default_series.truncated_inputs
 backend_failovers = default_series.backend_failovers

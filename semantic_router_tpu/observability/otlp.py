@@ -65,10 +65,16 @@ def build_payload(spans: List[Span],
     }]}
 
 
-class OTLPExporter:
-    """Attachable span sink: ``exporter.attach(tracer)`` registers it;
-    spans batch in memory and flush every ``flush_interval_s`` or at
-    ``max_batch`` pressure."""
+class _BatchingExporter:
+    """Shared OTLP/HTTP batching machinery: bounded in-memory buffer,
+    daemon flusher woken on pressure, drop-after-retries posture —
+    telemetry export must never block or destabilize the data plane.
+    Subclasses set ``_url_path``/``_event_name``/``_thread_name`` and
+    implement ``_build_payload(batch)``."""
+
+    _url_path = "/"
+    _event_name = "export_failed"
+    _thread_name = "otlp-exporter"
 
     def __init__(self, endpoint: str,
                  headers: Optional[Dict[str, str]] = None,
@@ -84,7 +90,7 @@ class OTLPExporter:
         self.max_batch = max_batch
         self.max_buffer = max_buffer
         self.timeout_s = timeout_s
-        self._buffer: List[Span] = []
+        self._buffer: List = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._wake = threading.Event()
@@ -92,35 +98,31 @@ class OTLPExporter:
         self.exported = 0
         self.dropped = 0
 
+    def _build_payload(self, batch: List) -> Dict:
+        raise NotImplementedError
+
     # -- sink ------------------------------------------------------------
 
-    def __call__(self, span: Span) -> None:
+    def __call__(self, item) -> None:
         with self._lock:
-            self._buffer.append(span)
+            self._buffer.append(item)
             if len(self._buffer) > self.max_buffer:
-                # bounded memory: oldest spans drop first
+                # bounded memory: oldest items drop first
                 overflow = len(self._buffer) - self.max_buffer
                 del self._buffer[:overflow]
                 self.dropped += overflow
             pressure = len(self._buffer) >= self.max_batch
         if pressure:
             # wake the daemon flusher; flushing INLINE here would put
-            # network I/O (up to 2×timeout) on the span-ending request
-            # thread — tracing must never block the data plane
+            # network I/O (up to 2×timeout) on the emitting request
+            # thread
             self._wake.set()
 
-    def attach(self, tracer: Tracer) -> "OTLPExporter":
-        tracer.add_sink(self)
+    def _start_thread(self) -> None:
         if self._thread is None:
             self._thread = threading.Thread(target=self._loop, daemon=True,
-                                            name="otlp-exporter")
+                                            name=self._thread_name)
             self._thread.start()
-        return self
-
-    def detach(self, tracer: Tracer) -> None:
-        tracer.remove_sink(self)
-        self._stop.set()
-        self._wake.set()  # unblock the flusher so it exits promptly
 
     # -- flushing --------------------------------------------------------
 
@@ -147,9 +149,9 @@ class OTLPExporter:
                 self._buffer[self.max_batch:]
         if not batch:
             return 0
-        payload = json.dumps(build_payload(batch, self.service_name))
+        payload = json.dumps(self._build_payload(batch))
         req = urllib.request.Request(
-            self.endpoint + "/v1/traces", data=payload.encode(),
+            self.endpoint + self._url_path, data=payload.encode(),
             method="POST")
         req.add_header("content-type", "application/json")
         for k, v in self.headers.items():
@@ -163,12 +165,35 @@ class OTLPExporter:
             except Exception as exc:
                 if attempt == 1:
                     self.dropped += len(batch)
-                    component_event("otlp", "export_failed",
+                    component_event("otlp", self._event_name,
                                     error=str(exc)[:200],
                                     dropped=len(batch), level="warning")
                 else:
                     time.sleep(0.2)
         return 0
+
+
+class OTLPExporter(_BatchingExporter):
+    """Attachable span sink: ``exporter.attach(tracer)`` registers it;
+    spans batch in memory and flush every ``flush_interval_s`` or at
+    ``max_batch`` pressure."""
+
+    _url_path = "/v1/traces"
+    _event_name = "export_failed"
+    _thread_name = "otlp-exporter"
+
+    def _build_payload(self, batch: List[Span]) -> Dict:
+        return build_payload(batch, self.service_name)
+
+    def attach(self, tracer: Tracer) -> "OTLPExporter":
+        tracer.add_sink(self)
+        self._start_thread()
+        return self
+
+    def detach(self, tracer: Tracer) -> None:
+        tracer.remove_sink(self)
+        self._stop.set()
+        self._wake.set()  # unblock the flusher so it exits promptly
 
 
 def build_exporter_from_config(obs_cfg: Dict,
@@ -185,3 +210,102 @@ def build_exporter_from_config(obs_cfg: Dict,
         service_name=tr.get("service_name", "semantic-router-tpu"),
         flush_interval_s=float(tr.get("flush_interval_s", 5.0)))
     return exporter.attach(tracer)
+
+
+# ---------------------------------------------------------------------------
+# OTLP log records: decision-record export (observability/explain.py)
+
+
+def record_to_otlp_log(record: Dict) -> Dict:
+    """One decision record as an OTLP logRecord: the canonical JSON is
+    the body (audit pipelines parse it), the filterable dimensions ride
+    as attributes, and the trace id links the log to the request's
+    spans in any OTLP backend."""
+    from .explain import record_to_json
+
+    decision = (record.get("decision") or {}).get("name", "")
+    out = {
+        "timeUnixNano": str(int(record.get("ts_unix", time.time()) * 1e9)),
+        "severityNumber": 9,  # SEVERITY_NUMBER_INFO
+        "severityText": "INFO",
+        "body": {"stringValue": record_to_json(record)},
+        "attributes": [
+            {"key": "event.name",
+             "value": {"stringValue": "router.decision"}},
+            {"key": "decision", "value": {"stringValue": decision}},
+            {"key": "model",
+             "value": {"stringValue": record.get("model", "")}},
+            {"key": "kind",
+             "value": {"stringValue": record.get("kind", "")}},
+            {"key": "record_id",
+             "value": {"stringValue": record.get("record_id", "")}},
+        ],
+    }
+    trace_id = record.get("trace_id", "")
+    if trace_id:
+        out["traceId"] = trace_id
+    return out
+
+
+def build_log_payload(records: List[Dict],
+                      service_name: str = "semantic-router-tpu") -> Dict:
+    return {"resourceLogs": [{
+        "resource": {"attributes": [
+            {"key": "service.name",
+             "value": {"stringValue": service_name}}]},
+        "scopeLogs": [{
+            "scope": {"name": "semantic_router_tpu"},
+            "logRecords": [record_to_otlp_log(r) for r in records],
+        }],
+    }]}
+
+
+class OTLPLogExporter(_BatchingExporter):
+    """Decision-record sink → OTLP/HTTP JSON ``/v1/logs``.  Same bounded
+    buffer + daemon flusher + drop-after-retries posture as the span
+    exporter (shared _BatchingExporter): audit export must never block
+    or destabilize routing."""
+
+    _url_path = "/v1/logs"
+    _event_name = "log_export_failed"
+    _thread_name = "otlp-log-exporter"
+
+    def __init__(self, endpoint: str, max_batch: int = 64,
+                 max_buffer: int = 1024, **kwargs) -> None:
+        super().__init__(endpoint, max_batch=max_batch,
+                         max_buffer=max_buffer, **kwargs)
+
+    def _build_payload(self, batch: List[Dict]) -> Dict:
+        return build_log_payload(batch, self.service_name)
+
+    def attach(self, explainer) -> "OTLPLogExporter":
+        explainer.sinks.append(self)
+        self._start_thread()
+        return self
+
+    def detach(self, explainer) -> None:
+        try:
+            explainer.sinks.remove(self)
+        except ValueError:
+            pass
+        self._stop.set()
+        self._wake.set()
+
+
+def build_log_exporter_from_config(obs_cfg: Dict, explainer
+                                   ) -> Optional[OTLPLogExporter]:
+    """Decision records export to the SAME collector endpoint the spans
+    use (observability.tracing.otlp_endpoint → ``/v1/logs``); absent
+    endpoint or explainer → records stay in-proc only."""
+    if explainer is None:
+        return None
+    tr = (obs_cfg or {}).get("tracing", {}) or {}
+    endpoint = tr.get("otlp_endpoint", "")
+    if not endpoint:
+        return None
+    exporter = OTLPLogExporter(
+        endpoint,
+        headers=tr.get("otlp_headers"),
+        service_name=tr.get("service_name", "semantic-router-tpu"),
+        flush_interval_s=float(tr.get("flush_interval_s", 5.0)))
+    return exporter.attach(explainer)

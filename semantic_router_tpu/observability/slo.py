@@ -73,9 +73,27 @@ _DUR_MULT = {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0,
              None: 1.0}
 
 _LATENCY_RE = re.compile(
-    r"^\s*(?P<metric>[\w.]+)\s+p(?P<pct>[\d.]+)\s*<\s*"
+    r"^\s*(?P<metric>[\w.]+)\s*(?:\{(?P<sel>[^}]*)\})?"
+    r"\s+p(?P<pct>[\d.]+)\s*<\s*"
     r"(?P<thresh>[\d.]+\s*(?:ms|s|m)?)\s*(?:over\s+(?P<win>[\w.]+))?\s*$",
     re.IGNORECASE)
+# one {label=value} member (quotes optional): per-model objectives like
+# routing_latency{model=qwen3-8b} p99 < 25ms
+_SEL_MEMBER_RE = re.compile(r'\s*(?P<k>\w+)\s*=\s*"?(?P<v>[^,"]*)"?\s*$')
+
+
+def _parse_selector(raw: Optional[str]) -> Dict[str, str]:
+    """``model=qwen3-8b, tier=premium`` → label dict; malformed members
+    raise (the objective is then skipped + reported, never fatal)."""
+    out: Dict[str, str] = {}
+    for member in (raw or "").split(","):
+        if not member.strip():
+            continue
+        m = _SEL_MEMBER_RE.match(member)
+        if not m or not m.group("v"):
+            raise ValueError(f"bad label selector member {member!r}")
+        out[m.group("k")] = m.group("v").strip()
+    return out
 _RATIO_RE = re.compile(
     r"^\s*(?P<metric>[\w.-]+?)\s+error[-_ ]?rate\s*<\s*"
     r"(?P<budget>[\d.]+)\s*%\s*(?:over\s+(?P<win>[\w.]+))?\s*$",
@@ -107,6 +125,10 @@ class SLOObjective:
     total_metric: str = ""    # ratio: denominator series
     window_s: float = 300.0   # the "over" clause — the fast short window
     raw: str = ""             # original expression (reports)
+    # label selector (latency objectives): restrict the histogram read
+    # to label sets carrying every pair — per-model SLOs like
+    # routing_latency{model=qwen3-8b} p99 < 25ms
+    labels: Dict[str, str] = field(default_factory=dict)
 
     def describe(self) -> Dict[str, Any]:
         d = {"name": self.name, "kind": self.kind, "metric": self.metric,
@@ -116,7 +138,16 @@ class SLOObjective:
             d["threshold_s"] = self.threshold_s
         else:
             d["total_metric"] = self.total_metric
+        if self.labels:
+            d["labels"] = dict(self.labels)
         return d
+
+    def gauge_labels(self) -> Dict[str, str]:
+        """The selector pairs as extra gauge labels on the llm_slo_*
+        reads ("label the burn-rate reads accordingly"); reserved keys
+        never collide with the monitor's own."""
+        return {k: v for k, v in self.labels.items()
+                if k not in ("objective", "window", "severity")}
 
 
 def parse_objective(spec: Any) -> SLOObjective:
@@ -139,7 +170,9 @@ def parse_objective(spec: Any) -> SLOObjective:
                     name or f"{metric}_latency", "latency", metric,
                     budget,
                     threshold_s=parse_duration_s(spec["threshold"]),
-                    window_s=window_s, raw=repr(spec))
+                    window_s=window_s, raw=repr(spec),
+                    labels={str(k): str(v) for k, v in
+                            (spec.get("labels", {}) or {}).items()})
             return SLOObjective(
                 name or f"{metric}_ratio", "ratio", metric,
                 float(spec["budget"]),
@@ -157,12 +190,16 @@ def parse_objective(spec: Any) -> SLOObjective:
         pct = float(m.group("pct"))
         if not 0.0 < pct < 100.0:
             raise ValueError(f"bad percentile p{pct} in {expr!r}")
+        labels = _parse_selector(m.group("sel"))
+        sel_suffix = "".join(f"[{k}={v}]"
+                             for k, v in sorted(labels.items()))
         return SLOObjective(
-            name or f"{alias}_p{m.group('pct')}", "latency", metric,
+            name or f"{alias}{sel_suffix}_p{m.group('pct')}",
+            "latency", metric,
             budget=1.0 - pct / 100.0,
             threshold_s=parse_duration_s(m.group("thresh")),
             window_s=parse_duration_s(m.group("win"), 300.0),
-            raw=expr)
+            raw=expr, labels=labels)
     m = _RATIO_RE.match(expr)
     if m:
         alias = m.group("metric")
@@ -220,6 +257,11 @@ class SLOMonitor:
         self._stop = threading.Event()
         self.config_errors: List[str] = []
         self._last_tick_t = float("-inf")
+        # runtime-event export (runtime/events.py): alert transitions
+        # emit slo_alert_firing / slo_alert_resolved so the kube
+        # operator can REACT (shed traffic / scale), not just report;
+        # wired by bootstrap to the registry's bus
+        self.event_bus = None
         # snapshot rings are bounded by the 72w horizon AND by count:
         # an aggressive scraper ticking inline must not grow them (and
         # the O(ring) window scans) without bound
@@ -251,7 +293,8 @@ class SLOMonitor:
             except (ValueError, KeyError, TypeError) as exc:
                 errors.append(f"{spec!r}: {exc}")
         with self._lock:
-            old_names = {o.name for o in self.objectives}
+            old_by_name = {o.name: o for o in self.objectives}
+            old_names = set(old_by_name)
             self.enabled = bool(slo_cfg.get("enabled", True)) \
                 and bool(objectives)
             self.evaluation_interval_s = max(0.05, float(
@@ -271,17 +314,30 @@ class SLOMonitor:
             for name in list(self._alerts):
                 if name not in keep:
                     del self._alerts[name]
-        # zero the firing gauge for every name that stops being ticked
+        # zero the firing gauge for every series that stops being ticked
         # (renamed/removed objectives, or everything when disabled):
         # the Gauge has no series-removal API, so a latched 1.0 would
         # page forever while /health reports healthy
-        self._zero_alert_gauges(old_names - keep
-                                | ({o.name for o in objectives} - keep))
+        stale = old_names - keep | ({o.name for o in objectives} - keep)
+        by_name = {**old_by_name, **{o.name: o for o in objectives}}
+        self._zero_alert_gauges(stale, by_name)
+        # an objective that KEEPS its name but changes its label
+        # selector stops writing the old labeled series — zero those
+        # too, or the old labels' firing gauge latches forever
+        new_by_name = {o.name: o for o in objectives}
+        for name in keep & old_names:
+            old_obj, new_obj = old_by_name[name], new_by_name.get(name)
+            if new_obj is not None and \
+                    old_obj.gauge_labels() != new_obj.gauge_labels():
+                self._zero_alert_gauges([name], {name: old_obj})
 
-    def _zero_alert_gauges(self, names) -> None:
+    def _zero_alert_gauges(self, names, by_name=None) -> None:
         for name in names:
+            obj = (by_name or {}).get(name)
+            extra = obj.gauge_labels() if obj is not None else {}
             for sev in ("fast", "slow"):
-                self.alert_gauge.set(0.0, objective=name, severity=sev)
+                self.alert_gauge.set(0.0, objective=name, severity=sev,
+                                     **extra)
 
     def windows_for(self, obj: SLOObjective) -> Dict[str, Any]:
         """The objective's four evaluation windows, derived from its base
@@ -303,7 +359,22 @@ class SLOMonitor:
             h = find(obj.metric)
             if h is None or not hasattr(h, "le_total"):
                 return 0.0, 0.0
-            good, total = h.le_total(obj.threshold_s)
+            # objective-aware buckets: a 25ms bound gets an EXACT 25ms
+            # edge instead of rounding down to the nearest existing one
+            # (lazy — the histogram may be created after configure();
+            # idempotent and cheap once the edge exists)
+            add_edge = getattr(h, "add_bucket_edge", None)
+            if add_edge is not None \
+                    and obj.threshold_s not in getattr(h, "buckets", ()):
+                try:
+                    add_edge(obj.threshold_s)
+                except Exception:
+                    pass
+            try:
+                good, total = h.le_total(obj.threshold_s,
+                                         labels=obj.labels or None)
+            except TypeError:  # histogram without label filtering
+                good, total = h.le_total(obj.threshold_s)
             return float(good), float(total - good)
         bad_m = find(obj.metric)
         total_m = find(obj.total_metric)
@@ -382,21 +453,58 @@ class SLOMonitor:
                         firing = firing or sev
                 _, frac = self._burn_over(ring, now, obj.window_s,
                                           obj.budget)
+                was_firing, was_severity = state.firing, state.severity
                 if firing and not state.firing:
                     state.since_unix = time.time()
                 state.firing = bool(firing)
                 state.severity = firing
                 state.burn = burns
+            # per-objective selector labels ride every llm_slo_* read
+            # (per-model objectives stay distinguishable in PromQL)
+            extra = obj.gauge_labels()
             for key, b in burns.items():
                 self.burn_gauge.set(round(b, 4), objective=obj.name,
-                                    window=key)
+                                    window=key, **extra)
             # write EVERY severity series each tick: gauges keyed on a
             # mutable label would otherwise latch the old severity at
             # 1.0 after the alert clears or changes severity
             for sev in ("fast", "slow"):
                 self.alert_gauge.set(1.0 if firing == sev else 0.0,
-                                     objective=obj.name, severity=sev)
-            self.sli_gauge.set(round(1.0 - frac, 6), objective=obj.name)
+                                     objective=obj.name, severity=sev,
+                                     **extra)
+            self.sli_gauge.set(round(1.0 - frac, 6), objective=obj.name,
+                               **extra)
+            # alert transitions → runtime events (outside the monitor
+            # lock: subscribers may call back into the monitor)
+            if firing != was_severity or bool(firing) != was_firing:
+                self._emit_alert_event(obj, firing, was_firing, burns)
+
+    def _emit_alert_event(self, obj: SLOObjective, firing: str,
+                          was_firing: bool,
+                          burns: Dict[str, float]) -> None:
+        """Export an alert transition as a runtime lifecycle event so
+        operators (kubewatch) can shed traffic or scale.  Emission must
+        never hurt the monitor — failures are swallowed."""
+        bus = self.event_bus
+        if bus is None:
+            return
+        try:
+            from ..runtime.events import (
+                SLO_ALERT_FIRING,
+                SLO_ALERT_RESOLVED,
+            )
+
+            if firing:
+                bus.emit(SLO_ALERT_FIRING, objective=obj.name,
+                         severity=firing, labels=dict(obj.labels),
+                         burn_rates={k: round(v, 4)
+                                     for k, v in burns.items()},
+                         objective_raw=obj.raw)
+            elif was_firing:
+                bus.emit(SLO_ALERT_RESOLVED, objective=obj.name,
+                         labels=dict(obj.labels))
+        except Exception:
+            pass
 
     # -- reads -------------------------------------------------------------
 

@@ -1,3 +1,11 @@
-from .recorder import ReplayRecord, ReplayRecorder, ReplayStore
+from .recorder import (
+    ReplayRecord,
+    ReplayRecorder,
+    ReplayStore,
+    replay_decision,
+    replay_diff,
+    signal_matches_from_record,
+)
 
-__all__ = ["ReplayRecord", "ReplayRecorder", "ReplayStore"]
+__all__ = ["ReplayRecord", "ReplayRecorder", "ReplayStore",
+           "replay_decision", "replay_diff", "signal_matches_from_record"]

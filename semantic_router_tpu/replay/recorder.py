@@ -35,6 +35,9 @@ class ReplayRecord:
     response_excerpt: str = ""
     cost: float = 0.0
     tool_trace: List[dict] = field(default_factory=list)
+    # cross-link into the explain ring (observability/explain.py): the
+    # full audit record for this routed request, when one was sampled
+    decision_record_id: str = ""
 
 
 class ReplayStore:
@@ -132,5 +135,141 @@ class ReplayRecorder:
                           if self.capture_request_body and route.body
                           else None),
             response_excerpt=excerpt,
+            decision_record_id=getattr(route, "decision_record_id", ""),
         )
         self.store.add(record)
+
+
+# ---------------------------------------------------------------------------
+# decision re-drive (the replay-grade half of observability/explain.py)
+
+
+def signal_matches_from_record(record: Dict[str, Any]):
+    """Rebuild the exact SignalMatches the decision engine saw from a
+    decision record's ``replay`` block — the input that makes offline
+    re-drives deterministic."""
+    from ..decision.engine import SignalMatches
+
+    payload = record.get("replay", {}) or {}
+    sm = SignalMatches(
+        matches={k: list(v) for k, v in
+                 (payload.get("matches", {}) or {}).items()},
+        confidences={k: float(v) for k, v in
+                     (payload.get("confidences", {}) or {}).items()},
+        details={k: dict(v) for k, v in
+                 (payload.get("details", {}) or {}).items()},
+    )
+    return sm
+
+
+def replay_decision(record: Dict[str, Any], cfg) -> Dict[str, Any]:
+    """Deterministically re-drive the decision engine over a stored
+    record's signals under ``cfg`` (a RouterConfig) — the counterfactual
+    primitive behind ``POST /debug/decisions/<id>/replay`` ("would
+    config v2 have routed this differently?").
+
+    The rule evaluation is exactly the live engine's (same
+    ``explain_rule_node`` path, full tree captured).  Model choice is
+    resolved WITHOUT live selector state or RNG:
+
+    - single candidate → that candidate;
+    - the new decision + candidate set identical to the recorded ones →
+      the recorded model (the live choice is the ground truth for an
+      unchanged config; online selector state is not replayable);
+    - otherwise → deterministic argmax over a fresh selector's
+      ``score_breakdown`` (falling back to highest weight).
+    """
+    from ..decision.engine import DecisionEngine, DecisionTraceEntry
+    from ..selection import SelectionContext, registry as selectors
+
+    sm = signal_matches_from_record(record)
+    engine = DecisionEngine(cfg.decisions, cfg.strategy)
+    trace: List[DecisionTraceEntry] = []
+    res = engine.evaluate(sm, trace=trace)
+
+    recorded_decision = (record.get("decision") or {})
+    out: Dict[str, Any] = {
+        "decision": res.decision.name if res else None,
+        "confidence": round(res.confidence, 6) if res else 0.0,
+        "matched_rules": list(res.matched_rules) if res else [],
+        "rule_trace": [
+            {"decision": e.decision, "matched": e.matched,
+             "confidence": round(e.confidence, 6),
+             "matched_rules": list(e.matched_rules), "tree": e.tree}
+            for e in trace],
+    }
+    if res is None:
+        out["model"] = cfg.default_model or record.get("model", "")
+        out["selection_basis"] = "no_decision_matched → default model"
+        return out
+
+    refs = res.decision.model_refs or []
+    algo = dict(res.decision.algorithm or {})
+    algo_type = str(algo.get("type", "static"))
+    candidates = [r.model for r in refs]
+    if len(refs) == 1:
+        out["model"] = refs[0].model
+        out["selection_basis"] = "single candidate"
+    elif res.decision.name == recorded_decision.get("name") \
+            and candidates == list(recorded_decision.get("candidates",
+                                                         [])):
+        out["model"] = record.get("model", "")
+        out["selection_basis"] = ("recorded choice (identical decision "
+                                  "+ candidate set)")
+    else:
+        model, basis = _deterministic_choice(record, res.decision, refs,
+                                             algo, algo_type, cfg,
+                                             selectors, SelectionContext,
+                                             sm)
+        out["model"] = model
+        out["selection_basis"] = basis
+    out["candidates"] = candidates
+    return out
+
+
+def _deterministic_choice(record, decision, refs, algo, algo_type, cfg,
+                          selectors, SelectionContext, sm):
+    """Stateless argmax over a fresh selector's score_breakdown; weight
+    argmax when the algorithm can't break down."""
+    try:
+        kwargs = {k: v for k, v in algo.items()
+                  if k not in ("type", "on_error", "artifact")}
+        selector = selectors.create(algo_type, **kwargs)
+    except Exception:
+        selector = None
+    fn = getattr(selector, "score_breakdown", None)
+    if fn is not None:
+        try:
+            cards = {m.name: m for m in cfg.model_cards}
+            sctx = SelectionContext(query=record.get("query", ""),
+                                    decision_name=decision.name,
+                                    signals=sm, model_cards=cards)
+            rows = fn(refs, sctx)
+            if rows:
+                best = max(rows, key=lambda r: r.get("score", 0.0))
+                return best["model"], \
+                    f"score_breakdown argmax ({algo_type})"
+        except Exception:
+            pass
+    best = max(refs, key=lambda r: r.weight)
+    return best.model, "highest weight"
+
+
+def replay_diff(record: Dict[str, Any],
+                replayed: Dict[str, Any]) -> Dict[str, Any]:
+    """Field-by-field outcome diff between a stored record and a
+    re-drive — what the counterfactual endpoint returns."""
+    recorded_decision = (record.get("decision") or {})
+    before = {
+        "decision": recorded_decision.get("name"),
+        "model": record.get("model", ""),
+        "matched_rules": recorded_decision.get("matched_rules", []),
+    }
+    after = {
+        "decision": replayed.get("decision"),
+        "model": replayed.get("model", ""),
+        "matched_rules": replayed.get("matched_rules", []),
+    }
+    changed = {k: {"recorded": before[k], "replayed": after[k]}
+               for k in before if before[k] != after[k]}
+    return {"identical": not changed, "changed": changed}

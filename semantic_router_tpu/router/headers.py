@@ -25,6 +25,10 @@ UNVERIFIED_FACTUAL = "x-vsr-unverified-factual"
 SKIP_PROCESSING = "x-vsr-skip-processing"
 LOOPER = "x-vsr-looper-request"
 MATCHED_RULES = "x-vsr-matched-rules"
+# decision-record id (observability/explain.py): echoed on responses so
+# a caller holding a response can fetch the full routing audit trail at
+# GET /debug/decisions/<id>
+DECISION_RECORD = "x-vsr-decision-record"
 
 
 def decision_headers(decision_name: str, model: str, category: str = "",

@@ -67,6 +67,10 @@ class RouteResult:
     # spans parent under a span that actually exists in the trace
     trace_id: str = ""
     root_span_id: str = ""
+    # decision-record id (observability/explain.py): set when this
+    # request's routing audit record landed in the explain ring; echoed
+    # to clients via the x-vsr-decision-record header
+    decision_record_id: str = ""
 
 
 @dataclass
@@ -111,7 +115,7 @@ class Router:
                  cache: Optional[CacheBackend] = None,
                  embedding_task: str = "embedding",
                  metrics: "Optional[M.MetricSeries]" = None,
-                 tracer=None, flightrec=None) -> None:
+                 tracer=None, flightrec=None, explain=None) -> None:
         self.cfg = cfg
         self.engine = engine
         self.embedding_task = embedding_task
@@ -140,6 +144,14 @@ class Router:
         if paired and getattr(self.flightrec, "on_retain", None) is None \
                 and hasattr(self.tracer, "force_sample"):
             self.flightrec.on_retain = self.tracer.force_sample
+        # decision explainability (observability/explain.py): per-request
+        # routing audit records; registry-bound when embedded, process
+        # default otherwise
+        from ..observability.explain import default_decision_explainer
+
+        self.explain = explain if explain is not None \
+            else default_decision_explainer
+        self._cfg_hash: Optional[str] = None  # lazy (record provenance)
 
         extra = []
         if engine is not None:
@@ -373,6 +385,14 @@ class Router:
                 pending_trace.parent_id
         else:
             trace_id, parent_span = self.tracer.extract(headers)
+        # decision-record draft: the sampling gate runs once here; every
+        # capture site downstream is a no-op when rec is None
+        rec = None
+        if self.explain is not None:
+            try:
+                rec = self.explain.begin(trace_id, request_id)
+            except Exception:
+                rec = None
         with self.tracer.span("router.route", trace_id=trace_id,
                               parent_id=parent_span,
                               request_id=request_id) as root:
@@ -381,13 +401,44 @@ class Router:
                 # opens (children read the parent id at creation time)
                 root.span_id = pending_trace.root_span_id
             result = self._route_impl(body, headers, request_id, trace_id,
-                                      start, precomputed_signals)
+                                      start, precomputed_signals, rec=rec)
             result.trace_id = trace_id
             result.root_span_id = root.span_id
             root.set(kind=result.kind, model=result.model)
+        self._commit_decision_record(rec, result)
         self._flight_record(result, trace_id, request_id,
                             time.perf_counter() - start)
         return result
+
+    def _config_hash(self) -> str:
+        if self._cfg_hash is None:
+            try:
+                from ..config.versions import config_hash
+
+                self._cfg_hash = config_hash(self.cfg.raw or {})
+            except Exception:
+                self._cfg_hash = ""
+        return self._cfg_hash
+
+    def _commit_decision_record(self, rec, result: RouteResult) -> None:
+        """Freeze + ring the request's decision record (fail open:
+        explainability must never hurt routing).  Passthrough and
+        rate-limited requests never reach the signal fan-out, so there
+        is nothing to explain — they are the only unrecorded kinds."""
+        if rec is None or result.kind in ("passthrough", "rate_limited"):
+            return
+        try:
+            record = rec.finish(
+                kind=result.kind, model=result.model,
+                latency_ms=result.routing_latency_s * 1e3,
+                query=rec.query,
+                redact_pii=self.explain.redact_pii,
+                config_hash=self._config_hash())
+            result.decision_record_id = self.explain.commit(record)
+            result.headers[H.DECISION_RECORD] = result.decision_record_id
+            self.M.decision_records.inc(kind=result.kind)
+        except Exception:
+            pass
 
     def _flight_record(self, result: RouteResult, trace_id: str,
                        request_id: str, duration_s: float) -> None:
@@ -410,7 +461,7 @@ class Router:
 
     def _route_impl(self, body: Dict[str, Any], headers: Dict[str, str],
                     request_id: str, trace_id: str, start: float,
-                    precomputed_signals=None) -> RouteResult:
+                    precomputed_signals=None, rec=None) -> RouteResult:
         ctx = RequestContext.from_openai_body(body, headers)
 
         # rate limit (processor_req_body_prepare.go:143-170) — runs BEFORE
@@ -459,10 +510,20 @@ class Router:
                 # fail-open families are an SLO input: the in-process
                 # monitor divides this by the evaluation count
                 self.M.signal_errors.inc(family=family)
+        if rec is not None:
+            rec.query = ctx.user_text
+            rec.capture_signals(signals, report, self.explain.redact_pii)
 
+        # explainability: the trace list makes the engine capture EVERY
+        # decision's full rule tree (decision.engine.explain_rule_node),
+        # one evaluation either way
+        decision_trace = [] if rec is not None else None
         with self.tracer.decision_span():
-            decision_res = decision_engine.evaluate(signals)
+            decision_res = decision_engine.evaluate(signals,
+                                                    trace=decision_trace)
         self.M.decision_latency.observe(decision_engine.last_eval_latency_s)
+        if rec is not None:
+            rec.capture_rule_trace(decision_trace)
 
         result = RouteResult(
             kind="route", request_id=request_id, signals=signals,
@@ -481,27 +542,40 @@ class Router:
                               H.MODEL: result.model,
                               H.REQUEST_ID: request_id}
             self._finalize_body(result, ctx, None)
+            self.M.decision_fallbacks.inc(reason="no_decision_matched")
+            if rec is not None:
+                rec.fallback_reason = "no_decision_matched"
             result.routing_latency_s = time.perf_counter() - start
             self.M.routing_latency.observe(result.routing_latency_s,
-                                           exemplar=trace_id)
+                                           exemplar=trace_id,
+                                           model=result.model)
             return result
 
         decision = decision_res.decision
         self.M.decision_matches.inc(name=decision.name)
+        for rule in decision_res.matched_rules:
+            # rule-hit frequency (Decisions dashboard row): bounded by
+            # the configured rule set
+            self.M.rule_hits.inc(rule=rule, decision=decision.name)
+        if rec is not None:
+            rec.capture_decision(decision_res, decision_engine.strategy)
 
         # -- pre-routing plugins ---------------------------------------
-        blocked = self._apply_policy_plugins(decision, signals, ctx, result)
+        blocked = self._apply_policy_plugins(decision, signals, ctx,
+                                             result, rec=rec)
         if blocked is not None:
             blocked.routing_latency_s = time.perf_counter() - start
             self.M.routing_latency.observe(blocked.routing_latency_s,
-                                           exemplar=trace_id)
+                                           exemplar=trace_id,
+                                           model=blocked.model)
             return blocked
 
-        cache_hit = self._check_cache(decision, ctx, result)
+        cache_hit = self._check_cache(decision, ctx, result, rec=rec)
         if cache_hit is not None:
             cache_hit.routing_latency_s = time.perf_counter() - start
             self.M.routing_latency.observe(cache_hit.routing_latency_s,
-                                           exemplar=trace_id)
+                                           exemplar=trace_id,
+                                           model=cache_hit.model)
             return cache_hit
 
         # -- selection --------------------------------------------------
@@ -525,6 +599,13 @@ class Router:
                     reason = f"{reason} → learning:{learned}"
         result.model = ref.model
         result.selection_reason = reason
+        if reason.startswith("selector error"):
+            self.M.decision_fallbacks.inc(reason="selector_error")
+            if rec is not None:
+                rec.fallback_reason = "selector_error"
+        if rec is not None:
+            self._capture_selection(rec, decision, ref, reason, ctx,
+                                    signals)
 
         algo = str(decision.algorithm.get("type", "static"))
         if algo in LOOPER_ALGORITHMS:
@@ -545,7 +626,8 @@ class Router:
         self.M.model_requests.inc(model=ref.model, decision=decision.name)
         result.routing_latency_s = time.perf_counter() - start
         self.M.routing_latency.observe(result.routing_latency_s,
-                                           exemplar=trace_id)
+                                       exemplar=trace_id,
+                                       model=ref.model)
         component_event("router", "routed", request_id=request_id,
                         decision=decision.name, model=ref.model,
                         latency_ms=round(result.routing_latency_s * 1e3, 2))
@@ -553,14 +635,58 @@ class Router:
 
     # -- plugin stages -----------------------------------------------------
 
+    def _capture_selection(self, rec, decision: Decision, ref: ModelRef,
+                           reason: str, ctx: RequestContext,
+                           signals: SignalMatches) -> None:
+        """Per-candidate score breakdown for the decision record (the
+        audit view of whichever selector ran).  Read-only and embed-free
+        — breakdown must never add device work to the hot path."""
+        try:
+            algo_type = str((decision.algorithm or {}).get("type",
+                                                           "static"))
+            refs = decision.model_refs or []
+            breakdown: List[dict] = []
+            if len(refs) <= 1:
+                breakdown = [{"model": r.model, "score": 1.0,
+                              "components": {"single_candidate": True}}
+                             for r in refs]
+            elif algo_type in LOOPER_ALGORITHMS:
+                breakdown = [{"model": r.model, "score": r.weight,
+                              "components": {"weight": r.weight,
+                                             "looper": algo_type}}
+                             for r in refs]
+            else:
+                selector = self._selectors.get(decision.name)
+                fn = getattr(selector, "score_breakdown", None)
+                if fn is not None:
+                    sctx = SelectionContext(
+                        query=ctx.user_text,
+                        decision_name=decision.name,
+                        category=next(iter(
+                            signals.matches.get("domain", ())), ""),
+                        session_id=ctx.headers.get("x-session-id", ""),
+                        user_id=ctx.user_id,
+                        signals=signals,
+                        token_count=ctx.approx_token_count(),
+                        model_cards=self.model_cards,
+                        embed_fn=None)
+                    breakdown = fn(refs, sctx)
+            rec.capture_selection(algo_type, reason, ref.model, breakdown)
+        except Exception:
+            rec.capture_selection("", reason, ref.model, [])
+
     def _apply_policy_plugins(self, decision: Decision,
                               signals: SignalMatches, ctx: RequestContext,
-                              result: RouteResult) -> Optional[RouteResult]:
+                              result: RouteResult,
+                              rec=None) -> Optional[RouteResult]:
         fast = decision.plugin("fast_response")
         if fast is not None and fast.enabled:
             content = fast.configuration.get(
                 "response", "Request handled by policy.")
             self.M.jailbreak_blocks.inc(decision=decision.name)
+            if rec is not None:
+                rec.capture_plugin("fast_response", "blocked",
+                                   decision=decision.name)
             return RouteResult(
                 kind="blocked", status=200, request_id=result.request_id,
                 decision=result.decision, signals=signals,
@@ -575,6 +701,9 @@ class Router:
             action = (pii_plugin.configuration.get("action", "header")
                       if pii_plugin else "header")
             if action == "block":
+                if rec is not None:
+                    rec.capture_plugin("pii", "blocked",
+                                       rules=list(pii_hits))
                 return RouteResult(
                     kind="blocked", status=403, request_id=result.request_id,
                     decision=result.decision, signals=signals,
@@ -583,10 +712,14 @@ class Router:
                         "type": "pii_policy_violation"}},
                     headers={H.PII_VIOLATION: ",".join(pii_hits)})
             result.headers[H.PII_VIOLATION] = ",".join(pii_hits)
+            if rec is not None:
+                rec.capture_plugin("pii", "annotated",
+                                   rules=list(pii_hits))
         return None
 
     def _check_cache(self, decision: Decision, ctx: RequestContext,
-                     result: RouteResult) -> Optional[RouteResult]:
+                     result: RouteResult, rec=None
+                     ) -> Optional[RouteResult]:
         plugin = decision.plugin("semantic-cache")
         if self.cache is None or plugin is None or not plugin.enabled:
             return None
@@ -597,11 +730,18 @@ class Router:
                 threshold=float(threshold) if threshold else None)
         except Exception:
             self.M.cache_lookups.inc(outcome="error")
+            if rec is not None:
+                rec.capture_plugin("semantic-cache", "error")
             return None
         if hit is None:
             self.M.cache_lookups.inc(outcome="miss")
+            if rec is not None:
+                rec.capture_plugin("semantic-cache", "miss")
             return None
         self.M.cache_lookups.inc(outcome="hit")
+        if rec is not None:
+            rec.capture_plugin("semantic-cache", "hit",
+                               model=hit.model or "cache")
         return RouteResult(
             kind="cache_hit", request_id=result.request_id,
             decision=result.decision, signals=result.signals,

@@ -88,6 +88,9 @@ API_CATALOG = {
         {"path": "/debug/flightrec/clear", "method": "POST"},
         {"path": "/debug/slo", "method": "GET"},
         {"path": "/debug/runtime", "method": "GET"},
+        {"path": "/debug/decisions", "method": "GET"},
+        {"path": "/debug/decisions/{id}", "method": "GET"},
+        {"path": "/debug/decisions/{id}/replay", "method": "POST"},
         {"path": "/info/models", "method": "GET"},
         {"path": "/config/router", "method": "GET"},
         {"path": "/config/router", "method": "PATCH"},
@@ -363,6 +366,16 @@ class RouterServer:
 
         return default_flight_recorder
 
+    def explainer(self):
+        """The registry-slotted decision explainer (process default when
+        the slot is empty) — shared by the /debug/decisions handlers."""
+        ex = self.registry.get("explain")
+        if ex is not None:
+            return ex
+        from ..observability.explain import default_decision_explainer
+
+        return default_decision_explainer
+
     def roles_for_key(self, presented: str) -> Optional[set]:
         """Constant-time scan of the configured API keys (the ONE place
         this comparison lives — _roles and the dashboard login both use
@@ -423,6 +436,11 @@ class RouterServer:
         exporter = getattr(self, "otlp_exporter", None)
         if exporter is not None:  # a leaked sink would double-export
             exporter.detach(self.registry.tracer)
+        log_exporter = getattr(self, "otlp_log_exporter", None)
+        if log_exporter is not None:
+            explainer = self.registry.get("explain")
+            if explainer is not None:
+                log_exporter.detach(explainer)
         self.router.shutdown()
 
     # ------------------------------------------------------------------
@@ -839,6 +857,35 @@ class RouterServer:
                         self._json(503, {"error": "no runtime stats"})
                     else:
                         self._json(200, rs.report())
+                elif path == "/debug/decisions":
+                    # decision-record listing, filterable by model /
+                    # decision / rule ("type:name") / signal family
+                    ex = server.explainer()
+                    q = self._query()
+                    try:
+                        limit = int(q.get("limit", "50") or 50)
+                    except ValueError:
+                        limit = 50
+                    self._json(200, {
+                        "stats": ex.stats(),
+                        "records": ex.list(
+                            limit=limit,
+                            model=q.get("model", ""),
+                            decision=q.get("decision", ""),
+                            rule=q.get("rule", ""),
+                            family=q.get("family", ""),
+                            kind=q.get("kind", ""))})
+                elif path.startswith("/debug/decisions/"):
+                    # one record by record id OR trace id — the full
+                    # signals → projections → rule tree → candidate
+                    # scores → final model chain
+                    key = path.rsplit("/", 1)[1]
+                    rec = server.explainer().get(key)
+                    if rec is None:
+                        self._json(404, {"error": "no decision record "
+                                                  f"for {key!r}"})
+                    else:
+                        self._json(200, rec)
                 elif path == "/config/router":
                     # secrets masked unless the key holds secret_view
                     # (management_api.go:67)
@@ -1093,6 +1140,56 @@ class RouterServer:
                             return
                         server.flightrec().clear()
                         self._json(200, {"ok": True})
+                    elif path.startswith("/debug/decisions/") \
+                            and path.endswith("/replay"):
+                        # counterfactual re-drive: stored signals →
+                        # decision engine under the live config (or a
+                        # candidate config in the body) → outcome diff.
+                        # Read-gated: replay computes, it mutates nothing.
+                        if self._authorize() is None:
+                            return
+                        key = path.split("/")[3]
+                        rec = server.explainer().get(key)
+                        if rec is None:
+                            self._json(404, {"error": "no decision "
+                                                      f"record for {key!r}"})
+                            return
+                        from ..config.schema import RouterConfig
+                        from ..replay import replay_decision, replay_diff
+
+                        cfg2 = server.cfg
+                        basis = "live config"
+                        if body.get("config"):
+                            try:
+                                # from_dict directly (no YAML round-trip
+                                # — same reasoning as dsl/decompile)
+                                cfg2 = RouterConfig.from_dict(
+                                    body["config"])
+                                basis = "candidate config"
+                            except Exception as exc:
+                                self._json(422, {
+                                    "error": f"bad config: {exc}"[:500]})
+                                return
+                        try:
+                            replayed = replay_decision(rec, cfg2)
+                        except Exception as exc:
+                            self._json(500, {"error": f"replay failed: "
+                                             f"{type(exc).__name__}: "
+                                             f"{exc}"[:500]})
+                            return
+                        self._json(200, {
+                            "record_id": rec["record_id"],
+                            "config_basis": basis,
+                            "recorded": {
+                                "decision": (rec.get("decision")
+                                             or {}).get("name"),
+                                "model": rec.get("model", ""),
+                                "matched_rules": (rec.get("decision")
+                                                  or {}).get(
+                                    "matched_rules", []),
+                            },
+                            "replayed": replayed,
+                            **replay_diff(rec, replayed)})
                     elif path == "/config/router/rollback":
                         if self._authorize(write=True,
                                            action="config_rollback") is None:

@@ -346,6 +346,8 @@ def build_router(cfg: RouterConfig, engine=None,
                     if registry is not None else None,
                     tracer=registry.tracer if registry is not None else None,
                     flightrec=registry.get("flightrec")
+                    if registry is not None else None,
+                    explain=registry.get("explain")
                     if registry is not None else None)
     from ..memory import InMemoryMemoryStore
     from ..vectorstore import VectorStoreManager
@@ -518,9 +520,13 @@ def apply_observability_knobs(cfg: RouterConfig, registry) -> None:
         # in-process SLO engine (observability.slo): objectives parse
         # here, burn-rate monitors run on their own thread, /health
         # reads the degraded flag.  Malformed objectives are skipped and
-        # reported via /debug/slo config_errors — never fatal.
+        # reported via /debug/slo config_errors — never fatal.  Firing
+        # alerts also export as runtime events on THIS registry's bus so
+        # the kube operator can react (shed traffic / scale) instead of
+        # only reporting.
         slo = registry.get("slo")
         if slo is not None:
+            slo.event_bus = registry.get("events")
             slo.configure(cfg.slo_config())
             if slo.enabled:
                 slo.start(slo.evaluation_interval_s)
@@ -532,6 +538,16 @@ def apply_observability_knobs(cfg: RouterConfig, registry) -> None:
                                 level="warning")
     except Exception as exc:
         component_event("bootstrap", "slo_config_invalid",
+                        error=str(exc)[:200], level="warning")
+    try:
+        # decision explainability (observability.explain): per-request
+        # routing audit records — ring size / sampling / PII redaction
+        # retune on hot reload like every other telemetry knob
+        explain = registry.get("explain")
+        if explain is not None:
+            explain.configure(cfg.decision_explain_config())
+    except Exception as exc:
+        component_event("bootstrap", "decision_explain_config_invalid",
                         error=str(exc)[:200], level="warning")
 
 
@@ -591,10 +607,17 @@ def serve(config_path: str, port: int = 8801,
     # OTLP span export when configured (observability.tracing.otlp_endpoint)
     # — attached to the SERVER's tracer (registry slot), so an embedded
     # second router's spans go to its own exporter
-    from ..observability.otlp import build_exporter_from_config
+    from ..observability.otlp import (
+        build_exporter_from_config,
+        build_log_exporter_from_config,
+    )
 
     server.otlp_exporter = build_exporter_from_config(
         cfg.observability, server.registry.tracer)
+    # decision records export as OTLP log records to the same collector
+    # (audit pipelines read /v1/logs; the trace id links back to spans)
+    server.otlp_log_exporter = build_log_exporter_from_config(
+        cfg.observability, server.registry.get("explain"))
 
     # observability knobs: applied here AND on config hot-reload (edits
     # to sample_rate / exemplars / flight_recorder must not need a

@@ -30,6 +30,11 @@ WARMUP_DONE = "warmup_done"
 ENGINE_READY = "engine_ready"
 ENGINE_FAILED = "engine_failed"
 CONFIG_RELOADED = "config_reloaded"
+# SLO burn-rate alert transitions (observability/slo.py → this bus):
+# reactive surface for the kube operator — shed traffic or scale on
+# firing instead of only reporting in /debug/slo
+SLO_ALERT_FIRING = "slo_alert_firing"
+SLO_ALERT_RESOLVED = "slo_alert_resolved"
 
 
 @dataclass

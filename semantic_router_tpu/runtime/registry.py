@@ -22,8 +22,8 @@ import threading
 from typing import Any, Dict, Optional
 
 _SLOTS = ("metrics", "tracer", "sessions", "profiler", "events",
-          "flightrec", "runtimestats", "slo", "engine", "cache",
-          "memory_store", "vectorstores", "replay_store")
+          "flightrec", "runtimestats", "slo", "explain", "engine",
+          "cache", "memory_store", "vectorstores", "replay_store")
 
 
 class RuntimeRegistry:
@@ -39,6 +39,7 @@ class RuntimeRegistry:
     def with_defaults(cls, **overrides: Any) -> "RuntimeRegistry":
         """Process-default sinks (shared across instances — the
         single-router posture); stateful stores stay per-instance."""
+        from ..observability.explain import default_decision_explainer
         from ..observability.flightrec import default_flight_recorder
         from ..observability.metrics import default_registry
         from ..observability.profiler import default_profiler
@@ -57,6 +58,7 @@ class RuntimeRegistry:
             "flightrec": default_flight_recorder,
             "runtimestats": default_runtime_stats,
             "slo": default_slo_monitor,
+            "explain": default_decision_explainer,
         }
         base.update(overrides)
         return cls(**base)
@@ -73,6 +75,7 @@ class RuntimeRegistry:
         other's /metrics, spans, or event feed.  Wire the emitters with
         ``build_router(cfg, registry=...)`` /
         ``RouterServer(..., registry=...)``."""
+        from ..observability.explain import DecisionExplainer
         from ..observability.flightrec import FlightRecorder
         from ..observability.metrics import MetricsRegistry
         from ..observability.profiler import ProfilerControl
@@ -95,6 +98,9 @@ class RuntimeRegistry:
             # llm_slo_* series stay isolated like everything else
             "runtimestats": RuntimeStats(metrics),
             "slo": SLOMonitor(metrics),
+            # per-instance decision-record ring: an embedded router's
+            # audit trail never mixes with another's
+            "explain": DecisionExplainer(),
         }
         base.update(overrides)
         return cls(**base)

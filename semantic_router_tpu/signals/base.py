@@ -169,6 +169,11 @@ class SignalResult:
     # kb family: per-KB metric values forwarded to kb_metric projection
     # inputs ({kb_name: {metric: value}})
     metrics: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    # where the value came from, for the decision-record audit trail:
+    # "heuristic" (model-free evaluator), "engine" (direct classify),
+    # "fused_bank" (served from the dispatcher's fused-prefetch memo) —
+    # empty means heuristic (evaluators that predate the field)
+    source: str = ""
 
 
 class SignalEvaluator(Protocol):

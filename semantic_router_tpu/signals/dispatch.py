@@ -74,11 +74,21 @@ class SignalDispatcher:
             try:
                 with batchtrace.activate(parent,
                                          f"signal.{e.signal_type}"):
-                    return e.evaluate(ctx)
+                    out = e.evaluate(ctx)
+                    if not out.source:
+                        # decision-record source attribution: evaluators
+                        # that don't self-report are heuristic unless
+                        # they hold an engine handle
+                        out.source = "engine" if getattr(
+                            e, "engine", None) is not None else "heuristic"
+                    return out
             except Exception as exc:  # fail open per family
                 return SignalResult(signal_type=e.signal_type,
                                     latency_s=time.perf_counter() - t0,
-                                    error=f"{type(exc).__name__}: {exc}")
+                                    error=f"{type(exc).__name__}: {exc}",
+                                    source="engine" if getattr(
+                                        e, "engine", None) is not None
+                                    else "heuristic")
 
         self._prefetch_fused(ctx, active)
         if len(active) <= 1:

@@ -61,12 +61,23 @@ class _EngineSignal:
         if memo is not None:
             hit = memo.get(key)
             if hit is not None:
+                # decision-record source attribution: this value rode
+                # the fused-bank prefetch (or an earlier evaluator's
+                # call) instead of paying its own forward
+                ctx.ext[("signal_source", id(self))] = "fused_bank"
                 return hit
         out = self.engine.classify(
             self.task, text, enc_cache=getattr(ctx, "enc_cache", None))
         if memo is not None:
             memo[key] = out
+        ctx.ext[("signal_source", id(self))] = "engine"
         return out
+
+    def _source(self, ctx: RequestContext) -> str:
+        """Where this evaluation's classify result came from (set by
+        _classify; "engine" when no classify ran — the family is still
+        engine-backed)."""
+        return ctx.ext.pop(("signal_source", id(self)), "engine")
 
     def evaluate(self, ctx: RequestContext) -> SignalResult:
         start = time.perf_counter()
@@ -79,6 +90,7 @@ class _EngineSignal:
         except Exception as exc:
             res.error = f"{type(exc).__name__}: {exc}"
         res.latency_s = time.perf_counter() - start
+        res.source = self._source(ctx)
         return res
 
     def _evaluate(self, ctx: RequestContext, res: SignalResult) -> None:
@@ -139,6 +151,7 @@ class JailbreakSignal(_EngineSignal):
         except Exception as exc:
             res.error = f"{type(exc).__name__}: {exc}"
         res.latency_s = time.perf_counter() - start
+        res.source = self._source(ctx)
         return res
 
     # guard safety levels → jailbreak scores (Unsafe blocks outright;

@@ -1,0 +1,174 @@
+"""Explain smoke (make explain-smoke, tier-1): boot the routing
+pipeline over a fake shared-trunk engine, push 50 mixed-signal requests
+through it, and assert every non-passthrough response yields a
+retrievable, schema-valid decision record that reconstructs the full
+chain (signals → projections → rule tree → candidate scores → final
+model/fallback) — and that replaying any record under the unchanged
+config reproduces the identical model choice (ISSUE 4 acceptance)."""
+
+import pytest
+
+from semantic_router_tpu.config.schema import (
+    Decision,
+    DomainRule,
+    ModelRef,
+    NamedRule,
+    RouterConfig,
+    RuleNode,
+    SignalsConfig,
+)
+from semantic_router_tpu.engine.testing import make_shared_trunk_engine
+from semantic_router_tpu.observability.explain import (
+    DecisionExplainer,
+    validate_record,
+)
+from semantic_router_tpu.observability.flightrec import FlightRecorder
+from semantic_router_tpu.observability.metrics import (
+    MetricSeries,
+    MetricsRegistry,
+)
+from semantic_router_tpu.observability.tracing import Tracer
+from semantic_router_tpu.replay import replay_decision, replay_diff
+from semantic_router_tpu.router.pipeline import Router
+
+N_REQUESTS = 50
+
+TEXTS = [
+    "what is the capital of france",
+    "sue them for breach of contract immediately",
+    "does this medicine interact with alcohol",
+    "design a distributed consensus algorithm step by step",
+    "this answer was wrong, fix the numbers please",
+]
+
+
+def _mixed_cfg() -> RouterConfig:
+    """Learned + heuristic families, multi-candidate decisions (so the
+    selector breakdown is non-trivial), and a default fallback path."""
+    return RouterConfig(
+        default_model="fallback-model",
+        signals=SignalsConfig(
+            domains=[DomainRule(name=lbl) for lbl in
+                     ("business", "law", "health", "computer science",
+                      "other")],
+            fact_check=[NamedRule(name="fact_check")],
+            user_feedbacks=[NamedRule(name="positive"),
+                            NamedRule(name="negative")],
+        ),
+        decisions=[
+            Decision(
+                name="law_route", priority=100,
+                rules=RuleNode(operator="OR", conditions=[
+                    RuleNode(signal_type="domain", name="law")]),
+                model_refs=[ModelRef(model="model-large", weight=0.7),
+                            ModelRef(model="model-small", weight=0.3)],
+                algorithm={"type": "multi_factor"}),
+            Decision(
+                name="factual_route", priority=50,
+                rules=RuleNode(operator="AND", conditions=[
+                    RuleNode(signal_type="fact_check", name="fact_check"),
+                    RuleNode(operator="NOT", conditions=[
+                        RuleNode(signal_type="domain", name="law")])]),
+                model_refs=[ModelRef(model="model-small")],
+                algorithm={"type": "static"}),
+        ],
+    )
+
+
+@pytest.fixture(scope="module")
+def stack():
+    engine = make_shared_trunk_engine(
+        metrics=MetricSeries(MetricsRegistry()))
+    explainer = DecisionExplainer(ring_size=N_REQUESTS * 2)
+    router = Router(_mixed_cfg(), engine=engine,
+                    metrics=MetricSeries(MetricsRegistry()),
+                    tracer=Tracer(capacity=N_REQUESTS * 40,
+                                  sample_rate=0.0),
+                    flightrec=FlightRecorder(), explain=explainer)
+    results = []
+    for i in range(N_REQUESTS):
+        res = router.route({"model": "auto", "messages": [
+            {"role": "user",
+             "content": f"{TEXTS[i % len(TEXTS)]} #{i}"}]})
+        results.append(res)
+    yield router, explainer, results
+    router.shutdown()
+    engine.shutdown()
+
+
+class TestExplainSmoke:
+    def test_every_request_yields_a_schema_valid_record(self, stack):
+        router, explainer, results = stack
+        for res in results:
+            assert res.kind != "passthrough"
+            assert res.decision_record_id, \
+                f"request {res.request_id} has no decision record"
+            assert res.headers.get("x-vsr-decision-record") \
+                == res.decision_record_id
+            rec = explainer.get(res.decision_record_id)
+            assert rec is not None, "record fell out of the ring"
+            problems = validate_record(rec)
+            assert not problems, problems
+            # retrievable by trace id too (span cross-link)
+            assert explainer.get(res.trace_id)["record_id"] \
+                == rec["record_id"]
+
+    def test_records_reconstruct_the_full_chain(self, stack):
+        router, explainer, results = stack
+        for res in results:
+            rec = explainer.get(res.decision_record_id)
+            # signals: every family the dispatcher ran, with source +
+            # latency; the learned families must attribute their source
+            assert rec["signals"], "no signal families captured"
+            sources = {row["source"] for row in rec["signals"].values()}
+            assert sources <= {"heuristic", "engine", "fused_bank"}
+            learned = [rec["signals"][f] for f in
+                       ("domain", "fact_check", "user_feedback")
+                       if f in rec["signals"]]
+            assert learned, "no learned families in the record"
+            assert all(row["source"] in ("engine", "fused_bank")
+                       for row in learned)
+            # rule trace: EVERY configured decision evaluated, with tree
+            assert [e["decision"] for e in rec["rule_trace"]] == \
+                ["law_route", "factual_route"]
+            for entry in rec["rule_trace"]:
+                assert entry["tree"] is not None
+                assert entry["tree"]["matched"] == entry["matched"]
+            # outcome chain: decision → selection → final model
+            if rec["decision"] is not None:
+                assert rec["model"] in rec["decision"]["candidates"] \
+                    or rec["kind"] != "route"
+                assert rec["selection"]["chosen"] == rec["model"]
+                cands = {c["model"]
+                         for c in rec["selection"]["candidates"]}
+                assert cands == set(rec["decision"]["candidates"])
+                for cand in rec["selection"]["candidates"]:
+                    assert "components" in cand
+            else:
+                assert rec["fallback_reason"] == "no_decision_matched"
+                assert rec["model"] == "fallback-model"
+
+    def test_replay_reproduces_identical_model_choice(self, stack):
+        router, explainer, results = stack
+        for res in results:
+            rec = explainer.get(res.decision_record_id)
+            replayed = replay_decision(rec, router.cfg)
+            diff = replay_diff(rec, replayed)
+            assert diff["identical"], \
+                f"replay diverged for {rec['record_id']}: {diff}"
+
+    def test_mix_covers_decision_and_fallback_paths(self, stack):
+        router, explainer, results = stack
+        kinds = {explainer.get(r.decision_record_id)["decision"]["name"]
+                 if explainer.get(r.decision_record_id)["decision"]
+                 else "" for r in results}
+        assert "law_route" in kinds or "factual_route" in kinds
+        listing = explainer.list(limit=N_REQUESTS,
+                                 decision="law_route")
+        for rec in listing:
+            assert rec["decision"]["name"] == "law_route"
+
+    def test_redaction_defaults_on(self, stack):
+        router, explainer, results = stack
+        for res in results:
+            assert explainer.get(res.decision_record_id)["query"] == ""

@@ -50,8 +50,9 @@ class TestGrafana:
         rc = main(["grafana", "--out-dir", str(tmp_path / "g")])
         assert rc == 0
         out = json.loads(capsys.readouterr().out)
-        # 5 curated dashboards (incl. Runtime & SLO) + catalog + provider
-        assert len(out["rendered"]) == 7
+        # 6 curated dashboards (incl. Runtime & SLO and Decisions) +
+        # catalog + provider
+        assert len(out["rendered"]) == 8
 
 
 class TestEmbedMap:

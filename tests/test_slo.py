@@ -226,6 +226,213 @@ class TestBurnRates:
         assert mon.degraded() == []
 
 
+class TestObjectiveAwareBuckets:
+    """PR 3 follow-on: a latency objective inserts an EXACT bucket edge
+    instead of rounding down to the nearest existing one."""
+
+    def test_edge_inserted_on_first_read(self):
+        reg = MetricsRegistry()
+        s = MetricSeries(reg)
+        mon = SLOMonitor(reg)
+        mon.configure({"objectives": [
+            "routing_latency p99 < 30ms over 1s"]})
+        assert 0.030 not in s.routing_latency.buckets
+        mon.tick(now=1.0)
+        assert 0.030 in s.routing_latency.buckets
+
+    def test_exact_edge_changes_the_verdict(self):
+        # 30ms traffic against a 40ms bound: the pre-existing edges
+        # (25ms, 50ms) would round 40ms DOWN to 25ms and count every
+        # request as bad; the exact 40ms edge counts them good
+        reg = MetricsRegistry()
+        s = MetricSeries(reg)
+        mon = SLOMonitor(reg)
+        mon.configure({"objectives": [
+            "routing_latency p99 < 40ms over 1s"]})
+        mon.tick(now=0.5)  # inserts the 40ms edge before traffic
+        for _ in range(100):
+            s.routing_latency.observe(0.030)
+        for t in range(1, 80):
+            mon.tick(now=0.5 + t)
+        assert mon.degraded() == []
+        good, total = s.routing_latency.le_total(0.040)
+        assert (good, total) == (100, 100)
+
+    def test_add_bucket_edge_preserves_counts_and_monotonicity(self):
+        from semantic_router_tpu.observability.metrics import Histogram
+
+        h = Histogram("t", buckets=(0.01, 0.1))
+        h.observe(0.005)
+        h.observe(0.05)
+        h.observe(0.5)
+        assert h.add_bucket_edge(0.025)
+        assert not h.add_bucket_edge(0.025)  # idempotent
+        assert h.buckets == [0.01, 0.025, 0.1]
+        # pre-insertion 0.05 stays in the upper half (counts bad at the
+        # new edge — conservative); totals unchanged
+        assert h.le_total(0.025) == (1, 3)
+        h.observe(0.02)  # post-insertion lands exactly
+        assert h.le_total(0.025) == (2, 4)
+        exposition = "\n".join(h.expose())
+        assert 'le="0.025"' in exposition
+
+
+class TestPerModelObjectives:
+    """PR 3 follow-on: label selectors in the objective DSL restrict
+    the histogram read, and the selector labels ride the gauge reads."""
+
+    def test_selector_parses(self):
+        o = parse_objective(
+            'routing_latency{model=qwen3-8b} p99 < 25ms over 5m')
+        assert o.labels == {"model": "qwen3-8b"}
+        assert "qwen3-8b" in o.name
+
+    def test_quoted_selector_and_explicit_dict(self):
+        o = parse_objective(
+            'completion_latency{model="big"} p95 < 2s over 5m')
+        assert o.labels == {"model": "big"}
+        o2 = parse_objective({"kind": "latency", "metric": "ttft",
+                              "threshold": "1s",
+                              "labels": {"model": "m1"}})
+        assert o2.labels == {"model": "m1"}
+
+    def test_bad_selector_is_contained(self):
+        mon = SLOMonitor(MetricsRegistry())
+        mon.configure({"objectives": [
+            "routing_latency{model=} p99 < 25ms"]})
+        assert mon.config_errors
+
+    def test_per_model_objective_isolates_models(self):
+        reg = MetricsRegistry()
+        s = MetricSeries(reg)
+        mon = SLOMonitor(reg)
+        mon.configure({"objectives": [
+            "routing_latency{model=slow-model} p99 < 25ms over 60s"]})
+        mon.tick(now=0.0)
+        for _ in range(200):
+            s.routing_latency.observe(0.200, model="slow-model")
+            s.routing_latency.observe(0.001, model="fast-model")
+        for t in range(1, 5):
+            mon.tick(now=float(t * 30))
+        # only the slow model's traffic counts against the objective
+        assert mon.degraded() != []
+        text = reg.expose()
+        assert 'model="slow-model"' in text \
+            and "llm_slo_alert_firing" in text
+
+    def test_label_change_zeroes_old_labeled_series(self):
+        # same objective NAME, new selector: the old labels' firing
+        # gauge must be zeroed or it latches at 1.0 forever
+        reg = MetricsRegistry()
+        s = MetricSeries(reg)
+        mon = SLOMonitor(reg)
+        mon.configure({"objectives": [{
+            "name": "lat", "kind": "latency", "metric": "routing_latency",
+            "threshold": "25ms", "window": "60s",
+            "labels": {"model": "a"}}]})
+        mon.tick(now=0.0)
+        for _ in range(100):
+            s.routing_latency.observe(0.100, model="a")
+        for t in range(1, 5):
+            mon.tick(now=float(t * 30))
+        assert mon.degraded() == ["lat"]
+        fired = reg.find("llm_slo_alert_firing")
+        assert any(fired.get(objective="lat", severity=sev, model="a")
+                   == 1.0 for sev in ("fast", "slow"))
+        mon.configure({"objectives": [{
+            "name": "lat", "kind": "latency", "metric": "routing_latency",
+            "threshold": "25ms", "window": "60s",
+            "labels": {"model": "b"}}]})
+        for sev in ("fast", "slow"):
+            assert fired.get(objective="lat", severity=sev,
+                             model="a") == 0.0
+
+    def test_unlabeled_objective_sums_all_models(self):
+        reg = MetricsRegistry()
+        s = MetricSeries(reg)
+        mon = SLOMonitor(reg)
+        mon.configure({"objectives": [
+            "routing_latency p50 < 25ms over 60s"]})
+        mon.tick(now=0.0)
+        for _ in range(100):
+            s.routing_latency.observe(0.001, model="a")
+            s.routing_latency.observe(0.001, model="b")
+        for t in range(1, 10):
+            mon.tick(now=float(t * 30))
+        assert mon.degraded() == []
+
+
+class TestAlertRuntimeEvents:
+    """PR 3 follow-on: alert transitions export as runtime events so
+    the kube operator can react instead of only reporting."""
+
+    def _firing_monitor(self):
+        from semantic_router_tpu.runtime.events import EventBus
+
+        reg = MetricsRegistry()
+        s = MetricSeries(reg)
+        mon = SLOMonitor(reg)
+        mon.event_bus = EventBus()
+        mon.configure({"objectives": [
+            "routing_latency p99 < 25ms over 60s"]})
+        mon.tick(now=0.0)
+        return reg, s, mon
+
+    def test_firing_and_resolved_events(self):
+        from semantic_router_tpu.runtime.events import (
+            SLO_ALERT_FIRING,
+            SLO_ALERT_RESOLVED,
+        )
+
+        reg, s, mon = self._firing_monitor()
+        for _ in range(100):
+            s.routing_latency.observe(0.100)
+        t = 0.0
+        for _ in range(10):
+            t += 30.0
+            mon.tick(now=t)
+        fired = mon.event_bus.recent(stage=SLO_ALERT_FIRING)
+        assert fired
+        detail = fired[0].detail
+        assert detail["objective"] == "routing_latency_p99"
+        assert detail["severity"] in ("fast", "slow")
+        assert "burn_rates" in detail
+        # recovery: flood good events until the alert clears
+        for _ in range(200_000):
+            s.routing_latency.observe(0.001)
+        for _ in range(200):
+            t += 60.0
+            mon.tick(now=t)
+        assert mon.degraded() == []
+        assert mon.event_bus.recent(stage=SLO_ALERT_RESOLVED)
+
+    def test_no_bus_no_crash(self):
+        reg, s, mon = self._firing_monitor()
+        mon.event_bus = None
+        for _ in range(100):
+            s.routing_latency.observe(0.100)
+        for t in range(1, 10):
+            mon.tick(now=float(t * 30))  # transitions without a bus
+        assert mon.degraded() != []
+
+    def test_bootstrap_wires_bus(self):
+        from semantic_router_tpu.config.schema import RouterConfig
+        from semantic_router_tpu.runtime.bootstrap import (
+            apply_observability_knobs,
+        )
+        from semantic_router_tpu.runtime.registry import RuntimeRegistry
+
+        reg = RuntimeRegistry.isolated()
+        cfg = RouterConfig.from_dict({"observability": {"slo": {
+            "objectives": ["routing_latency p99 < 25ms over 5m"]}}})
+        apply_observability_knobs(cfg, reg)
+        slo = reg.get("slo")
+        try:
+            assert slo.event_bus is reg.get("events")
+        finally:
+            slo.stop()
+
+
 def _get(url, path):
     with urllib.request.urlopen(url + path, timeout=30) as resp:
         return resp.status, json.loads(resp.read())
