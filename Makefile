@@ -2,7 +2,8 @@ PY ?= python
 SHELL := /bin/bash
 
 .PHONY: test test-fast tier1 trace-smoke metrics-lint explain-smoke \
-	native bench bench-replay perf perf-record serve-mock clean
+	resilience-smoke native bench bench-replay perf perf-record \
+	serve-mock clean
 
 bench-replay:
 	$(PY) benchmarks/replay_bench.py --n 500 \
@@ -45,6 +46,18 @@ metrics-lint:
 explain-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_explain_smoke.py \
 	  -q -p no:cacheprovider
+
+# overload-control gate (docs/RESILIENCE.md): chaos e2e over the
+# routing pipeline — fault_proxy plans + an injected slow/erroring
+# signal backend drive the SLO engine's fast burn window, and the
+# degradation ladder must escalate L0→L3 monotonically, shed
+# priority-aware (high priority keeps learned signals at L2/L3), and
+# recover to L0 with hysteresis once the faults clear, with every
+# transition visible as runtime events + metrics + decision-record
+# annotations.  Tier-1 (runs inside `make tier1` too).
+resilience-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience.py \
+	  tests/test_resilience_chaos.py -q -p no:cacheprovider
 
 native:
 	$(PY) -m semantic_router_tpu.native.build

@@ -512,6 +512,110 @@ def _measure_explain_overhead(platform: str) -> dict:
         engine.shutdown()
 
 
+def _measure_resilience_overhead(platform: str) -> dict:
+    """signals/s through the FULL routing pipeline with the degradation
+    controller attached (enabled, holding L0 — the always-on posture)
+    vs detached — the <1% acceptance gate for ISSUE 5's overload
+    control.  At L0 the per-request gate is one integer read, so the
+    e2e delta must sit inside noise; the deterministic number times the
+    gate DIRECTLY (level read + admit at L2) like the explain arm."""
+    import time as _time
+
+    from semantic_router_tpu.config.schema import (
+        DomainRule,
+        NamedRule,
+        RouterConfig,
+        SignalsConfig,
+    )
+    from semantic_router_tpu.engine.testing import make_shared_trunk_engine
+    from semantic_router_tpu.observability.flightrec import FlightRecorder
+    from semantic_router_tpu.observability.metrics import (
+        MetricSeries,
+        MetricsRegistry,
+    )
+    from semantic_router_tpu.observability.tracing import Tracer
+    from semantic_router_tpu.resilience.controller import (
+        DegradationController,
+    )
+    from semantic_router_tpu.router.pipeline import Router
+
+    n_tasks = 3  # the shared-trunk engine's learned families
+    n_iters = 40 if platform == "cpu" else 100
+    engine = make_shared_trunk_engine(
+        metrics=MetricSeries(MetricsRegistry()))
+    cfg = RouterConfig(
+        default_model="backend-model",
+        signals=SignalsConfig(
+            domains=[DomainRule(name=lbl) for lbl in
+                     ("business", "law", "health", "computer science",
+                      "other")],
+            fact_check=[NamedRule(name="fact_check")],
+            user_feedbacks=[NamedRule(name="positive"),
+                            NamedRule(name="negative")]))
+    controller = DegradationController(MetricsRegistry())
+    controller.configure({"enabled": True})
+    router = Router(cfg, engine=engine,
+                    metrics=MetricSeries(MetricsRegistry()),
+                    tracer=Tracer(sample_rate=0.0),
+                    flightrec=FlightRecorder(), explain=None,
+                    resilience=controller)
+    # explain=None falls back to the process default; detach it so the
+    # arm isolates the RESILIENCE delta
+    router.explain = None
+    try:
+        texts = [f"benchmark request number {i} about contract law"
+                 for i in range(16)]
+
+        def body(i: int) -> dict:
+            return {"model": "auto", "messages": [
+                {"role": "user", "content": texts[i % len(texts)]}]}
+
+        def run(attached: bool, n: int) -> float:
+            router.resilience = controller if attached else None
+            t0 = _time.perf_counter()
+            for i in range(n):
+                router.route(body(i))
+            return n_tasks * n / (_time.perf_counter() - t0)
+
+        run(True, 10)  # warm jit cache + selector construction
+        off_rates, on_rates = [], []
+        for i in range(4):
+            order = (False, True) if i % 2 == 0 else (True, False)
+            for attached in order:
+                (on_rates if attached else off_rates).append(
+                    run(attached, n_iters))
+        off, on = max(off_rates), max(on_rates)
+
+        # deterministic gate cost: the L0 read the hot path pays, and
+        # the full admit() a degraded router pays per request at L2
+        t0 = _time.perf_counter()
+        calls = 200_000
+        for _ in range(calls):
+            controller.level()
+        l0_ns = (_time.perf_counter() - t0) / calls * 1e9
+        controller._level = 2  # direct: measure admit without a ladder
+        t0 = _time.perf_counter()
+        calls = 50_000
+        for _ in range(calls):
+            controller.admit("normal", n_signals=3)
+        admit_ns = (_time.perf_counter() - t0) / calls * 1e9
+        controller._level = 0
+        routes_per_s = max(off, on) / n_tasks
+        hot_pct = l0_ns * 1e-9 * routes_per_s * 100.0
+        return {
+            "engine_signals_per_s_resilience_off": round(off, 1),
+            "engine_signals_per_s_resilience_on": round(on, 1),
+            "resilience_e2e_delta_pct":
+                round(100.0 * (off - on) / off, 2),
+            "l0_gate_ns": round(l0_ns, 1),
+            "l2_admit_ns": round(admit_ns, 1),
+            "resilience_overhead_pct": round(hot_pct, 4),
+        }
+    finally:
+        router.shutdown()
+        engine.shutdown()
+
+
 def _measure_tracing_overhead(platform: str) -> dict:
     """signals/s through the tiny shared-trunk ENGINE (batcher + fused
     trunk group — the path batch tracing instruments) under three tracing
@@ -828,6 +932,17 @@ def _run_bench(platform: str) -> None:
         sys.stderr.write(f"bench: explain arm failed "
                          f"({type(exc).__name__}: {exc}); skipped\n")
 
+    # resilience overhead arm (docs/RESILIENCE.md, ISSUE 5 acceptance):
+    # the degradation controller's per-request gate at L0 must cost <1%
+    # of engine signals/s — one integer read on the healthy path.
+    resilience_row = None
+    try:
+        resilience_row = _measure_resilience_overhead(platform)
+        sys.stderr.write(f"bench: resilience overhead {resilience_row}\n")
+    except Exception as exc:
+        sys.stderr.write(f"bench: resilience arm failed "
+                         f"({type(exc).__name__}: {exc}); skipped\n")
+
     batch, signals_per_s, best_impl = best
     # On a CPU fallback the host geometry is the whole story (this image
     # exposes ONE 2.1GHz core — ~0.09 TFLOPs f32 roofline — while the
@@ -852,6 +967,8 @@ def _run_bench(platform: str) -> None:
         record["runtime_stats"] = rs_row
     if explain_row is not None:
         record["explain"] = explain_row
+    if resilience_row is not None:
+        record["resilience"] = resilience_row
     if platform != "cpu":
         # side evidence for the bench README / judge: full sweep detail
         try:

@@ -1077,6 +1077,13 @@ class RouterConfig:
     # store: {backend, ...}, adaptation: {mode, candidate_set},
     # protection: {scope, identity.headers, tuning}}
     learning: Dict[str, Any] = field(default_factory=dict)
+    # overload control & graceful degradation (resilience/controller.py):
+    # {enabled, interval_s, max_level, hysteresis_ticks, escalate_ticks,
+    # queue_high_watermark, saturation_high_watermark, brownout_class,
+    # admission: {target_utilization, burst_s, reject_class,
+    # default_cost_ms}, fail_static: {model}, priority: {header,
+    # trust_header, default, model_classes, group_classes}}
+    resilience: Dict[str, Any] = field(default_factory=dict)
     # canonical v0.3 contract surface (canonical_config.go): named routing
     # profiles + virtual-model entrypoints + deployment listeners/providers
     recipes: List[RoutingRecipe] = field(default_factory=list)
@@ -1129,6 +1136,7 @@ class RouterConfig:
             external_models=list(d.get("external_models", []) or []),
             learning=dict(routing.get("learning",
                                       d.get("learning", {})) or {}),
+            resilience=dict(d.get("resilience", {}) or {}),
             recipes=[RoutingRecipe.from_dict(r)
                      for r in d.get("recipes", []) or []],
             entrypoints=[Entrypoint.from_dict(e)
@@ -1235,13 +1243,19 @@ class RouterConfig:
               ring_size: 512     # bounded in-process record ring
               sample_rate: 1.0   # deterministic per trace id
               redact_pii: true   # drop query text + pii details
+              durable:           # optional SQLite mirror of the ring
+                backend: sqlite  # (observability/explain_store.py) —
+                path: /var/lib/vsr/decisions.db  # post-restart audits
+                max_records: 100000
 
         Malformed values fall back to the defaults (telemetry config is
         never fatal)."""
         d = (self.observability or {}).get("decisions", {}) or {}
         out: Dict[str, Any] = {"enabled": bool(d.get("enabled", True)),
                                "redact_pii": bool(d.get("redact_pii",
-                                                        True))}
+                                                        True)),
+                               "durable": dict(d.get("durable", {})
+                                               or {})}
         try:
             out["ring_size"] = int(d.get("ring_size", 512))
         except (TypeError, ValueError):
@@ -1251,6 +1265,38 @@ class RouterConfig:
         except (TypeError, ValueError):
             out["sample_rate"] = 1.0
         return out
+
+    def resilience_config(self) -> Dict[str, Any]:
+        """The ``resilience`` block, passed verbatim to
+        DegradationController.configure / PriorityResolver.from_config
+        (which own parsing + error containment — a malformed resilience
+        knob must never stop the server)::
+
+          resilience:
+            enabled: true
+            interval_s: 2            # control-loop tick period
+            max_level: 4             # ladder ceiling (0..4)
+            escalate_ticks: 1        # overloaded ticks per rung up
+            hysteresis_ticks: 3      # healthy ticks per rung down
+            queue_high_watermark: 64 # batcher pending_items trip point
+            saturation_high_watermark: 0.9   # dispatch-pool busy ratio
+            brownout_class: normal   # this class and below go
+                                     # heuristic-only at L2
+            admission:               # L3 token buckets
+              target_utilization: 0.8
+              burst_s: 2.0
+              reject_class: low      # 429'd outright at L3
+              default_cost_ms: 5     # pre-telemetry request cost
+            fail_static:
+              model: ""              # L4 model ("" = default_model)
+            priority:
+              header: x-vsr-priority
+              trust_header: true
+              default: normal
+              model_classes: {}      # model/entrypoint -> class
+              group_classes: {}      # authz group -> class
+        """
+        return dict(self.resilience or {})
 
     # -- recipes (pkg/config/recipes.go) -----------------------------------
 

@@ -549,7 +549,21 @@ class InferenceEngine:
             old_pool.shutdown(wait=False)
         self._stacked_pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="stacked-bank")
-        self.path_chooser = DualPathChooser(strategy=strategy)
+        # live cost prior (resilience.costmodel): the runtime-stats
+        # warm-execute EWMAs break the chooser's cold start — the step
+        # sampler has per-variant timing for this engine's programs long
+        # before the chooser accumulates min_history of its own records
+        cost_prior = None
+        if self._runtime_stats is not None:
+            from ..resilience.costmodel import (
+                CostModel,
+                make_path_cost_prior,
+            )
+
+            cost_prior = make_path_cost_prior(
+                CostModel(self._runtime_stats))
+        self.path_chooser = DualPathChooser(strategy=strategy,
+                                            cost_prior=cost_prior)
         self.last_path_selection = None
 
     def classify_multi(self, tasks: Sequence[str], texts: Sequence[str],

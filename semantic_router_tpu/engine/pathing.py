@@ -102,16 +102,40 @@ class PerformanceHistory:
 
 
 class DualPathChooser:
-    """Pick the execution path for a multi-task classify call."""
+    """Pick the execution path for a multi-task classify call.
+
+    ``cost_prior`` (optional callable → {"stacked": s, "traditional":
+    s}) feeds the runtime-stats warm-execute EWMAs
+    (resilience.costmodel.make_path_cost_prior) into the cold-start
+    decision: before this chooser has enough of its OWN records, the
+    device-step sampler usually already knows what each path's programs
+    cost — the engine's batch runners record every step regardless of
+    who submitted it.  History still overrides the prior once
+    ``min_history`` records accumulate per path."""
 
     def __init__(self, strategy: str = "adaptive",
-                 min_history: int = 8) -> None:
+                 min_history: int = 8, cost_prior=None) -> None:
         if strategy not in ("adaptive", "latency", "confidence",
                             "traditional", "stacked"):
             raise ValueError(f"unknown strategy {strategy!r}")
         self.strategy = strategy
         self.min_history = min_history
         self.history = PerformanceHistory()
+        self.cost_prior = cost_prior
+
+    def _prior_estimates(self):
+        """(traditional_s, stacked_s) from the live cost prior, or None
+        unless BOTH paths have telemetry (a one-sided prior would just
+        re-encode which path ran first).  Never raises into choose()."""
+        if self.cost_prior is None:
+            return None
+        try:
+            prior = self.cost_prior() or {}
+        except Exception:
+            return None
+        if "traditional" in prior and "stacked" in prior:
+            return float(prior["traditional"]), float(prior["stacked"])
+        return None
 
     def record(self, path: str, tasks: Sequence[str], batch_size: int,
                latency_s: float, confidence: float, ok: bool = True
@@ -131,8 +155,24 @@ class DualPathChooser:
         n_tasks = max(len(req.tasks), 1)
 
         if trad.total < self.min_history or stack.total < self.min_history:
-            # cold start: fused pass amortizes the shared trunk across
-            # tasks; a single task gains nothing from stacking
+            # cold start: before own-history converges, a LIVE cost
+            # prior from the device-step EWMAs beats the static rule —
+            # the sampler has usually seen both paths' programs execute
+            # even when this chooser hasn't recorded them
+            prior = self._prior_estimates()
+            if prior is not None:
+                t_est, s_est = prior
+                path = STACKED if s_est <= t_est else TRADITIONAL
+                if n_tasks < 2:
+                    path = TRADITIONAL  # one task never stacks
+                return PathSelection(
+                    path, 0.6,
+                    f"cold start, step-EWMA prior: stacked "
+                    f"{s_est * 1e3:.2f}ms vs traditional "
+                    f"{t_est * 1e3:.2f}ms → {path}",
+                    stack if path == STACKED else trad)
+            # no telemetry either: fused pass amortizes the shared trunk
+            # across tasks; a single task gains nothing from stacking
             path = STACKED if n_tasks >= 2 else TRADITIONAL
             return PathSelection(
                 path, 0.5,

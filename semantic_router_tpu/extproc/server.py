@@ -292,16 +292,25 @@ class ExtProcService:
             state.response_status = 200
         state.is_sse = "text/event-stream" in hdrs.get("content-type", "")
         common = pb.CommonResponse(status=pb.CommonResponse.CONTINUE)
+        echo: Dict[str, str] = {}
         record_id = getattr(state.route, "decision_record_id", "") \
             if state.route is not None else ""
         if record_id:
             # echo the routing audit record's id on the RESPONSE so a
             # caller holding a completion can fetch the full decision
             # chain at GET /debug/decisions/<id>
+            echo[H.DECISION_RECORD] = record_id
+        if state.route is not None:
+            # degradation echo (resilience/controller.py): a response
+            # routed under a degraded ladder says so even when the
+            # request-path header mutation was already sent
+            lvl = (state.route.headers or {}).get(H.DEGRADATION, "")
+            if lvl:
+                echo[H.DEGRADATION] = lvl
+        if echo:
             common = pb.CommonResponse(
                 status=pb.CommonResponse.CONTINUE,
-                header_mutation=_set_headers(
-                    {H.DECISION_RECORD: record_id}))
+                header_mutation=_set_headers(echo))
         resp = pb.ProcessingResponse(response_headers=pb.HeadersResponse(
             response=common))
         if state.is_sse:

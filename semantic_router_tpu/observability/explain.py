@@ -72,6 +72,10 @@ RECORD_SCHEMA: Dict[str, tuple] = {
     "replay": (dict,),
     "query": (str,),
     "config_hash": (str,),
+    # resilience/controller.py: the degradation-ladder level this
+    # request routed under — a replay of a brownout-era record must know
+    # learned signals were intentionally absent, not broken
+    "degradation_level": (int,),
 }
 
 _SIGNAL_KEYS = ("source", "latency_ms", "error", "hits")
@@ -157,7 +161,8 @@ class RecordDraft:
 
     __slots__ = ("trace_id", "request_id", "signals", "projections",
                  "rule_trace", "decision", "selection", "plugins",
-                 "fallback_reason", "query", "replay_payload")
+                 "fallback_reason", "query", "replay_payload",
+                 "degradation_level")
 
     def __init__(self, trace_id: str, request_id: str) -> None:
         self.trace_id = trace_id
@@ -171,6 +176,7 @@ class RecordDraft:
         self.fallback_reason = ""
         self.query = ""
         self.replay_payload: Dict[str, Any] = {}
+        self.degradation_level = 0
 
     # -- capture methods (called from router.pipeline) --------------------
 
@@ -267,6 +273,7 @@ class RecordDraft:
             or {"matches": {}, "confidences": {}, "details": {}},
             "query": "" if redact_pii else query,
             "config_hash": config_hash,
+            "degradation_level": int(self.degradation_level),
         }
 
 
@@ -290,6 +297,11 @@ class DecisionExplainer:
         self.sinks: List[Callable[[Dict[str, Any]], None]] = []
         self.recorded = 0
         self.dropped = 0
+        # optional durable backend (observability/explain_store.py):
+        # attached by bootstrap from observability.decisions.durable so
+        # post-restart audits survive the in-process ring
+        self.durable_store = None
+        self._durable_sink: Optional[Callable] = None
 
     # -- configuration -----------------------------------------------------
 
@@ -312,6 +324,30 @@ class DecisionExplainer:
                 pass
             self.redact_pii = bool(cfg.get("redact_pii", self.redact_pii))
             self._trim_locked()
+
+    def attach_durable(self, store) -> None:
+        """Attach (or replace) the durable record store: records commit
+        to the ring AND the store's ``add``; a previous store's sink is
+        detached first so hot reloads never double-write.  ``None``
+        detaches."""
+        with self._lock:
+            if self._durable_sink is not None:
+                try:
+                    self.sinks.remove(self._durable_sink)
+                except ValueError:
+                    pass
+                self._durable_sink = None
+            old = self.durable_store
+            self.durable_store = store
+            if store is not None:
+                sink = store.add
+                self._durable_sink = sink
+                self.sinks.append(sink)
+        if old is not None and old is not store:
+            try:
+                old.close()
+            except Exception:
+                pass
 
     # -- recording ---------------------------------------------------------
 

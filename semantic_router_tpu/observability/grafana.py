@@ -315,6 +315,68 @@ def decisions() -> Dict:
                       tags=["decisions", "explainability"])
 
 
+_RESILIENCE_MD = """\
+The degradation ladder (docs/RESILIENCE.md) closes the loop from the
+SLO engine to the data plane:
+
+- **L1** sheds optional work (cache writes, compression, trace
+  sampling), **L2** browns out learned signals for low-priority
+  traffic, **L3** admission-controls with cost-model token buckets
+  (lowest class gets 429 + Retry-After), **L4** serves the static
+  default model with zero signal extraction.
+- `GET /debug/resilience` — level, pressure inputs, bucket fills,
+  cost-model estimates, transition history
+- Responses under degradation carry `x-vsr-degradation-level`; decision
+  records annotate the level so replays of brownout-era traffic stay
+  honest.
+
+Every transition is a `degradation_level_changed` runtime event — the
+same feed the kube operator turns into CRD status conditions.
+"""
+
+
+def resilience() -> Dict:
+    """The "Resilience" dashboard (ISSUE 5): ladder level, shed rate by
+    priority class, admission bucket fill, transition rate — next to a
+    link panel into /debug/resilience."""
+    p = [
+        _stat("Degradation level",
+              "max(llm_degradation_level)",
+              panel_id=1, x=0, y=0),
+        _stat("Shed rate",
+              "sum(rate(llm_shed_total[5m])) or vector(0)",
+              panel_id=2, x=6, y=0),
+        _stat("SLO alerts firing",
+              "sum(llm_slo_alert_firing) or vector(0)",
+              panel_id=3, x=12, y=0),
+        _stat("Admission headroom (worst class)",
+              "min(llm_admission_bucket_fill)",
+              unit="percentunit", panel_id=4, x=18, y=0),
+        _panel("Shed rate by priority class",
+               ["sum(rate(llm_shed_total[5m])) by (priority)"],
+               panel_id=5, x=0, y=4, legends=["{{priority}}"]),
+        _panel("Shed rate by ladder level",
+               ["sum(rate(llm_shed_total[5m])) by (level)"],
+               panel_id=6, x=12, y=4, legends=["{{level}}"]),
+        _panel("Admission bucket fill by class",
+               ["llm_admission_bucket_fill"],
+               unit="percentunit", panel_id=7, x=0, y=12,
+               legends=["{{priority}}"]),
+        _panel("Ladder transitions",
+               ["sum(rate(llm_degradation_transitions_total[5m])) "
+                "by (direction)"],
+               panel_id=8, x=12, y=12, legends=["{{direction}}"]),
+        _panel("Fail-static fallbacks",
+               ['sum(rate(llm_decision_fallbacks_total'
+                '{reason="fail_static"}[5m])) or vector(0)'],
+               panel_id=9, x=0, y=20),
+        _text_panel("Overload control", _RESILIENCE_MD,
+                    panel_id=10, x=12, y=20),
+    ]
+    return _dashboard("srt-resilience", "Semantic Router — Resilience",
+                      p, tags=["resilience", "overload"])
+
+
 def catalog(registry=None) -> Dict:
     """Auto-generated dashboard: one panel per registered series —
     anything new in the registry shows up here without template edits."""
@@ -368,6 +430,7 @@ def render_all(out_dir: str, registry=None) -> List[str]:
         "serving.json": serving(),
         "runtime_slo.json": runtime_slo(),
         "decisions.json": decisions(),
+        "resilience.json": resilience(),
         "metric_catalog.json": catalog(registry),
     }
     for fname, dash in dashboards.items():

@@ -237,6 +237,27 @@ class RuntimeStats:
             if fn is None or self._providers.get(name) is fn:
                 self._providers.pop(name, None)
 
+    def provider_stats(self) -> Dict[str, Dict[str, float]]:
+        """One pass over the registered providers WITHOUT touching the
+        gauges — the read the resilience controller polls for queue
+        pressure (sample_process publishes the same values to series).
+        A failing provider is skipped, never fatal."""
+        with self._lock:
+            providers = list(self._providers.items())
+        queues: Dict[str, Dict[str, float]] = {}
+        for name, fn in providers:
+            try:
+                stats = fn() or {}
+            except Exception:
+                continue  # a torn-down batcher must not kill sampling
+            queues[name] = {}
+            for stat, value in stats.items():
+                try:
+                    queues[name][str(stat)] = float(value)
+                except (TypeError, ValueError):
+                    continue
+        return queues
+
     @staticmethod
     def _read_rss_bytes() -> float:
         try:
@@ -289,22 +310,10 @@ class RuntimeStats:
             pass  # no jax / no backend: host gauges still report
         sample["devices"] = devices
 
-        with self._lock:
-            providers = list(self._providers.items())
-        queues: Dict[str, Dict[str, float]] = {}
-        for name, fn in providers:
-            try:
-                stats = fn() or {}
-            except Exception:
-                continue  # a torn-down batcher must not kill sampling
-            queues[name] = {}
-            for stat, value in stats.items():
-                try:
-                    v = float(value)
-                except (TypeError, ValueError):
-                    continue
-                self.queue_stats.set(v, batcher=name, stat=str(stat))
-                queues[name][str(stat)] = v
+        queues = self.provider_stats()
+        for name, stats in queues.items():
+            for stat, v in stats.items():
+                self.queue_stats.set(v, batcher=name, stat=stat)
         sample["queues"] = queues
         # publish GC collection counts accumulated by the callback;
         # read-inc-write runs under the lock so a concurrent

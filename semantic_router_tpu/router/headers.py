@@ -29,6 +29,13 @@ MATCHED_RULES = "x-vsr-matched-rules"
 # a caller holding a response can fetch the full routing audit trail at
 # GET /debug/decisions/<id>
 DECISION_RECORD = "x-vsr-decision-record"
+# degradation ladder (resilience/controller.py): the current shed-ladder
+# level echoed on every response while the router is degraded (>L0), so
+# clients and load balancers see brownouts/admission control explicitly;
+# x-vsr-priority is the request's claimed priority class (honored only
+# behind resilience.priority.trust_header)
+DEGRADATION = "x-vsr-degradation-level"
+PRIORITY = "x-vsr-priority"
 
 
 def decision_headers(decision_name: str, model: str, category: str = "",

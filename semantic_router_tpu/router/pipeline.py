@@ -115,7 +115,8 @@ class Router:
                  cache: Optional[CacheBackend] = None,
                  embedding_task: str = "embedding",
                  metrics: "Optional[M.MetricSeries]" = None,
-                 tracer=None, flightrec=None, explain=None) -> None:
+                 tracer=None, flightrec=None, explain=None,
+                 resilience=None) -> None:
         self.cfg = cfg
         self.engine = engine
         self.embedding_task = embedding_task
@@ -151,6 +152,17 @@ class Router:
 
         self.explain = explain if explain is not None \
             else default_decision_explainer
+        # overload control (resilience/controller.py): the shed-ladder
+        # gate every request passes; registry-bound when embedded,
+        # process default otherwise (disabled + L0 until bootstrap
+        # configures it — one integer read per request)
+        from ..resilience.controller import default_degradation_controller
+        from ..resilience.priority import PriorityResolver
+
+        self.resilience = resilience if resilience is not None \
+            else default_degradation_controller
+        self.priority = PriorityResolver.from_config(
+            cfg.resilience_config())
         self._cfg_hash: Optional[str] = None  # lazy (record provenance)
 
         extra = []
@@ -199,6 +211,11 @@ class Router:
             extra += remote_evs
         self.dispatcher = build_heuristic_dispatcher(cfg, extra=extra)
         self.decision_engine = DecisionEngine(cfg.decisions, cfg.strategy)
+        # learned-family lists per dispatcher, frozen at construction:
+        # the resilience gate reads them per request while degraded, and
+        # the evaluator set only changes on a router rebuild
+        self._learned_types: Dict[int, List[str]] = {
+            id(self.dispatcher): self.dispatcher.learned_types()}
         # recipe-aware routing (pkg/config/recipes.go + canonical
         # entrypoints): each named profile gets its own dispatcher and
         # decision engine at construction time; per-request resolution is
@@ -214,6 +231,8 @@ class Router:
                 self._recipe_engines[rec.name] = (
                     build_heuristic_dispatcher(sub_cfg, extra=extra),
                     DecisionEngine(rec.decisions, rec.strategy))
+            for disp, _ in self._recipe_engines.values():
+                self._learned_types[id(disp)] = disp.learned_types()
         self.rate_limiter = RateLimiter.from_config(cfg.ratelimit)
         sp_cfg = cfg.skip_processing or {}
         self._skip_enabled = bool(sp_cfg.get("enabled", False))
@@ -309,14 +328,17 @@ class Router:
                 return self.dispatcher, self.decision_engine, True
         return self.dispatcher, self.decision_engine, False
 
-    def _prepare_signal_view(self, ctx, headers: Dict[str, str]
-                             ) -> List[str]:
+    def _prepare_signal_view(self, ctx, headers: Dict[str, str],
+                             compress: bool = True) -> List[str]:
         """The ONE place that decides what reaches the classifiers:
         applies prompt compression to ``ctx`` in-place and returns the
         skip-signals list. route() and evaluate_signals() both call this —
         the streamed prefetch's signal reuse is only sound if the two
-        paths can never drift."""
-        if self.compressor is not None \
+        paths can never drift.  ``compress=False`` is the L1
+        shed-optional posture: compression saves backend tokens at the
+        price of router CPU, exactly the trade an overloaded router
+        stops making."""
+        if compress and self.compressor is not None \
                 and ctx.approx_token_count() >= self.pc_min_tokens:
             ctx._user_text = self.compressor.compress(ctx.user_text).text
         # Signal families are dropped from operator config; the request
@@ -328,6 +350,14 @@ class Router:
                      headers.get("x-vsr-skip-signals", "").split(",")
                      if s.strip()]
         return skip
+
+    def _compress_allowed(self) -> bool:
+        """Prompt compression is optional work: shed while the ladder
+        is at L1+.  The ONE read route() and evaluate_signals() share,
+        so a streamed prefetch's (possibly compressed) signal view can
+        never diverge from the inline path's."""
+        return self.resilience is None \
+            or not self.resilience.shed_optional_active()
 
     def begin_pending_trace(self, headers: Optional[Dict[str, str]] = None):
         """Pre-mint the (trace_id, root_span_id) a future route() call
@@ -354,15 +384,33 @@ class Router:
         under the request's future router.route root span."""
         headers = {k.lower(): v for k, v in (headers or {}).items()}
         ctx = RequestContext.from_openai_body(body, headers)
-        skip = self._prepare_signal_view(ctx, headers)
+        compress = self._compress_allowed()
+        skip = self._prepare_signal_view(ctx, headers, compress=compress)
         dispatcher, _, _ = self._engines_for_model(ctx.model)
+        # the degradation ladder gates the PREFETCH too: a browned-out
+        # priority class must not burn fused-bank capacity on an early
+        # evaluation the inline path would have skipped (read-only —
+        # shed/admission stay in route(), which can answer the request)
+        if self.resilience is not None and self.resilience.level() > 0:
+            try:
+                if self.resilience.browned_out(
+                        self.priority.resolve(ctx)):
+                    skip = skip + (
+                        self._learned_types.get(id(dispatcher))
+                        or dispatcher.learned_types())
+            except Exception:
+                pass
         if pending is None:
-            return dispatcher.evaluate(ctx, skip_signals=skip)
-        with self.tracer.span("signals.evaluate",
-                              trace_id=pending.trace_id,
-                              parent_id=pending.root_span_id,
-                              prefetch=True):
-            return dispatcher.evaluate(ctx, skip_signals=skip)
+            signals, report = dispatcher.evaluate(ctx, skip_signals=skip)
+        else:
+            with self.tracer.span("signals.evaluate",
+                                  trace_id=pending.trace_id,
+                                  parent_id=pending.root_span_id,
+                                  prefetch=True):
+                signals, report = dispatcher.evaluate(ctx,
+                                                      skip_signals=skip)
+        report.compressed_view = compress
+        return signals, report
 
     def route(self, body: Dict[str, Any],
               headers: Optional[Dict[str, str]] = None,
@@ -405,6 +453,14 @@ class Router:
             result.trace_id = trace_id
             result.root_span_id = root.span_id
             root.set(kind=result.kind, model=result.model)
+        # degradation echo: while the ladder is above L0 every response
+        # carries the level, so clients and LBs see brownouts explicitly
+        if self.resilience is not None:
+            lvl = self.resilience.level()
+            if lvl > 0:
+                result.headers.setdefault(H.DEGRADATION, str(lvl))
+                if rec is not None:
+                    rec.degradation_level = max(rec.degradation_level, lvl)
         self._commit_decision_record(rec, result)
         self._flight_record(result, trace_id, request_id,
                             time.perf_counter() - start)
@@ -425,7 +481,8 @@ class Router:
         explainability must never hurt routing).  Passthrough and
         rate-limited requests never reach the signal fan-out, so there
         is nothing to explain — they are the only unrecorded kinds."""
-        if rec is None or result.kind in ("passthrough", "rate_limited"):
+        if rec is None or result.kind in ("passthrough", "rate_limited",
+                                          "shed"):
             return
         try:
             record = rec.finish(
@@ -483,14 +540,68 @@ class Router:
             return RouteResult(kind="passthrough", body=body,
                                request_id=request_id)
 
-        # compression + skip config — shared with evaluate_signals() so a
-        # prefetched view and the inline view can never diverge. The
-        # compression side-effect on ctx is needed even when signals were
-        # prefetched: cache lookup / selection / memory all read
-        # ctx.user_text downstream.
-        skip = self._prepare_signal_view(ctx, headers)
+        # overload gate (resilience/controller.py): the shed ladder
+        # speaks BEFORE any signal work.  L0 is one integer read; the
+        # gate itself fails open — a broken controller must degrade to
+        # full service, never to an outage.  Engines resolve first so
+        # the gate costs the request's ACTUAL dispatcher (an entrypoint
+        # profile may fan out a different learned set).
         dispatcher, decision_engine, via_entrypoint = \
             self._engines_for_model(ctx.model)
+        learned = self._learned_types.get(id(dispatcher))
+        if learned is None:  # carry-over dispatcher from a hot swap
+            learned = dispatcher.learned_types()
+        disp = None
+        if self.resilience is not None \
+                and self.resilience.level() > 0:
+            try:
+                disp = self.resilience.admit(
+                    self.priority.resolve(ctx),
+                    n_signals=len(learned) or 1)
+            except Exception:
+                disp = None
+        if disp is not None and rec is not None:
+            rec.degradation_level = disp.level
+        if disp is not None and disp.action == "shed":
+            # L3/L4 admission: 429 + Retry-After, like the rate limiter
+            # but load-driven (DAGOR-style priority shedding)
+            return RouteResult(
+                kind="shed", status=429, request_id=request_id,
+                response_body={"error": {
+                    "message": "router overloaded — request shed "
+                               f"({disp.reason})",
+                    "type": "overloaded",
+                    "retry_after": round(disp.retry_after_s, 2)}},
+                headers={"retry-after": str(int(disp.retry_after_s) + 1),
+                         H.DEGRADATION: str(disp.level),
+                         H.PRIORITY: disp.priority})
+        if disp is not None and disp.fail_static:
+            return self._fail_static(body, ctx, headers, request_id,
+                                     trace_id, start, disp, rec=rec)
+
+        # compression + skip config — shared with evaluate_signals() so a
+        # prefetched view and the inline view can never diverge (both
+        # read _compress_allowed; when signals WERE prefetched the
+        # prefetch's recorded decision wins outright, so a ladder
+        # transition between prefetch and route can't make ctx.user_text
+        # diverge from the text the signals saw). The compression
+        # side-effect on ctx is needed even when signals were prefetched:
+        # cache lookup / selection / memory all read ctx.user_text
+        # downstream.
+        compress = self._compress_allowed()
+        if precomputed_signals is not None:
+            recorded = getattr(precomputed_signals[1],
+                               "compressed_view", None)
+            if recorded is not None:
+                compress = recorded
+        skip = self._prepare_signal_view(ctx, headers, compress=compress)
+        if disp is not None and not disp.use_learned \
+                and precomputed_signals is None:
+            # L2 brownout: this request's priority class routes on
+            # heuristics alone — every engine-backed family is skipped,
+            # reserving fused-bank capacity for higher classes.  (A
+            # streamed prefetch already paid the forward; keep it.)
+            skip = skip + learned
         if precomputed_signals is not None:
             # streamed-frontend overlap: signals were evaluated while
             # the body was still arriving (same text, same skip config,
@@ -631,6 +742,39 @@ class Router:
         component_event("router", "routed", request_id=request_id,
                         decision=decision.name, model=ref.model,
                         latency_ms=round(result.routing_latency_s * 1e3, 2))
+        return result
+
+    def _fail_static(self, body: Dict[str, Any], ctx: RequestContext,
+                     headers: Dict[str, str], request_id: str,
+                     trace_id: str, start: float, disp,
+                     rec=None) -> RouteResult:
+        """L4 fail-static: route to the configured static model with
+        ZERO signal extraction — no classifier forwards, no cache, no
+        plugins.  The response is still a valid routed request (the
+        reference's fail-open posture, made an explicit ladder rung
+        instead of an accident of a dead engine)."""
+        model = ""
+        if self.resilience is not None:
+            model = getattr(self.resilience, "fail_static_model", "")
+        model = model or self.cfg.default_model \
+            or (self.cfg.model_cards[0].name if self.cfg.model_cards
+                else ctx.model)
+        result = RouteResult(
+            kind="route", request_id=request_id, model=model,
+            body=dict(body), selection_reason="fail_static")
+        self._finalize_body(result, ctx, None)
+        result.headers = {H.SCHEMA: H.SCHEMA_VERSION, H.MODEL: model,
+                          H.REQUEST_ID: request_id,
+                          H.DEGRADATION: str(disp.level),
+                          H.PRIORITY: disp.priority}
+        if rec is not None:
+            rec.fallback_reason = "fail_static"
+            rec.degradation_level = disp.level
+        self.M.decision_fallbacks.inc(reason="fail_static")
+        self.M.model_requests.inc(model=model, decision="fail_static")
+        result.routing_latency_s = time.perf_counter() - start
+        self.M.routing_latency.observe(result.routing_latency_s,
+                                       exemplar=trace_id, model=model)
         return result
 
     # -- plugin stages -----------------------------------------------------
@@ -1043,9 +1187,14 @@ class Router:
         if out.warnings:
             out.headers[H.WARNINGS] = ",".join(out.warnings)
 
-        # cache update (processor_res_cache.go)
+        # cache update (processor_res_cache.go) — skipped while the
+        # degradation ladder is at L1+ (cache WRITES are the canonical
+        # optional work: an embedding forward per response that only
+        # pays off later; reads stay on, hits still shed load)
+        shed_writes = self.resilience is not None \
+            and self.resilience.shed_optional_active()
         if self.cache is not None and route.kind == "route" and content \
-                and decision is not None:
+                and decision is not None and not shed_writes:
             plugin = decision.plugin("semantic-cache")
             if plugin is not None and plugin.enabled and route.body:
                 try:

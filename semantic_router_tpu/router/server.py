@@ -88,6 +88,7 @@ API_CATALOG = {
         {"path": "/debug/flightrec/clear", "method": "POST"},
         {"path": "/debug/slo", "method": "GET"},
         {"path": "/debug/runtime", "method": "GET"},
+        {"path": "/debug/resilience", "method": "GET"},
         {"path": "/debug/decisions", "method": "GET"},
         {"path": "/debug/decisions/{id}", "method": "GET"},
         {"path": "/debug/decisions/{id}/replay", "method": "POST"},
@@ -857,15 +858,43 @@ class RouterServer:
                         self._json(503, {"error": "no runtime stats"})
                     else:
                         self._json(200, rs.report())
+                elif path == "/debug/resilience":
+                    # degradation-ladder snapshot: level, pressure
+                    # inputs, admission buckets, cost model, transitions
+                    res = server.registry.get("resilience")
+                    if res is None:
+                        self._json(503, {"error": "no resilience "
+                                                  "controller"})
+                    else:
+                        self._json(200, res.report())
                 elif path == "/debug/decisions":
                     # decision-record listing, filterable by model /
-                    # decision / rule ("type:name") / signal family
+                    # decision / rule ("type:name") / signal family;
+                    # ?source=durable reads the SQLite mirror (records
+                    # that survived a restart) instead of the ring
                     ex = server.explainer()
                     q = self._query()
                     try:
                         limit = int(q.get("limit", "50") or 50)
                     except ValueError:
                         limit = 50
+                    if q.get("source", "") == "durable":
+                        store = getattr(ex, "durable_store", None)
+                        if store is None:
+                            self._json(503, {"error": "no durable "
+                                                      "decision store"})
+                            return
+                        self._json(200, {
+                            "source": "durable",
+                            "stats": {"retained": len(store)},
+                            "records": store.list(
+                                limit=limit,
+                                model=q.get("model", ""),
+                                decision=q.get("decision", ""),
+                                kind=q.get("kind", ""),
+                                rule=q.get("rule", ""),
+                                family=q.get("family", ""))})
+                        return
                     self._json(200, {
                         "stats": ex.stats(),
                         "records": ex.list(
@@ -878,9 +907,16 @@ class RouterServer:
                 elif path.startswith("/debug/decisions/"):
                     # one record by record id OR trace id — the full
                     # signals → projections → rule tree → candidate
-                    # scores → final model chain
+                    # scores → final model chain; ?source=durable falls
+                    # through to the SQLite mirror after the ring misses
                     key = path.rsplit("/", 1)[1]
-                    rec = server.explainer().get(key)
+                    ex = server.explainer()
+                    rec = ex.get(key)
+                    if rec is None \
+                            and self._query().get("source") == "durable":
+                        store = getattr(ex, "durable_store", None)
+                        if store is not None:
+                            rec = store.get(key)
                     if rec is None:
                         self._json(404, {"error": "no decision record "
                                                   f"for {key!r}"})

@@ -348,6 +348,8 @@ def build_router(cfg: RouterConfig, engine=None,
                     flightrec=registry.get("flightrec")
                     if registry is not None else None,
                     explain=registry.get("explain")
+                    if registry is not None else None,
+                    resilience=registry.get("resilience")
                     if registry is not None else None)
     from ..memory import InMemoryMemoryStore
     from ..vectorstore import VectorStoreManager
@@ -545,9 +547,55 @@ def apply_observability_knobs(cfg: RouterConfig, registry) -> None:
         # retune on hot reload like every other telemetry knob
         explain = registry.get("explain")
         if explain is not None:
-            explain.configure(cfg.decision_explain_config())
+            ex_cfg = cfg.decision_explain_config()
+            explain.configure(ex_cfg)
+            # optional durable backend (explain_store.py): records also
+            # land in SQLite so post-restart audits work; idempotent on
+            # hot reload (same path keeps the same store)
+            durable = ex_cfg.get("durable") or {}
+            if durable.get("backend") == "sqlite" and durable.get("path"):
+                cur = getattr(explain, "durable_store", None)
+                if cur is None or getattr(cur, "path", "") \
+                        != durable["path"]:
+                    from ..observability.explain_store import (
+                        SQLiteDecisionStore,
+                    )
+
+                    explain.attach_durable(SQLiteDecisionStore(
+                        durable["path"],
+                        max_records=int(durable.get("max_records",
+                                                    100_000))))
+            elif getattr(explain, "durable_store", None) is not None:
+                explain.attach_durable(None)
     except Exception as exc:
         component_event("bootstrap", "decision_explain_config_invalid",
+                        error=str(exc)[:200], level="warning")
+    try:
+        # overload control (resilience.controller): bind the ladder to
+        # THIS registry's sensors (event bus, SLO monitor, runtimestats)
+        # and effect surfaces (tracer, explainer), configure the knobs,
+        # and run the control loop.  The first subsystem where the
+        # telemetry stack steers the data plane — and like every other
+        # knob block, malformed config must never stop the server.
+        res = registry.get("resilience")
+        if res is not None:
+            res.bind(events=registry.get("events"),
+                     slo=registry.get("slo"),
+                     runtimestats=registry.get("runtimestats"),
+                     tracer=registry.tracer,
+                     explain=registry.get("explain"))
+            res.configure(cfg.resilience_config())
+            # the tracer/explain knob blocks above just re-applied the
+            # OPERATOR sampling values; if the ladder is degraded the L1
+            # shed must win again (and remember the NEW values to
+            # restore on recovery)
+            res.resync_knob_effects()
+            if res.enabled:
+                res.start(res.interval_s)
+            else:
+                res.stop()
+    except Exception as exc:
+        component_event("bootstrap", "resilience_config_invalid",
                         error=str(exc)[:200], level="warning")
 
 
@@ -642,6 +690,9 @@ def serve(config_path: str, port: int = 8801,
                 client = KubeClient.in_cluster()
             server.kube_operator = KubeOperator(
                 client, config_path).start()
+            # close the loop: SLO alerts + degradation-ladder moves
+            # surface as IntelligentPool status conditions/scale hints
+            server.kube_operator.attach_bus(server.registry.get("events"))
             component_event("bootstrap", "kube_operator_started",
                             namespace=client.namespace)
         except Exception as exc:

@@ -79,14 +79,34 @@ class KubeClient:
         return (f"{self.base_url}/apis/{GROUP}/{VERSION}/namespaces/"
                 f"{self.namespace}/{plural}")
 
-    def _request(self, url: str, timeout: Optional[float] = None):
-        req = urllib.request.Request(url)
+    def _request(self, url: str, timeout: Optional[float] = None,
+                 method: str = "GET", data: Optional[bytes] = None,
+                 content_type: str = ""):
+        req = urllib.request.Request(url, data=data, method=method)
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
+        if content_type:
+            req.add_header("Content-Type", content_type)
         kwargs: Dict[str, Any] = {"timeout": timeout or self.timeout_s}
         if self._ssl_ctx is not None:
             kwargs["context"] = self._ssl_ctx
         return urllib.request.urlopen(req, **kwargs)
+
+    def patch_status(self, plural: str, name: str,
+                     status: Dict[str, Any]) -> bool:
+        """Merge-patch a CR's status subresource (the controller's
+        reporting surface: SLO alert conditions + scale hints land
+        here).  False on any failure — status is best-effort, the
+        controller must keep reconciling without it."""
+        body = json.dumps({"status": status}).encode()
+        url = f"{self._path(plural)}/{name}/status"
+        try:
+            with self._request(url, method="PATCH", data=body,
+                               content_type="application/"
+                                            "merge-patch+json") as resp:
+                return 200 <= resp.status < 300
+        except (urllib.error.URLError, OSError, ValueError):
+            return False
 
     def list(self, plural: str) -> Tuple[List[Dict], str]:
         """(items, resourceVersion)."""
@@ -156,6 +176,139 @@ class KubeOperator:
         self._threads: List[threading.Thread] = []
         self.last_status = ""
         self.reconcile_count = 0
+        # SLO / degradation reactions (ISSUE 5 satellite — the PR 4
+        # open item "no operator yet SUBSCRIBES to slo_alert_firing"):
+        # runtime events land here as kube-convention status conditions
+        # + a scale hint, pushed to the IntelligentPool's /status
+        self._bus_unsub: Optional[Callable[[], None]] = None
+        self.status_conditions: Dict[str, Dict[str, Any]] = {}
+        self.scale_hint = "steady"
+        self._firing_objectives: Dict[str, str] = {}
+        self._degradation_level = 0
+        self.status_push_count = 0
+        # status pushes run on their own thread: the event-bus callback
+        # must never hold the SLO monitor's / degradation controller's
+        # emitting thread hostage to a slow kube API (a 30s PATCH stall
+        # inside the control loop would blind it during the incident)
+        self._status_dirty = threading.Event()
+        self._status_thread: Optional[threading.Thread] = None
+
+    # -- SLO / degradation status surface ------------------------------
+
+    def attach_bus(self, bus) -> "KubeOperator":
+        """Subscribe to the runtime event bus: SLO alert transitions and
+        degradation-ladder moves become CRD status conditions and a
+        scale hint on the IntelligentPool — the operator now REACTS to
+        the telemetry stack instead of only regenerating config."""
+        if bus is None:
+            return self
+        if self._bus_unsub is not None:
+            try:
+                self._bus_unsub()
+            except Exception:
+                pass
+        self._bus_unsub = bus.subscribe(self._on_runtime_event)
+        if self._status_thread is None or not self._status_thread.is_alive():
+            self._status_thread = threading.Thread(
+                target=self._status_loop, daemon=True,
+                name="kubewatch-status")
+            self._status_thread.start()
+        return self
+
+    def _on_runtime_event(self, ev) -> None:
+        """Event-bus callback: bookkeeping only — the PATCH happens on
+        the status thread, so the emitter (SLO monitor / degradation
+        controller) never blocks on the kube API."""
+        try:
+            from .events import (
+                DEGRADATION_LEVEL_CHANGED,
+                SLO_ALERT_FIRING,
+                SLO_ALERT_RESOLVED,
+            )
+
+            if ev.stage == SLO_ALERT_FIRING:
+                self._firing_objectives[str(ev.detail.get(
+                    "objective", ""))] = str(ev.detail.get("severity",
+                                                           "fast"))
+            elif ev.stage == SLO_ALERT_RESOLVED:
+                self._firing_objectives.pop(
+                    str(ev.detail.get("objective", "")), None)
+            elif ev.stage == DEGRADATION_LEVEL_CHANGED:
+                self._degradation_level = int(ev.detail.get("to_level",
+                                                            0))
+            else:
+                return
+            self._recompute_conditions()
+            self._status_dirty.set()
+        except Exception:
+            pass  # status reporting must never hurt the controller
+
+    def _status_loop(self) -> None:
+        while not self._stop.is_set():
+            if not self._status_dirty.wait(timeout=0.5):
+                continue
+            self._status_dirty.clear()
+            try:
+                self._push_status()
+            except Exception:
+                pass
+
+    def _recompute_conditions(self) -> None:
+        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+        def _set(ctype: str, status: bool, reason: str,
+                 message: str) -> None:
+            cur = self.status_conditions.get(ctype)
+            changed = cur is None or cur["status"] != \
+                ("True" if status else "False")
+            self.status_conditions[ctype] = {
+                "type": ctype,
+                "status": "True" if status else "False",
+                "reason": reason,
+                "message": message,
+                "lastTransitionTime": now if changed
+                else cur["lastTransitionTime"],
+            }
+
+        firing = dict(self._firing_objectives)
+        _set("SLOAlertFiring", bool(firing),
+             ",".join(sorted(firing)) or "AllObjectivesHealthy",
+             f"{len(firing)} SLO objective(s) burning budget"
+             if firing else "no burn-rate alerts firing")
+        lvl = self._degradation_level
+        _set("Degraded", lvl > 0, f"DegradationLevel{lvl}",
+             f"shed ladder at L{lvl}" if lvl
+             else "serving at full quality")
+        # scale hint: a fast-severity burn or a brownout+ ladder means
+        # the pool needs replicas, not just patience
+        fast = any(sev == "fast" for sev in firing.values())
+        if fast or lvl >= 2:
+            self.scale_hint = "scale_up"
+        elif firing or lvl > 0:
+            self.scale_hint = "hold"
+        else:
+            self.scale_hint = "steady"
+
+    def _push_status(self) -> None:
+        """Best-effort merge-patch onto the (first) IntelligentPool's
+        status subresource; no pool = conditions stay local (served via
+        operator introspection)."""
+        with self._state_lock:
+            pools = list(self._state.get("intelligentpools", {}).values())
+        if not pools:
+            return
+        pool = sorted(pools, key=self._key)[0]
+        meta = pool.get("metadata", {}) or {}
+        name = meta.get("name", "")
+        if not name:
+            return
+        ok = self.client.patch_status(
+            "intelligentpools", name,
+            {"conditions": sorted(self.status_conditions.values(),
+                                  key=lambda c: c["type"]),
+             "scaleHint": self.scale_hint})
+        if ok:
+            self.status_push_count += 1
 
     # -- state ---------------------------------------------------------
 
@@ -266,6 +419,12 @@ class KubeOperator:
     def stop(self) -> None:
         self._stop.set()
         self._dirty.set()
+        if self._bus_unsub is not None:
+            try:
+                self._bus_unsub()
+            except Exception:
+                pass
+            self._bus_unsub = None
 
 
 # ---------------------------------------------------------------------------
@@ -324,6 +483,51 @@ class MiniKubeAPI:
                     self.send_header("content-length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+
+            def do_PATCH(self):
+                if api.token:
+                    auth = self.headers.get("Authorization", "")
+                    if auth != f"Bearer {api.token}":
+                        self.send_response(401)
+                        self.end_headers()
+                        return
+                parts = self.path.strip("/").split("/")
+                # apis/{group}/{version}/namespaces/{ns}/{plural}/{name}
+                # /status — the status subresource the operator patches
+                if len(parts) != 8 or parts[0] != "apis" \
+                        or parts[7] != "status":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                plural, name, ns = parts[5], parts[6], parts[4]
+                length = int(self.headers.get("content-length", 0))
+                try:
+                    patch = json.loads(self.rfile.read(length) or b"{}")
+                except json.JSONDecodeError:
+                    self.send_response(400)
+                    self.end_headers()
+                    return
+                with api._lock:
+                    obj = api._objects.get(plural, {}).get(f"{ns}/{name}")
+                    if obj is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    # merge-patch semantics on the status subresource
+                    status = dict(obj.get("status", {}) or {})
+                    status.update(patch.get("status", {}) or {})
+                    obj["status"] = status
+                    api._rv += 1
+                    obj["metadata"]["resourceVersion"] = str(api._rv)
+                    body = json.dumps(obj).encode()
+                    # no watch broadcast: status-subresource updates are
+                    # the operator's OWN writes — replaying them into
+                    # its watch would only churn the reconcile debounce
+                self.send_response(200)
+                self.send_header("content-type", "application/json")
+                self.send_header("content-length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def _serve_watch(self, plural, params):
                 q = _Queue()

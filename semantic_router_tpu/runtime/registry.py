@@ -22,8 +22,9 @@ import threading
 from typing import Any, Dict, Optional
 
 _SLOTS = ("metrics", "tracer", "sessions", "profiler", "events",
-          "flightrec", "runtimestats", "slo", "explain", "engine",
-          "cache", "memory_store", "vectorstores", "replay_store")
+          "flightrec", "runtimestats", "slo", "explain", "resilience",
+          "engine", "cache", "memory_store", "vectorstores",
+          "replay_store")
 
 
 class RuntimeRegistry:
@@ -47,6 +48,7 @@ class RuntimeRegistry:
         from ..observability.session import default_session_telemetry
         from ..observability.slo import default_slo_monitor
         from ..observability.tracing import default_tracer
+        from ..resilience.controller import default_degradation_controller
         from .events import default_bus
 
         base: Dict[str, Any] = {
@@ -59,6 +61,7 @@ class RuntimeRegistry:
             "runtimestats": default_runtime_stats,
             "slo": default_slo_monitor,
             "explain": default_decision_explainer,
+            "resilience": default_degradation_controller,
         }
         base.update(overrides)
         return cls(**base)
@@ -83,9 +86,12 @@ class RuntimeRegistry:
         from ..observability.session import SessionTelemetry
         from ..observability.slo import SLOMonitor
         from ..observability.tracing import Tracer
+        from ..resilience.controller import DegradationController
+        from ..resilience.costmodel import CostModel
         from .events import EventBus
 
         metrics = MetricsRegistry()
+        runtimestats = RuntimeStats(metrics)
         base: Dict[str, Any] = {
             "metrics": metrics,
             "tracer": Tracer(),
@@ -96,11 +102,15 @@ class RuntimeRegistry:
             # runtime telemetry + SLO engine write into THIS instance's
             # metrics registry, so embedded routers' llm_runtime_*/
             # llm_slo_* series stay isolated like everything else
-            "runtimestats": RuntimeStats(metrics),
+            "runtimestats": runtimestats,
             "slo": SLOMonitor(metrics),
             # per-instance decision-record ring: an embedded router's
             # audit trail never mixes with another's
             "explain": DecisionExplainer(),
+            # per-instance degradation ladder: one router browning out
+            # must never shed a sibling's traffic
+            "resilience": DegradationController(
+                metrics, cost_model=CostModel(runtimestats)),
         }
         base.update(overrides)
         return cls(**base)

@@ -31,6 +31,13 @@ class DispatchReport:
     results: Dict[str, SignalResult] = field(default_factory=dict)
     wall_s: float = 0.0
     projection_trace: Optional[ProjectionTrace] = None
+    # set by Router.evaluate_signals: whether the evaluated view was
+    # prompt-compressed.  route() reuses the PREFETCH's decision when
+    # consuming precomputed signals, so a degradation-ladder transition
+    # between prefetch and route cannot make ctx.user_text diverge from
+    # the text the signals actually saw.  None = not recorded (direct
+    # dispatcher callers).
+    compressed_view: Optional[bool] = None
 
 
 class SignalDispatcher:
@@ -50,6 +57,15 @@ class SignalDispatcher:
         if self.used_types is None:
             return list(self.evaluators.values())
         return [e for t, e in self.evaluators.items() if t in self.used_types]
+
+    def learned_types(self) -> List[str]:
+        """Families backed by an inference engine (device work) — the
+        set the resilience brownout (L2) skips for low-priority
+        requests, so fused-bank capacity stays reserved for traffic
+        that keeps full service.  Heuristic families never appear here:
+        brownout must degrade quality, not kill routing."""
+        return sorted(t for t, e in self.evaluators.items()
+                      if getattr(e, "engine", None) is not None)
 
     def evaluate(self, ctx: RequestContext,
                  skip_signals: Optional[List[str]] = None

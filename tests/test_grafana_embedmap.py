@@ -50,9 +50,9 @@ class TestGrafana:
         rc = main(["grafana", "--out-dir", str(tmp_path / "g")])
         assert rc == 0
         out = json.loads(capsys.readouterr().out)
-        # 6 curated dashboards (incl. Runtime & SLO and Decisions) +
-        # catalog + provider
-        assert len(out["rendered"]) == 8
+        # 7 curated dashboards (incl. Runtime & SLO, Decisions, and
+        # Resilience) + catalog + provider
+        assert len(out["rendered"]) == 9
 
 
 class TestEmbedMap:

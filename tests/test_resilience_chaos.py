@@ -1,0 +1,455 @@
+"""Chaos e2e for the degradation ladder (make resilience-smoke,
+tier-1; ISSUE 5 acceptance).
+
+A fault_proxy plan turns an injected signal backend into a 100%-error
+dependency; the resulting fail-open errors burn the signal error-rate
+SLO inside its FAST window, the alert lands on the runtime event bus,
+and the controller must:
+
+- escalate L0 → L1 → L2 → L3 monotonically (one rung per tick),
+- shed priority-aware: at L2/L3 high-priority requests still route
+  with LEARNED signals while low-priority traffic runs heuristic-only
+  and (at L3) the lowest class gets 429 + Retry-After,
+- recover to L0 with hysteresis once the faults clear — and restore
+  the operator's sampling knobs exactly,
+
+with every transition visible as runtime events, metrics, and
+decision-record annotations.  A second leg proves the HTTP surface
+(shed response + x-vsr-degradation-level echo + /debug/resilience),
+the durable explain mirror, and the kube operator's CRD status
+conditions."""
+
+import urllib.error
+import urllib.request
+
+import pytest
+
+from semantic_router_tpu.config.schema import (
+    Decision,
+    DomainRule,
+    ModelRef,
+    NamedRule,
+    RouterConfig,
+    RuleNode,
+    SignalsConfig,
+)
+from semantic_router_tpu.engine.testing import make_shared_trunk_engine
+from semantic_router_tpu.observability.explain import DecisionExplainer
+from semantic_router_tpu.observability.flightrec import FlightRecorder
+from semantic_router_tpu.observability.metrics import (
+    MetricSeries,
+    MetricsRegistry,
+)
+from semantic_router_tpu.observability.slo import SLOMonitor
+from semantic_router_tpu.observability.tracing import Tracer
+from semantic_router_tpu.resilience import DegradationController
+from semantic_router_tpu.router import headers as H
+from semantic_router_tpu.router.fault_proxy import FaultProxy
+from semantic_router_tpu.router.mock_backend import MockVLLMServer
+from semantic_router_tpu.router.pipeline import Router
+from semantic_router_tpu.runtime.events import (
+    DEGRADATION_LEVEL_CHANGED,
+    EventBus,
+    SLO_ALERT_FIRING,
+)
+from semantic_router_tpu.signals.base import SignalHit, SignalResult
+
+
+class ProxiedSignal:
+    """The injected signal backend: evaluates by calling an HTTP
+    dependency THROUGH the fault proxy — exactly the remote-classifier
+    shape, so fault_proxy plans script its failure modes."""
+
+    signal_type = "chaos"
+    engine = None  # heuristic family: brownout never silences it
+
+    def __init__(self, url: str) -> None:
+        self.url = url
+
+    def evaluate(self, ctx):
+        with urllib.request.urlopen(self.url + "/health",
+                                    timeout=5) as resp:
+            resp.read()
+        return SignalResult(signal_type="chaos",
+                            hits=[SignalHit(rule="reachable")])
+
+
+def _cfg() -> RouterConfig:
+    return RouterConfig(
+        default_model="fallback-model",
+        signals=SignalsConfig(
+            domains=[DomainRule(name=lbl) for lbl in
+                     ("business", "law", "health", "computer science",
+                      "other")],
+            fact_check=[NamedRule(name="fact_check")],
+        ),
+        decisions=[Decision(
+            name="law_route", priority=100,
+            rules=RuleNode(operator="OR", conditions=[
+                RuleNode(signal_type="domain", name="law")]),
+            model_refs=[ModelRef(model="model-large")],
+        )],
+        resilience={
+            "enabled": True,
+            "escalate_ticks": 1,
+            "hysteresis_ticks": 2,
+            "max_level": 3,  # chaos leg proves L0→L3; L4 is unit-tested
+        },
+    )
+
+
+@pytest.fixture(scope="module")
+def stack():
+    backend = MockVLLMServer().start()
+    proxy = FaultProxy(backend.url, plan=["error"]).start()
+    registry = MetricsRegistry()
+    series = MetricSeries(registry)
+    bus = EventBus()
+    mon = SLOMonitor(registry)
+    mon.event_bus = bus
+    mon.configure({"objectives": ["signal error-rate < 1% over 0.2s"]})
+    controller = DegradationController(registry)
+    controller.bind(events=bus, slo=mon)
+    engine = make_shared_trunk_engine(metrics=MetricSeries(
+        MetricsRegistry()))
+    explainer = DecisionExplainer(ring_size=512)
+    tracer = Tracer(sample_rate=0.25)
+    cfg = _cfg()
+    router = Router(cfg, engine=engine, metrics=series, tracer=tracer,
+                    flightrec=FlightRecorder(), explain=explainer,
+                    resilience=controller)
+    controller.bind(tracer=tracer, explain=explainer)
+    controller.configure(cfg.resilience_config())
+    # the chaos family joins the live dispatcher (and the used-types
+    # gate) exactly as a remote classifier would
+    router.dispatcher.evaluators["chaos"] = ProxiedSignal(proxy.url)
+    if router.dispatcher.used_types is not None:
+        router.dispatcher.used_types.add("chaos")
+    yield {
+        "router": router, "controller": controller, "monitor": mon,
+        "bus": bus, "proxy": proxy, "series": series,
+        "explainer": explainer, "tracer": tracer, "registry": registry,
+    }
+    router.shutdown()
+    engine.shutdown()
+    proxy.stop()
+    backend.stop()
+
+
+def _route(router, text="sue them for breach of contract", **headers):
+    return router.route(
+        {"model": "auto",
+         "messages": [{"role": "user", "content": text}]},
+        headers=headers or None)
+
+
+class TestChaosLadder:
+    """Ordered phases over one module-scoped stack — escalation, then
+    priority-aware shedding, then recovery."""
+
+    def test_1_fault_plan_fires_fast_alert_within_window(self, stack):
+        mon, router = stack["monitor"], stack["router"]
+        mon.tick(now=100.0)
+        for i in range(40):
+            res = _route(router, f"what is the capital of france #{i}")
+            assert res.kind == "route"  # fail-open: errors never block
+            assert res.report.results["chaos"].error
+        mon.tick(now=100.2)  # the fast window closes over 100% errors
+        assert "signal_error_rate" in mon.degraded()
+        firing = stack["bus"].recent(10, stage=SLO_ALERT_FIRING)
+        assert firing and firing[0].detail["severity"] == "fast"
+
+    def test_2_monotone_escalation_to_admission(self, stack):
+        c = stack["controller"]
+        assert c.level() == 0
+        levels = [c.tick() for _ in range(4)]
+        assert levels == [1, 2, 3, 3]  # monotone, one rung per tick
+        changes = stack["bus"].recent(
+            10, stage=DEGRADATION_LEVEL_CHANGED)
+        assert [e.detail["to_level"] for e in changes] == [3, 2, 1]
+        # L1 knob shedding took effect on the bound surfaces
+        assert stack["tracer"].sample_rate == 0.0
+        assert stack["explainer"].sample_rate == pytest.approx(0.1)
+
+    def test_3_priority_aware_brownout_and_shedding(self, stack):
+        router, c = stack["router"], stack["controller"]
+        assert c.level() == 3
+        # low priority: shed outright with 429 + Retry-After
+        res = _route(router, **{H.PRIORITY: "low"})
+        assert res.kind == "shed" and res.status == 429
+        assert "retry-after" in res.headers
+        assert res.headers[H.DEGRADATION] == "3"
+        assert res.response_body["error"]["type"] == "overloaded"
+        # critical: full service — learned families still evaluate
+        res = _route(router, **{H.PRIORITY: "critical"})
+        assert res.kind == "route"
+        assert "domain" in res.report.results
+        assert res.headers.get(H.DEGRADATION) == "3"
+        # normal: served, but heuristic-only (learned families skipped;
+        # the heuristic chaos family still runs)
+        res = _route(router, **{H.PRIORITY: "normal"})
+        assert res.kind == "route"
+        assert "domain" not in res.report.results
+        assert "fact_check" not in res.report.results
+        assert "chaos" in res.report.results
+        assert res.model == "fallback-model"  # no signals → default
+        # the streamed-prefetch seam is gated the same way: a browned-
+        # out class must not burn fused-bank capacity on an early
+        # evaluation the inline path would skip
+        body = {"model": "auto", "messages": [
+            {"role": "user", "content": "sue them for breach"}]}
+        _, rep = router.evaluate_signals(body,
+                                         {H.PRIORITY: "normal"})
+        assert "domain" not in rep.results
+        assert rep.compressed_view is False  # L1+ sheds compression
+        _, rep = router.evaluate_signals(body,
+                                         {H.PRIORITY: "critical"})
+        assert "domain" in rep.results
+
+    def test_4_shed_metrics_and_gauge_exposed(self, stack):
+        text = stack["registry"].expose()
+        assert "llm_degradation_level 3" in text
+        assert 'llm_shed_total{level="admission",priority="low"}' in text
+        assert "llm_degradation_transitions_total" in text
+
+    def test_5_decision_records_annotate_the_level(self, stack):
+        ex, router = stack["explainer"], stack["router"]
+        # sampling was floored at L1 — force-record one brownout request
+        ex.sample_rate = 1.0
+        res = _route(router, **{H.PRIORITY: "normal"})
+        ex.sample_rate = 0.1
+        rec = ex.get(res.decision_record_id)
+        assert rec is not None
+        assert rec["degradation_level"] == 3
+        from semantic_router_tpu.observability.explain import (
+            validate_record,
+        )
+
+        assert validate_record(rec) == []
+
+    def test_6_recovery_with_hysteresis(self, stack):
+        c, mon, series = stack["controller"], stack["monitor"], \
+            stack["series"]
+        with stack["proxy"]._lock:  # faults clear: plan flips to ok
+            stack["proxy"].plan = ["ok"]
+            stack["proxy"]._plan_i = 0
+        # clean traffic washes the burn out of every window pair
+        # (injected clock, same technique as test_slo)
+        t = 100.2
+        for i in range(90):
+            t += 0.2
+            for _ in range(20):
+                series.signal_latency.observe(0.001, family="chaos")
+            mon.tick(now=t)
+        assert mon.degraded() == []
+        levels = [c.tick() for _ in range(7)]
+        # hysteresis_ticks=2: two healthy ticks per rung down, never
+        # skipping a rung
+        assert levels == [3, 2, 2, 1, 1, 0, 0]
+        # operator knobs restored exactly on reaching L0 (the values
+        # saved when the ladder was entered, not the floored ones)
+        assert stack["tracer"].sample_rate == 0.25
+        assert stack["explainer"].sample_rate == 1.0
+        # full service again
+        res = _route(stack["router"], **{H.PRIORITY: "low"})
+        assert res.kind == "route" and "domain" in res.report.results
+        assert H.DEGRADATION not in res.headers
+
+
+class TestHTTPSurface:
+    """Shed responses + degradation echo + /debug/resilience over the
+    real HTTP server (no engine — the ladder is engine-agnostic)."""
+
+    @pytest.fixture()
+    def server(self, tmp_path):
+        import json as _json
+
+        from semantic_router_tpu.observability.explain_store import (
+            SQLiteDecisionStore,
+        )
+        from semantic_router_tpu.router.server import RouterServer
+        from semantic_router_tpu.runtime.registry import RuntimeRegistry
+
+        backend = MockVLLMServer().start()
+        registry = RuntimeRegistry.isolated()
+        controller = registry.get("resilience")
+        controller.bind(events=registry.get("events"))
+        cfg = _cfg()
+        controller.configure(cfg.resilience_config())
+        explainer = registry.get("explain")
+        explainer.attach_durable(SQLiteDecisionStore(
+            str(tmp_path / "decisions.db")))
+        router = Router(cfg, metrics=registry.metric_series(),
+                        tracer=registry.tracer,
+                        flightrec=registry.get("flightrec"),
+                        explain=explainer, resilience=controller)
+        srv = RouterServer(router, cfg, default_backend=backend.url,
+                           registry=registry).start()
+        yield srv, controller, registry
+        srv.stop()
+        router.shutdown()
+        backend.stop()
+
+    def _post(self, url, payload, headers=None):
+        import json as _json
+
+        req = urllib.request.Request(
+            url + "/v1/chat/completions",
+            data=_json.dumps(payload).encode(), method="POST")
+        req.add_header("content-type", "application/json")
+        for k, v in (headers or {}).items():
+            req.add_header(k, v)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, dict(resp.headers), \
+                    _json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers), _json.loads(e.read() or b"{}")
+
+    def _escalate(self, controller, registry, to_level):
+        registry.get("events").emit(SLO_ALERT_FIRING, objective="o",
+                                    severity="fast")
+        for _ in range(to_level):
+            controller.tick()
+        assert controller.level() == to_level
+
+    def test_shed_response_and_echo(self, server):
+        srv, controller, registry = server
+        body = {"model": "auto", "messages": [
+            {"role": "user", "content": "hello"}]}
+        status, headers, _ = self._post(srv.url, body)
+        assert status == 200 and H.DEGRADATION not in headers
+        self._escalate(controller, registry, 3)
+        status, headers, payload = self._post(
+            srv.url, body, {H.PRIORITY: "low"})
+        assert status == 429
+        assert payload["error"]["type"] == "overloaded"
+        assert headers.get("retry-after")
+        assert headers.get(H.DEGRADATION) == "3"
+        # higher classes still serve, with the level echoed
+        status, headers, _ = self._post(srv.url, body,
+                                        {H.PRIORITY: "critical"})
+        assert status == 200
+        assert headers.get(H.DEGRADATION) == "3"
+
+    def test_debug_resilience_endpoint(self, server):
+        import json as _json
+
+        srv, controller, registry = server
+        self._escalate(controller, registry, 2)
+        with urllib.request.urlopen(srv.url + "/debug/resilience",
+                                    timeout=10) as resp:
+            rep = _json.loads(resp.read())
+        assert rep["level"] == 2 and rep["level_name"] == "brownout"
+        assert rep["pressure"]["firing"] == {"o": "fast"}
+
+    def test_durable_decisions_survive_and_serve(self, server, tmp_path):
+        import json as _json
+
+        from semantic_router_tpu.observability.explain_store import (
+            SQLiteDecisionStore,
+        )
+
+        srv, controller, registry = server
+        body = {"model": "auto", "messages": [
+            {"role": "user", "content": "hello"}]}
+        status, headers, _ = self._post(srv.url, body)
+        assert status == 200
+        rid = headers.get(H.DECISION_RECORD)
+        assert rid
+        # served from the durable mirror
+        with urllib.request.urlopen(
+                srv.url + "/debug/decisions?source=durable",
+                timeout=10) as resp:
+            out = _json.loads(resp.read())
+        assert out["source"] == "durable"
+        assert any(r["record_id"] == rid for r in out["records"])
+        # the mirror survives a "restart": a fresh store handle over the
+        # same file still finds the record after the ring is gone
+        registry.get("explain").clear()
+        assert registry.get("explain").get(rid) is None
+        with urllib.request.urlopen(
+                srv.url + f"/debug/decisions/{rid}?source=durable",
+                timeout=10) as resp:
+            rec = _json.loads(resp.read())
+        assert rec["record_id"] == rid
+        reopened = SQLiteDecisionStore(str(tmp_path / "decisions.db"))
+        assert reopened.get(rid)["record_id"] == rid
+        reopened.close()
+
+
+class TestKubeStatusConditions:
+    """The PR 4 open item: the operator SUBSCRIBES to slo_alert_firing
+    (and ladder transitions) and surfaces them as IntelligentPool status
+    conditions + a scale hint."""
+
+    def test_events_become_crd_status(self, tmp_path):
+        import json as _json
+        import time as _time
+
+        from semantic_router_tpu.runtime.kubewatch import (
+            GROUP,
+            KubeClient,
+            KubeOperator,
+            MiniKubeAPI,
+        )
+
+        api = MiniKubeAPI()
+        try:
+            api.apply("intelligentpools", {
+                "apiVersion": f"{GROUP}/v1alpha1",
+                "kind": "IntelligentPool",
+                "metadata": {"name": "pool"},
+                "spec": {"defaultModel": "m", "models": [{"name": "m"}]},
+            })
+            client = KubeClient(api.url)
+            op = KubeOperator(client, str(tmp_path / "cfg.yaml")).start()
+            bus = EventBus()
+            op.attach_bus(bus)
+            try:
+                deadline = _time.time() + 10
+                while _time.time() < deadline and not op._state.get(
+                        "intelligentpools"):
+                    _time.sleep(0.05)
+                bus.emit(SLO_ALERT_FIRING, objective="lat_p99",
+                         severity="fast")
+                bus.emit(DEGRADATION_LEVEL_CHANGED, from_level=1,
+                         to_level=2, direction="escalate",
+                         reason="fast_alert")
+                deadline = _time.time() + 10
+                while _time.time() < deadline \
+                        and op.status_push_count < 2:
+                    _time.sleep(0.05)
+                assert op.status_push_count >= 2
+                items, _ = client.list("intelligentpools")
+                status = items[0].get("status", {})
+                conds = {c["type"]: c for c in status.get("conditions",
+                                                          [])}
+                assert conds["SLOAlertFiring"]["status"] == "True"
+                assert "lat_p99" in conds["SLOAlertFiring"]["reason"]
+                assert conds["Degraded"]["status"] == "True"
+                assert status.get("scaleHint") == "scale_up"
+                # resolution flips the conditions back
+                from semantic_router_tpu.runtime.events import (
+                    SLO_ALERT_RESOLVED,
+                )
+
+                bus.emit(SLO_ALERT_RESOLVED, objective="lat_p99")
+                bus.emit(DEGRADATION_LEVEL_CHANGED, from_level=2,
+                         to_level=0, direction="de_escalate",
+                         reason="recovered")
+                deadline = _time.time() + 10
+                while _time.time() < deadline \
+                        and op.status_push_count < 4:
+                    _time.sleep(0.05)
+                items, _ = client.list("intelligentpools")
+                status = items[0].get("status", {})
+                conds = {c["type"]: c for c in status.get("conditions",
+                                                          [])}
+                assert conds["SLOAlertFiring"]["status"] == "False"
+                assert conds["Degraded"]["status"] == "False"
+                assert status.get("scaleHint") == "steady"
+            finally:
+                op.stop()
+        finally:
+            api.close()
