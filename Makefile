@@ -2,8 +2,8 @@ PY ?= python
 SHELL := /bin/bash
 
 .PHONY: test test-fast tier1 trace-smoke metrics-lint explain-smoke \
-	resilience-smoke native bench bench-replay perf perf-record \
-	serve-mock clean
+	resilience-smoke fleet-smoke native bench bench-replay perf \
+	perf-record serve-mock clean
 
 bench-replay:
 	$(PY) benchmarks/replay_bench.py --n 500 \
@@ -58,6 +58,17 @@ explain-smoke:
 resilience-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience.py \
 	  tests/test_resilience_chaos.py -q -p no:cacheprovider
+
+# multi-replica gate (docs/STATE_PLANE.md): 3 in-process router
+# replicas share one MiniRedis state plane — a cache entry written
+# through replica A must hit on B/C, fault-proxy overload on one
+# replica must converge every replica to the same degradation level
+# within one poll, and killing the backend mid-run must degrade to
+# local-only state with zero request failures (restart re-attaches and
+# replays buffered writes).  Tier-1 (runs inside `make tier1` too).
+fleet-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_stateplane.py \
+	  tests/test_stateplane_chaos.py -q -p no:cacheprovider
 
 native:
 	$(PY) -m semantic_router_tpu.native.build

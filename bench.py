@@ -616,6 +616,146 @@ def _measure_resilience_overhead(platform: str) -> dict:
         engine.shutdown()
 
 
+def _measure_stateplane_overhead(platform: str) -> dict:
+    """signals/s through the FULL routing pipeline with a state plane
+    attached vs detached — the <1% acceptance gate for ISSUE 6.  At L0
+    the per-request plane cost is ONE consistent-hash ring lookup (the
+    affinity echo); plane round trips ride the controller tick thread
+    and the cache/mirror background writers, never the request thread.
+    Deterministic numbers alongside: ring owner_of ns, the RESP plane
+    round-trip mean over MiniRedis, and the cross-replica shared-cache
+    hit rate the fleet gate proves."""
+    import time as _time
+
+    from semantic_router_tpu.config.schema import (
+        DomainRule,
+        NamedRule,
+        RouterConfig,
+        SignalsConfig,
+    )
+    from semantic_router_tpu.engine.testing import make_shared_trunk_engine
+    from semantic_router_tpu.observability.flightrec import FlightRecorder
+    from semantic_router_tpu.observability.metrics import (
+        MetricSeries,
+        MetricsRegistry,
+    )
+    from semantic_router_tpu.observability.tracing import Tracer
+    from semantic_router_tpu.router.pipeline import Router
+    from semantic_router_tpu.state.resp import MiniRedis
+    from semantic_router_tpu.stateplane import (
+        GuardedBackend,
+        RespStateBackend,
+        SharedSemanticCache,
+        StatePlane,
+        build_backend,
+    )
+    from semantic_router_tpu.stateplane.harness import hash_embed
+
+    n_tasks = 3
+    n_iters = 40 if platform == "cpu" else 100
+    engine = make_shared_trunk_engine(
+        metrics=MetricSeries(MetricsRegistry()))
+    cfg = RouterConfig(
+        default_model="backend-model",
+        signals=SignalsConfig(
+            domains=[DomainRule(name=lbl) for lbl in
+                     ("business", "law", "health", "computer science",
+                      "other")],
+            fact_check=[NamedRule(name="fact_check")],
+            user_feedbacks=[NamedRule(name="positive"),
+                            NamedRule(name="negative")]))
+    plane = StatePlane(build_backend({"backend": "memory"}),
+                       replica_id="bench-a")
+    plane.heartbeat_once()
+    router = Router(cfg, engine=engine,
+                    metrics=MetricSeries(MetricsRegistry()),
+                    tracer=Tracer(sample_rate=0.0),
+                    flightrec=FlightRecorder(), explain=None,
+                    resilience=None)
+    router.explain = None
+    mini = MiniRedis().start()
+    try:
+        texts = [f"benchmark request number {i} about contract law"
+                 for i in range(16)]
+
+        def body(i: int) -> dict:
+            return {"model": "auto", "messages": [
+                {"role": "user", "content": texts[i % len(texts)]}]}
+
+        def run(attached: bool, n: int) -> float:
+            router.stateplane = plane if attached else None
+            t0 = _time.perf_counter()
+            for i in range(n):
+                router.route(body(i))
+            return n_tasks * n / (_time.perf_counter() - t0)
+
+        run(True, 10)  # warm jit cache + selector construction
+        off_rates, on_rates = [], []
+        for i in range(4):
+            order = (False, True) if i % 2 == 0 else (True, False)
+            for attached in order:
+                (on_rates if attached else off_rates).append(
+                    run(attached, n_iters))
+        off, on = max(off_rates), max(on_rates)
+
+        # deterministic hot-path cost: the affinity ring lookup the
+        # attached router pays per routed response
+        t0 = _time.perf_counter()
+        calls = 100_000
+        for i in range(calls):
+            plane.owner_of(texts[i % len(texts)])
+        owner_ns = (_time.perf_counter() - t0) / calls * 1e9
+
+        # plane round-trip mean over a real RESP socket (MiniRedis) —
+        # what every control-plane exchange (heartbeat, pressure
+        # publish, cache write) costs off the request thread
+        resp = GuardedBackend(RespStateBackend(port=mini.port))
+        for i in range(300):
+            resp.put(f"bench:k{i % 16}", b"v")
+            resp.get(f"bench:k{i % 16}")
+        roundtrip_ms = resp.mean_roundtrip_s() * 1e3
+
+        # cross-replica shared-cache hit rate: entries written through
+        # replica A, looked up through replica B (exact + similar)
+        embed = hash_embed()
+        mk = lambda rid: StatePlane(
+            GuardedBackend(RespStateBackend(port=mini.port)),
+            replica_id=rid, namespace="bench")
+        pa, pb = mk("bench-a"), mk("bench-b")
+        ca = SharedSemanticCache(pa, embed)
+        cb = SharedSemanticCache(pb, embed)
+        for i in range(24):
+            ca.add(f"benchmark query {i} about topic {i % 6}",
+                   f"answer {i}")
+        lookups = hits = 0
+        for i in range(24):
+            lookups += 1
+            if cb.find_similar(
+                    f"benchmark query {i} about topic {i % 6}"):
+                hits += 1
+        hit_rate = hits / lookups if lookups else 0.0
+        pa.close(), pb.close()
+        resp.close()
+
+        routes_per_s = max(off, on) / n_tasks
+        hot_pct = owner_ns * 1e-9 * routes_per_s * 100.0
+        return {
+            "engine_signals_per_s_plane_off": round(off, 1),
+            "engine_signals_per_s_plane_on": round(on, 1),
+            "stateplane_e2e_delta_pct":
+                round(100.0 * (off - on) / off, 2),
+            "affinity_lookup_ns": round(owner_ns, 1),
+            "plane_roundtrip_ms": round(roundtrip_ms, 4),
+            "shared_cache_cross_replica_hit_rate": round(hit_rate, 3),
+            "stateplane_overhead_pct": round(hot_pct, 4),
+        }
+    finally:
+        mini.stop()
+        plane.close()
+        router.shutdown()
+        engine.shutdown()
+
+
 def _measure_tracing_overhead(platform: str) -> dict:
     """signals/s through the tiny shared-trunk ENGINE (batcher + fused
     trunk group — the path batch tracing instruments) under three tracing
@@ -943,6 +1083,19 @@ def _run_bench(platform: str) -> None:
         sys.stderr.write(f"bench: resilience arm failed "
                          f"({type(exc).__name__}: {exc}); skipped\n")
 
+    # state-plane overhead arm (docs/STATE_PLANE.md, ISSUE 6
+    # acceptance): an attached plane must cost <1% of engine signals/s
+    # at L0 — one ring lookup per route; round trips stay off the
+    # request thread.  Also records the cross-replica shared-cache hit
+    # rate and the RESP plane round-trip mean.
+    stateplane_row = None
+    try:
+        stateplane_row = _measure_stateplane_overhead(platform)
+        sys.stderr.write(f"bench: stateplane overhead {stateplane_row}\n")
+    except Exception as exc:
+        sys.stderr.write(f"bench: stateplane arm failed "
+                         f"({type(exc).__name__}: {exc}); skipped\n")
+
     batch, signals_per_s, best_impl = best
     # On a CPU fallback the host geometry is the whole story (this image
     # exposes ONE 2.1GHz core — ~0.09 TFLOPs f32 roofline — while the
@@ -969,6 +1122,8 @@ def _run_bench(platform: str) -> None:
         record["explain"] = explain_row
     if resilience_row is not None:
         record["resilience"] = resilience_row
+    if stateplane_row is not None:
+        record["stateplane"] = stateplane_row
     if platform != "cpu":
         # side evidence for the bench README / judge: full sweep detail
         try:

@@ -1084,6 +1084,13 @@ class RouterConfig:
     # default_cost_ms}, fail_static: {model}, priority: {header,
     # trust_header, default, model_classes, group_classes}}
     resilience: Dict[str, Any] = field(default_factory=dict)
+    # shared state plane (stateplane/): pluggable fleet backend behind
+    # which the semantic cache, vector store, explain mirror, and
+    # fleet-wide degradation share state across N replicas — {enabled,
+    # backend: memory|resp|sqlite, replica_id, namespace, heartbeat_s,
+    # ttl_s, ring_vnodes, cooldown_s, share: {cache, vectorstore,
+    # explain, fleet}, backend_config: {host, port, path, ...}}
+    stateplane: Dict[str, Any] = field(default_factory=dict)
     # canonical v0.3 contract surface (canonical_config.go): named routing
     # profiles + virtual-model entrypoints + deployment listeners/providers
     recipes: List[RoutingRecipe] = field(default_factory=list)
@@ -1137,6 +1144,7 @@ class RouterConfig:
             learning=dict(routing.get("learning",
                                       d.get("learning", {})) or {}),
             resilience=dict(d.get("resilience", {}) or {}),
+            stateplane=dict(d.get("stateplane", {}) or {}),
             recipes=[RoutingRecipe.from_dict(r)
                      for r in d.get("recipes", []) or []],
             entrypoints=[Entrypoint.from_dict(e)
@@ -1297,6 +1305,60 @@ class RouterConfig:
               group_classes: {}      # authz group -> class
         """
         return dict(self.resilience or {})
+
+    def stateplane_config(self) -> Dict[str, Any]:
+        """Normalized ``stateplane`` block — the ONE interpretation
+        point (bootstrap, the fleet harness, and tests must never drift
+        on defaults)::
+
+          stateplane:
+            enabled: false         # default OFF: byte-identical
+                                   # single-process behavior
+            backend: resp          # memory | resp/redis/valkey | sqlite
+            backend_config:
+              host: redis.svc      # resp
+              port: 6379
+              path: /var/lib/vsr/plane.db   # sqlite
+            replica_id: ""         # default host-pid-nonce
+            namespace: srt         # key prefix on the shared store
+            heartbeat_s: 2         # membership beat; TTL = 3x
+            ring_vnodes: 64        # consistent-hash ring resolution
+            cooldown_s: 2          # breaker reopen probe interval
+            share:                 # which layers ride the plane
+              cache: true
+              vectorstore: true
+              explain: true
+              fleet: true          # fleet-aggregated shed ladder
+
+        Malformed values fall back to defaults — shared-state config
+        must never stop a replica."""
+        sp = dict(self.stateplane or {})
+        out: Dict[str, Any] = {
+            "enabled": bool(sp.get("enabled", False)),
+            "backend": str(sp.get("backend", "memory")),
+            "replica_id": str(sp.get("replica_id", "")),
+            "namespace": str(sp.get("namespace", "srt")) or "srt",
+            "backend_config": dict(sp.get("backend_config", {}) or {}),
+        }
+
+        def _f(key: str, default: float, lo: float) -> float:
+            try:
+                return max(lo, float(sp.get(key, default)))
+            except (TypeError, ValueError):
+                return default
+
+        out["heartbeat_s"] = _f("heartbeat_s", 2.0, 0.05)
+        out["ttl_s"] = _f("ttl_s", 0.0, 0.0)  # 0 = 3x heartbeat
+        out["cooldown_s"] = _f("cooldown_s", 2.0, 0.05)
+        try:
+            out["ring_vnodes"] = max(1, int(sp.get("ring_vnodes", 64)))
+        except (TypeError, ValueError):
+            out["ring_vnodes"] = 64
+        share = dict(sp.get("share", {}) or {})
+        out["share"] = {k: bool(share.get(k, True))
+                        for k in ("cache", "vectorstore", "explain",
+                                  "fleet")}
+        return out
 
     # -- recipes (pkg/config/recipes.go) -----------------------------------
 

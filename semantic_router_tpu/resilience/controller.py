@@ -78,6 +78,9 @@ class Disposition:
     fail_static: bool = False    # L4: static model, zero extraction
     retry_after_s: float = 0.0   # set on shed
     reason: str = ""
+    # families that stay active even when use_learned is False — the L2
+    # safety floor (jailbreak screening survives the brownout)
+    keep_families: tuple = ()
 
 
 _ALLOW = Disposition()  # the immutable L0 fast path
@@ -149,6 +152,16 @@ class DegradationController:
         self.fail_static_model = ""
         self.trace_sample_floor = 0.0
         self.decision_sample_floor = 0.1
+        # L2 brownout safety floor: these signal families stay ACTIVE
+        # even for priority classes that route heuristic-only — the
+        # jailbreak screen is cheap relative to the risk of browning it
+        # out (signals.dispatch.SAFETY_FAMILIES is the default set)
+        from ..signals.dispatch import SAFETY_FAMILIES
+
+        self.brownout_keep = frozenset(SAFETY_FAMILIES)
+        # ceiling for the drain-rate Retry-After estimate (a deep queue
+        # must not tell clients to come back in an hour)
+        self.retry_after_cap_s = 60.0
 
         self._level = L0_NORMAL
         self._over_ticks = 0
@@ -168,6 +181,13 @@ class DegradationController:
         self.event_bus = None
         self.slo = None
         self.runtime_stats = None
+        # shared state plane (stateplane.StatePlane): when bound, each
+        # tick publishes THIS replica's pressure and steps the ladder
+        # from the FLEET aggregate — N replicas shed as one.  Plane
+        # failures fall back to local sensors (never escalate on a
+        # partition).
+        self.fleet = None
+        self._fleet_view: Optional[Dict[str, Any]] = None
         self._unsubscribe: Optional[Callable[[], None]] = None
         # knob-shedding targets (L1 side effects) + saved values
         self._tracer = None
@@ -226,6 +246,18 @@ class DegradationController:
             self.brownout_min_rank = rank_of(
                 str(res_cfg.get("brownout_class", "")),
                 self.brownout_min_rank)
+            if "brownout_keep_families" in res_cfg:
+                try:
+                    self.brownout_keep = frozenset(
+                        str(f) for f in
+                        (res_cfg.get("brownout_keep_families") or ()))
+                except TypeError:
+                    pass
+            try:
+                self.retry_after_cap_s = max(1.0, float(res_cfg.get(
+                    "retry_after_cap_s", self.retry_after_cap_s)))
+            except (TypeError, ValueError):
+                pass
             adm = dict(res_cfg.get("admission", {}) or {})
             try:
                 self.admission_target_utilization = max(0.01, min(1.0, float(
@@ -270,14 +302,19 @@ class DegradationController:
             self._after_transition(old_level, new_level)
 
     def bind(self, events=None, slo=None, runtimestats=None,
-             tracer=None, explain=None) -> "DegradationController":
+             tracer=None, explain=None,
+             fleet=None) -> "DegradationController":
         """Attach the sensor/effect surfaces (registry slots).  Re-bind
-        is idempotent: the previous event subscription is dropped."""
+        is idempotent: the previous event subscription is dropped.
+        ``fleet`` is a stateplane.StatePlane — ticks then aggregate
+        fleet-wide pressure instead of this process's alone."""
         if runtimestats is not None:
             self.runtime_stats = runtimestats
             self.cost_model.runtime_stats = runtimestats
         if slo is not None:
             self.slo = slo
+        if fleet is not None:
+            self.fleet = fleet
         if tracer is not None:
             self._tracer = tracer
         if explain is not None:
@@ -361,6 +398,49 @@ class DegradationController:
                 pass
         return firing
 
+    def _fleet_exchange(self, firing: Dict[str, str],
+                        queues: Dict[str, float]
+                        ) -> Optional[Dict[str, Any]]:
+        """Publish this replica's pressure to the state plane and read
+        the fleet aggregate.  Any plane failure returns None — the tick
+        proceeds on LOCAL sensors only, so a partition degrades to
+        per-replica behavior instead of flapping the ladder or (worse)
+        treating the outage itself as overload."""
+        if self.fleet is None:
+            return None
+        try:
+            with self._lock:
+                level = self._level
+                engine_down = self._engine_down
+            self.fleet.publish_pressure({
+                "firing": dict(firing),
+                "pending_items": queues["pending_items"],
+                "pool_saturation": queues["pool_saturation"],
+                "engine_down": engine_down,
+                "level": level,
+                "interval_s": self.interval_s,
+            })
+            return self.fleet.fleet_pressure()
+        except Exception:
+            return None
+
+    def _drain_retry_s(self, fallback: float) -> float:
+        """Retry-After from the LIVE queue drain rate: backlog depth ×
+        the warm per-row device cost (runtimestats EWMAs through the
+        cost model) estimates when the queue will actually have
+        headroom again — replacing the static ladder-interval guess.
+        Pre-telemetry (or empty queue) keeps the fallback."""
+        try:
+            pending = float(self._last_pressure.get("pending_items",
+                                                    0.0))
+            per_row = self.cost_model.cost_per_row_s()
+            if per_row and pending > 0:
+                return max(1.0, min(self.retry_after_cap_s,
+                                    pending * per_row))
+        except Exception:
+            pass
+        return max(1.0, fallback)
+
     # -- the ladder --------------------------------------------------------
 
     def tick(self, now: Optional[float] = None) -> int:
@@ -371,6 +451,20 @@ class DegradationController:
             return self._level
         firing = self._alert_severities()
         queues = self._queue_pressure()
+        fleet_view = self._fleet_exchange(firing, queues)
+        if fleet_view is not None:
+            # the fleet aggregate is the sensor: worst queues anywhere,
+            # union of firing alerts — every replica steps from the
+            # same inputs, so levels converge within one poll interval
+            for name, sev in (fleet_view.get("firing") or {}).items():
+                if firing.get(name) != "fast":
+                    firing[name] = str(sev)
+            queues["pending_items"] = max(
+                queues["pending_items"],
+                float(fleet_view.get("pending_items", 0.0)))
+            queues["pool_saturation"] = max(
+                queues["pool_saturation"],
+                float(fleet_view.get("pool_saturation", 0.0)))
         fast = any(sev == "fast" for sev in firing.values())
         slow = bool(firing) and not fast
         pending = queues["pending_items"]
@@ -386,6 +480,13 @@ class DegradationController:
                 "pool_saturation": sat, "engine_down": engine_down,
                 "overloaded": overloaded, "stressed": stressed,
             }
+            if self.fleet is not None:
+                self._last_pressure["fleet"] = {
+                    "aggregated": fleet_view is not None,
+                    "replicas": (fleet_view or {}).get("replicas", 0),
+                    "levels": (fleet_view or {}).get("levels", {}),
+                }
+                self._fleet_view = fleet_view
             old = self._level
             if engine_down:
                 # a dead engine IS the fail-static posture — jump, don't
@@ -575,7 +676,10 @@ class DegradationController:
             use_learned = False
         if lvl >= L3_ADMISSION and rank > 0:
             if rank >= self.reject_min_rank:
-                retry = max(1.0, self.interval_s * self.hysteresis_ticks)
+                # Retry-After from the live drain rate (fallback: the
+                # static recovery-window guess this replaced)
+                retry = self._drain_retry_s(
+                    self.interval_s * self.hysteresis_ticks)
                 return self._shed(lvl, priority, retry,
                                   "lowest_class_rejected")
             if not self._buckets:
@@ -585,11 +689,14 @@ class DegradationController:
                 cost = self.cost_model.request_cost_s(n_signals)
                 if not bucket.try_take(cost):
                     return self._shed(lvl, priority,
-                                      max(1.0, bucket.wait_s(cost)),
+                                      max(bucket.wait_s(cost),
+                                          self._drain_retry_s(1.0)),
                                       "admission_bucket_empty")
         return Disposition(level=lvl, priority=priority,
                            use_learned=use_learned, shed_optional=True,
-                           reason=level_name(lvl))
+                           reason=level_name(lvl),
+                           keep_families=tuple(self.brownout_keep)
+                           if not use_learned else ())
 
     def _shed(self, lvl: int, priority: str, retry_after_s: float,
               reason: str) -> Disposition:
@@ -619,6 +726,8 @@ class DegradationController:
                 "escalate_ticks": self.escalate_ticks,
                 "brownout_class": PRIORITY_CLASSES[min(
                     self.brownout_min_rank, len(PRIORITY_CLASSES) - 1)],
+                "brownout_keep_families": sorted(self.brownout_keep),
+                "fleet_attached": self.fleet is not None,
                 "reject_class": PRIORITY_CLASSES[min(
                     self.reject_min_rank, len(PRIORITY_CLASSES) - 1)],
                 "pressure": dict(self._last_pressure),

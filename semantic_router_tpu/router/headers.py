@@ -36,6 +36,10 @@ DECISION_RECORD = "x-vsr-decision-record"
 # behind resilience.priority.trust_header)
 DEGRADATION = "x-vsr-degradation-level"
 PRIORITY = "x-vsr-priority"
+# state plane (stateplane/): the replica whose hot local state
+# (EncodingCache, fused-bank memos) this prompt maps to on the
+# consistent-hash ring — affinity-aware LBs key off this echo
+AFFINITY = "x-vsr-affinity-replica"
 
 
 def decision_headers(decision_name: str, model: str, category: str = "",

@@ -293,6 +293,16 @@ _META: Dict[tuple, Dict[str, Any]] = {
         "request": _ref("VectorSearchRequest")},
     ("GET", "/debug/profiler"): {
         "tag": "debug", "summary": "Profiler status."},
+    ("GET", "/debug/stateplane"): {
+        "tag": "debug",
+        "summary": "Shared-state-plane snapshot: replica membership, "
+                   "consistent-hash ring distribution, backend health, "
+                   "aggregated fleet pressure."},
+    ("GET", "/metrics/external"): {
+        "tag": "system", "open": True,
+        "summary": "ExternalMetricValueList-shaped scaling signals "
+                   "(llm_degradation_level, llm_queue_pressure) for "
+                   "KEDA / an HPA external-metrics adapter."},
     ("POST", "/debug/profiler/start"): {
         "tag": "debug", "summary": "Start a JAX profiler trace."},
     ("POST", "/debug/profiler/stop"): {

@@ -298,6 +298,10 @@ class Router:
         # optional subsystems (attach externally or via bootstrap)
         self.vectorstores = None  # vectorstore.VectorStoreManager
         self.memory_store = None  # memory.InMemoryMemoryStore
+        # shared state plane (stateplane.StatePlane): attached by
+        # bootstrap when stateplane.enabled; None = single-process
+        # posture, zero reads on the hot path
+        self.stateplane = None
 
     def skip_requested(self, headers: Dict[str, str]) -> bool:
         """True when the (operator-enabled) skip-processing header is on
@@ -395,9 +399,9 @@ class Router:
             try:
                 if self.resilience.browned_out(
                         self.priority.resolve(ctx)):
-                    skip = skip + (
-                        self._learned_types.get(id(dispatcher))
-                        or dispatcher.learned_types())
+                    skip = skip + self._learned_families(
+                        dispatcher,
+                        getattr(self.resilience, "brownout_keep", ()))
             except Exception:
                 pass
         if pending is None:
@@ -548,9 +552,7 @@ class Router:
         # profile may fan out a different learned set).
         dispatcher, decision_engine, via_entrypoint = \
             self._engines_for_model(ctx.model)
-        learned = self._learned_types.get(id(dispatcher))
-        if learned is None:  # carry-over dispatcher from a hot swap
-            learned = dispatcher.learned_types()
+        learned = self._learned_families(dispatcher)
         disp = None
         if self.resilience is not None \
                 and self.resilience.level() > 0:
@@ -598,10 +600,13 @@ class Router:
         if disp is not None and not disp.use_learned \
                 and precomputed_signals is None:
             # L2 brownout: this request's priority class routes on
-            # heuristics alone — every engine-backed family is skipped,
-            # reserving fused-bank capacity for higher classes.  (A
-            # streamed prefetch already paid the forward; keep it.)
-            skip = skip + learned
+            # heuristics alone — engine-backed families are skipped,
+            # reserving fused-bank capacity for higher classes, EXCEPT
+            # the safety floor (disp.keep_families, default jailbreak):
+            # browning out the abuse screen is never the right trade.
+            # (A streamed prefetch already paid the forward; keep it.)
+            skip = skip + self._learned_families(dispatcher,
+                                                 disp.keep_families)
         if precomputed_signals is not None:
             # streamed-frontend overlap: signals were evaluated while
             # the body was still arriving (same text, same skip config,
@@ -652,6 +657,7 @@ class Router:
             result.headers = {H.SCHEMA: H.SCHEMA_VERSION,
                               H.MODEL: result.model,
                               H.REQUEST_ID: request_id}
+            self._stamp_affinity(result, ctx)
             self._finalize_body(result, ctx, None)
             self.M.decision_fallbacks.inc(reason="no_decision_matched")
             if rec is not None:
@@ -683,6 +689,7 @@ class Router:
 
         cache_hit = self._check_cache(decision, ctx, result, rec=rec)
         if cache_hit is not None:
+            self._stamp_affinity(cache_hit, ctx)
             cache_hit.routing_latency_s = time.perf_counter() - start
             self.M.routing_latency.observe(cache_hit.routing_latency_s,
                                            exemplar=trace_id,
@@ -733,6 +740,7 @@ class Router:
             reasoning_effort=ref.reasoning_effort,
             matched_rules=decision_res.matched_rules))
         result.headers[H.REQUEST_ID] = request_id
+        self._stamp_affinity(result, ctx)
 
         self.M.model_requests.inc(model=ref.model, decision=decision.name)
         result.routing_latency_s = time.perf_counter() - start
@@ -743,6 +751,32 @@ class Router:
                         decision=decision.name, model=ref.model,
                         latency_ms=round(result.routing_latency_s * 1e3, 2))
         return result
+
+    def _stamp_affinity(self, result: "RouteResult",
+                        ctx: RequestContext) -> None:
+        """Replica affinity (stateplane ring): which replica's hot
+        local state — EncodingCache rows, fused-bank memos — this
+        prompt belongs on.  An affinity-aware LB keys its hashing off
+        this echo; one blake2b + ring lookup, only when a plane is
+        attached, on every routed response (matched or fallback)."""
+        if self.stateplane is not None:
+            try:
+                result.headers[H.AFFINITY] = \
+                    self.stateplane.owner_of(ctx.user_text)
+            except Exception:
+                pass
+
+    def _learned_families(self, dispatcher, keep=()) -> List[str]:
+        """Engine-backed signal families for this dispatcher, minus the
+        brownout safety floor ``keep`` — the ONE place the keep-filter
+        semantics live for both the prefetch and inline brownout paths
+        (mirrors SignalDispatcher.learned_types(keep=), reading the
+        construction-time memo instead of rescanning evaluators)."""
+        types = self._learned_types.get(id(dispatcher))
+        if types is None:  # carry-over dispatcher from a hot swap
+            types = dispatcher.learned_types()
+        return [t for t in types if t not in keep] if keep \
+            else list(types)
 
     def _fail_static(self, body: Dict[str, Any], ctx: RequestContext,
                      headers: Dict[str, str], request_id: str,

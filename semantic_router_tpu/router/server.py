@@ -89,6 +89,8 @@ API_CATALOG = {
         {"path": "/debug/slo", "method": "GET"},
         {"path": "/debug/runtime", "method": "GET"},
         {"path": "/debug/resilience", "method": "GET"},
+        {"path": "/debug/stateplane", "method": "GET"},
+        {"path": "/metrics/external", "method": "GET"},
         {"path": "/debug/decisions", "method": "GET"},
         {"path": "/debug/decisions/{id}", "method": "GET"},
         {"path": "/debug/decisions/{id}/replay", "method": "POST"},
@@ -376,6 +378,58 @@ class RouterServer:
         from ..observability.explain import default_decision_explainer
 
         return default_decision_explainer
+
+    def external_metrics(self, metric: str = "") -> Dict[str, Any]:
+        """ExternalMetricValueList-shaped scaling signals — the
+        HPA/KEDA half of overload control (deploy/k8s/keda-scaler.yaml
+        consumes this; docs/RESILIENCE.md "react" loop).  Items:
+        fleet-max ``llm_degradation_level`` and worst
+        ``llm_queue_pressure`` first (stable order — KEDA indexes into
+        them), then one level row per replica when a state plane is
+        attached.  ``metric`` filters (the adapter path's last
+        segment)."""
+        import datetime as _dt
+
+        res = self.registry.get("resilience")
+        plane = self.registry.get("stateplane")
+        level = float(res.level()) if res is not None else 0.0
+        pending = 0.0
+        if res is not None:
+            try:
+                pending = float(res.report()["pressure"].get(
+                    "pending_items", 0.0))
+            except Exception:
+                pending = 0.0
+        levels: Dict[str, float] = {}
+        if plane is not None:
+            try:
+                fleet = plane.fleet_pressure()
+                levels = {str(r): float(v)
+                          for r, v in (fleet.get("levels") or {}).items()}
+                if levels:
+                    level = max(level, max(levels.values()))
+                pending = max(pending,
+                              float(fleet.get("pending_items", 0.0)))
+            except Exception:
+                pass  # plane down: serve the local view
+        ts = _dt.datetime.now(_dt.timezone.utc).isoformat()
+
+        def item(name: str, value: float, **labels: str) -> dict:
+            return {"metricName": name, "metricLabels": dict(labels),
+                    "timestamp": ts, "value": str(int(value))
+                    if float(value).is_integer() else str(value)}
+
+        items = [item("llm_degradation_level", level, scope="fleet"),
+                 item("llm_queue_pressure", pending, scope="fleet")]
+        for replica, lvl in sorted(levels.items()):
+            items.append(item("llm_degradation_level", lvl,
+                              replica=replica))
+        if metric:
+            items = [i for i in items if i["metricName"] == metric]
+        return {"kind": "ExternalMetricValueList",
+                "apiVersion": "external.metrics.k8s.io/v1beta1",
+                "metadata": {},
+                "items": items}
 
     def roles_for_key(self, presented: str) -> Optional[set]:
         """Constant-time scan of the configured API keys (the ONE place
@@ -757,6 +811,25 @@ class RouterServer:
                     else:
                         self._text(200, reg.expose(),
                                    "text/plain; version=0.0.4")
+                elif path == "/metrics/external" \
+                        or path.startswith(
+                            "/apis/external.metrics.k8s.io/v1beta1"):
+                    # external-metrics-shaped scaling signals (open like
+                    # /metrics — KEDA / an HPA adapter polls them; they
+                    # hold load levels, not data).  Adapter paths:
+                    # .../v1beta1[/namespaces/{ns}[/{metric}]] — only a
+                    # segment AFTER the namespace name selects a metric
+                    # (a namespace-level list must return everything,
+                    # not filter on the namespace string).
+                    metric = ""
+                    if path.startswith("/apis/"):
+                        segs = [s for s in path.split("/") if s]
+                        rest = segs[segs.index("v1beta1") + 1:]
+                        if rest and rest[0] == "namespaces":
+                            metric = rest[2] if len(rest) > 2 else ""
+                        elif rest:
+                            metric = rest[0]
+                    self._json(200, server.external_metrics(metric))
                 elif path == "/v1/models":
                     self._json(200, {"object": "list", "data": [
                         {"id": m.name, "object": "model",
@@ -867,6 +940,17 @@ class RouterServer:
                                                   "controller"})
                     else:
                         self._json(200, res.report())
+                elif path == "/debug/stateplane":
+                    # shared-state-plane snapshot: membership, ring
+                    # distribution, backend health, fleet pressure
+                    plane = server.registry.get("stateplane") \
+                        or getattr(server.router, "stateplane", None)
+                    if plane is None:
+                        self._json(503, {"error": "no state plane "
+                                                  "(stateplane.enabled"
+                                                  " is false)"})
+                    else:
+                        self._json(200, plane.report())
                 elif path == "/debug/decisions":
                     # decision-record listing, filterable by model /
                     # decision / rule ("type:name") / signal family;

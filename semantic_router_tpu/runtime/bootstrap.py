@@ -16,6 +16,7 @@ unloaded (signals fail open) — the model-free mock seam is
 from __future__ import annotations
 
 import os
+import sys
 import threading
 from typing import Optional
 
@@ -358,6 +359,88 @@ def build_router(cfg: RouterConfig, engine=None,
     if engine is not None and engine.has_task("embedding"):
         embed_fn = lambda text: engine.embed("embedding", [text])[0]
 
+    # shared state plane (stateplane/): constructed once and carried
+    # across hot reloads like every stateful subsystem; enabled=false
+    # (the default) builds NOTHING — byte-identical single-process
+    # behavior.  A plane that fails to construct degrades to local
+    # state with a warning, never a dead replica.
+    sp_cfg = cfg.stateplane_config()
+    plane = None
+    if sp_cfg["enabled"]:
+        if carry_from is not None \
+                and getattr(carry_from, "stateplane", None) is not None:
+            plane = carry_from.stateplane
+        elif registry is not None \
+                and registry.get("stateplane") is not None:
+            plane = registry.get("stateplane")
+        else:
+            try:
+                from ..stateplane import build_state_plane
+
+                plane = build_state_plane(
+                    cfg, metrics=registry.metrics
+                    if registry is not None else None)
+                if plane is not None:
+                    plane.start()
+                    if registry is not None:
+                        registry.swap(stateplane=plane)
+                    component_event("bootstrap", "stateplane_attached",
+                                    backend=sp_cfg["backend"],
+                                    replica=plane.replica_id)
+            except Exception as exc:
+                component_event("bootstrap", "stateplane_failed",
+                                level="warning",
+                                error=f"{type(exc).__name__}: "
+                                      f"{exc}"[:200])
+                plane = None
+    else:
+        # hot-reload DISABLE: a previously-attached plane must actually
+        # stop — heartbeat thread, registry slot, /debug/stateplane,
+        # fleet sensing — or the operator's "off" means nothing
+        old_plane = getattr(carry_from, "stateplane", None) \
+            if carry_from is not None else None
+        if old_plane is None and registry is not None:
+            old_plane = registry.get("stateplane")
+        if old_plane is not None:
+            try:
+                old_plane.close()
+            except Exception:
+                pass
+            if registry is not None:
+                registry.swap(stateplane=None)
+            component_event("bootstrap", "stateplane_detached")
+    router.stateplane = plane
+
+    # plane-shared semantic cache: only in-proc backends get wrapped —
+    # an operator-configured redis/qdrant/milvus cache is already
+    # cross-replica by nature.  The wrapped in-proc cache stays as the
+    # local fallback the plane degrades to.  Reload-aware both ways: a
+    # carried plain cache gets wrapped when the plane turns on, a
+    # carried SharedSemanticCache unwraps to its local fallback when
+    # the plane (or share.cache) turns off.
+    if plane is not None and sp_cfg["share"]["cache"] \
+            and router.cache is not None \
+            and cfg.semantic_cache.backend_type in ("memory", "hnsw",
+                                                    "hybrid"):
+        from ..stateplane import SharedSemanticCache
+
+        cache_embed = getattr(router.cache, "embed_fn", None) or embed_fn
+        if not isinstance(router.cache, SharedSemanticCache) \
+                and cache_embed is not None:
+            router.cache = SharedSemanticCache(
+                plane, cache_embed,
+                similarity_threshold=cfg.semantic_cache
+                .similarity_threshold,
+                ttl_seconds=cfg.semantic_cache.ttl_seconds,
+                local=router.cache)
+    elif router.cache is not None:
+        sp_cache_mod = sys.modules.get(
+            "semantic_router_tpu.stateplane.cache")
+        if sp_cache_mod is not None and isinstance(
+                router.cache, sp_cache_mod.SharedSemanticCache) \
+                and router.cache.local is not None:
+            router.cache = router.cache.local
+
     if carry_from is not None:
         router.memory_store = carry_from.memory_store
         router.vectorstores = carry_from.vectorstores
@@ -427,11 +510,18 @@ def build_router(cfg: RouterConfig, engine=None,
         except Exception as exc:
             component_event("bootstrap", "vectorstore_registry_failed",
                             level="warning", error=str(exc)[:200])
+    # plane-shared vector stores: like the cache, only the in-proc
+    # default rides the plane — sqlite/qdrant/milvus/llamastack are
+    # already durable/shared backends in their own right
+    vs_backend = vs_cfg.get("backend", "memory")
+    if plane is not None and sp_cfg["share"]["vectorstore"] \
+            and vs_backend == "memory":
+        vs_backend = "stateplane"
     router.vectorstores = VectorStoreManager(
-        embed_fn, backend=vs_cfg.get("backend", "memory"),
+        embed_fn, backend=vs_backend,
         base_path=vs_cfg.get("path"),
         backend_config=vs_cfg.get("backend_config"),
-        registry=registry)
+        registry=registry, stateplane=plane)
     if registry is not None:
         attached = router.vectorstores.load_from_registry()
         if attached:
@@ -551,8 +641,14 @@ def apply_observability_knobs(cfg: RouterConfig, registry) -> None:
             explain.configure(ex_cfg)
             # optional durable backend (explain_store.py): records also
             # land in SQLite so post-restart audits work; idempotent on
-            # hot reload (same path keeps the same store)
+            # hot reload (same path keeps the same store).  With a state
+            # plane attached (and no explicit sqlite config) the durable
+            # mirror rides the plane instead — every replica serves the
+            # FLEET's audit trail at /debug/decisions?source=durable.
             durable = ex_cfg.get("durable") or {}
+            plane = registry.get("stateplane")
+            sp_share = cfg.stateplane_config()["share"] \
+                if plane is not None else {}
             if durable.get("backend") == "sqlite" and durable.get("path"):
                 cur = getattr(explain, "durable_store", None)
                 if cur is None or getattr(cur, "path", "") \
@@ -565,6 +661,16 @@ def apply_observability_knobs(cfg: RouterConfig, registry) -> None:
                         durable["path"],
                         max_records=int(durable.get("max_records",
                                                     100_000))))
+            elif plane is not None and sp_share.get("explain"):
+                from ..stateplane import StatePlaneDecisionStore
+
+                cur = getattr(explain, "durable_store", None)
+                if not isinstance(cur, StatePlaneDecisionStore) \
+                        or cur.plane is not plane:
+                    explain.attach_durable(StatePlaneDecisionStore(
+                        plane,
+                        max_records=int(durable.get("max_records",
+                                                    10_000))))
             elif getattr(explain, "durable_store", None) is not None:
                 explain.attach_durable(None)
     except Exception as exc:
@@ -579,11 +685,20 @@ def apply_observability_knobs(cfg: RouterConfig, registry) -> None:
         # knob block, malformed config must never stop the server.
         res = registry.get("resilience")
         if res is not None:
+            plane = registry.get("stateplane")
+            share_fleet = plane is not None and \
+                cfg.stateplane_config()["share"].get("fleet")
             res.bind(events=registry.get("events"),
                      slo=registry.get("slo"),
                      runtimestats=registry.get("runtimestats"),
                      tracer=registry.tracer,
-                     explain=registry.get("explain"))
+                     explain=registry.get("explain"),
+                     fleet=plane if share_fleet else None)
+            if not share_fleet:
+                # bind() only ever attaches; a reload that turned the
+                # plane (or share.fleet) off must actually detach the
+                # fleet sensor or the ladder keeps stepping from it
+                res.fleet = None
             res.configure(cfg.resilience_config())
             # the tracer/explain knob blocks above just re-applied the
             # OPERATOR sampling values; if the ladder is degraded the L1
@@ -620,6 +735,12 @@ def serve(config_path: str, port: int = 8801,
         server = RouterServer(router, cfg, default_backend=default_backend,
                               port=port, config_path=config_path)
         server.startup = tracker
+        # the plane built in build_router (no registry yet on this
+        # path) joins the server's registry so the knob wiring below —
+        # fleet-aggregated resilience, the plane explain mirror — and
+        # /debug/stateplane all see it
+        if getattr(router, "stateplane", None) is not None:
+            server.registry.swap(stateplane=router.stateplane)
     except Exception as exc:
         # explicit failStartup (runtime_bootstrap.go:170): readiness
         # monitors must see failed=true, not eternally-starting

@@ -24,7 +24,12 @@ from typing import Any, Dict, Optional
 _SLOTS = ("metrics", "tracer", "sessions", "profiler", "events",
           "flightrec", "runtimestats", "slo", "explain", "resilience",
           "engine", "cache", "memory_store", "vectorstores",
-          "replay_store")
+          "replay_store",
+          # shared state plane (stateplane.StatePlane): empty in the
+          # single-process posture; bootstrap fills it when
+          # stateplane.enabled — per-registry, so two embedded routers
+          # can ride different planes (or none)
+          "stateplane")
 
 
 class RuntimeRegistry:

@@ -25,6 +25,13 @@ from .base import RequestContext, SignalEvaluator, SignalResult
 # the parallel per-evaluator path instead of stalling the whole request
 PREFETCH_TIMEOUT_S = 10.0
 
+# signal families that are a SAFETY control, not a quality optimization:
+# the L2 brownout (resilience/controller.py) keeps these evaluating even
+# for priority classes routed heuristic-only — browning out the
+# jailbreak screen to save fused-bank capacity would trade an abuse
+# vector for throughput, which is never the right trade
+SAFETY_FAMILIES = ("jailbreak",)
+
 
 @dataclass
 class DispatchReport:
@@ -58,14 +65,19 @@ class SignalDispatcher:
             return list(self.evaluators.values())
         return [e for t, e in self.evaluators.items() if t in self.used_types]
 
-    def learned_types(self) -> List[str]:
+    def learned_types(self, keep=None) -> List[str]:
         """Families backed by an inference engine (device work) — the
         set the resilience brownout (L2) skips for low-priority
         requests, so fused-bank capacity stays reserved for traffic
         that keeps full service.  Heuristic families never appear here:
-        brownout must degrade quality, not kill routing."""
+        brownout must degrade quality, not kill routing.  ``keep``
+        (default SAFETY_FAMILIES via the controller) names families the
+        caller must NOT brown out — they are excluded from the skip
+        set."""
+        keep_set = set(keep or ())
         return sorted(t for t, e in self.evaluators.items()
-                      if getattr(e, "engine", None) is not None)
+                      if getattr(e, "engine", None) is not None
+                      and t not in keep_set)
 
     def evaluate(self, ctx: RequestContext,
                  skip_signals: Optional[List[str]] = None

@@ -328,6 +328,10 @@ class MiniRedis:
         self._listener.listen(64)
         self.host, self.port = self._listener.getsockname()
         self._running = False
+        # open connections, tracked so stop() can sever them: a
+        # "killed" server whose established sockets keep answering
+        # would make backend-loss chaos tests prove nothing
+        self._conns: set = set()
 
     def start(self) -> "MiniRedis":
         self._running = True
@@ -338,9 +342,25 @@ class MiniRedis:
     def stop(self) -> None:
         self._running = False
         try:
+            # shutdown BEFORE close: a thread blocked in accept() holds
+            # a kernel reference to the listening socket, so close()
+            # alone leaves it accepting (and the port unbindable) until
+            # that accept returns — shutdown wakes it immediately
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._listener.close()
         except OSError:
             pass
+        with self._lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for conn in conns:  # sever live clients like a real crash
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     # -- internals -------------------------------------------------------
 
@@ -356,6 +376,8 @@ class MiniRedis:
                              daemon=True, name="miniredis-conn").start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        with self._lock:
+            self._conns.add(conn)
         reader = _Reader(conn)
         try:
             while True:
@@ -383,11 +405,16 @@ class MiniRedis:
                     conn.sendall(b"-ERR " + type(e).__name__.encode()
                                  + b": " + str(e).encode()[:200] + b"\r\n")
                     continue
-                if reply == "__QUIT__":
-                    conn.sendall(b"+OK\r\n")
-                    return
-                conn.sendall(reply)
+                try:
+                    if reply == "__QUIT__":
+                        conn.sendall(b"+OK\r\n")
+                        return
+                    conn.sendall(reply)
+                except OSError:
+                    return  # peer (or stop()) severed the socket
         finally:
+            with self._lock:
+                self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:

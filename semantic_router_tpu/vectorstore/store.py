@@ -170,7 +170,7 @@ class VectorStoreManager:
                  backend: str = "memory",
                  base_path: Optional[str] = None,
                  backend_config: Optional[Dict] = None,
-                 registry=None) -> None:
+                 registry=None, stateplane=None) -> None:
         self.embed_fn = embed_fn
         self.backend = backend
         self.base_path = base_path
@@ -180,6 +180,10 @@ class VectorStoreManager:
         # store operations — the registry is recovery metadata, not the
         # data path
         self.registry = registry
+        # backend="stateplane": named stores live on the shared state
+        # plane (stateplane.SharedVectorStore) — rows ingested through
+        # one replica retrieve on every replica
+        self.stateplane = stateplane
         self._stores: Dict[str, InMemoryVectorStore] = {}
         self._lock = threading.Lock()
         self._qdrant = None
@@ -212,6 +216,11 @@ class VectorStoreManager:
         return self._llamastack
 
     def _new_store(self, name: str, **kwargs) -> InMemoryVectorStore:
+        if self.backend == "stateplane" and self.stateplane is not None:
+            from ..stateplane.vectorstore import SharedVectorStore
+
+            return SharedVectorStore(self.stateplane, name,
+                                     embed_fn=self.embed_fn, **kwargs)
         if self.backend == "llamastack":
             from ..state.llamastack import LlamaStackVectorStore
 
@@ -276,12 +285,20 @@ class VectorStoreManager:
                 self._stores[name] = store
             if store is not None or self.backend not in ("qdrant",
                                                          "milvus",
-                                                         "llamastack"):
+                                                         "llamastack",
+                                                         "stateplane"):
                 return store
         # remote probes are network round-trips: NEVER hold the manager
         # lock across them (a slow server would stall every store op)
         try:
-            if self.backend == "qdrant":
+            if self.backend == "stateplane":
+                from ..stateplane.vectorstore import store_exists
+
+                # a SIBLING replica may have created this store on the
+                # plane — attach to it by name, like the sqlite re-attach
+                exists = self.stateplane is not None \
+                    and store_exists(self.stateplane, name)
+            elif self.backend == "qdrant":
                 prefix = self.backend_config.get("collection_prefix",
                                                  "vsr-")
                 exists = self._qdrant_client().collection_exists(
@@ -401,7 +418,16 @@ class VectorStoreManager:
             # re-attached this process — otherwise it resurrects
             os.remove(self._db_path(name))
             return True
-        if self.backend == "qdrant":
+        if self.backend == "stateplane" and self.stateplane is not None:
+            try:
+                plane = self.stateplane
+                keys = plane.backend.scan(plane.key("vs", name, ""))
+                if keys:
+                    plane.backend.delete(*keys)
+                    return True
+            except Exception:
+                pass
+        elif self.backend == "qdrant":
             prefix = self.backend_config.get("collection_prefix", "vsr-")
             try:
                 if self._qdrant_client().collection_exists(

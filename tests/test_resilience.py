@@ -276,6 +276,66 @@ class TestAdmit:
             assert d.fail_static and d.action == "allow"
             assert not d.use_learned
 
+    def test_l2_brownout_keeps_safety_families(self):
+        # the jailbreak screen survives the brownout: a browned-out
+        # class's disposition names the families route() must NOT skip
+        bus, c = make_controller()
+        bus.emit(SLO_ALERT_FIRING, objective="o", severity="fast")
+        c.tick()
+        c.tick()
+        assert c.level() == 2
+        d = c.admit("normal")
+        assert not d.use_learned
+        assert "jailbreak" in d.keep_families
+        # full-service classes carry no keep set (nothing is skipped)
+        assert c.admit("high").keep_families == ()
+        # operator override via the knob block
+        _, c2 = make_controller(
+            brownout_keep_families=["jailbreak", "pii"])
+        assert c2.brownout_keep == frozenset({"jailbreak", "pii"})
+        assert c2.report()["brownout_keep_families"] == [
+            "jailbreak", "pii"]
+
+    def test_dispatcher_learned_types_honors_keep(self):
+        from semantic_router_tpu.signals.dispatch import (
+            SAFETY_FAMILIES,
+            SignalDispatcher,
+        )
+
+        class Fake:
+            def __init__(self, t, engine):
+                self.signal_type = t
+                self.engine = engine
+
+        disp = SignalDispatcher([Fake("jailbreak", object()),
+                                 Fake("domain", object()),
+                                 Fake("keyword", None)])
+        try:
+            assert disp.learned_types() == ["domain", "jailbreak"]
+            assert disp.learned_types(keep=SAFETY_FAMILIES) == ["domain"]
+        finally:
+            disp.pool.shutdown(wait=False)
+
+    def test_l3_retry_after_from_live_drain_rate(self):
+        bus, c = make_controller()
+        bus.emit(SLO_ALERT_FIRING, objective="o", severity="fast")
+        for _ in range(3):
+            c.tick()
+        assert c.level() == 3
+        # live drain estimate: backlog × warm per-row device cost
+        c.cost_model.cost_per_row_s = lambda: 0.05
+        c._last_pressure = {"pending_items": 100.0}
+        assert c.admit("low").retry_after_s == pytest.approx(5.0)
+        # a deep queue is capped — never "come back in an hour"
+        c._last_pressure = {"pending_items": 1e6}
+        assert c.admit("low").retry_after_s == pytest.approx(
+            c.retry_after_cap_s)
+        # pre-telemetry keeps the static recovery-window fallback
+        c.cost_model.cost_per_row_s = lambda: None
+        c._last_pressure = {"pending_items": 100.0}
+        assert c.admit("low").retry_after_s == pytest.approx(
+            max(1.0, c.interval_s * c.hysteresis_ticks))
+
 
 class TestKnobSideEffects:
     def test_trace_and_record_sampling_shed_and_restore(self):

@@ -1,0 +1,346 @@
+"""Fleet chaos e2e (ISSUE 6 tentpole + backend-loss satellite).
+
+Three in-process router replicas — each a full Router with its own
+isolated RuntimeRegistry — share ONE MiniRedis state plane, exactly
+like N pods in front of one Redis.  The ``make fleet-smoke`` standing
+gate runs this file (CPU-only, no engine, no chip):
+
+1. membership + ring agreement across the fleet;
+2. a semantic-cache entry written through replica A is a hit on B/C;
+3. fault-proxy overload on ONE replica fires its SLO fast-burn alert
+   and every replica converges to the same degradation level within
+   one controller poll interval (fleet-aggregated sensors);
+4. hysteresis recovery stays in lockstep once the faults clear;
+5. the backend killed MID-RUN degrades every replica to local-only
+   state with zero request failures; a restart re-attaches, replays
+   buffered writes, and the fleet reconverges;
+6. /debug/stateplane + /metrics/external over the real HTTP server.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from semantic_router_tpu.router import headers as H
+from semantic_router_tpu.router.fault_proxy import FaultProxy
+from semantic_router_tpu.router.mock_backend import MockVLLMServer
+from semantic_router_tpu.signals.base import SignalHit, SignalResult
+from semantic_router_tpu.state.resp import MiniRedis
+from semantic_router_tpu.stateplane import (
+    GuardedBackend,
+    RespStateBackend,
+    StatePlane,
+)
+from semantic_router_tpu.stateplane.harness import ReplicaFleet
+
+
+class ProxiedSignal:
+    """Remote-classifier-shaped signal whose dependency runs through
+    the fault proxy — the proxy plan scripts its failure modes."""
+
+    signal_type = "chaos"
+    engine = None  # heuristic family: brownout never silences it
+
+    def __init__(self, url: str) -> None:
+        self.url = url
+
+    def evaluate(self, ctx):
+        with urllib.request.urlopen(self.url + "/health",
+                                    timeout=5) as resp:
+            resp.read()
+        return SignalResult(signal_type="chaos",
+                            hits=[SignalHit(rule="reachable")])
+
+
+def _route(replica, text, **headers):
+    return replica.router.route(
+        {"model": "auto",
+         "messages": [{"role": "user", "content": text}]},
+        headers=headers or None)
+
+
+@pytest.fixture(scope="module")
+def stack():
+    mini = MiniRedis().start()
+    port = mini.port
+    backend = MockVLLMServer().start()
+    proxy = FaultProxy(backend.url, plan=["error"]).start()
+    fleet = ReplicaFleet(
+        backend_factory=lambda: GuardedBackend(
+            RespStateBackend(port=port), cooldown_s=0.2),
+        n=3, heartbeat_s=0.2).start()
+    # replica-0 carries the full local sensor chain (fault-proxied
+    # signal → metrics → SLO fast-burn window), like one pod taking the
+    # brunt of an overload; the OTHER replicas only see it via the plane
+    r0 = fleet.replicas[0]
+    r0.router.dispatcher.evaluators["chaos"] = ProxiedSignal(proxy.url)
+    if r0.router.dispatcher.used_types is not None:
+        r0.router.dispatcher.used_types.add("chaos")
+    mon = r0.registry.get("slo")
+    mon.event_bus = r0.registry.get("events")
+    mon.configure({"objectives": ["signal error-rate < 1% over 0.2s"]})
+    r0.controller.bind(slo=mon)
+    yield {"mini": mini, "port": port, "fleet": fleet, "proxy": proxy,
+           "monitor": mon, "backend": backend}
+    fleet.stop()
+    proxy.stop()
+    backend.stop()
+    mini.stop()
+
+
+class TestFleetConvergence:
+    """Ordered phases over one module-scoped fleet."""
+
+    def test_1_membership_and_ring_agreement(self, stack):
+        fleet = stack["fleet"]
+        names = sorted(r.name for r in fleet.replicas)
+        for r in fleet.replicas:
+            assert r.plane.members() == names
+        # every replica computes the same affinity answer
+        for key in ("alpha", "bravo", "charlie", "delta"):
+            owners = {r.plane.owner_of(key) for r in fleet.replicas}
+            assert len(owners) == 1
+
+    def test_2_cache_write_on_a_hits_on_b(self, stack):
+        fleet = stack["fleet"]
+        a, b, c = fleet.replicas
+        text = "what does this contract clause mean"
+        res = _route(a, text)
+        assert res.kind == "route"  # nothing cached yet
+        a.router.cache.add(text, "a shared legal answer",
+                           model="model-large")
+        for other in (b, c):
+            res = _route(other, text)
+            assert res.kind == "cache_hit"
+            assert res.response_body["choices"][0]["message"][
+                "content"] == "a shared legal answer"
+        # affinity echo rides every routed response when a plane is up
+        res = _route(a, "is this liability clause legal")
+        assert res.headers.get(H.AFFINITY) in {
+            r.name for r in fleet.replicas}
+
+    def test_3_overload_on_one_replica_converges_fleet(self, stack):
+        fleet, mon = stack["fleet"], stack["monitor"]
+        r0 = fleet.replicas[0]
+        mon.tick(now=100.0)
+        for i in range(40):
+            res = _route(r0, f"routine question number {i}")
+            assert res.kind == "route"  # fail-open: errors never block
+            assert res.report.results["chaos"].error
+        mon.tick(now=100.2)  # fast window closes over 100% errors
+        assert "signal_error_rate" in mon.degraded()
+        # every poll: each replica publishes local pressure, reads the
+        # fleet aggregate, and steps — levels stay converged per round
+        seen = []
+        for _ in range(3):
+            fleet.tick_all()
+            levels = fleet.levels()
+            assert len(set(levels)) == 1, levels
+            seen.append(levels[0])
+        assert seen == [1, 2, 3]  # monotone, one rung per poll, fleet-wide
+        for r in fleet.replicas:
+            rep = r.controller.report()
+            assert rep["fleet_attached"]
+            assert rep["pressure"]["fleet"]["aggregated"]
+            assert rep["pressure"]["fleet"]["replicas"] == 3
+
+    def test_4_recovery_stays_in_lockstep(self, stack):
+        fleet, mon, proxy = stack["fleet"], stack["monitor"], \
+            stack["proxy"]
+        with proxy._lock:  # faults clear: plan flips to ok
+            proxy.plan = ["ok"]
+            proxy._plan_i = 0
+        r0 = fleet.replicas[0]
+        series = r0.router.M
+        t = 100.2
+        for _ in range(90):  # clean traffic washes out the burn windows
+            t += 0.2
+            for _ in range(20):
+                series.signal_latency.observe(0.001, family="chaos")
+            mon.tick(now=t)
+        assert mon.degraded() == []
+        for _ in range(8):  # hysteresis_ticks=2 → two polls per rung
+            fleet.tick_all()
+            levels = fleet.levels()
+            assert len(set(levels)) == 1, levels
+        assert fleet.levels() == [0, 0, 0]
+
+    def test_5_backend_killed_mid_run_degrades_to_local(self, stack):
+        fleet = stack["fleet"]
+        a, b, _ = fleet.replicas
+        stack["mini"].stop()
+        # every replica notices within a heartbeat + breaker trip
+        deadline = time.time() + 5.0
+        while time.time() < deadline and any(
+                r.plane.available for r in fleet.replicas):
+            time.sleep(0.05)
+        assert not any(r.plane.available for r in fleet.replicas)
+        # zero request failures: every replica keeps routing on local
+        # state (cache reads/writes fall back, controller ticks local)
+        offline_q = "a legal question asked while the plane was down"
+        a.router.cache.add(offline_q, "buffered answer", model="m-l")
+        for r in fleet.replicas:
+            res = _route(r, "is this contract enforceable offline")
+            assert res.kind in ("route", "cache_hit")
+            assert H.AFFINITY in res.headers  # ring keeps last members
+        # the write that fell back local still serves LOCALLY on a
+        assert _route(a, offline_q).kind == "cache_hit"
+        assert _route(b, offline_q).kind == "route"  # not shared yet
+        # ticks proceed on local sensors; the outage itself is NOT
+        # treated as overload
+        fleet.tick_all()
+        assert fleet.levels() == [0, 0, 0]
+        assert a.plane.members() == sorted(
+            r.name for r in fleet.replicas)  # last-known ring held
+        rep = a.plane.report()
+        assert rep["fleet"].get("unreachable") is True
+        assert rep["backend"]["available"] is False
+
+    def test_6_backend_restart_reattaches_and_reconciles(self, stack):
+        fleet = stack["fleet"]
+        a, b, c = fleet.replicas
+        stack["mini"] = MiniRedis(port=stack["port"]).start()
+        offline_q = "a legal question asked while the plane was down"
+        # heartbeats probe through the breaker cooldown; recovery fires
+        # the on_recover hooks (pending-write replay + mirror resync)
+        deadline = time.time() + 10.0
+        while time.time() < deadline and not all(
+                r.plane.available for r in fleet.replicas):
+            time.sleep(0.05)
+        assert all(r.plane.available for r in fleet.replicas)
+        # membership reconverges
+        names = sorted(r.name for r in fleet.replicas)
+        deadline = time.time() + 10.0
+        while time.time() < deadline and any(
+                r.plane.members() != names for r in fleet.replicas):
+            time.sleep(0.05)
+        for r in fleet.replicas:
+            assert r.plane.members() == names
+        # the buffered write replayed: now a hit on the OTHER replicas
+        deadline = time.time() + 10.0
+        while time.time() < deadline \
+                and _route(b, offline_q).kind != "cache_hit":
+            time.sleep(0.1)
+        assert _route(b, offline_q).kind == "cache_hit"
+        assert _route(c, offline_q).kind == "cache_hit"
+
+
+class TestHTTPSurface:
+    """/debug/stateplane + the external-metrics scaling endpoint over
+    the real HTTP server."""
+
+    @pytest.fixture()
+    def server(self):
+        from semantic_router_tpu.router.pipeline import Router
+        from semantic_router_tpu.router.server import RouterServer
+        from semantic_router_tpu.runtime.registry import RuntimeRegistry
+        from semantic_router_tpu.stateplane import build_backend
+        from semantic_router_tpu.stateplane.harness import fleet_config
+
+        backend = MockVLLMServer().start()
+        plane = StatePlane(build_backend({"backend": "memory"}),
+                           replica_id="srv-a", heartbeat_s=0.2)
+        plane.heartbeat_once()
+        registry = RuntimeRegistry.isolated(stateplane=plane)
+        controller = registry.get("resilience")
+        controller.bind(events=registry.get("events"), fleet=plane)
+        cfg = fleet_config()
+        controller.configure(cfg.resilience_config())
+        router = Router(cfg, metrics=registry.metric_series(),
+                        tracer=registry.tracer,
+                        flightrec=registry.get("flightrec"),
+                        explain=registry.get("explain"),
+                        resilience=controller)
+        router.stateplane = plane
+        srv = RouterServer(router, cfg, default_backend=backend.url,
+                           registry=registry).start()
+        yield srv, plane, controller
+        srv.stop()
+        router.shutdown()
+        plane.close()
+        backend.stop()
+
+    def _get(self, url):
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+
+    def test_debug_stateplane(self, server):
+        srv, plane, _ = server
+        status, rep = self._get(srv.url + "/debug/stateplane")
+        assert status == 200
+        assert rep["replica_id"] == "srv-a"
+        assert rep["members"] == ["srv-a"]
+        assert rep["backend"]["available"] is True
+        assert abs(sum(rep["ring"]["distribution"].values()) - 1.0) < 0.01
+        assert rep["fleet"]["replicas"] >= 0
+
+    def test_external_metrics_shape_and_fleet_max(self, server):
+        srv, plane, controller = server
+        # another replica publishes a deeper degradation level: the
+        # scaling signal must surface the FLEET max, not the local view
+        plane.backend.put(plane.key("replica", "srv-b"),
+                          b"{}", ttl_s=30)
+        plane.publish_pressure({"level": 0, "pending_items": 4.0})
+        sibling = StatePlane(plane.backend, replica_id="srv-b",
+                             namespace=plane.ns)
+        sibling.publish_pressure({"level": 2, "pending_items": 9.0})
+        status, doc = self._get(srv.url + "/metrics/external")
+        assert status == 200
+        assert doc["kind"] == "ExternalMetricValueList"
+        assert doc["apiVersion"] == "external.metrics.k8s.io/v1beta1"
+        by_name = {}
+        for item in doc["items"]:
+            by_name.setdefault(item["metricName"], []).append(item)
+        fleet_level = [i for i in by_name["llm_degradation_level"]
+                       if i["metricLabels"].get("scope") == "fleet"]
+        assert fleet_level and fleet_level[0]["value"] == "2"
+        pressure = [i for i in by_name["llm_queue_pressure"]
+                    if i["metricLabels"].get("scope") == "fleet"]
+        assert pressure and float(pressure[0]["value"]) == 9.0
+        replicas = {i["metricLabels"].get("replica")
+                    for i in by_name["llm_degradation_level"]
+                    if "replica" in i["metricLabels"]}
+        assert replicas == {"srv-a", "srv-b"}
+        # the adapter-path form filters to one metric (what the KEDA
+        # scaler in deploy/k8s/keda-scaler.yaml polls)
+        status, doc = self._get(
+            srv.url + "/apis/external.metrics.k8s.io/v1beta1/namespaces/"
+                      "default/llm_degradation_level")
+        assert status == 200
+        assert doc["items"]
+        assert all(i["metricName"] == "llm_degradation_level"
+                   for i in doc["items"])
+        # a namespace-LEVEL list (no metric segment) returns every
+        # metric — the namespace name must not act as a metric filter
+        status, doc = self._get(
+            srv.url + "/apis/external.metrics.k8s.io/v1beta1/namespaces/"
+                      "llm-router")
+        assert status == 200
+        names = {i["metricName"] for i in doc["items"]}
+        assert {"llm_degradation_level",
+                "llm_queue_pressure"} <= names
+
+    def test_debug_stateplane_503_without_plane(self):
+        from semantic_router_tpu.router.pipeline import Router
+        from semantic_router_tpu.router.server import RouterServer
+        from semantic_router_tpu.runtime.registry import RuntimeRegistry
+        from semantic_router_tpu.stateplane.harness import fleet_config
+
+        backend = MockVLLMServer().start()
+        cfg = fleet_config()
+        registry = RuntimeRegistry.isolated()
+        router = Router(cfg, metrics=registry.metric_series())
+        srv = RouterServer(router, cfg, default_backend=backend.url,
+                           registry=registry).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(srv.url + "/debug/stateplane",
+                                       timeout=10)
+            assert err.value.code == 503
+        finally:
+            srv.stop()
+            router.shutdown()
+            backend.stop()
