@@ -2,8 +2,8 @@ PY ?= python
 SHELL := /bin/bash
 
 .PHONY: test test-fast tier1 trace-smoke metrics-lint explain-smoke \
-	resilience-smoke fleet-smoke native bench bench-replay perf \
-	perf-record serve-mock clean
+	resilience-smoke fleet-smoke flywheel-smoke native bench \
+	bench-replay perf perf-record serve-mock clean
 
 bench-replay:
 	$(PY) benchmarks/replay_bench.py --n 500 \
@@ -69,6 +69,16 @@ resilience-smoke:
 fleet-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_stateplane.py \
 	  tests/test_stateplane_chaos.py -q -p no:cacheprovider
+
+# learned-routing-flywheel gate (docs/FLYWHEEL.md): records 100 mixed
+# requests in-process, exports the corpus, trains the cost-aware bandit
+# purely from those records, evaluates it counterfactually against the
+# incumbent (bootstrap CI must clear zero), proves shadow mode changes
+# NOTHING about routing, and walks the canary → promote → SLO-burn
+# rollback ladder.  Tier-1 (runs inside `make tier1` too).
+flywheel-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_flywheel.py \
+	  tests/test_flywheel_smoke.py -q -p no:cacheprovider
 
 native:
 	$(PY) -m semantic_router_tpu.native.build

@@ -512,6 +512,100 @@ def _measure_explain_overhead(platform: str) -> dict:
         engine.shutdown()
 
 
+def _measure_flywheel(platform: str) -> dict:
+    """Flywheel loop throughput (docs/FLYWHEEL.md, ISSUE 8): route a
+    labeled request stream through a heuristic router, then time the
+    corpus export (rows/s) and run one full train → counterfactual-eval
+    turn, reporting the candidate-vs-incumbent reward delta with its
+    bootstrap CI.  Engine-free by design — the flywheel's own cost must
+    be visible without device noise."""
+    import time as _time
+
+    from semantic_router_tpu.config.schema import RouterConfig
+    from semantic_router_tpu.flywheel import (
+        CorpusExporter,
+        CostAwareBanditSelector,
+        counterfactual_eval,
+    )
+    from semantic_router_tpu.observability.explain import DecisionExplainer
+    from semantic_router_tpu.observability.flightrec import FlightRecorder
+    from semantic_router_tpu.observability.metrics import (
+        MetricSeries,
+        MetricsRegistry,
+    )
+    from semantic_router_tpu.observability.tracing import Tracer
+    from semantic_router_tpu.resilience.costmodel import CostModel
+    from semantic_router_tpu.router.pipeline import Router
+
+    n_requests = 200 if platform == "cpu" else 400
+    cfg = RouterConfig.from_dict({
+        "default_model": "general-7b",
+        "signals": {"keywords": [
+            {"name": "code_keywords", "operator": "OR",
+             "method": "exact", "keywords": ["debug", "refactor"]}],
+            "language": [{"name": "en"}]},
+        "decisions": [
+            {"name": "code_route", "priority": 100,
+             "rules": {"operator": "OR", "conditions": [
+                 {"type": "keyword", "name": "code_keywords"}]},
+             "modelRefs": [{"model": "code-7b", "weight": 0.5},
+                           {"model": "general-7b", "weight": 0.5}],
+             "algorithm": {"type": "static", "seed": 11}},
+            {"name": "chat_route", "priority": 0,
+             "rules": {"operator": "OR", "conditions": [
+                 {"type": "language", "name": "en"}]},
+             "modelRefs": [{"model": "general-7b", "weight": 0.5},
+                           {"model": "premium-70b", "weight": 0.5}],
+             "algorithm": {"type": "static", "seed": 13}},
+        ]})
+    router = Router(cfg, explain=DecisionExplainer(ring_size=4096),
+                    metrics=MetricSeries(MetricsRegistry()),
+                    tracer=Tracer(sample_rate=0.0),
+                    flightrec=FlightRecorder())
+    try:
+        from semantic_router_tpu.flywheel import OutcomeBook
+
+        best = {"code_route": "code-7b", "chat_route": "general-7b"}
+        outcomes = OutcomeBook(capacity=n_requests)
+        for i in range(n_requests):
+            text = (f"please debug module {i}" if i % 2 == 0
+                    else f"tell me about the weather today {i}")
+            res = router.route({"model": "auto", "messages": [
+                {"role": "user", "content": text}]})
+            good = res.model == best[res.decision.decision.name]
+            outcomes.note(res.decision_record_id,
+                          "good_fit" if good else "underpowered",
+                          latency_ms=120.0 if good else 900.0)
+
+        exporter = CorpusExporter(explain=router.explain,
+                                  outcomes=outcomes,
+                                  cost_model=CostModel(),
+                                  max_rows=n_requests)
+        t0 = _time.perf_counter()
+        rows = exporter.export_rows()
+        export_s = _time.perf_counter() - t0
+
+        sel = CostAwareBanditSelector(dim=64)
+        t0 = _time.perf_counter()
+        sel.fit_offline(rows)
+        train_s = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        ev = counterfactual_eval(rows, sel, n_boot=200, seed=0)
+        eval_s = _time.perf_counter() - t0
+        return {
+            "corpus_rows": len(rows),
+            "export_rows_per_s": round(len(rows) / max(export_s, 1e-9),
+                                       1),
+            "train_s": round(train_s, 4),
+            "eval_s": round(eval_s, 4),
+            "reward_delta": ev.get("reward_delta"),
+            "reward_delta_ci": ev.get("reward_delta_ci"),
+            "counterfactual_win": ev.get("win"),
+        }
+    finally:
+        router.shutdown()
+
+
 def _measure_resilience_overhead(platform: str) -> dict:
     """signals/s through the FULL routing pipeline with the degradation
     controller attached (enabled, holding L0 — the always-on posture)
@@ -1096,6 +1190,17 @@ def _run_bench(platform: str) -> None:
         sys.stderr.write(f"bench: stateplane arm failed "
                          f"({type(exc).__name__}: {exc}); skipped\n")
 
+    # flywheel arm (docs/FLYWHEEL.md, ISSUE 8): corpus-export rows/s
+    # plus the counterfactual candidate-vs-incumbent reward delta over
+    # a labeled request stream — the closed loop's own perf trajectory.
+    flywheel_row = None
+    try:
+        flywheel_row = _measure_flywheel(platform)
+        sys.stderr.write(f"bench: flywheel {flywheel_row}\n")
+    except Exception as exc:
+        sys.stderr.write(f"bench: flywheel arm failed "
+                         f"({type(exc).__name__}: {exc}); skipped\n")
+
     batch, signals_per_s, best_impl = best
     # On a CPU fallback the host geometry is the whole story (this image
     # exposes ONE 2.1GHz core — ~0.09 TFLOPs f32 roofline — while the
@@ -1124,6 +1229,8 @@ def _run_bench(platform: str) -> None:
         record["resilience"] = resilience_row
     if stateplane_row is not None:
         record["stateplane"] = stateplane_row
+    if flywheel_row is not None:
+        record["flywheel"] = flywheel_row
     if platform != "cpu":
         # side evidence for the bench README / judge: full sweep detail
         try:

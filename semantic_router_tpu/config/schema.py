@@ -1091,6 +1091,13 @@ class RouterConfig:
     # ttl_s, ring_vnodes, cooldown_s, share: {cache, vectorstore,
     # explain, fleet}, backend_config: {host, port, path, ...}}
     stateplane: Dict[str, Any] = field(default_factory=dict)
+    # learned routing flywheel (flywheel/): decision records → trained
+    # policies → counterfactual promotion — {enabled, corpus: {max_rows,
+    # path}, features: {dim}, trainer: {algorithms, out_dir, alpha,
+    # cost_weight}, evaluator: {min_rows, bootstrap, seed}, promotion:
+    # {mode: off|shadow|auto, canary_fraction, canary_min_requests,
+    # rollback_on: any|fast}, admission: {enabled, floor, ceiling}}
+    flywheel: Dict[str, Any] = field(default_factory=dict)
     # canonical v0.3 contract surface (canonical_config.go): named routing
     # profiles + virtual-model entrypoints + deployment listeners/providers
     recipes: List[RoutingRecipe] = field(default_factory=list)
@@ -1145,6 +1152,7 @@ class RouterConfig:
                                       d.get("learning", {})) or {}),
             resilience=dict(d.get("resilience", {}) or {}),
             stateplane=dict(d.get("stateplane", {}) or {}),
+            flywheel=dict(d.get("flywheel", {}) or {}),
             recipes=[RoutingRecipe.from_dict(r)
                      for r in d.get("recipes", []) or []],
             entrypoints=[Entrypoint.from_dict(e)
@@ -1358,6 +1366,85 @@ class RouterConfig:
         out["share"] = {k: bool(share.get(k, True))
                         for k in ("cache", "vectorstore", "explain",
                                   "fleet")}
+        return out
+
+    def flywheel_config(self) -> Dict[str, Any]:
+        """Normalized ``flywheel`` block — the ONE interpretation point
+        (bootstrap, the controller, and tests must never drift on
+        defaults)::
+
+          flywheel:
+            enabled: false         # default OFF: byte-identical routing
+            corpus:
+              max_rows: 10000      # export window over the explain ring
+                                   # + durable mirror
+              path: ""             # optional JSONL export target
+            features:
+              dim: 64              # signal-hash bucket width
+            trainer:
+              algorithms: [cost_bandit]   # first trainable = candidate
+              out_dir: ""          # artifact directory ("" = in-memory)
+              alpha: 0.0           # LinUCB exploration bonus
+              cost_weight: 0.1     # device-cost penalty weight
+            evaluator:
+              min_rows: 20         # corpus floor before any cycle acts
+              bootstrap: 200       # CI resamples
+              seed: 0
+            promotion:
+              mode: shadow         # off | shadow | auto
+              canary_fraction: 0.1
+              canary_min_requests: 200
+              rollback_on: any     # any | fast (SLO burn severities)
+            admission:
+              enabled: true        # feed value weights to L3 admission
+              floor: 0.25          # weight clamp (cheapest admission)
+              ceiling: 4.0
+
+        Malformed values fall back to defaults — flywheel config must
+        never stop the server."""
+        fw = dict(self.flywheel or {})
+        out: Dict[str, Any] = {"enabled": bool(fw.get("enabled", False))}
+
+        def _block(name: str, defaults: Dict[str, Any]) -> Dict[str, Any]:
+            raw = dict(fw.get(name, {}) or {})
+            merged = dict(defaults)
+            for k, v in raw.items():
+                if k not in defaults:
+                    continue
+                want = type(defaults[k])
+                try:
+                    if want is bool:
+                        merged[k] = bool(v)
+                    elif want is int:
+                        merged[k] = int(v)
+                    elif want is float:
+                        merged[k] = float(v)
+                    elif want is list:
+                        # a bare scalar ("algorithms: cost_bandit") is
+                        # one entry, never exploded character-wise
+                        if isinstance(v, (list, tuple)):
+                            merged[k] = [str(x) for x in v]
+                        elif v:
+                            merged[k] = [str(v)]
+                    else:
+                        merged[k] = str(v)
+                except (TypeError, ValueError):
+                    pass
+            return merged
+
+        out["corpus"] = _block("corpus", {"max_rows": 10_000,
+                                          "path": ""})
+        out["features"] = _block("features", {"dim": 64})
+        out["trainer"] = _block("trainer", {
+            "algorithms": ["cost_bandit"], "out_dir": "",
+            "alpha": 0.0, "cost_weight": 0.1})
+        out["evaluator"] = _block("evaluator", {
+            "min_rows": 20, "bootstrap": 200, "seed": 0})
+        out["promotion"] = _block("promotion", {
+            "mode": "shadow", "canary_fraction": 0.1,
+            "canary_min_requests": 200, "rollback_on": "any"})
+        out["admission"] = _block("admission", {
+            "enabled": True, "floor": 0.25, "ceiling": 4.0})
         return out
 
     # -- recipes (pkg/config/recipes.go) -----------------------------------

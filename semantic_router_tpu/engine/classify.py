@@ -1410,7 +1410,38 @@ class InferenceEngine:
         # never pair new row indices with this batch's logits ordering
         bank, row_of, widths = g.demux
         n = len(items)
-        padded_n = self._padded_batch(n)
+        # identical token sequences within the batch ride a SINGLE
+        # trunk row (the trunk output depends only on ids+mask; per-item
+        # task mixes differ at demux, not at the forward).  Key on the
+        # encoding bytes clipped at the bucket edge — the exact rows the
+        # device would see.  K requests for the same hot prompt cost one
+        # row instead of K.
+        urow: List[int] = []
+        uniq_items: List[BatchItem] = items
+        if n > 1:
+            uniq_items = []
+            index: Dict[bytes, int] = {}
+            for item in items:
+                enc = item.payload.encoding
+                L = min(len(enc), bucket)
+                # the clip flag is part of the key: a 45-token item
+                # clipped at a 32 bucket shares device rows with a
+                # 32-token item, but their truncation/overflow
+                # accounting must not cross-attribute
+                key = (np.asarray(enc.ids[:L]).tobytes() + b"|"
+                       + np.asarray(enc.attention_mask[:L]).tobytes()
+                       + (b"|c" if len(enc) > bucket else b"|f"))
+                at = index.get(key)
+                if at is None:
+                    at = index[key] = len(uniq_items)
+                    uniq_items.append(item)
+                urow.append(at)
+        else:
+            urow = list(range(n))
+        n_rows = len(uniq_items)
+        if n_rows < n:
+            self._series().fused_dedup_rows.inc(n - n_rows)
+        padded_n = self._padded_batch(n_rows)
 
         from ..observability import batchtrace
         from ..observability.profiler import trace_span
@@ -1431,10 +1462,11 @@ class InferenceEngine:
             detailed = step is not None and step.detailed \
                 and g.traced_fns is not None
             with batchtrace.stage(step, "stack"):
-                ids, mask, clipped = self._stack_items(items, bucket,
+                ids, mask, clipped = self._stack_items(uniq_items,
+                                                       bucket,
                                                        padded_n, g.pad_id)
                 for i, item in enumerate(items):
-                    if clipped[i]:
+                    if clipped[urow[i]]:
                         for task in item.payload.tasks:
                             self._series().bucket_overflows.inc(task=task)
                 ids_dev, mask_dev = self._to_device(ids, mask)
@@ -1468,8 +1500,8 @@ class InferenceEngine:
             # variant key instead of polluting the warm-execute EWMA the
             # dashboards (and the planned path-chooser cost model) read
             self._record_step(f"trunk:{gid}", bucket, variant,
-                              n, padded_n, time.perf_counter() - fwd_t0,
-                              fresh)
+                              n_rows, padded_n,
+                              time.perf_counter() - fwd_t0, fresh)
             self._series().trunk_forwards.inc(group=gid, path="fused")
 
             demux_cm = batchtrace.stage(step, "demux")
@@ -1482,7 +1514,10 @@ class InferenceEngine:
                     for task in item.payload.tasks:
                         row = row_of[task]
                         width = widths[row]
-                        p = _softmax(logits[i, row, :width][None, :])[0]
+                        # fan the shared trunk row's logits out to
+                        # every duplicate item at demux
+                        p = _softmax(
+                            logits[urow[i], row, :width][None, :])[0]
                         idx = int(p.argmax())
                         labels = self._tasks[task].labels
                         per_task[task] = ClassResult(
@@ -1494,7 +1529,7 @@ class InferenceEngine:
                                     else str(j)):
                                    float(p[j]) for j in range(width)},
                             latency_s=now - item.payload.submit_t,
-                            truncated=enc.truncated or clipped[i],
+                            truncated=enc.truncated or clipped[urow[i]],
                         )
                     out.append(per_task[item.payload.tasks[0]]
                                if len(item.payload.tasks) == 1

@@ -1,0 +1,58 @@
+"""Learned routing flywheel: decision records → trained policies →
+counterfactual promotion (ROADMAP direction 4).
+
+The closed loop, end to end::
+
+    route() ──records──▶ explain ring / durable mirror
+                              │ CorpusExporter (+ verdict labels from
+                              │  note_outcome / the learning ledgers)
+                              ▼
+                       versioned corpus rows
+                              │ train_policies (cost_bandit + the
+                              │  existing selection trainers)
+                              ▼
+                       JSON policy artifacts
+                              │ counterfactual_eval (replayed against
+                              │  the corpus, bootstrap CIs — no live
+                              │  traffic)
+                              ▼
+                  shadow ─▶ canary ─▶ promote   (SLO burn ⇒ rollback)
+
+See docs/FLYWHEEL.md for the corpus schema, reward definition, and
+promotion-ladder semantics.  ``flywheel.enabled: false`` (the default)
+builds none of this — byte-identical routing.
+"""
+
+from .controller import STATES, FlywheelController
+from .corpus import (
+    ROW_SCHEMA,
+    ROW_VERSION,
+    CorpusExporter,
+    OutcomeBook,
+    record_to_row,
+    reward_for,
+    row_to_json,
+    rows_to_routing_records,
+    validate_row,
+)
+from .evaluator import RewardModel, bootstrap_ci, counterfactual_eval
+from .features import (
+    DEFAULT_DIM,
+    FEATURE_KIND,
+    feature_dim,
+    row_features,
+    signal_features,
+    signals_obj_features,
+)
+from .policy import CostAwareBanditSelector
+from .trainer import load_policy, train_policies
+
+__all__ = [
+    "CorpusExporter", "CostAwareBanditSelector", "DEFAULT_DIM",
+    "FEATURE_KIND", "FlywheelController", "OutcomeBook", "ROW_SCHEMA",
+    "ROW_VERSION", "RewardModel", "STATES", "bootstrap_ci",
+    "counterfactual_eval", "feature_dim", "load_policy",
+    "record_to_row", "reward_for", "row_features", "row_to_json",
+    "rows_to_routing_records", "signal_features",
+    "signals_obj_features", "train_policies", "validate_row",
+]

@@ -77,20 +77,10 @@ def _sampled(tracer: Tracer, trace_id: str) -> bool:
     forced = getattr(tracer, "is_force_sampled", None)
     if forced is not None and forced(trace_id):
         return True
+    from .tracing import trace_id_in_ratio
+
     rate = float(getattr(tracer, "sample_rate", 1.0))
-    if rate >= 1.0:
-        return True
-    if rate <= 0.0:
-        return False
-    try:
-        # rightmost bytes, per the OTel TraceIdRatioBased convention:
-        # externally-minted W3C ids often carry timestamps in the HIGH
-        # bytes (X-Ray-style gateways), which would skew a prefix-based
-        # ratio to 0% or 100%; trace-context level 2 guarantees the
-        # randomness lives in the rightmost 7 bytes
-        return int(trace_id[-8:], 16) / 0xFFFFFFFF < rate
-    except ValueError:
-        return True
+    return trace_id_in_ratio(trace_id, rate, default=True)
 
 
 def capture() -> Optional[TraceContext]:

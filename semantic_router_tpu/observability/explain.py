@@ -184,13 +184,20 @@ class RecordDraft:
         """Per-family value + source + latency from the dispatch report,
         plus the replay-grade SignalMatches payload."""
         for family, res in report.results.items():
-            self.signals[family] = {
+            row = {
                 "source": res.source or "heuristic",
                 "latency_ms": res.latency_s * 1e3,
                 "error": res.error or "",
                 "hits": [{"rule": h.rule, "confidence": float(h.confidence)}
                          for h in res.hits],
             }
+            if res.metrics:
+                # kb-family metric outputs (kb_metric projection
+                # inputs): captured so replay can re-drive projections
+                # from raw hits; only present when the family produced
+                # metrics, so metric-free records keep their bytes
+                row["metrics"] = _jsonable(res.metrics)
+            self.signals[family] = row
         pt = report.projection_trace
         if pt is not None:
             self.projections = {
@@ -360,15 +367,11 @@ class DecisionExplainer:
         trace sample together."""
         if not self.enabled:
             return None
-        rate = self.sample_rate
-        if rate < 1.0:
-            if rate <= 0.0:
-                return None
-            try:
-                if int(trace_id[-8:], 16) / 0xFFFFFFFF >= rate:
-                    return None
-            except ValueError:
-                pass
+        from .tracing import trace_id_in_ratio
+
+        if not trace_id_in_ratio(trace_id, self.sample_rate,
+                                 default=True):
+            return None
         return RecordDraft(trace_id, request_id)
 
     def commit(self, record: Dict[str, Any]) -> str:

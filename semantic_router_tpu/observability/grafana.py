@@ -377,6 +377,49 @@ def resilience() -> Dict:
                       p, tags=["resilience", "overload"])
 
 
+_FLYWHEEL_MD = (
+    "**Learned routing flywheel** (docs/FLYWHEEL.md): decision records "
+    "export as a training corpus, policies train offline, candidates "
+    "evaluate counterfactually against recorded traffic, then promote "
+    "shadow → canary → serving with automatic rollback on SLO burn.  "
+    "State: 0=idle 1=candidate 2=shadow 3=canary 4=promoted "
+    "5=rolled_back.  Inspect live state at `/debug/flywheel`."
+)
+
+
+def flywheel() -> Dict:
+    """The "Flywheel" dashboard: promotion state, corpus export rate,
+    shadow agreement, canary overrides, counterfactual reward delta."""
+    p = [
+        _stat("Promotion state",
+              "max(llm_flywheel_state)",
+              panel_id=1, x=0, y=0),
+        _stat("Reward delta (candidate - incumbent)",
+              "max(llm_flywheel_reward_delta)",
+              panel_id=2, x=6, y=0),
+        _stat("Shadow agreement",
+              'sum(rate(llm_flywheel_shadow_total{agree="true"}[5m])) '
+              '/ sum(rate(llm_flywheel_shadow_total[5m]))',
+              unit="percentunit", panel_id=3, x=12, y=0),
+        _stat("Canary override rate",
+              "sum(rate(llm_flywheel_overrides_total[5m])) or vector(0)",
+              panel_id=4, x=18, y=0),
+        _panel("Corpus export rate by outcome source",
+               ["sum(rate(llm_flywheel_corpus_rows_total[5m])) "
+                "by (source)"],
+               panel_id=5, x=0, y=4, legends=["{{source}}"]),
+        _panel("Shadow scores by agreement",
+               ["sum(rate(llm_flywheel_shadow_total[5m])) by (agree)"],
+               panel_id=6, x=12, y=4, legends=["agree={{agree}}"]),
+        _panel("Promotion-state transitions",
+               ["sum(rate(llm_flywheel_transitions_total[5m])) by (to)"],
+               panel_id=7, x=0, y=12, legends=["→ {{to}}"]),
+        _text_panel("Flywheel", _FLYWHEEL_MD, panel_id=8, x=12, y=12),
+    ]
+    return _dashboard("srt-flywheel", "Semantic Router — Flywheel",
+                      p, tags=["flywheel", "learning"])
+
+
 def catalog(registry=None) -> Dict:
     """Auto-generated dashboard: one panel per registered series —
     anything new in the registry shows up here without template edits."""
@@ -431,6 +474,7 @@ def render_all(out_dir: str, registry=None) -> List[str]:
         "runtime_slo.json": runtime_slo(),
         "decisions.json": decisions(),
         "resilience.json": resilience(),
+        "flywheel.json": flywheel(),
         "metric_catalog.json": catalog(registry),
     }
     for fname, dash in dashboards.items():

@@ -441,6 +441,10 @@ class MetricSeries:
             "llm_engine_tokenizations_total",
             "Host tokenizations actually executed (request-level "
             "tokenize-once cache hits never count)")
+        self.fused_dedup_rows = registry.counter(
+            "llm_engine_fused_dedup_rows_total",
+            "Duplicate token sequences collapsed within fused batches "
+            "(each saved one trunk row; logits fan out on demux)")
         self.bucket_overflows = registry.counter(
             "llm_batcher_bucket_overflow_total",
             "Inputs longer than the largest seq bucket — clipped at the "
@@ -482,6 +486,7 @@ truncated_inputs = default_series.truncated_inputs
 backend_failovers = default_series.backend_failovers
 trunk_forwards = default_series.trunk_forwards
 tokenizations = default_series.tokenizations
+fused_dedup_rows = default_series.fused_dedup_rows
 bucket_overflows = default_series.bucket_overflows
 batcher_queue_wait = default_series.batcher_queue_wait
 batcher_fill_ratio = default_series.batcher_fill_ratio

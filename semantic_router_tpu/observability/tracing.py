@@ -50,6 +50,29 @@ def new_span_id() -> str:
     return _rand_hex(16)
 
 
+def trace_id_in_ratio(trace_id: str, rate: float,
+                      default: bool = True) -> bool:
+    """THE deterministic trace-id ratio convention, in one place:
+    rightmost 8 hex chars over 0xFFFFFFFF (OTel TraceIdRatioBased —
+    externally-minted W3C ids often carry timestamps in the HIGH bytes,
+    which would skew a prefix ratio to 0% or 100%; trace-context level
+    2 guarantees the randomness lives in the rightmost 7 bytes).
+
+    Batch-trace sampling, decision-record sampling, and flywheel canary
+    membership all route through this so a request's detailed trace,
+    audit record, and canary assignment co-sample.  ``default`` answers
+    unparseable ids: telemetry fails open (sample), a canary fails
+    closed (incumbent)."""
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    try:
+        return int(trace_id[-8:], 16) / 0xFFFFFFFF < rate
+    except (TypeError, ValueError):
+        return default
+
+
 # Cross-instance active-span context: the innermost open span of THIS
 # thread regardless of which Tracer opened it.  Batch tracing captures
 # from here at enqueue time (the batcher cannot know which tracer the

@@ -2,10 +2,12 @@ from .recorder import (
     ReplayRecord,
     ReplayRecorder,
     ReplayStore,
+    raw_signal_matches_from_record,
     replay_decision,
     replay_diff,
     signal_matches_from_record,
 )
 
 __all__ = ["ReplayRecord", "ReplayRecorder", "ReplayStore",
-           "replay_decision", "replay_diff", "signal_matches_from_record"]
+           "raw_signal_matches_from_record", "replay_decision",
+           "replay_diff", "signal_matches_from_record"]

@@ -162,11 +162,65 @@ def signal_matches_from_record(record: Dict[str, Any]):
     return sm
 
 
-def replay_decision(record: Dict[str, Any], cfg) -> Dict[str, Any]:
-    """Deterministically re-drive the decision engine over a stored
+def raw_signal_matches_from_record(record: Dict[str, Any]):
+    """Rebuild the PRE-PROJECTION SignalMatches from a record's
+    per-family ``signals`` rows (evaluator hits, before the dispatch
+    layer's complexity composers and projection outputs were folded in)
+    plus the kb-metric outputs — the inputs a projection re-drive
+    needs.  Returns (SignalMatches, kb_metrics)."""
+    from ..decision.engine import SignalMatches
+
+    sm = SignalMatches()
+    kb_metrics: Dict[str, Dict[str, float]] = {}
+    for family, row in (record.get("signals") or {}).items():
+        for h in (row or {}).get("hits", []) or []:
+            sm.add(family, str(h.get("rule", "")),
+                   float(h.get("confidence", 1.0)))
+        for kb, metrics in ((row or {}).get("metrics", {})
+                            or {}).items():
+            kb_metrics.setdefault(str(kb), {}).update(
+                {str(m): float(v) for m, v in (metrics or {}).items()})
+    details = (record.get("replay", {}) or {}).get("details", {}) or {}
+    sm.details = {k: dict(v) for k, v in details.items()}
+    return sm, kb_metrics
+
+
+def _reproject(record: Dict[str, Any], cfg):
+    """Re-drive complexity composers + projections from the record's
+    RAW signal hits under ``cfg`` — so a projection-config change
+    (partition members, score weights, mapping thresholds) is
+    counterfactually testable instead of frozen into the recorded
+    projection outputs.  Mirrors signals.dispatch evaluate() exactly:
+    composer escalation first, then ProjectionEvaluator.  Returns None
+    when the record carries no raw signal rows (legacy records fall
+    back to the recorded post-projection matches)."""
+    if not record.get("signals"):
+        return None
+    from ..decision.projections import ProjectionEvaluator
+    from ..signals.dispatch import apply_complexity_composers
+
+    sm, kb_metrics = raw_signal_matches_from_record(record)
+    # the SAME post-fan-out stages the live dispatch ran, under the
+    # replay config: composer escalation, then projections
+    apply_complexity_composers(sm, cfg.signals.complexity)
+    trace = ProjectionEvaluator(cfg.projections).evaluate(
+        sm, kb_metrics=kb_metrics)
+    return sm, trace
+
+
+def replay_decision(record: Dict[str, Any], cfg,
+                    reproject: bool = True) -> Dict[str, Any]:
+    """Deterministically re-drive the routing brain over a stored
     record's signals under ``cfg`` (a RouterConfig) — the counterfactual
     primitive behind ``POST /debug/decisions/<id>/replay`` ("would
     config v2 have routed this differently?").
+
+    ``reproject`` (default) re-drives the PROJECTION layer too, from the
+    record's raw per-family hits: composers and partitions/scores/
+    mappings evaluate under ``cfg``, so projection-config changes are
+    counterfactually testable.  Records without raw signal rows (or
+    ``reproject=False``) fall back to the recorded post-projection
+    matches — the pre-flywheel behavior.
 
     The rule evaluation is exactly the live engine's (same
     ``explain_rule_node`` path, full tree captured).  Model choice is
@@ -182,7 +236,23 @@ def replay_decision(record: Dict[str, Any], cfg) -> Dict[str, Any]:
     from ..decision.engine import DecisionEngine, DecisionTraceEntry
     from ..selection import SelectionContext, registry as selectors
 
-    sm = signal_matches_from_record(record)
+    sm = None
+    projections = None
+    if reproject:
+        try:
+            redriven = _reproject(record, cfg)
+        except Exception:
+            redriven = None
+        if redriven is not None:
+            sm, ptrace = redriven
+            projections = {
+                "partitions": {k: dict(v)
+                               for k, v in ptrace.partitions.items()},
+                "scores": dict(ptrace.scores),
+                "mappings": dict(ptrace.mappings),
+            }
+    if sm is None:
+        sm = signal_matches_from_record(record)
     engine = DecisionEngine(cfg.decisions, cfg.strategy)
     trace: List[DecisionTraceEntry] = []
     res = engine.evaluate(sm, trace=trace)
@@ -192,6 +262,7 @@ def replay_decision(record: Dict[str, Any], cfg) -> Dict[str, Any]:
         "decision": res.decision.name if res else None,
         "confidence": round(res.confidence, 6) if res else 0.0,
         "matched_rules": list(res.matched_rules) if res else [],
+        "projections": projections,
         "rule_trace": [
             {"decision": e.decision, "matched": e.matched,
              "confidence": round(e.confidence, 6),

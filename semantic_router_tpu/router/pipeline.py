@@ -302,6 +302,10 @@ class Router:
         # bootstrap when stateplane.enabled; None = single-process
         # posture, zero reads on the hot path
         self.stateplane = None
+        # learned routing flywheel (flywheel.FlywheelController):
+        # attached by bootstrap when flywheel.enabled; None = zero
+        # flywheel work anywhere on the hot path
+        self.flywheel = None
 
     def skip_requested(self, headers: Dict[str, str]) -> bool:
         """True when the (operator-enabled) skip-processing header is on
@@ -715,6 +719,23 @@ class Router:
                 if new_ref is not None:
                     ref = new_ref
                     reason = f"{reason} → learning:{learned}"
+        if self.flywheel is not None:
+            # flywheel shadow/canary hook: shadow logs the candidate
+            # policy's choice into the decision record (zero routing
+            # effect); canary returns an override ref for the
+            # deterministic per-trace-id fraction.  Fail-open — a
+            # broken flywheel must never touch routing.
+            try:
+                override = self.flywheel.on_route(
+                    decision, decision.model_refs or [ref], ref, rec,
+                    signals, trace_id=trace_id,
+                    priority=self.priority.resolve(ctx),
+                    query=ctx.user_text)
+                if override is not None:
+                    ref = override
+                    reason = f"{reason} → flywheel:canary"
+            except Exception:
+                pass
         result.model = ref.model
         result.selection_reason = reason
         if reason.startswith("selector error"):
@@ -1325,6 +1346,16 @@ class Router:
                 verdict=verdict, success=success,
                 latency_ms=latency_ms,
                 tier=route.decision.decision.tier)
+        if self.flywheel is not None and route.decision_record_id:
+            # per-request reward label for the next corpus export —
+            # the exact-outcome half of the flywheel's reward join
+            try:
+                self.flywheel.note_outcome(
+                    route.decision_record_id,
+                    verdict or ("good_fit" if success else "failed"),
+                    quality=quality, latency_ms=latency_ms)
+            except Exception:
+                pass
         selector = self._selectors.get(route.decision.decision.name)
         if selector is None:
             return

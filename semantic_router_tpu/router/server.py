@@ -94,6 +94,8 @@ API_CATALOG = {
         {"path": "/debug/decisions", "method": "GET"},
         {"path": "/debug/decisions/{id}", "method": "GET"},
         {"path": "/debug/decisions/{id}/replay", "method": "POST"},
+        {"path": "/debug/flywheel", "method": "GET"},
+        {"path": "/debug/flywheel/cycle", "method": "POST"},
         {"path": "/info/models", "method": "GET"},
         {"path": "/config/router", "method": "GET"},
         {"path": "/config/router", "method": "PATCH"},
@@ -951,6 +953,17 @@ class RouterServer:
                                                   " is false)"})
                     else:
                         self._json(200, plane.report())
+                elif path == "/debug/flywheel":
+                    # learned-routing flywheel snapshot: promotion
+                    # state, corpus stats, last train/eval reports,
+                    # shadow agreement, admission value weights
+                    fw = server.registry.get("flywheel")
+                    if fw is None:
+                        self._json(503, {"error": "no flywheel "
+                                                  "(flywheel.enabled "
+                                                  "is false)"})
+                    else:
+                        self._json(200, fw.stats())
                 elif path == "/debug/decisions":
                     # decision-record listing, filterable by model /
                     # decision / rule ("type:name") / signal family;
@@ -1260,6 +1273,26 @@ class RouterServer:
                             return
                         server.flightrec().clear()
                         self._json(200, {"ok": True})
+                    elif path == "/debug/flywheel/cycle":
+                        # one flywheel turn (export → train →
+                        # counterfactual eval → shadow on win): runs
+                        # trainers in-process, so edit-gated + audited
+                        # like the profiler
+                        if self._authorize(write=True,
+                                           action="flywheel") is None:
+                            return
+                        fw = server.registry.get("flywheel")
+                        if fw is None:
+                            self._json(503, {
+                                "error": "no flywheel "
+                                         "(flywheel.enabled is false)"})
+                            return
+                        try:
+                            self._json(200, fw.run_cycle())
+                        except Exception as exc:
+                            self._json(500, {
+                                "error": f"{type(exc).__name__}: "
+                                         f"{exc}"[:300]})
                     elif path.startswith("/debug/decisions/") \
                             and path.endswith("/replay"):
                         # counterfactual re-drive: stored signals →

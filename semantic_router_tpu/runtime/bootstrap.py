@@ -714,6 +714,49 @@ def apply_observability_knobs(cfg: RouterConfig, registry) -> None:
                         error=str(exc)[:200], level="warning")
 
 
+def apply_flywheel_knobs(cfg: RouterConfig, registry, router) -> None:
+    """Attach/configure/detach the learned-routing flywheel
+    (flywheel/controller.py) for a registry + router pair.  Called at
+    boot and on config hot reload; ``flywheel.enabled: false`` (the
+    default) constructs NOTHING and detaches any previous controller —
+    byte-identical routing posture.  Like every knob block, malformed
+    flywheel config must never stop the server."""
+    try:
+        fw_cfg = cfg.flywheel_config()
+        if not fw_cfg["enabled"]:
+            old = registry.get("flywheel")
+            if old is not None:
+                try:
+                    old.close()
+                except Exception:
+                    pass
+                registry.swap(flywheel=None)
+                component_event("bootstrap", "flywheel_detached")
+            if router is not None:
+                router.flywheel = None
+            return
+        from ..flywheel import FlywheelController
+
+        fw = registry.get("flywheel")
+        if fw is None:
+            fw = FlywheelController(registry.metrics)
+            registry.swap(flywheel=fw)
+            component_event("bootstrap", "flywheel_attached")
+        res = registry.get("resilience")
+        fw.bind(explain=registry.get("explain"),
+                events=registry.get("events"),
+                cost_model=getattr(res, "cost_model", None)
+                if res is not None else None,
+                router=router)
+        fw.configure(fw_cfg)
+        if router is not None:
+            router.flywheel = fw
+    except Exception as exc:
+        component_event("bootstrap", "flywheel_config_invalid",
+                        error=f"{type(exc).__name__}: {exc}"[:200],
+                        level="warning")
+
+
 def serve(config_path: str, port: int = 8801,
           default_backend: str = "", mock_models: bool = False,
           status_path: Optional[str] = None,
@@ -792,6 +835,9 @@ def serve(config_path: str, port: int = 8801,
     # to sample_rate / exemplars / flight_recorder must not need a
     # restart)
     apply_observability_knobs(cfg, server.registry)
+    # learned-routing flywheel: attached after the observability stack
+    # so it can bind the explainer / event bus / cost model it feeds on
+    apply_flywheel_knobs(cfg, server.registry, router)
 
     # startKubernetesControllerIfNeeded (cmd/main.go:50): live CRD watch
     # regenerating the config file the ConfigWatcher below hot-swaps
@@ -833,6 +879,7 @@ def serve(config_path: str, port: int = 8801,
             server.router = new_router
             server.cfg = new_cfg
             apply_observability_knobs(new_cfg, server.registry)
+            apply_flywheel_knobs(new_cfg, server.registry, new_router)
             # grace period before tearing down the old dispatcher so
             # requests already inside old.route() finish their fan-out
             threading.Timer(30.0, old.dispatcher.shutdown).start()

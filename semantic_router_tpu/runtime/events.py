@@ -39,6 +39,10 @@ SLO_ALERT_RESOLVED = "slo_alert_resolved"
 # change is a lifecycle event, so operators and the kube controller see
 # the data plane shedding in the same feed the alerts arrive on
 DEGRADATION_LEVEL_CHANGED = "degradation_level_changed"
+# flywheel promotion-ladder transitions (flywheel/controller.py):
+# shadow/canary/promote/rollback moves ride the same feed, so a canary
+# rollback is as visible as the SLO burn that triggered it
+FLYWHEEL_STATE_CHANGED = "flywheel_state_changed"
 
 
 @dataclass

@@ -29,7 +29,11 @@ _SLOTS = ("metrics", "tracer", "sessions", "profiler", "events",
           # single-process posture; bootstrap fills it when
           # stateplane.enabled — per-registry, so two embedded routers
           # can ride different planes (or none)
-          "stateplane")
+          "stateplane",
+          # learned routing flywheel (flywheel.FlywheelController):
+          # empty unless flywheel.enabled — built by bootstrap, so the
+          # disabled posture constructs nothing
+          "flywheel")
 
 
 class RuntimeRegistry:

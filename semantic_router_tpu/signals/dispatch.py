@@ -47,6 +47,28 @@ class DispatchReport:
     compressed_view: Optional[bool] = None
 
 
+def apply_complexity_composers(signals: SignalMatches,
+                               complexity_rules) -> None:
+    """Composer escalation, in ONE place: a matched composer forces its
+    rule to ":hard", dropping any lower level the family evaluator
+    reported.  Shared by the live dispatch fan-out and the replay
+    engine's raw re-drive (replay/recorder._reproject) — the two must
+    never drift, or replayed projections stop matching what the live
+    request computed."""
+    from ..decision.engine import eval_rule_node
+
+    for rule in complexity_rules:
+        if rule.composer is None:
+            continue
+        matched, conf, _ = eval_rule_node(rule.composer, signals)
+        hard = f"{rule.name}:hard"
+        if matched and hard not in signals.matches.get("complexity", ()):
+            levels = signals.matches.get("complexity", [])
+            signals.matches["complexity"] = [
+                n for n in levels if n.split(":", 1)[0] != rule.name]
+            signals.add("complexity", hard, max(conf, 0.5))
+
+
 class SignalDispatcher:
     def __init__(self, evaluators: List[SignalEvaluator],
                  projections: Optional[ProjectionEvaluator] = None,
@@ -141,20 +163,7 @@ class SignalDispatcher:
         # block on complexity signals — evaluated after the fan-out since
         # it references other signals).
         if self.complexity_rules:
-            from ..decision.engine import eval_rule_node
-
-            for rule in self.complexity_rules:
-                if rule.composer is None:
-                    continue
-                matched, conf, _ = eval_rule_node(rule.composer, signals)
-                hard = f"{rule.name}:hard"
-                if matched and hard not in signals.matches.get("complexity", ()):
-                    # drop any lower level this rule reported
-                    levels = signals.matches.get("complexity", [])
-                    signals.matches["complexity"] = [
-                        n for n in levels
-                        if n.split(":", 1)[0] != rule.name]
-                    signals.add("complexity", hard, max(conf, 0.5))
+            apply_complexity_composers(signals, self.complexity_rules)
 
         needs_projection = (
             self.projections is not None

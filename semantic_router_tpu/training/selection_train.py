@@ -366,6 +366,12 @@ def load_selector(path: str):
         blob = f.read()
     data = json.loads(blob)
     algo = data["algorithm"]
+    if algo == "cost_bandit":
+        # flywheel-trained contextual bandit: self-contained feature
+        # recipe (signal-hash), no category wrapping needed
+        from ..flywheel.policy import CostAwareBanditSelector
+
+        return CostAwareBanditSelector.from_json(blob)
     cls = {"knn": KNNSelector, "kmeans": KMeansSelector,
            "svm": SVMSelector, "mlp": MLPSelector,
            "gmtrouter": GMTRouterSelector}[algo]

@@ -153,6 +153,60 @@ class TestReplayDeterminism:
         assert back.confidences == sm.confidences
         assert back.details == sm.details
 
+    def test_counterfactual_projection_threshold_flip(self):
+        """Replay re-drives PROJECTIONS from raw signal hits: flipping
+        a mapping threshold in the candidate config changes which band
+        fires and therefore which decision wins — something the frozen
+        post-projection matches (reproject=False) can never see."""
+        from semantic_router_tpu.config.schema import RouterConfig
+
+        router = _fixture_router()
+        try:
+            res = router.route({"model": "auto", "messages": [
+                {"role": "user", "content": "hello world"}]})
+            rec = router.explain.get(res.decision_record_id)
+            assert rec["decision"]["name"] == "default_route"
+            raw = json.loads(json.dumps(router.cfg.raw))
+            raw["routing"]["projections"]["mappings"][0]["outputs"] = [
+                {"name": "support_escalated", "gte": -1.0}]
+            cfg2 = RouterConfig.from_dict(raw)
+            replayed = replay_decision(rec, cfg2)
+            assert replayed["decision"] == "escalated_band_route"
+            assert replayed["projections"]["mappings"][
+                "request_band"] == "support_escalated"
+            diff = replay_diff(rec, replayed)
+            assert not diff["identical"]
+            # the frozen-projection path replays the RECORDED band and
+            # cannot observe the threshold flip
+            frozen = replay_decision(rec, cfg2, reproject=False)
+            assert frozen["decision"] == "default_route"
+        finally:
+            router.shutdown()
+
+    def test_raw_reconstruction_matches_live_projection(self):
+        """Under the UNCHANGED config, re-driving projections from raw
+        hits must land exactly where the live request did (composer +
+        partition + mapping determinism)."""
+        from semantic_router_tpu.replay import (
+            raw_signal_matches_from_record,
+        )
+        from semantic_router_tpu.replay.recorder import _reproject
+
+        router = _fixture_router()
+        try:
+            res = router.route(dict(GOLDEN_BODY))
+            rec = router.explain.get(res.decision_record_id)
+            sm, _trace = _reproject(rec, router.cfg)
+            recorded = rec["replay"]
+            assert {k: sorted(v) for k, v in sm.matches.items()} == \
+                {k: sorted(v) for k, v in recorded["matches"].items()}
+            for key, conf in recorded["confidences"].items():
+                assert sm.confidences[key] == pytest.approx(conf)
+            raw_sm, _ = raw_signal_matches_from_record(rec)
+            assert "projection" not in raw_sm.matches
+        finally:
+            router.shutdown()
+
     def test_counterfactual_config_changes_outcome(self):
         router = _fixture_router()
         try:

@@ -1,0 +1,646 @@
+"""Flywheel unit + golden tests (ISSUE 8).
+
+- golden corpus-row test: a fixed request's exported row, volatile
+  fields normalized, must serialize byte-identically to
+  tests/fixtures/flywheel_corpus_golden.json (the schema contract the
+  trainer/evaluator parse);
+- feature determinism across the three call sites (corpus row, live
+  SignalMatches);
+- the cost-aware bandit: offline fit separates arms by context, JSON
+  round-trip preserves choices, foreign-dim feedback is ignored;
+- counterfactual evaluator: a better policy wins with CI > 0,
+  deterministically per seed;
+- promotion state machine: shadow → canary → promote, SLO-burn
+  rollback, incumbent selector restore;
+- admission value weights: per-decision values roll up by class and
+  change what L3 charges.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from semantic_router_tpu.config import load_config
+from semantic_router_tpu.config.schema import ModelRef, RouterConfig
+from semantic_router_tpu.decision.engine import SignalMatches
+from semantic_router_tpu.flywheel import (
+    CorpusExporter,
+    CostAwareBanditSelector,
+    FlywheelController,
+    OutcomeBook,
+    ROW_SCHEMA,
+    ROW_VERSION,
+    counterfactual_eval,
+    record_to_row,
+    reward_for,
+    row_features,
+    row_to_json,
+    signals_obj_features,
+    validate_row,
+)
+from semantic_router_tpu.observability.explain import DecisionExplainer
+from semantic_router_tpu.observability.flightrec import FlightRecorder
+from semantic_router_tpu.observability.metrics import (
+    MetricSeries,
+    MetricsRegistry,
+)
+from semantic_router_tpu.observability.tracing import Tracer
+from semantic_router_tpu.resilience.costmodel import CostModel
+from semantic_router_tpu.router.pipeline import Router
+from semantic_router_tpu.runtime.events import (
+    EventBus,
+    FLYWHEEL_STATE_CHANGED,
+    SLO_ALERT_FIRING,
+)
+from semantic_router_tpu.selection.base import (
+    SelectionContext,
+    registry as selector_registry,
+)
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "router_config.yaml")
+GOLDEN = os.path.join(os.path.dirname(__file__), "fixtures",
+                      "flywheel_corpus_golden.json")
+
+
+def _fixture_router():
+    cfg = load_config(FIXTURE)
+    return Router(cfg, explain=DecisionExplainer(),
+                  metrics=MetricSeries(MetricsRegistry()),
+                  tracer=Tracer(sample_rate=0.0),
+                  flightrec=FlightRecorder())
+
+
+def synth_rows(n=200, seed=0):
+    """Learnable synthetic corpus: code-route traffic is best served by
+    code-7b, chat-route by general-7b; the logged (incumbent) choice is
+    a coin flip, so a correct policy must beat it."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        is_code = i % 2 == 0
+        decision = "code_route" if is_code else "chat_route"
+        cands = ["code-7b", "general-7b"] if is_code \
+            else ["general-7b", "premium-70b"]
+        chosen = cands[int(rng.integers(2))]
+        best = "code-7b" if is_code else "general-7b"
+        reward = 1.0 if chosen == best else 0.3
+        signals = {"language": [["en", 0.633333]]}
+        if is_code:
+            signals["keyword"] = [["code_keywords", 1.0]]
+        rows.append({
+            "row_version": ROW_VERSION,
+            "record_id": f"{i:016x}",
+            "trace_id": f"{i:032x}",
+            "ts_unix": 1000.0 + i,
+            "decision": decision,
+            "candidates": cands,
+            "chosen": chosen,
+            "signals": signals,
+            "projections": None,
+            "degradation_level": 0,
+            "query": f"query {i}",
+            "outcome": {"verdict": "good_fit" if reward == 1.0
+                        else "underpowered",
+                        "quality": 0.0, "latency_ms": 100.0,
+                        "source": "observed"},
+            "reward": reward,
+            "cost_device_s": 0.005,
+            "config_hash": "fixed",
+        })
+    return rows
+
+
+def _normalize_row(row: dict) -> dict:
+    out = json.loads(row_to_json(row))
+    out["record_id"] = "0" * 16
+    out["trace_id"] = "0" * 32
+    out["ts_unix"] = 0
+    out["config_hash"] = "fixed"
+    return out
+
+
+class TestCorpusSchema:
+    def test_golden_row_is_byte_stable(self):
+        """The corpus contract audit: one fixed request through the e2e
+        fixture config exports byte-identically to the pinned golden."""
+        router = _fixture_router()
+        try:
+            res = router.route({"model": "auto", "messages": [
+                {"role": "user",
+                 "content": "urgent: please debug this function asap"}]})
+            rec = router.explain.get(res.decision_record_id)
+            row = record_to_row(rec, cost_model=CostModel())
+            assert not validate_row(row)
+            got = row_to_json(_normalize_row(row))
+            if not os.path.exists(GOLDEN):  # first run: pin the golden
+                with open(GOLDEN, "w") as f:
+                    f.write(got + "\n")
+            with open(GOLDEN) as f:
+                want = f.read().strip()
+            assert got == want, (
+                "corpus row drifted from the golden schema — if the "
+                "change is intentional, delete "
+                "tests/fixtures/flywheel_corpus_golden.json and rerun "
+                "to re-pin")
+        finally:
+            router.shutdown()
+
+    def test_validate_row_catches_drift(self):
+        row = synth_rows(1)[0]
+        assert not validate_row(row)
+        bad = dict(row)
+        bad.pop("reward")
+        assert any("reward" in p for p in validate_row(bad))
+        bad = dict(row, extra_key=1)
+        assert any("extra_key" in p for p in validate_row(bad))
+        bad = dict(row, reward=2.0)
+        assert any("outside" in p for p in validate_row(bad))
+        bad = dict(row, outcome=dict(row["outcome"], verdict="nope"))
+        assert any("verdict" in p for p in validate_row(bad))
+
+    def test_schema_covers_every_emitted_key(self):
+        row = synth_rows(1)[0]
+        assert set(row) == set(ROW_SCHEMA)
+
+    def test_non_route_records_are_skipped(self):
+        assert record_to_row({"kind": "blocked", "model": "m"}) is None
+        assert record_to_row({"kind": "cache_hit", "model": "m"}) is None
+
+    def test_reward_definition(self):
+        assert reward_for("good_fit") == 1.0
+        assert reward_for("failed") == 0.0
+        assert reward_for("underpowered") == 0.3
+        assert reward_for("overprovisioned") == 0.6
+        # quality blends 50/50
+        assert reward_for("good_fit", quality=0.5) == 0.75
+
+    def test_outcome_book_bounded_and_joined(self):
+        book = OutcomeBook(capacity=4)
+        for i in range(8):
+            book.note(f"r{i}", "good_fit", latency_ms=float(i))
+        assert len(book) == 4
+        assert book.get("r0") is None
+        assert book.get("r7")["latency_ms"] == 7.0
+        book.note("r7", "bogus_verdict")  # ignored
+        assert book.get("r7")["verdict"] == "good_fit"
+
+    def test_exporter_jsonl_round_trip(self, tmp_path):
+        router = _fixture_router()
+        try:
+            for text in ("debug my function", "hello world",
+                         "urgent asap fix"):
+                router.route({"model": "auto", "messages": [
+                    {"role": "user", "content": text}]})
+            exporter = CorpusExporter(explain=router.explain,
+                                      cost_model=CostModel())
+            rows = exporter.export_rows()
+            assert rows
+            for row in rows:
+                assert not validate_row(row)
+            path = str(tmp_path / "corpus.jsonl")
+            manifest = exporter.export_jsonl(path)
+            assert manifest["rows"] == len(rows)
+            back = CorpusExporter.load_jsonl(path)
+            assert back == rows
+        finally:
+            router.shutdown()
+
+
+class TestFeatures:
+    def test_row_and_live_features_agree(self):
+        row = synth_rows(2)[0]
+        sm = SignalMatches()
+        for family, hits in row["signals"].items():
+            for rule, conf in hits:
+                sm.add(family, rule, conf)
+        a = row_features(row, dim=32)
+        b = signals_obj_features(sm, dim=32)
+        assert np.allclose(a, b)
+
+    def test_features_deterministic_across_calls(self):
+        row = synth_rows(2)[1]
+        assert np.array_equal(row_features(row), row_features(row))
+
+    def test_distinct_signals_distinct_features(self):
+        rows = synth_rows(2)
+        assert not np.allclose(row_features(rows[0]),
+                               row_features(rows[1]))
+
+
+class TestLiveVsCorpusFeatureParity:
+    def test_shadow_scoring_matches_counterfactual_choice(self):
+        """The promotion gate's core invariant: the candidate's LIVE
+        shadow choice for a request equals the counterfactual
+        ``_policy_choice`` over that request's exported corpus row —
+        even under a config WITH projections (the corpus row's signal
+        view is the record's post-projection replay block, exactly what
+        the live selector context held)."""
+        from semantic_router_tpu.flywheel.evaluator import _policy_choice
+
+        router = _fixture_router()
+        try:
+            fw = FlywheelController(MetricsRegistry())
+            fw.bind(explain=router.explain, events=EventBus(),
+                    cost_model=CostModel(), router=router)
+            fw.configure({"enabled": True})
+            router.flywheel = fw
+            sel = CostAwareBanditSelector(dim=64)
+            sel.fit_offline(synth_rows(100))
+            fw.candidate = sel
+            fw.candidate_meta = {"algorithm": "cost_bandit"}
+            fw.enter_shadow(reason="test")
+            # fusion_route: the fixture's multi-candidate decision,
+            # reachable heuristically; projections fire on every request
+            res = router.route({"model": "auto", "messages": [
+                {"role": "user",
+                 "content": "convene a panel of experts please"}]})
+            rec = router.explain.get(res.decision_record_id)
+            fly = [p for p in rec["plugins"]
+                   if p["plugin"] == "flywheel"]
+            assert fly, "shadow score recorded"
+            row = record_to_row(rec, cost_model=CostModel())
+            assert "projection" in row["signals"]
+            assert _policy_choice(sel, row) == \
+                fly[0]["detail"]["chosen"]
+        finally:
+            router.shutdown()
+
+
+class TestHotReloadReinstall:
+    def test_rebinding_new_router_keeps_promotion(self):
+        """A config hot reload rebuilds the router with fresh incumbent
+        selectors; re-binding the controller must re-install a promoted
+        candidate on the NEW router (and rollback must restore the NEW
+        router's incumbents, not the old router's stale objects)."""
+        old_router = _fixture_router()
+        new_router = _fixture_router()
+        try:
+            fw = FlywheelController(MetricsRegistry())
+            fw.bind(events=EventBus(), cost_model=CostModel(),
+                    router=old_router)
+            fw.configure({"enabled": True})
+            fw.candidate = _AlwaysBestPolicy()
+            fw.last_eval = {"cost_by_decision": {"fusion_route": {}}}
+            fw.promote(reason="test")
+            assert old_router._selectors["fusion_route"] is fw.candidate
+            # the reload: bind the same controller to the new router
+            fresh_incumbent = object()
+            new_router._selectors["fusion_route"] = fresh_incumbent
+            fw.bind(router=new_router)
+            assert new_router._selectors["fusion_route"] is fw.candidate
+            assert fw.state == "promoted"
+            fw.rollback("test")
+            assert new_router._selectors["fusion_route"] \
+                is fresh_incumbent
+        finally:
+            old_router.shutdown()
+            new_router.shutdown()
+
+
+class TestCostAwareBandit:
+    def test_offline_fit_separates_arms_by_context(self):
+        rows = synth_rows(200)
+        sel = CostAwareBanditSelector(dim=64)
+        report = sel.fit_offline(rows)
+        assert set(report["arms"]) == {"code-7b", "general-7b",
+                                       "premium-70b"}
+        code_row, chat_row = rows[0], rows[1]
+
+        def choice(row):
+            sm = SignalMatches()
+            for family, hits in row["signals"].items():
+                for rule, conf in hits:
+                    sm.add(family, rule, conf)
+            refs = [ModelRef(model=m) for m in row["candidates"]]
+            return sel.select(refs, SelectionContext(
+                signals=sm, decision_name=row["decision"])).ref.model
+
+        assert choice(code_row) == "code-7b"
+        assert choice(chat_row) == "general-7b"
+
+    def test_json_round_trip_preserves_choices(self):
+        rows = synth_rows(120)
+        sel = CostAwareBanditSelector(dim=32)
+        sel.fit_offline(rows)
+        back = CostAwareBanditSelector.from_json(sel.to_json())
+        sm = SignalMatches()
+        sm.add("keyword", "code_keywords", 1.0)
+        sm.add("language", "en", 0.633333)
+        refs = [ModelRef(model="code-7b"), ModelRef(model="general-7b")]
+        ctx = SelectionContext(signals=sm)
+        assert sel.select(refs, ctx).ref.model == \
+            back.select(refs, ctx).ref.model
+        assert json.loads(sel.to_json()) == json.loads(back.to_json())
+
+    def test_registered_in_selection_registry(self):
+        sel = selector_registry.create("cost_bandit", dim=16)
+        assert isinstance(sel, CostAwareBanditSelector)
+
+    def test_artifact_loads_through_selection_trainer(self, tmp_path):
+        from semantic_router_tpu.training.selection_train import (
+            load_selector,
+        )
+
+        sel = CostAwareBanditSelector(dim=16)
+        sel.fit_offline(synth_rows(40))
+        path = str(tmp_path / "cost_bandit.json")
+        with open(path, "w") as f:
+            f.write(sel.to_json())
+        loaded = load_selector(path)
+        assert isinstance(loaded, CostAwareBanditSelector)
+        assert loaded.model_costs == sel.model_costs
+
+    def test_foreign_dim_feedback_ignored(self):
+        from semantic_router_tpu.selection.base import Feedback
+
+        sel = CostAwareBanditSelector(dim=16)
+        sel.update(Feedback(model="m", success=True,
+                            query_embedding=np.ones(7, np.float32)))
+        assert not sel.arms
+
+    def test_untrained_falls_back_to_weight(self):
+        sel = CostAwareBanditSelector(dim=16)
+        refs = [ModelRef(model="a", weight=0.2),
+                ModelRef(model="b", weight=0.8)]
+        res = sel.select(refs, SelectionContext())
+        assert res.ref.model == "b"
+        assert "untrained" in res.reason
+
+    def test_cost_penalty_flips_near_ties(self):
+        """Two arms with equal reward: the pricier arm loses once the
+        cost weight is non-zero."""
+        rows = []
+        base = synth_rows(2)[0]
+        for i in range(40):
+            chosen = ("slow-model", "fast-model")[i % 2]
+            rows.append(dict(
+                base, record_id=f"{i:016x}", decision="tie_route",
+                candidates=["slow-model", "fast-model"], chosen=chosen,
+                reward=0.8,
+                outcome={"verdict": "good_fit", "quality": 0.0,
+                         "latency_ms": 4000.0 if chosen == "slow-model"
+                         else 100.0, "source": "observed"}))
+        sel = CostAwareBanditSelector(dim=16, cost_weight=0.5)
+        sel.fit_offline(rows)
+        assert sel.model_costs["slow-model"] == 1.0
+        sm = SignalMatches()
+        sm.add("keyword", "code_keywords", 1.0)
+        sm.add("language", "en", 0.633333)
+        refs = [ModelRef(model="slow-model"),
+                ModelRef(model="fast-model")]
+        assert sel.select(refs, SelectionContext(signals=sm)) \
+            .ref.model == "fast-model"
+
+
+class _AlwaysBestPolicy:
+    """Oracle policy for evaluator tests."""
+
+    def select(self, candidates, ctx):
+        from semantic_router_tpu.selection.base import SelectionResult
+
+        best = {"code_route": "code-7b", "chat_route": "general-7b"}
+        want = best.get(ctx.decision_name)
+        for c in candidates:
+            if c.model == want:
+                return SelectionResult(c, 1.0, "oracle")
+        return SelectionResult(candidates[0], 0.0, "oracle-fallback")
+
+
+class TestCounterfactualEvaluator:
+    def test_better_policy_wins_with_positive_ci(self):
+        rows = synth_rows(300)
+        report = counterfactual_eval(rows, _AlwaysBestPolicy(),
+                                     n_boot=200, seed=0)
+        assert report["evaluated"]
+        assert report["policy"]["reward_mean"] > \
+            report["incumbent"]["reward_mean"]
+        lo, hi = report["reward_delta_ci"]
+        assert lo > 0.0 and hi >= lo
+        assert report["win"]
+        assert report["policy"]["regret_mean"] < \
+            report["incumbent"]["regret_mean"]
+
+    def test_incumbent_vs_itself_is_a_wash(self):
+        rows = synth_rows(300)
+
+        class Echo:
+            def select(self, candidates, ctx):
+                from semantic_router_tpu.selection.base import (
+                    SelectionResult,
+                )
+
+                return SelectionResult(candidates[0], 1.0, "echo")
+
+        # the echo policy picks the first candidate — for code_route
+        # that IS the best model, so delta is positive there but the
+        # report must stay internally consistent
+        report = counterfactual_eval(rows, Echo(), n_boot=100, seed=1)
+        assert report["evaluated"]
+        assert -1.0 <= report["reward_delta"] <= 1.0
+
+    def test_deterministic_per_seed(self):
+        rows = synth_rows(200)
+        a = counterfactual_eval(rows, _AlwaysBestPolicy(), seed=7)
+        b = counterfactual_eval(rows, _AlwaysBestPolicy(), seed=7)
+        assert a == b
+        c = counterfactual_eval(rows, _AlwaysBestPolicy(), seed=8)
+        assert c["reward_delta_ci"] != a["reward_delta_ci"] or \
+            c["seed"] != a["seed"]
+
+    def test_min_rows_floor(self):
+        report = counterfactual_eval(synth_rows(4), _AlwaysBestPolicy(),
+                                     min_rows=50)
+        assert not report["evaluated"]
+
+    def test_decision_values_present(self):
+        report = counterfactual_eval(synth_rows(100),
+                                     _AlwaysBestPolicy())
+        assert set(report["decision_values"]) == {"code_route",
+                                                  "chat_route"}
+        for v in report["decision_values"].values():
+            assert v > 0
+
+
+class TestPromotionMachine:
+    def _controller(self, router=None):
+        bus = EventBus()
+        fw = FlywheelController(MetricsRegistry())
+        fw.bind(events=bus, cost_model=CostModel(), router=router,
+                explain=router.explain if router is not None else None)
+        fw.configure({"enabled": True,
+                      "evaluator": {"min_rows": 10, "bootstrap": 50},
+                      "promotion": {"mode": "shadow"}})
+        return fw, bus
+
+    def test_shadow_requires_candidate(self):
+        fw, _ = self._controller()
+        with pytest.raises(RuntimeError):
+            fw.enter_shadow()
+
+    def test_slo_burn_rolls_back_canary(self):
+        fw, bus = self._controller()
+        fw.candidate = _AlwaysBestPolicy()
+        fw.enter_canary(fraction=0.5)
+        assert fw.state == "canary"
+        bus.emit(SLO_ALERT_FIRING, objective="routing_latency",
+                 severity="fast")
+        assert fw.state == "rolled_back"
+        assert "slo_burn" in fw.rollback_reason
+
+    def test_rollback_on_fast_ignores_slow_burn(self):
+        fw, bus = self._controller()
+        fw.configure({"promotion": {"rollback_on": "fast"}})
+        fw.candidate = _AlwaysBestPolicy()
+        fw.enter_canary()
+        bus.emit(SLO_ALERT_FIRING, objective="x", severity="slow")
+        assert fw.state == "canary"
+        bus.emit(SLO_ALERT_FIRING, objective="x", severity="fast")
+        assert fw.state == "rolled_back"
+
+    def test_burn_outside_canary_is_ignored(self):
+        fw, bus = self._controller()
+        bus.emit(SLO_ALERT_FIRING, objective="x", severity="fast")
+        assert fw.state == "idle"
+
+    def test_state_changes_emit_events(self):
+        fw, bus = self._controller()
+        seen = []
+        bus.subscribe(lambda ev: seen.append(ev)
+                      if ev.stage == FLYWHEEL_STATE_CHANGED else None)
+        fw.candidate = _AlwaysBestPolicy()
+        fw.enter_shadow()
+        fw.enter_canary()
+        assert [e.detail["to_state"] for e in seen] == ["shadow",
+                                                        "canary"]
+
+    def test_run_cycle_never_replaces_a_serving_candidate(self):
+        """A cycle triggered while canary/promoted must not swap the
+        candidate or move the state out of the SLO-rollback guard's
+        window — the serving policy stays protected until rolled back."""
+        router = _fixture_router()
+        try:
+            fw, bus = self._controller(router=router)
+            # seed enough records for a real cycle
+            for text in ("debug a", "debug b", "hello world") * 8:
+                router.route({"model": "auto", "messages": [
+                    {"role": "user", "content": text}]})
+            serving = _AlwaysBestPolicy()
+            fw.candidate = serving
+            fw.enter_canary(reason="test")
+            report = fw.run_cycle()
+            assert report.get("skipped_promotion")
+            assert fw.state == "canary"
+            assert fw.candidate is serving
+            # the rollback guard still fires
+            bus.emit(SLO_ALERT_FIRING, objective="x", severity="fast")
+            assert fw.state == "rolled_back"
+        finally:
+            router.shutdown()
+
+    def test_promote_installs_and_rollback_restores(self):
+        router = _fixture_router()
+        try:
+            fw, _bus = self._controller(router=router)
+            fw.candidate = _AlwaysBestPolicy()
+            fw.last_eval = {"cost_by_decision": {
+                "cs_reasoning_route": {}, "fusion_route": {}}}
+            sentinel = object()
+            router._selectors["fusion_route"] = sentinel
+            took = fw.promote()
+            # only multi-candidate decisions seen in the eval corpus
+            assert set(took) == {"cs_reasoning_route", "fusion_route"}
+            assert router._selectors["fusion_route"] is fw.candidate
+            fw.rollback("test")
+            assert router._selectors["fusion_route"] is sentinel
+            assert "cs_reasoning_route" not in router._selectors
+            assert fw.state == "rolled_back"
+        finally:
+            router.shutdown()
+
+
+class TestAdmissionValueWeights:
+    def test_weights_roll_up_by_class_and_change_charges(self):
+        cm = CostModel()
+        fw = FlywheelController(MetricsRegistry())
+        fw.bind(cost_model=cm)
+        fw.configure({"enabled": True})
+        # live traffic shares: critical runs chat_route, low runs
+        # code_route... values make chat twice as valuable
+        fw._class_traffic = {"high": {"chat_route": 10},
+                             "low": {"code_route": 10}}
+        weights = fw.update_admission_weights({
+            "decision_values": {"chat_route": 200.0,
+                                "code_route": 50.0}})
+        assert weights["high"] > 1.0 > weights["low"]
+        # the L3 charge: high-value class pays LESS per request
+        base = cm.request_cost_s(2)
+        assert cm.admission_cost_s(2, "high") < base
+        assert cm.admission_cost_s(2, "low") > base
+        # unknown class / no key keeps the exact legacy charge
+        assert cm.admission_cost_s(2, "normal") == base
+        assert cm.admission_cost_s(2) == base
+
+    def test_no_weights_is_byte_identical_behavior(self):
+        cm = CostModel()
+        assert cm.admission_cost_s(3, "low") == cm.request_cost_s(3)
+
+    def test_weights_clamped(self):
+        cm = CostModel()
+        fw = FlywheelController(MetricsRegistry())
+        fw.bind(cost_model=cm)
+        fw.configure({"enabled": True,
+                      "admission": {"floor": 0.5, "ceiling": 2.0}})
+        fw._class_traffic = {"high": {"a": 1}, "low": {"b": 1}}
+        weights = fw.update_admission_weights({
+            "decision_values": {"a": 1e6, "b": 1e-6}})
+        assert weights["high"] == 2.0
+        assert weights["low"] == 0.5
+
+    def test_controller_report_exposes_weights(self):
+        cm = CostModel()
+        cm.set_value_weights({"low": 0.5})
+        assert cm.report()["value_weights"] == {"low": 0.5}
+
+
+class TestBootstrapWiring:
+    def test_apply_flywheel_knobs_attach_and_detach(self):
+        from semantic_router_tpu.runtime.bootstrap import (
+            apply_flywheel_knobs,
+        )
+        from semantic_router_tpu.runtime.registry import RuntimeRegistry
+
+        router = _fixture_router()
+        try:
+            registry = RuntimeRegistry.isolated()
+            cfg_on = RouterConfig.from_dict(
+                {"flywheel": {"enabled": True}})
+            apply_flywheel_knobs(cfg_on, registry, router)
+            fw = registry.get("flywheel")
+            assert fw is not None
+            assert router.flywheel is fw
+            assert fw.explain is registry.get("explain")
+            # disable detaches and clears the router hook
+            cfg_off = RouterConfig.from_dict({})
+            apply_flywheel_knobs(cfg_off, registry, router)
+            assert registry.get("flywheel") is None
+            assert router.flywheel is None
+        finally:
+            router.shutdown()
+
+    def test_flywheel_config_normalizer_defaults(self):
+        cfg = RouterConfig.from_dict({})
+        fw = cfg.flywheel_config()
+        assert fw["enabled"] is False
+        assert fw["promotion"]["mode"] == "shadow"
+        assert fw["admission"]["enabled"] is True
+        # malformed values fall back
+        cfg2 = RouterConfig.from_dict({"flywheel": {
+            "enabled": 1, "evaluator": {"min_rows": "nope"},
+            "promotion": {"canary_fraction": "bad"}}})
+        fw2 = cfg2.flywheel_config()
+        assert fw2["enabled"] is True
+        assert fw2["evaluator"]["min_rows"] == 20
+        assert fw2["promotion"]["canary_fraction"] == 0.1

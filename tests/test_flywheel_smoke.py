@@ -1,0 +1,360 @@
+"""Flywheel end-to-end smoke (ISSUE 8 acceptance, tier-1 gate).
+
+The whole loop in-process over a mock-free heuristic router: 100 mixed
+requests route and get outcome verdicts → the corpus exports → the
+cost-aware bandit trains purely from those records → the candidate is
+evaluated counterfactually against the incumbent (with bootstrap CIs)
+→ it serves in shadow with provably identical routing → canaries via
+the promotion ladder → promotes, and rolls back on SLO burn.
+
+Plus the two determinism contracts: export→train→evaluate reruns are
+byte-identical, and flywheel shadow on/off routing outputs are equal.
+"""
+
+import json
+
+import pytest
+
+from semantic_router_tpu.config.schema import RouterConfig
+from semantic_router_tpu.flywheel import (
+    CorpusExporter,
+    CostAwareBanditSelector,
+    FlywheelController,
+    counterfactual_eval,
+    validate_row,
+)
+from semantic_router_tpu.observability.explain import DecisionExplainer
+from semantic_router_tpu.observability.flightrec import FlightRecorder
+from semantic_router_tpu.observability.metrics import (
+    MetricSeries,
+    MetricsRegistry,
+)
+from semantic_router_tpu.observability.tracing import Tracer
+from semantic_router_tpu.resilience.costmodel import CostModel
+from semantic_router_tpu.router.pipeline import Router
+from semantic_router_tpu.runtime.events import EventBus, SLO_ALERT_FIRING
+
+# Learnable structure: code traffic is best served by code-7b, chat by
+# general-7b; the incumbent (seeded weight-proportional static choice)
+# flips a coin, so a correct policy must beat it counterfactually.
+SMOKE_CFG = {
+    "default_model": "general-7b",
+    "model_cards": [
+        {"name": "code-7b", "quality_score": 0.8,
+         "pricing": {"prompt": 0.2, "completion": 0.4}},
+        {"name": "general-7b", "quality_score": 0.75,
+         "pricing": {"prompt": 0.2, "completion": 0.4}},
+        {"name": "premium-70b", "quality_score": 0.95,
+         "pricing": {"prompt": 1.5, "completion": 3.0}},
+    ],
+    "signals": {
+        "keywords": [
+            {"name": "code_keywords", "operator": "OR",
+             "method": "exact", "keywords": ["debug", "refactor"]},
+        ],
+        "language": [{"name": "en"}],
+    },
+    "decisions": [
+        {"name": "code_route", "priority": 100,
+         "rules": {"operator": "OR", "conditions": [
+             {"type": "keyword", "name": "code_keywords"}]},
+         "modelRefs": [{"model": "code-7b", "weight": 0.5},
+                       {"model": "general-7b", "weight": 0.5}],
+         "algorithm": {"type": "static", "seed": 11}},
+        {"name": "chat_route", "priority": 0,
+         "rules": {"operator": "OR", "conditions": [
+             {"type": "language", "name": "en"}]},
+         "modelRefs": [{"model": "general-7b", "weight": 0.5},
+                       {"model": "premium-70b", "weight": 0.5}],
+         "algorithm": {"type": "static", "seed": 13}},
+    ],
+}
+
+BEST = {"code_route": "code-7b", "chat_route": "general-7b"}
+
+
+def _router():
+    cfg = RouterConfig.from_dict(json.loads(json.dumps(SMOKE_CFG)))
+    return Router(cfg, explain=DecisionExplainer(ring_size=2048),
+                  metrics=MetricSeries(MetricsRegistry()),
+                  tracer=Tracer(sample_rate=0.0),
+                  flightrec=FlightRecorder())
+
+
+def _requests(n):
+    out = []
+    for i in range(n):
+        if i % 2 == 0:
+            text = f"please debug the widget module case {i}"
+        else:
+            text = f"tell me about the weather and the news today {i}"
+        out.append({"model": "auto", "messages": [
+            {"role": "user", "content": text}]})
+    return out
+
+
+def _route_and_label(router, n):
+    """Route n mixed requests and feed back the ground-truth verdicts
+    (good_fit for the decision's best model, underpowered otherwise)."""
+    results = []
+    for body in _requests(n):
+        res = router.route(body)
+        assert res.kind == "route"
+        dec = res.decision.decision.name
+        good = res.model == BEST[dec]
+        router.record_feedback(
+            res, success=True,
+            verdict="good_fit" if good else "underpowered",
+            latency_ms=120.0 if good else 900.0)
+        results.append(res)
+    return results
+
+
+def _flywheel(router, bus=None, **overrides):
+    fw = FlywheelController(MetricsRegistry())
+    fw.bind(explain=router.explain, events=bus or EventBus(),
+            cost_model=CostModel(), router=router)
+    cfg = {"enabled": True,
+           "evaluator": {"min_rows": 50, "bootstrap": 100, "seed": 0},
+           "trainer": {"algorithms": ["cost_bandit"]}}
+    cfg.update(overrides)
+    fw.configure(cfg)
+    router.flywheel = fw
+    return fw
+
+
+class TestEndToEndFlywheel:
+    def test_record_train_evaluate_shadow(self, tmp_path):
+        """The acceptance loop: 100 recorded requests → export →
+        train bandit → counterfactual eval (CI) → shadow on win."""
+        router = _router()
+        try:
+            fw = _flywheel(router)
+            _route_and_label(router, 100)
+            report = fw.run_cycle(out_dir=str(tmp_path))
+            assert report["rows"] >= 100
+            ev = report["eval"]
+            assert ev["evaluated"]
+            # trained purely from recorded decision records, the
+            # policy must beat the coin-flip incumbent with CI > 0
+            assert ev["policy"]["reward_mean"] > \
+                ev["incumbent"]["reward_mean"]
+            assert ev["reward_delta_ci"][0] > 0
+            assert ev["win"]
+            assert fw.state == "shadow"
+            assert (tmp_path / "cost_bandit.json").exists()
+
+            # shadow scoring on live traffic: policy choice lands in
+            # the record, zero routing effect, agreement tracked
+            res = router.route(_requests(2)[0])
+            rec = router.explain.get(res.decision_record_id)
+            fly = [p for p in rec["plugins"]
+                   if p["plugin"] == "flywheel"]
+            assert fly and fly[0]["verdict"] == "shadow"
+            assert fly[0]["detail"]["chosen"] == "code-7b"
+            assert fw.shadow_seen >= 1
+            stats = fw.stats()
+            assert stats["state"] == "shadow"
+            assert stats["last_eval"]["win"]
+        finally:
+            router.shutdown()
+
+    def test_corpus_rows_all_schema_valid(self):
+        router = _router()
+        try:
+            _route_and_label(router, 30)
+            rows = CorpusExporter(explain=router.explain,
+                                  cost_model=CostModel()).export_rows()
+            assert len(rows) >= 30
+            for row in rows:
+                assert not validate_row(row)
+            observed = [r for r in rows
+                        if r["outcome"]["source"] == "observed"]
+            assert not observed  # no OutcomeBook attached here
+        finally:
+            router.shutdown()
+
+    def test_outcomes_join_as_observed_rewards(self):
+        router = _router()
+        try:
+            fw = _flywheel(router)
+            _route_and_label(router, 40)
+            rows = CorpusExporter(explain=router.explain,
+                                  outcomes=fw.outcomes,
+                                  cost_model=CostModel()).export_rows()
+            observed = [r for r in rows
+                        if r["outcome"]["source"] == "observed"]
+            assert len(observed) == len(rows)
+            for row in observed:
+                want = 1.0 if row["chosen"] == BEST[row["decision"]] \
+                    else 0.3
+                assert row["reward"] == want
+        finally:
+            router.shutdown()
+
+
+class TestShadowZeroBehaviorChange:
+    def test_routing_identical_with_shadow_on_and_off(self):
+        """The shadow-mode guarantee: two fresh routers, identical
+        seeded config, identical request stream — the one carrying a
+        shadow-mode flywheel routes every request to the SAME model
+        with the SAME headers (minus the record id)."""
+        trainer_router = _router()
+        try:
+            fw0 = _flywheel(trainer_router)
+            _route_and_label(trainer_router, 80)
+            rows = fw0.export_corpus()
+            candidate = CostAwareBanditSelector(dim=64)
+            candidate.fit_offline(rows)
+        finally:
+            trainer_router.shutdown()
+
+        plain = _router()
+        shadowed = _router()
+        try:
+            fw = _flywheel(shadowed)
+            fw.candidate = candidate
+            fw.candidate_meta = {"algorithm": "cost_bandit"}
+            fw.enter_shadow(reason="test")
+            for body in _requests(40):
+                a = plain.route(dict(body))
+                b = shadowed.route(dict(body))
+                assert a.model == b.model
+                assert a.kind == b.kind
+                assert a.selection_reason == b.selection_reason
+                volatile = ("x-vsr-decision-record",
+                            "x-vsr-request-id")
+                ha = {k: v for k, v in a.headers.items()
+                      if k not in volatile}
+                hb = {k: v for k, v in b.headers.items()
+                      if k not in volatile}
+                assert ha == hb
+                # ...while the shadowed router's records carry the
+                # policy's choice
+                rec = shadowed.explain.get(b.decision_record_id)
+                assert any(p["plugin"] == "flywheel"
+                           for p in rec["plugins"])
+            assert fw.shadow_seen == 40
+        finally:
+            plain.shutdown()
+            shadowed.shutdown()
+
+
+class TestCanaryAndRollback:
+    def _trained_candidate(self):
+        router = _router()
+        try:
+            fw = _flywheel(router)
+            _route_and_label(router, 80)
+            rows = fw.export_corpus()
+            sel = CostAwareBanditSelector(dim=64)
+            sel.fit_offline(rows)
+            return sel
+        finally:
+            router.shutdown()
+
+    def test_canary_overrides_and_slo_burn_rolls_back(self):
+        candidate = self._trained_candidate()
+        bus = EventBus()
+        router = _router()
+        try:
+            fw = _flywheel(router, bus=bus)
+            fw.candidate = candidate
+            fw.candidate_meta = {"algorithm": "cost_bandit"}
+            fw.enter_canary(fraction=1.0, reason="test")
+            # at fraction 1.0 every code request routes by the policy
+            for body in _requests(20):
+                res = router.route(body)
+                dec = res.decision.decision.name
+                assert res.model == BEST[dec]
+            assert fw.overrides > 0
+            rec_models = {
+                p["detail"]["chosen"]
+                for r in router.explain.list(limit=20)
+                for p in r["plugins"] if p["plugin"] == "flywheel"}
+            assert rec_models <= set(BEST.values())
+
+            # SLO burn → instant rollback; overrides stop
+            bus.emit(SLO_ALERT_FIRING, objective="routing_latency p99",
+                     severity="fast")
+            assert fw.state == "rolled_back"
+            overrides_before = fw.overrides
+            for body in _requests(10):
+                res = router.route(body)
+                assert "flywheel:canary" not in res.selection_reason
+            assert fw.overrides == overrides_before
+        finally:
+            router.shutdown()
+
+    def test_auto_promote_after_canary_floor(self):
+        candidate = self._trained_candidate()
+        router = _router()
+        try:
+            fw = _flywheel(router, promotion={
+                "mode": "auto", "canary_fraction": 1.0,
+                "canary_min_requests": 6})
+            fw.candidate = candidate
+            fw.candidate_meta = {"algorithm": "cost_bandit"}
+            fw.last_eval = {"cost_by_decision": {"code_route": {},
+                                                 "chat_route": {}}}
+            fw.enter_canary(reason="test")
+            _ = [router.route(b) for b in _requests(12)]
+            assert fw.state == "promoted"
+            assert set(fw._promoted_decisions) == {"code_route",
+                                                   "chat_route"}
+            # the candidate now IS the serving selector
+            res = router.route(_requests(2)[0])
+            assert res.model == "code-7b"
+            assert "cost_bandit" in res.selection_reason
+            # rollback restores the seeded incumbents
+            fw.rollback("test")
+            assert "code_route" not in router._selectors \
+                or router._selectors["code_route"] is not candidate
+        finally:
+            router.shutdown()
+
+
+class TestRoundTripDeterminism:
+    def test_export_train_evaluate_is_deterministic(self):
+        """Same ring contents → byte-identical corpus, artifact, and
+        evaluation report across reruns."""
+        router = _router()
+        try:
+            fw = _flywheel(router)
+            _route_and_label(router, 60)
+            exporter = CorpusExporter(explain=router.explain,
+                                      outcomes=fw.outcomes,
+                                      cost_model=CostModel())
+            rows_a = exporter.export_rows()
+            rows_b = exporter.export_rows()
+            assert rows_a == rows_b
+
+            sel_a = CostAwareBanditSelector(dim=64)
+            sel_a.fit_offline(rows_a)
+            sel_b = CostAwareBanditSelector(dim=64)
+            sel_b.fit_offline(rows_b)
+            assert sel_a.to_json() == sel_b.to_json()
+
+            ev_a = counterfactual_eval(rows_a, sel_a, n_boot=100,
+                                       seed=0)
+            ev_b = counterfactual_eval(rows_b, sel_b, n_boot=100,
+                                       seed=0)
+            assert ev_a == ev_b
+        finally:
+            router.shutdown()
+
+
+class TestDebugEndpointShape:
+    def test_stats_payload_is_json_serializable(self):
+        router = _router()
+        try:
+            fw = _flywheel(router)
+            _route_and_label(router, 60)
+            fw.run_cycle()
+            payload = fw.stats()
+            json.dumps(payload)  # /debug/flywheel contract
+            assert payload["enabled"]
+            assert payload["state"] in ("shadow", "candidate")
+            assert "admission_weights" in payload
+        finally:
+            router.shutdown()

@@ -194,6 +194,52 @@ class TestFusedEquivalence:
             eng.shutdown()
 
 
+class TestFusedDedup:
+    def test_identical_sequences_share_one_trunk_row(self):
+        """Identical token sequences within one fused batch ride a
+        single trunk row and fan logits out on demux — counter-proven:
+        6 copies of the same prompt collapse 5 rows, and every copy's
+        result equals the singleton run bit-for-bit."""
+        series = fresh_series()
+        cfg = InferenceEngineConfig(max_batch_size=16, max_wait_ms=20.0,
+                                    seq_len_buckets=[32, 128, 512])
+        eng = make_shared_trunk_engine(engine_cfg=cfg, metrics=series)
+        try:
+            text = "the same hot prompt arriving six times"
+            task = TASKS[0]
+            solo = eng.classify(task, text)
+            before = series.fused_dedup_rows.total()
+            out = eng.classify_batch(task, [text] * 6)
+            assert series.fused_dedup_rows.total() - before >= 5
+            for r in out:
+                assert r.label == solo.label
+                assert r.index == solo.index
+                for k in r.probs:
+                    assert r.probs[k] == pytest.approx(solo.probs[k],
+                                                       abs=1e-5)
+        finally:
+            eng.shutdown()
+
+    def test_dedup_keeps_mixed_batches_correct(self, fused_engine,
+                                               unfused_engine):
+        """Duplicates mixed with distinct prompts: the deduped fused
+        batch still matches the unfused reference for every item."""
+        texts = ["alpha prompt", "alpha prompt", "beta prompt",
+                 "alpha prompt", "gamma prompt", "beta prompt"]
+        for task in TASKS:
+            fused = fused_engine.classify_batch(task, texts)
+            trad = unfused_engine.classify_batch(task, texts)
+            for f, t in zip(fused, trad):
+                assert f.label == t.label
+                for k in f.probs:
+                    assert f.probs[k] == pytest.approx(t.probs[k],
+                                                       abs=1e-4)
+
+    def test_dedup_counter_registered(self):
+        series = fresh_series()
+        assert series.fused_dedup_rows.total() == 0
+
+
 class TestFanoutCounters:
     def _dispatcher(self, eng):
         from semantic_router_tpu.signals.dispatch import SignalDispatcher

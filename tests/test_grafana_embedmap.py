@@ -50,9 +50,9 @@ class TestGrafana:
         rc = main(["grafana", "--out-dir", str(tmp_path / "g")])
         assert rc == 0
         out = json.loads(capsys.readouterr().out)
-        # 7 curated dashboards (incl. Runtime & SLO, Decisions, and
-        # Resilience) + catalog + provider
-        assert len(out["rendered"]) == 9
+        # 8 curated dashboards (incl. Runtime & SLO, Decisions,
+        # Resilience, and Flywheel) + catalog + provider
+        assert len(out["rendered"]) == 10
 
 
 class TestEmbedMap:
