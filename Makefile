@@ -2,8 +2,8 @@ PY ?= python
 SHELL := /bin/bash
 
 .PHONY: test test-fast tier1 trace-smoke metrics-lint explain-smoke \
-	resilience-smoke fleet-smoke flywheel-smoke native bench \
-	bench-replay perf perf-record serve-mock clean
+	resilience-smoke fleet-smoke flywheel-smoke upstream-smoke native \
+	bench bench-replay perf perf-record serve-mock clean
 
 bench-replay:
 	$(PY) benchmarks/replay_bench.py --n 500 \
@@ -79,6 +79,19 @@ fleet-smoke:
 flywheel-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_flywheel.py \
 	  tests/test_flywheel_smoke.py -q -p no:cacheprovider
+
+# upstream-failover gate (docs/RESILIENCE.md "Upstream failover"):
+# breaker state-machine units + deadline math + the failover chaos e2e
+# — the selected backend is FaultProxy'd to 100% error (and separately
+# to timeout / timed flap), ≥99% of requests must still succeed via
+# failover to the next-best candidate, the breaker must open within
+# the failure window and recover through its half-open probe, no
+# retries at degradation ≥ L2, and resilience.upstream disabled (the
+# default) must route byte-identically.  Tier-1 (runs inside
+# `make tier1` too).
+upstream-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_upstream.py \
+	  tests/test_upstream_chaos.py -q -p no:cacheprovider
 
 native:
 	$(PY) -m semantic_router_tpu.native.build

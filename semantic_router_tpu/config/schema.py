@@ -1314,6 +1314,81 @@ class RouterConfig:
         """
         return dict(self.resilience or {})
 
+    def upstream_config(self) -> Dict[str, Any]:
+        """Normalized ``resilience.upstream`` block — the upstream
+        resilience plane (resilience/upstream.py), the ONE
+        interpretation point::
+
+          resilience:
+            upstream:
+              enabled: false       # default OFF: byte-identical routing
+              fleet_share: true    # publish open circuits via the
+                                   # state plane (when one is attached)
+              breaker:
+                failures: 5        # consecutive failures to open
+                open_s: 10         # cooldown before the half-open probe
+                ewma_alpha: 0.2    # error-rate / latency EWMA weight
+                error_rate: 0.5    # ALSO open on sustained EWMA error
+                                   # rate >= this once >= 10 samples
+                                   # exist (0 or 1 disables this leg)
+              retry:
+                budget_per_s: 1.0  # token-bucket retry budget
+                burst: 10          # bucket burst (retries)
+                max_attempts: 3    # total attempts incl. the first
+                backoff_ms: 50     # jittered exponential backoff base
+                disable_at_level: 2   # no retries at degradation >= L2
+                on: [connect, 5xx, timeout, reset]  # retryable kinds
+              deadline:
+                header: x-vsr-deadline
+                default_s: 0       # request budget (0 = flat forward
+                                   # timeout)
+                floor_s: 0.5       # per-attempt timeout floor
+
+        Malformed values fall back to defaults — resilience config must
+        never stop the server."""
+        up = dict((self.resilience or {}).get("upstream", {}) or {})
+        out: Dict[str, Any] = {
+            "enabled": bool(up.get("enabled", False)),
+            "fleet_share": bool(up.get("fleet_share", True)),
+        }
+
+        def _block(name: str, defaults: Dict[str, Any]) -> Dict[str, Any]:
+            raw = dict(up.get(name, {}) or {})
+            merged = dict(defaults)
+            for k, v in raw.items():
+                if k not in defaults:
+                    continue
+                want = type(defaults[k])
+                try:
+                    if want is bool:
+                        merged[k] = bool(v)
+                    elif want is int:
+                        merged[k] = int(v)
+                    elif want is float:
+                        merged[k] = float(v)
+                    elif want is list:
+                        if isinstance(v, (list, tuple)):
+                            merged[k] = [str(x) for x in v]
+                        elif v:
+                            merged[k] = [str(v)]
+                    else:
+                        merged[k] = str(v)
+                except (TypeError, ValueError):
+                    pass
+            return merged
+
+        out["breaker"] = _block("breaker", {
+            "failures": 5, "open_s": 10.0, "ewma_alpha": 0.2,
+            "error_rate": 0.5})
+        out["retry"] = _block("retry", {
+            "budget_per_s": 1.0, "burst": 10.0, "max_attempts": 3,
+            "backoff_ms": 50.0, "disable_at_level": 2,
+            "on": ["connect", "5xx", "timeout", "reset"]})
+        out["deadline"] = _block("deadline", {
+            "header": "x-vsr-deadline", "default_s": 0.0,
+            "floor_s": 0.5})
+        return out
+
     def stateplane_config(self) -> Dict[str, Any]:
         """Normalized ``stateplane`` block — the ONE interpretation
         point (bootstrap, the fleet harness, and tests must never drift
@@ -1375,6 +1450,8 @@ class RouterConfig:
 
           flywheel:
             enabled: false         # default OFF: byte-identical routing
+            cycle_interval_s: 0    # scheduled run_cycle period
+                                   # (0 = operator-triggered POST only)
             corpus:
               max_rows: 10000      # export window over the explain ring
                                    # + durable mirror
@@ -1404,6 +1481,13 @@ class RouterConfig:
         never stop the server."""
         fw = dict(self.flywheel or {})
         out: Dict[str, Any] = {"enabled": bool(fw.get("enabled", False))}
+        # scheduled cycle runner: run_cycle() fires every interval
+        # instead of operator-triggered POST only (0 = operator-only)
+        try:
+            out["cycle_interval_s"] = max(
+                0.0, float(fw.get("cycle_interval_s", 0.0)))
+        except (TypeError, ValueError):
+            out["cycle_interval_s"] = 0.0
 
         def _block(name: str, defaults: Dict[str, Any]) -> Dict[str, Any]:
             raw = dict(fw.get(name, {}) or {})

@@ -31,8 +31,10 @@ out; docs/FUSED_BANK.md is the operator story.
 
 from __future__ import annotations
 
+import hashlib
 import threading
 import time
+import weakref
 from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence
 
@@ -47,6 +49,61 @@ from .batcher import BatchItem, DynamicBatcher, pick_bucket, pow2_batch
 # batch-group key prefix for fused trunk groups — the group id, not the
 # task name, is the batching unit (see module docstring)
 TRUNK_KEY = "__trunk__"
+
+# content digests of trunk parameter leaves, memoized by object id with
+# a weakref guard (id() values recycle after GC; the guard makes a
+# recycled id recompute instead of serving a stale digest).  Keyed by
+# id so the common case — K tasks registered over the SAME arrays —
+# hashes each leaf once, not K times.
+_LEAF_DIGESTS: Dict[int, tuple] = {}
+_LEAF_DIGESTS_LOCK = threading.Lock()
+
+
+def _leaf_digest(leaf) -> str:
+    """Content address of one parameter array: blake2b over dtype +
+    shape + bytes.  Registration-time only (never on the hot path)."""
+    key = id(leaf)
+    with _LEAF_DIGESTS_LOCK:
+        hit = _LEAF_DIGESTS.get(key)
+    if hit is not None:
+        ref, digest = hit
+        if ref() is leaf:
+            return digest
+    x = np.ascontiguousarray(np.asarray(leaf))
+    h = hashlib.blake2b(digest_size=16)
+    h.update(str(x.dtype).encode())
+    h.update(str(x.shape).encode())
+    h.update(x.data)
+    digest = h.hexdigest()
+    try:
+        with _LEAF_DIGESTS_LOCK:
+            _LEAF_DIGESTS[key] = (weakref.ref(leaf), digest)
+            if len(_LEAF_DIGESTS) > 4096:
+                # sweep entries whose arrays died (config hot reloads
+                # re-register tasks; without this the memo grows one
+                # stale tuple per collected leaf, forever)
+                for k in [k for k, (r, _d) in _LEAF_DIGESTS.items()
+                          if r() is None]:
+                    del _LEAF_DIGESTS[k]
+    except TypeError:
+        pass  # not weakref-able: recompute next time
+    return digest
+
+
+def _tokenizer_fingerprint(tok) -> Hashable:
+    """Content identity for a tokenizer — two equivalent tokenizers
+    must not split a trunk group just for being distinct objects.
+    HashTokenizer is fully described by its vocab size; file-backed
+    tokenizers key on their source path + vocab; anything else keeps
+    object identity (correct, just never cross-instance)."""
+    name = type(tok).__name__
+    vocab = getattr(tok, "vocab_size", None)
+    if name == "HashTokenizer":
+        return (name, vocab)
+    path = getattr(tok, "path", "")
+    if path:
+        return (name, path, vocab)
+    return (name, id(tok))
 
 
 @dataclass
@@ -313,10 +370,14 @@ class InferenceEngine:
     def _trunk_fingerprint(module, params, tokenizer: Tokenizer,
                            max_seq_len: int, pad_id: int
                            ) -> Optional[tuple]:
-        """Grouping key: tasks registered with the SAME trunk parameter
-        arrays (object identity — no false positives, no content hashing
-        on the hot registration path), the same tokenizer object, and
-        compatible shape discipline share one fused group."""
+        """Grouping key: tasks whose trunk parameter arrays hold the
+        SAME CONTENT (blake2b digests, memoized by object id so the
+        common same-arrays case hashes once), a content-equivalent
+        tokenizer, and compatible shape discipline share one fused
+        group.  Content addressing — not object identity — so two
+        checkpoint files with identical frozen trunks fuse too; the
+        digest memo's weakref guard keeps recycled ids from ever
+        producing a false positive."""
         cfg = getattr(module, "config", None)
         if cfg is None:
             return None
@@ -324,14 +385,22 @@ class InferenceEngine:
         trunk = p.get("model") if hasattr(p, "get") else None
         if trunk is None:
             return None
-        leaf_ids = tuple(id(x) for x in jax.tree_util.tree_leaves(trunk))
+        try:
+            leaf_key = tuple(
+                _leaf_digest(x)
+                for x in jax.tree_util.tree_leaves(trunk))
+        except Exception:
+            # un-hashable leaves (exotic array types): fall back to the
+            # identity fingerprint — correct, just never cross-file
+            leaf_key = tuple(
+                id(x) for x in jax.tree_util.tree_leaves(trunk))
         try:
             # label width is per-head, never part of the trunk identity
             cfg_key = repr(replace(cfg, num_labels=0))
         except TypeError:
             cfg_key = repr(cfg)
-        return (leaf_ids, id(tokenizer), int(max_seq_len), int(pad_id),
-                cfg_key)
+        return (leaf_key, _tokenizer_fingerprint(tokenizer),
+                int(max_seq_len), int(pad_id), cfg_key)
 
     def _evict_locked(self, name: str) -> None:
         """Remove a task from its trunk group (caller holds self._lock):

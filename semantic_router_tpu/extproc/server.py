@@ -348,6 +348,21 @@ class ExtProcService:
             if state.t_start else 0.0
         success = state.response_status == 200
 
+        # upstream health feed, extproc shape: Envoy owns endpoint
+        # selection, so the plane tracks the MODEL level (endpoint "")
+        # — the selection-time candidate mask and the exported
+        # x-vsr-fallback-models ranking both read it
+        up = getattr(self.router, "upstream_health", None)
+        if up is not None:
+            try:
+                up.record(route.model, "",
+                          state.response_status < 500,
+                          latency_ms / 1e3,
+                          kind="ok" if state.response_status < 500
+                          else "5xx")
+            except Exception:
+                pass
+
         if state.is_sse:
             final = self._assemble_sse(raw)
             try:

@@ -72,6 +72,16 @@ class FlywheelController:
         self.cfg: Dict[str, Any] = _default_cfg()
         self.enabled = False
         self.state = "idle"
+        # scheduled cycle runner (flywheel.cycle_interval_s): run_cycle
+        # fires periodically instead of operator-triggered POST only
+        self.cycle_interval_s = 0.0
+        self.cycles_run = 0
+        self._cycle_thread: Optional[threading.Thread] = None
+        self._cycle_stop = threading.Event()
+        # serializes run_cycle(): the scheduled runner and the operator
+        # POST /debug/flywheel/cycle handler may otherwise interleave
+        # candidate installation with the promotion-state transition
+        self._cycle_mutex = threading.Lock()
         self.outcomes = OutcomeBook()
         self.candidate = None           # the policy under evaluation
         self.candidate_meta: Dict[str, Any] = {}
@@ -139,6 +149,17 @@ class FlywheelController:
             self.outcomes.capacity = max(
                 self.outcomes.capacity,
                 int(self.cfg["corpus"]["max_rows"]))
+            try:
+                self.cycle_interval_s = max(0.0, float(
+                    cfg.get("cycle_interval_s", self.cycle_interval_s)))
+            except (TypeError, ValueError):
+                pass
+        # (re)arm the scheduled runner OUTSIDE the lock: interval > 0
+        # starts/retunes it, 0 stops it (operator-triggered only)
+        if self.cycle_interval_s > 0 and self.enabled:
+            self.start_cycles(self.cycle_interval_s)
+        else:
+            self.stop_cycles()
 
     def bind(self, explain=None, events=None, experience=None,
              cost_model=None, router=None) -> "FlywheelController":
@@ -248,7 +269,14 @@ class FlywheelController:
     def run_cycle(self, out_dir: Optional[str] = None) -> Dict[str, Any]:
         """One full flywheel turn: export → train → counterfactual eval
         → (on win) shadow.  Returns the cycle report served at
-        /debug/flywheel."""
+        /debug/flywheel.  Serialized: the scheduled runner and the
+        operator POST may not interleave (a half-installed candidate
+        must never enter the promotion ladder)."""
+        with self._cycle_mutex:
+            return self._run_cycle_locked(out_dir)
+
+    def _run_cycle_locked(self, out_dir: Optional[str] = None
+                          ) -> Dict[str, Any]:
         from .evaluator import counterfactual_eval
         from .trainer import load_policy, train_policies
 
@@ -335,6 +363,50 @@ class FlywheelController:
             report["state"] = self.state
         self.last_cycle_at = time.time()
         return report
+
+    # -- scheduled cycle runner (flywheel.cycle_interval_s) ----------------
+
+    def start_cycles(self, interval_s: float) -> None:
+        """Run run_cycle() every ``interval_s`` on a daemon thread
+        (ROADMAP direction-4 follow-on: the flywheel turns itself
+        instead of waiting for an operator POST).  Idempotent: a live
+        runner just retunes its interval; cycle errors are contained
+        and counted, never fatal."""
+        self.cycle_interval_s = max(0.05, float(interval_s))
+        if self._cycle_thread is not None \
+                and self._cycle_thread.is_alive():
+            return
+
+        # each runner owns a FRESH stop event, captured in its closure:
+        # stop_cycles' join(timeout) can abandon a runner mid-run_cycle,
+        # and a later start_cycles must never resurrect the abandoned
+        # one by clearing a SHARED event (two concurrent runners would
+        # race on promotion state)
+        stop = self._cycle_stop = threading.Event()
+
+        def loop() -> None:
+            while not stop.wait(self.cycle_interval_s):
+                if not self.enabled:
+                    continue
+                try:
+                    self.run_cycle()
+                    self.cycles_run += 1
+                except Exception as exc:
+                    component_event(
+                        "flywheel", "scheduled_cycle_failed",
+                        error=f"{type(exc).__name__}: {exc}"[:200],
+                        level="warning")
+
+        self._cycle_thread = threading.Thread(
+            target=loop, daemon=True, name="flywheel-cycles")
+        self._cycle_thread.start()
+
+    def stop_cycles(self) -> None:
+        self._cycle_stop.set()
+        thread = self._cycle_thread
+        self._cycle_thread = None
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=1.0)
 
     # -- promotion ladder --------------------------------------------------
 
@@ -560,6 +632,8 @@ class FlywheelController:
             "state": self.state,
             "candidate": dict(self.candidate_meta),
             "last_cycle_at": self.last_cycle_at,
+            "cycle_interval_s": self.cycle_interval_s,
+            "scheduled_cycles_run": self.cycles_run,
             "corpus": {"max_rows": self.cfg["corpus"]["max_rows"],
                        "outcomes_held": len(self.outcomes)},
             "shadow": {"seen": shadow_seen, "agree": shadow_agree,
@@ -579,6 +653,7 @@ class FlywheelController:
         }
 
     def close(self) -> None:
+        self.stop_cycles()
         if self._unsubscribe is not None:
             try:
                 self._unsubscribe()

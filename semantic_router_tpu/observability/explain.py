@@ -76,6 +76,11 @@ RECORD_SCHEMA: Dict[str, tuple] = {
     # request routed under — a replay of a brownout-era record must know
     # learned signals were intentionally absent, not broken
     "degradation_level": (int,),
+    # resilience/upstream.py: the forward attempt ladder when the proxy
+    # path failed over ([] for the clean single-attempt case) — each
+    # entry {model, endpoint, outcome, status[, latency_ms]}, stamped
+    # after the forward completes via DecisionExplainer.annotate
+    "failover_path": (list,),
 }
 
 _SIGNAL_KEYS = ("source", "latency_ms", "error", "hits")
@@ -281,6 +286,7 @@ class RecordDraft:
             "query": "" if redact_pii else query,
             "config_hash": config_hash,
             "degradation_level": int(self.degradation_level),
+            "failover_path": [],
         }
 
 
@@ -389,6 +395,30 @@ class DecisionExplainer:
             except Exception:
                 pass
         return record["record_id"]
+
+    def annotate(self, key: str, **fields: Any) -> bool:
+        """Post-commit annotation of a ringed record (the forward path
+        finishes AFTER route() committed the record — failover_path can
+        only land here).  Schema-gated: unknown keys are dropped so an
+        annotation can never break validate_record.  The durable mirror
+        re-adds the record (stores upsert by record id), so post-restart
+        audits see the failover too."""
+        rec = self.get(key)
+        if rec is None:
+            return False
+        clean = {k: _jsonable(v) for k, v in fields.items()
+                 if k in RECORD_SCHEMA}
+        if not clean:
+            return False
+        with self._lock:
+            rec.update(clean)
+            store = self.durable_store
+        if store is not None:
+            try:
+                store.add(rec)
+            except Exception:
+                pass
+        return True
 
     def _trim_locked(self) -> None:
         while len(self._ring) > self.ring_size:

@@ -420,6 +420,66 @@ def flywheel() -> Dict:
                       p, tags=["flywheel", "learning"])
 
 
+_UPSTREAMS_MD = (
+    "**Upstream resilience plane** (docs/RESILIENCE.md \"Upstream "
+    "failover\"): every forward outcome feeds a per-(model, endpoint) "
+    "health scorer — EWMA error rate + latency and a consecutive-"
+    "failure circuit breaker with half-open probing.  Open circuits "
+    "are masked at selection time, the proxy path fails over to the "
+    "ranked next-best candidates under a token-bucket retry budget "
+    "(no retries at degradation ≥ L2), and per-attempt timeouts "
+    "derive from the `x-vsr-deadline` end-to-end budget.  Inspect "
+    "live state at `/debug/upstreams`."
+)
+
+
+def upstreams() -> Dict:
+    """The "Upstreams" dashboard (ISSUE 9): open circuits, per-outcome
+    forward rate, failover rate, retry-budget decisions, attempt
+    latency — next to a link panel into /debug/upstreams."""
+    p = [
+        _stat("Open circuits",
+              "max(llm_upstream_breaker_open) or vector(0)",
+              panel_id=1, x=0, y=0),
+        _stat("Failover rate",
+              "sum(rate(llm_upstream_failovers_total[5m])) or vector(0)",
+              panel_id=2, x=6, y=0),
+        _stat("Upstream error rate",
+              'sum(rate(llm_upstream_requests_total{outcome!="ok"}[5m]))'
+              ' / sum(rate(llm_upstream_requests_total[5m]))',
+              unit="percentunit", panel_id=3, x=12, y=0),
+        _stat("Retries denied",
+              'sum(rate(llm_upstream_retries_total{granted="false"}'
+              '[5m])) or vector(0)',
+              panel_id=4, x=18, y=0),
+        _panel("Forward attempts by outcome",
+               ["sum(rate(llm_upstream_requests_total[5m])) "
+                "by (outcome)"],
+               panel_id=5, x=0, y=4, legends=["{{outcome}}"]),
+        _panel("Failovers by serving model",
+               ["sum(rate(llm_upstream_failovers_total[5m])) "
+                "by (model)"],
+               panel_id=6, x=12, y=4, legends=["{{model}}"]),
+        _panel("Breaker transitions",
+               ["sum(rate(llm_upstream_breaker_transitions_total[5m])) "
+                "by (state)"],
+               panel_id=7, x=0, y=12, legends=["→ {{state}}"]),
+        _panel("Attempt latency",
+               _hist_quantiles("llm_upstream_attempt_latency_seconds"),
+               unit="s", panel_id=8, x=12, y=12,
+               legends=["p50", "p95", "p99"]),
+        _panel("Retry budget decisions",
+               ["sum(rate(llm_upstream_retries_total[5m])) "
+                "by (granted, reason)"],
+               panel_id=9, x=0, y=20,
+               legends=["granted={{granted}} {{reason}}"]),
+        _text_panel("Upstream failover", _UPSTREAMS_MD,
+                    panel_id=10, x=12, y=20),
+    ]
+    return _dashboard("srt-upstreams", "Semantic Router — Upstreams",
+                      p, tags=["resilience", "upstreams"])
+
+
 def catalog(registry=None) -> Dict:
     """Auto-generated dashboard: one panel per registered series —
     anything new in the registry shows up here without template edits."""
@@ -475,6 +535,7 @@ def render_all(out_dir: str, registry=None) -> List[str]:
         "decisions.json": decisions(),
         "resilience.json": resilience(),
         "flywheel.json": flywheel(),
+        "upstreams.json": upstreams(),
         "metric_catalog.json": catalog(registry),
     }
     for fname, dash in dashboards.items():

@@ -20,6 +20,12 @@ from .controller import (
     level_name,
 )
 from .costmodel import CostModel, make_path_cost_prior
+from .upstream import (
+    DEADLINE_HEADER,
+    UpstreamHealth,
+    attempt_timeout,
+    parse_deadline,
+)
 from .priority import (
     PRIORITY_CLASSES,
     PRIORITY_HEADER,
@@ -34,4 +40,6 @@ __all__ = [
     "level_name", "LEVEL_NAMES",
     "L0_NORMAL", "L1_SHED_OPTIONAL", "L2_BROWNOUT", "L3_ADMISSION",
     "L4_FAIL_STATIC",
+    "UpstreamHealth", "parse_deadline", "attempt_timeout",
+    "DEADLINE_HEADER",
 ]

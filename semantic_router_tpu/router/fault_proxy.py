@@ -13,7 +13,16 @@ plans, runtime-adjustable so a test can flip modes mid-traffic):
 - ``refuse``: stop accepting entirely (connect refused ≈ dead replica);
 - ``latency_ms``: added per-request delay (tail-latency injection);
 - ``plan``: an explicit per-request script, e.g. ["ok", "error",
-  "disconnect"] cycled — deterministic chaos for assertions.
+  "disconnect"] cycled — deterministic chaos for assertions;
+- ``slow`` (plan action): hold the request ``slow_ms`` before
+  forwarding — the hung-backend mode that trips client read timeouts
+  instead of returning a clean 5xx;
+- ``reset`` (plan action): hard RST after reading the request
+  (SO_LINGER 0) — connection reset mid-exchange, not a polite FIN;
+- ``set_flap(down_s, up_s, mode)``: timed flapping — the proxy
+  alternates between a faulty window (``mode``: error/slow/reset/
+  disconnect) and a healthy window, so chaos tests can script partial
+  and INTERMITTENT failure, not just clean 5xx.
 
 Everything else proxies verbatim to the target backend.
 """
@@ -22,6 +31,7 @@ from __future__ import annotations
 
 import json
 import socket
+import struct
 import threading
 import time
 import urllib.error
@@ -34,15 +44,19 @@ class FaultProxy:
 
     def __init__(self, target_url: str, error_rate: float = 0.0,
                  disconnect_rate: float = 0.0, latency_ms: float = 0.0,
-                 plan: Optional[List[str]] = None, seed: int = 0) -> None:
+                 plan: Optional[List[str]] = None, seed: int = 0,
+                 slow_ms: float = 2000.0) -> None:
         import numpy as np
 
         self.target_url = target_url.rstrip("/")
         self.error_rate = error_rate
         self.disconnect_rate = disconnect_rate
         self.latency_ms = latency_ms
+        self.slow_ms = slow_ms
         self.plan = list(plan) if plan else None
         self._plan_i = 0
+        # timed flap: (down_s, up_s, mode, t0) — None = no flapping
+        self._flap: Optional[tuple] = None
         self._rng = np.random.default_rng(seed)
         self._lock = threading.Lock()
         self.stats = {"ok": 0, "error": 0, "disconnect": 0}
@@ -58,8 +72,29 @@ class FaultProxy:
     def url(self) -> str:
         return f"http://127.0.0.1:{self.port}"
 
+    def set_flap(self, down_s: float, up_s: float,
+                 mode: str = "error") -> None:
+        """Timed flapping: ``down_s`` of ``mode`` faults, then ``up_s``
+        healthy, repeating — the intermittent-backend shape that
+        exercises breaker open → half-open probe → reopen cycles.
+        Overrides plan/rates while set; runtime-adjustable."""
+        with self._lock:
+            self._flap = (max(0.0, float(down_s)), max(0.0, float(up_s)),
+                          mode, time.monotonic())
+
+    def clear_flap(self) -> None:
+        with self._lock:
+            self._flap = None
+
     def _next_action(self) -> str:
         with self._lock:
+            if self._flap is not None:
+                down_s, up_s, mode, t0 = self._flap
+                period = down_s + up_s
+                if period <= 0:
+                    return mode
+                phase = (time.monotonic() - t0) % period
+                return mode if phase < down_s else "ok"
             if self.plan:
                 action = self.plan[self._plan_i % len(self.plan)]
                 self._plan_i += 1
@@ -115,6 +150,22 @@ class FaultProxy:
             self._note(action)
             if action == "disconnect":
                 return  # close-after-read: the at-most-once hard case
+            if action == "reset":
+                # hard RST, not a polite FIN: SO_LINGER 0 makes close()
+                # abort the connection — "connection reset by peer" on
+                # the client, the mid-exchange network-failure shape
+                try:
+                    conn.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                    struct.pack("ii", 1, 0))
+                except OSError:
+                    pass
+                return
+            if action == "slow":
+                # hung backend: hold the request long enough to trip a
+                # client read timeout, then forward normally (the
+                # response may arrive after the client gave up)
+                time.sleep(self.slow_ms / 1e3)
+                action = "ok"
             if action == "error":
                 payload = json.dumps({"error": {
                     "message": "injected backend failure",

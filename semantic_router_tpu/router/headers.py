@@ -40,6 +40,14 @@ PRIORITY = "x-vsr-priority"
 # (EncodingCache, fused-bank memos) this prompt maps to on the
 # consistent-hash ring — affinity-aware LBs key off this echo
 AFFINITY = "x-vsr-affinity-replica"
+# upstream resilience plane (resilience/upstream.py): ranked next-best
+# candidate models exported toward the data plane so an Envoy retry
+# policy (deploy/envoy/retry-policy.yaml) can fail over the way the
+# reverse-proxy path does; x-vsr-deadline carries the request's
+# remaining end-to-end budget in seconds (or an absolute epoch
+# deadline) and derives per-attempt forward timeouts
+FALLBACK_MODELS = "x-vsr-fallback-models"
+DEADLINE = "x-vsr-deadline"
 
 
 def decision_headers(decision_name: str, model: str, category: str = "",

@@ -87,21 +87,27 @@ class UpstreamPool:
     # -- internals ------------------------------------------------------
 
     def _borrow(self, scheme: str, host: str, port: int,
-                timeout: float):
-        key = (scheme, host, port)
-        with self._lock:
-            stack = self._idle.get(key)
-            while stack:
-                conn = stack.pop()
-                if not _stale(conn.sock):
-                    conn.timeout = timeout
-                    if conn.sock is not None:
-                        conn.sock.settimeout(timeout)
-                    return conn, True
-                try:
-                    conn.close()
-                except OSError:
-                    pass
+                timeout: float, fresh: bool = False):
+        """``fresh=True`` bypasses the idle stack entirely — the retry
+        path uses it so a request that just died on one stale pooled
+        socket can't be handed ANOTHER stale pooled socket (the _stale
+        probe only sees an EOF that has already arrived; a server
+        closing idle connections as it receives bytes defeats it)."""
+        if not fresh:
+            key = (scheme, host, port)
+            with self._lock:
+                stack = self._idle.get(key)
+                while stack:
+                    conn = stack.pop()
+                    if not _stale(conn.sock):
+                        conn.timeout = timeout
+                        if conn.sock is not None:
+                            conn.sock.settimeout(timeout)
+                        return conn, True
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
         cls = _ConnS if scheme == "https" else _Conn
         return cls(host, port, timeout=timeout), False
 
@@ -136,12 +142,19 @@ class UpstreamPool:
             path += "?" + parts.query
         last_exc: Optional[Exception] = None
         for attempt in (0, 1):
-            conn, reused = self._borrow(scheme, host, port, timeout)
+            # the retry always runs on a FRESH connection: the failure
+            # that got us here was very likely a stale keep-alive
+            # socket, and the rest of the idle stack aged exactly the
+            # same way
+            conn, reused = self._borrow(scheme, host, port, timeout,
+                                        fresh=attempt > 0)
             sent = False
+            got_response = False
             try:
                 conn.request(method, path, body=body, headers=headers)
                 sent = True
                 resp = conn.getresponse()
+                got_response = True
                 data = resp.read()
                 keep = (resp.version >= 11 and
                         resp.headers.get("connection", "").lower()
@@ -157,16 +170,29 @@ class UpstreamPool:
                 except OSError:
                     pass
                 last_exc = exc
-                if sent and not (reused and attempt == 0 and
-                                 isinstance(exc,
-                                            http.client
-                                            .RemoteDisconnected)):
+                # the keep-alive close race, REUSED connections only: a
+                # server tearing down an idle connection as our bytes
+                # arrive surfaces as a clean RemoteDisconnected (FIN
+                # before any response byte) or — when our request bytes
+                # were still pending in its buffer at close — a hard
+                # ECONNRESET/EPIPE.  Either way the socket was dead
+                # before this request: retry once on a FRESH connection
+                # instead of surfacing a spurious backend failure.
+                # ``not got_response`` keeps this narrow: once a status
+                # line was parsed the server provably processed the
+                # request, and a reset mid-body must surface, never
+                # replay.  (A crash-after-execute that RSTs before any
+                # response byte is indistinguishable from the idle
+                # close — the same call Go's http.Transport makes for
+                # reused connections with nothing received.)
+                stale_reuse_race = (
+                    reused and attempt == 0 and not got_response
+                    and isinstance(exc, (http.client.RemoteDisconnected,
+                                         ConnectionResetError,
+                                         BrokenPipeError)))
+                if sent and not stale_reuse_race:
                     # response-phase failure: the server may have
-                    # executed the request — never retry. Exception:
-                    # RemoteDisconnected on a REUSED connection means
-                    # the server closed it idle before reading anything
-                    # (the inherent keep-alive close race) — known
-                    # unprocessed, safe to retry once fresh.
+                    # executed the request — never retry.
                     # Callers doing endpoint failover need the same
                     # at-most-once distinction, so it rides the exception.
                     exc.request_delivered = True  # type: ignore[attr-defined]

@@ -298,6 +298,12 @@ _META: Dict[tuple, Dict[str, Any]] = {
         "summary": "Shared-state-plane snapshot: replica membership, "
                    "consistent-hash ring distribution, backend health, "
                    "aggregated fleet pressure."},
+    ("GET", "/debug/upstreams"): {
+        "tag": "debug",
+        "summary": "Upstream resilience plane snapshot: per-(model, "
+                   "endpoint) circuit-breaker state, EWMA error rate "
+                   "and latency, retry-budget fill, and fleet-shared "
+                   "open circuits."},
     ("GET", "/metrics/external"): {
         "tag": "system", "open": True,
         "summary": "ExternalMetricValueList-shaped scaling signals "

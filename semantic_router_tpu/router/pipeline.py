@@ -71,6 +71,10 @@ class RouteResult:
     # request's routing audit record landed in the explain ring; echoed
     # to clients via the x-vsr-decision-record header
     decision_record_id: str = ""
+    # upstream resilience plane (resilience/upstream.py): ranked
+    # next-best candidate models for budgeted failover — filled only
+    # when the plane is attached, also exported as x-vsr-fallback-models
+    fallback_models: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -306,6 +310,10 @@ class Router:
         # attached by bootstrap when flywheel.enabled; None = zero
         # flywheel work anywhere on the hot path
         self.flywheel = None
+        # upstream resilience plane (resilience.upstream.UpstreamHealth):
+        # attached by bootstrap when resilience.upstream.enabled; None =
+        # no health mask, no fallback export — byte-identical routing
+        self.upstream_health = None
 
     def skip_requested(self, headers: Dict[str, str]) -> bool:
         """True when the (operator-enabled) skip-processing header is on
@@ -754,6 +762,16 @@ class Router:
         self._apply_mutation_plugins(decision, ref, ctx, result)
         self._finalize_body(result, ctx, ref)
 
+        if self.upstream_health is not None:
+            # ranked next-best candidates for budgeted failover: the
+            # reverse-proxy path re-routes through them on upstream
+            # failure; the extproc path exports them so an Envoy retry
+            # policy can do the same (deploy/envoy/retry-policy.yaml)
+            alts = self._ranked_alternates(decision, ref, ctx, signals)
+            if alts:
+                result.fallback_models = alts
+                result.headers[H.FALLBACK_MODELS] = ",".join(alts)
+
         category = next((n for n in signals.matches.get("domain", ())), "")
         result.headers.update(H.decision_headers(
             decision.name, ref.model, category=category,
@@ -834,6 +852,23 @@ class Router:
 
     # -- plugin stages -----------------------------------------------------
 
+    def _selection_ctx(self, decision: Decision, ctx: RequestContext,
+                       signals: SignalMatches,
+                       embed_fn=None) -> SelectionContext:
+        """The ONE SelectionContext construction — selection, the
+        decision-record breakdown, and upstream fallback ranking must
+        never drift on what a selector gets to see."""
+        return SelectionContext(
+            query=ctx.user_text,
+            decision_name=decision.name,
+            category=next(iter(signals.matches.get("domain", ())), ""),
+            session_id=ctx.headers.get("x-session-id", ""),
+            user_id=ctx.user_id,
+            signals=signals,
+            token_count=ctx.approx_token_count(),
+            model_cards=self.model_cards,
+            embed_fn=embed_fn)
+
     def _capture_selection(self, rec, decision: Decision, ref: ModelRef,
                            reason: str, ctx: RequestContext,
                            signals: SignalMatches) -> None:
@@ -858,21 +893,45 @@ class Router:
                 selector = self._selectors.get(decision.name)
                 fn = getattr(selector, "score_breakdown", None)
                 if fn is not None:
-                    sctx = SelectionContext(
-                        query=ctx.user_text,
-                        decision_name=decision.name,
-                        category=next(iter(
-                            signals.matches.get("domain", ())), ""),
-                        session_id=ctx.headers.get("x-session-id", ""),
-                        user_id=ctx.user_id,
-                        signals=signals,
-                        token_count=ctx.approx_token_count(),
-                        model_cards=self.model_cards,
-                        embed_fn=None)
-                    breakdown = fn(refs, sctx)
+                    breakdown = fn(refs, self._selection_ctx(
+                        decision, ctx, signals))
             rec.capture_selection(algo_type, reason, ref.model, breakdown)
         except Exception:
             rec.capture_selection("", reason, ref.model, [])
+
+    def _ranked_alternates(self, decision: Decision, chosen: ModelRef,
+                           ctx: RequestContext,
+                           signals: SignalMatches) -> List[str]:
+        """Next-best candidate models after ``chosen``, best first:
+        selector score (score_breakdown when the selector exposes it,
+        configured weight otherwise) re-ranked by upstream health score
+        and filtered of open circuits.  Read-only and embed-free — this
+        must never add device work; fail-open to no alternates."""
+        try:
+            refs = [r for r in (decision.model_refs or [])
+                    if r.model != chosen.model]
+            if not refs:
+                return []
+            scores: Dict[str, float] = {}
+            selector = self._selectors.get(decision.name)
+            fn = getattr(selector, "score_breakdown", None)
+            if fn is not None:
+                try:
+                    for row in fn(decision.model_refs,
+                                  self._selection_ctx(decision, ctx,
+                                                      signals)):
+                        scores[str(row.get("model", ""))] = \
+                            float(row.get("score", 0.0))
+                except Exception:
+                    scores = {}
+            up = self.upstream_health
+            ranked = sorted(
+                refs, key=lambda r: -(scores.get(r.model, r.weight)
+                                      * up.health_score(r.model)))
+            return [r.model for r in ranked
+                    if not up.model_open(r.model)][:3]
+        except Exception:
+            return []
 
     def _apply_policy_plugins(self, decision: Decision,
                               signals: SignalMatches, ctx: RequestContext,
@@ -949,12 +1008,34 @@ class Router:
                                                      model=hit.model or "cache"),
             headers={H.CACHE_HIT: "true", H.DECISION: decision.name})
 
+    def _upstream_mask(self, refs: List[ModelRef]) -> tuple:
+        """Drop candidates whose every endpoint circuit is open
+        (resilience/upstream.py) — an unhealthy model is never chosen
+        while alternatives exist.  Fail-open twice over: masking never
+        empties the candidate set, and plane errors never mask at
+        all."""
+        if self.upstream_health is None or len(refs) <= 1:
+            return refs, ()
+        try:
+            masked = tuple(sorted({r.model for r in refs
+                                   if self.upstream_health.model_open(
+                                       r.model)}))
+            if masked and len(masked) < len(refs):
+                return [r for r in refs
+                        if r.model not in masked], masked
+        except Exception:
+            pass
+        return refs, ()
+
     def _select_model(self, decision: Decision, ctx: RequestContext,
                       signals: SignalMatches) -> tuple[ModelRef, str]:
         refs = decision.model_refs or [
             ModelRef(model=self.cfg.default_model or ctx.model)]
+        refs, masked = self._upstream_mask(refs)
         if len(refs) == 1:
-            return refs[0], "single candidate"
+            return refs[0], ("single candidate" if not masked else
+                             "single healthy candidate (upstream mask: "
+                             + ",".join(masked) + ")")
         algo = dict(decision.algorithm or {})
         algo_type = str(algo.get("type", "static"))
         if algo_type in LOOPER_ALGORITHMS:
@@ -993,22 +1074,15 @@ class Router:
             eng = self.engine
             task = self.embedding_task
             embed_fn = lambda text: eng.embed(task, [text])[0]
-        sctx = SelectionContext(
-            query=ctx.user_text,
-            decision_name=decision.name,
-            category=next(iter(signals.matches.get("domain", ())), ""),
-            session_id=ctx.headers.get("x-session-id", ""),
-            user_id=ctx.user_id,
-            signals=signals,
-            token_count=ctx.approx_token_count(),
-            model_cards=self.model_cards,
-            embed_fn=embed_fn,
-        )
+        sctx = self._selection_ctx(decision, ctx, signals,
+                                   embed_fn=embed_fn)
+        mask_note = (" (upstream mask: " + ",".join(masked) + ")") \
+            if masked else ""
         try:
             res = selector.select(refs, sctx)
-            return res.ref, res.reason
+            return res.ref, res.reason + mask_note
         except Exception:
-            return refs[0], "selector error → first candidate"
+            return refs[0], "selector error → first candidate" + mask_note
 
     def _apply_mutation_plugins(self, decision: Decision, ref: ModelRef,
                                 ctx: RequestContext,

@@ -89,6 +89,7 @@ API_CATALOG = {
         {"path": "/debug/slo", "method": "GET"},
         {"path": "/debug/runtime", "method": "GET"},
         {"path": "/debug/resilience", "method": "GET"},
+        {"path": "/debug/upstreams", "method": "GET"},
         {"path": "/debug/stateplane", "method": "GET"},
         {"path": "/metrics/external", "method": "GET"},
         {"path": "/debug/decisions", "method": "GET"},
@@ -548,6 +549,255 @@ class RouterServer:
             return status, {"error": {
                 "message": raw[:300].decode(errors="replace")}}
 
+    @property
+    def upstreams(self):
+        """The registry-slotted upstream resilience plane
+        (resilience/upstream.py); None = the disabled default posture,
+        which keeps the legacy forward path byte-identical."""
+        return self.registry.get("upstreams")
+
+    def _pick_stream_backend(self, model: str) -> str:
+        """Streaming pins ONE endpoint (no mid-stream failover) — but
+        with the upstream plane attached the pin skips open circuits,
+        so a stream never starts against a backend known to be dead."""
+        candidates = self.resolver.resolve_candidates(model)
+        up = self.upstreams
+        if up is not None:
+            for url in candidates:
+                if up.allow(model, url):
+                    return url
+        return candidates[0] if candidates else ""
+
+    def _note_stream_outcome(self, model: str, endpoint: str, ok: bool,
+                             latency_s: float, kind: str = "") -> None:
+        """Feed a streaming forward's outcome to the health scorer
+        (streams bypass _forward_resilient)."""
+        up = self.upstreams
+        if up is not None:
+            up.record(model, endpoint, ok, latency_s,
+                      kind=kind or ("ok" if ok else "connect"))
+
+    def _attempt_forward(self, model: str, endpoint: str,
+                         body: Dict[str, Any], hdrs_src: Dict[str, str],
+                         timeout_s: float, remaining_s: float,
+                         deadline_header: str):
+        """One upstream attempt under the resilience plane.  Returns
+        (status, resp, kind, latency_s, errmsg) — ``kind`` classifies
+        the outcome for the health scorer and the retry policy:
+        ok | 5xx | timeout | connect | reset."""
+        import http.client as _hc
+        import socket as _socket
+
+        data, hdrs = self._prep_forward(body, hdrs_src)
+        # deadline propagation: the backend sees the budget that is
+        # actually left, not the router's flat timeout
+        hdrs[deadline_header] = f"{max(0.0, remaining_s):.3f}"
+        t0 = time.perf_counter()
+        try:
+            status, _, raw = self.upstream_pool.request(
+                "POST", endpoint + "/v1/chat/completions", data, hdrs,
+                timeout_s)
+        except (_hc.HTTPException, TimeoutError, OSError) as e:
+            latency = time.perf_counter() - t0
+            # undelivered first: a connect/send-phase failure — even a
+            # connect TIMEOUT — is provably unprocessed, and must stay
+            # retryable under the at-most-once retry.on: [connect]
+            # posture (docs/OPERATIONS.md)
+            if not getattr(e, "request_delivered", True):
+                kind = "connect"
+            elif isinstance(e, (_socket.timeout, TimeoutError)):
+                kind = "timeout"
+            else:
+                kind = "reset"
+            return 502, None, kind, latency, f"{type(e).__name__}: {e}"
+        latency = time.perf_counter() - t0
+        status, resp = self._parse_upstream(status, raw)
+        return status, resp, ("5xx" if status >= 500 else "ok"), \
+            latency, ""
+
+    def _forward_resilient(self, route, fwd_headers: Dict[str, str],
+                           req_headers: Dict[str, str]):
+        """Budgeted failover forward (resilience/upstream.py): the
+        candidate ladder is (primary model's endpoints, then the ranked
+        fallback models' endpoints), each gated by its circuit breaker;
+        an end-to-end deadline derives per-attempt timeouts; every
+        attempt past the first needs a token from the retry budget and
+        is refused outright at degradation >= L2, so retry storms can
+        never amplify overload.  With the plane disabled (the default)
+        this delegates to the legacy endpoint-failover path —
+        byte-identical behavior.
+
+        Returns (status, resp, endpoint, failover_path)."""
+        up = self.upstreams
+        if up is None:
+            status, resp, endpoint = self._forward_failover(
+                route.model, route.body, fwd_headers)
+            return status, resp, endpoint, []
+
+        from ..resilience.upstream import attempt_timeout, parse_deadline
+
+        dl_cfg = up.cfg["deadline"]
+        budget = parse_deadline(
+            req_headers,
+            float(dl_cfg["default_s"]) or self.forward_timeout_s,
+            header=str(dl_cfg["header"]))
+        deadline_t = time.monotonic() + budget
+        floor_s = float(dl_cfg["floor_s"])
+        deadline_header = str(dl_cfg["header"])
+
+        candidates: list = []
+        for model in [route.model] + list(
+                getattr(route, "fallback_models", ()) or ()):
+            if any(m == model for m, _ in candidates):
+                continue
+            for url in self.resolver.resolve_candidates(model):
+                if url:
+                    candidates.append((model, url))
+        if not candidates:
+            return 502, {"error": {
+                "message": f"no backend for model {route.model!r}",
+                "type": "backend_error"}}, "", []
+
+        max_attempts = min(up.max_attempts(), len(candidates))
+        path: list = []
+        last = (502, {"error": {
+            "message": "all upstream candidates unavailable",
+            "type": "backend_error"}}, "")
+        attempts = 0
+        for model, endpoint in candidates:
+            if attempts >= max_attempts:
+                break
+            remaining = deadline_t - time.monotonic()
+            if remaining <= 0.01:
+                path.append({"model": model, "endpoint": endpoint,
+                             "outcome": "deadline_exhausted",
+                             "status": 0})
+                break
+            if not up.allow(model, endpoint):
+                path.append({"model": model, "endpoint": endpoint,
+                             "outcome": "skipped_open", "status": 0})
+                continue
+            if attempts > 0:
+                granted, why = up.try_retry()
+                if not granted:
+                    path.append({"model": model, "endpoint": endpoint,
+                                 "outcome": f"retry_denied:{why}",
+                                 "status": 0})
+                    break
+                time.sleep(min(up.backoff_s(attempts),
+                               max(0.0, deadline_t - time.monotonic())))
+                remaining = deadline_t - time.monotonic()
+                if remaining <= 0.01:
+                    # deadline died during the backoff: a ~1ms doomed
+                    # attempt would charge a health failure against a
+                    # possibly-healthy endpoint — stop instead
+                    path.append({"model": model, "endpoint": endpoint,
+                                 "outcome": "deadline_exhausted",
+                                 "status": 0})
+                    break
+            body = route.body
+            hdrs_src = fwd_headers
+            if model != route.model:
+                # a fallback model forwards AS that model, with THAT
+                # model's upstream credentials
+                body = dict(route.body)
+                body["model"] = model
+                try:
+                    hdrs_src = dict(fwd_headers)
+                    hdrs_src.update(self._credentials_for_model(
+                        model, req_headers))
+                except PermissionError:
+                    path.append({"model": model, "endpoint": endpoint,
+                                 "outcome": "authz_denied", "status": 0})
+                    continue
+            timeout_s = attempt_timeout(
+                remaining, max_attempts - attempts, floor_s,
+                self.forward_timeout_s)
+            status, resp, kind, latency, err = self._attempt_forward(
+                model, endpoint, body, hdrs_src, timeout_s, remaining,
+                deadline_header)
+            attempts += 1
+            up.record(model, endpoint, kind == "ok", latency, kind=kind)
+            path.append({"model": model, "endpoint": endpoint,
+                         "outcome": kind, "status": int(status),
+                         "latency_ms": round(latency * 1e3, 2)})
+            if kind == "ok":
+                if attempts > 1 or model != route.model:
+                    up.failovers.inc(model=model)
+                    self.router.M.backend_failovers.inc(model=model)
+                return status, resp, endpoint, path
+            if resp is None:
+                resp = {"error": {
+                    "message": f"backend unreachable: {err}",
+                    "type": "backend_error"}}
+            last = (status, resp, endpoint)
+            if not up.retry_on(kind):
+                break
+        # every candidate failed, was circuit-blocked, or the budget/
+        # deadline ran out: if nothing was even attempted (all circuits
+        # open) and budget REMAINS, force ONE attempt at the head
+        # candidate — serving a probably-dead backend beats serving
+        # nothing.  Same per-model credential/body discipline as the
+        # main loop: a fallback model forwards AS itself with ITS
+        # credentials, and an authz denial stays fail-closed.
+        if attempts == 0 and candidates \
+                and deadline_t - time.monotonic() > 0.01:
+            model, endpoint = candidates[0]
+            body = route.body
+            hdrs_src = fwd_headers
+            if model != route.model:
+                body = dict(route.body)
+                body["model"] = model
+                try:
+                    hdrs_src = dict(fwd_headers)
+                    hdrs_src.update(self._credentials_for_model(
+                        model, req_headers))
+                except PermissionError as exc:
+                    path.append({"model": model, "endpoint": endpoint,
+                                 "outcome": "authz_denied",
+                                 "status": 0})
+                    return 403, {"error": {"message": str(exc),
+                                           "type": "authz_error"}}, \
+                        "", path
+            remaining = max(0.05, deadline_t - time.monotonic())
+            timeout_s = attempt_timeout(remaining, 1, floor_s,
+                                        self.forward_timeout_s)
+            status, resp, kind, latency, err = self._attempt_forward(
+                model, endpoint, body, hdrs_src, timeout_s, remaining,
+                deadline_header)
+            up.record(model, endpoint, kind == "ok", latency, kind=kind)
+            path.append({"model": model, "endpoint": endpoint,
+                         "outcome": f"forced:{kind}",
+                         "status": int(status),
+                         "latency_ms": round(latency * 1e3, 2)})
+            if resp is None:
+                resp = {"error": {
+                    "message": f"backend unreachable: {err}",
+                    "type": "backend_error"}}
+            return status, resp, endpoint, path
+        return (*last, path)
+
+    def _annotate_failover(self, route, path: list) -> Dict[str, str]:
+        """After-the-fact visibility for a failover: stamp the decision
+        record's ``failover_path`` and return the extra response
+        headers.  No-op (and no record write) for the clean
+        single-attempt case."""
+        if not path or (len(path) == 1 and path[0].get("outcome")
+                        == "ok"):
+            return {}
+        extra: Dict[str, str] = {}
+        final = path[-1]
+        if final.get("outcome") in ("ok", "forced:ok") \
+                and final.get("model") and final["model"] != route.model:
+            extra["x-vsr-failover-model"] = final["model"]
+        if getattr(route, "decision_record_id", ""):
+            try:
+                self.explainer().annotate(route.decision_record_id,
+                                          failover_path=path)
+            except Exception:
+                pass
+        return extra
+
     def _forward_failover(self, model: str, body: Dict[str, Any],
                           headers: Dict[str, str]):
         """Forward with endpoint failover: try each candidate in the
@@ -942,6 +1192,18 @@ class RouterServer:
                                                   "controller"})
                     else:
                         self._json(200, res.report())
+                elif path == "/debug/upstreams":
+                    # upstream resilience plane snapshot: per-(model,
+                    # endpoint) breaker state + EWMA health, retry
+                    # budget fill, fleet-shared open circuits
+                    up = server.registry.get("upstreams")
+                    if up is None:
+                        self._json(503, {"error": "no upstream "
+                                                  "resilience plane "
+                                                  "(resilience.upstream"
+                                                  ".enabled is false)"})
+                    else:
+                        self._json(200, up.report())
                 elif path == "/debug/stateplane":
                     # shared-state-plane snapshot: membership, ring
                     # distribution, backend health, fleet pressure
@@ -1980,9 +2242,10 @@ class RouterServer:
 
                 if route.body.get("stream"):
                     # streaming pins one endpoint (no mid-stream
-                    # failover); non-stream resolution lives inside
-                    # _forward_failover
-                    backend = server.resolver.resolve(route.model)
+                    # failover) — health-masked when the upstream plane
+                    # is attached; non-stream resolution lives inside
+                    # _forward_resilient
+                    backend = server._pick_stream_backend(route.model)
                     if not backend:
                         self._json(502, {"error": {
                             "message":
@@ -2004,17 +2267,21 @@ class RouterServer:
                 t0 = time.perf_counter()
                 tok = default_tracker.begin(route.model)
                 try:
-                    status, resp, _ = server._forward_failover(
-                        route.model, route.body, fwd_headers)
+                    status, resp, _, failover_path = \
+                        server._forward_resilient(route, fwd_headers,
+                                                  headers)
                 finally:
                     default_tracker.end(route.model, tok)
                 latency_ms = (time.perf_counter() - t0) * 1e3
+                failover_headers = server._annotate_failover(
+                    route, failover_path)
                 if status == 200:
                     processed = server.router.process_response(route, resp)
                     server.router.record_feedback(route, success=True,
                                                   latency_ms=latency_ms)
                     self._record_session(route, resp, headers)
                     out_headers = dict(route.headers)
+                    out_headers.update(failover_headers)
                     out_headers.update(processed.headers)
                     payload = processed.body
                     if anthropic:
@@ -2168,9 +2435,10 @@ class RouterServer:
                                route.headers)
                     return
                 if body.get("stream"):
-                    # streaming pins one endpoint; non-stream resolution
-                    # lives inside _forward_failover
-                    backend = server.resolver.resolve(route.model)
+                    # streaming pins one endpoint (health-masked);
+                    # non-stream resolution lives inside
+                    # _forward_resilient
+                    backend = server._pick_stream_backend(route.model)
                     if not backend:
                         self._json(502, {"error": {
                             "message":
@@ -2180,9 +2448,11 @@ class RouterServer:
                     self._stream_responses(route, backend, fwd, body)
                     return
                 t0 = time.perf_counter()
-                status, resp, _ = server._forward_failover(
-                    route.model, route.body, fwd)
+                status, resp, _, failover_path = \
+                    server._forward_resilient(route, fwd, headers)
                 latency_ms = (time.perf_counter() - t0) * 1e3
+                failover_headers = server._annotate_failover(
+                    route, failover_path)
                 if status == 200:
                     processed = server.router.process_response(route, resp)
                     server.router.record_feedback(route, success=True,
@@ -2191,6 +2461,7 @@ class RouterServer:
                                            chat_request=route.body,
                                            store=server.response_store)
                     out_headers = dict(route.headers)
+                    out_headers.update(failover_headers)
                     out_headers.update(processed.headers)
                     self._json(200, out, out_headers)
                 else:
@@ -2297,16 +2568,25 @@ class RouterServer:
                     server.router.record_feedback(
                         route, success=False,
                         latency_ms=(time.perf_counter() - t0) * 1e3)
+                    server._note_stream_outcome(
+                        route.model, backend, e.code < 500,
+                        time.perf_counter() - t0,
+                        kind="5xx" if e.code >= 500 else "ok")
                     self._json(e.code, payload, route.headers)
                     return
                 except Exception as exc:
                     server.router.record_feedback(
                         route, success=False,
                         latency_ms=(time.perf_counter() - t0) * 1e3)
+                    server._note_stream_outcome(
+                        route.model, backend, False,
+                        time.perf_counter() - t0)
                     self._json(502, {"error": {
                         "message": f"backend unreachable: {exc}",
                         "type": "backend_error"}}, route.headers)
                     return
+                server._note_stream_outcome(route.model, backend, True,
+                                            time.perf_counter() - t0)
 
                 self._sse_headers(route.headers)
 
@@ -2431,16 +2711,25 @@ class RouterServer:
                     server.router.record_feedback(
                         route, success=False,
                         latency_ms=(time.perf_counter() - t0) * 1e3)
+                    server._note_stream_outcome(
+                        route.model, backend, e.code < 500,
+                        time.perf_counter() - t0,
+                        kind="5xx" if e.code >= 500 else "ok")
                     self._json(e.code, payload, route.headers)
                     return
                 except Exception as exc:
                     server.router.record_feedback(
                         route, success=False,
                         latency_ms=(time.perf_counter() - t0) * 1e3)
+                    server._note_stream_outcome(
+                        route.model, backend, False,
+                        time.perf_counter() - t0)
                     self._json(502, {"error": {
                         "message": f"backend unreachable: {exc}",
                         "type": "backend_error"}}, route.headers)
                     return
+                server._note_stream_outcome(route.model, backend, True,
+                                            time.perf_counter() - t0)
 
                 self._sse_headers(route.headers)
 

@@ -352,6 +352,11 @@ def build_router(cfg: RouterConfig, engine=None,
                     if registry is not None else None,
                     resilience=registry.get("resilience")
                     if registry is not None else None)
+    # upstream resilience plane (resilience/upstream.py): carried like
+    # every registry-slotted service; apply_upstream_knobs owns
+    # attach/detach, this just re-binds an existing plane on rebuilds
+    if registry is not None and registry.get("upstreams") is not None:
+        router.upstream_health = registry.get("upstreams")
     from ..memory import InMemoryMemoryStore
     from ..vectorstore import VectorStoreManager
 
@@ -714,6 +719,47 @@ def apply_observability_knobs(cfg: RouterConfig, registry) -> None:
                         error=str(exc)[:200], level="warning")
 
 
+def apply_upstream_knobs(cfg: RouterConfig, registry, router) -> None:
+    """Attach/configure/detach the upstream resilience plane
+    (resilience/upstream.py) for a registry + router pair.  Called at
+    boot and on config hot reload; ``resilience.upstream.enabled:
+    false`` (the default) constructs NOTHING and detaches any previous
+    plane — byte-identical routing posture.  Like every knob block,
+    malformed upstream config must never stop the server."""
+    try:
+        up_cfg = cfg.upstream_config()
+        if not up_cfg["enabled"]:
+            old = registry.get("upstreams")
+            if old is not None:
+                registry.swap(upstreams=None)
+                component_event("bootstrap", "upstreams_detached")
+            if router is not None:
+                router.upstream_health = None
+            return
+        from ..resilience.upstream import UpstreamHealth
+
+        up = registry.get("upstreams")
+        if up is None:
+            up = UpstreamHealth(registry.metrics)
+            registry.swap(upstreams=up)
+            component_event("bootstrap", "upstreams_attached")
+        up.bind(events=registry.get("events"),
+                plane=registry.get("stateplane"),
+                resilience=registry.get("resilience"))
+        if not up_cfg["fleet_share"]:
+            # bind() only ever attaches; a reload that turned
+            # fleet_share off must actually detach the plane or open
+            # circuits keep publishing
+            up.plane = None
+        up.configure(up_cfg)
+        if router is not None:
+            router.upstream_health = up
+    except Exception as exc:
+        component_event("bootstrap", "upstream_config_invalid",
+                        error=f"{type(exc).__name__}: {exc}"[:200],
+                        level="warning")
+
+
 def apply_flywheel_knobs(cfg: RouterConfig, registry, router) -> None:
     """Attach/configure/detach the learned-routing flywheel
     (flywheel/controller.py) for a registry + router pair.  Called at
@@ -838,6 +884,9 @@ def serve(config_path: str, port: int = 8801,
     # learned-routing flywheel: attached after the observability stack
     # so it can bind the explainer / event bus / cost model it feeds on
     apply_flywheel_knobs(cfg, server.registry, router)
+    # upstream resilience plane: after the degradation controller and
+    # state plane exist, so the retry gate and fleet share bind live
+    apply_upstream_knobs(cfg, server.registry, router)
 
     # startKubernetesControllerIfNeeded (cmd/main.go:50): live CRD watch
     # regenerating the config file the ConfigWatcher below hot-swaps
@@ -880,6 +929,7 @@ def serve(config_path: str, port: int = 8801,
             server.cfg = new_cfg
             apply_observability_knobs(new_cfg, server.registry)
             apply_flywheel_knobs(new_cfg, server.registry, new_router)
+            apply_upstream_knobs(new_cfg, server.registry, new_router)
             # grace period before tearing down the old dispatcher so
             # requests already inside old.route() finish their fan-out
             threading.Timer(30.0, old.dispatcher.shutdown).start()

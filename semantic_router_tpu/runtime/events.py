@@ -43,6 +43,12 @@ DEGRADATION_LEVEL_CHANGED = "degradation_level_changed"
 # shadow/canary/promote/rollback moves ride the same feed, so a canary
 # rollback is as visible as the SLO burn that triggered it
 FLYWHEEL_STATE_CHANGED = "flywheel_state_changed"
+# upstream circuit-breaker transitions (resilience/upstream.py): a
+# backend endpoint tripping open (or recovering via its half-open
+# probe) rides the same feed as the shed-ladder moves, so operators see
+# BACKEND failure and SELF overload in one place
+UPSTREAM_UNHEALTHY = "upstream_unhealthy"
+UPSTREAM_RECOVERED = "upstream_recovered"
 
 
 @dataclass

@@ -33,7 +33,12 @@ _SLOTS = ("metrics", "tracer", "sessions", "profiler", "events",
           # learned routing flywheel (flywheel.FlywheelController):
           # empty unless flywheel.enabled — built by bootstrap, so the
           # disabled posture constructs nothing
-          "flywheel")
+          "flywheel",
+          # upstream resilience plane (resilience.upstream
+          # UpstreamHealth): empty unless resilience.upstream.enabled —
+          # built by bootstrap, so the disabled posture constructs
+          # nothing and routing stays byte-identical
+          "upstreams")
 
 
 class RuntimeRegistry:

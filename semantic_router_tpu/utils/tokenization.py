@@ -107,6 +107,10 @@ class HFTokenizer:
         from tokenizers import Tokenizer as _Tok
 
         self.tok = _Tok.from_file(path)
+        # source path = the tokenizer's content identity for trunk-group
+        # fingerprinting (engine.classify._tokenizer_fingerprint): two
+        # loads of the same tokenizer.json must not split a fused group
+        self.path = path
         self._vocab_size = self.tok.get_vocab_size()
 
     @classmethod

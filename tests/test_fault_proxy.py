@@ -145,3 +145,79 @@ class TestProxyFaultModes:
             server.stop()
             router.shutdown()
             proxy.stop()
+
+
+class TestNewFaultModes:
+    """slow / reset / timed-flap plans (ISSUE 9 satellite): chaos tests
+    can script partial and intermittent failure, not just clean 5xx."""
+
+    def test_slow_plan_delays_then_serves(self, backend):
+        import time
+        import urllib.request as _ur
+
+        proxy = FaultProxy(backend.url, plan=["slow"],
+                           slow_ms=300).start()
+        try:
+            req = _ur.Request(
+                proxy.url + "/v1/chat/completions",
+                data=json.dumps({"model": "m", "messages": [
+                    {"role": "user", "content": "hi"}]}).encode(),
+                headers={"content-type": "application/json"})
+            t0 = time.perf_counter()
+            with _ur.urlopen(req, timeout=10) as resp:
+                assert resp.status == 200
+            assert time.perf_counter() - t0 >= 0.3
+            assert proxy.stats["slow"] == 1
+        finally:
+            proxy.stop()
+
+    def test_slow_plan_trips_a_short_client_timeout(self, backend):
+        import urllib.request as _ur
+
+        proxy = FaultProxy(backend.url, plan=["slow"],
+                           slow_ms=2000).start()
+        try:
+            req = _ur.Request(
+                proxy.url + "/v1/chat/completions",
+                data=b"{}",
+                headers={"content-type": "application/json"})
+            with pytest.raises(Exception):
+                _ur.urlopen(req, timeout=0.3).read()
+        finally:
+            proxy.stop()
+
+    def test_reset_plan_hard_resets_the_connection(self, backend):
+        import socket
+
+        proxy = FaultProxy(backend.url, plan=["reset"]).start()
+        try:
+            s = socket.create_connection(("127.0.0.1", proxy.port),
+                                         timeout=5)
+            s.sendall(b"POST /v1/chat/completions HTTP/1.1\r\n"
+                      b"host: x\r\ncontent-length: 2\r\n\r\n{}")
+            # RST (not FIN): recv raises ECONNRESET instead of
+            # returning b"" — the mid-exchange network-failure shape
+            with pytest.raises(ConnectionResetError):
+                if s.recv(1024) == b"":
+                    raise ConnectionResetError  # platform folded to FIN
+            s.close()
+            assert proxy.stats["reset"] == 1
+        finally:
+            proxy.stop()
+
+    def test_timed_flap_alternates_fault_and_health(self, backend):
+        proxy = FaultProxy(backend.url).start()
+        try:
+            proxy.set_flap(0.1, 0.1, mode="error")
+            actions = set()
+            import time as _t
+
+            t0 = _t.monotonic()
+            while _t.monotonic() - t0 < 0.35:
+                actions.add(proxy._next_action())
+                _t.sleep(0.02)
+            assert actions == {"error", "ok"}  # both phases observed
+            proxy.clear_flap()
+            assert proxy._next_action() == "ok"
+        finally:
+            proxy.stop()

@@ -644,3 +644,75 @@ class TestBootstrapWiring:
         assert fw2["enabled"] is True
         assert fw2["evaluator"]["min_rows"] == 20
         assert fw2["promotion"]["canary_fraction"] == 0.1
+
+
+class TestScheduledCycleRunner:
+    """flywheel.cycle_interval_s (ISSUE 9 satellite): run_cycle fires
+    periodically instead of operator-triggered POST only."""
+
+    def test_config_normalizer_parses_interval(self):
+        from semantic_router_tpu.config.schema import RouterConfig
+
+        cfg = RouterConfig.from_dict({"flywheel": {
+            "enabled": True, "cycle_interval_s": 30}}).flywheel_config()
+        assert cfg["cycle_interval_s"] == 30.0
+        assert RouterConfig().flywheel_config()["cycle_interval_s"] == 0.0
+        bad = RouterConfig.from_dict({"flywheel": {
+            "cycle_interval_s": "soon"}}).flywheel_config()
+        assert bad["cycle_interval_s"] == 0.0
+
+    def test_interval_drives_run_cycle(self):
+        import time as _t
+
+        fw = FlywheelController(MetricsRegistry())
+        calls = []
+        fw.run_cycle = lambda *a, **k: calls.append(1)
+        try:
+            fw.configure({"enabled": True, "cycle_interval_s": 0.05})
+            deadline = _t.monotonic() + 3.0
+            while len(calls) < 2 and _t.monotonic() < deadline:
+                _t.sleep(0.02)
+            assert len(calls) >= 2
+            assert fw.stats()["cycle_interval_s"] == 0.05
+        finally:
+            fw.close()
+
+    def test_zero_interval_stops_the_runner(self):
+        import time as _t
+
+        fw = FlywheelController(MetricsRegistry())
+        calls = []
+        fw.run_cycle = lambda *a, **k: calls.append(1)
+        try:
+            fw.configure({"enabled": True, "cycle_interval_s": 0.05})
+            deadline = _t.monotonic() + 3.0
+            while not calls and _t.monotonic() < deadline:
+                _t.sleep(0.02)
+            assert calls
+            fw.configure({"enabled": True, "cycle_interval_s": 0})
+            assert fw._cycle_thread is None
+            n = len(calls)
+            _t.sleep(0.15)
+            assert len(calls) == n  # no further fires after stop
+        finally:
+            fw.close()
+
+    def test_cycle_errors_contained(self):
+        import time as _t
+
+        fw = FlywheelController(MetricsRegistry())
+        calls = []
+
+        def boom(*a, **k):
+            calls.append(1)
+            raise RuntimeError("cycle exploded")
+
+        fw.run_cycle = boom
+        try:
+            fw.configure({"enabled": True, "cycle_interval_s": 0.04})
+            deadline = _t.monotonic() + 3.0
+            while len(calls) < 2 and _t.monotonic() < deadline:
+                _t.sleep(0.02)
+            assert len(calls) >= 2  # the runner survived the error
+        finally:
+            fw.close()

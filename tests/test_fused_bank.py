@@ -475,3 +475,120 @@ class TestWindowedStillTraditional:
                                              stride=16)
         assert res.label in fused_engine.task_labels("intent")
         assert res.truncated is False
+
+
+class TestContentAddressedFingerprint:
+    """Content-addressed trunk fingerprint (ISSUE 9 satellite, carried
+    from PR 1): different checkpoint loads with IDENTICAL frozen trunks
+    fuse into one TrunkGroup — object identity is no longer required —
+    while trunks differing in a single weight stay separate."""
+
+    def _two_task_engine(self, copy_trunk: bool, perturb: bool = False):
+        import flax
+        import jax
+        import jax.numpy as jnp
+
+        from semantic_router_tpu.engine.classify import InferenceEngine
+        from semantic_router_tpu.engine.testing import TINY, tiny_config
+        from semantic_router_tpu.models.modernbert import (
+            ModernBertForSequenceClassification,
+        )
+
+        cfg = InferenceEngineConfig(max_batch_size=8, max_wait_ms=1.0,
+                                    seq_len_buckets=[32, 128])
+        eng = InferenceEngine(cfg, metrics=fresh_series())
+        tok = HashTokenizer(vocab_size=TINY["vocab_size"])
+        key = jax.random.PRNGKey(7)
+        dummy = jnp.ones((1, 8), jnp.int32)
+        trunk = None
+        for i, (name, labels) in enumerate(
+                [("task_a", ["x", "y"]), ("task_b", ["p", "q", "r"])]):
+            module = ModernBertForSequenceClassification(
+                tiny_config(len(labels)))
+            params = flax.core.unfreeze(
+                module.init(jax.random.fold_in(key, i), dummy))
+            if trunk is None:
+                trunk = params["params"]["model"]
+            elif copy_trunk:
+                # DISTINCT arrays with identical bytes — the two-
+                # checkpoint-files-same-frozen-trunk shape
+                copied = jax.tree_util.tree_map(
+                    lambda a: jnp.array(np.array(a)), trunk)
+                if perturb:
+                    leaves, treedef = jax.tree_util.tree_flatten(copied)
+                    leaves[0] = leaves[0].at[(0,) * leaves[0].ndim].add(
+                        1e-3)
+                    copied = jax.tree_util.tree_unflatten(treedef,
+                                                          leaves)
+                params["params"]["model"] = copied
+            engine_trunk = params["params"]["model"]
+            assert copy_trunk is False or i == 0 \
+                or engine_trunk is not trunk  # really distinct objects
+            eng.register_task(name, "sequence", module, params, tok,
+                              labels, max_seq_len=128)
+        return eng
+
+    def test_identical_content_distinct_arrays_fuse(self):
+        eng = self._two_task_engine(copy_trunk=True)
+        try:
+            groups = eng.trunk_group_info()
+            assert len(groups) == 1
+            (members,) = groups.values()
+            assert sorted(members) == ["task_a", "task_b"]
+            # and the fused path still serves correct labels
+            res = eng.classify("task_b", "hello fused world")
+            assert res.label in ("p", "q", "r")
+        finally:
+            eng.shutdown()
+
+    def test_single_weight_difference_splits_groups(self):
+        eng = self._two_task_engine(copy_trunk=True, perturb=True)
+        try:
+            assert len(eng.trunk_group_info()) == 2
+        finally:
+            eng.shutdown()
+
+    def test_equivalent_tokenizer_instances_do_not_split(self):
+        import flax
+        import jax
+        import jax.numpy as jnp
+
+        from semantic_router_tpu.engine.classify import InferenceEngine
+        from semantic_router_tpu.engine.testing import TINY, tiny_config
+        from semantic_router_tpu.models.modernbert import (
+            ModernBertForSequenceClassification,
+        )
+
+        cfg = InferenceEngineConfig(max_batch_size=8, max_wait_ms=1.0,
+                                    seq_len_buckets=[32, 128])
+        eng = InferenceEngine(cfg, metrics=fresh_series())
+        key = jax.random.PRNGKey(9)
+        dummy = jnp.ones((1, 8), jnp.int32)
+        trunk = None
+        for i, name in enumerate(["t1", "t2"]):
+            module = ModernBertForSequenceClassification(tiny_config(2))
+            params = flax.core.unfreeze(
+                module.init(jax.random.fold_in(key, 0), dummy))
+            if trunk is None:
+                trunk = params["params"]["model"]
+            else:
+                params["params"]["model"] = trunk
+            # a FRESH HashTokenizer per task: same vocab = same content
+            eng.register_task(name, "sequence", module, params,
+                              HashTokenizer(vocab_size=TINY["vocab_size"]),
+                              ["a", "b"], max_seq_len=128)
+        try:
+            assert len(eng.trunk_group_info()) == 1
+        finally:
+            eng.shutdown()
+
+    def test_digest_memo_serves_identity_case(self):
+        from semantic_router_tpu.engine.classify import _leaf_digest
+
+        arr = np.arange(16.0, dtype=np.float32)
+        d1 = _leaf_digest(arr)
+        assert _leaf_digest(arr) == d1            # memo hit
+        assert _leaf_digest(arr.copy()) == d1     # content equal
+        arr2 = arr.copy()
+        arr2[3] += 1.0
+        assert _leaf_digest(arr2) != d1

@@ -50,9 +50,9 @@ class TestGrafana:
         rc = main(["grafana", "--out-dir", str(tmp_path / "g")])
         assert rc == 0
         out = json.loads(capsys.readouterr().out)
-        # 8 curated dashboards (incl. Runtime & SLO, Decisions,
-        # Resilience, and Flywheel) + catalog + provider
-        assert len(out["rendered"]) == 10
+        # 9 curated dashboards (incl. Runtime & SLO, Decisions,
+        # Resilience, Flywheel, and Upstreams) + catalog + provider
+        assert len(out["rendered"]) == 11
 
 
 class TestEmbedMap:

@@ -1,0 +1,621 @@
+"""Upstream resilience plane units (ISSUE 9; make upstream-smoke).
+
+- circuit-breaker state machine: consecutive-failure trip, cooldown,
+  half-open probe (one at a time), probe success/failure;
+- deadline math: header parsing (relative / absolute / junk) and
+  per-attempt timeout derivation;
+- retry budget: token-bucket grant/deny + the degradation >= L2 gate;
+- selection-time candidate mask + ranked-alternates export;
+- fleet-shared open circuits over the StateBackend seam;
+- /debug/upstreams payload schema;
+- config normalizer defaults;
+- UpstreamPool stale-reuse: a request that dies on a stale pooled
+  keep-alive socket retries on a FRESH connection, never on another
+  pooled one;
+- DecisionExplainer.annotate stamping failover_path schema-legally.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from semantic_router_tpu.config.schema import RouterConfig
+from semantic_router_tpu.observability.explain import (
+    DecisionExplainer,
+    validate_record,
+)
+from semantic_router_tpu.observability.metrics import MetricsRegistry
+from semantic_router_tpu.resilience.upstream import (
+    UpstreamHealth,
+    attempt_timeout,
+    parse_deadline,
+)
+from semantic_router_tpu.router import Router
+from semantic_router_tpu.router import headers as H
+from semantic_router_tpu.router.httpclient import UpstreamPool
+from semantic_router_tpu.runtime.events import (
+    EventBus,
+    UPSTREAM_RECOVERED,
+    UPSTREAM_UNHEALTHY,
+)
+
+
+def make_plane(cfg_overrides=None):
+    up = UpstreamHealth(MetricsRegistry())
+    base = RouterConfig.from_dict({"resilience": {"upstream": {
+        "enabled": True, **(cfg_overrides or {})}}}).upstream_config()
+    up.configure(base)
+    return up
+
+
+# ---------------------------------------------------------------------------
+# deadline math
+
+
+class TestDeadline:
+    def test_relative_header(self):
+        assert parse_deadline({"x-vsr-deadline": "30"}, 300.0) == 30.0
+
+    def test_absolute_epoch_header(self):
+        t = time.time() + 12.0
+        got = parse_deadline({"x-vsr-deadline": str(t)}, 300.0)
+        assert 10.0 < got <= 12.5
+
+    def test_missing_and_junk_fall_back(self):
+        assert parse_deadline({}, 42.0) == 42.0
+        assert parse_deadline({"x-vsr-deadline": "soon"}, 42.0) == 42.0
+        assert parse_deadline({"x-vsr-deadline": "-5"}, 42.0) == 42.0
+
+    def test_client_cannot_exceed_operator_cap(self):
+        assert parse_deadline({"x-vsr-deadline": "9000"}, 300.0) == 300.0
+
+    def test_attempt_timeout_splits_budget(self):
+        # 30s left, 3 attempts -> 10s each
+        assert attempt_timeout(30.0, 3, 0.5, 300.0) == pytest.approx(10.0)
+
+    def test_attempt_timeout_floor_and_remaining(self):
+        # floor wins over a tiny share, but never exceeds what's left
+        assert attempt_timeout(3.0, 10, 0.5, 300.0) == pytest.approx(0.5)
+        assert attempt_timeout(0.2, 10, 0.5, 300.0) == pytest.approx(0.2)
+
+    def test_attempt_timeout_cap(self):
+        assert attempt_timeout(1000.0, 1, 0.5, 300.0) == 300.0
+
+
+# ---------------------------------------------------------------------------
+# breaker state machine
+
+
+class TestBreaker:
+    def test_trips_after_consecutive_failures(self):
+        up = make_plane({"breaker": {"failures": 3, "open_s": 60}})
+        for _ in range(2):
+            up.record("m", "ep", ok=False)
+        assert up.allow("m", "ep")          # still closed
+        up.record("m", "ep", ok=False)      # third consecutive: open
+        assert not up.allow("m", "ep")
+        assert up.report()["open_circuits"] == 1
+
+    def test_success_resets_consecutive_count(self):
+        up = make_plane({"breaker": {"failures": 3, "open_s": 60}})
+        up.record("m", "ep", ok=False)
+        up.record("m", "ep", ok=False)
+        up.record("m", "ep", ok=True)
+        up.record("m", "ep", ok=False)
+        up.record("m", "ep", ok=False)
+        assert up.allow("m", "ep")          # never reached 3 in a row
+
+    def test_half_open_probe_after_cooldown_single_probe(self):
+        up = make_plane({"breaker": {"failures": 1, "open_s": 0.05}})
+        up.record("m", "ep", ok=False)
+        assert not up.allow("m", "ep")
+        time.sleep(0.06)
+        assert up.allow("m", "ep")          # the half-open probe
+        assert not up.allow("m", "ep")      # only ONE probe in flight
+
+    def test_probe_success_closes_and_emits_recovered(self):
+        up = make_plane({"breaker": {"failures": 1, "open_s": 0.05}})
+        bus = EventBus()
+        up.bind(events=bus)
+        up.record("m", "ep", ok=False)
+        time.sleep(0.06)
+        assert up.allow("m", "ep")
+        up.record("m", "ep", ok=True)       # probe succeeded
+        assert up.allow("m", "ep")
+        stages = [e.stage for e in bus.recent(10)]
+        assert UPSTREAM_UNHEALTHY in stages
+        assert UPSTREAM_RECOVERED in stages
+
+    def test_probe_failure_reopens_with_fresh_cooldown(self):
+        up = make_plane({"breaker": {"failures": 1, "open_s": 0.08}})
+        up.record("m", "ep", ok=False)
+        time.sleep(0.09)
+        assert up.allow("m", "ep")
+        up.record("m", "ep", ok=False)      # probe failed
+        assert not up.allow("m", "ep")      # back to open, cooling
+
+    def test_abandoned_probe_expires_instead_of_wedging(self):
+        # a probe whose forward never reported back (retry denied after
+        # allow(), caller crash) must EXPIRE — the endpoint may not sit
+        # in half-open with a phantom probe forever
+        up = make_plane({"breaker": {"failures": 1, "open_s": 0.05}})
+        up.record("m", "ep", ok=False)
+        time.sleep(0.06)
+        assert up.allow("m", "ep")          # probe granted, never fed
+        assert not up.allow("m", "ep")      # in flight: blocked
+        time.sleep(0.06)
+        assert up.allow("m", "ep")          # expired: a fresh probe
+
+    def test_ewma_error_rate_tracks(self):
+        up = make_plane({"breaker": {"ewma_alpha": 0.5, "failures": 99}})
+        up.record("m", "ep", ok=False)
+        up.record("m", "ep", ok=False)
+        row = up.report()["endpoints"][0]
+        assert row["error_rate_ewma"] == pytest.approx(0.75)
+        assert up.health_score("m") == pytest.approx(0.25)
+
+    def test_sustained_error_rate_trips_without_consecutive_run(self):
+        # an endpoint failing every other request never strings
+        # `failures` consecutive errors, but the EWMA leg trips it once
+        # >= 10 samples exist above breaker.error_rate
+        up = make_plane({"breaker": {"failures": 99, "open_s": 60,
+                                     "ewma_alpha": 0.5,
+                                     "error_rate": 0.5}})
+        pattern = [False, True, False, False, True,
+                   False, False, False, True, False]
+        for i, ok in enumerate(pattern):
+            assert up.allow("m", "ep"), f"tripped early at sample {i}"
+            up.record("m", "ep", ok=ok)
+        assert not up.allow("m", "ep")  # sample 10: EWMA 0.73 >= 0.5
+        # error_rate 1.0 disables the EWMA leg entirely
+        up2 = make_plane({"breaker": {"failures": 99, "open_s": 60,
+                                      "ewma_alpha": 0.5,
+                                      "error_rate": 1.0}})
+        for _ in range(20):
+            up2.record("m", "ep", ok=False)
+        assert up2.allow("m", "ep")
+
+
+# ---------------------------------------------------------------------------
+# retry budget + degradation gate
+
+
+class _StubLadder:
+    def __init__(self, lvl):
+        self._lvl = lvl
+
+    def level(self):
+        return self._lvl
+
+
+class TestRetryBudget:
+    def test_budget_grants_then_denies(self):
+        up = make_plane({"retry": {"budget_per_s": 0.001, "burst": 2}})
+        assert up.try_retry()[0]
+        assert up.try_retry()[0]
+        ok, why = up.try_retry()
+        assert not ok and why == "budget_exhausted"
+
+    def test_no_retries_at_l2(self):
+        up = make_plane()
+        up.bind(resilience=_StubLadder(2))
+        ok, why = up.try_retry()
+        assert not ok and why == "degraded_l2"
+
+    def test_retries_allowed_at_l1(self):
+        up = make_plane()
+        up.bind(resilience=_StubLadder(1))
+        assert up.try_retry()[0]
+
+    def test_retry_on_policy(self):
+        up = make_plane({"retry": {"on": ["connect"]}})
+        assert up.retry_on("connect")
+        assert not up.retry_on("5xx")
+
+    def test_backoff_jittered_exponential(self):
+        up = make_plane({"retry": {"backoff_ms": 100}})
+        b1, b2 = up.backoff_s(1), up.backoff_s(2)
+        assert 0.05 <= b1 <= 0.15
+        assert 0.1 <= b2 <= 0.3
+
+
+# ---------------------------------------------------------------------------
+# model-level mask
+
+
+class TestModelMask:
+    def test_unknown_model_never_masked(self):
+        up = make_plane()
+        assert not up.model_open("never-seen")
+
+    def test_all_endpoints_open_masks_model(self):
+        up = make_plane({"breaker": {"failures": 1, "open_s": 60}})
+        up.record("m", "ep1", ok=False)
+        up.record("m", "ep2", ok=False)
+        assert up.model_open("m")
+
+    def test_one_healthy_endpoint_unmasks(self):
+        up = make_plane({"breaker": {"failures": 1, "open_s": 60}})
+        up.record("m", "ep1", ok=False)
+        up.record("m", "ep2", ok=True)
+        assert not up.model_open("m")
+
+    def test_probe_ready_circuit_unmasks(self):
+        up = make_plane({"breaker": {"failures": 1, "open_s": 0.05}})
+        up.record("m", "ep1", ok=False)
+        assert up.model_open("m")
+        time.sleep(0.06)
+        assert not up.model_open("m")  # cooldown over: let traffic probe
+
+
+# ---------------------------------------------------------------------------
+# fleet share over the StateBackend seam
+
+
+class TestFleetShare:
+    def _planes(self):
+        from semantic_router_tpu.stateplane.backend import (
+            GuardedBackend,
+            InMemoryStateBackend,
+        )
+        from semantic_router_tpu.stateplane.plane import StatePlane
+
+        shared = InMemoryStateBackend()
+        pa = StatePlane(GuardedBackend(shared), replica_id="a",
+                        namespace="t-up")
+        pb = StatePlane(GuardedBackend(shared), replica_id="b",
+                        namespace="t-up")
+        return pa, pb
+
+    def test_sibling_open_circuit_masks_here(self):
+        pa, pb = self._planes()
+        up_a = make_plane({"breaker": {"failures": 1, "open_s": 60}})
+        up_b = make_plane()
+        up_a.bind(plane=pa)
+        up_b.bind(plane=pb)
+        up_a.record("m", "ep1", ok=False)   # opens + publishes
+        up_b._fleet_ttl_s = 0.0             # force a fresh read
+        assert up_b.model_open("m")
+        assert {"model": "m", "endpoint": "ep1"} \
+            in up_b.report()["fleet_open"]
+
+    def test_local_knowledge_wins_over_fleet(self):
+        pa, pb = self._planes()
+        up_a = make_plane({"breaker": {"failures": 1, "open_s": 60}})
+        up_b = make_plane()
+        up_a.bind(plane=pa)
+        up_b.bind(plane=pb)
+        up_a.record("m", "ep1", ok=False)
+        up_b.record("m", "ep1", ok=True)    # B knows ep1 is fine
+        up_b._fleet_ttl_s = 0.0
+        assert not up_b.model_open("m")
+
+    def test_fleet_share_off_publishes_nothing(self):
+        pa, pb = self._planes()
+        up_a = make_plane({"fleet_share": False,
+                           "breaker": {"failures": 1, "open_s": 60}})
+        up_b = make_plane()
+        up_a.bind(plane=pa)
+        up_b.bind(plane=pb)
+        up_a.record("m", "ep1", ok=False)
+        up_b._fleet_ttl_s = 0.0
+        assert not up_b.model_open("m")
+
+
+# ---------------------------------------------------------------------------
+# config normalizer
+
+
+class TestUpstreamConfig:
+    def test_defaults_disabled(self):
+        cfg = RouterConfig().upstream_config()
+        assert cfg["enabled"] is False
+        assert cfg["breaker"]["failures"] == 5
+        assert cfg["retry"]["disable_at_level"] == 2
+        assert cfg["deadline"]["header"] == "x-vsr-deadline"
+
+    def test_overrides_and_malformed(self):
+        cfg = RouterConfig.from_dict({"resilience": {"upstream": {
+            "enabled": True,
+            "breaker": {"failures": "7", "open_s": "junk"},
+            "retry": {"on": "connect", "unknown_key": 1},
+        }}}).upstream_config()
+        assert cfg["enabled"] is True
+        assert cfg["breaker"]["failures"] == 7
+        assert cfg["breaker"]["open_s"] == 10.0     # junk -> default
+        assert cfg["retry"]["on"] == ["connect"]    # bare scalar
+        assert "unknown_key" not in cfg["retry"]
+
+    def test_report_schema(self):
+        up = make_plane()
+        up.record("m", "ep", ok=True, latency_s=0.01)
+        rep = up.report()
+        assert set(rep) == {"enabled", "endpoints", "open_circuits",
+                            "retry_budget", "fleet_open", "config"}
+        row = rep["endpoints"][0]
+        for key in ("model", "endpoint", "state", "consecutive_failures",
+                    "error_rate_ewma", "latency_ewma_ms", "requests",
+                    "failures", "opens"):
+            assert key in row
+        assert json.dumps(rep)  # JSON-serializable end to end
+
+
+# ---------------------------------------------------------------------------
+# selection-time mask + alternates export (pipeline wiring)
+
+
+ROUTE_CFG = {
+    "default_model": "m-b",
+    "routing": {
+        "modelCards": [{"name": "m-a"}, {"name": "m-b"},
+                       {"name": "m-c"}],
+        "signals": {"keywords": [{
+            "name": "go", "operator": "OR", "method": "exact",
+            "keywords": ["go"]}]},
+        "decisions": [{
+            "name": "go_route", "priority": 10,
+            "rules": {"operator": "OR", "conditions": [
+                {"type": "keyword", "name": "go"}]},
+            # one positive weight: weighted_choice is deterministic
+            # (m-a always; with m-a masked the zero-weight sum falls to
+            # the first remaining candidate, m-b)
+            "modelRefs": [{"model": "m-a", "weight": 1},
+                          {"model": "m-b", "weight": 0},
+                          {"model": "m-c", "weight": 0}],
+            "algorithm": {"type": "static"},
+        }],
+    },
+}
+
+
+def _body(text="go"):
+    return {"model": "auto",
+            "messages": [{"role": "user", "content": text}]}
+
+
+class TestSelectionMask:
+    def test_no_plane_no_mask_no_header(self):
+        router = Router(RouterConfig.from_dict(ROUTE_CFG))
+        try:
+            res = router.route(_body())
+            assert res.model == "m-a"
+            assert H.FALLBACK_MODELS not in res.headers
+            assert res.fallback_models == []
+        finally:
+            router.shutdown()
+
+    def test_open_circuit_model_never_selected(self):
+        router = Router(RouterConfig.from_dict(ROUTE_CFG))
+        up = make_plane({"breaker": {"failures": 1, "open_s": 60}})
+        router.upstream_health = up
+        try:
+            up.record("m-a", "ep", ok=False)    # m-a circuit opens
+            res = router.route(_body())
+            assert res.model == "m-b"           # next-best candidate
+            assert "upstream mask" in res.selection_reason
+        finally:
+            router.shutdown()
+
+    def test_alternates_exported_ranked_and_filtered(self):
+        router = Router(RouterConfig.from_dict(ROUTE_CFG))
+        up = make_plane({"breaker": {"failures": 1, "open_s": 60}})
+        router.upstream_health = up
+        try:
+            up.record("m-c", "ep", ok=False)    # m-c is dead
+            res = router.route(_body())
+            assert res.model == "m-a"
+            # alternates exclude the chosen model and the open circuit
+            assert res.fallback_models == ["m-b"]
+            assert res.headers[H.FALLBACK_MODELS] == "m-b"
+        finally:
+            router.shutdown()
+
+    def test_all_open_falls_back_to_full_candidate_set(self):
+        router = Router(RouterConfig.from_dict(ROUTE_CFG))
+        up = make_plane({"breaker": {"failures": 1, "open_s": 60}})
+        router.upstream_health = up
+        try:
+            for m in ("m-a", "m-b", "m-c"):
+                up.record(m, "ep", ok=False)
+            res = router.route(_body())
+            assert res.model == "m-a"           # mask never empties
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# decision-record annotation
+
+
+class TestAnnotate:
+    def test_failover_path_lands_and_validates(self):
+        ex = DecisionExplainer()
+        draft = ex.begin("a" * 32, "req1")
+        rec = draft.finish(kind="route", model="m-a", latency_ms=1.0,
+                           query="", redact_pii=True)
+        rid = ex.commit(rec)
+        path = [{"model": "m-a", "endpoint": "http://x", "outcome": "5xx",
+                 "status": 503},
+                {"model": "m-b", "endpoint": "http://y", "outcome": "ok",
+                 "status": 200}]
+        assert ex.annotate(rid, failover_path=path)
+        got = ex.get(rid)
+        assert got["failover_path"][1]["outcome"] == "ok"
+        assert validate_record(got) == []
+
+    def test_unknown_keys_dropped_missing_record_false(self):
+        ex = DecisionExplainer()
+        draft = ex.begin("b" * 32, "req2")
+        rid = ex.commit(draft.finish(kind="route", model="m",
+                                     latency_ms=1.0, query="",
+                                     redact_pii=True))
+        assert not ex.annotate(rid, not_a_field=[1])
+        assert not ex.annotate("missing", failover_path=[])
+        assert validate_record(ex.get(rid)) == []
+
+
+# ---------------------------------------------------------------------------
+# UpstreamPool stale-reuse fix
+
+
+class _CloseOnReuseServer:
+    """Keep-alive server that serves one response per connection, then —
+    once armed — closes the OLD connection the moment bytes arrive on
+    it.  That defeats the pool's select()-based staleness probe (the
+    FIN hasn't arrived at borrow time), forcing the mid-request
+    RemoteDisconnected path."""
+
+    def __init__(self):
+        self.srv = socket.socket()
+        self.srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(8)
+        self.port = self.srv.getsockname()[1]
+        self.armed = threading.Event()
+        self.connections = 0
+        # rendezvous for the setup phase: the first response on each
+        # connection waits until TWO connections are in flight, so the
+        # pool deterministically ends up holding two keep-alive sockets
+        # (without it the setup requests can serialize onto one)
+        self.setup_barrier = threading.Barrier(2)
+        self._stop = threading.Event()
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                self.srv.settimeout(0.2)
+                conn, _ = self.srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            self.connections += 1
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        try:
+            served = 0
+            buf = b""
+            while True:
+                conn.settimeout(5)
+                # read ONE complete request (headers + content-length
+                # body) — a naive recv-per-request server double-serves
+                # when http.client sends headers and body in separate
+                # segments, corrupting the keep-alive stream
+                while b"\r\n\r\n" not in buf:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    if served >= 1 and self.armed.is_set():
+                        return  # close mid-request: stale-reuse case
+                    buf += chunk
+                head, _, rest = buf.partition(b"\r\n\r\n")
+                length = 0
+                for line in head.decode("latin-1").split("\r\n"):
+                    if line.lower().startswith("content-length:"):
+                        length = int(line.split(":", 1)[1].strip())
+                while len(rest) < length:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    rest += chunk
+                buf = rest[length:]
+                if served >= 1 and self.armed.is_set():
+                    return
+                if not self.armed.is_set() and served == 0:
+                    try:
+                        self.setup_barrier.wait(timeout=2)
+                    except threading.BrokenBarrierError:
+                        pass
+                body = b"ok"
+                conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                             b"content-type: text/plain\r\n"
+                             + f"content-length: {len(body)}\r\n\r\n"
+                             .encode() + body)
+                served += 1
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self.srv.close()
+        except OSError:
+            pass
+
+
+class TestPoolStaleReuse:
+    def test_retry_runs_on_fresh_connection(self):
+        srv = _CloseOnReuseServer()
+        pool = UpstreamPool()
+        url = f"http://127.0.0.1:{srv.port}/x"
+        try:
+            # two parallel requests -> TWO pooled keep-alive sockets
+            results = []
+
+            def one():
+                results.append(pool.request("POST", url, b"{}", {}, 5))
+
+            threads = [threading.Thread(target=one) for _ in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert [r[0] for r in results] == [200, 200]
+            assert srv.connections == 2
+            # arm: every OLD connection now dies on first reuse.  The
+            # next request pops stale pooled conn #1 (dies mid-send),
+            # and the retry MUST go out on a fresh connection — the old
+            # behavior would pop stale pooled conn #2 and fail.
+            srv.armed.set()
+            status, _, body = pool.request("POST", url, b"{}", {}, 5)
+            assert status == 200 and body == b"ok"
+            assert srv.connections == 3  # the retry's fresh connection
+        finally:
+            pool.close()
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# deploy example
+
+
+class TestEnvoyRetryPolicyExample:
+    def test_retry_policy_yaml_well_formed(self):
+        import os
+
+        import yaml
+
+        path = os.path.join(os.path.dirname(__file__), "..", "deploy",
+                            "envoy", "retry-policy.yaml")
+        with open(path) as f:
+            doc = yaml.safe_load(f)
+        clusters = {c["name"]: c
+                    for c in doc["static_resources"]["clusters"]}
+        # the aggregate wrapper must list primary before fallback
+        agg = clusters["qwen3_8b_with_fallback"]["cluster_type"]
+        tiers = agg["typed_config"]["clusters"]
+        assert tiers.index("qwen3_8b_primary") \
+            < tiers.index("qwen3_8b_fallback")
+        # every route carries the retry policy with per-try timeout
+        vhosts = doc["static_resources"]["listeners"][0][
+            "filter_chains"][0]["filters"][0]["typed_config"][
+            "route_config"]["virtual_hosts"]
+        for route in vhosts[0]["routes"]:
+            rp = route["route"]["retry_policy"]
+            assert "5xx" in rp["retry_on"]
+            assert rp["per_try_timeout"]
+        # outlier detection = the Envoy-side breaker on every real tier
+        for name in ("qwen3_8b_primary", "qwen3_8b_fallback",
+                     "default_backend"):
+            assert clusters[name]["outlier_detection"]["consecutive_5xx"]

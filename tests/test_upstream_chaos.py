@@ -1,0 +1,315 @@
+"""Upstream-failover chaos e2e (ISSUE 9 acceptance; make upstream-smoke).
+
+The selected backend sits behind a FaultProxy scripted to 100% error —
+and separately to timeout (slow) and timed flap — while a healthy
+next-best candidate stays up.  With the upstream resilience plane on:
+
+- >=99% of requests must still succeed via failover to the next-best
+  candidate;
+- the failover must be visible in decision records (failover_path) and
+  llm_upstream_* metrics;
+- the breaker must open within the configured failure window (after
+  which SELECTION masks the dead model — no more doomed first
+  attempts) and recover through its half-open probe once the backend
+  heals;
+- no retries may be issued at degradation >= L2;
+- resilience.upstream disabled (the default) must route byte-identically
+  and construct nothing.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from semantic_router_tpu.config.schema import RouterConfig
+from semantic_router_tpu.router import headers as H
+from semantic_router_tpu.router.fault_proxy import FaultProxy
+from semantic_router_tpu.router.mock_backend import MockVLLMServer
+from semantic_router_tpu.router.server import RouterServer
+from semantic_router_tpu.runtime.bootstrap import (
+    apply_upstream_knobs,
+    build_router,
+)
+from semantic_router_tpu.runtime.events import (
+    UPSTREAM_RECOVERED,
+    UPSTREAM_UNHEALTHY,
+)
+from semantic_router_tpu.runtime.registry import RuntimeRegistry
+
+
+def _cfg_dict(endpoint_a: str, endpoint_b: str, upstream=None) -> dict:
+    return {
+        "default_model": "m-b",
+        "routing": {
+            "modelCards": [
+                {"name": "m-a",
+                 "backend_refs": [{"endpoint": endpoint_a}]},
+                {"name": "m-b",
+                 "backend_refs": [{"endpoint": endpoint_b}]},
+            ],
+            "signals": {"keywords": [{
+                "name": "go", "operator": "OR", "method": "exact",
+                "keywords": ["go"]}]},
+            "decisions": [{
+                "name": "go_route", "priority": 10,
+                "rules": {"operator": "OR", "conditions": [
+                    {"type": "keyword", "name": "go"}]},
+                # one positive weight = deterministic selection: m-a
+                # while healthy, the first remaining candidate (m-b)
+                # once m-a is masked
+                "modelRefs": [{"model": "m-a", "weight": 1},
+                              {"model": "m-b", "weight": 0}],
+                "algorithm": {"type": "static"},
+            }],
+        },
+        "resilience": {"upstream": upstream} if upstream else {},
+    }
+
+
+UPSTREAM_KNOBS = {
+    "enabled": True,
+    "breaker": {"failures": 5, "open_s": 0.4, "ewma_alpha": 0.3},
+    "retry": {"budget_per_s": 50.0, "burst": 60.0, "max_attempts": 3,
+              "backoff_ms": 10.0, "disable_at_level": 2},
+    "deadline": {"floor_s": 0.2},
+}
+
+
+class Stack:
+    """One full serving stack: MockVLLM <- FaultProxy (model m-a's
+    endpoint) + MockVLLM direct (m-b), router + HTTP server over an
+    isolated registry, upstream plane attached via the real bootstrap
+    knob path."""
+
+    def __init__(self, upstream=UPSTREAM_KNOBS, forward_timeout_s=8.0):
+        self.backend = MockVLLMServer().start()
+        self.proxy = FaultProxy(self.backend.url).start()
+        self.cfg = RouterConfig.from_dict(
+            _cfg_dict(self.proxy.url, self.backend.url,
+                      upstream=upstream))
+        self.registry = RuntimeRegistry.isolated()
+        self.router = build_router(self.cfg, engine=None,
+                                   registry=self.registry)
+        apply_upstream_knobs(self.cfg, self.registry, self.router)
+        self.server = RouterServer(
+            self.router, self.cfg, port=0,
+            forward_timeout_s=forward_timeout_s,
+            registry=self.registry).start()
+        self.events = []
+        self.registry.get("events").subscribe(self.events.append)
+
+    @property
+    def up(self):
+        return self.registry.get("upstreams")
+
+    def chat(self, text="go", headers=None, timeout=30):
+        req = urllib.request.Request(
+            self.server.url + "/v1/chat/completions",
+            data=json.dumps({"model": "auto", "messages": [
+                {"role": "user", "content": text}]}).encode(),
+            method="POST")
+        req.add_header("content-type", "application/json")
+        for k, v in (headers or {}).items():
+            req.add_header(k, v)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, dict(resp.headers), \
+                    json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers), json.loads(e.read() or b"{}")
+
+    def get(self, path):
+        with urllib.request.urlopen(self.server.url + path,
+                                    timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+
+    def stop(self):
+        self.server.stop()
+        self.proxy.stop()
+        self.backend.stop()
+
+    def event_stages(self):
+        return [e.stage for e in self.events]
+
+
+@pytest.fixture()
+def stack():
+    s = Stack()
+    yield s
+    s.stop()
+
+
+class TestErrorFailover:
+    def test_100pct_error_backend_fails_over_and_breaker_opens(
+            self, stack):
+        stack.proxy.plan = ["error"]
+        statuses, failover_headers, selected = [], 0, []
+        for _ in range(60):
+            status, headers, body = stack.chat()
+            statuses.append(status)
+            selected.append(headers.get(H.MODEL, ""))
+            if headers.get("x-vsr-failover-model"):
+                failover_headers += 1
+        ok = sum(1 for s in statuses if s == 200)
+        assert ok / len(statuses) >= 0.99          # the acceptance bar
+        # early requests failed over m-a -> m-b inside the forward
+        assert failover_headers >= 1
+        # the breaker opened within the failure window: from then on
+        # SELECTION masks m-a outright (no doomed first attempt)
+        assert selected[-1] == "m-b"
+        assert stack.proxy.stats.get("error", 0) <= 10  # not 60 retries
+        # visibility: events, metrics, /debug/upstreams, records
+        assert UPSTREAM_UNHEALTHY in stack.event_stages()
+        expo = stack.registry.metrics.expose()
+        assert "llm_upstream_failovers_total" in expo
+        assert 'outcome="5xx"' in expo
+        _, dbg = stack.get("/debug/upstreams")
+        row = next(r for r in dbg["endpoints"] if r["model"] == "m-a")
+        assert row["state"] == "open"
+        assert row["consecutive_failures"] >= 5
+        recs = stack.registry.get("explain").list(limit=100)
+        paths = [r["failover_path"] for r in recs if r["failover_path"]]
+        assert paths, "no decision record carries a failover_path"
+        flat = paths[0]
+        assert any(p["outcome"] == "5xx" and p["model"] == "m-a"
+                   for p in flat)
+        assert any(p["outcome"] == "ok" and p["model"] == "m-b"
+                   for p in flat)
+
+    def test_recovery_via_half_open_probe(self, stack):
+        stack.proxy.plan = ["error"]
+        for _ in range(8):
+            stack.chat()
+        assert stack.up.model_open("m-a")
+        # the backend heals; after the cooldown the next request is the
+        # half-open probe, succeeds, and closes the circuit
+        stack.proxy.plan = None
+        stack.proxy.error_rate = 0.0
+        time.sleep(0.45)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            status, headers, _ = stack.chat()
+            if status == 200 and headers.get(H.MODEL) == "m-a" \
+                    and not headers.get("x-vsr-failover-model"):
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("m-a never recovered")
+        assert UPSTREAM_RECOVERED in stack.event_stages()
+        _, dbg = stack.get("/debug/upstreams")
+        row = next(r for r in dbg["endpoints"] if r["model"] == "m-a")
+        assert row["state"] == "closed"
+
+
+class TestTimeoutFailover:
+    def test_slow_backend_fails_over_within_deadline(self):
+        s = Stack()
+        try:
+            s.proxy.slow_ms = 4000
+            s.proxy.plan = ["slow"]
+            t0 = time.monotonic()
+            status, headers, _ = s.chat(
+                headers={"x-vsr-deadline": "3"}, timeout=30)
+            elapsed = time.monotonic() - t0
+            assert status == 200
+            assert headers.get("x-vsr-failover-model") == "m-b"
+            # deadline-derived per-attempt timeout (3s/3 attempts = 1s)
+            # beat both the 4s hang and the flat 8s forward timeout
+            assert elapsed < 3.5
+            expo = s.registry.metrics.expose()
+            assert 'outcome="timeout"' in expo
+        finally:
+            s.stop()
+
+
+class TestFlapFailover:
+    def test_flapping_backend_stays_above_99pct(self):
+        s = Stack()
+        try:
+            s.proxy.set_flap(0.2, 0.2, mode="error")
+            ok = total = 0
+            for _ in range(40):
+                status, _, _ = s.chat()
+                total += 1
+                ok += int(status == 200)
+                time.sleep(0.03)
+            assert ok / total >= 0.99
+        finally:
+            s.stop()
+
+
+class _StubLadder:
+    def __init__(self, lvl):
+        self._lvl = lvl
+
+    def level(self):
+        return self._lvl
+
+
+class TestDegradationGate:
+    def test_no_retries_at_l2(self, stack):
+        stack.up.bind(resilience=_StubLadder(2))
+        stack.proxy.plan = ["error"]
+        status, headers, body = stack.chat()
+        # the failure surfaces: failover would be a retry, and retries
+        # are off at L2 — the shed ladder's fight, not the plane's
+        assert status == 503
+        assert body["error"]["type"] == "fault_proxy"
+        assert stack.proxy.stats.get("error", 0) == 1
+        expo = stack.registry.metrics.expose()
+        assert 'granted="false"' in expo and 'reason="degraded"' in expo
+        recs = stack.registry.get("explain").list(limit=10)
+        path = recs[0]["failover_path"]
+        assert any(p["outcome"].startswith("retry_denied:degraded")
+                   for p in path)
+
+
+class TestDisabledDefault:
+    def test_disabled_constructs_nothing_and_routes_identically(self):
+        s = Stack(upstream=None)
+        try:
+            assert s.registry.get("upstreams") is None
+            assert s.router.upstream_health is None
+            status, headers, _ = s.chat()
+            assert status == 200
+            assert H.FALLBACK_MODELS not in headers
+            assert "x-vsr-failover-model" not in headers
+            code = None
+            try:
+                s.get("/debug/upstreams")
+            except urllib.error.HTTPError as e:
+                code = e.code
+            assert code == 503
+        finally:
+            s.stop()
+
+    def test_route_headers_byte_identical_without_plane(self):
+        backend = MockVLLMServer().start()
+        cfg_off = RouterConfig.from_dict(
+            _cfg_dict(backend.url, backend.url, upstream=None))
+        cfg_off2 = RouterConfig.from_dict(
+            _cfg_dict(backend.url, backend.url,
+                      upstream={"enabled": False}))
+        from semantic_router_tpu.router import Router
+
+        r1 = Router(cfg_off)
+        r2 = Router(cfg_off2)
+        reg = RuntimeRegistry.isolated()
+        apply_upstream_knobs(cfg_off2, reg, r2)   # stays detached
+        try:
+            body = {"model": "auto", "messages": [
+                {"role": "user", "content": "go"}]}
+            a, b = r1.route(dict(body)), r2.route(dict(body))
+            ha = {k: v for k, v in a.headers.items()
+                  if k != H.REQUEST_ID and k != H.DECISION_RECORD}
+            hb = {k: v for k, v in b.headers.items()
+                  if k != H.REQUEST_ID and k != H.DECISION_RECORD}
+            assert ha == hb and a.model == b.model
+            assert reg.get("upstreams") is None
+        finally:
+            r1.shutdown()
+            r2.shutdown()
+            backend.stop()
