@@ -2,8 +2,9 @@ PY ?= python
 SHELL := /bin/bash
 
 .PHONY: test test-fast tier1 trace-smoke metrics-lint explain-smoke \
-	resilience-smoke fleet-smoke flywheel-smoke upstream-smoke native \
-	bench bench-replay perf perf-record serve-mock clean
+	resilience-smoke fleet-smoke flywheel-smoke upstream-smoke \
+	packing-smoke native bench bench-replay perf perf-record \
+	serve-mock clean
 
 bench-replay:
 	$(PY) benchmarks/replay_bench.py --n 500 \
@@ -68,7 +69,19 @@ resilience-smoke:
 # replays buffered writes).  Tier-1 (runs inside `make tier1` too).
 fleet-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_stateplane.py \
-	  tests/test_stateplane_chaos.py -q -p no:cacheprovider
+	  tests/test_stateplane_chaos.py \
+	  "tests/test_packing.py::TestPackingLoad" -q -p no:cacheprovider
+
+# sequence-packing gate (docs/PACKING.md): packer layout + mask/
+# position-id contract, packed-vs-unpacked logits parity (≤1e-4) across
+# mixed-length / mixed-task / LoRA'd / deduped / token batches,
+# truncation + bucket-overflow semantics under packing, the
+# continuous-admission starvation bound, auto-tuner policy, knob
+# wiring, and the mixed-length-load padding-waste drop.  Tier-1 (runs
+# inside `make tier1` too).
+packing-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_packing.py \
+	  -q -p no:cacheprovider
 
 # learned-routing-flywheel gate (docs/FLYWHEEL.md): records 100 mixed
 # requests in-process, exports the corpus, trains the cost-aware bandit

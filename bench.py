@@ -908,6 +908,69 @@ def _measure_tracing_overhead(platform: str) -> dict:
 # the measurement (runs inside whichever process owns the backend)
 
 
+def _measure_packing(platform: str) -> dict:
+    """Sequence-packing arm (docs/PACKING.md, ISSUE 11 acceptance): the
+    SAME shared-trunk engine serving a short-prompt-heavy mix with the
+    packing scheduler on vs off — signals/s and the token-level fill
+    ratio (runtimestats) for each.  Packing must hold fill >= 0.85 and
+    signals/s no worse than the padded scheduler on the CPU fallback."""
+    import numpy as np
+
+    from semantic_router_tpu.config.schema import InferenceEngineConfig
+    from semantic_router_tpu.engine.testing import make_shared_trunk_engine
+    from semantic_router_tpu.observability.metrics import (
+        MetricSeries,
+        MetricsRegistry,
+    )
+    from semantic_router_tpu.observability.runtimestats import RuntimeStats
+
+    rng = np.random.default_rng(0xBEEF)
+    words = ("alpha beta gamma delta epsilon zeta eta theta iota kappa "
+             "lambda mu nu xi omicron pi rho sigma tau upsilon").split()
+
+    def mk_texts(n: int) -> list:
+        return [" ".join(rng.choice(words,
+                                    size=int(rng.integers(8, 28))))
+                for _ in range(n)]
+
+    texts = mk_texts(64)
+    window_s = 3.0 if platform == "cpu" else 6.0
+    rows = {}
+    for label, knobs in (("packed", {"enabled": True}),
+                         ("padded", {"enabled": False})):
+        rs = RuntimeStats(MetricsRegistry())
+        eng = make_shared_trunk_engine(
+            engine_cfg=InferenceEngineConfig(
+                max_batch_size=16, max_wait_ms=2.0,
+                seq_len_buckets=[128, 512], packing=knobs),
+            metrics=MetricSeries(MetricsRegistry()), runtime_stats=rs)
+        try:
+            eng.classify_batch("intent", texts)  # warm the jit cache
+            rs.clear()
+            n = 0
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < window_s:
+                eng.classify_batch("intent", texts)
+                n += len(texts)
+            dt = time.perf_counter() - t0
+            progs = [p for p in rs.programs()
+                     if p["group"].startswith("trunk:")]
+            tok_real = sum(p.get("tokens_real", 0) for p in progs)
+            tok_pad = sum(p.get("tokens_padded", 0) for p in progs)
+            rows[label] = {
+                "signals_per_s": round(n / dt, 2),
+                "fill_ratio": round(tok_real / tok_pad, 4)
+                if tok_pad else None,
+            }
+        finally:
+            eng.shutdown()
+    out = {"packed": rows["packed"], "padded": rows["padded"]}
+    if rows["padded"]["signals_per_s"]:
+        out["speedup"] = round(rows["packed"]["signals_per_s"]
+                               / rows["padded"]["signals_per_s"], 3)
+    return out
+
+
 def _run_bench(platform: str) -> None:
     sys.stderr.write(f"bench: running on platform={platform}\n")
 
@@ -1201,6 +1264,18 @@ def _run_bench(platform: str) -> None:
         sys.stderr.write(f"bench: flywheel arm failed "
                          f"({type(exc).__name__}: {exc}); skipped\n")
 
+    # packing arm (docs/PACKING.md, ISSUE 11 acceptance): signals/s +
+    # token fill ratio with the packing scheduler on vs off over a
+    # short-prompt-heavy synthetic mix — the padding-waste lever's own
+    # perf trajectory.
+    packing_row = None
+    try:
+        packing_row = _measure_packing(platform)
+        sys.stderr.write(f"bench: packing {packing_row}\n")
+    except Exception as exc:
+        sys.stderr.write(f"bench: packing arm failed "
+                         f"({type(exc).__name__}: {exc}); skipped\n")
+
     batch, signals_per_s, best_impl = best
     # On a CPU fallback the host geometry is the whole story (this image
     # exposes ONE 2.1GHz core — ~0.09 TFLOPs f32 roofline — while the
@@ -1231,6 +1306,8 @@ def _run_bench(platform: str) -> None:
         record["stateplane"] = stateplane_row
     if flywheel_row is not None:
         record["flywheel"] = flywheel_row
+    if packing_row is not None:
+        record["packing"] = packing_row
     if platform != "cpu":
         # side evidence for the bench README / judge: full sweep detail
         try:

@@ -960,6 +960,12 @@ class InferenceEngineConfig:
     # Per-task opt-out via register_task(..., fuse=False) for tasks whose
     # max_seq_len / tokenizer must diverge from their trunk siblings.
     fuse_trunks: bool = True
+    # sequence-packed continuous batching (docs/PACKING.md): raw knob
+    # block, normalized by engine.packing.normalize_packing — the ONE
+    # interpretation point.  {"enabled": false} restores byte-identical
+    # fixed-batch behavior; hot-reloadable via bootstrap
+    # apply_packing_knobs.
+    packing: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "InferenceEngineConfig":
@@ -974,10 +980,19 @@ class InferenceEngineConfig:
             matryoshka_dims=list(d.get("matryoshka_dims", [])),
             dispatch_workers=int(d.get("dispatch_workers", 4)),
             fuse_trunks=bool(d.get("fuse_trunks", True)),
+            packing=dict(d.get("packing", {}) or {}),
         )
         if d.get("seq_len_buckets"):
             out.seq_len_buckets = [int(x) for x in d["seq_len_buckets"]]
         return out
+
+    def packing_config(self) -> Dict[str, Any]:
+        """Normalized engine.packing block (defaults merged) — delegates
+        to the subsystem's own normalizer so a directly constructed
+        engine and a bootstrap-configured one can never drift."""
+        from ..engine.packing import normalize_packing
+
+        return normalize_packing(self.packing)
 
 
 DEFAULT_RECIPE_NAME = "default"
@@ -1383,7 +1398,13 @@ class RouterConfig:
         out["retry"] = _block("retry", {
             "budget_per_s": 1.0, "burst": 10.0, "max_attempts": 3,
             "backoff_ms": 50.0, "disable_at_level": 2,
-            "on": ["connect", "5xx", "timeout", "reset"]})
+            "on": ["connect", "5xx", "timeout", "reset"],
+            # share the retry budget FLEET-WIDE through the StatePlane
+            # StateBackend seam (docs/RESILIENCE.md): N replicas then
+            # spend ONE budget_per_s pool instead of N — active only
+            # when a plane is attached and fleet_share is on; plane
+            # loss degrades to the local per-replica bucket
+            "fleet_budget": True})
         out["deadline"] = _block("deadline", {
             "header": "x-vsr-deadline", "default_s": 0.0,
             "floor_s": 0.5})

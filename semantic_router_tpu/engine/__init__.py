@@ -7,9 +7,19 @@ from .classify import (
     TokenClassResult,
     TrunkGroup,
 )
+from .packing import (
+    PackedBatch,
+    PackingBatcher,
+    ShapeAutoTuner,
+    normalize_packing,
+    pack_items,
+    plan_take,
+)
 
 __all__ = [
     "BatchItem", "ClassResult", "DynamicBatcher", "EntitySpan",
-    "InferenceEngine", "TRUNK_KEY", "TokenClassResult", "TrunkGroup",
-    "pick_bucket", "pow2_batch",
+    "InferenceEngine", "PackedBatch", "PackingBatcher",
+    "ShapeAutoTuner", "TRUNK_KEY", "TokenClassResult", "TrunkGroup",
+    "normalize_packing", "pack_items", "pick_bucket", "plan_take",
+    "pow2_batch",
 ]

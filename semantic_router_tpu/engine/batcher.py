@@ -37,7 +37,7 @@ import threading
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence, Set
+from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence
 
 
 def _capture_trace():
@@ -62,6 +62,10 @@ class BatchItem:
     # the originating request's (tracer, trace_id, span_id, sampled),
     # captured at enqueue — None on untraced requests
     trace: Any = field(default_factory=_capture_trace)
+    # packed steps this item was passed over by the packing scheduler's
+    # lookahead (engine.packing.scheduler): bounded by the scheduler's
+    # starvation_steps knob — the continuous-admission fairness bound
+    deferred: int = 0
 
 
 BatchRunner = Callable[[Hashable, List[BatchItem]], Sequence[Any]]
@@ -185,7 +189,11 @@ class DynamicBatcher:
         self.max_batch_size = max(1, max_batch_size)
         self.max_wait_s = max_wait_ms / 1000.0
         self._queues: Dict[Hashable, List[BatchItem]] = {}
-        self._inflight: Set[Hashable] = set()
+        # in-flight STEP COUNT per group (plain DynamicBatcher caps at
+        # 1 — ordering + compile dedup; the packing scheduler raises the
+        # cap so host-side composition of step k+1 overlaps step k's
+        # device execution: continuous admission)
+        self._inflight: Dict[Hashable, int] = {}
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._stop = False
@@ -261,7 +269,8 @@ class DynamicBatcher:
                                      self._queues.values()),
                 "pending_groups": sum(1 for v in self._queues.values()
                                       if v),
-                "inflight_groups": len(self._inflight),
+                "inflight_groups": sum(1 for v in self._inflight.values()
+                                       if v > 0),
             }
         pool = self._pool.stats()
         out["pool_queued"] = pool["queued"]
@@ -303,19 +312,46 @@ class DynamicBatcher:
 
     # -- scheduler loop ----------------------------------------------------
 
+    # composition hooks — the packing scheduler (engine.packing.scheduler
+    # .PackingBatcher) overrides these; the defaults reproduce the
+    # original fixed-batch behavior exactly.
+
+    def _inflight_cap(self, key: Hashable) -> int:
+        """Max concurrent in-flight steps for a group.  1 (the default)
+        keeps per-group ordering and compile dedup; the packing
+        scheduler raises it for continuous admission."""
+        return 1
+
+    def _group_full(self, key: Hashable, items: List[BatchItem]) -> bool:
+        """True when the group should fire without waiting."""
+        return len(items) >= self.max_batch_size
+
+    def _ready_immediately(self, key: Hashable,
+                           items: List[BatchItem]) -> bool:
+        """Extra readiness (continuous admission): fire before max_wait
+        because something else provides the accumulation window."""
+        return False
+
+    def _take_batch(self, key: Hashable, items: List[BatchItem]
+                    ) -> tuple:
+        """Split a group's queue into (batch to dispatch, remainder)."""
+        return items[:self.max_batch_size], items[self.max_batch_size:]
+
     def _ready_group(self) -> Optional[Hashable]:
         """A group is ready when full, or its oldest item aged past
         max_wait, or (low-QPS fast path) nothing else is pending.
-        Groups with a batch already in flight are NOT ready — one
-        in-flight batch per group keeps ordering and compile-dedup."""
+        Groups at their in-flight cap are NOT ready — the cap (1 by
+        default) keeps ordering and compile-dedup."""
         now = time.perf_counter()
         oldest_key, oldest_age = None, -1.0
         total = 0
         for key, items in self._queues.items():
-            if not items or key in self._inflight:
+            if not items or self._inflight.get(key, 0) \
+                    >= self._inflight_cap(key):
                 continue
             total += len(items)
-            if len(items) >= self.max_batch_size:
+            if self._group_full(key, items) \
+                    or self._ready_immediately(key, items):
                 return key
             age = now - items[0].enqueue_t
             if age > oldest_age:
@@ -333,7 +369,8 @@ class DynamicBatcher:
     def _next_deadline(self) -> Optional[float]:
         deadline = None
         for key, items in self._queues.items():
-            if items and key not in self._inflight:
+            if items and self._inflight.get(key, 0) \
+                    < self._inflight_cap(key):
                 d = items[0].enqueue_t + self.max_wait_s
                 deadline = d if deadline is None else min(deadline, d)
         return deadline
@@ -352,21 +389,31 @@ class DynamicBatcher:
                 if self._stop:
                     return
                 items = self._queues[key]
-                batch = items[:self.max_batch_size]
-                self._queues[key] = items[self.max_batch_size:]
-                self._inflight.add(key)
+                batch, rest = self._take_batch(key, items)
+                if not batch:  # defensive: a planner must never wedge
+                    batch, rest = items[:1], items[1:]
+                self._queues[key] = rest
+                self._inflight[key] = self._inflight.get(key, 0) + 1
                 self._stats["batches"] += 1
                 self._stats["items"] += len(batch)
                 self._stats["max_batch"] = max(self._stats["max_batch"],
                                                len(batch))
                 self._stats["max_inflight"] = max(
-                    self._stats["max_inflight"], len(self._inflight))
+                    self._stats["max_inflight"],
+                    sum(1 for v in self._inflight.values() if v > 0))
             self._observe_batch(batch)
             try:
                 self._pool.submit(self._dispatch, self._cancel_batch,
                                   key, batch)
             except RuntimeError:  # pool shut down underneath us
                 self._cancel_batch(key, batch)
+
+    def _release_inflight(self, key: Hashable) -> None:
+        n = self._inflight.get(key, 0)
+        if n <= 1:
+            self._inflight.pop(key, None)
+        else:
+            self._inflight[key] = n - 1
 
     def _dispatch(self, key: Hashable, batch: List[BatchItem]) -> None:
         try:
@@ -375,14 +422,14 @@ class DynamicBatcher:
             # group becomes dispatchable again; wake the picker in case
             # it queued more items for this group while we ran
             with self._wake:
-                self._inflight.discard(key)
+                self._release_inflight(key)
                 self._wake.notify()
 
     def _cancel_batch(self, key: Hashable, batch: List[BatchItem]) -> None:
         """Shutdown raced this batch out of the pool queue: fail its
         futures rather than running the model against torn-down state."""
         with self._wake:
-            self._inflight.discard(key)
+            self._release_inflight(key)
         for item in batch:
             if not item.future.done():
                 item.future.set_exception(RuntimeError("batcher stopped"))

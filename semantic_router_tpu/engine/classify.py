@@ -45,6 +45,13 @@ import numpy as np
 from ..config.schema import InferenceEngineConfig
 from ..utils.tokenization import Encoding, Tokenizer, decode_entity_spans
 from .batcher import BatchItem, DynamicBatcher, pick_bucket, pow2_batch
+from .packing import (
+    RowPlan,
+    PackingBatcher,
+    ShapeAutoTuner,
+    normalize_packing,
+    pack_items,
+)
 
 # batch-group key prefix for fused trunk groups — the group id, not the
 # task name, is the batching unit (see module docstring)
@@ -192,11 +199,22 @@ class TrunkGroup:
     pad_id: int
     members: List[str] = field(default_factory=list)
     entries: List[dict] = field(default_factory=list)
+    # sequence-head view (bank rows over SEQUENCE members only — token
+    # members live in the parallel tok_* fields, stacked separately
+    # because their heads apply per TOKEN, not per pooled row)
     widths: List[int] = field(default_factory=list)  # true label widths
     row_of: Dict[str, int] = field(default_factory=dict)
     bank: Any = None
+    tok_bank: Any = None
+    tok_widths: List[int] = field(default_factory=list)
+    tok_row_of: Dict[str, int] = field(default_factory=dict)
     apply_fn: Any = None
-    # atomic (bank, row_of, widths) snapshot for the demux: the runner
+    # the fused jit program set keyed by flavor: seq / tok / both plus
+    # their packed_* siblings (engine.packing) — all share the ONE trunk
+    # forward; the runner picks by batch contents, so a batch with no
+    # token items never pays the per-token head matmul
+    fns: Any = None
+    # atomic demux snapshot (banks + row maps + widths): the runner
     # reads ONE consistent view, so a concurrent re-registration can
     # never pair new row indices with old logits ordering
     demux: Any = None
@@ -256,14 +274,40 @@ class InferenceEngine:
                     raise ValueError(
                         f"seq_len_buckets {bad} not divisible by sp={sp}"
                         f" (ring attention shards S over sp)")
-        self.batcher = DynamicBatcher(
+        # sequence-packed continuous batching (engine.packing,
+        # docs/PACKING.md): the batch composer is ALWAYS the packing
+        # scheduler — with packing disabled every hook delegates to the
+        # DynamicBatcher base class (byte-identical batching), so the
+        # enabled knob hot-flips without swapping a live batcher
+        self._packing = normalize_packing(
+            getattr(self.cfg, "packing", None))
+        self.batcher = PackingBatcher(
             self._run_batch,
+            bucket_of=self._packing_bucket_of,
+            segment_cap_of=self._packing_segment_cap_of,
             max_batch_size=self.cfg.max_batch_size,
             max_wait_ms=self.cfg.max_wait_ms,
             name="tpu-engine-batcher",
             dispatch_workers=self.cfg.dispatch_workers,
             metrics=metrics,
+            enabled=self._packing["enabled"],
+            max_segments_per_row=self._packing["max_segments_per_row"],
+            max_items_per_step=self._packing["max_items_per_step"],
+            max_inflight_steps=self._packing["max_inflight_steps"],
+            starvation_steps=self._packing["starvation_steps"],
         )
+        # the online shape auto-tuner exists per engine (cheap state);
+        # its POLLING THREAD is bootstrap's to start (apply_packing_knobs
+        # honors engine.packing.autotune) — bare test engines stay
+        # thread-free and drive step() directly
+        at = self._packing["autotune"]
+        self._autotuner = ShapeAutoTuner(
+            self._runtime_stats, self.batcher,
+            target_fill=at["target_fill"],
+            min_samples=at["min_samples"],
+            segments_floor=self._packing["max_segments_per_row"],
+            max_segments_cap=at["max_segments_cap"],
+            interval_s=at["interval_s"])
         # queue-depth / pool-saturation gauges ride the runtime-stats
         # sampler; keyed by batcher name, so a rebuilt engine replaces
         # the provider and shutdown() unregisters it.  The host instance
@@ -336,7 +380,10 @@ class InferenceEngine:
         # entry must stack from host copies
         entry = tkey = host_trunk = None
         want_fuse = self.cfg.fuse_trunks if fuse is None else bool(fuse)
-        if want_fuse and kind == "sequence":
+        if want_fuse and kind in ("sequence", "token"):
+            # token-classification heads (PII / hallucination spans)
+            # fuse too: same trunk forward, their heads apply per token
+            # and stack into the group's tok_bank (docs/FUSED_BANK.md)
             from ..models.lora import head_bank_entry
 
             entry = head_bank_entry(module, params)
@@ -411,17 +458,14 @@ class InferenceEngine:
         g = self._task_group.pop(name, None)
         if g is None:
             return
-        row = g.row_of.pop(name, None)
-        if row is None:
+        try:
+            idx = g.members.index(name)
+        except ValueError:
             return
-        g.members.pop(row)
-        g.entries.pop(row)
-        g.widths.pop(row)
-        for t, r in g.row_of.items():
-            if r > row:
-                g.row_of[t] = r - 1
+        g.members.pop(idx)
+        g.entries.pop(idx)
         if g.members:
-            self._rebuild_bank(g)
+            self._rebuild_bank(g)  # re-derives row maps + widths
         else:
             self._groups_by_gid.pop(g.gid, None)
             for k, v in list(self._trunk_groups.items()):
@@ -465,54 +509,133 @@ class InferenceEngine:
                 new_p["model"] = g.trunk_params
                 t.params = ({**dict(t.params), "params": new_p}
                             if "params" in t.params else new_p)
-            g.row_of[name] = len(g.members)
             g.members.append(name)
             g.entries.append(entry)
-            g.widths.append(int(np.shape(entry["cls_kernel"])[1]))
-            self._rebuild_bank(g)
+            self._rebuild_bank(g)  # derives row maps + widths per kind
             self._task_group[name] = g
 
     def _rebuild_bank(self, g: TrunkGroup) -> None:
-        """Re-stack the head/adapter bank after membership changes.  The
-        fused fn takes the bank as an argument, so a new member costs one
-        recompile (the task axis grew) — registration-time, never serving
-        -time."""
+        """Re-stack the head/adapter banks after membership changes —
+        SEQUENCE heads and TOKEN heads stack separately (pooled-row vs
+        per-token application).  The fused fns take the banks as
+        arguments, so a new member costs one recompile (the task axis
+        grew) — registration-time, never serving-time."""
         from ..models.lora import stack_head_bank
 
-        bank = stack_head_bank(g.entries)
-        if self.mesh is not None:
-            from ..parallel import shard_head_bank
+        def _stack(idxs: List[int]):
+            if not idxs:
+                return None
+            bank = stack_head_bank([g.entries[i] for i in idxs])
+            if self.mesh is not None:
+                from ..parallel import shard_head_bank
 
-            bank = shard_head_bank(bank, self.mesh)
-        else:
+                return shard_head_bank(bank, self.mesh)
             # commit to device ONCE: a host-numpy bank would re-upload
             # tens of MB per batch through the jit boundary
-            bank = {k: jnp.asarray(v) for k, v in bank.items()}
-        g.bank = bank
+            return {k: jnp.asarray(v) for k, v in bank.items()}
+
+        seq_idx = [i for i, e in enumerate(g.entries)
+                   if e.get("kind", "sequence") == "sequence"]
+        tok_idx = [i for i, e in enumerate(g.entries)
+                   if e.get("kind") == "token"]
+        g.bank = _stack(seq_idx)
+        g.tok_bank = _stack(tok_idx)
+        g.row_of = {g.members[i]: r for r, i in enumerate(seq_idx)}
+        g.widths = [int(np.shape(g.entries[i]["cls_kernel"])[1])
+                    for i in seq_idx]
+        g.tok_row_of = {g.members[i]: r for r, i in enumerate(tok_idx)}
+        g.tok_widths = [int(np.shape(g.entries[i]["cls_kernel"])[1])
+                        for i in tok_idx]
         # one atomic assignment: the runner's demux view stays consistent
-        g.demux = (bank, dict(g.row_of), list(g.widths))
-        if g.apply_fn is None:
+        g.demux = {
+            "bank": g.bank, "tok_bank": g.tok_bank,
+            "row_of": dict(g.row_of), "widths": list(g.widths),
+            "tok_row_of": dict(g.tok_row_of),
+            "tok_widths": list(g.tok_widths),
+        }
+        if g.fns is None:
             g.apply_fn = self._make_fused_fn(g)
 
     def _make_fused_fn(self, g: TrunkGroup):
+        """Build the group's fused jit program set.  Every flavor shares
+        the SAME trunk forward; only the head application differs:
+
+        - seq:  pooled rows → apply_head_bank → [B, T, L]
+        - tok:  every token → apply_head_bank on [B·S, D] → [B, S, T, L]
+        - both: one trunk forward feeding both head banks
+        - packed_*: the sequence-packing siblings (engine.packing) —
+          block-diagonal attention + per-segment positions in the trunk,
+          per-SEGMENT pooling for sequence heads (docs/PACKING.md).
+
+        jit() is free until called: flavors a deployment never uses are
+        never compiled."""
         from ..models.lora import apply_head_bank
         from ..models.modernbert import activation
-        from ..ops.attention import cls_pool, mean_pool
+        from ..ops.attention import (
+            cls_pool,
+            mean_pool,
+            packed_cls_pool,
+            packed_mean_pool,
+        )
 
         cfg = g.config
         act = activation(cfg.classifier_activation)
         use_mean = cfg.classifier_pooling == "mean"
         trunk = g.trunk_module
 
-        def fused(trunk_params, bank, ids, mask):
-            hidden = trunk.apply({"params": trunk_params}, ids, mask)
-            pooled = (mean_pool(hidden, mask) if use_mean
-                      else cls_pool(hidden))
-            return apply_head_bank(bank, pooled, act, cfg.norm_eps)
+        def hidden_fn(trunk_params, ids, mask, pos=None, seg=None):
+            return trunk.apply({"params": trunk_params}, ids, mask,
+                               position_ids=pos, segment_ids=seg)
+
+        def pool(hidden, mask):
+            return mean_pool(hidden, mask) if use_mean \
+                else cls_pool(hidden)
+
+        def ppool(hidden, seg, seg_row, seg_start):
+            return packed_mean_pool(hidden, seg, seg_row.shape[0]) \
+                if use_mean else packed_cls_pool(hidden, seg_row,
+                                                 seg_start)
+
+        def tok_heads(tok_bank, hidden):
+            B, S, H = hidden.shape
+            flat = apply_head_bank(tok_bank, hidden.reshape(B * S, H),
+                                   act, cfg.norm_eps)
+            return flat.reshape(B, S, flat.shape[-2], flat.shape[-1])
+
+        def seq_fn(trunk_params, bank, ids, mask):
+            h = hidden_fn(trunk_params, ids, mask)
+            return apply_head_bank(bank, pool(h, mask), act, cfg.norm_eps)
+
+        def tok_fn(trunk_params, tok_bank, ids, mask):
+            return tok_heads(tok_bank, hidden_fn(trunk_params, ids, mask))
+
+        def both_fn(trunk_params, bank, tok_bank, ids, mask):
+            h = hidden_fn(trunk_params, ids, mask)
+            return (apply_head_bank(bank, pool(h, mask), act,
+                                    cfg.norm_eps),
+                    tok_heads(tok_bank, h))
+
+        def packed_seq_fn(trunk_params, bank, ids, mask, pos, seg,
+                          seg_row, seg_start):
+            h = hidden_fn(trunk_params, ids, mask, pos, seg)
+            return apply_head_bank(bank, ppool(h, seg, seg_row,
+                                               seg_start),
+                                   act, cfg.norm_eps)
+
+        def packed_tok_fn(trunk_params, tok_bank, ids, mask, pos, seg):
+            return tok_heads(tok_bank,
+                             hidden_fn(trunk_params, ids, mask, pos, seg))
+
+        def packed_both_fn(trunk_params, bank, tok_bank, ids, mask, pos,
+                           seg, seg_row, seg_start):
+            h = hidden_fn(trunk_params, ids, mask, pos, seg)
+            return (apply_head_bank(bank, ppool(h, seg, seg_row,
+                                                seg_start),
+                                    act, cfg.norm_eps),
+                    tok_heads(tok_bank, h))
 
         def trunk_pool(trunk_params, ids, mask):
-            hidden = trunk.apply({"params": trunk_params}, ids, mask)
-            return mean_pool(hidden, mask) if use_mean else cls_pool(hidden)
+            return pool(hidden_fn(trunk_params, ids, mask), mask)
 
         def heads(bank, pooled):
             return apply_head_bank(bank, pooled, act, cfg.norm_eps)
@@ -520,13 +643,100 @@ class InferenceEngine:
         # jit() is free until called: sampled batch traces pay the split
         # programs' compiles, untraced traffic never touches them
         g.traced_fns = (jax.jit(trunk_pool), jax.jit(heads))
-        return jax.jit(fused)
+        g.fns = {
+            "seq": jax.jit(seq_fn),
+            "tok": jax.jit(tok_fn),
+            "both": jax.jit(both_fn),
+            "packed_seq": jax.jit(packed_seq_fn),
+            "packed_tok": jax.jit(packed_tok_fn),
+            "packed_both": jax.jit(packed_both_fn),
+        }
+        return g.fns["seq"]
 
     def trunk_group_info(self) -> Dict[str, List[str]]:
         """gid → member task names (management API / tests)."""
         with self._lock:
             return {g.gid: list(g.members)
                     for g in self._groups_by_gid.values()}
+
+    # -- sequence packing (engine.packing, docs/PACKING.md) ----------------
+
+    def _packing_bucket_of(self, key: Hashable) -> Optional[int]:
+        """The packing scheduler's eligibility callback: the row length
+        for groups the fused runner can PACK, else None (the composer
+        then keeps base fixed-batch behavior, so a step can never carry
+        more items than the unpacked path could serve).  Packable =
+        fused trunk group, dense attention, no serving mesh (sharded
+        packed gathers are the ROADMAP follow-on), bucket not demoted by
+        the auto-tuner."""
+        if not (isinstance(key, tuple) and len(key) == 3
+                and key[0] == TRUNK_KEY):
+            return None
+        if self.mesh is not None:
+            return None
+        g = getattr(self, "_groups_by_gid", {}).get(key[1])
+        if g is None or getattr(g.config, "attention_impl",
+                                "dense") != "dense":
+            return None
+        tuner = getattr(self, "_autotuner", None)
+        if tuner is not None and tuner.blocked(f"trunk:{key[1]}",
+                                               key[2]):
+            return None
+        return int(key[2])
+
+    def _packing_segment_cap_of(self, key: Hashable) -> int:
+        """Per-group segment cap, tuner policy over the config default —
+        the ONE value the scheduler's take AND the runner's pack both
+        use, so a planned step always re-plans identically."""
+        base = self._packing["max_segments_per_row"]
+        tuner = getattr(self, "_autotuner", None)
+        if tuner is None or not (isinstance(key, tuple)
+                                 and len(key) == 3):
+            return base
+        pol = tuner.policy(f"trunk:{key[1]}")
+        try:
+            return max(1, int(pol.get("max_segments_per_row", base)))
+        except (TypeError, ValueError):
+            return base
+
+    def configure_packing(self, knobs: Optional[Dict[str, Any]]) -> None:
+        """Apply the engine.packing block (boot + config hot reload):
+        normalizes through the ONE interpretation point and retunes the
+        live scheduler + auto-tuner in place — no batcher swap, no
+        pending-item loss."""
+        pk = normalize_packing(knobs)
+        self._packing = pk
+        if isinstance(self.batcher, PackingBatcher):
+            self.batcher.configure(pk)
+        tuner = self._autotuner
+        if tuner is not None:
+            at = pk["autotune"]
+            tuner.target_fill = at["target_fill"]
+            tuner.min_samples = at["min_samples"]
+            tuner.max_segments_cap = at["max_segments_cap"]
+            tuner.interval_s = max(0.5, at["interval_s"])
+            # per-group caps grow from the (possibly re-tuned) config
+            # default, not a stale boot-time floor
+            tuner.segments_floor = pk["max_segments_per_row"]
+
+    def packing_report(self) -> Dict[str, Any]:
+        """Operator snapshot (GET /debug/runtime rides this via the
+        engine owner): live knobs, scheduler state, auto-tuner policy."""
+        out: Dict[str, Any] = {"knobs": {
+            k: (dict(v) if isinstance(v, dict) else v)
+            for k, v in self._packing.items()}}
+        b = self.batcher
+        if isinstance(b, PackingBatcher):
+            out["scheduler"] = {
+                "enabled": b.enabled,
+                "max_segments_per_row": b.max_segments_per_row,
+                "max_items_per_step": b._item_budget(),
+                "max_inflight_steps": b.max_inflight_steps,
+                "starvation_steps": b.starvation_steps,
+            }
+        if self._autotuner is not None:
+            out["autotuner"] = self._autotuner.report()
+        return out
 
     def _common_trunk_group(self, tasks: Sequence[str]
                             ) -> Optional[TrunkGroup]:
@@ -553,6 +763,11 @@ class InferenceEngine:
         consumed from the memo, so it degrades, never breaks."""
         tasks = list(tasks)
         if not tasks:
+            return False
+        # the prefetch fan-out is classify_multi, which is sequence-only;
+        # token trunk-group members coalesce through their own
+        # token_classify submits instead
+        if any(self.task_kind(t) != "sequence" for t in tasks):
             return False
         if self._common_trunk_group(tasks) is not None:
             return True
@@ -1032,9 +1247,20 @@ class InferenceEngine:
         t = self._require(task, kind="token")
         enc, tok_s, cached = self._encode_info(t, text, enc_cache)
         bucket = pick_bucket(len(enc), self.cfg.seq_len_buckets)
-        fut = self.batcher.submit((task, bucket),
-                                  _Payload(text, enc, threshold,
-                                           tok_s=tok_s, tok_cached=cached))
+        g = self._task_group.get(task)
+        if g is not None:
+            # fused token member: batch under the TRUNK — one trunk
+            # forward serves concurrent sequence AND token siblings,
+            # and the packed path covers token spans too
+            fut = self.batcher.submit(
+                (TRUNK_KEY, g.gid, bucket),
+                _Payload(text, enc, threshold, tasks=(task,),
+                         tok_s=tok_s, tok_cached=cached))
+        else:
+            fut = self.batcher.submit(
+                (task, bucket),
+                _Payload(text, enc, threshold,
+                         tok_s=tok_s, tok_cached=cached))
         return fut.result(timeout=timeout)
 
     def embed(self, task: str, texts: Sequence[str],
@@ -1109,7 +1335,11 @@ class InferenceEngine:
                     pass
         # fused trunk groups compile their OWN programs (trunk + stacked
         # heads): warm those the same way — one cold fused bucket would
-        # stall the whole bank's traffic, not one task's
+        # stall the whole bank's traffic, not one task's.  Every flavor
+        # the group can serve warms: seq AND tok/both (token members),
+        # AND the packed siblings when packing is enabled — a cold
+        # packed program would compile inline on the dispatch worker,
+        # the exact stall this warmup exists to prevent.
         for g in list(self._groups_by_gid.values()):
             if tasks and not any(m in tasks for m in g.members):
                 continue
@@ -1122,10 +1352,19 @@ class InferenceEngine:
                     ids[:, 0] = 1
                     mask = np.ones((padded_n, b), np.int32)
                     ids_dev, mask_dev = self._to_device(ids, mask)
-                    out = g.apply_fn(g.trunk_params, g.bank,
-                                     ids_dev, mask_dev)
-                    jax.block_until_ready(out)
-                    if g.traced_fns is not None:
+                    if g.bank is not None:
+                        jax.block_until_ready(g.fns["seq"](
+                            g.trunk_params, g.bank, ids_dev, mask_dev))
+                    if g.tok_bank is not None:
+                        jax.block_until_ready(g.fns["tok"](
+                            g.trunk_params, g.tok_bank, ids_dev,
+                            mask_dev))
+                        if g.bank is not None:
+                            out = g.fns["both"](g.trunk_params, g.bank,
+                                                g.tok_bank, ids_dev,
+                                                mask_dev)
+                            jax.block_until_ready(out)
+                    if g.traced_fns is not None and g.bank is not None:
                         # the split batch-trace programs (batchtrace
                         # stage fencing) compile on the first SAMPLED
                         # batch of a shape — warm them here too, or that
@@ -1138,6 +1377,58 @@ class InferenceEngine:
                         jax.block_until_ready(head_fn(g.bank, pooled))
                 except Exception:
                     pass
+                self._warm_packed(g, b)
+
+    def _warm_packed(self, g: TrunkGroup, bucket: int) -> None:
+        """Pre-compile the hot packed programs for one (group, bucket):
+        a 1-row, 2-segment packed batch per flavor.  Other (rows, K)
+        shapes still compile on demand — each is one more program, but
+        this covers the min_segments entry shape every packed bucket
+        hits first."""
+        if not self._packing["enabled"] or self.mesh is not None \
+                or g.fns is None \
+                or getattr(g.config, "attention_impl",
+                           "dense") != "dense":
+            return
+        try:
+            class _WarmEnc:
+                """Minimal Encoding shim so warmup builds its packed
+                batch through pack_items — ONE layout implementation,
+                the warm program traces exactly what real packed steps
+                will."""
+
+                def __init__(self, n: int) -> None:
+                    self.ids = np.ones(n, np.int32)
+                    self.attention_mask = np.ones(n, np.int32)
+
+                def __len__(self) -> int:
+                    return len(self.ids)
+
+            half = max(1, bucket // 2)
+            pb = pack_items(
+                [_WarmEnc(half), _WarmEnc(bucket - half)], bucket,
+                g.pad_id, max_rows=1, max_segments_per_row=2,
+                pad_rows_to=self._padded_batch(1), pad_segments_to=2)
+            ids_dev, mask_dev = self._to_device(pb.ids, pb.mask)
+            pos_dev = jnp.asarray(pb.position_ids)
+            seg_dev = jnp.asarray(pb.segment_ids)
+            row_dev = jnp.asarray(pb.seg_row)
+            start_dev = jnp.asarray(pb.seg_start)
+            if g.bank is not None:
+                jax.block_until_ready(g.fns["packed_seq"](
+                    g.trunk_params, g.bank, ids_dev, mask_dev,
+                    pos_dev, seg_dev, row_dev, start_dev))
+            if g.tok_bank is not None:
+                jax.block_until_ready(g.fns["packed_tok"](
+                    g.trunk_params, g.tok_bank, ids_dev, mask_dev,
+                    pos_dev, seg_dev))
+                if g.bank is not None:
+                    out = g.fns["packed_both"](
+                        g.trunk_params, g.bank, g.tok_bank, ids_dev,
+                        mask_dev, pos_dev, seg_dev, row_dev, start_dev)
+                    jax.block_until_ready(out)
+        except Exception:
+            pass
 
     def _matryoshka_variants(self):
         """(exit_layer, output_dim) pairs to pre-compile: the full model
@@ -1158,6 +1449,8 @@ class InferenceEngine:
                 self.batcher.name, self._rs_provider_fn)
         except Exception:
             pass
+        if self._autotuner is not None:
+            self._autotuner.stop()
         self.batcher.shutdown()
         pool = getattr(self, "_stacked_pool", None)
         if pool is not None:
@@ -1221,13 +1514,17 @@ class InferenceEngine:
 
     def _record_step(self, group: str, bucket: int, variant: str,
                      rows: int, padded_rows: int, seconds: float,
-                     compiled: bool) -> None:
+                     compiled: bool, tokens_real: int = 0,
+                     tokens_padded: int = 0, segments: int = 0) -> None:
         """One always-on step sample (observability.runtimestats): a
-        bounded deque append on the hot path; never raises."""
+        bounded deque append on the hot path; never raises.  Fused and
+        packed steps additionally carry token-level fill + segment
+        counts — the series the packing auto-tuner consumes."""
         try:
             self._runtime_stats.record_step(
                 group, bucket, variant, rows, padded_rows, seconds,
-                compiled=compiled)
+                compiled=compiled, tokens_real=tokens_real,
+                tokens_padded=tokens_padded, segments=segments)
         except Exception:
             pass
 
@@ -1468,16 +1765,18 @@ class InferenceEngine:
 
     def _run_fused_batch(self, gid: str, bucket: int,
                          items: List[BatchItem]) -> Sequence[Any]:
-        """One trunk forward for a batch MIXING member tasks: stack the
-        sequences, run trunk + every stacked head
-        (models.lora.apply_head_bank), then demux each item's (row, task)
-        logits against the task's own label set — decode semantics
-        identical to the traditional path."""
+        """One trunk forward for a batch MIXING member tasks — sequence
+        and token heads alike: dedup identical encodings, decide packed
+        vs unpacked composition (engine.packing), execute the matching
+        fused program, then demux each item's (row/segment, task) logits
+        against the task's own label set — decode semantics identical to
+        the traditional path."""
         g = self._groups_by_gid[gid]
-        # ONE consistent (bank, rows, widths) view for this whole batch:
-        # a concurrent re-registration swaps g.demux atomically and can
-        # never pair new row indices with this batch's logits ordering
-        bank, row_of, widths = g.demux
+        # ONE consistent demux view (banks + row maps + widths) for this
+        # whole batch: a concurrent re-registration swaps g.demux
+        # atomically and can never pair new row indices with this
+        # batch's logits ordering
+        demux = g.demux
         n = len(items)
         # identical token sequences within the batch ride a SINGLE
         # trunk row (the trunk output depends only on ids+mask; per-item
@@ -1510,7 +1809,113 @@ class InferenceEngine:
         n_rows = len(uniq_items)
         if n_rows < n:
             self._series().fused_dedup_rows.inc(n - n_rows)
+
+        # which head banks this batch actually needs: a batch with no
+        # token items never pays the per-token head matmul
+        kinds = {self._tasks[t].kind for item in items
+                 for t in item.payload.tasks if t in self._tasks}
+        need_tok = "token" in kinds
+        need_seq = "sequence" in kinds or not need_tok
+        flavor = "both" if (need_tok and need_seq) \
+            else ("tok" if need_tok else "seq")
+
+        # packed vs unpacked composition (engine.packing): pack when the
+        # plan strictly reduces padded device rows (or the continuous
+        # scheduler over-took on the promise of packing); 1-unique-row
+        # batches — including the fused-dedup hot-prompt case — stay on
+        # the unpacked path bit-identically
+        pk = self._packing
+        packable = (pk["enabled"] and self.mesh is None
+                    and g.fns is not None
+                    and getattr(g.config, "attention_impl",
+                                "dense") == "dense")
+        use_packed = False
+        plan_rows = 0
+        tuner = self._autotuner
+        # the same per-group cap the scheduler's take planned with
+        max_segs = self._packing_segment_cap_of((TRUNK_KEY, gid, bucket))
+        if packable and n_rows >= pk["min_segments"]:
+            blocked = tuner is not None and \
+                tuner.blocked(f"trunk:{gid}", bucket)
+            must_pack = n_rows > self.cfg.max_batch_size
+            if must_pack or not blocked:
+                plan = RowPlan(bucket, self.cfg.max_batch_size, max_segs)
+                fits = all(
+                    plan.add(min(len(it.payload.encoding), bucket))
+                    is not None for it in uniq_items)
+                if fits:
+                    packed_padded = self._padded_batch(plan.rows_used)
+                    unpacked_padded = self._padded_batch(
+                        min(n_rows, self.cfg.max_batch_size))
+                    if must_pack or packed_padded < unpacked_padded:
+                        use_packed = True
+                        plan_rows = plan.rows_used
+        if not use_packed and n_rows > self.cfg.max_batch_size:
+            # the scheduler over-took but the plan no longer fits (a
+            # hot-reload raced the knobs down): serve in halves —
+            # correctness over one perfect step
+            mid = max(1, n // 2)
+            return (list(self._run_fused_batch(gid, bucket, items[:mid]))
+                    + list(self._run_fused_batch(gid, bucket,
+                                                 items[mid:])))
+        if use_packed:
+            return self._run_fused_packed(g, gid, bucket, items, urow,
+                                          uniq_items, demux, flavor,
+                                          max_segs, plan_rows)
+        return self._run_fused_unpacked(g, gid, bucket, items, urow,
+                                        uniq_items, demux, flavor)
+
+    # -- fused demux helpers -----------------------------------------------
+
+    def _demux_seq(self, task: str, p: np.ndarray, latency_s: float,
+                   truncated: bool) -> ClassResult:
+        """Decode one item's sequence logits (already softmaxed over the
+        task's true width) with ITS label set — identical semantics to
+        the traditional path's width-tolerant decode."""
+        idx = int(p.argmax())
+        labels = self._tasks[task].labels
+        return ClassResult(
+            label=labels[idx] if idx < len(labels) else str(idx),
+            index=idx,
+            confidence=float(p[idx]),
+            probs={(labels[j] if j < len(labels) else str(j)):
+                   float(p[j]) for j in range(p.shape[-1])},
+            latency_s=latency_s,
+            truncated=truncated,
+        )
+
+    def _demux_tok(self, task: str, tok_probs: np.ndarray, item,
+                   enc: Encoding, L: int, latency_s: float,
+                   truncated: bool) -> TokenClassResult:
+        """Decode one item's per-token logits → entity spans with exact
+        char offsets, same contract as the traditional token branch."""
+        t = self._tasks[task]
+        pred = tok_probs.argmax(-1)
+        labels = [t.labels[j] if j < len(t.labels) else str(j)
+                  for j in pred]
+        scores = [float(tok_probs[k, j]) for k, j in enumerate(pred)]
+        spans = decode_entity_spans(
+            item.payload.text, enc.offsets[:L], labels, scores,
+            threshold=item.payload.threshold)
+        return TokenClassResult(
+            entities=[EntitySpan(**s) for s in spans],
+            latency_s=latency_s,
+            truncated=truncated,
+        )
+
+    def _fused_result(self, item, per_task: Dict[str, Any]):
+        return per_task[item.payload.tasks[0]] \
+            if len(item.payload.tasks) == 1 else per_task
+
+    def _run_fused_unpacked(self, g: TrunkGroup, gid: str, bucket: int,
+                            items: List[BatchItem], urow: List[int],
+                            uniq_items: List[BatchItem], demux: dict,
+                            flavor: str) -> Sequence[Any]:
+        """The fixed-row fused path: one trunk row per unique encoding,
+        padded to the bucket edge — exactly the pre-packing behavior."""
+        n_rows = len(uniq_items)
         padded_n = self._padded_batch(n_rows)
+        bank, tok_bank = demux["bank"], demux["tok_bank"]
 
         from ..observability import batchtrace
         from ..observability.profiler import trace_span
@@ -1529,7 +1934,7 @@ class InferenceEngine:
             kind="fused")
         try:
             detailed = step is not None and step.detailed \
-                and g.traced_fns is not None
+                and g.traced_fns is not None and flavor == "seq"
             with batchtrace.stage(step, "stack"):
                 ids, mask, clipped = self._stack_items(uniq_items,
                                                        bucket,
@@ -1541,17 +1946,15 @@ class InferenceEngine:
                 ids_dev, mask_dev = self._to_device(ids, mask)
             self._note_shape(f"trunk:{gid}", (padded_n, bucket))
             variant = "fused_detailed" if detailed else "fused"
-            fresh = self._step_fresh(f"trunk:{gid}", variant,
+            fresh = self._step_fresh(f"trunk:{gid}",
+                                     f"{variant}:{flavor}",
                                      (padded_n, bucket))
+            tokens_real = sum(min(len(it.payload.encoding), bucket)
+                              for it in uniq_items)
+            seq_logits = tok_logits = None
             fwd_t0 = time.perf_counter()
             with trace_span(f"engine.classify.fused.{gid}"):
-                if not detailed:
-                    # the default hot path: one fused program, no fences
-                    # (non-detailed traced batches still get step + ride
-                    # continuity spans from finish())
-                    logits = g.apply_fn(g.trunk_params, bank, ids_dev,
-                                        mask_dev)
-                else:
+                if detailed:
                     # sampled: the SAME math split in two fenced programs
                     # so trunk vs head time attribute separately
                     trunk_fn, head_fn = g.traced_fns
@@ -1560,17 +1963,37 @@ class InferenceEngine:
                                           mask_dev)
                         step.fence(pooled)
                     with step.stage("head_matmul"):
-                        logits = head_fn(bank, pooled)
-                        step.fence(logits)
-                logits = np.asarray(jax.device_get(logits),
-                                    dtype=np.float32)
+                        seq_logits = head_fn(bank, pooled)
+                        step.fence(seq_logits)
+                elif flavor == "seq":
+                    # the default hot path: one fused program, no fences
+                    # (non-detailed traced batches still get step + ride
+                    # continuity spans from finish())
+                    seq_logits = g.fns["seq"](g.trunk_params, bank,
+                                              ids_dev, mask_dev)
+                elif flavor == "tok":
+                    tok_logits = g.fns["tok"](g.trunk_params, tok_bank,
+                                              ids_dev, mask_dev)
+                else:
+                    seq_logits, tok_logits = g.fns["both"](
+                        g.trunk_params, bank, tok_bank, ids_dev,
+                        mask_dev)
+                if seq_logits is not None:
+                    seq_logits = np.asarray(jax.device_get(seq_logits),
+                                            dtype=np.float32)
+                if tok_logits is not None:
+                    tok_logits = np.asarray(jax.device_get(tok_logits),
+                                            dtype=np.float32)
             # detailed (sampled-trace) batches ran the fenced split
             # programs — slower by construction — so they get their own
             # variant key instead of polluting the warm-execute EWMA the
-            # dashboards (and the planned path-chooser cost model) read
+            # dashboards (and the path-chooser cost model) read
             self._record_step(f"trunk:{gid}", bucket, variant,
                               n_rows, padded_n,
-                              time.perf_counter() - fwd_t0, fresh)
+                              time.perf_counter() - fwd_t0, fresh,
+                              tokens_real=tokens_real,
+                              tokens_padded=padded_n * bucket,
+                              segments=n_rows)
             self._series().trunk_forwards.inc(group=gid, path="fused")
 
             demux_cm = batchtrace.stage(step, "demux")
@@ -1579,30 +2002,155 @@ class InferenceEngine:
             with demux_cm:
                 for i, item in enumerate(items):
                     enc = item.payload.encoding
-                    per_task: Dict[str, ClassResult] = {}
+                    L = min(len(enc), bucket)
+                    latency = now - item.payload.submit_t
+                    trunc = enc.truncated or clipped[urow[i]]
+                    per_task: Dict[str, Any] = {}
                     for task in item.payload.tasks:
-                        row = row_of[task]
-                        width = widths[row]
-                        # fan the shared trunk row's logits out to
-                        # every duplicate item at demux
-                        p = _softmax(
-                            logits[urow[i], row, :width][None, :])[0]
-                        idx = int(p.argmax())
-                        labels = self._tasks[task].labels
-                        per_task[task] = ClassResult(
-                            label=labels[idx] if idx < len(labels)
-                            else str(idx),
-                            index=idx,
-                            confidence=float(p[idx]),
-                            probs={(labels[j] if j < len(labels)
-                                    else str(j)):
-                                   float(p[j]) for j in range(width)},
-                            latency_s=now - item.payload.submit_t,
-                            truncated=enc.truncated or clipped[urow[i]],
-                        )
-                    out.append(per_task[item.payload.tasks[0]]
-                               if len(item.payload.tasks) == 1
-                               else per_task)
+                        if self._tasks[task].kind == "token":
+                            row = demux["tok_row_of"][task]
+                            width = demux["tok_widths"][row]
+                            probs = _softmax(
+                                tok_logits[urow[i], :L, row, :width])
+                            per_task[task] = self._demux_tok(
+                                task, probs, item, enc, L, latency,
+                                trunc)
+                        else:
+                            row = demux["row_of"][task]
+                            width = demux["widths"][row]
+                            # fan the shared trunk row's logits out to
+                            # every duplicate item at demux
+                            p = _softmax(
+                                seq_logits[urow[i], row,
+                                           :width][None, :])[0]
+                            per_task[task] = self._demux_seq(
+                                task, p, latency, trunc)
+                    out.append(self._fused_result(item, per_task))
+            return out
+        finally:
+            if step is not None:
+                step.finish()
+
+    def _run_fused_packed(self, g: TrunkGroup, gid: str, bucket: int,
+                          items: List[BatchItem], urow: List[int],
+                          uniq_items: List[BatchItem], demux: dict,
+                          flavor: str, max_segs: int,
+                          plan_rows: int) -> Sequence[Any]:
+        """The sequence-packed fused path (docs/PACKING.md): unique
+        encodings bin-pack into shared rows under a block-diagonal
+        attention mask with per-segment RoPE positions; sequence heads
+        pool PER SEGMENT, token heads demux each segment's span of the
+        per-token logits.  Logit parity with the unpacked path is the
+        golden gate (tests/test_packing.py, ≤1e-4)."""
+        n_rows = len(uniq_items)
+        padded_rows = self._padded_batch(plan_rows)
+        # the segment axis pads to a power of two — K_pad joins the
+        # closed static-shape set like the row axis does
+        k_pad = 1 << max(0, n_rows - 1).bit_length()
+        bank, tok_bank = demux["bank"], demux["tok_bank"]
+
+        from ..observability import batchtrace
+        from ..observability.profiler import trace_span
+
+        step = batchtrace.start_step(
+            items, group=f"trunk:{gid}", bucket=bucket,
+            max_batch=self.cfg.max_batch_size, padded_rows=padded_rows,
+            kind="fused")
+        try:
+            with batchtrace.stage(step, "stack"):
+                pb = pack_items(
+                    [it.payload.encoding for it in uniq_items], bucket,
+                    g.pad_id, max_rows=self.cfg.max_batch_size,
+                    max_segments_per_row=max_segs,
+                    pad_rows_to=padded_rows, pad_segments_to=k_pad)
+                clipped = [s.clipped for s in pb.segments]
+                for i, item in enumerate(items):
+                    if clipped[urow[i]]:
+                        for task in item.payload.tasks:
+                            self._series().bucket_overflows.inc(task=task)
+                ids_dev, mask_dev = self._to_device(pb.ids, pb.mask)
+                pos_dev = jnp.asarray(pb.position_ids)
+                seg_dev = jnp.asarray(pb.segment_ids)
+                seg_row = jnp.asarray(pb.seg_row)
+                seg_start = jnp.asarray(pb.seg_start)
+            if step is not None:
+                # packed-step span attributes: the trace shows HOW
+                # packed this step ran, next to the existing batch
+                # size/fill attributes
+                step.attrs["packing.packed"] = True
+                step.attrs["packing.segments"] = n_rows
+                step.attrs["packing.rows"] = pb.rows_used
+                step.attrs["packing.token_fill"] = round(
+                    pb.tokens_real / max(1, padded_rows * bucket), 4)
+            self._note_shape(f"trunk:{gid}", (padded_rows, bucket))
+            # the K (segment) axis is its own static program dimension:
+            # compile detection keys on it so a fresh K over a warm row
+            # shape still counts as the compile it is
+            fresh = self._step_fresh(f"trunk:{gid}",
+                                     f"packed:{flavor}:{k_pad}",
+                                     (padded_rows, bucket))
+            seq_logits = tok_logits = None
+            fwd_t0 = time.perf_counter()
+            with trace_span(f"engine.classify.packed.{gid}"):
+                if flavor == "seq":
+                    seq_logits = g.fns["packed_seq"](
+                        g.trunk_params, bank, ids_dev, mask_dev,
+                        pos_dev, seg_dev, seg_row, seg_start)
+                elif flavor == "tok":
+                    tok_logits = g.fns["packed_tok"](
+                        g.trunk_params, tok_bank, ids_dev, mask_dev,
+                        pos_dev, seg_dev)
+                else:
+                    seq_logits, tok_logits = g.fns["packed_both"](
+                        g.trunk_params, bank, tok_bank, ids_dev,
+                        mask_dev, pos_dev, seg_dev, seg_row, seg_start)
+                if seq_logits is not None:
+                    seq_logits = np.asarray(jax.device_get(seq_logits),
+                                            dtype=np.float32)
+                if tok_logits is not None:
+                    tok_logits = np.asarray(jax.device_get(tok_logits),
+                                            dtype=np.float32)
+            self._record_step(f"trunk:{gid}", bucket, "packed",
+                              pb.rows_used, padded_rows,
+                              time.perf_counter() - fwd_t0, fresh,
+                              tokens_real=pb.tokens_real,
+                              tokens_padded=padded_rows * bucket,
+                              segments=n_rows)
+            # a packed step IS a fused trunk forward (dashboards sum
+            # path="fused" for bank coalescing); packing visibility has
+            # its own counter + the runtimestats "packed" variant
+            self._series().trunk_forwards.inc(group=gid, path="fused")
+            self._series().packed_steps.inc(group=gid)
+
+            demux_cm = batchtrace.stage(step, "demux")
+            now = time.perf_counter()
+            out: List[Any] = []
+            with demux_cm:
+                for i, item in enumerate(items):
+                    enc = item.payload.encoding
+                    seg = pb.segments[urow[i]]
+                    latency = now - item.payload.submit_t
+                    trunc = enc.truncated or seg.clipped
+                    per_task: Dict[str, Any] = {}
+                    for task in item.payload.tasks:
+                        if self._tasks[task].kind == "token":
+                            row = demux["tok_row_of"][task]
+                            width = demux["tok_widths"][row]
+                            sl = slice(seg.start, seg.start + seg.length)
+                            probs = _softmax(
+                                tok_logits[seg.row, sl, row, :width])
+                            per_task[task] = self._demux_tok(
+                                task, probs, item, enc, seg.length,
+                                latency, trunc)
+                        else:
+                            row = demux["row_of"][task]
+                            width = demux["widths"][row]
+                            p = _softmax(
+                                seq_logits[urow[i], row,
+                                           :width][None, :])[0]
+                            per_task[task] = self._demux_seq(
+                                task, p, latency, trunc)
+                    out.append(self._fused_result(item, per_task))
             return out
         finally:
             if step is not None:

@@ -93,6 +93,7 @@ SHARED_TRUNK_TASKS = [
 def make_shared_trunk_engine(
     tasks: Optional[Sequence[tuple]] = None,
     lora_tasks: Sequence[str] = (),
+    token_tasks: Optional[Sequence[tuple]] = None,
     engine_cfg: Optional[InferenceEngineConfig] = None,
     seed: int = 0,
     fuse: Optional[bool] = None,
@@ -109,6 +110,9 @@ def make_shared_trunk_engine(
     ``lora_tasks``: member names built as ModernBertLoRAHeadClassifier
     (head-LoRA) instead of the plain head, with non-zero adapters — the
     LoRA / non-LoRA mixed-batch shape.
+    ``token_tasks``: iterable of (name, labels) built as
+    ModernBertForTokenClassification over the SAME trunk — the fused
+    token-head shape (PII spans sharing the trunk forward).
     ``fuse``: forwarded to register_task (None → engine config default).
     """
     import flax
@@ -125,16 +129,21 @@ def make_shared_trunk_engine(
     key = jax.random.PRNGKey(seed)
     dummy = jnp.ones((1, 8), jnp.int32)
     trunk_params = None
-    for i, (name, labels) in enumerate(tasks):
+    specs = [(name, "sequence", labels) for name, labels in tasks]
+    specs += [(name, "token", labels)
+              for name, labels in (token_tasks or [])]
+    for i, (name, kind, labels) in enumerate(specs):
         mcfg = tiny_config(len(labels))
-        if name in lora_tasks:
+        if kind == "token":
+            module = ModernBertForTokenClassification(mcfg)
+        elif name in lora_tasks:
             module = ModernBertLoRAHeadClassifier(
                 mcfg, LoRAConfig(rank=4, alpha=8.0), len(labels))
         else:
             module = ModernBertForSequenceClassification(mcfg)
         params = flax.core.unfreeze(
             module.init(jax.random.fold_in(key, i), dummy))
-        if name in lora_tasks:
+        if kind != "token" and name in lora_tasks:
             # lora_B inits to zeros (exact no-op delta) — give the test
             # adapters real weight so the fused path provably applies them
             shape = params["params"]["lora_B"].shape
@@ -146,7 +155,7 @@ def make_shared_trunk_engine(
             # the splice that makes the trunk SHARED: same arrays, so the
             # engine's identity fingerprint groups every task
             params["params"]["model"] = trunk_params
-        engine.register_task(name, "sequence", module, params, tok,
+        engine.register_task(name, kind, module, params, tok,
                              labels, max_seq_len=512, fuse=fuse)
     return engine
 

@@ -133,9 +133,15 @@ def head_bank_entry(module, params) -> Optional[Dict[str, Any]]:
     """Extract the stackable prediction head of a bank-fusable classifier.
 
     Returns host-side arrays {dense_kernel, dense_bias?, lora_A?, lora_B?,
-    scale, norm_scale, norm_bias?, cls_kernel, cls_bias, num_labels}, or
-    None when the module is not fusable (unknown architecture) — the
-    engine then keeps the task on its traditional per-task path."""
+    scale, norm_scale, norm_bias?, cls_kernel, cls_bias, kind}, or None
+    when the module is not fusable (unknown architecture) — the engine
+    then keeps the task on its traditional per-task path.  ``kind``
+    ("sequence" | "token") tells the engine which bank the head stacks
+    into: token heads (PII / hallucination spans) run the same head math
+    per TOKEN instead of per pooled row, sharing the trunk forward with
+    their sequence siblings (docs/FUSED_BANK.md)."""
+    from .modernbert import ModernBertForTokenClassification
+
     p = params.get("params", params)
     try:
         if isinstance(module, ModernBertLoRAHeadClassifier):
@@ -149,8 +155,10 @@ def head_bank_entry(module, params) -> Optional[Dict[str, Any]]:
                 "norm_bias": p["head_norm"].get("bias"),
                 "cls_kernel": p["classifier"]["kernel"],
                 "cls_bias": p["classifier"]["bias"],
+                "kind": "sequence",
             }
-        if isinstance(module, ModernBertForSequenceClassification):
+        if isinstance(module, (ModernBertForSequenceClassification,
+                               ModernBertForTokenClassification)):
             head, cls = p["head"], p["classifier"]
             return {
                 "dense_kernel": head["dense"]["kernel"],
@@ -162,6 +170,9 @@ def head_bank_entry(module, params) -> Optional[Dict[str, Any]]:
                 "norm_bias": head["norm"].get("bias"),
                 "cls_kernel": cls["kernel"],
                 "cls_bias": cls["bias"],
+                "kind": "token"
+                if isinstance(module, ModernBertForTokenClassification)
+                else "sequence",
             }
     except (KeyError, TypeError):
         return None

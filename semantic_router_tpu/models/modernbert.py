@@ -35,9 +35,11 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import (
+    block_diagonal_bias,
     chunked_sdpa,
     cls_pool,
     mean_pool,
+    packed_window_bias,
     padding_bias,
     sdpa,
     sliding_window_bias,
@@ -192,7 +194,9 @@ class ModernBertAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, attention_mask: jnp.ndarray,
-                 task_index: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 task_index: Optional[jnp.ndarray] = None,
+                 position_ids: Optional[jnp.ndarray] = None,
+                 segment_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         cfg = self.config
         dense = _make_dense(self, cfg, task_index)
         B, S, _ = x.shape
@@ -212,7 +216,28 @@ class ModernBertAttention(nn.Module):
             spec = RopeSpec(D, theta, yarn=None)
             window = cfg.local_attention
         cos, sin = spec.tables(S)
+        if position_ids is not None:
+            # sequence packing: RoPE by SEGMENT-LOCAL position, not row
+            # index — gather the same float32 tables by position id so a
+            # packed segment rotates bit-identically to itself unpacked
+            cos = jnp.asarray(cos)[position_ids][:, None]  # [B, 1, S, D]
+            sin = jnp.asarray(sin)[position_ids][:, None]
         q, k = apply_rotary(q, k, cos, sin)
+
+        if segment_ids is not None:
+            # packed rows: block-diagonal attention (each segment attends
+            # only to itself) + window on segment-local positions — only
+            # the dense path carries packing (the engine gates on it)
+            if cfg.attention_impl != "dense":
+                raise ValueError(
+                    f"sequence packing requires attention_impl='dense' "
+                    f"(got {cfg.attention_impl!r})")
+            bias = block_diagonal_bias(segment_ids)
+            if window > 0:
+                bias = bias + packed_window_bias(position_ids, window)
+            out = sdpa(q, k, v, bias=bias)
+            out = jnp.moveaxis(out, 1, 2).reshape(B, S, cfg.hidden_size)
+            return dense(cfg.hidden_size, cfg.attention_bias, "Wo")(out)
 
         if cfg.attention_impl == "flash":
             from ..ops.flash_attention import flash_attention
@@ -261,7 +286,9 @@ class ModernBertEncoderLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, attention_mask: jnp.ndarray,
-                 task_index: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 task_index: Optional[jnp.ndarray] = None,
+                 position_ids: Optional[jnp.ndarray] = None,
+                 segment_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         cfg = self.config
         if self.layer_id == 0:
             attn_in = x  # identity: embedding norm already applied
@@ -271,7 +298,7 @@ class ModernBertEncoderLayer(nn.Module):
                                    dtype=cfg.dtype)(x)
         x = x + ModernBertAttention(cfg, self.layer_id, name="attn",
                                     dense_factory=self.dense_factory)(
-            attn_in, attention_mask, task_index)
+            attn_in, attention_mask, task_index, position_ids, segment_ids)
         mlp_in = nn.LayerNorm(epsilon=cfg.norm_eps, use_bias=cfg.norm_bias,
                               name="mlp_norm", dtype=cfg.dtype)(x)
         return x + ModernBertMLP(cfg, name="mlp",
@@ -292,7 +319,14 @@ class ModernBertModel(nn.Module):
     def __call__(self, input_ids: jnp.ndarray,
                  attention_mask: Optional[jnp.ndarray] = None,
                  exit_layer: Optional[int] = None,
-                 task_index: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 task_index: Optional[jnp.ndarray] = None,
+                 position_ids: Optional[jnp.ndarray] = None,
+                 segment_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        """``position_ids``/``segment_ids`` select the sequence-packed
+        path (engine.packing): multiple prompts share each row under a
+        block-diagonal attention mask with per-segment RoPE positions —
+        numerically each segment computes exactly what it would alone in
+        a padded row (docs/PACKING.md is the contract)."""
         cfg = self.config
         if attention_mask is None:
             attention_mask = jnp.ones_like(input_ids)
@@ -304,7 +338,7 @@ class ModernBertModel(nn.Module):
                 break  # Matryoshka layer early-exit (static under jit)
             x = ModernBertEncoderLayer(cfg, i, name=f"layers_{i}",
                                        dense_factory=self.dense_factory)(
-                x, attention_mask, task_index)
+                x, attention_mask, task_index, position_ids, segment_ids)
         return nn.LayerNorm(epsilon=cfg.norm_eps, use_bias=cfg.norm_bias,
                             name="final_norm", dtype=cfg.dtype)(x)
 

@@ -310,6 +310,9 @@ class DecisionExplainer:
         self.sinks: List[Callable[[Dict[str, Any]], None]] = []
         self.recorded = 0
         self.dropped = 0
+        # annotate() re-deliveries to sinks (post-commit failover_path
+        # stamps re-exporting so the OTLP log line carries them)
+        self.re_exported = 0
         # optional durable backend (observability/explain_store.py):
         # attached by bootstrap from observability.decisions.durable so
         # post-restart audits survive the in-process ring
@@ -400,9 +403,14 @@ class DecisionExplainer:
         """Post-commit annotation of a ringed record (the forward path
         finishes AFTER route() committed the record — failover_path can
         only land here).  Schema-gated: unknown keys are dropped so an
-        annotation can never break validate_record.  The durable mirror
-        re-adds the record (stores upsert by record id), so post-restart
-        audits see the failover too."""
+        annotation can never break validate_record.
+
+        The annotated record RE-DELIVERS to every sink: the commit-time
+        export left (e.g.) the OTLP log line without the failover_path
+        it was annotated with, so export-shaped sinks receive a second
+        delivery of the same record id carrying the annotation
+        (consumers key on record_id — last write wins) and the durable
+        mirror upserts in place.  Counted in ``re_exported``."""
         rec = self.get(key)
         if rec is None:
             return False
@@ -412,10 +420,10 @@ class DecisionExplainer:
             return False
         with self._lock:
             rec.update(clean)
-            store = self.durable_store
-        if store is not None:
+            self.re_exported += 1
+        for sink in list(self.sinks):
             try:
-                store.add(rec)
+                sink(rec)
             except Exception:
                 pass
         return True
@@ -480,7 +488,8 @@ class DecisionExplainer:
                     "ring_size": self.ring_size,
                     "retained": len(self._ring),
                     "recorded": self.recorded,
-                    "dropped": self.dropped}
+                    "dropped": self.dropped,
+                    "re_exported": self.re_exported}
 
     def clear(self) -> None:
         with self._lock:

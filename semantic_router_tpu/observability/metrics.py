@@ -445,6 +445,11 @@ class MetricSeries:
             "llm_engine_fused_dedup_rows_total",
             "Duplicate token sequences collapsed within fused batches "
             "(each saved one trunk row; logits fan out on demux)")
+        self.packed_steps = registry.counter(
+            "llm_engine_packed_steps_total",
+            "Device steps composed from sequence-packed rows "
+            "(engine.packing): several prompts shared each row under a "
+            "block-diagonal mask")
         self.bucket_overflows = registry.counter(
             "llm_batcher_bucket_overflow_total",
             "Inputs longer than the largest seq bucket — clipped at the "
@@ -487,6 +492,7 @@ backend_failovers = default_series.backend_failovers
 trunk_forwards = default_series.trunk_forwards
 tokenizations = default_series.tokenizations
 fused_dedup_rows = default_series.fused_dedup_rows
+packed_steps = default_series.packed_steps
 bucket_overflows = default_series.bucket_overflows
 batcher_queue_wait = default_series.batcher_queue_wait
 batcher_fill_ratio = default_series.batcher_fill_ratio

@@ -68,11 +68,17 @@ class ProgramStats:
     last_execute_s: float = 0.0
     rows_real: int = 0
     rows_padded: int = 0
+    # packed-row accounting (engine.packing): token-level fill — the
+    # row-level ratio above cannot see intra-row padding once several
+    # prompts share a row, so packed steps report the real token counts
+    tokens_real: int = 0
+    tokens_padded: int = 0
+    segments_real: int = 0
 
     def snapshot(self) -> Dict[str, Any]:
         waste = (self.rows_padded - self.rows_real) / self.rows_padded \
             if self.rows_padded else 0.0
-        return {
+        out = {
             "group": self.group, "bucket": self.bucket,
             "variant": self.variant,
             "compiles": self.compiles,
@@ -86,6 +92,15 @@ class ProgramStats:
             "padding_waste_ratio": round(waste, 4),
             "fill_ratio_mean": round(1.0 - waste, 4),
         }
+        if self.tokens_padded:
+            tfill = self.tokens_real / self.tokens_padded
+            out["tokens_real"] = self.tokens_real
+            out["tokens_padded"] = self.tokens_padded
+            out["token_fill_ratio"] = round(tfill, 4)
+            out["token_waste_ratio"] = round(1.0 - tfill, 4)
+        if self.segments_real:
+            out["segments_real"] = self.segments_real
+        return out
 
 
 class RuntimeStats:
@@ -139,6 +154,11 @@ class RuntimeStats:
             "llm_runtime_step_rows_total",
             "Device batch rows by kind: real rows carried requests, "
             "padding rows were shape-bucket waste")
+        self.step_tokens = registry.counter(
+            "llm_runtime_step_tokens_total",
+            "Device batch TOKENS by kind on packing-accounted steps: "
+            "real tokens carried prompts, padding tokens were row waste "
+            "(engine.packing's fill surface)")
         self.rss_bytes = registry.gauge(
             "llm_process_rss_bytes", "Router process resident set size")
         self.threads = registry.gauge(
@@ -161,17 +181,21 @@ class RuntimeStats:
 
     def record_step(self, group: str, bucket: int, variant: str,
                     rows: int, padded_rows: int, seconds: float,
-                    compiled: bool = False) -> None:
+                    compiled: bool = False, tokens_real: int = 0,
+                    tokens_padded: int = 0, segments: int = 0) -> None:
         """One device step, called by the engine's batch runners on the
         untraced hot path: a single bounded deque append (aggregation is
-        deferred to flush())."""
+        deferred to flush()).  Packed steps (engine.packing) additionally
+        carry token-level fill (``tokens_real``/``tokens_padded``) and
+        the segment count — the series the shape auto-tuner consumes."""
         if not self.enabled:
             return
         if len(self._pending) == self._pending.maxlen:
             self._dropped += 1  # bounded: backpressure never blocks serving
         self._pending.append((group, int(bucket), variant, int(rows),
                               int(padded_rows), float(seconds),
-                              bool(compiled)))
+                              bool(compiled), int(tokens_real),
+                              int(tokens_padded), int(segments)))
 
     # -- aggregation -------------------------------------------------------
 
@@ -185,7 +209,8 @@ class RuntimeStats:
                 sample = self._pending.popleft()
             except IndexError:
                 break
-            group, bucket, variant, rows, padded, secs, compiled = sample
+            (group, bucket, variant, rows, padded, secs, compiled,
+             tok_real, tok_padded, segments) = sample
             key = (group, bucket, variant)
             with self._lock:
                 p = self._programs.get(key)
@@ -194,6 +219,9 @@ class RuntimeStats:
                     self._programs[key] = p
                 p.rows_real += rows
                 p.rows_padded += padded
+                p.tokens_real += tok_real
+                p.tokens_padded += tok_padded
+                p.segments_real += segments
                 if compiled:
                     p.compiles += 1
                     p.compile_s_total += secs
@@ -214,6 +242,11 @@ class RuntimeStats:
             if padded > rows:
                 self.step_rows.inc(padded - rows, group=group,
                                    kind="padding")
+            if tok_padded:
+                self.step_tokens.inc(tok_real, group=group, kind="real")
+                if tok_padded > tok_real:
+                    self.step_tokens.inc(tok_padded - tok_real,
+                                         group=group, kind="padding")
             n += 1
         return n
 

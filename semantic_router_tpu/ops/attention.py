@@ -49,6 +49,34 @@ def sliding_window_bias(seq_len: int, window: int,
     return jnp.where(allowed, 0.0, NEG_INF).astype(dtype)[None, None, :, :]
 
 
+def block_diagonal_bias(segment_ids: jnp.ndarray,
+                        dtype=jnp.float32) -> jnp.ndarray:
+    """[B, S] int segment ids (−1 = padding) → [B, 1, S, S] additive bias
+    allowing attention only WITHIN a segment — the sequence-packing mask:
+    each packed prompt attends exactly as if it sat alone in its row.
+    Padding keys (seg −1) are always masked, even against padding
+    queries, so a packed row is numerically independent of what shares
+    it."""
+    same = segment_ids[:, :, None] == segment_ids[:, None, :]
+    valid = (segment_ids >= 0)[:, None, :]
+    allowed = same & valid
+    return jnp.where(allowed, 0.0, NEG_INF).astype(dtype)[:, None, :, :]
+
+
+def packed_window_bias(position_ids: jnp.ndarray, window: int,
+                       dtype=jnp.float32) -> jnp.ndarray:
+    """[B, S] per-segment position ids → [B, 1, S, S] sliding-window bias
+    computed on SEGMENT-LOCAL positions, not row indices: inside one
+    packed segment positions are contiguous, so |p_i − p_j| equals the
+    unpacked |i − j| and the local-attention window reproduces the
+    unpacked semantics exactly (combine with block_diagonal_bias — the
+    position test alone would let a window straddle two segments whose
+    local positions happen to align)."""
+    dist = jnp.abs(position_ids[:, :, None] - position_ids[:, None, :])
+    allowed = dist <= (window // 2)
+    return jnp.where(allowed, 0.0, NEG_INF).astype(dtype)[:, None, :, :]
+
+
 def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
          bias: Optional[jnp.ndarray] = None,
          scale: Optional[float] = None) -> jnp.ndarray:
@@ -127,6 +155,30 @@ def mean_pool(hidden: jnp.ndarray, attention_mask: jnp.ndarray) -> jnp.ndarray:
     summed = jnp.sum(hidden * mask, axis=1)
     counts = jnp.clip(jnp.sum(mask, axis=1), 1e-9, None)
     return summed / counts
+
+
+def packed_cls_pool(hidden: jnp.ndarray, seg_row: jnp.ndarray,
+                    seg_start: jnp.ndarray) -> jnp.ndarray:
+    """Per-segment CLS pooling over packed rows: gather each segment's
+    first token — hidden [R, S, D] × seg_row/seg_start [K] → [K, D].
+    Padding segments point at (0, 0); their pooled vectors are demuxed
+    away host-side."""
+    return hidden[seg_row, seg_start]
+
+
+def packed_mean_pool(hidden: jnp.ndarray,
+                     segment_ids: jnp.ndarray,
+                     n_segments: int) -> jnp.ndarray:
+    """Per-segment masked mean over packed rows: hidden [R, S, D] ×
+    segment_ids [R, S] (global segment index, −1 = padding) → [K, D].
+    One [K, R·S] selection matmul — at classifier shapes this is noise
+    next to the trunk forward it amortizes."""
+    flat = hidden.reshape(-1, hidden.shape[-1])
+    seg = segment_ids.reshape(-1)
+    sel = (seg[None, :] == jnp.arange(n_segments)[:, None]) \
+        .astype(hidden.dtype)
+    counts = jnp.clip(sel.sum(axis=-1, keepdims=True), 1e-9, None)
+    return (sel @ flat) / counts
 
 
 def cls_pool(hidden: jnp.ndarray) -> jnp.ndarray:

@@ -164,6 +164,12 @@ class UpstreamHealth:
         self._fleet_ttl_s = 2.0
 
         self._retry_bucket = self._build_bucket()
+        # fleet-shared retry budget accounting (report()) + per-window
+        # caches so a retry spike costs one backend round trip per
+        # attempt (prev-window count is immutable; reap runs once)
+        self._fleet_budget_stats = {"granted": 0, "denied": 0}
+        self._fleet_prev = (-1, 0)       # (window, count)
+        self._fleet_reaped_window = -1
 
         self.requests = registry.counter(
             "llm_upstream_requests_total",
@@ -393,7 +399,15 @@ class UpstreamHealth:
             except Exception:
                 pass
             return False, f"degraded_l{level}"
-        if not self._retry_bucket.try_take(1.0):
+        # fleet-shared budget first (retry.fleet_budget over the
+        # StatePlane seam): N replicas spend ONE budget_per_s pool; a
+        # dead/absent plane falls back to the local per-replica bucket
+        granted = None
+        if self._fleet_budget_active():
+            granted = self._fleet_take()
+        if granted is None:
+            granted = self._retry_bucket.try_take(1.0)
+        if not granted:
             try:
                 # same string as the failover_path entry and the
                 # OPERATIONS.md runbook query — one vocabulary
@@ -421,6 +435,68 @@ class UpstreamHealth:
         with self._lock:
             jitter = 0.5 + self._rng.random()
         return min(1.0, base * (2 ** max(0, attempt - 1)) * jitter)
+
+    # -- fleet-shared retry budget (StateBackend seam) ---------------------
+
+    def _fleet_budget_active(self) -> bool:
+        return (self.plane is not None
+                and bool(self.cfg.get("fleet_share", True))
+                and bool(self.cfg["retry"].get("fleet_budget", True)))
+
+    def _fleet_take(self) -> Optional[bool]:
+        """One retry token from the FLEET-WIDE budget: an atomic incr on
+        a per-second window key shared by every replica, with one
+        window's unused allowance carrying over (capped by ``burst``) so
+        short bursts still pass — a windowed approximation of the local
+        token bucket whose budget N replicas would otherwise each spend
+        in full.  Returns True/False = fleet decision, None = the plane
+        is unusable (caller falls back to the local bucket: a partition
+        degrades to per-replica budgets instead of refusing retries).
+        """
+        plane = self.plane
+        try:
+            window = int(time.time())
+            r = self.cfg["retry"]
+            per_s = float(r["budget_per_s"])
+            count = plane.backend.incr(
+                plane.key("retrybudget", str(window)), 1)
+            # the previous window's count is immutable once its second
+            # has passed: read it ONCE per window and cache — a retry
+            # spike (exactly when this path is hot) costs one round
+            # trip per attempt, not three
+            with self._lock:
+                prev_window, prev = self._fleet_prev
+            if prev_window != window - 1:
+                prev = 0
+                raw = plane.backend.get(
+                    plane.key("retrybudget", str(window - 1)))
+                if raw:
+                    try:
+                        prev = int(raw.decode())
+                    except (ValueError, UnicodeDecodeError):
+                        prev = 0
+                with self._lock:
+                    self._fleet_prev = (window - 1, prev)
+            carry = min(float(r["burst"]), max(0.0, per_s - prev))
+            granted = count <= per_s + carry
+            reap = False
+            with self._lock:
+                self._fleet_budget_stats[
+                    "granted" if granted else "denied"] += 1
+                if self._fleet_reaped_window != window:
+                    self._fleet_reaped_window = window
+                    reap = True
+            if reap:
+                # reap a stale window ONCE per window so the shared
+                # keyspace stays O(1) without a delete per attempt
+                try:
+                    plane.backend.delete(
+                        plane.key("retrybudget", str(window - 3)))
+                except Exception:
+                    pass
+            return granted
+        except Exception:
+            return None
 
     # -- fleet share (StateBackend seam) -----------------------------------
 
@@ -493,6 +569,10 @@ class UpstreamHealth:
                 "budget_per_s": float(
                     self.cfg["retry"]["budget_per_s"]),
                 "burst": float(self.cfg["retry"]["burst"])},
+            "fleet_budget": {
+                "active": self._fleet_budget_active(),
+                "granted": self._fleet_budget_stats["granted"],
+                "denied": self._fleet_budget_stats["denied"]},
             "fleet_open": [{"model": m, "endpoint": e}
                            for m, e in fleet],
             "config": cfg,

@@ -1177,12 +1177,22 @@ class RouterServer:
                         self._json(200, slo.report())
                 elif path == "/debug/runtime":
                     # runtime telemetry snapshot: per-jit-program
-                    # compile/execute registry + process/device gauges
+                    # compile/execute registry + process/device gauges,
+                    # plus the packing scheduler/auto-tuner state when
+                    # an engine serves (docs/PACKING.md)
                     rs = server.registry.get("runtimestats")
                     if rs is None:
                         self._json(503, {"error": "no runtime stats"})
                     else:
-                        self._json(200, rs.report())
+                        rep = rs.report()
+                        eng = getattr(server.router, "engine", None)
+                        if eng is not None and hasattr(eng,
+                                                       "packing_report"):
+                            try:
+                                rep["packing"] = eng.packing_report()
+                            except Exception:
+                                pass
+                        self._json(200, rep)
                 elif path == "/debug/resilience":
                     # degradation-ladder snapshot: level, pressure
                     # inputs, admission buckets, cost model, transitions

@@ -760,6 +760,34 @@ def apply_upstream_knobs(cfg: RouterConfig, registry, router) -> None:
                         level="warning")
 
 
+def apply_packing_knobs(cfg: RouterConfig, engine) -> None:
+    """Apply the engine.packing block (docs/PACKING.md) to a live
+    engine: retunes the packing scheduler's composition knobs in place
+    and starts/stops the shape auto-tuner's polling thread — the thread
+    is bootstrap's to own (bare test engines drive step() directly).
+    Called at boot and on config hot reload; ``enabled: false`` restores
+    byte-identical fixed-batch composition without swapping the
+    batcher.  Malformed packing config must never stop the server."""
+    if engine is None or not hasattr(engine, "configure_packing"):
+        return
+    try:
+        pk = cfg.engine.packing_config()
+        engine.configure_packing(cfg.engine.packing)
+        tuner = getattr(engine, "_autotuner", None)
+        if tuner is not None:
+            if pk["enabled"] and pk["autotune"]["enabled"]:
+                tuner.start(pk["autotune"]["interval_s"])
+            else:
+                tuner.stop()
+        component_event("bootstrap", "packing_configured",
+                        enabled=pk["enabled"],
+                        autotune=pk["autotune"]["enabled"])
+    except Exception as exc:
+        component_event("bootstrap", "packing_config_invalid",
+                        error=f"{type(exc).__name__}: {exc}"[:200],
+                        level="warning")
+
+
 def apply_flywheel_knobs(cfg: RouterConfig, registry, router) -> None:
     """Attach/configure/detach the learned-routing flywheel
     (flywheel/controller.py) for a registry + router pair.  Called at
@@ -887,6 +915,9 @@ def serve(config_path: str, port: int = 8801,
     # upstream resilience plane: after the degradation controller and
     # state plane exist, so the retry gate and fleet share bind live
     apply_upstream_knobs(cfg, server.registry, router)
+    # sequence-packed batching: scheduler knobs + the shape auto-tuner
+    # thread (the engine survives hot reloads, so this retunes in place)
+    apply_packing_knobs(cfg, engine)
 
     # startKubernetesControllerIfNeeded (cmd/main.go:50): live CRD watch
     # regenerating the config file the ConfigWatcher below hot-swaps
@@ -930,6 +961,7 @@ def serve(config_path: str, port: int = 8801,
             apply_observability_knobs(new_cfg, server.registry)
             apply_flywheel_knobs(new_cfg, server.registry, new_router)
             apply_upstream_knobs(new_cfg, server.registry, new_router)
+            apply_packing_knobs(new_cfg, engine)
             # grace period before tearing down the old dispatcher so
             # requests already inside old.route() finish their fan-out
             threading.Timer(30.0, old.dispatcher.shutdown).start()

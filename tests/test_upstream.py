@@ -293,6 +293,57 @@ class TestFleetShare:
         up_b._fleet_ttl_s = 0.0
         assert not up_b.model_open("m")
 
+    def test_fleet_retry_budget_shared_across_replicas(self):
+        """N replicas spend ONE retry budget through the plane: with
+        budget_per_s=2 (+carry 2 at most), replica A's spend exhausts
+        what replica B may take in the same window — per-replica
+        buckets would have granted ~2× that."""
+        pa, pb = self._planes()
+        up_a = make_plane({"retry": {"budget_per_s": 2.0, "burst": 2.0}})
+        up_b = make_plane({"retry": {"budget_per_s": 2.0, "burst": 2.0}})
+        up_a.bind(plane=pa)
+        up_b.bind(plane=pb)
+        assert up_a._fleet_budget_active()
+        if time.time() % 1 > 0.5:   # don't straddle a window boundary
+            time.sleep(1.0 - time.time() % 1)
+        granted = sum(1 for _ in range(6) if up_a.try_retry()[0]) \
+            + sum(1 for _ in range(6) if up_b.try_retry()[0])
+        # fleet ceiling = per_s + carry <= 4 in one window; purely
+        # local buckets would have granted 8 (burst 2 + refill each)
+        assert granted <= 4
+        denied = up_a.report()["fleet_budget"]["denied"] \
+            + up_b.report()["fleet_budget"]["denied"]
+        assert denied >= 8
+        # the shared counter lives under the namespace's retrybudget key
+        assert any("retrybudget" in k for k in
+                   pa.backend.scan("t-up:retrybudget"))
+
+    def test_fleet_budget_falls_back_local_on_plane_death(self):
+        from semantic_router_tpu.stateplane.backend import (
+            GuardedBackend,
+            InMemoryStateBackend,
+        )
+        from semantic_router_tpu.stateplane.plane import StatePlane
+
+        class DeadBackend(InMemoryStateBackend):
+            def incr(self, key, by=1):
+                raise RuntimeError("plane down")
+
+        plane = StatePlane(GuardedBackend(DeadBackend()),
+                           replica_id="a", namespace="t-dead")
+        up = make_plane({"retry": {"budget_per_s": 5.0, "burst": 5.0}})
+        up.bind(plane=plane)
+        ok, reason = up.try_retry()   # local bucket serves the request
+        assert ok and reason == ""
+
+    def test_fleet_budget_knob_off_stays_local(self):
+        pa, _ = self._planes()
+        up = make_plane({"retry": {"fleet_budget": False}})
+        up.bind(plane=pa)
+        assert not up._fleet_budget_active()
+        assert up.try_retry()[0] is True
+        assert pa.backend.scan("t-up:retrybudget") == []
+
     def test_fleet_share_off_publishes_nothing(self):
         pa, pb = self._planes()
         up_a = make_plane({"fleet_share": False,
@@ -334,7 +385,8 @@ class TestUpstreamConfig:
         up.record("m", "ep", ok=True, latency_s=0.01)
         rep = up.report()
         assert set(rep) == {"enabled", "endpoints", "open_circuits",
-                            "retry_budget", "fleet_open", "config"}
+                            "retry_budget", "fleet_budget", "fleet_open",
+                            "config"}
         row = rep["endpoints"][0]
         for key in ("model", "endpoint", "state", "consecutive_failures",
                     "error_rate_ewma", "latency_ewma_ms", "requests",
@@ -455,6 +507,35 @@ class TestAnnotate:
         assert not ex.annotate(rid, not_a_field=[1])
         assert not ex.annotate("missing", failover_path=[])
         assert validate_record(ex.get(rid)) == []
+
+    def test_annotate_re_exports_to_sinks(self):
+        """The OTLP export-ordering fix: the record exports at commit
+        BEFORE the forward finishes, so annotate() must re-deliver the
+        updated record to every sink — the second delivery (same
+        record_id) carries the failover_path the first one could not."""
+        ex = DecisionExplainer()
+        deliveries = []
+        ex.sinks.append(lambda rec: deliveries.append(
+            (rec["record_id"], list(rec["failover_path"]))))
+        draft = ex.begin("c" * 32, "req3")
+        rid = ex.commit(draft.finish(kind="route", model="m",
+                                     latency_ms=1.0, query="",
+                                     redact_pii=True))
+        assert deliveries == [(rid, [])]   # commit-time line: no path
+        path = [{"model": "m", "endpoint": "e", "outcome": "5xx",
+                 "status": 503},
+                {"model": "m2", "endpoint": "e2", "outcome": "ok",
+                 "status": 200}]
+        assert ex.annotate(rid, failover_path=path)
+        assert len(deliveries) == 2
+        rid2, exported_path = deliveries[1]
+        assert rid2 == rid                 # consumers key on record_id
+        assert exported_path == path       # the re-export carries it
+        assert ex.stats()["re_exported"] == 1
+        # a failed-sink annotate still lands in the ring
+        ex.sinks.append(lambda rec: 1 / 0)
+        assert ex.annotate(rid, failover_path=[])
+        assert ex.get(rid)["failover_path"] == []
 
 
 # ---------------------------------------------------------------------------
