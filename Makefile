@@ -3,7 +3,7 @@ SHELL := /bin/bash
 
 .PHONY: test test-fast tier1 trace-smoke metrics-lint explain-smoke \
 	resilience-smoke fleet-smoke flywheel-smoke upstream-smoke \
-	packing-smoke native bench bench-replay perf perf-record \
+	packing-smoke analyze native bench bench-replay perf perf-record \
 	serve-mock clean
 
 bench-replay:
@@ -68,7 +68,8 @@ resilience-smoke:
 # local-only state with zero request failures (restart re-attaches and
 # replays buffered writes).  Tier-1 (runs inside `make tier1` too).
 fleet-smoke:
-	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_stateplane.py \
+	env JAX_PLATFORMS=cpu VSR_ANALYZE=1 $(PY) -m pytest \
+	  tests/test_stateplane.py \
 	  tests/test_stateplane_chaos.py \
 	  "tests/test_packing.py::TestPackingLoad" -q -p no:cacheprovider
 
@@ -80,8 +81,24 @@ fleet-smoke:
 # wiring, and the mixed-length-load padding-waste drop.  Tier-1 (runs
 # inside `make tier1` too).
 packing-smoke:
-	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_packing.py \
+	env JAX_PLATFORMS=cpu VSR_ANALYZE=1 $(PY) -m pytest \
+	  tests/test_packing.py -q -p no:cacheprovider
+
+# repo-native analysis gate (docs/ANALYSIS.md): the static lock-order
+# graph + cycle check, the jit-purity lint, the knob-wiring
+# cross-check (schema -> normalizer -> bootstrap boot+reload -> docs
+# row), and the metric cross-reference (code <-> dashboards/docs/
+# deploy), all counter-proven against planted violations under
+# tests/fixtures/analysis/.  Findings fail the gate unless justified
+# in semantic_router_tpu/analysis/baseline.toml.  Pure AST + text
+# scanning — no jax, no model loads, <60s budget asserted in the
+# test.  Tier-1 (runs inside `make tier1` too); the RUNTIME half (the
+# lock-order witness + thread-leak gate) arms via VSR_ANALYZE=1 on
+# the packing/fleet smoke suites above.
+analyze:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_analysis.py \
 	  -q -p no:cacheprovider
+	env JAX_PLATFORMS=cpu $(PY) -m semantic_router_tpu.analysis
 
 # learned-routing-flywheel gate (docs/FLYWHEEL.md): records 100 mixed
 # requests in-process, exports the corpus, trains the cost-aware bandit

@@ -971,6 +971,24 @@ def _measure_packing(platform: str) -> dict:
     return out
 
 
+def _measure_analyze() -> dict:
+    """Wall-time note for the `make analyze` static-analysis gate
+    (docs/ANALYSIS.md) — pure AST + text scanning, platform-independent,
+    so the checker costs ride every BENCH record."""
+    t0 = time.perf_counter()
+    from semantic_router_tpu.analysis import run_all
+
+    report = run_all()
+    return {
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "checker_wall_s": {k: round(v, 3)
+                           for k, v in sorted(report.timings_s.items())},
+        "new_findings": len(report.findings),
+        "baselined": len(report.suppressed),
+        "ok": report.ok,
+    }
+
+
 def _run_bench(platform: str) -> None:
     sys.stderr.write(f"bench: running on platform={platform}\n")
 
@@ -1276,6 +1294,17 @@ def _run_bench(platform: str) -> None:
         sys.stderr.write(f"bench: packing arm failed "
                          f"({type(exc).__name__}: {exc}); skipped\n")
 
+    # the `make analyze` tier-1 gate's cost, kept visible in the BENCH
+    # json (docs/ANALYSIS.md): per-checker wall time + finding counts —
+    # the gate must stay cheap enough that nobody is tempted to skip it
+    analyze_row = None
+    try:
+        analyze_row = _measure_analyze()
+        sys.stderr.write(f"bench: analyze {analyze_row}\n")
+    except Exception as exc:
+        sys.stderr.write(f"bench: analyze note failed "
+                         f"({type(exc).__name__}: {exc}); skipped\n")
+
     batch, signals_per_s, best_impl = best
     # On a CPU fallback the host geometry is the whole story (this image
     # exposes ONE 2.1GHz core — ~0.09 TFLOPs f32 roofline — while the
@@ -1308,6 +1337,8 @@ def _run_bench(platform: str) -> None:
         record["flywheel"] = flywheel_row
     if packing_row is not None:
         record["packing"] = packing_row
+    if analyze_row is not None:
+        record["analyze"] = analyze_row
     if platform != "cpu":
         # side evidence for the bench README / judge: full sweep detail
         try:

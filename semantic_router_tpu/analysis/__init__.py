@@ -1,0 +1,17 @@
+"""Repo-native analysis suite (docs/ANALYSIS.md): machine-checked
+invariants that previously lived in prose — the static+runtime lock-
+order graph, the jit-purity lint, the knob-wiring cross-check, and the
+metric cross-reference — behind the ``make analyze`` tier-1 gate.
+
+Import-light by design: nothing here imports jax or any serving module,
+so the gate runs in ~a second under ``JAX_PLATFORMS=cpu`` with no model
+loads, and the witness can be installed before heavyweight imports.
+"""
+
+from .findings import Finding, Report, apply_baseline, load_baseline
+from .runner import BASELINE_PATH, REPO_ROOT, run_all, static_lock_edges
+
+__all__ = [
+    "Finding", "Report", "apply_baseline", "load_baseline",
+    "run_all", "static_lock_edges", "BASELINE_PATH", "REPO_ROOT",
+]

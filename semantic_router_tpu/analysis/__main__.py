@@ -1,0 +1,9 @@
+"""``python -m semantic_router_tpu.analysis`` — run the full analysis
+suite against the repo and exit nonzero on any new finding or baseline-
+hygiene error (docs/ANALYSIS.md)."""
+
+import sys
+
+from .runner import main
+
+sys.exit(main())

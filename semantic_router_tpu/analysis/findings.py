@@ -1,0 +1,165 @@
+"""Finding model + suppression baseline for the repo-native analysis
+suite (docs/ANALYSIS.md).
+
+A checker emits :class:`Finding` rows keyed by a *stable* identity
+``(checker, key)`` — the key must survive unrelated line churn (it names
+the violated invariant, e.g. ``undocumented:llm_foo_total`` or
+``edge:engine/batcher.py:197->observability/runtimestats.py:126``), so
+the checked-in ``baseline.toml`` keeps matching across refactors.
+
+Baseline policy (the PR-3 metrics-lint contract, generalized): the gate
+fails on any finding NOT in the baseline, on any baseline entry without
+a written justification, and on any baseline entry that no longer
+matches a finding (stale suppressions rot into lies — delete them when
+the violation is fixed).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class Finding:
+    checker: str          # locks | jit-purity | knobs | metrics-xref
+    key: str              # stable identity for baseline matching
+    message: str          # human sentence: what is wrong and where
+    path: str = ""        # repo-relative file the finding anchors to
+    line: int = 0
+
+    def ident(self) -> Tuple[str, str]:
+        return (self.checker, self.key)
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.path else "<repo>"
+        return f"[{self.checker}] {loc}: {self.message}  (key={self.key})"
+
+
+@dataclass
+class Suppression:
+    checker: str
+    key: str
+    reason: str = ""
+    line: int = 0  # line in baseline.toml (for error messages)
+
+
+@dataclass
+class Report:
+    """One analysis run: raw findings partitioned against the baseline."""
+
+    findings: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)   # gate-level problems
+    timings_s: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.errors
+
+    def render(self) -> str:
+        lines: List[str] = []
+        for f in self.findings:
+            lines.append("NEW " + f.render())
+        for e in self.errors:
+            lines.append("GATE " + e)
+        lines.append(
+            f"analyze: {len(self.findings)} new finding(s), "
+            f"{len(self.suppressed)} baselined, "
+            f"{len(self.errors)} gate error(s)")
+        for name, t in sorted(self.timings_s.items()):
+            lines.append(f"  {name}: {t * 1e3:.0f} ms")
+        return "\n".join(lines)
+
+
+# -- baseline.toml ---------------------------------------------------------
+#
+# Python 3.10 has no tomllib and the container bakes no toml package, so
+# this parses the narrow dialect the baseline actually uses:
+#
+#   [[suppress]]
+#   checker = "metrics-xref"
+#   key = "undocumented:llm_foo_total"
+#   reason = "internal-only series, consumed by the dashboard backend"
+#
+# Only [[suppress]] tables with double-quoted string values; # comments.
+
+
+def parse_baseline(text: str) -> List[Suppression]:
+    entries: List[Suppression] = []
+    cur: Optional[Suppression] = None
+    for lineno, raw in enumerate(text.split("\n"), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[[suppress]]":
+            cur = Suppression(checker="", key="", line=lineno)
+            entries.append(cur)
+            continue
+        if line.startswith("["):
+            raise ValueError(
+                f"baseline.toml:{lineno}: only [[suppress]] tables are "
+                f"supported, got {line!r}")
+        if cur is None:
+            raise ValueError(
+                f"baseline.toml:{lineno}: key/value outside a "
+                f"[[suppress]] table")
+        if "=" not in line:
+            raise ValueError(f"baseline.toml:{lineno}: malformed line "
+                             f"{line!r}")
+        name, _, value = line.partition("=")
+        name = name.strip()
+        value = value.strip()
+        if not (value.startswith('"') and value.endswith('"')
+                and len(value) >= 2):
+            raise ValueError(
+                f"baseline.toml:{lineno}: value for {name!r} must be a "
+                f"double-quoted string")
+        value = value[1:-1]
+        if name not in ("checker", "key", "reason"):
+            raise ValueError(
+                f"baseline.toml:{lineno}: unknown field {name!r}")
+        setattr(cur, name, value)
+    return entries
+
+
+def load_baseline(path: str) -> List[Suppression]:
+    if not os.path.exists(path):
+        return []
+    with open(path, "r") as f:
+        return parse_baseline(f.read())
+
+
+def apply_baseline(findings: List[Finding],
+                   suppressions: List[Suppression]) -> Report:
+    """Partition findings against the baseline; baseline-hygiene
+    violations (missing reason, stale entry, duplicate entry) surface as
+    gate errors so the suppress list can only shrink honestly."""
+    report = Report()
+    seen_idents = set()
+    by_ident: Dict[Tuple[str, str], Suppression] = {}
+    for s in suppressions:
+        if not s.reason.strip():
+            report.errors.append(
+                f"baseline.toml:{s.line}: suppression "
+                f"({s.checker}, {s.key}) has no justification — every "
+                f"baselined finding needs a written reason")
+        if (s.checker, s.key) in by_ident:
+            report.errors.append(
+                f"baseline.toml:{s.line}: duplicate suppression for "
+                f"({s.checker}, {s.key})")
+        by_ident[(s.checker, s.key)] = s
+    for f in findings:
+        if f.ident() in by_ident:
+            report.suppressed.append(f)
+            seen_idents.add(f.ident())
+        else:
+            report.findings.append(f)
+    for s in suppressions:
+        if (s.checker, s.key) not in seen_idents:
+            report.errors.append(
+                f"baseline.toml:{s.line}: stale suppression "
+                f"({s.checker}, {s.key}) matches no current finding — "
+                f"delete it (the violation is fixed or the key moved)")
+    return report

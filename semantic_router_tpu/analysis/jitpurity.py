@@ -1,0 +1,306 @@
+"""JIT-purity lint (docs/ANALYSIS.md).
+
+Functions reachable from a ``jax.jit``/``jax.pmap`` call site must stay
+pure and device-resident: a stray host sync (``.item()``,
+``float()``/``int()`` on a traced array, ``np.asarray`` on a traced
+value, ``jax.device_get``/``block_until_ready``) silently serializes
+the device behind the dispatch queue, and side effects (``time.*``,
+``print``) run once per *trace*, not per call — both are invisible to
+unit tests and lethal to the hot path.
+
+Mechanics (pure AST, no imports executed):
+
+1. find jit roots: ``jax.jit(f)`` / ``jax.pmap(f)`` / ``pjit(f)`` where
+   ``f`` is a plain name, across the scanned roots (``engine/``,
+   ``models/``, ``ops/`` by default);
+2. resolve the call graph from those roots — same-module defs
+   (including nested/closure defs) and cross-module defs reachable
+   through ``from x import y`` / ``import x`` within the scanned set;
+3. inside each reachable function, taint the function's parameters
+   (the traced values) and flow taint through simple assignments; flag
+   host-sync patterns on tainted expressions and side-effect calls
+   anywhere.
+
+Shape arithmetic is exempt: ``int(x.shape[0])``, ``len(x)``,
+``x.ndim``/``x.size`` are static under tracing and never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding
+
+DEFAULT_SUBDIRS = ("engine", "models", "ops")
+
+_JIT_WRAPPERS = {"jit", "pmap", "pjit"}
+_SIDE_EFFECT_TIME = {"time", "perf_counter", "monotonic", "sleep",
+                     "process_time", "thread_time"}
+
+
+@dataclass
+class _Module:
+    rel: str
+    tree: ast.Module
+    # name -> FunctionDef anywhere in the module (module level, nested,
+    # methods); first definition wins
+    defs: Dict[str, ast.FunctionDef] = field(default_factory=dict)
+    # local name -> module rel path it was imported from (scanned set)
+    from_imports: Dict[str, Tuple[str, str]] = field(
+        default_factory=dict)   # alias -> (module rel, original name)
+    mod_imports: Dict[str, str] = field(default_factory=dict)
+
+
+def _iter_py(root: str, subdirs: Tuple[str, ...]) -> List[str]:
+    out: List[str] = []
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        for dirpath, _d, filenames in os.walk(base):
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(out)
+
+
+def _module_rel_of_import(importing_rel: str, module: Optional[str],
+                          level: int, pkg_root_name: str) -> Optional[str]:
+    """Best-effort: resolve an import statement to a repo-relative .py
+    path WITHIN the scanned package; None for anything external."""
+    if level == 0:
+        if not module or not module.startswith(pkg_root_name + "."):
+            return None
+        parts = module.split(".")[1:]
+    else:
+        base = importing_rel.split(os.sep)[:-1]
+        if level > 1:
+            base = base[: len(base) - (level - 1)]
+        parts = base + (module.split(".") if module else [])
+        if parts and parts[0] == pkg_root_name:
+            parts = parts[1:]
+    return os.path.join(*parts) + ".py" if parts else None
+
+
+class _DefCollector(ast.NodeVisitor):
+    def __init__(self, mod: _Module) -> None:
+        self.mod = mod
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.mod.defs.setdefault(node.name, node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+
+def _collect_module(root: str, path: str, pkg_name: str) -> Optional[_Module]:
+    rel = os.path.relpath(path, root)
+    try:
+        with open(path, "r") as f:
+            tree = ast.parse(f.read(), filename=rel)
+    except SyntaxError:
+        return None
+    mod = _Module(rel=rel, tree=tree)
+    _DefCollector(mod).visit(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            target = _module_rel_of_import(rel, node.module, node.level,
+                                           pkg_name)
+            if target is None:
+                continue
+            for alias in node.names:
+                mod.from_imports[alias.asname or alias.name] = (
+                    target, alias.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.startswith(pkg_name + "."):
+                    parts = alias.name.split(".")[1:]
+                    mod.mod_imports[alias.asname or alias.name] = \
+                        os.path.join(*parts) + ".py"
+    return mod
+
+
+def _jit_roots(mod: _Module) -> List[Tuple[str, int]]:
+    """Names passed to jax.jit/pmap/pjit in this module (+ call line)."""
+    roots: List[Tuple[str, int]] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        is_wrapper = (
+            (isinstance(fn, ast.Attribute) and fn.attr in _JIT_WRAPPERS
+             and isinstance(fn.value, ast.Name)
+             and fn.value.id in ("jax", "pjit"))
+            or (isinstance(fn, ast.Name) and fn.id in _JIT_WRAPPERS))
+        if not is_wrapper or not node.args:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Name):
+            roots.append((arg.id, node.lineno))
+    return roots
+
+
+def _call_names(fn: ast.FunctionDef) -> List[ast.Call]:
+    return [n for n in ast.walk(fn) if isinstance(n, ast.Call)]
+
+
+def _expr_names(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _is_shape_arith(node: ast.AST) -> bool:
+    """True when the expression only touches static tracing metadata
+    (.shape/.ndim/.size/len/range) — exempt from the host-sync flag."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in ("shape", "ndim",
+                                                       "size", "dtype"):
+            return True
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id in ("len", "range"):
+            return True
+    return False
+
+
+def _tainted_locals(fn: ast.FunctionDef) -> Set[str]:
+    """Parameters + names assigned from tainted expressions (two fixed-
+    point passes cover the straight-line and one level of loop flow)."""
+    tainted: Set[str] = {a.arg for a in fn.args.args
+                        + fn.args.posonlyargs + fn.args.kwonlyargs
+                        if a.arg not in ("self", "cls")}
+    if fn.args.vararg:
+        tainted.add(fn.args.vararg.arg)
+    for _ in range(2):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and node.targets:
+                if _expr_names(node.value) & tainted:
+                    for t in node.targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                tainted.add(n.id)
+            elif isinstance(node, ast.AugAssign):
+                if _expr_names(node.value) & tainted and \
+                        isinstance(node.target, ast.Name):
+                    tainted.add(node.target.id)
+    return tainted
+
+
+def _scan_function(rel: str, fn: ast.FunctionDef,
+                   findings: List[Finding]) -> None:
+    tainted = _tainted_locals(fn)
+    ordinals: Dict[str, int] = {}
+
+    def _flag(node: ast.AST, pattern: str, detail: str) -> None:
+        # churn-stable key: file + function + pattern (+ordinal for
+        # repeats) — never the line number, so a baselined suppression
+        # survives unrelated edits above the flagged call.  The line
+        # still rides on the finding for display.
+        n = ordinals.get(pattern, 0) + 1
+        ordinals[pattern] = n
+        suffix = f"#{n}" if n > 1 else ""
+        findings.append(Finding(
+            checker="jit-purity",
+            key=f"{rel}:{fn.name}:{pattern}{suffix}",
+            path=rel, line=getattr(node, "lineno", fn.lineno),
+            message=(f"{fn.name}() is reachable from a jax.jit call "
+                     f"site but {detail} — host syncs serialize the "
+                     f"device; side effects run per-trace, not "
+                     f"per-call")))
+
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        # x.item() / x.tolist() / x.block_until_ready() on tainted exprs
+        if isinstance(f, ast.Attribute):
+            if f.attr in ("item", "tolist") \
+                    and _expr_names(f.value) & tainted:
+                _flag(node, f.attr,
+                      f"calls .{f.attr}() on a traced value")
+                continue
+            if f.attr == "block_until_ready":
+                _flag(node, "block_until_ready",
+                      "calls .block_until_ready() under tracing")
+                continue
+            # np.asarray/np.array/onp.* on tainted values
+            if isinstance(f.value, ast.Name) \
+                    and f.value.id in ("np", "onp", "numpy") \
+                    and f.attr in ("asarray", "array", "copy") \
+                    and node.args \
+                    and _expr_names(node.args[0]) & tainted:
+                _flag(node, f"np.{f.attr}",
+                      f"materializes a traced value via "
+                      f"np.{f.attr}()")
+                continue
+            # jax.device_get(x)
+            if f.attr == "device_get" and isinstance(f.value, ast.Name) \
+                    and f.value.id == "jax":
+                _flag(node, "device_get",
+                      "calls jax.device_get() under tracing")
+                continue
+            # time.time() etc: side effect per trace
+            if isinstance(f.value, ast.Name) and f.value.id == "time" \
+                    and f.attr in _SIDE_EFFECT_TIME:
+                _flag(node, f"time.{f.attr}",
+                      f"calls time.{f.attr}() — a trace-time side "
+                      f"effect frozen into the compiled program")
+                continue
+        elif isinstance(f, ast.Name):
+            if f.id in ("float", "int", "bool") and node.args:
+                arg = node.args[0]
+                if _expr_names(arg) & tainted \
+                        and not _is_shape_arith(arg):
+                    _flag(node, f.id,
+                          f"coerces a traced value with {f.id}()")
+                continue
+            if f.id == "print":
+                _flag(node, "print",
+                      "calls print() — a trace-time side effect")
+                continue
+
+
+def check(root: str, subdirs: Tuple[str, ...] = DEFAULT_SUBDIRS,
+          pkg_name: str = "semantic_router_tpu"
+          ) -> List[Finding]:
+    modules: Dict[str, _Module] = {}
+    scan_root = root
+    for path in _iter_py(root, subdirs):
+        mod = _collect_module(scan_root, path, pkg_name)
+        if mod is not None:
+            modules[mod.rel] = mod
+
+    findings: List[Finding] = []
+    # BFS from jit roots through the resolvable call graph
+    seen: Set[Tuple[str, str]] = set()
+    queue: List[Tuple[str, str]] = []
+    for rel, mod in modules.items():
+        for name, _line in _jit_roots(mod):
+            if name in mod.defs:
+                queue.append((rel, name))
+    while queue:
+        rel, name = queue.pop()
+        if (rel, name) in seen:
+            continue
+        seen.add((rel, name))
+        mod = modules.get(rel)
+        if mod is None or name not in mod.defs:
+            continue
+        fn = mod.defs[name]
+        _scan_function(rel, fn, findings)
+        for call in _call_names(fn):
+            cf = call.func
+            if isinstance(cf, ast.Name):
+                if cf.id in mod.defs:
+                    queue.append((rel, cf.id))
+                elif cf.id in mod.from_imports:
+                    target_rel, orig = mod.from_imports[cf.id]
+                    if target_rel in modules:
+                        queue.append((target_rel, orig))
+            elif isinstance(cf, ast.Attribute) \
+                    and isinstance(cf.value, ast.Name):
+                target_rel = mod.mod_imports.get(cf.value.id)
+                if target_rel and target_rel in modules:
+                    queue.append((target_rel, cf.attr))
+    # stable order for reports and baseline diffs
+    findings.sort(key=lambda f: (f.path, f.line, f.key))
+    return findings

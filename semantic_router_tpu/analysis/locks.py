@@ -1,0 +1,501 @@
+"""Static lock-acquisition analysis (docs/ANALYSIS.md).
+
+An AST pass over the threaded subsystems (``engine/``, ``stateplane/``,
+``resilience/``, ``flywheel/``, ``observability/`` by default) that
+builds the static lock graph:
+
+- **lock census** — every ``self.attr = threading.Lock()/RLock()/
+  Condition()`` site, keyed ``relpath:line`` (the same key the runtime
+  witness derives from the construction frame, so static and runtime
+  edges merge into one graph);
+- **acquisition edges** — inside a ``with self.lock:`` region, any
+  nested acquisition (directly, via a same-class method, or via a call
+  on an attribute whose class the census knows) adds edge
+  ``held-site -> acquired-site``;
+- **findings** — a cycle in the edge graph (``cycle:...``: the static
+  shape of a deadlock) and any lock-held call into a lock-acquiring
+  method of a *different module* (``held-call:...``: the pattern that
+  turns two privately-consistent modules into one inverted pair).
+
+The pass is deliberately an over-approximation on edges (a method that
+acquires a lock on *some* path counts as acquiring it) and an
+under-approximation on aliasing (only ``self.``-rooted locks and
+constructor-typed attributes resolve); what it cannot see, the runtime
+witness (analysis/witness.py) records during the smoke suites.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding
+
+DEFAULT_SUBDIRS = ("engine", "stateplane", "resilience", "flywheel",
+                   "observability")
+
+_LOCK_FACTORIES = ("Lock", "RLock", "Condition")
+
+
+@dataclass(frozen=True)
+class LockSite:
+    path: str      # repo-relative
+    line: int
+    owner: str     # "module.Class.attr"
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}:{self.line}"
+
+
+@dataclass
+class ClassInfo:
+    module: str                      # repo-relative module path
+    name: str
+    bases: List[str] = field(default_factory=list)
+    lock_attrs: Dict[str, LockSite] = field(default_factory=dict)
+    # Condition(self.X) wrapping an existing lock: attr -> wrapped attr
+    aliases: Dict[str, str] = field(default_factory=dict)
+    # self.attr = SomeClass(...): attr -> class name as written
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    methods: Dict[str, ast.FunctionDef] = field(default_factory=dict)
+
+
+@dataclass
+class LockGraph:
+    sites: Dict[str, LockSite] = field(default_factory=dict)
+    # (held site key, acquired site key) -> human context
+    edges: Dict[Tuple[str, str], str] = field(default_factory=dict)
+    findings: List[Finding] = field(default_factory=list)
+
+    def add_edge(self, held: LockSite, acquired: LockSite,
+                 context: str) -> None:
+        if held.key == acquired.key:
+            return  # same allocation site: reentrancy, not an ordering
+        self.edges.setdefault((held.key, acquired.key), context)
+
+
+def _is_lock_ctor(node: ast.AST) -> Optional[str]:
+    """'Lock'/'RLock'/'Condition' when node is threading.<factory>(...)
+    or a bare imported <factory>(...)."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr in _LOCK_FACTORIES \
+            and isinstance(fn.value, ast.Name) \
+            and fn.value.id == "threading":
+        return fn.attr
+    if isinstance(fn, ast.Name) and fn.id in _LOCK_FACTORIES:
+        return fn.id
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _called_name(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    if isinstance(call.func, ast.Attribute) \
+            and isinstance(call.func.value, ast.Name):
+        return call.func.attr
+    return None
+
+
+def _iter_py(root: str, subdirs: Tuple[str, ...]) -> List[str]:
+    out: List[str] = []
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        for dirpath, _dirnames, filenames in os.walk(base):
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(out)
+
+
+class _Census:
+    """All classes (with their locks, attribute types, and methods)
+    across the analyzed modules."""
+
+    def __init__(self) -> None:
+        # class name -> list of ClassInfo (same name may repeat across
+        # modules; resolution prefers same-module)
+        self.by_name: Dict[str, List[ClassInfo]] = {}
+        self.classes: List[ClassInfo] = []
+
+    def add(self, info: ClassInfo) -> None:
+        self.classes.append(info)
+        self.by_name.setdefault(info.name, []).append(info)
+
+    def resolve(self, name: str, module: str) -> Optional[ClassInfo]:
+        cands = self.by_name.get(name, [])
+        for c in cands:
+            if c.module == module:
+                return c
+        return cands[0] if cands else None
+
+    def effective_lock_attrs(self, info: ClassInfo,
+                             _seen: Optional[Set[int]] = None
+                             ) -> Dict[str, LockSite]:
+        """Own lock attrs + inherited ones (a PackingBatcher method
+        acquiring ``self._lock`` acquires DynamicBatcher's lock)."""
+        seen = _seen or set()
+        if id(info) in seen:
+            return dict(info.lock_attrs)
+        seen.add(id(info))
+        out: Dict[str, LockSite] = {}
+        for base in info.bases:
+            b = self.resolve(base, info.module)
+            if b is not None:
+                out.update(self.effective_lock_attrs(b, seen))
+        out.update(info.lock_attrs)
+        return out
+
+    def effective_aliases(self, info: ClassInfo) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for base in info.bases:
+            b = self.resolve(base, info.module)
+            if b is not None:
+                out.update(self.effective_aliases(b))
+        out.update(info.aliases)
+        return out
+
+    def find_method(self, info: ClassInfo, name: str,
+                    _seen: Optional[Set[int]] = None
+                    ) -> Optional[Tuple[ClassInfo, ast.FunctionDef]]:
+        seen = _seen or set()
+        if id(info) in seen:
+            return None
+        seen.add(id(info))
+        if name in info.methods:
+            return (info, info.methods[name])
+        for base in info.bases:
+            b = self.resolve(base, info.module)
+            if b is not None:
+                got = self.find_method(b, name, seen)
+                if got is not None:
+                    return got
+        return None
+
+
+def _collect_class(module: str, node: ast.ClassDef) -> ClassInfo:
+    info = ClassInfo(module=module, name=node.name)
+    for b in node.bases:
+        if isinstance(b, ast.Name):
+            info.bases.append(b.id)
+        elif isinstance(b, ast.Attribute):
+            info.bases.append(b.attr)
+    for item in node.body:
+        if isinstance(item, ast.FunctionDef):
+            info.methods[item.name] = item
+            for stmt in ast.walk(item):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                if len(stmt.targets) != 1:
+                    continue
+                attr = _self_attr(stmt.targets[0])
+                if attr is None:
+                    continue
+                kind = _is_lock_ctor(stmt.value)
+                if kind == "Condition" and isinstance(stmt.value, ast.Call) \
+                        and stmt.value.args:
+                    wrapped = _self_attr(stmt.value.args[0])
+                    if wrapped is not None:
+                        info.aliases[attr] = wrapped
+                        continue
+                if kind is not None:
+                    info.lock_attrs[attr] = LockSite(
+                        path=module, line=stmt.value.lineno,
+                        owner=f"{module}:{node.name}.{attr}")
+                    continue
+                if isinstance(stmt.value, ast.Call):
+                    cname = None
+                    if isinstance(stmt.value.func, ast.Name):
+                        cname = stmt.value.func.id
+                    elif isinstance(stmt.value.func, ast.Attribute):
+                        cname = stmt.value.func.attr
+                    if cname and cname[:1].isupper() or \
+                            (cname and cname.startswith("_")
+                             and cname.lstrip("_")[:1].isupper()):
+                        info.attr_types[attr] = cname
+    return info
+
+
+class _MethodWalker(ast.NodeVisitor):
+    """Walks one method body tracking the set of held locks and
+    recording edges into the graph."""
+
+    def __init__(self, analyzer: "LockAnalyzer", info: ClassInfo,
+                 method: ast.FunctionDef) -> None:
+        self.an = analyzer
+        self.info = info
+        self.method = method
+        self.lock_attrs = analyzer.census.effective_lock_attrs(info)
+        self.aliases = analyzer.census.effective_aliases(info)
+        self.held: List[LockSite] = []
+
+    def _lock_of(self, expr: ast.AST) -> Optional[LockSite]:
+        attr = _self_attr(expr)
+        if attr is None:
+            return None
+        attr = self.aliases.get(attr, attr)
+        return self.lock_attrs.get(attr)
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired: List[LockSite] = []
+        for item in node.items:
+            site = self._lock_of(item.context_expr)
+            if site is not None:
+                for held in self.held:
+                    self.an.graph.add_edge(
+                        held, site,
+                        f"{self.info.module}:{self.info.name}."
+                        f"{self.method.name} line {node.lineno}")
+                acquired.append(site)
+                self.held.append(site)
+        for stmt in node.body:
+            self.visit(stmt)
+        for site in acquired:
+            self.held.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        if not self.held:
+            return
+        # self.method(...) — same-class (or inherited) call
+        callee = node.func
+        if isinstance(callee, ast.Attribute) \
+                and isinstance(callee.value, ast.Name) \
+                and callee.value.id == "self":
+            target = self.an.census.find_method(self.info, callee.attr)
+            if target is not None:
+                self._edges_into(target[0], target[1], node, foreign=False)
+            return
+        # self.attr.method(...) — constructor-typed attribute call
+        if isinstance(callee, ast.Attribute):
+            owner_attr = _self_attr(callee.value)
+            if owner_attr is not None:
+                cname = self.info.attr_types.get(owner_attr)
+                if cname is None:
+                    for base in self.info.bases:
+                        b = self.an.census.resolve(base, self.info.module)
+                        if b is not None and owner_attr in b.attr_types:
+                            cname = b.attr_types[owner_attr]
+                            break
+                if cname is not None:
+                    tcls = self.an.census.resolve(cname, self.info.module)
+                    if tcls is not None:
+                        target = self.an.census.find_method(
+                            tcls, callee.attr)
+                        if target is not None:
+                            self._edges_into(
+                                target[0], target[1], node,
+                                foreign=(target[0].module
+                                         != self.info.module))
+
+    def _edges_into(self, tcls: ClassInfo, method: ast.FunctionDef,
+                    node: ast.Call, foreign: bool) -> None:
+        acquired = self.an.locks_acquired(tcls, method)
+        if not acquired:
+            return
+        context = (f"{self.info.module}:{self.info.name}."
+                   f"{self.method.name} line {node.lineno} calls "
+                   f"{tcls.module}:{tcls.name}.{method.name} while "
+                   f"holding a lock")
+        for held in self.held:
+            for site in acquired:
+                self.an.graph.add_edge(held, site, context)
+        if foreign:
+            self.an.graph.findings.append(Finding(
+                checker="locks",
+                key=(f"held-call:{self.held[-1].owner}->"
+                     f"{tcls.module}:{tcls.name}.{method.name}"),
+                path=self.info.module, line=node.lineno,
+                message=(
+                    f"{self.info.name}.{self.method.name} calls "
+                    f"{tcls.name}.{method.name} ({tcls.module}) while "
+                    f"holding {self.held[-1].owner} — the callee "
+                    f"acquires its own lock(s); a foreign module's "
+                    f"locking discipline inside this critical section "
+                    f"is a lock-order hazard (shrink the region or "
+                    f"move the call out)")))
+
+
+class LockAnalyzer:
+    def __init__(self, root: str,
+                 subdirs: Tuple[str, ...] = DEFAULT_SUBDIRS,
+                 rel_root: Optional[str] = None) -> None:
+        self.root = root
+        self.subdirs = subdirs
+        # site keys are relative to rel_root.  The runtime witness keys
+        # lock construction sites relative to the REPO root, so the
+        # runner passes the repo root here — with the default (the scan
+        # root) the two graphs would use disjoint node names and the
+        # static+runtime merge could never find a cross-proof cycle.
+        self.rel_root = rel_root or root
+        self.census = _Census()
+        self.graph = LockGraph()
+        self._acq_memo: Dict[Tuple[int, str], Set[LockSite]] = {}
+        self._acq_stack: Set[Tuple[int, str]] = set()
+        self._rel: Dict[str, str] = {}
+
+    # -- passes ------------------------------------------------------------
+
+    def collect(self) -> None:
+        for path in _iter_py(self.root, self.subdirs):
+            rel = os.path.relpath(path, self.rel_root)
+            try:
+                with open(path, "r") as f:
+                    tree = ast.parse(f.read(), filename=rel)
+            except SyntaxError:
+                continue
+            for node in tree.body:
+                if isinstance(node, ast.ClassDef):
+                    self.census.add(_collect_class(rel, node))
+        for info in self.census.classes:
+            for site in info.lock_attrs.values():
+                self.graph.sites[site.key] = site
+
+    def analyze(self) -> LockGraph:
+        self.collect()
+        for info in self.census.classes:
+            for method in info.methods.values():
+                _MethodWalker(self, info, method).visit(method)
+        return self.graph
+
+    # -- transitive acquired-set ------------------------------------------
+
+    def locks_acquired(self, info: ClassInfo,
+                       method: ast.FunctionDef) -> Set[LockSite]:
+        """Lock sites a method may acquire, transitively through
+        same-class calls (recursion-guarded, memoized)."""
+        key = (id(info), method.name)
+        if key in self._acq_memo:
+            return self._acq_memo[key]
+        if key in self._acq_stack:
+            return set()
+        self._acq_stack.add(key)
+        out: Set[LockSite] = set()
+        lock_attrs = self.census.effective_lock_attrs(info)
+        aliases = self.census.effective_aliases(info)
+        for node in ast.walk(method):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr is None:
+                        continue
+                    attr = aliases.get(attr, attr)
+                    if attr in lock_attrs:
+                        out.add(lock_attrs[attr])
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "self":
+                target = self.census.find_method(info, node.func.attr)
+                if target is not None:
+                    out |= self.locks_acquired(target[0], target[1])
+        self._acq_stack.discard(key)
+        self._acq_memo[key] = out
+        return out
+
+
+def _find_cycles(edges: Dict[Tuple[str, str], str]
+                 ) -> List[List[str]]:
+    """Strongly-connected components with >1 node (or a self-edge) in
+    the site graph — each is a potential deadlock shape."""
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    counter = [0]
+    sccs: List[List[str]] = []
+
+    def strongconnect(v: str) -> None:
+        # iterative Tarjan (the analyzed graphs are small, but keep
+        # recursion out of library code)
+        work = [(v, iter(sorted(graph[v])))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp: List[str] = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1 or (node, node) in edges:
+                    sccs.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+def cycle_findings(edges: Dict[Tuple[str, str], str],
+                   sites: Optional[Dict[str, LockSite]] = None,
+                   checker: str = "locks") -> List[Finding]:
+    out: List[Finding] = []
+    for comp in _find_cycles(edges):
+        names = []
+        for k in comp:
+            site = (sites or {}).get(k)
+            names.append(site.owner if site is not None else k)
+        first = (sites or {}).get(comp[0])
+        out.append(Finding(
+            checker=checker,
+            key="cycle:" + "+".join(comp),
+            path=first.path if first is not None else "",
+            line=first.line if first is not None else 0,
+            message=("lock-order cycle between " + ", ".join(names)
+                     + " — two threads taking these locks in opposite "
+                       "orders deadlock; impose a single order or "
+                       "collapse to one lock")))
+    return out
+
+
+def check(root: str, subdirs: Tuple[str, ...] = DEFAULT_SUBDIRS,
+          rel_root: Optional[str] = None
+          ) -> Tuple[List[Finding], LockGraph]:
+    """Run the static pass; returns (findings, graph).  The graph's
+    edges are also what the runtime witness merges with — pass
+    ``rel_root`` as the repo root so site keys match the witness's."""
+    analyzer = LockAnalyzer(root, subdirs, rel_root=rel_root)
+    graph = analyzer.analyze()
+    findings = list(graph.findings)
+    findings.extend(cycle_findings(graph.edges, graph.sites))
+    return findings, graph

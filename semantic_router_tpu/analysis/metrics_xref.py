@@ -1,0 +1,180 @@
+"""Metric cross-reference checker (docs/ANALYSIS.md).
+
+PR 3's metrics-lint proves the /metrics *exposition grammar*; this
+checker proves the *referential integrity* of the metric namespace
+across the repo:
+
+- **ghost-reference** — every ``llm_*`` series named in the Grafana
+  dashboard generators (``observability/grafana.py``), the docs, and
+  the deploy configs (KEDA scaler, alerts) must be declared by code.
+  A dashboard panel reading a series nobody exports renders as an
+  eternally-empty graph — the silent failure mode PR 3's grammar lint
+  cannot see;
+- **undocumented-series** — every series code declares must be named by
+  at least one dashboard, doc, or deploy config.  An unreferenced
+  series is cost without an audience, and usually means the docs/
+  dashboards drifted when the series was renamed.
+
+Matching is suffix-aware (``_bucket``/``_sum``/``_count`` resolve to
+their histogram family) and wildcard-aware (a docs mention like
+``llm_runtime_*`` or a trailing-underscore prefix covers every series
+under that prefix).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Set, Tuple
+
+from .findings import Finding
+
+_METRIC_RE = re.compile(r"\bllm_[a-z0-9_]+")
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+# registration calls whose first string arg names the series: the
+# metrics registry factories plus the external-metrics adapter's
+# item() rows (router/server.py serves those to KEDA/HPA directly)
+_DECL_FACTORIES = {"counter", "gauge", "histogram",
+                   "Counter", "Gauge", "Histogram", "item"}
+
+
+@dataclass
+class XrefConfig:
+    root: str
+    package: str = "semantic_router_tpu"
+    # reference surfaces: (label, relative path or dir, extensions)
+    reference_sources: Tuple[Tuple[str, str, Tuple[str, ...]], ...] = (
+        ("grafana", os.path.join("semantic_router_tpu", "observability",
+                                 "grafana.py"), (".py",)),
+        ("docs", "docs", (".md",)),
+        ("readme", "README.md", (".md",)),
+        # deploy/k8s only: the Envoy configs under deploy/envoy use
+        # llm_* as LISTENER/CLUSTER names, not metric series
+        ("deploy", os.path.join("deploy", "k8s"), (".yaml", ".yml")),
+    )
+
+
+@dataclass
+class Xref:
+    declared: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    referenced: Dict[str, List[str]] = field(default_factory=dict)
+    prefixes: Set[str] = field(default_factory=set)  # wildcard covers
+
+
+def _iter_files(base: str, exts: Tuple[str, ...]) -> List[str]:
+    if os.path.isfile(base):
+        return [base]
+    out: List[str] = []
+    for dirpath, _d, filenames in os.walk(base):
+        for fn in sorted(filenames):
+            if fn.endswith(exts):
+                out.append(os.path.join(dirpath, fn))
+    return sorted(out)
+
+
+def collect_declared(root: str, package: str,
+                     skip: Tuple[str, ...] = ("grafana.py",)
+                     ) -> Dict[str, Tuple[str, int]]:
+    """Series registered by code: first string argument of a
+    counter()/gauge()/histogram() (or class-constructor) call."""
+    declared: Dict[str, Tuple[str, int]] = {}
+    for path in _iter_files(os.path.join(root, package), (".py",)):
+        if os.path.basename(path) in skip:
+            continue
+        rel = os.path.relpath(path, root)
+        try:
+            with open(path, "r") as f:
+                tree = ast.parse(f.read(), filename=rel)
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            f_ = node.func
+            name = f_.attr if isinstance(f_, ast.Attribute) else (
+                f_.id if isinstance(f_, ast.Name) else "")
+            if name not in _DECL_FACTORIES:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value,
+                                                            str) \
+                    and arg.value.startswith("llm_"):
+                declared.setdefault(arg.value, (rel, node.lineno))
+    return declared
+
+
+def collect_referenced(cfg: XrefConfig) -> Tuple[Dict[str, List[str]],
+                                                 Set[str]]:
+    referenced: Dict[str, List[str]] = {}
+    prefixes: Set[str] = set()
+    for label, relpath, exts in cfg.reference_sources:
+        base = os.path.join(cfg.root, relpath)
+        if not os.path.exists(base):
+            continue
+        for path in _iter_files(base, exts):
+            rel = os.path.relpath(path, cfg.root)
+            try:
+                with open(path, "r") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            for m in _METRIC_RE.finditer(text):
+                tok = m.group(0)
+                # "llm_runtime_" or "llm_slo_*" style prefix mentions
+                end = m.end()
+                if tok.endswith("_") or (end < len(text)
+                                         and text[end] == "*"):
+                    prefixes.add(tok.rstrip("_") + "_")
+                else:
+                    referenced.setdefault(tok, []).append(
+                        f"{label}:{rel}")
+    return referenced, prefixes
+
+
+def _base_name(name: str, declared: Dict[str, Tuple[str, int]]) -> str:
+    """Resolve histogram sample suffixes to their declared family."""
+    if name in declared:
+        return name
+    for suf in _HIST_SUFFIXES:
+        if name.endswith(suf) and name[: -len(suf)] in declared:
+            return name[: -len(suf)]
+    return name
+
+
+def check(cfg: XrefConfig) -> List[Finding]:
+    findings: List[Finding] = []
+    declared = collect_declared(cfg.root, cfg.package)
+    referenced, prefixes = collect_referenced(cfg)
+
+    # forward: every reference resolves to a declared series
+    for name in sorted(referenced):
+        base = _base_name(name, declared)
+        if base in declared:
+            continue
+        sources = sorted(set(referenced[name]))
+        findings.append(Finding(
+            checker="metrics-xref", key=f"ghost:{name}",
+            path=sources[0].split(":", 1)[1], line=0,
+            message=(f"series {name!r} is referenced by "
+                     f"{', '.join(sources)} but no code declares it — "
+                     f"the panel/doc row reads an eternally-empty "
+                     f"series")))
+
+    # reverse: every declared series is referenced somewhere
+    ref_bases = {_base_name(n, declared) for n in referenced}
+    for name in sorted(declared):
+        if name in ref_bases:
+            continue
+        if any(name.startswith(p) for p in prefixes):
+            continue
+        rel, line = declared[name]
+        findings.append(Finding(
+            checker="metrics-xref", key=f"undocumented:{name}",
+            path=rel, line=line,
+            message=(f"series {name!r} is declared by code but named "
+                     f"by no dashboard, doc, or deploy config — "
+                     f"document it in the metrics table or remove "
+                     f"it")))
+    return findings

@@ -1,0 +1,77 @@
+"""``make analyze`` entry point: run every checker, apply the baseline,
+render the report (docs/ANALYSIS.md).
+
+Budget contract: the whole suite is pure AST + text scanning — no JAX
+import, no model loads, no network — and must finish in well under the
+60 s tier-1 budget asserted by tests/test_analysis.py.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from . import jitpurity, knobs, locks, metrics_xref
+from .findings import Report, apply_baseline, load_baseline
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(_PKG_DIR)
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.toml")
+
+
+def run_all(root: Optional[str] = None,
+            baseline_path: Optional[str] = None) -> Report:
+    root = root or REPO_ROOT
+    baseline_path = baseline_path or BASELINE_PATH
+    findings = []
+    timings = {}
+
+    t0 = time.perf_counter()
+    lock_findings, _graph = locks.check(
+        os.path.join(root, "semantic_router_tpu"), rel_root=root)
+    findings.extend(lock_findings)
+    timings["locks"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    findings.extend(jitpurity.check(
+        os.path.join(root, "semantic_router_tpu")))
+    timings["jit-purity"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    findings.extend(knobs.check(knobs.KnobCheckConfig(root=root)))
+    timings["knobs"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    findings.extend(metrics_xref.check(
+        metrics_xref.XrefConfig(root=root)))
+    timings["metrics-xref"] = time.perf_counter() - t0
+
+    try:
+        suppressions = load_baseline(baseline_path)
+    except ValueError as exc:
+        report = Report(findings=findings)
+        report.errors.append(str(exc))
+        report.timings_s = timings
+        return report
+    report = apply_baseline(findings, suppressions)
+    report.timings_s = timings
+    return report
+
+
+def static_lock_edges(root: Optional[str] = None):
+    """The static lock graph's edges — what the runtime witness merges
+    with at session teardown (tests/conftest.py).  Keyed relative to
+    the REPO root (``rel_root``) so node names line up with the
+    witness's construction-site keys."""
+    root = root or REPO_ROOT
+    _findings, graph = locks.check(
+        os.path.join(root, "semantic_router_tpu"), rel_root=root)
+    return graph.edges
+
+
+def main() -> int:
+    report = run_all()
+    print(report.render())
+    return 0 if report.ok else 1

@@ -1,0 +1,370 @@
+"""Runtime lock-order witness + thread-leak gate (docs/ANALYSIS.md).
+
+``install()`` (called by tests/conftest.py when ``VSR_ANALYZE=1``)
+replaces ``threading.Lock``/``threading.RLock`` with factories that
+wrap locks *constructed from repo code* in a recording proxy.  Each
+successful acquire while other witnessed locks are held records a
+directed edge ``held-site -> acquired-site`` (sites are the
+``relpath:line`` of the lock's construction — exactly the key the
+static pass in analysis/locks.py assigns to
+``self._x = threading.Lock()`` assignments, so both graphs merge).
+
+Locks constructed outside the repo (jax, stdlib, site-packages) get the
+*original* primitives back — zero overhead where we have no business
+watching.  The witness's own state lives behind one raw
+``_thread.allocate_lock`` held only for dict updates (never while
+calling out), so it cannot itself deadlock, and edges are recorded
+first-occurrence-only so steady-state overhead is a thread-local list
+walk per acquire.
+
+``check_lock_order()`` merges the recorded runtime edges with the
+static graph and fails on any cycle; ``check_thread_leaks()`` is the
+companion gate asserting tests leave no stray non-daemon threads and no
+unexpected daemon threads (allowlisted process-lifetime threads aside).
+Both run from the conftest session hook under ``VSR_ANALYZE=1``.
+"""
+
+from __future__ import annotations
+
+import _thread
+import os
+import re
+import sys
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .findings import Finding
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_MAX_EDGES = 20_000
+
+_state_lock = _thread.allocate_lock()
+_edges: Dict[Tuple[str, str], str] = {}   # (held, acquired) -> context
+_tls = threading.local()
+
+_orig_lock = None
+_orig_rlock = None
+_installed = False
+
+
+def _held() -> List["_WitnessLock"]:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+def _counts() -> Dict[int, int]:
+    counts = getattr(_tls, "counts", None)
+    if counts is None:
+        counts = _tls.counts = {}
+    return counts
+
+
+def _construction_site() -> Optional[str]:
+    """repo-relative ``path:line`` of the frame that called the lock
+    factory, or None when construction happened outside the repo."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.endswith(("witness.py", "threading.py")) \
+                and "importlib" not in fn:
+            break
+        f = f.f_back
+    if f is None:
+        return None
+    fn = os.path.abspath(f.f_code.co_filename)
+    if not fn.startswith(_REPO_ROOT + os.sep):
+        return None
+    rel = os.path.relpath(fn, _REPO_ROOT)
+    # tests construct locks too; witness them under their test path so
+    # fixture-driven counter-proofs work, but keep virtualenv dirs out
+    if rel.split(os.sep, 1)[0] in (".venv", "venv", "node_modules"):
+        return None
+    return f"{rel}:{f.f_lineno}"
+
+
+def _note_edges(held: List["_WitnessLock"],
+                lock: "_WitnessLock") -> None:
+    if len(_edges) >= _MAX_EDGES:
+        return
+    tname = threading.current_thread().name
+    new_edges = []
+    for h in held:
+        if h.site != lock.site:
+            pair = (h.site, lock.site)
+            if pair not in _edges:
+                new_edges.append(pair)
+    if new_edges:
+        with _state_lock:
+            for pair in new_edges:
+                _edges.setdefault(
+                    pair, f"runtime: thread {tname!r} acquired "
+                          f"{pair[1]} while holding {pair[0]}")
+
+
+def _record_acquire(lock: "_WitnessLock") -> None:
+    """Reentrancy-aware bookkeeping (RLock wrappers); plain Lock
+    wrappers go through the leaner fast path in acquire()."""
+    if lock._reentrant:
+        counts = _counts()
+        lid = id(lock)
+        n = counts.get(lid, 0)
+        counts[lid] = n + 1
+        if n > 0:
+            return  # reentrant re-acquire: not an ordering event
+    held = _held()
+    if held:
+        _note_edges(held, lock)
+    held.append(lock)
+
+
+def _record_release(lock: "_WitnessLock") -> None:
+    if lock._reentrant:
+        counts = _counts()
+        lid = id(lock)
+        n = counts.get(lid, 0)
+        if n > 1:
+            counts[lid] = n - 1
+            return
+        counts.pop(lid, None)
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] is lock:
+            del held[i]
+            break
+
+
+class _WitnessLock:
+    """Recording proxy over a raw lock/RLock.  Implements the full
+    Condition-compatible protocol (``_release_save`` / ``_acquire_restore``
+    / ``_is_owned`` forward to the inner RLock with witness bookkeeping
+    kept consistent, so ``threading.Condition()`` wait/notify works
+    unchanged over witnessed locks)."""
+
+    __slots__ = ("_inner", "site", "_reentrant")
+
+    def __init__(self, inner, site: str, reentrant: bool) -> None:
+        self._inner = inner
+        self.site = site
+        self._reentrant = reentrant
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _record_acquire(self)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        _record_release(self)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    # -- Condition protocol (only meaningful for RLock inners) -------------
+
+    def _is_owned(self):
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        # plain Lock: mirror threading.Condition's fallback probe
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        if hasattr(self._inner, "_release_save"):
+            counts = _counts()
+            saved = counts.get(id(self), 0)
+            state = self._inner._release_save()
+            counts.pop(id(self), None)
+            held = _held()
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] is self:
+                    del held[i]
+                    break
+            return (state, saved)
+        self.release()
+        return (None, 1)
+
+    def _acquire_restore(self, token) -> None:
+        state, saved = token
+        if state is not None:
+            self._inner._acquire_restore(state)
+            # re-entering the monitor after wait(): same ordering event
+            # as a fresh acquire
+            _record_acquire(self)
+            if saved > 1:
+                _counts()[id(self)] = saved
+        else:
+            self.acquire()
+
+    def __repr__(self) -> str:
+        return f"<WitnessLock {self.site} over {self._inner!r}>"
+
+
+def _make_lock():
+    site = _construction_site()
+    if site is None or not _installed:
+        return _thread.allocate_lock()
+    return _WitnessLock(_thread.allocate_lock(), site, reentrant=False)
+
+
+def _make_rlock():
+    site = _construction_site()
+    if site is None or not _installed:
+        return _orig_rlock() if _orig_rlock is not None \
+            else threading._PyRLock()
+    # the pure-python RLock exposes _release_save/_acquire_restore/
+    # _is_owned, which the Condition protocol above forwards to
+    return _WitnessLock(threading._PyRLock(), site, reentrant=True)
+
+
+def install() -> None:
+    """Patch the threading lock factories.  Idempotent; locks created
+    before install stay raw (they simply go unwitnessed)."""
+    global _installed, _orig_lock, _orig_rlock
+    if _installed:
+        return
+    _orig_lock = threading.Lock
+    _orig_rlock = threading.RLock
+    _installed = True
+    threading.Lock = _make_lock
+    threading.RLock = _make_rlock
+
+
+def uninstall() -> None:
+    global _installed
+    if not _installed:
+        return
+    threading.Lock = _orig_lock
+    threading.RLock = _orig_rlock
+    _installed = False
+
+
+def enabled() -> bool:
+    return _installed
+
+
+def reset() -> None:
+    """Drop recorded edges (between counter-proof tests)."""
+    with _state_lock:
+        _edges.clear()
+
+
+def runtime_edges() -> Dict[Tuple[str, str], str]:
+    with _state_lock:
+        return dict(_edges)
+
+
+class capture:
+    """Scoped edge capture for counter-proof tests: edges recorded
+    inside the ``with`` block land in ``.edges`` and are REMOVED from
+    the global store on exit, so a deliberately-planted inversion in a
+    self-test can never fail the session-level gate."""
+
+    def __enter__(self) -> "capture":
+        with _state_lock:
+            self._before = set(_edges)
+        self.edges: Dict[Tuple[str, str], str] = {}
+        return self
+
+    def __exit__(self, *exc) -> None:
+        with _state_lock:
+            for k in list(_edges):
+                if k not in self._before:
+                    self.edges[k] = _edges.pop(k)
+
+
+# -- gates -----------------------------------------------------------------
+
+
+def check_lock_order(static_edges: Optional[
+        Dict[Tuple[str, str], str]] = None,
+        extra_edges: Optional[Dict[Tuple[str, str], str]] = None
+        ) -> List[Finding]:
+    """Cycle check over the MERGED static + runtime graph — a runtime
+    edge A->B plus a static edge B->A is exactly the inversion neither
+    view alone proves.  Findings carry checker="locks", the SAME
+    namespace as the static pass's cycle findings, so one baseline
+    entry governs both halves of the gate (`make analyze` and the
+    VSR_ANALYZE session hook must never disagree about a suppression)."""
+    from .locks import cycle_findings
+
+    merged: Dict[Tuple[str, str], str] = {}
+    for src in (static_edges or {}), runtime_edges(), (extra_edges or {}):
+        for pair, ctx in src.items():
+            merged.setdefault(pair, ctx)
+    return cycle_findings(merged, sites=None, checker="locks")
+
+
+def thread_snapshot() -> Set[threading.Thread]:
+    """Snapshot by Thread OBJECT identity, not ident: CPython recycles
+    idents, so an ident-keyed baseline could silently mask a leaked
+    thread that happens to reuse a departed thread's id."""
+    return set(threading.enumerate())
+
+
+# Intentionally process-lifetime threads: these are created once per
+# process by module-level machinery and survive registry detach by
+# design.  Everything else must be gone when its owner shuts down.
+DEFAULT_THREAD_ALLOWLIST = (
+    r"^pydevd\.",          # debugger internals, when present
+    r"^asyncio_\d+$",
+    r"^ThreadPoolExecutor-",  # stdlib atexit-joined pools (e.g. jax's)
+    r"^jax_",              # jax internal service threads
+    r"^grpc-default-executor",
+)
+
+
+def check_thread_leaks(baseline: Iterable[threading.Thread],
+                       allowlist: Iterable[str] = DEFAULT_THREAD_ALLOWLIST,
+                       grace_s: float = 3.0) -> List[Finding]:
+    """Non-daemon threads the session created must be gone; daemon
+    threads must match the allowlist.  A short grace window lets
+    bounded teardown (timers, joins already in flight) finish.
+    ``baseline`` is a set of Thread OBJECTS (thread_snapshot())."""
+    baseline = set(baseline)
+    patterns = [re.compile(p) for p in allowlist]
+    deadline = time.monotonic() + grace_s
+
+    def leaked() -> List[threading.Thread]:
+        out = []
+        for t in threading.enumerate():
+            if t is threading.current_thread():
+                continue
+            if t in baseline or not t.is_alive():
+                continue
+            if any(p.search(t.name or "") for p in patterns):
+                continue
+            out.append(t)
+        return out
+
+    remaining = leaked()
+    while remaining and time.monotonic() < deadline:
+        time.sleep(0.05)
+        remaining = leaked()
+    findings: List[Finding] = []
+    for t in remaining:
+        kind = "daemon" if t.daemon else "NON-DAEMON"
+        findings.append(Finding(
+            checker="thread-leak",
+            key=f"leak:{t.name}",
+            message=(
+                f"{kind} thread {t.name!r} survived the test session — "
+                f"a component started it and never stopped it "
+                f"(shutdown()/detach must join worker threads; "
+                f"process-lifetime threads belong on the conftest "
+                f"allowlist with a justification)")))
+    return findings

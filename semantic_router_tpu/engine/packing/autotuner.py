@@ -53,7 +53,16 @@ class ShapeAutoTuner:
         self.unblock_after_steps = max(1, int(unblock_after_steps))
         self._policy: Dict[str, Dict[str, Any]] = {}
         self._blocked_at: Dict[tuple, int] = {}  # (group, bucket) → step
+        # writer lock only.  READS go through the immutable published
+        # snapshot below: the scheduler calls blocked()/policy() from
+        # inside the batcher's composition regions (under the batcher
+        # lock), so a read that took this lock would be a lock-held
+        # foreign acquisition — the exact hazard `make analyze`'s
+        # lock-order witness polices.  Writers build fresh dicts and
+        # swap ONE reference (atomic under the GIL); readers never
+        # block and never see a half-applied policy.
         self._lock = threading.Lock()
+        self._published: Dict[str, Dict[str, Any]] = {}
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.steps = 0
@@ -62,14 +71,14 @@ class ShapeAutoTuner:
     # -- the decision ------------------------------------------------------
 
     def policy(self, group: str) -> Dict[str, Any]:
-        """The live policy for one batch group (empty = defaults)."""
-        with self._lock:
-            return dict(self._policy.get(group, {}))
+        """The live policy for one batch group (empty = defaults).
+        Lock-free: reads the published snapshot (callers sit inside
+        batcher-lock regions)."""
+        return dict(self._published.get(group, {}))
 
     def blocked(self, group: str, bucket: int) -> bool:
-        with self._lock:
-            return bucket in self._policy.get(group, {}).get(
-                "blocked_buckets", ())
+        return bucket in self._published.get(group, {}).get(
+            "blocked_buckets", ())
 
     def step(self) -> Dict[str, Dict[str, Any]]:
         """One tuning pass over the program registry; returns the new
@@ -140,24 +149,29 @@ class ShapeAutoTuner:
                         pol["blocked_buckets"] = [
                             x for x in pol["blocked_buckets"] if x != b]
                         self.retunes += 1
+            self._publish_locked()
         return self.policy_map()
+
+    def _publish_locked(self) -> None:
+        """Swap in a fresh immutable snapshot of the policy map (caller
+        holds ``_lock``).  One reference assignment — readers observe
+        either the whole old policy or the whole new one."""
+        self._published = {g: dict(p) for g, p in self._policy.items()}
 
     def _current_segments(self, group: str) -> int:
         """The group's LIVE cap: its own policy, else the configured
         floor — never another group's raised cap (the scheduler reads
         the same per-group value through the engine's segment_cap_of,
         so take-time and pack-time plans can't diverge)."""
-        with self._lock:
-            pol = self._policy.get(group, {})
-            try:
-                return max(1, int(pol.get("max_segments_per_row",
-                                          self.segments_floor)))
-            except (TypeError, ValueError):
-                return self.segments_floor
+        pol = self._published.get(group, {})
+        try:
+            return max(1, int(pol.get("max_segments_per_row",
+                                      self.segments_floor)))
+        except (TypeError, ValueError):
+            return self.segments_floor
 
     def policy_map(self) -> Dict[str, Dict[str, Any]]:
-        with self._lock:
-            return {g: dict(p) for g, p in self._policy.items()}
+        return {g: dict(p) for g, p in self._published.items()}
 
     def report(self) -> Dict[str, Any]:
         return {"steps": self.steps, "retunes": self.retunes,

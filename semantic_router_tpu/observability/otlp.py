@@ -196,11 +196,13 @@ class OTLPExporter(_BatchingExporter):
         self._wake.set()  # unblock the flusher so it exits promptly
 
 
-def build_exporter_from_config(obs_cfg: Dict,
+def build_exporter_from_config(tr: Dict,
                                tracer: Tracer) -> Optional[OTLPExporter]:
-    """observability.tracing.otlp_endpoint wires the exporter at
-    bootstrap; absent config → tracing stays in-proc only."""
-    tr = (obs_cfg or {}).get("tracing", {}) or {}
+    """``tr`` is the NORMALIZED tracing block —
+    ``RouterConfig.tracing_config()``, the one interpretation point for
+    observability.tracing (bootstrap passes it; never re-derive the
+    sub-dict here).  Absent endpoint → tracing stays in-proc only."""
+    tr = tr or {}
     endpoint = tr.get("otlp_endpoint", "")
     if not endpoint:
         return None
@@ -292,14 +294,16 @@ class OTLPLogExporter(_BatchingExporter):
         self._wake.set()
 
 
-def build_log_exporter_from_config(obs_cfg: Dict, explainer
+def build_log_exporter_from_config(tr: Dict, explainer
                                    ) -> Optional[OTLPLogExporter]:
     """Decision records export to the SAME collector endpoint the spans
-    use (observability.tracing.otlp_endpoint → ``/v1/logs``); absent
-    endpoint or explainer → records stay in-proc only."""
+    use (``tracing_config()["otlp_endpoint"]`` → ``/v1/logs``); ``tr``
+    is the normalized tracing block, same contract as
+    :func:`build_exporter_from_config`.  Absent endpoint or explainer →
+    records stay in-proc only."""
     if explainer is None:
         return None
-    tr = (obs_cfg or {}).get("tracing", {}) or {}
+    tr = tr or {}
     endpoint = tr.get("otlp_endpoint", "")
     if not endpoint:
         return None

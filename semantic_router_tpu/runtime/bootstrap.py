@@ -899,11 +899,11 @@ def serve(config_path: str, port: int = 8801,
     )
 
     server.otlp_exporter = build_exporter_from_config(
-        cfg.observability, server.registry.tracer)
+        cfg.tracing_config(), server.registry.tracer)
     # decision records export as OTLP log records to the same collector
     # (audit pipelines read /v1/logs; the trace id links back to spans)
     server.otlp_log_exporter = build_log_exporter_from_config(
-        cfg.observability, server.registry.get("explain"))
+        cfg.tracing_config(), server.registry.get("explain"))
 
     # observability knobs: applied here AND on config hot-reload (edits
     # to sample_rate / exemplars / flight_recorder must not need a

@@ -186,6 +186,10 @@ class VectorStoreManager:
         self.stateplane = stateplane
         self._stores: Dict[str, InMemoryVectorStore] = {}
         self._lock = threading.Lock()
+        # serializes CREATE end-to-end (rare admin op; network I/O is
+        # fine here) without ever holding the hot _lock across I/O —
+        # see create() for why both locks exist
+        self._create_lock = threading.Lock()
         self._qdrant = None
 
     def _qdrant_client(self):
@@ -261,16 +265,53 @@ class VectorStoreManager:
 
         return os.path.join(self.base_path or ".", f"{name}.vectorstore.db")
 
+    @staticmethod
+    def _close_store(store) -> None:
+        """Release a fully-constructed store that lost a publish race
+        (open sqlite handle / remote attachment must not leak)."""
+        for closer in ("close", "stop"):
+            fn = getattr(store, closer, None)
+            if callable(fn):
+                try:
+                    fn()
+                except Exception:
+                    pass
+                return
+
     def create(self, name: str, **kwargs) -> InMemoryVectorStore:
         import os
 
-        with self._lock:
-            if name in self._stores or (
-                    self.backend == "sqlite"
-                    and os.path.exists(self._db_path(name))):
-                raise ValueError(f"store {name!r} exists")
+        # _create_lock serializes create-vs-create end-to-end, so a
+        # true duplicate still raises at this pre-check (the original
+        # single-lock semantics).  The hot _lock is NEVER held across
+        # construction: remote backends do network I/O there (stateplane
+        # attach, qdrant/milvus collection calls) and holding the
+        # manager lock across a round-trip stalls every store op — the
+        # lock-order witness flagged exactly that edge.
+        with self._create_lock:
+            with self._lock:
+                if name in self._stores or (
+                        self.backend == "sqlite"
+                        and os.path.exists(self._db_path(name))):
+                    raise ValueError(f"store {name!r} exists")
             store = self._new_store(name, **kwargs)
-            self._stores[name] = store
+            with self._lock:
+                published = self._stores.setdefault(name, store)
+                if published is not store and kwargs:
+                    # with creates serialized, the only racer here is a
+                    # READER (get()) that discovered our freshly-written
+                    # artifacts and re-attached — but its attachment was
+                    # built WITHOUT our kwargs, so the creator's
+                    # configured store must win the mapping.  The
+                    # reader's object stays alive (it may already be in
+                    # use; both back the same artifacts).
+                    self._stores[name] = store
+                    published = store
+            if published is not store:
+                # kwargs-less creation lost to an equivalent reader
+                # attachment: drop our duplicate handle, keep theirs
+                self._close_store(store)
+                store = published
         self._registry_register(name)
         return store
 
@@ -319,17 +360,33 @@ class VectorStoreManager:
         except Exception:
             return None  # unreachable server: behave as absent
         with self._lock:  # publish (first attacher wins)
-            return self._stores.setdefault(name, store)
+            published = self._stores.setdefault(name, store)
+        if published is not store:
+            self._close_store(store)  # lost the race: release the dup
+        return published
 
     def get_or_create(self, name: str) -> InMemoryVectorStore:
         existing = self.get(name)
         if existing is not None:
             return existing
-        # remote-backend construction does network I/O — build OUTSIDE
-        # the lock (same invariant get() documents), publish under it
-        store = self._new_store(name)
-        with self._lock:
-            store = self._stores.setdefault(name, store)
+        # _create_lock: creation (here AND create()) is serialized, so
+        # create(name, **kwargs) can never lose its publish to a
+        # kwargs-less builder racing through this path — the only
+        # publisher that can beat a creation is get()'s reader-attach,
+        # which attaches to the creator's own artifacts
+        with self._create_lock:
+            with self._lock:
+                store = self._stores.get(name)
+            if store is not None:
+                return store
+            # remote-backend construction does network I/O — build
+            # OUTSIDE the hot lock (same invariant get() documents),
+            # publish under it
+            built = self._new_store(name)
+            with self._lock:
+                store = self._stores.setdefault(name, built)
+            if store is not built:
+                self._close_store(built)  # reader attached first
         self._registry_register(name)
         return store
 

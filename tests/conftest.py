@@ -26,6 +26,82 @@ import pathlib
 
 import pytest
 
+# -- analysis mode (docs/ANALYSIS.md) ---------------------------------------
+#
+# VSR_ANALYZE=1 (always-on for the smoke suites via their Makefile
+# targets, opt-in elsewhere) arms two session-level gates:
+#
+#   * the runtime lock-order witness: threading.Lock/RLock constructed
+#     from repo code record acquisition-order edges during the run; at
+#     session end the edges merge with the static lock graph
+#     (analysis/locks.py) and any cycle fails the session;
+#   * the thread-leak gate: the session must end with no new
+#     non-daemon threads and no unexpected daemon threads.
+#
+# The witness is installed AFTER the jax import above: jax's internal
+# locks predate it (and out-of-repo constructions get raw primitives
+# back anyway), so tier-1 overhead stays <5% on the smoke suites.
+
+VSR_ANALYZE = os.environ.get("VSR_ANALYZE", "") not in ("", "0")
+
+# Intentionally process-lifetime threads (beyond the witness defaults).
+# Every entry needs a reason — this list is the thread-leak baseline.
+THREAD_ALLOWLIST = (
+    # jax CPU client callback/dispatch threads live for the process
+    r"^jax",
+    # stdlib concurrent.futures pools joined at interpreter exit
+    r"^ThreadPoolExecutor-",
+)
+
+_thread_baseline = None
+
+if VSR_ANALYZE:
+    from semantic_router_tpu.analysis import witness as _witness
+
+    _witness.install()
+
+
+def pytest_sessionstart(session):
+    global _thread_baseline
+    if VSR_ANALYZE:
+        _thread_baseline = _witness.thread_snapshot()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not VSR_ANALYZE:
+        return
+    from semantic_router_tpu.analysis import (
+        BASELINE_PATH,
+        load_baseline,
+        static_lock_edges,
+    )
+    from semantic_router_tpu.analysis.findings import apply_baseline
+    from semantic_router_tpu.analysis.witness import (
+        DEFAULT_THREAD_ALLOWLIST,
+    )
+
+    problems = _witness.check_lock_order(static_lock_edges())
+    problems += _witness.check_thread_leaks(
+        _thread_baseline or set(),
+        allowlist=tuple(DEFAULT_THREAD_ALLOWLIST) + THREAD_ALLOWLIST)
+    # honor baseline.toml here too: a justified suppression must mean
+    # the same thing to `make analyze` and to this session gate (stale-
+    # entry hygiene is `make analyze`'s job, not the smoke suites')
+    try:
+        sup = [s for s in load_baseline(BASELINE_PATH)
+               if s.checker in ("locks", "thread-leak")]
+        problems = apply_baseline(problems, sup).findings
+    except ValueError:
+        pass  # malformed baseline fails `make analyze` with the detail
+    if problems:
+        print("\n=== VSR_ANALYZE session gates FAILED ===")
+        for f in problems:
+            print(f.render())
+        print(f"({len(_witness.runtime_edges())} runtime lock edges "
+              f"recorded this session)")
+        session.exitstatus = 1
+
+
 FIXTURES = pathlib.Path(__file__).parent / "fixtures"
 
 

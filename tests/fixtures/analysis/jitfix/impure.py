@@ -1,0 +1,21 @@
+"""Planted jit-impurity: ``entry`` is jit'd and (directly and through
+``_inner``) hits every host-sync pattern the lint must flag.  Never
+imported — the checker parses, it does not execute."""
+
+import time
+
+import jax
+
+
+def _inner(x):
+    return float(x.sum())          # flag: float() on a traced value
+
+
+def entry(x):
+    t = time.time()                # flag: trace-time side effect
+    y = x * 2
+    v = y.item()                   # flag: host sync
+    return _inner(y) + v + t
+
+
+entry_jit = jax.jit(entry)

@@ -1,0 +1,13 @@
+"""Negative control: shape arithmetic (`.shape`, len()) is static
+under tracing and must NOT be flagged."""
+
+import jax
+
+
+def entry(x):
+    rows = int(x.shape[0])
+    n = len(x)
+    return x.reshape(rows * n // n, -1)
+
+
+entry_jit = jax.jit(entry)

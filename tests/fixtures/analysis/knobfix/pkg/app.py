@@ -1,0 +1,9 @@
+"""Reads ``wired_block`` legitimately via its normalizer — and plants
+one knob-bypass (.get() on the raw block outside the schema)."""
+
+
+def serve(cfg):
+    knobs = cfg.foo_config()
+    # planted violation: raw block interpreted outside the normalizer
+    bad = cfg.wired_block.get("documented_knob", 99)
+    return knobs, bad

@@ -1,0 +1,24 @@
+"""Planted knob-wiring violations (analysis/knobs.py counter-proof):
+``orphan_block`` is parsed but read nowhere; ``ghost_config`` is a
+normalizer nothing applies; ``foo_config`` interprets
+``undocumented_secret_knob`` which no docs table mentions."""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+
+@dataclass
+class RouterConfig:
+    wired_block: Dict[str, Any] = field(default_factory=dict)
+    orphan_block: Dict[str, Any] = field(default_factory=dict)
+    phantom_block: Dict[str, Any] = field(default_factory=dict)
+
+    def foo_config(self) -> Dict[str, Any]:
+        wb = dict(self.wired_block or {})
+        return {
+            "documented_knob": int(wb.get("documented_knob", 3)),
+            "secret": bool(wb.get("undocumented_secret_knob", False)),
+        }
+
+    def ghost_config(self) -> Dict[str, Any]:
+        return dict(self.phantom_block or {})

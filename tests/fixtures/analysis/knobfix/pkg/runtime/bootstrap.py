@@ -1,0 +1,11 @@
+"""Planted apply-once violation: ``apply_foo_knobs`` exists but is
+called exactly once (boot only — no hot-reload call site)."""
+
+
+def apply_foo_knobs(cfg, registry):
+    registry.configure(cfg.foo_config())
+
+
+def run_server(cfg, registry):
+    apply_foo_knobs(cfg, registry)   # boot only: the reload half is
+    return registry                  # deliberately missing

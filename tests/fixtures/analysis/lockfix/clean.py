@@ -1,0 +1,21 @@
+"""Negative control: consistent one-directional nesting (outer→inner
+everywhere) must produce edges but NO cycle and NO held-call finding
+(both classes live in this one module)."""
+
+import threading
+
+
+class Outer:
+    def __init__(self):
+        self._outer = threading.Lock()
+        self._inner = threading.Lock()
+
+    def a(self):
+        with self._outer:
+            with self._inner:
+                return 1
+
+    def b(self):
+        with self._outer:
+            with self._inner:
+                return 2

@@ -1,0 +1,21 @@
+"""Planted lock-order cycle: ``forward`` takes a→b, ``backward`` takes
+b→a.  analysis/locks.py must flag the cycle (tests/test_analysis.py).
+Never imported by product code."""
+
+import threading
+
+
+class Inverted:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def backward(self):
+        with self._b:
+            with self._a:
+                return 2

@@ -1,0 +1,18 @@
+"""Planted lock-held foreign call: ``Caller.poke`` calls into
+``mod_c.Helper.bump`` (which takes its own lock) while holding
+``Caller._lock``.  analysis/locks.py must emit a ``held-call`` finding
+plus the cross-module edge.  Never imported by product code."""
+
+import threading
+
+from .mod_c import Helper
+
+
+class Caller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.helper = Helper()
+
+    def poke(self):
+        with self._lock:
+            return self.helper.bump()

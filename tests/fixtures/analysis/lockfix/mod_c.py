@@ -1,0 +1,15 @@
+"""Lock-owning callee for the planted lock-held foreign call
+(mod_b.py)."""
+
+import threading
+
+
+class Helper:
+    def __init__(self):
+        self._hlock = threading.Lock()
+        self.count = 0
+
+    def bump(self):
+        with self._hlock:
+            self.count += 1
+            return self.count

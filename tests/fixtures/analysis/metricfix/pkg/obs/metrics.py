@@ -1,0 +1,13 @@
+"""Planted metric-xref violations: ``llm_fix_orphan_total`` is
+declared but referenced nowhere; docs/METRICS.md names
+``llm_fix_ghost_total`` which nothing declares."""
+
+
+class Registry:
+    def counter(self, name, help_):
+        return (name, help_)
+
+
+def build(reg: Registry):
+    reg.counter("llm_fix_requests_total", "requests (documented)")
+    reg.counter("llm_fix_orphan_total", "declared but never documented")

@@ -1,17 +1,59 @@
 """Every declared config knob has a reader (the r4 verdict's dead-knob
 class: a parsed-but-unread field silently lies to operators).
 
-Covers the two knobs a field-vs-reader scan found dead after
-use_flash_attention was wired: semantic_cache.embedding_model and
-engine.matryoshka_layers/dims.
+Two layers:
+
+- **exhaustive** (TestExhaustiveKnobWiring): the analysis suite's knob
+  checker derives the WHOLE surface from config/schema.py — every
+  RouterConfig field read somewhere, every ``*_config`` normalizer
+  applied, every ``apply_*_knobs`` called at boot AND reload, every
+  interpreted knob key in a docs table, no raw-block ``.get()`` outside
+  the schema.  The spot checks below stay because they prove *runtime*
+  behavior (the knob value actually changes what the code does), which
+  a static cross-check cannot.
+- **spot** (the original two dead-knob regressions):
+  semantic_cache.embedding_model and engine.matryoshka_layers/dims.
 """
 
 import numpy as np
 import pytest
 
+from semantic_router_tpu.analysis import knobs as knob_checker
+from semantic_router_tpu.analysis.runner import REPO_ROOT
 from semantic_router_tpu.config import load_config
 from semantic_router_tpu.config.schema import InferenceEngineConfig
 from semantic_router_tpu.engine.testing import make_test_engine
+
+
+class TestExhaustiveKnobWiring:
+    """The whole knob surface, derived from the schema — not a curated
+    list that rots (docs/ANALYSIS.md)."""
+
+    def test_every_knob_wired_documented_and_normalized(self):
+        from semantic_router_tpu.analysis import (
+            BASELINE_PATH,
+            load_baseline,
+        )
+        from semantic_router_tpu.analysis.findings import apply_baseline
+
+        findings = knob_checker.check(
+            knob_checker.KnobCheckConfig(root=REPO_ROOT))
+        sup = [s for s in load_baseline(BASELINE_PATH)
+               if s.checker == "knobs"]
+        rep = apply_baseline(findings, sup)
+        assert rep.findings == [], "\n".join(
+            f.render() for f in rep.findings)
+
+    def test_surface_is_nonempty(self):
+        # guard against the checker silently deriving nothing (an empty
+        # surface would pass forever)
+        surface = knob_checker._schema_surface(
+            knob_checker.KnobCheckConfig(root=REPO_ROOT))
+        fields, normalizers = surface[0], surface[1]
+        assert len(fields) >= 25, sorted(fields)
+        assert {"resilience_config", "stateplane_config",
+                "flywheel_config", "upstream_config",
+                "packing_config"} <= set(normalizers)
 
 
 class TestCacheEmbeddingModelKnob:

@@ -333,7 +333,10 @@ class TestOTLPExport:
 
         tracer = Tracer()
         assert build_exporter_from_config({}, tracer) is None
+        # the builder takes the NORMALIZED tracing block
+        # (RouterConfig.tracing_config()), not the whole observability
+        # dict — the knob checker enforces the one interpretation point
         exp = build_exporter_from_config(
-            {"tracing": {"otlp_endpoint": "http://127.0.0.1:9"}}, tracer)
+            {"otlp_endpoint": "http://127.0.0.1:9"}, tracer)
         assert exp is not None
         exp.detach(tracer)

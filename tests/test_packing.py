@@ -578,6 +578,10 @@ class TestAutoTuner:
             with tuner._lock:
                 tuner._policy["trunk:trunk0"] = {
                     "blocked_buckets": [32, 128, 512]}
+                # readers consume the lock-free published snapshot
+                # (blocked()/policy() must not take the tuner lock from
+                # inside batcher-lock regions — see make analyze)
+                tuner._publish_locked()
             rs = eng._runtime_stats
             rs.clear()
             eng.classify_batch("intent", MIXED_TEXTS)

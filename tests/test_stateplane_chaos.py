@@ -83,12 +83,17 @@ def stack():
     mon.event_bus = r0.registry.get("events")
     mon.configure({"objectives": ["signal error-rate < 1% over 0.2s"]})
     r0.controller.bind(slo=mon)
-    yield {"mini": mini, "port": port, "fleet": fleet, "proxy": proxy,
-           "monitor": mon, "backend": backend}
+    stack = {"mini": mini, "port": port, "fleet": fleet, "proxy": proxy,
+             "monitor": mon, "backend": backend}
+    yield stack
     fleet.stop()
     proxy.stop()
     backend.stop()
-    mini.stop()
+    # stop the CURRENT server: the backend-restart leg replaces
+    # stack["mini"] with a fresh MiniRedis after killing the original —
+    # stopping the stale local here leaked the restarted server's
+    # accept thread (caught by the VSR_ANALYZE thread-leak gate)
+    stack["mini"].stop()
 
 
 class TestFleetConvergence:
