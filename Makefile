@@ -3,8 +3,8 @@ SHELL := /bin/bash
 
 .PHONY: test test-fast tier1 trace-smoke metrics-lint explain-smoke \
 	resilience-smoke fleet-smoke flywheel-smoke upstream-smoke \
-	packing-smoke analyze native bench bench-replay perf perf-record \
-	serve-mock clean
+	packing-smoke kernels-smoke analyze native bench bench-replay \
+	perf perf-record serve-mock clean
 
 bench-replay:
 	$(PY) benchmarks/replay_bench.py --n 500 \
@@ -45,8 +45,8 @@ metrics-lint:
 # retrievable, schema-valid decision record whose replay reproduces the
 # identical model choice.  Tier-1 (runs inside `make tier1` too).
 explain-smoke:
-	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_explain_smoke.py \
-	  -q -p no:cacheprovider
+	env JAX_PLATFORMS=cpu VSR_ANALYZE=1 $(PY) -m pytest \
+	  tests/test_explain_smoke.py -q -p no:cacheprovider
 
 # overload-control gate (docs/RESILIENCE.md): chaos e2e over the
 # routing pipeline — fault_proxy plans + an injected slow/erroring
@@ -56,8 +56,13 @@ explain-smoke:
 # recover to L0 with hysteresis once the faults clear, with every
 # transition visible as runtime events + metrics + decision-record
 # annotations.  Tier-1 (runs inside `make tier1` too).
+# VSR_ANALYZE=1 (ROADMAP PR 12 follow-on): thread-lifecycle audited —
+# the kubewatch watch threads and the durable decision store's writer
+# now shut down bounded, so the lock-order witness + thread-leak gate
+# arm here like on the packing/fleet smokes.
 resilience-smoke:
-	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience.py \
+	env JAX_PLATFORMS=cpu VSR_ANALYZE=1 $(PY) -m pytest \
+	  tests/test_resilience.py \
 	  tests/test_resilience_chaos.py -q -p no:cacheprovider
 
 # multi-replica gate (docs/STATE_PLANE.md): 3 in-process router
@@ -84,6 +89,18 @@ packing-smoke:
 	env JAX_PLATFORMS=cpu VSR_ANALYZE=1 $(PY) -m pytest \
 	  tests/test_packing.py -q -p no:cacheprovider
 
+# quantized-trunk + tuned-kernel gate (docs/KERNELS.md): quantization
+# parity (per-dtype golden logits + calibrated top-class agreement),
+# the Pallas epilogue/BGMV kernels driven in INTERPRET mode against
+# their XLA oracles (no TPU required — compiled kernels only run
+# on-chip), engine-level BGMV ≤1e-4 parity vs the padded all-heads
+# matmul across LoRA'd/packed/deduped batches, the hot-flip contract,
+# and the engine.quant/engine.kernels knob wiring.  Tier-1 (runs
+# inside `make tier1` too).
+kernels-smoke:
+	env JAX_PLATFORMS=cpu VSR_ANALYZE=1 $(PY) -m pytest \
+	  tests/test_kernels.py -q -p no:cacheprovider
+
 # repo-native analysis gate (docs/ANALYSIS.md): the static lock-order
 # graph + cycle check, the jit-purity lint, the knob-wiring
 # cross-check (schema -> normalizer -> bootstrap boot+reload -> docs
@@ -107,7 +124,8 @@ analyze:
 # NOTHING about routing, and walks the canary → promote → SLO-burn
 # rollback ladder.  Tier-1 (runs inside `make tier1` too).
 flywheel-smoke:
-	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_flywheel.py \
+	env JAX_PLATFORMS=cpu VSR_ANALYZE=1 $(PY) -m pytest \
+	  tests/test_flywheel.py \
 	  tests/test_flywheel_smoke.py -q -p no:cacheprovider
 
 # upstream-failover gate (docs/RESILIENCE.md "Upstream failover"):
@@ -120,7 +138,8 @@ flywheel-smoke:
 # default) must route byte-identically.  Tier-1 (runs inside
 # `make tier1` too).
 upstream-smoke:
-	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_upstream.py \
+	env JAX_PLATFORMS=cpu VSR_ANALYZE=1 $(PY) -m pytest \
+	  tests/test_upstream.py \
 	  tests/test_upstream_chaos.py -q -p no:cacheprovider
 
 native:

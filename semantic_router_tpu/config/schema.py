@@ -966,6 +966,16 @@ class InferenceEngineConfig:
     # fixed-batch behavior; hot-reloadable via bootstrap
     # apply_packing_knobs.
     packing: Dict[str, Any] = field(default_factory=dict)
+    # quantized trunk serving mode (docs/KERNELS.md): raw knob block
+    # normalized by engine.kernels.normalize_quant — mode off|bf16|int8
+    # (default off = byte-identical), per-trunk-group selector, parity
+    # calibration.  Hot-reloadable via bootstrap apply_kernel_knobs.
+    quant: Dict[str, Any] = field(default_factory=dict)
+    # tuned-kernel toggles (docs/KERNELS.md): raw knob block normalized
+    # by engine.kernels.normalize_kernels — head-bank epilogue fusion +
+    # the BGMV per-item gather.  All default OFF; hot-reloadable via
+    # bootstrap apply_kernel_knobs.
+    kernels: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "InferenceEngineConfig":
@@ -981,6 +991,8 @@ class InferenceEngineConfig:
             dispatch_workers=int(d.get("dispatch_workers", 4)),
             fuse_trunks=bool(d.get("fuse_trunks", True)),
             packing=dict(d.get("packing", {}) or {}),
+            quant=dict(d.get("quant", {}) or {}),
+            kernels=dict(d.get("kernels", {}) or {}),
         )
         if d.get("seq_len_buckets"):
             out.seq_len_buckets = [int(x) for x in d["seq_len_buckets"]]
@@ -993,6 +1005,20 @@ class InferenceEngineConfig:
         from ..engine.packing import normalize_packing
 
         return normalize_packing(self.packing)
+
+    def quant_config(self) -> Dict[str, Any]:
+        """Normalized engine.quant block (docs/KERNELS.md) — same
+        delegation pattern as packing_config: engine.kernels owns the
+        ONE interpretation point."""
+        from ..engine.kernels import normalize_quant
+
+        return normalize_quant(self.quant)
+
+    def kernels_config(self) -> Dict[str, Any]:
+        """Normalized engine.kernels block (docs/KERNELS.md)."""
+        from ..engine.kernels import normalize_kernels
+
+        return normalize_kernels(self.kernels)
 
 
 DEFAULT_RECIPE_NAME = "default"

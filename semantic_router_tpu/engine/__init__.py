@@ -7,6 +7,7 @@ from .classify import (
     TokenClassResult,
     TrunkGroup,
 )
+from .kernels import normalize_kernels, normalize_quant
 from .packing import (
     PackedBatch,
     PackingBatcher,
@@ -20,6 +21,6 @@ __all__ = [
     "BatchItem", "ClassResult", "DynamicBatcher", "EntitySpan",
     "InferenceEngine", "PackedBatch", "PackingBatcher",
     "ShapeAutoTuner", "TRUNK_KEY", "TokenClassResult", "TrunkGroup",
-    "normalize_packing", "pack_items", "pick_bucket", "plan_take",
-    "pow2_batch",
+    "normalize_kernels", "normalize_packing", "normalize_quant",
+    "pack_items", "pick_bucket", "plan_take", "pow2_batch",
 ]

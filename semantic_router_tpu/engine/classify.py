@@ -45,6 +45,7 @@ import numpy as np
 from ..config.schema import InferenceEngineConfig
 from ..utils.tokenization import Encoding, Tokenizer, decode_entity_spans
 from .batcher import BatchItem, DynamicBatcher, pick_bucket, pow2_batch
+from .kernels import normalize_kernels, normalize_quant, quant_selects
 from .packing import (
     RowPlan,
     PackingBatcher,
@@ -212,8 +213,17 @@ class TrunkGroup:
     # the fused jit program set keyed by flavor: seq / tok / both plus
     # their packed_* siblings (engine.packing) — all share the ONE trunk
     # forward; the runner picks by batch contents, so a batch with no
-    # token items never pays the per-token head matmul
+    # token items never pays the per-token head matmul.  The dict ALSO
+    # carries "trunk_params" (the SERVING trunk tree — the quantized
+    # variant when engine.quant selects this group) and "meta" (the
+    # kernel-knob snapshot these programs were built under), so one
+    # atomic read pairs programs with the params they trace against —
+    # a hot knob flip swaps the whole dict (docs/KERNELS.md)
     fns: Any = None
+    # packed-shape census rows carried across a kernel-flip rebuild so
+    # warmup_packed_hot can recompile the previously hot shapes against
+    # the NEW program set (the rebuild purged their compile records)
+    warm_hints: Any = None
     # atomic demux snapshot (banks + row maps + widths): the runner
     # reads ONE consistent view, so a concurrent re-registration can
     # never pair new row indices with old logits ordering
@@ -321,6 +331,14 @@ class InferenceEngine:
                 self.batcher.name, self._rs_provider_fn)
         except Exception:
             pass
+        # raw-engine-speed knob blocks (docs/KERNELS.md): quantized
+        # trunk serving mode + tuned-kernel toggles, normalized through
+        # the ONE interpretation point (engine.kernels) — defaults all
+        # OFF, so an unconfigured engine serves byte-identically
+        self._quant = normalize_quant(getattr(self.cfg, "quant", None))
+        self._kernels = normalize_kernels(getattr(self.cfg, "kernels",
+                                                  None))
+        self._kernel_rebuilds = 0
         # fused classifier bank: trunk fingerprint → TrunkGroup, plus the
         # task→group and gid→group views the hot path reads
         self._trunk_groups: Dict[tuple, TrunkGroup] = {}
@@ -553,10 +571,104 @@ class InferenceEngine:
             "tok_row_of": dict(g.tok_row_of),
             "tok_widths": list(g.tok_widths),
         }
-        if g.fns is None:
-            g.apply_fn = self._make_fused_fn(g)
+        self._refresh_serving(g, locked=True)
 
-    def _make_fused_fn(self, g: TrunkGroup):
+    # -- kernel/quant serving programs (docs/KERNELS.md) -------------------
+
+    def _serving_meta(self, g: TrunkGroup) -> dict:
+        """The kernel-knob snapshot one group's programs build under:
+        quant mode (per-group selector), epilogue fusion, and whether
+        the BGMV gather engages (bank at least min_tasks heads wide)."""
+        kk = self._kernels
+        return {
+            "quant": quant_selects(self._quant, g.gid, g.members),
+            "epilogue": bool(kk["epilogue"]["enabled"]),
+            "bgmv": bool(kk["bgmv"]["enabled"]
+                         and len(g.widths) >= kk["bgmv"]["min_tasks"]),
+        }
+
+    def _refresh_serving(self, g: TrunkGroup,
+                         locked: bool = False) -> None:
+        """(Re)build the group's fused program set when the kernel-knob
+        snapshot changed (or none exists yet).  The swap is ONE dict
+        assignment — in-flight batches finish on the programs they
+        already read; the next step serves the new set (the hot-flip
+        contract, tests/test_kernels.py).  A real rebuild purges the
+        group's compile records (the new programs' jit caches are cold)
+        but keeps the packed-shape census as warm_hints so
+        warmup_packed_hot can recompile the hot shapes off-path.
+
+        ``locked``: the caller already holds self._lock (the
+        registration path — _rebuild_bank runs under it); the purge
+        must not re-acquire the non-reentrant lock."""
+        meta = self._serving_meta(g)
+        old = g.fns
+        if old is not None and old.get("meta") == meta:
+            return
+        g.fns = self._make_fused_fn(g, meta)
+        g.apply_fn = g.fns["seq"]
+        if old is not None:
+            self._kernel_rebuilds += 1
+            self._series().kernel_rebuilds.inc(group=g.gid)
+            group = f"trunk:{g.gid}"
+
+            def purge():
+                keys = [k for k in self._compiled_steps
+                        if k[0] == group]
+                self._compiled_steps = {
+                    k for k in self._compiled_steps if k[0] != group}
+                return keys
+
+            if locked:
+                keys = purge()
+            else:
+                with self._lock:
+                    keys = purge()
+            # MERGE with hints a prior rebuild already saved: a dual
+            # flip (quant AND kernels in one reload) rebuilds twice,
+            # and the second purge sees an empty registry — overwriting
+            # would drop the first rebuild's census
+            g.warm_hints = sorted(
+                set(self._parse_census_keys(keys))
+                | {tuple(r) for r in (g.warm_hints or ())})
+
+    def configure_quant(self, knobs: Optional[Dict[str, Any]]) -> None:
+        """Apply the engine.quant block (boot + config hot reload):
+        normalize through the ONE interpretation point, then rebuild
+        each affected trunk group's serving programs — quantization of
+        the weights happens HERE (once), never on the forward path."""
+        self._quant = normalize_quant(knobs)
+        for g in list(self._groups_by_gid.values()):
+            self._refresh_serving(g)
+
+    def configure_kernels(self, knobs: Optional[Dict[str, Any]]) -> None:
+        """Apply the engine.kernels block (boot + config hot reload):
+        epilogue fusion + BGMV gather toggles; same rebuild contract as
+        configure_quant."""
+        self._kernels = normalize_kernels(knobs)
+        for g in list(self._groups_by_gid.values()):
+            self._refresh_serving(g)
+
+    def kernels_report(self) -> Dict[str, Any]:
+        """Operator snapshot (GET /debug/runtime rides this): the live
+        normalized knob blocks, per-group serving meta, and how many
+        hot flips rebuilt jit program sets this process."""
+        out: Dict[str, Any] = {
+            "quant": {k: (dict(v) if isinstance(v, dict) else
+                          list(v) if isinstance(v, list) else v)
+                      for k, v in self._quant.items()},
+            "kernels": {k: dict(v) for k, v in self._kernels.items()},
+            "rebuilds": self._kernel_rebuilds,
+        }
+        groups = {}
+        for gid, g in list(self._groups_by_gid.items()):
+            fns = g.fns
+            if fns is not None:
+                groups[gid] = dict(fns["meta"])
+        out["groups"] = groups
+        return out
+
+    def _make_fused_fn(self, g: TrunkGroup, meta: Optional[dict] = None):
         """Build the group's fused jit program set.  Every flavor shares
         the SAME trunk forward; only the head application differs:
 
@@ -568,8 +680,18 @@ class InferenceEngine:
           per-SEGMENT pooling for sequence heads (docs/PACKING.md).
 
         jit() is free until called: flavors a deployment never uses are
-        never compiled."""
-        from ..models.lora import apply_head_bank
+        never compiled.
+
+        ``meta`` (engine.kernels / engine.quant snapshot,
+        _serving_meta) shapes the programs: quant swaps the trunk for
+        its bf16/int8 serving variant (models.quant.build_quant_trunk —
+        weights transform HERE, once, never per step); epilogue routes
+        the head banks through the fused Pallas epilogue; bgmv swaps
+        the all-heads sequence matmul for the per-pair gather, which
+        adds (pair_rows, pair_tasks) operands to the seq-carrying
+        flavors.  The returned dict carries the SERVING trunk params +
+        the meta so the runner reads one consistent snapshot."""
+        from ..models.lora import apply_head_bank, apply_head_bank_bgmv
         from ..models.modernbert import activation
         from ..ops.attention import (
             cls_pool,
@@ -579,9 +701,25 @@ class InferenceEngine:
         )
 
         cfg = g.config
+        meta = dict(meta or {"quant": "off", "epilogue": False,
+                             "bgmv": False})
         act = activation(cfg.classifier_activation)
         use_mean = cfg.classifier_pooling == "mean"
-        trunk = g.trunk_module
+        if meta["quant"] == "off":
+            trunk, serving_params = g.trunk_module, g.trunk_params
+        else:
+            from ..models.quant import build_quant_trunk
+
+            trunk, serving_params = build_quant_trunk(
+                cfg, g.trunk_params, meta["quant"])
+            if serving_params is not g.trunk_params:
+                # int8: commit the quantized leaves to device ONCE — a
+                # host-numpy tree would re-upload per batch through the
+                # jit boundary
+                serving_params = jax.tree_util.tree_map(
+                    jnp.asarray, serving_params)
+        epilogue = meta["epilogue"]
+        bgmv = meta["bgmv"]
 
         def hidden_fn(trunk_params, ids, mask, pos=None, seg=None):
             return trunk.apply({"params": trunk_params}, ids, mask,
@@ -596,62 +734,104 @@ class InferenceEngine:
                 if use_mean else packed_cls_pool(hidden, seg_row,
                                                  seg_start)
 
+        def seq_heads(bank, pooled, pair_rows=None, pair_tasks=None):
+            if bgmv:
+                return apply_head_bank_bgmv(bank, pooled, pair_rows,
+                                            pair_tasks, act,
+                                            cfg.norm_eps)
+            return apply_head_bank(bank, pooled, act, cfg.norm_eps,
+                                   epilogue=epilogue)
+
         def tok_heads(tok_bank, hidden):
             B, S, H = hidden.shape
             flat = apply_head_bank(tok_bank, hidden.reshape(B * S, H),
-                                   act, cfg.norm_eps)
+                                   act, cfg.norm_eps, epilogue=epilogue)
             return flat.reshape(B, S, flat.shape[-2], flat.shape[-1])
 
-        def seq_fn(trunk_params, bank, ids, mask):
-            h = hidden_fn(trunk_params, ids, mask)
-            return apply_head_bank(bank, pool(h, mask), act, cfg.norm_eps)
+        if bgmv:
+            def seq_fn(trunk_params, bank, ids, mask, pr, pt):
+                h = hidden_fn(trunk_params, ids, mask)
+                return seq_heads(bank, pool(h, mask), pr, pt)
+
+            def both_fn(trunk_params, bank, tok_bank, ids, mask, pr,
+                        pt):
+                h = hidden_fn(trunk_params, ids, mask)
+                return (seq_heads(bank, pool(h, mask), pr, pt),
+                        tok_heads(tok_bank, h))
+
+            def packed_seq_fn(trunk_params, bank, ids, mask, pos, seg,
+                              seg_row, seg_start, pr, pt):
+                h = hidden_fn(trunk_params, ids, mask, pos, seg)
+                return seq_heads(bank, ppool(h, seg, seg_row,
+                                             seg_start), pr, pt)
+
+            def packed_both_fn(trunk_params, bank, tok_bank, ids, mask,
+                               pos, seg, seg_row, seg_start, pr, pt):
+                h = hidden_fn(trunk_params, ids, mask, pos, seg)
+                return (seq_heads(bank, ppool(h, seg, seg_row,
+                                              seg_start), pr, pt),
+                        tok_heads(tok_bank, h))
+        else:
+            def seq_fn(trunk_params, bank, ids, mask):
+                h = hidden_fn(trunk_params, ids, mask)
+                return seq_heads(bank, pool(h, mask))
+
+            def both_fn(trunk_params, bank, tok_bank, ids, mask):
+                h = hidden_fn(trunk_params, ids, mask)
+                return (seq_heads(bank, pool(h, mask)),
+                        tok_heads(tok_bank, h))
+
+            def packed_seq_fn(trunk_params, bank, ids, mask, pos, seg,
+                              seg_row, seg_start):
+                h = hidden_fn(trunk_params, ids, mask, pos, seg)
+                return seq_heads(bank, ppool(h, seg, seg_row,
+                                             seg_start))
+
+            def packed_both_fn(trunk_params, bank, tok_bank, ids, mask,
+                               pos, seg, seg_row, seg_start):
+                h = hidden_fn(trunk_params, ids, mask, pos, seg)
+                return (seq_heads(bank, ppool(h, seg, seg_row,
+                                              seg_start)),
+                        tok_heads(tok_bank, h))
 
         def tok_fn(trunk_params, tok_bank, ids, mask):
-            return tok_heads(tok_bank, hidden_fn(trunk_params, ids, mask))
-
-        def both_fn(trunk_params, bank, tok_bank, ids, mask):
-            h = hidden_fn(trunk_params, ids, mask)
-            return (apply_head_bank(bank, pool(h, mask), act,
-                                    cfg.norm_eps),
-                    tok_heads(tok_bank, h))
-
-        def packed_seq_fn(trunk_params, bank, ids, mask, pos, seg,
-                          seg_row, seg_start):
-            h = hidden_fn(trunk_params, ids, mask, pos, seg)
-            return apply_head_bank(bank, ppool(h, seg, seg_row,
-                                               seg_start),
-                                   act, cfg.norm_eps)
+            return tok_heads(tok_bank, hidden_fn(trunk_params, ids,
+                                                 mask))
 
         def packed_tok_fn(trunk_params, tok_bank, ids, mask, pos, seg):
             return tok_heads(tok_bank,
-                             hidden_fn(trunk_params, ids, mask, pos, seg))
+                             hidden_fn(trunk_params, ids, mask, pos,
+                                       seg))
 
-        def packed_both_fn(trunk_params, bank, tok_bank, ids, mask, pos,
-                           seg, seg_row, seg_start):
-            h = hidden_fn(trunk_params, ids, mask, pos, seg)
-            return (apply_head_bank(bank, ppool(h, seg, seg_row,
-                                                seg_start),
-                                    act, cfg.norm_eps),
-                    tok_heads(tok_bank, h))
+        if g.traced_fns is None:
+            # the fenced batch-trace split programs stay STOCK math
+            # (unquantized trunk, einsum heads): they only serve
+            # detailed sampled batches, which the runner gates on the
+            # stock meta so traced numbers describe what actually runs
+            stock_trunk = g.trunk_module
 
-        def trunk_pool(trunk_params, ids, mask):
-            return pool(hidden_fn(trunk_params, ids, mask), mask)
+            def trunk_pool(trunk_params, ids, mask):
+                h = stock_trunk.apply({"params": trunk_params}, ids,
+                                      mask)
+                return pool(h, mask)
 
-        def heads(bank, pooled):
-            return apply_head_bank(bank, pooled, act, cfg.norm_eps)
+            def heads(bank, pooled):
+                return apply_head_bank(bank, pooled, act, cfg.norm_eps)
 
-        # jit() is free until called: sampled batch traces pay the split
-        # programs' compiles, untraced traffic never touches them
-        g.traced_fns = (jax.jit(trunk_pool), jax.jit(heads))
-        g.fns = {
+            # jit() is free until called: sampled batch traces pay the
+            # split programs' compiles, untraced traffic never touches
+            # them
+            g.traced_fns = (jax.jit(trunk_pool), jax.jit(heads))
+        return {
             "seq": jax.jit(seq_fn),
             "tok": jax.jit(tok_fn),
             "both": jax.jit(both_fn),
             "packed_seq": jax.jit(packed_seq_fn),
             "packed_tok": jax.jit(packed_tok_fn),
             "packed_both": jax.jit(packed_both_fn),
+            "trunk_params": serving_params,
+            "meta": meta,
         }
-        return g.fns["seq"]
 
     def trunk_group_info(self) -> Dict[str, List[str]]:
         """gid → member task names (management API / tests)."""
@@ -1352,17 +1532,23 @@ class InferenceEngine:
                     ids[:, 0] = 1
                     mask = np.ones((padded_n, b), np.int32)
                     ids_dev, mask_dev = self._to_device(ids, mask)
+                    fns = g.fns
+                    tp = fns["trunk_params"]
+                    # BGMV programs carry the pair operands; warm the
+                    # 1-pair entry shape (other pair widths compile on
+                    # demand — each is one more pow2 program)
+                    pair = (jnp.zeros(1, jnp.int32),
+                            jnp.zeros(1, jnp.int32)) \
+                        if fns["meta"]["bgmv"] else ()
                     if g.bank is not None:
-                        jax.block_until_ready(g.fns["seq"](
-                            g.trunk_params, g.bank, ids_dev, mask_dev))
+                        jax.block_until_ready(fns["seq"](
+                            tp, g.bank, ids_dev, mask_dev, *pair))
                     if g.tok_bank is not None:
-                        jax.block_until_ready(g.fns["tok"](
-                            g.trunk_params, g.tok_bank, ids_dev,
-                            mask_dev))
+                        jax.block_until_ready(fns["tok"](
+                            tp, g.tok_bank, ids_dev, mask_dev))
                         if g.bank is not None:
-                            out = g.fns["both"](g.trunk_params, g.bank,
-                                                g.tok_bank, ids_dev,
-                                                mask_dev)
+                            out = fns["both"](tp, g.bank, g.tok_bank,
+                                              ids_dev, mask_dev, *pair)
                             jax.block_until_ready(out)
                     if g.traced_fns is not None and g.bank is not None:
                         # the split batch-trace programs (batchtrace
@@ -1381,15 +1567,28 @@ class InferenceEngine:
 
     def _warm_packed(self, g: TrunkGroup, bucket: int) -> None:
         """Pre-compile the hot packed programs for one (group, bucket):
-        a 1-row, 2-segment packed batch per flavor.  Other (rows, K)
-        shapes still compile on demand — each is one more program, but
-        this covers the min_segments entry shape every packed bucket
-        hits first."""
+        a 1-row, 2-segment packed batch per flavor — the min_segments
+        entry shape every packed bucket hits first.  Other (rows, K)
+        shapes warm from the compiled-step census via
+        warmup_packed_hot (docs/PACKING.md "packed-path warmup")."""
+        self._warm_packed_shape(g, bucket, k_pad=2,
+                                padded_rows=self._padded_batch(1))
+
+    def _warm_packed_shape(self, g: TrunkGroup, bucket: int, k_pad: int,
+                           padded_rows: int, pair_pad: int = 0,
+                           flavors: Optional[Sequence[str]] = None
+                           ) -> bool:
+        """Compile one packed (padded_rows, bucket, K_pad) program set
+        off the dispatch path, then MARK it in the compiled-step
+        registry: the first real packed step of this shape is a warm
+        execute and must account as one (cold-count stays flat —
+        tests/test_packing.py TestPackedWarmup)."""
         if not self._packing["enabled"] or self.mesh is not None \
                 or g.fns is None \
                 or getattr(g.config, "attention_impl",
                            "dense") != "dense":
-            return
+            return False
+        fns = g.fns
         try:
             class _WarmEnc:
                 """Minimal Encoding shim so warmup builds its packed
@@ -1404,31 +1603,113 @@ class InferenceEngine:
                 def __len__(self) -> int:
                     return len(self.ids)
 
+            k_eff = max(2, int(k_pad))
             half = max(1, bucket // 2)
             pb = pack_items(
                 [_WarmEnc(half), _WarmEnc(bucket - half)], bucket,
                 g.pad_id, max_rows=1, max_segments_per_row=2,
-                pad_rows_to=self._padded_batch(1), pad_segments_to=2)
+                pad_rows_to=padded_rows, pad_segments_to=k_eff)
             ids_dev, mask_dev = self._to_device(pb.ids, pb.mask)
             pos_dev = jnp.asarray(pb.position_ids)
             seg_dev = jnp.asarray(pb.segment_ids)
             row_dev = jnp.asarray(pb.seg_row)
             start_dev = jnp.asarray(pb.seg_start)
-            if g.bank is not None:
-                jax.block_until_ready(g.fns["packed_seq"](
-                    g.trunk_params, g.bank, ids_dev, mask_dev,
-                    pos_dev, seg_dev, row_dev, start_dev))
-            if g.tok_bank is not None:
-                jax.block_until_ready(g.fns["packed_tok"](
-                    g.trunk_params, g.tok_bank, ids_dev, mask_dev,
+            tp = fns["trunk_params"]
+            if fns["meta"]["bgmv"]:
+                pp = int(pair_pad) or 2
+                pair = (jnp.zeros(pp, jnp.int32),
+                        jnp.zeros(pp, jnp.int32))
+                sfx = f":p{pp}"
+            else:
+                pair, sfx = (), ""
+            want = set(flavors or ("seq", "tok", "both"))
+            if g.bank is not None and "seq" in want:
+                jax.block_until_ready(fns["packed_seq"](
+                    tp, g.bank, ids_dev, mask_dev,
+                    pos_dev, seg_dev, row_dev, start_dev, *pair))
+                self._step_fresh(f"trunk:{g.gid}",
+                                 f"packed:seq:{k_eff}{sfx}",
+                                 (padded_rows, bucket))
+            if g.tok_bank is not None and "tok" in want:
+                jax.block_until_ready(fns["packed_tok"](
+                    tp, g.tok_bank, ids_dev, mask_dev,
                     pos_dev, seg_dev))
-                if g.bank is not None:
-                    out = g.fns["packed_both"](
-                        g.trunk_params, g.bank, g.tok_bank, ids_dev,
-                        mask_dev, pos_dev, seg_dev, row_dev, start_dev)
-                    jax.block_until_ready(out)
+                self._step_fresh(f"trunk:{g.gid}",
+                                 f"packed:tok:{k_eff}",
+                                 (padded_rows, bucket))
+            if g.bank is not None and g.tok_bank is not None \
+                    and "both" in want:
+                out = fns["packed_both"](
+                    tp, g.bank, g.tok_bank, ids_dev, mask_dev,
+                    pos_dev, seg_dev, row_dev, start_dev, *pair)
+                jax.block_until_ready(out)
+                self._step_fresh(f"trunk:{g.gid}",
+                                 f"packed:both:{k_eff}{sfx}",
+                                 (padded_rows, bucket))
+            return True
         except Exception:
-            pass
+            return False
+
+    def _packed_census_rows(self, gid: str) -> list:
+        """Packed program shapes this engine has executed for one
+        group, recovered from the compiled-step registry:
+        (bucket, k_pad, padded_rows, flavor, pair_pad) tuples — the
+        shape census warmup_packed_hot recompiles after a retune or a
+        kernel-flip rebuild."""
+        group = f"trunk:{gid}"
+        with self._lock:
+            keys = [k for k in self._compiled_steps if k[0] == group]
+        return self._parse_census_keys(keys)
+
+    @staticmethod
+    def _parse_census_keys(keys) -> list:
+        out = set()
+        for k in keys:
+            variant = k[1]
+            if not variant.startswith("packed:"):
+                continue
+            try:
+                parts = variant.split(":")
+                flavor, k_pad = parts[1], int(parts[2])
+                pair_pad = int(parts[3][1:]) if len(parts) > 3 else 0
+                padded_rows, bucket = int(k[2]), int(k[3])
+            except (IndexError, ValueError):
+                continue
+            out.add((bucket, k_pad, padded_rows, flavor, pair_pad))
+        return sorted(out)
+
+    def packed_shape_census(self) -> Dict[str, list]:
+        """gid → packed shape rows (operator/tests view)."""
+        return {gid: self._packed_census_rows(gid)
+                for gid in list(self._groups_by_gid)}
+
+    def warmup_packed_hot(self) -> int:
+        """Pre-compile every packed shape the census (plus any
+        warm_hints a kernel-flip rebuild carried over) says is hot,
+        against the CURRENT program set.  Bootstrap calls this at
+        apply-knobs time (boot + hot reload) so the first packed step
+        after a boot/retune/kernel-flip is a warm execute, not an
+        inline XLA compile on the dispatch worker.  Returns the number
+        of shapes warmed."""
+        n = 0
+        for gid, g in list(self._groups_by_gid.items()):
+            rows = set(self._packed_census_rows(gid))
+            rows.update(tuple(r) for r in (g.warm_hints or ()))
+            # rows that cannot warm RIGHT NOW (packing hot-disabled, a
+            # transient failure) stay as hints — re-enabling packing
+            # later must still find the hot shapes to warm
+            remaining = set()
+            for row in sorted(rows):
+                bucket, k_pad, padded_rows, flavor, pair_pad = row
+                if self._warm_packed_shape(g, bucket, k_pad,
+                                           padded_rows,
+                                           pair_pad=pair_pad,
+                                           flavors=(flavor,)):
+                    n += 1
+                else:
+                    remaining.add(row)
+            g.warm_hints = sorted(remaining) if remaining else None
+        return n
 
     def _matryoshka_variants(self):
         """(exit_layer, output_dim) pairs to pre-compile: the full model
@@ -1775,8 +2056,12 @@ class InferenceEngine:
         # ONE consistent demux view (banks + row maps + widths) for this
         # whole batch: a concurrent re-registration swaps g.demux
         # atomically and can never pair new row indices with this
-        # batch's logits ordering
+        # batch's logits ordering.  The program set snapshots the same
+        # way: a hot kernel/quant flip swaps g.fns atomically, and this
+        # batch finishes on the (programs, serving trunk params, meta)
+        # triple it read here — never a torn mix
         demux = g.demux
+        fns = g.fns
         n = len(items)
         # identical token sequences within the batch ride a SINGLE
         # trunk row (the trunk output depends only on ids+mask; per-item
@@ -1826,7 +2111,7 @@ class InferenceEngine:
         # the unpacked path bit-identically
         pk = self._packing
         packable = (pk["enabled"] and self.mesh is None
-                    and g.fns is not None
+                    and fns is not None
                     and getattr(g.config, "attention_impl",
                                 "dense") == "dense")
         use_packed = False
@@ -1860,10 +2145,52 @@ class InferenceEngine:
                                                  items[mid:])))
         if use_packed:
             return self._run_fused_packed(g, gid, bucket, items, urow,
-                                          uniq_items, demux, flavor,
-                                          max_segs, plan_rows)
+                                          uniq_items, demux, fns,
+                                          flavor, max_segs, plan_rows)
         return self._run_fused_unpacked(g, gid, bucket, items, urow,
-                                        uniq_items, demux, flavor)
+                                        uniq_items, demux, fns, flavor)
+
+    def _bgmv_pairs(self, items: List[BatchItem], urow: List[int],
+                    demux: dict):
+        """(pair_rows, pair_tasks, pair_index) for the BGMV gather path
+        (docs/KERNELS.md): one pair per distinct (trunk row, bank row) a
+        sequence task in this batch needs — deduped items share pairs
+        exactly like they share trunk rows.  The pair axis pads to a
+        power of two (dummy pairs compute row 0 × task 0 and demux to
+        nothing) so it joins the closed static-shape set."""
+        pair_index: Dict[tuple, int] = {}
+        for i, item in enumerate(items):
+            for task in item.payload.tasks:
+                t = self._tasks.get(task)
+                if t is None or t.kind == "token":
+                    continue
+                key = (urow[i], demux["row_of"][task])
+                if key not in pair_index:
+                    pair_index[key] = len(pair_index)
+        n = max(1, len(pair_index))
+        p_pad = 1 << (n - 1).bit_length()
+        pr = np.zeros(p_pad, np.int32)
+        pt = np.zeros(p_pad, np.int32)
+        for (u, row), p in pair_index.items():
+            pr[p] = u
+            pt[p] = row
+        return pr, pt, pair_index
+
+    def _count_kernel_step(self, gid: str, meta: dict,
+                           used_bgmv: bool) -> None:
+        """llm_engine_kernel_steps_total: device steps served through
+        each tuned-kernel path — the operator's proof the knobs are
+        actually on the hot path, not just accepted by config."""
+        if not (meta["quant"] != "off" or meta["epilogue"] or used_bgmv):
+            return
+        m = self._series()
+        if meta["quant"] != "off":
+            m.kernel_steps.inc(group=gid,
+                               kernel=f"quant_{meta['quant']}")
+        if meta["epilogue"]:
+            m.kernel_steps.inc(group=gid, kernel="epilogue")
+        if used_bgmv:
+            m.kernel_steps.inc(group=gid, kernel="bgmv")
 
     # -- fused demux helpers -----------------------------------------------
 
@@ -1910,12 +2237,22 @@ class InferenceEngine:
     def _run_fused_unpacked(self, g: TrunkGroup, gid: str, bucket: int,
                             items: List[BatchItem], urow: List[int],
                             uniq_items: List[BatchItem], demux: dict,
-                            flavor: str) -> Sequence[Any]:
+                            fns: dict, flavor: str) -> Sequence[Any]:
         """The fixed-row fused path: one trunk row per unique encoding,
         padded to the bucket edge — exactly the pre-packing behavior."""
         n_rows = len(uniq_items)
         padded_n = self._padded_batch(n_rows)
         bank, tok_bank = demux["bank"], demux["tok_bank"]
+        meta = fns["meta"]
+        tparams = fns["trunk_params"]
+        use_bgmv = meta["bgmv"] and flavor in ("seq", "both")
+        pr_dev = pt_dev = pair_index = None
+        pair_sfx = ""
+        if use_bgmv:
+            pr, pt, pair_index = self._bgmv_pairs(items, urow, demux)
+            pr_dev, pt_dev = jnp.asarray(pr), jnp.asarray(pt)
+            # the padded pair count is its own static program dimension
+            pair_sfx = f":p{pr.shape[0]}"
 
         from ..observability import batchtrace
         from ..observability.profiler import trace_span
@@ -1933,8 +2270,13 @@ class InferenceEngine:
             max_batch=self.cfg.max_batch_size, padded_rows=padded_n,
             kind="fused")
         try:
+            # detailed (fenced-split) sampling only describes the STOCK
+            # programs: with a kernel/quant knob live, the split
+            # programs would time math the serving path no longer runs
             detailed = step is not None and step.detailed \
-                and g.traced_fns is not None and flavor == "seq"
+                and g.traced_fns is not None and flavor == "seq" \
+                and meta["quant"] == "off" and not meta["epilogue"] \
+                and not use_bgmv
             with batchtrace.stage(step, "stack"):
                 ids, mask, clipped = self._stack_items(uniq_items,
                                                        bucket,
@@ -1947,7 +2289,7 @@ class InferenceEngine:
             self._note_shape(f"trunk:{gid}", (padded_n, bucket))
             variant = "fused_detailed" if detailed else "fused"
             fresh = self._step_fresh(f"trunk:{gid}",
-                                     f"{variant}:{flavor}",
+                                     f"{variant}:{flavor}{pair_sfx}",
                                      (padded_n, bucket))
             tokens_real = sum(min(len(it.payload.encoding), bucket)
                               for it in uniq_items)
@@ -1969,15 +2311,18 @@ class InferenceEngine:
                     # the default hot path: one fused program, no fences
                     # (non-detailed traced batches still get step + ride
                     # continuity spans from finish())
-                    seq_logits = g.fns["seq"](g.trunk_params, bank,
-                                              ids_dev, mask_dev)
+                    args = (tparams, bank, ids_dev, mask_dev)
+                    if use_bgmv:
+                        args += (pr_dev, pt_dev)
+                    seq_logits = fns["seq"](*args)
                 elif flavor == "tok":
-                    tok_logits = g.fns["tok"](g.trunk_params, tok_bank,
-                                              ids_dev, mask_dev)
+                    tok_logits = fns["tok"](tparams, tok_bank,
+                                            ids_dev, mask_dev)
                 else:
-                    seq_logits, tok_logits = g.fns["both"](
-                        g.trunk_params, bank, tok_bank, ids_dev,
-                        mask_dev)
+                    args = (tparams, bank, tok_bank, ids_dev, mask_dev)
+                    if use_bgmv:
+                        args += (pr_dev, pt_dev)
+                    seq_logits, tok_logits = fns["both"](*args)
                 if seq_logits is not None:
                     seq_logits = np.asarray(jax.device_get(seq_logits),
                                             dtype=np.float32)
@@ -1995,6 +2340,7 @@ class InferenceEngine:
                               tokens_padded=padded_n * bucket,
                               segments=n_rows)
             self._series().trunk_forwards.inc(group=gid, path="fused")
+            self._count_kernel_step(gid, meta, use_bgmv)
 
             demux_cm = batchtrace.stage(step, "demux")
             now = time.perf_counter()
@@ -2019,10 +2365,15 @@ class InferenceEngine:
                             row = demux["row_of"][task]
                             width = demux["widths"][row]
                             # fan the shared trunk row's logits out to
-                            # every duplicate item at demux
-                            p = _softmax(
-                                seq_logits[urow[i], row,
-                                           :width][None, :])[0]
+                            # every duplicate item at demux; the BGMV
+                            # path demuxes by PAIR instead of (row,
+                            # task) — same logits, gathered on device
+                            if use_bgmv:
+                                src = seq_logits[
+                                    pair_index[(urow[i], row)], :width]
+                            else:
+                                src = seq_logits[urow[i], row, :width]
+                            p = _softmax(src[None, :])[0]
                             per_task[task] = self._demux_seq(
                                 task, p, latency, trunc)
                     out.append(self._fused_result(item, per_task))
@@ -2034,7 +2385,7 @@ class InferenceEngine:
     def _run_fused_packed(self, g: TrunkGroup, gid: str, bucket: int,
                           items: List[BatchItem], urow: List[int],
                           uniq_items: List[BatchItem], demux: dict,
-                          flavor: str, max_segs: int,
+                          fns: dict, flavor: str, max_segs: int,
                           plan_rows: int) -> Sequence[Any]:
         """The sequence-packed fused path (docs/PACKING.md): unique
         encodings bin-pack into shared rows under a block-diagonal
@@ -2048,6 +2399,17 @@ class InferenceEngine:
         # closed static-shape set like the row axis does
         k_pad = 1 << max(0, n_rows - 1).bit_length()
         bank, tok_bank = demux["bank"], demux["tok_bank"]
+        meta = fns["meta"]
+        tparams = fns["trunk_params"]
+        use_bgmv = meta["bgmv"] and flavor in ("seq", "both")
+        pr_dev = pt_dev = pair_index = None
+        pair_sfx = ""
+        if use_bgmv:
+            # packed pairs index SEGMENTS: the packed pool emits one
+            # pooled row per segment, and urow is the segment index
+            pr, pt, pair_index = self._bgmv_pairs(items, urow, demux)
+            pr_dev, pt_dev = jnp.asarray(pr), jnp.asarray(pt)
+            pair_sfx = f":p{pr.shape[0]}"
 
         from ..observability import batchtrace
         from ..observability.profiler import trace_span
@@ -2087,23 +2449,29 @@ class InferenceEngine:
             # compile detection keys on it so a fresh K over a warm row
             # shape still counts as the compile it is
             fresh = self._step_fresh(f"trunk:{gid}",
-                                     f"packed:{flavor}:{k_pad}",
+                                     f"packed:{flavor}:{k_pad}"
+                                     f"{pair_sfx}",
                                      (padded_rows, bucket))
             seq_logits = tok_logits = None
             fwd_t0 = time.perf_counter()
             with trace_span(f"engine.classify.packed.{gid}"):
                 if flavor == "seq":
-                    seq_logits = g.fns["packed_seq"](
-                        g.trunk_params, bank, ids_dev, mask_dev,
-                        pos_dev, seg_dev, seg_row, seg_start)
+                    args = (tparams, bank, ids_dev, mask_dev,
+                            pos_dev, seg_dev, seg_row, seg_start)
+                    if use_bgmv:
+                        args += (pr_dev, pt_dev)
+                    seq_logits = fns["packed_seq"](*args)
                 elif flavor == "tok":
-                    tok_logits = g.fns["packed_tok"](
-                        g.trunk_params, tok_bank, ids_dev, mask_dev,
+                    tok_logits = fns["packed_tok"](
+                        tparams, tok_bank, ids_dev, mask_dev,
                         pos_dev, seg_dev)
                 else:
-                    seq_logits, tok_logits = g.fns["packed_both"](
-                        g.trunk_params, bank, tok_bank, ids_dev,
-                        mask_dev, pos_dev, seg_dev, seg_row, seg_start)
+                    args = (tparams, bank, tok_bank, ids_dev,
+                            mask_dev, pos_dev, seg_dev, seg_row,
+                            seg_start)
+                    if use_bgmv:
+                        args += (pr_dev, pt_dev)
+                    seq_logits, tok_logits = fns["packed_both"](*args)
                 if seq_logits is not None:
                     seq_logits = np.asarray(jax.device_get(seq_logits),
                                             dtype=np.float32)
@@ -2121,6 +2489,7 @@ class InferenceEngine:
             # its own counter + the runtimestats "packed" variant
             self._series().trunk_forwards.inc(group=gid, path="fused")
             self._series().packed_steps.inc(group=gid)
+            self._count_kernel_step(gid, meta, use_bgmv)
 
             demux_cm = batchtrace.stage(step, "demux")
             now = time.perf_counter()
@@ -2145,9 +2514,12 @@ class InferenceEngine:
                         else:
                             row = demux["row_of"][task]
                             width = demux["widths"][row]
-                            p = _softmax(
-                                seq_logits[urow[i], row,
-                                           :width][None, :])[0]
+                            if use_bgmv:
+                                src = seq_logits[
+                                    pair_index[(urow[i], row)], :width]
+                            else:
+                                src = seq_logits[urow[i], row, :width]
+                            p = _softmax(src[None, :])[0]
                             per_task[task] = self._demux_seq(
                                 task, p, latency, trunc)
                     out.append(self._fused_result(item, per_task))

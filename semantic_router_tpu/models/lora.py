@@ -232,7 +232,8 @@ def stack_head_bank(entries: List[Dict[str, Any]]) -> Dict[str, jnp.ndarray]:
 
 
 def apply_head_bank(bank: Dict[str, jnp.ndarray], pooled: jnp.ndarray,
-                    activation, norm_eps: float) -> jnp.ndarray:
+                    activation, norm_eps: float,
+                    epilogue: bool = False) -> jnp.ndarray:
     """Fan pooled trunk features [B, D] out through EVERY stacked head as
     batched einsums → logits [B, T, L_max].
 
@@ -240,17 +241,34 @@ def apply_head_bank(bank: Dict[str, jnp.ndarray], pooled: jnp.ndarray,
     computing all heads for all rows is cheaper than a per-item gather —
     head FLOPs are ~0.1% of the trunk's — and keeps the jit cache keyed on
     (batch, seq) only.  The engine demultiplexes each item's (row, task)
-    logits host-side and softmaxes over the task's true label width; a
-    per-item Pallas BGMV gather is the ROADMAP follow-on for much larger
-    banks."""
-    h = jnp.einsum("bd,tdh->bth", pooled, bank["dense_kernel"])
-    if "dense_bias" in bank:
-        h = h + bank["dense_bias"][None]
-    if "lora_A" in bank:
-        low = jnp.einsum("bd,tdr->btr", pooled, bank["lora_A"])
-        h = h + bank["scale"][None, :, None] * jnp.einsum(
-            "btr,trh->bth", low, bank["lora_B"])
-    h = activation(h)
+    logits host-side and softmaxes over the task's true label width; for
+    much wider banks ``apply_head_bank_bgmv`` below gathers per item
+    instead (engine.kernels.bgmv, docs/KERNELS.md).
+
+    ``epilogue=True`` routes the dense+bias+activation through the fused
+    Pallas epilogue kernel (ops.epilogue — one MXU dispatch instead of
+    matmul + bias-add + activation; the LoRA delta's skinny matmuls stay
+    XLA einsums feeding the kernel).  Parity with the einsum path is
+    ≤1e-4 (tests/test_kernels.py)."""
+    if epilogue:
+        from ..ops.epilogue import head_epilogue
+
+        delta = None
+        if "lora_A" in bank:
+            low = jnp.einsum("bd,tdr->btr", pooled, bank["lora_A"])
+            delta = bank["scale"][None, :, None] * jnp.einsum(
+                "btr,trh->bth", low, bank["lora_B"])
+        h = head_epilogue(pooled, bank["dense_kernel"],
+                          bank.get("dense_bias"), delta, activation)
+    else:
+        h = jnp.einsum("bd,tdh->bth", pooled, bank["dense_kernel"])
+        if "dense_bias" in bank:
+            h = h + bank["dense_bias"][None]
+        if "lora_A" in bank:
+            low = jnp.einsum("bd,tdr->btr", pooled, bank["lora_A"])
+            h = h + bank["scale"][None, :, None] * jnp.einsum(
+                "btr,trh->bth", low, bank["lora_B"])
+        h = activation(h)
     mu = h.mean(axis=-1, keepdims=True)
     var = ((h - mu) ** 2).mean(axis=-1, keepdims=True)
     h = (h - mu) * jax.lax.rsqrt(var + norm_eps)
@@ -259,6 +277,46 @@ def apply_head_bank(bank: Dict[str, jnp.ndarray], pooled: jnp.ndarray,
         h = h + bank["norm_bias"][None]
     return jnp.einsum("bth,thl->btl", h, bank["cls_kernel"]) \
         + bank["cls_bias"][None]
+
+
+def apply_head_bank_bgmv(bank: Dict[str, jnp.ndarray],
+                         pooled: jnp.ndarray,
+                         pair_rows: jnp.ndarray,
+                         pair_tasks: jnp.ndarray,
+                         activation, norm_eps: float) -> jnp.ndarray:
+    """Per-item gathered head application (the BGMV serving shape,
+    docs/KERNELS.md): each (row, task) PAIR computes only ITS task's
+    head — pooled [N, D] × pairs [P] → logits [P, L_max].  Work scales
+    with pairs, not rows × tasks, which is what stops wide banks paying
+    the zero-padded all-heads matmul.
+
+    The two full-width matmuls (head dense, classifier) ride the Pallas
+    BGMV gather kernel on TPU (ops.bgmv; XLA take+einsum elsewhere);
+    the rank-r LoRA matmuls stay XLA einsums (skinny lanes tile poorly
+    on the MXU).  Numerics: same math as ``apply_head_bank`` restricted
+    to the requested pairs — parity ≤1e-4 is the gate
+    (tests/test_kernels.py, packed + deduped batches included)."""
+    from ..ops.bgmv import bgmv
+
+    x = jnp.take(pooled, pair_rows, axis=0)             # [P, D]
+    h = bgmv(x, bank["dense_kernel"], pair_tasks)       # [P, H]
+    if "dense_bias" in bank:
+        h = h + jnp.take(bank["dense_bias"], pair_tasks, axis=0)
+    if "lora_A" in bank:
+        low = jnp.einsum("pd,pdr->pr", x,
+                         jnp.take(bank["lora_A"], pair_tasks, axis=0))
+        h = h + jnp.take(bank["scale"], pair_tasks)[:, None] \
+            * jnp.einsum("pr,prh->ph", low,
+                         jnp.take(bank["lora_B"], pair_tasks, axis=0))
+    h = activation(h)
+    mu = h.mean(axis=-1, keepdims=True)
+    var = ((h - mu) ** 2).mean(axis=-1, keepdims=True)
+    h = (h - mu) * jax.lax.rsqrt(var + norm_eps)
+    h = h * jnp.take(bank["norm_scale"], pair_tasks, axis=0)
+    if "norm_bias" in bank:
+        h = h + jnp.take(bank["norm_bias"], pair_tasks, axis=0)
+    return bgmv(h, bank["cls_kernel"], pair_tasks) \
+        + jnp.take(bank["cls_bias"], pair_tasks, axis=0)
 
 
 class MultiTaskLoRAClassifier(nn.Module):

@@ -172,7 +172,11 @@ def _make_dense(module, cfg: ModernBertConfig,
 
     Default: plain nn.Dense. With a ``dense_factory`` on the module (the
     LoRA path), the factory's module is called with the task index so the
-    adapter pair is selected per call (a gather — no recompile on swap)."""
+    adapter pair is selected per call (a gather — no recompile on swap).
+    The int8 quantized serving mode rides the same seam
+    (models.quant.build_quant_trunk): its factory-made QuantDense layers
+    accept and ignore the task index — quantized trunks carry no
+    per-task adapters (docs/KERNELS.md)."""
     factory = getattr(module, "dense_factory", None)
 
     def make(features: int, use_bias: bool, name: str):

@@ -1,0 +1,111 @@
+"""Quantized ModernBERT trunk serving mode (docs/KERNELS.md).
+
+The reference ships quantized BERT-family classifiers as its default
+serving mode; this module is the TPU-native analog for the fused
+classifier bank's shared trunk (engine.classify TrunkGroup):
+
+- ``bf16``: the trunk module recompiles with ``dtype=bfloat16`` —
+  activations ride the MXU's native input dtype; parameters stay
+  untouched (Flax casts per-op), so flipping back to ``off`` is
+  byte-identical.
+- ``int8``: every trunk dense kernel (Wqkv / Wo / Wi) quantizes ONCE at
+  knob-application time to per-output-channel symmetric int8 + f32
+  scales (ops.quant.quantize_per_channel — ~4× weight HBM), and the
+  forward path swaps each projection for ``QuantDense`` via the trunk's
+  existing ``dense_factory`` seam (the same seam the LoRA path uses):
+  a dequant-fused matmul with bf16 activations and f32 accumulation.
+  Embeddings and LayerNorms stay float (they are noise in both FLOPs
+  and bytes).
+
+The engine applies this per trunk group behind ``engine.quant``
+(mode: off|bf16|int8, default off = byte-identical), gated by the
+golden parity harness in tests/test_kernels.py (calibrated logit
+tolerance + top-class-agreement — docs/KERNELS.md "parity policy").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.quant import dequant_matmul, quantize_per_channel
+from .modernbert import ModernBertConfig, ModernBertModel
+
+QUANT_MODES = ("off", "bf16", "int8")
+
+
+class QuantDense(nn.Module):
+    """Dense projection over a pre-quantized int8 kernel: params are
+    ``kernel_q`` (int8 [D, F]) + ``scale`` (f32 [F]) — produced by
+    ``quantize_trunk_params``, never trained/initialised in place.
+    Accepts (and ignores) the ``task_index`` the trunk's dense_factory
+    seam threads to every factory-made layer."""
+
+    features: int
+    use_bias: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray,
+                 task_index: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        del task_index  # quantized trunks carry no per-task adapters
+        d = x.shape[-1]
+        q = self.param("kernel_q", nn.initializers.zeros,
+                       (d, self.features), jnp.int8)
+        scale = self.param("scale", nn.initializers.ones,
+                           (self.features,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.features,)) if self.use_bias else None
+        return dequant_matmul(x.astype(self.dtype), q, scale, bias=bias,
+                              compute_dtype=self.dtype)
+
+
+def quantize_trunk_params(trunk_params: Any) -> Any:
+    """Transform a ModernBERT trunk parameter subtree for QuantDense
+    serving: every dense ``{"kernel": [D, F](, "bias")}`` subtree
+    becomes ``{"kernel_q", "scale"(, "bias")}``; embeddings and
+    LayerNorms pass through unchanged.  Checkpoint-load/knob-apply time
+    only — never on the hot path."""
+
+    def walk(node):
+        if not isinstance(node, dict) and not hasattr(node, "items"):
+            return node
+        if "kernel" in node and getattr(node["kernel"], "ndim", 0) == 2:
+            q, scale = quantize_per_channel(node["kernel"])
+            out: Dict[str, Any] = {"kernel_q": q, "scale": scale}
+            if "bias" in node:
+                out["bias"] = node["bias"]
+            return out
+        return {k: walk(v) for k, v in node.items()}
+
+    return walk(trunk_params if isinstance(trunk_params, dict)
+                else dict(trunk_params))
+
+
+def build_quant_trunk(config: ModernBertConfig, trunk_params: Any,
+                      mode: str) -> Tuple[Any, Any]:
+    """(module, params) serving pair for one trunk group at ``mode``.
+
+    ``off`` echoes the inputs (the caller keeps serving the original
+    arrays — byte-identical); ``bf16`` swaps only the module's compute
+    dtype; ``int8`` additionally rewrites the params through
+    ``quantize_trunk_params`` and threads QuantDense through the
+    trunk's dense_factory seam."""
+    if mode not in QUANT_MODES:
+        raise ValueError(f"unknown quant mode {mode!r} "
+                         f"(expected one of {QUANT_MODES})")
+    if mode == "off":
+        return ModernBertModel(config), trunk_params
+    bf16_cfg = dataclasses.replace(config, dtype=jnp.bfloat16)
+    if mode == "bf16":
+        return ModernBertModel(bf16_cfg), trunk_params
+
+    def dense_factory(features: int, use_bias: bool, name: str):
+        return QuantDense(features, use_bias=use_bias, name=name,
+                          dtype=jnp.bfloat16)
+
+    return (ModernBertModel(bf16_cfg, dense_factory=dense_factory),
+            quantize_trunk_params(trunk_params))

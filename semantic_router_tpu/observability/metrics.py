@@ -450,6 +450,18 @@ class MetricSeries:
             "Device steps composed from sequence-packed rows "
             "(engine.packing): several prompts shared each row under a "
             "block-diagonal mask")
+        # tuned-kernel / quant serving observability (docs/KERNELS.md):
+        # the knobs' presence on the actual hot path, not just in config
+        self.kernel_steps = registry.counter(
+            "llm_engine_kernel_steps_total",
+            "Device steps served through a tuned-kernel path "
+            "(engine.quant / engine.kernels), by kernel: quant_bf16 / "
+            "quant_int8 / epilogue / bgmv")
+        self.kernel_rebuilds = registry.counter(
+            "llm_engine_kernel_rebuilds_total",
+            "Fused jit program-set rebuilds from engine.quant / "
+            "engine.kernels hot flips (in-flight batches finish on the "
+            "old programs; the next step serves the new)")
         self.bucket_overflows = registry.counter(
             "llm_batcher_bucket_overflow_total",
             "Inputs longer than the largest seq bucket — clipped at the "
@@ -493,6 +505,8 @@ trunk_forwards = default_series.trunk_forwards
 tokenizations = default_series.tokenizations
 fused_dedup_rows = default_series.fused_dedup_rows
 packed_steps = default_series.packed_steps
+kernel_steps = default_series.kernel_steps
+kernel_rebuilds = default_series.kernel_rebuilds
 bucket_overflows = default_series.bucket_overflows
 batcher_queue_wait = default_series.batcher_queue_wait
 batcher_fill_ratio = default_series.batcher_fill_ratio

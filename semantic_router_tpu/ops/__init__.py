@@ -7,6 +7,9 @@ from .attention import (
     sdpa,
     sliding_window_bias,
 )
+from .bgmv import bgmv, bgmv_reference
+from .epilogue import head_epilogue, head_epilogue_reference
+from .quant import dequant_matmul, dequantize, quantize_per_channel
 from .rope import (
     RopeSpec,
     apply_rotary,
@@ -17,7 +20,9 @@ from .rope import (
 )
 
 __all__ = [
-    "NEG_INF", "RopeSpec", "apply_rotary", "chunked_sdpa", "cls_pool",
-    "default_inv_freq", "mean_pool", "padding_bias", "rope_tables",
+    "NEG_INF", "RopeSpec", "apply_rotary", "bgmv", "bgmv_reference",
+    "chunked_sdpa", "cls_pool", "default_inv_freq", "dequant_matmul",
+    "dequantize", "head_epilogue", "head_epilogue_reference",
+    "mean_pool", "padding_bias", "quantize_per_channel", "rope_tables",
     "rotate_half", "sdpa", "sliding_window_bias", "yarn_inv_freq",
 ]

@@ -1,0 +1,130 @@
+"""Pallas fused dense+bias+activation epilogue for the head bank.
+
+The all-heads head-bank matmul (models.lora.apply_head_bank) is the one
+hot-path matmul the trunk-collapse PRs left un-tuned: XLA lowers it as
+``einsum → add(bias) → add(lora delta) → gelu`` — up to three extra
+element-wise dispatches touching a [B, T, H] intermediate per step.
+This kernel streams the same math through the MXU once per (task,
+row-block) tile with the bias add, optional LoRA delta add, and the
+activation applied in-register before the tile ever leaves VMEM
+(SURVEY hard-part 1: the step budget lives or dies on dispatch count).
+
+Layout: x [rows, D] (pooled rows, or [B·S, D] for token heads);
+kernel [T, D, H]; grid = (T, rows/BLOCK_ROWS).  The LoRA delta — two
+skinny rank-r matmuls — stays an XLA einsum OUTSIDE the kernel (skinny
+lanes tile poorly on the MXU) and enters as a precomputed [rows, T, H]
+operand added before the activation, so LoRA'd and plain banks share
+one kernel.
+
+``head_epilogue`` is the public entry: Pallas on TPU (the tunneled chip
+registers as platform 'axon'), pure-XLA fallback elsewhere —
+bit-compatible semantics; the fallback doubles as the numerics oracle
+in tests via interpret mode (docs/KERNELS.md "interpret-mode caveat":
+CPU tier-1 drives the kernel interpreted for parity, never for speed).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_ROWS = 256
+
+
+def _epilogue_kernel(x_ref, w_ref, b_ref, d_ref, o_ref, *,
+                     act: Callable):
+    """One (task, row-block) program: matmul + bias + delta + act."""
+    x = x_ref[...].astype(jnp.float32)            # [Br, D]
+    w = w_ref[0].astype(jnp.float32)              # [D, H]
+    h = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    if b_ref is not None:
+        h = h + b_ref[0].astype(jnp.float32)[None, :]
+    if d_ref is not None:
+        h = h + d_ref[:, 0, :].astype(jnp.float32)
+    o_ref[:, 0, :] = act(h).astype(o_ref.dtype)
+
+
+def head_epilogue_pallas(x: jnp.ndarray, kernel: jnp.ndarray,
+                         bias: Optional[jnp.ndarray],
+                         delta: Optional[jnp.ndarray],
+                         act: Callable,
+                         block_rows: int = DEFAULT_BLOCK_ROWS,
+                         interpret: Optional[bool] = None) -> jnp.ndarray:
+    """x [rows, D] × kernel [T, D, H] (+ bias [T, H]) (+ delta
+    [rows, T, H]) → act(x@W + b + delta) [rows, T, H].
+
+    ``interpret``: None = auto (Pallas interpret mode off-TPU so the
+    same call site runs everywhere; compiled kernel on the chip)."""
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    rows, D = x.shape
+    T, _, H = kernel.shape
+    br = min(block_rows, max(rows, 1))
+    pad = (-rows) % br
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        if delta is not None:
+            delta = jnp.pad(delta, ((0, pad), (0, 0), (0, 0)))
+    rp = rows + pad
+
+    in_specs = [
+        pl.BlockSpec((br, D), lambda t, r: (r, 0)),
+        pl.BlockSpec((1, D, H), lambda t, r: (t, 0, 0)),
+    ]
+    operands = [x, kernel]
+    if bias is not None:
+        in_specs.append(pl.BlockSpec((1, H), lambda t, r: (t, 0)))
+        operands.append(bias)
+    if delta is not None:
+        in_specs.append(pl.BlockSpec((br, 1, H), lambda t, r: (r, t, 0)))
+        operands.append(delta)
+
+    def kern(*refs):
+        x_ref, w_ref = refs[0], refs[1]
+        i = 2
+        b_ref = d_ref = None
+        if bias is not None:
+            b_ref = refs[i]
+            i += 1
+        if delta is not None:
+            d_ref = refs[i]
+            i += 1
+        _epilogue_kernel(x_ref, w_ref, b_ref, d_ref, refs[-1], act=act)
+
+    out = pl.pallas_call(
+        kern,
+        grid=(T, rp // br),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((br, 1, H), lambda t, r: (r, t, 0)),
+        out_shape=jax.ShapeDtypeStruct((rp, T, H), x.dtype),
+        interpret=interpret,
+    )(*operands)
+    return out[:rows]
+
+
+def head_epilogue_reference(x: jnp.ndarray, kernel: jnp.ndarray,
+                            bias: Optional[jnp.ndarray],
+                            delta: Optional[jnp.ndarray],
+                            act: Callable) -> jnp.ndarray:
+    """The pure-XLA epilogue — exactly the pre-kernel einsum math, kept
+    as the off-chip serving path and the parity oracle."""
+    h = jnp.einsum("bd,tdh->bth", x, kernel)
+    if bias is not None:
+        h = h + bias[None]
+    if delta is not None:
+        h = h + delta
+    return act(h)
+
+
+def head_epilogue(x: jnp.ndarray, kernel: jnp.ndarray,
+                  bias: Optional[jnp.ndarray] = None,
+                  delta: Optional[jnp.ndarray] = None,
+                  act: Callable = lambda h: h) -> jnp.ndarray:
+    """Dispatch: Pallas kernel on TPU; XLA fallback elsewhere (the
+    tunneled chip registers as platform 'axon', not 'tpu')."""
+    if jax.default_backend() in ("tpu", "axon"):
+        return head_epilogue_pallas(x, kernel, bias, delta, act)
+    return head_epilogue_reference(x, kernel, bias, delta, act)

@@ -1,0 +1,74 @@
+"""Per-channel symmetric int8 weight quantization + dequant-fused matmul.
+
+The raw-engine-speed quant layer (docs/KERNELS.md): the reference ships
+quantized BERT-family classifiers as its default serving mode, and this
+module is the TPU-native analog — weights quantize ONCE at checkpoint
+load (per-OUTPUT-channel symmetric scales, the lossless-argmax-friendly
+layout), and the forward path runs a dequant-fused matmul: XLA fuses
+``q.astype(compute) * scale`` into the matmul epilogue, so int8 weights
+never materialize as a dense float copy in HBM.
+
+Numerics contract:
+
+- ``quantize_per_channel``: w[..., D, F] → (q int8[..., D, F],
+  scale f32[..., F]), symmetric (zero-point-free) so the matmul stays a
+  pure scale — ``dequantize(quantize(w)) - w`` is bounded by scale/2
+  per element (round-to-nearest over 127 levels).
+- ``dequant_matmul``: x @ dequantize(q) computed as
+  ``(x @ q.astype(dtype)) * scale`` with a float32 accumulator
+  (``preferred_element_type``) — bit-comparable to dequantize-then-
+  matmul up to XLA reduction order, which is what the parity gate in
+  tests/test_kernels.py pins (calibrated logit tolerance +
+  top-class-agreement, docs/KERNELS.md "parity policy").
+
+Everything here is jit-pure (no host syncs, no time, no prints): these
+ops are reachable from the engine's fused batch programs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+INT8_LEVELS = 127.0  # symmetric: [-127, 127]; -128 stays unused
+
+
+def quantize_per_channel(w: jnp.ndarray, eps: float = 1e-12
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-output-channel int8 quantization of a dense kernel
+    ``[..., D, F]`` (F = output features, the last axis — matching the
+    Flax Dense kernel layout).  Returns (q int8, scale f32[..., F]).
+
+    Registration-time only — never on the hot path."""
+    w = jnp.asarray(w)
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2)  # [..., F]
+    scale = jnp.maximum(absmax / INT8_LEVELS, eps)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale[..., None, :]),
+                 -INT8_LEVELS, INT8_LEVELS).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize(q: jnp.ndarray, scale: jnp.ndarray,
+               dtype=jnp.float32) -> jnp.ndarray:
+    """Explicit dequantize (the numerics oracle in tests): q * scale."""
+    return (q.astype(jnp.float32) * scale[..., None, :]).astype(dtype)
+
+
+def dequant_matmul(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray,
+                   bias: Optional[jnp.ndarray] = None,
+                   compute_dtype=jnp.bfloat16) -> jnp.ndarray:
+    """``x @ (q * scale) (+ bias)`` with the dequant fused into the
+    matmul: int8 weights cast to ``compute_dtype`` in-op (XLA fuses the
+    convert into the MXU feed), accumulate in float32, then one
+    per-output-channel scale multiply.  Output dtype follows x."""
+    out_dtype = x.dtype
+    y = jax.lax.dot_general(
+        x.astype(compute_dtype), q.astype(compute_dtype),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    y = y * scale
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(out_dtype)

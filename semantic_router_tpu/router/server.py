@@ -1192,6 +1192,15 @@ class RouterServer:
                                 rep["packing"] = eng.packing_report()
                             except Exception:
                                 pass
+                        # per-kernel on/off + quant mode + rebuild count
+                        # (docs/KERNELS.md): the serving truth, next to
+                        # the program registry the knobs act on
+                        if eng is not None and hasattr(eng,
+                                                       "kernels_report"):
+                            try:
+                                rep["kernels"] = eng.kernels_report()
+                            except Exception:
+                                pass
                         self._json(200, rep)
                 elif path == "/debug/resilience":
                     # degradation-ladder snapshot: level, pressure

@@ -779,11 +779,50 @@ def apply_packing_knobs(cfg: RouterConfig, engine) -> None:
                 tuner.start(pk["autotune"]["interval_s"])
             else:
                 tuner.stop()
+        # packed-path warmup (docs/PACKING.md): recompile the packed
+        # shapes the engine's compiled-step census says are hot, so the
+        # first packed step after this boot/retune is a warm execute
+        # instead of an inline XLA compile on the dispatch worker
+        warmed = 0
+        if pk["enabled"] and hasattr(engine, "warmup_packed_hot"):
+            warmed = engine.warmup_packed_hot()
         component_event("bootstrap", "packing_configured",
                         enabled=pk["enabled"],
-                        autotune=pk["autotune"]["enabled"])
+                        autotune=pk["autotune"]["enabled"],
+                        warmed_shapes=warmed)
     except Exception as exc:
         component_event("bootstrap", "packing_config_invalid",
+                        error=f"{type(exc).__name__}: {exc}"[:200],
+                        level="warning")
+
+
+def apply_kernel_knobs(cfg: RouterConfig, engine) -> None:
+    """Apply the engine.quant + engine.kernels blocks (docs/KERNELS.md)
+    to a live engine: quantizes trunk-group weights / flips the tuned
+    kernel paths by atomically swapping each group's fused jit program
+    set — in-flight batches finish on the programs they already hold.
+    Called at boot and on config hot reload; all defaults are OFF
+    (byte-identical serving).  After a flip rebuilt program sets, the
+    packed-shape census re-warms so the first packed step afterward is
+    not a cold compile.  Malformed kernel config must never stop the
+    server."""
+    if engine is None or not hasattr(engine, "configure_kernels"):
+        return
+    try:
+        qk = cfg.engine.quant_config()
+        kk = cfg.engine.kernels_config()
+        engine.configure_quant(cfg.engine.quant)
+        engine.configure_kernels(cfg.engine.kernels)
+        warmed = 0
+        if hasattr(engine, "warmup_packed_hot"):
+            warmed = engine.warmup_packed_hot()
+        component_event("bootstrap", "kernels_configured",
+                        quant=qk["mode"],
+                        epilogue=kk["epilogue"]["enabled"],
+                        bgmv=kk["bgmv"]["enabled"],
+                        warmed_shapes=warmed)
+    except Exception as exc:
+        component_event("bootstrap", "kernels_config_invalid",
                         error=f"{type(exc).__name__}: {exc}"[:200],
                         level="warning")
 
@@ -918,6 +957,9 @@ def serve(config_path: str, port: int = 8801,
     # sequence-packed batching: scheduler knobs + the shape auto-tuner
     # thread (the engine survives hot reloads, so this retunes in place)
     apply_packing_knobs(cfg, engine)
+    # quantized trunk + tuned-kernel toggles (docs/KERNELS.md): swap
+    # each trunk group's fused program set per engine.quant/.kernels
+    apply_kernel_knobs(cfg, engine)
 
     # startKubernetesControllerIfNeeded (cmd/main.go:50): live CRD watch
     # regenerating the config file the ConfigWatcher below hot-swaps
@@ -962,6 +1004,7 @@ def serve(config_path: str, port: int = 8801,
             apply_flywheel_knobs(new_cfg, server.registry, new_router)
             apply_upstream_knobs(new_cfg, server.registry, new_router)
             apply_packing_knobs(new_cfg, engine)
+            apply_kernel_knobs(new_cfg, engine)
             # grace period before tearing down the old dispatcher so
             # requests already inside old.route() finish their fan-out
             threading.Timer(30.0, old.dispatcher.shutdown).start()

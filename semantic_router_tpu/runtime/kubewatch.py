@@ -119,38 +119,59 @@ class KubeClient:
     def watch(self, plural: str, resource_version: str,
               on_event: Callable[[str, Dict], None],
               stop: threading.Event,
-              timeout_s: float = 300.0) -> None:
+              timeout_s: float = 300.0,
+              register: Optional[Callable] = None) -> None:
         """Stream events to ``on_event(type, object)`` until the server
         closes the stream or ``stop`` is set. Raises HTTPError(410) when
-        the resourceVersion is too old — caller must re-list."""
+        the resourceVersion is too old — caller must re-list.
+
+        ``register`` (optional) receives the live response stream, then
+        None when the stream ends — the operator's stop() closes the
+        registered stream so a watcher blocked in read1() wakes
+        immediately instead of riding out the watch window (bounded
+        shutdown; the VSR_ANALYZE thread-leak gate pins this)."""
         url = (f"{self._path(plural)}?watch=1"
                f"&resourceVersion={resource_version}"
                f"&timeoutSeconds={int(timeout_s)}")
         with self._request(url, timeout=timeout_s + 10) as resp:
-            buf = b""
-            while not stop.is_set():
-                chunk = resp.read1(65536)
-                if not chunk:
-                    return  # server closed (watch window expired)
-                buf += chunk
-                while b"\n" in buf:
-                    line, buf = buf.split(b"\n", 1)
-                    if not line.strip():
-                        continue
-                    event = json.loads(line)
-                    etype = event.get("type", "")
-                    obj = event.get("object", {}) or {}
-                    if etype == "ERROR":
-                        code = int((obj.get("code") or 0))
-                        if code == 410:
-                            raise urllib.error.HTTPError(
-                                url, 410, "Gone", None, None)
-                        component_event("kubewatch", "watch_error",
-                                        level="warning",
-                                        reason=str(obj)[:200])
-                        continue
-                    if etype != "BOOKMARK":
-                        on_event(etype, obj)
+            try:
+                if register is not None:
+                    register(resp)
+                buf = b""
+                while not stop.is_set():
+                    try:
+                        chunk = resp.read1(65536)
+                    except Exception:
+                        # a severed socket surfaces as OSError,
+                        # ValueError, or http.client.IncompleteRead
+                        # depending on where the reader was parked
+                        if stop.is_set():
+                            return  # stop() severed the stream under us
+                        raise
+                    if not chunk:
+                        return  # server closed (watch window expired)
+                    buf += chunk
+                    while b"\n" in buf:
+                        line, buf = buf.split(b"\n", 1)
+                        if not line.strip():
+                            continue
+                        event = json.loads(line)
+                        etype = event.get("type", "")
+                        obj = event.get("object", {}) or {}
+                        if etype == "ERROR":
+                            code = int((obj.get("code") or 0))
+                            if code == 410:
+                                raise urllib.error.HTTPError(
+                                    url, 410, "Gone", None, None)
+                            component_event("kubewatch", "watch_error",
+                                            level="warning",
+                                            reason=str(obj)[:200])
+                            continue
+                        if etype != "BOOKMARK":
+                            on_event(etype, obj)
+            finally:
+                if register is not None:
+                    register(None)
 
 
 class KubeOperator:
@@ -174,6 +195,11 @@ class KubeOperator:
         self._dirty = threading.Event()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        # live watch streams by plural: stop() closes them so watcher
+        # threads blocked in read1() wake NOW, not at the watch-window
+        # deadline (bounded shutdown — the thread-leak gate pins this)
+        self._streams: Dict[str, Any] = {}
+        self._streams_lock = threading.Lock()
         self.last_status = ""
         self.reconcile_count = 0
         # SLO / degradation reactions (ISSUE 5 satellite — the PR 4
@@ -363,7 +389,9 @@ class KubeOperator:
                     self.client.watch(
                         plural, rv,
                         lambda t, o, p=plural: self._apply_event(p, t, o),
-                        self._stop)
+                        self._stop,
+                        register=lambda resp, p=plural:
+                        self._register_stream(p, resp))
                     # clean stream end: resume from the newest rv the
                     # stream DELIVERED (tracked in _apply_event) — not
                     # from surviving objects, which lose the rv of a
@@ -416,9 +444,50 @@ class KubeOperator:
         self._threads.append(t)
         return self
 
+    @staticmethod
+    def _sever_stream(resp) -> None:
+        """Shut the stream's SOCKET down, not resp.close() — close()
+        drains the chunked body to EOF and would block behind the very
+        read being interrupted."""
+        import socket as _socket
+
+        try:
+            raw = getattr(getattr(resp, "fp", None), "raw", None)
+            sock = getattr(raw, "_sock", None)
+            if sock is not None:
+                sock.shutdown(_socket.SHUT_RDWR)
+        except Exception:
+            pass
+
+    def _register_stream(self, plural: str, resp) -> None:
+        stopping = False
+        with self._streams_lock:
+            if resp is None:
+                self._streams.pop(plural, None)
+            else:
+                self._streams[plural] = resp
+                stopping = self._stop.is_set()
+        if stopping:
+            # stop() already swept the streams it could see; a stream
+            # opened AFTER that sweep (watcher was mid-reconnect) must
+            # sever itself or its thread rides out the watch window
+            self._sever_stream(resp)
+
     def stop(self) -> None:
         self._stop.set()
         self._dirty.set()
+        self._status_dirty.set()
+        # sever live watch streams: a watcher blocked in read1() would
+        # otherwise ride out the watch window (up to 300s) after stop
+        with self._streams_lock:
+            streams = list(self._streams.values())
+        for resp in streams:
+            self._sever_stream(resp)
+        for t in self._threads:
+            t.join(timeout=5.0)
+        st = self._status_thread
+        if st is not None:
+            st.join(timeout=5.0)
         if self._bus_unsub is not None:
             try:
                 self._bus_unsub()
@@ -442,6 +511,11 @@ class MiniKubeAPI:
         self._rv = 0
         self._lock = threading.Lock()
         self._watchers: List[Tuple[str, "_Queue"]] = []
+        # close() sets this so in-flight watch-stream handler threads
+        # exit within one queue poll instead of riding out their
+        # timeoutSeconds window (a "closed" server must actually die —
+        # same contract the MiniRedis sever fix established)
+        self._closing = threading.Event()
 
         api = self
 
@@ -562,7 +636,8 @@ class MiniKubeAPI:
                 deadline = time.time() + float(
                     params.get("timeoutSeconds", "300"))
                 try:
-                    while time.time() < deadline:
+                    while time.time() < deadline \
+                            and not api._closing.is_set():
                         ev = q.get(timeout=0.25)
                         if ev is None:
                             continue
@@ -629,6 +704,15 @@ class MiniKubeAPI:
                 q.put(event)
 
     def close(self) -> None:
+        self._closing.set()
+        # wait for in-flight watch handlers to notice (bounded: each
+        # wakes within one 0.25s queue poll)
+        deadline = time.time() + 3.0
+        while time.time() < deadline:
+            with self._lock:
+                if not self._watchers:
+                    break
+            time.sleep(0.05)
         self._httpd.shutdown()
         self._httpd.server_close()
 

@@ -1,0 +1,593 @@
+"""Quantized trunk + fused-kernel hot path gates (docs/KERNELS.md).
+
+The `make kernels-smoke` tier-1 suite: quantization parity (per-dtype
+golden logits + calibrated top-class-agreement — the PR 1 fused-vs-split
+1e-4 harness relaxed per docs/KERNELS.md "parity policy"), the Pallas
+epilogue and BGMV kernels driven in interpret mode against their XLA
+oracles, the engine-level BGMV path bit-compared to the padded all-heads
+matmul across LoRA'd / packed / deduped batches, the hot-flip contract
+(knob changes rebuild jit programs without dropping in-flight batches),
+and the knob wiring (schema → normalizer → bootstrap → report).
+No TPU required: compiled kernels only run on-chip; here they run
+interpreted (numerics identical, speed meaningless by design).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from semantic_router_tpu.config.schema import InferenceEngineConfig
+from semantic_router_tpu.engine.kernels import (
+    normalize_kernels,
+    normalize_quant,
+    quant_selects,
+)
+from semantic_router_tpu.engine.testing import (
+    make_shared_trunk_engine,
+    tiny_config,
+)
+from semantic_router_tpu.observability.metrics import (
+    MetricSeries,
+    MetricsRegistry,
+)
+
+TASKS = ["intent", "fact_check", "user_feedback"]
+PII = ("pii", ["O", "B-EMAIL_ADDRESS", "I-EMAIL_ADDRESS"])
+# fixture corpus: varied lengths, duplicates included (dedup coverage)
+CORPUS = [
+    "the quarterly contract needs legal review",
+    "tiny",
+    "my throat hurts and i have a fever since tuesday",
+    "refactor the parser to use a visitor pattern",
+    "what is the capital of france",
+    "the quarterly contract needs legal review",
+    "sue the landlord over the broken lease terms",
+    "train a neural network on tabular data",
+    "is this investment portfolio diversified enough",
+    "hello world",
+    "symptoms include nausea and a mild headache",
+    "deploy the service behind a load balancer",
+]
+
+
+def kernel_engine(quant=None, kernels=None, **kwargs):
+    eng = make_shared_trunk_engine(
+        lora_tasks=("fact_check",),
+        engine_cfg=InferenceEngineConfig(
+            max_batch_size=8, max_wait_ms=1.0,
+            seq_len_buckets=[32, 128, 512],
+            quant=dict(quant or {}), kernels=dict(kernels or {})),
+        metrics=MetricSeries(MetricsRegistry()),
+        **kwargs)
+    return eng
+
+
+def prob_matrix(results):
+    return np.array([[r.probs[k] for k in sorted(r.probs)]
+                     for r in results])
+
+
+def goldens(eng, texts=CORPUS, tasks=TASKS):
+    out = eng.classify_multi(tasks, texts)
+    return {t: prob_matrix(rs) for t, rs in out.items()}
+
+
+# ---------------------------------------------------------------------------
+# quantization math
+
+
+class TestQuantOps:
+    def test_roundtrip_error_bounded_by_half_scale(self):
+        from semantic_router_tpu.ops.quant import (
+            dequantize,
+            quantize_per_channel,
+        )
+
+        rng = np.random.default_rng(0)
+        w = rng.standard_normal((64, 48)).astype(np.float32)
+        q, scale = quantize_per_channel(w)
+        assert np.asarray(q).dtype == np.int8
+        assert np.asarray(scale).shape == (48,)
+        err = np.abs(np.asarray(dequantize(q, scale)) - w)
+        # symmetric round-to-nearest: per-channel error <= scale/2
+        assert np.all(err <= np.asarray(scale)[None, :] / 2 + 1e-7)
+
+    def test_per_channel_beats_per_tensor_on_skewed_kernels(self):
+        from semantic_router_tpu.ops.quant import (
+            dequantize,
+            quantize_per_channel,
+        )
+
+        rng = np.random.default_rng(1)
+        w = rng.standard_normal((64, 8)).astype(np.float32)
+        w[:, 0] *= 100.0  # one loud channel must not wash out the rest
+        q, scale = quantize_per_channel(w)
+        err = np.abs(np.asarray(dequantize(q, scale)) - w)
+        assert err[:, 1:].max() < 0.02
+
+    def test_dequant_matmul_matches_explicit_dequant(self):
+        from semantic_router_tpu.ops.quant import (
+            dequant_matmul,
+            dequantize,
+            quantize_per_channel,
+        )
+
+        rng = np.random.default_rng(2)
+        w = rng.standard_normal((32, 24)).astype(np.float32)
+        x = rng.standard_normal((4, 32)).astype(np.float32)
+        q, scale = quantize_per_channel(w)
+        got = np.asarray(dequant_matmul(jnp.asarray(x), q, scale),
+                         np.float32)
+        # same bf16-activation compute as the serving path
+        ref = np.asarray(
+            jnp.asarray(x).astype(jnp.bfloat16)
+            @ dequantize(q, scale, jnp.bfloat16), np.float32)
+        assert np.max(np.abs(got - ref)) < 0.35  # bf16 accum order
+
+
+class TestQuantTrunk:
+    def test_off_mode_echoes_inputs(self):
+        import flax
+
+        from semantic_router_tpu.models.modernbert import ModernBertModel
+        from semantic_router_tpu.models.quant import build_quant_trunk
+
+        cfg = tiny_config(3)
+        params = flax.core.unfreeze(ModernBertModel(cfg).init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32)))["params"]
+        _, p = build_quant_trunk(cfg, params, "off")
+        assert p is params  # byte-identical posture: same arrays
+
+    @pytest.mark.parametrize("mode,tol", [("bf16", 0.05), ("int8", 0.1)])
+    def test_trunk_parity(self, mode, tol):
+        import flax
+
+        from semantic_router_tpu.models.modernbert import ModernBertModel
+        from semantic_router_tpu.models.quant import build_quant_trunk
+
+        cfg = tiny_config(3)
+        base = ModernBertModel(cfg)
+        params = flax.core.unfreeze(base.init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32)))["params"]
+        rng = np.random.default_rng(3)
+        ids = jnp.asarray(rng.integers(3, 1000, (2, 16)), jnp.int32)
+        mask = jnp.ones((2, 16), jnp.int32)
+        h0 = np.asarray(base.apply({"params": params}, ids, mask),
+                        np.float32)
+        mod, p = build_quant_trunk(cfg, params, mode)
+        h = np.asarray(mod.apply({"params": p}, ids, mask), np.float32)
+        assert np.max(np.abs(h - h0)) < tol
+
+    def test_int8_param_tree_shape(self):
+        import flax
+
+        from semantic_router_tpu.models.modernbert import ModernBertModel
+        from semantic_router_tpu.models.quant import quantize_trunk_params
+
+        cfg = tiny_config(3)
+        params = flax.core.unfreeze(ModernBertModel(cfg).init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32)))["params"]
+        qp = quantize_trunk_params(params)
+        wqkv = qp["layers_0"]["attn"]["Wqkv"]
+        assert set(wqkv) == {"kernel_q", "scale"}
+        assert np.asarray(wqkv["kernel_q"]).dtype == np.int8
+        # non-dense subtrees survive untouched
+        assert "embedding" in qp["embeddings"]["tok_embeddings"]
+        assert "scale" in qp["final_norm"] \
+            and "kernel_q" not in qp["final_norm"]
+
+
+class TestQuantParitySuite:
+    """The golden accuracy-parity gate (docs/KERNELS.md parity policy):
+    per-dtype logit deviation bounded by the calibrated tolerance, and
+    top-class agreement ≥ min_top_agree over the fixture corpus — ties
+    (golden margin below margin_floor) excluded, because a quantized
+    near-coin-flip is not a disagreement."""
+
+    @pytest.fixture(scope="class")
+    def golden(self):
+        eng = kernel_engine()
+        try:
+            yield goldens(eng)
+        finally:
+            eng.shutdown()
+
+    def _gate(self, golden, quant_cfg):
+        mode = quant_cfg["mode"]
+        par = normalize_quant(quant_cfg)["parity"]
+        eng = kernel_engine(quant=quant_cfg)
+        try:
+            got = goldens(eng)
+        finally:
+            eng.shutdown()
+        agree = total = 0
+        for t in TASKS:
+            g, q = golden[t], got[t]
+            assert np.max(np.abs(q - g)) <= par["max_logit_diff"], \
+                f"{mode}:{t} exceeded the calibrated tolerance"
+            top = np.sort(g, axis=-1)
+            margin = top[:, -1] - top[:, -2]
+            confident = margin >= par["margin_floor"]
+            total += int(confident.sum())
+            agree += int((g.argmax(-1)[confident]
+                          == q.argmax(-1)[confident]).sum())
+        assert total > 0
+        assert agree / total >= par["min_top_agree"], \
+            f"{mode} top-class agreement {agree}/{total}"
+
+    def test_bf16_gate(self, golden):
+        self._gate(golden, {"mode": "bf16"})
+
+    def test_int8_gate(self, golden):
+        self._gate(golden, {"mode": "int8"})
+
+    def test_off_is_byte_identical(self, golden):
+        eng = kernel_engine(quant={"mode": "off"})
+        try:
+            got = goldens(eng)
+        finally:
+            eng.shutdown()
+        for t in TASKS:
+            assert np.array_equal(got[t], golden[t])
+
+    def test_group_selector_limits_quant(self, golden):
+        """quant.groups naming NO member of the trunk group leaves it
+        serving f32 — byte-identical."""
+        eng = kernel_engine(quant={"mode": "int8",
+                                   "groups": ["some_other_task"]})
+        try:
+            rep = eng.kernels_report()
+            assert all(m["quant"] == "off"
+                       for m in rep["groups"].values())
+            got = goldens(eng)
+        finally:
+            eng.shutdown()
+        for t in TASKS:
+            assert np.array_equal(got[t], golden[t])
+
+
+# ---------------------------------------------------------------------------
+# kernels (interpret mode on CPU — numerics only)
+
+
+class TestEpilogueKernel:
+    @pytest.mark.parametrize("with_bias,with_delta", [
+        (False, False), (True, False), (True, True)])
+    def test_interpret_parity_vs_reference(self, with_bias, with_delta):
+        from semantic_router_tpu.ops.epilogue import (
+            head_epilogue_pallas,
+            head_epilogue_reference,
+        )
+
+        rng = np.random.default_rng(5)
+        T, rows, D, H = 3, 10, 32, 40  # rows indivisible by block
+        x = jnp.asarray(rng.standard_normal((rows, D)), jnp.float32)
+        K = jnp.asarray(rng.standard_normal((T, D, H)) * 0.1, jnp.float32)
+        b = jnp.asarray(rng.standard_normal((T, H)), jnp.float32) \
+            if with_bias else None
+        d = jnp.asarray(rng.standard_normal((rows, T, H)) * 0.1,
+                        jnp.float32) if with_delta else None
+        act = lambda h: jax.nn.gelu(h, approximate=False)  # noqa: E731
+        got = head_epilogue_pallas(x, K, b, d, act, block_rows=4,
+                                   interpret=True)
+        ref = head_epilogue_reference(x, K, b, d, act)
+        assert np.max(np.abs(np.asarray(got) - np.asarray(ref))) <= 1e-4
+
+    def test_apply_head_bank_epilogue_parity(self):
+        from semantic_router_tpu.models.lora import (
+            apply_head_bank,
+            stack_head_bank,
+        )
+
+        rng = np.random.default_rng(6)
+        D = 32
+        entries = []
+        for i, L in enumerate((5, 2, 3)):
+            entries.append({
+                "dense_kernel": rng.standard_normal((D, D)) * 0.1,
+                "dense_bias": None,
+                "lora_A": rng.standard_normal((D, 4)) * 0.1
+                if i == 1 else None,
+                "lora_B": rng.standard_normal((4, D)) * 0.1
+                if i == 1 else None,
+                "scale": 2.0 if i == 1 else 0.0,
+                "norm_scale": np.ones(D, np.float32),
+                "norm_bias": None,
+                "cls_kernel": rng.standard_normal((D, L)) * 0.1,
+                "cls_bias": np.zeros(L, np.float32),
+                "kind": "sequence",
+            })
+        bank = {k: jnp.asarray(v)
+                for k, v in stack_head_bank(entries).items()}
+        pooled = jnp.asarray(rng.standard_normal((6, D)), jnp.float32)
+        act = lambda h: jax.nn.gelu(h, approximate=False)  # noqa: E731
+        ref = apply_head_bank(bank, pooled, act, 1e-5)
+        got = apply_head_bank(bank, pooled, act, 1e-5, epilogue=True)
+        assert np.max(np.abs(np.asarray(got) - np.asarray(ref))) <= 1e-4
+
+
+class TestBgmvKernel:
+    def test_interpret_parity_vs_reference(self):
+        from semantic_router_tpu.ops.bgmv import bgmv_pallas, bgmv_reference
+
+        rng = np.random.default_rng(7)
+        T, P, D, H = 5, 9, 32, 40
+        x = jnp.asarray(rng.standard_normal((P, D)), jnp.float32)
+        W = jnp.asarray(rng.standard_normal((T, D, H)) * 0.1, jnp.float32)
+        idx = jnp.asarray(rng.integers(0, T, P), jnp.int32)
+        got = bgmv_pallas(x, W, idx, interpret=True)
+        ref = bgmv_reference(x, W, idx)
+        assert np.max(np.abs(np.asarray(got) - np.asarray(ref))) <= 1e-4
+
+    def test_bank_bgmv_matches_padded_selection(self):
+        from semantic_router_tpu.models.lora import (
+            apply_head_bank,
+            apply_head_bank_bgmv,
+            stack_head_bank,
+        )
+
+        rng = np.random.default_rng(8)
+        D = 32
+        entries = [{
+            "dense_kernel": rng.standard_normal((D, D)) * 0.1,
+            "dense_bias": rng.standard_normal(D) * 0.1,
+            "lora_A": rng.standard_normal((D, 4)) * 0.1,
+            "lora_B": rng.standard_normal((4, D)) * 0.1,
+            "scale": 2.0,
+            "norm_scale": np.ones(D, np.float32),
+            "norm_bias": np.zeros(D, np.float32),
+            "cls_kernel": rng.standard_normal((D, 4)) * 0.1,
+            "cls_bias": np.zeros(4, np.float32),
+            "kind": "sequence",
+        } for _ in range(6)]
+        bank = {k: jnp.asarray(v)
+                for k, v in stack_head_bank(entries).items()}
+        pooled = jnp.asarray(rng.standard_normal((5, D)), jnp.float32)
+        pr = jnp.asarray([0, 0, 3, 4, 2, 1], jnp.int32)
+        pt = jnp.asarray([1, 4, 0, 5, 2, 3], jnp.int32)
+        act = lambda h: jax.nn.gelu(h, approximate=False)  # noqa: E731
+        padded = np.asarray(apply_head_bank(bank, pooled, act, 1e-5))
+        got = np.asarray(apply_head_bank_bgmv(bank, pooled, pr, pt,
+                                              act, 1e-5))
+        sel = padded[np.asarray(pr), np.asarray(pt)]
+        assert np.max(np.abs(got - sel)) <= 1e-4
+
+
+class TestEngineBgmv:
+    """Engine-level BGMV parity: the per-pair gather path vs the padded
+    all-heads matmul — mixed-task fan-outs, LoRA'd members, deduped and
+    PACKED batches (acceptance: ≤1e-4 everywhere)."""
+
+    BGMV = {"bgmv": {"enabled": True, "min_tasks": 2}}
+
+    @pytest.fixture(scope="class")
+    def engines(self):
+        on = kernel_engine(kernels=self.BGMV, token_tasks=[PII])
+        off = kernel_engine(token_tasks=[PII])
+        assert all(m["bgmv"]
+                   for m in on.kernels_report()["groups"].values())
+        yield on, off
+        on.shutdown()
+        off.shutdown()
+
+    def _close(self, a, b):
+        assert np.max(np.abs(prob_matrix(a) - prob_matrix(b))) <= 1e-4
+        assert [r.label for r in a] == [r.label for r in b]
+
+    def test_multi_task_fanout(self, engines):
+        on, off = engines
+        a, b = on.classify_multi(TASKS, CORPUS), \
+            off.classify_multi(TASKS, CORPUS)
+        for t in TASKS:
+            self._close(a[t], b[t])
+
+    def test_deduped_batch(self, engines):
+        on, off = engines
+        texts = ["hot prompt"] * 4 + ["cold", "hot prompt", "distinct"]
+        self._close(on.classify_batch("intent", texts),
+                    off.classify_batch("intent", texts))
+
+    def test_lora_member(self, engines):
+        on, off = engines
+        self._close(on.classify_batch("fact_check", CORPUS),
+                    off.classify_batch("fact_check", CORPUS))
+
+    def test_packed_batches_ride_bgmv(self, engines):
+        """Packing is on by default in these engines: the parity calls
+        above ran packed steps through the BGMV head path.  Prove it —
+        packed programs executed AND their compile keys carry the pair
+        dimension."""
+        on, _ = engines
+        progs = on._runtime_stats.programs()
+        assert any(p["variant"] == "packed" for p in progs)
+        census = on.packed_shape_census()
+        rows = [r for rs in census.values() for r in rs]
+        assert rows and all(r[4] > 0 for r in rows), \
+            f"packed programs missing the pair_pad dimension: {rows}"
+
+    def test_token_members_keep_all_heads(self, engines):
+        """Token heads demux per token — they stay on the all-heads
+        matmul; BGMV only reroutes the pooled sequence heads."""
+        on, off = engines
+        for txt in CORPUS[:4]:
+            a = on.token_classify("pii", txt)
+            b = off.token_classify("pii", txt)
+            assert len(a.entities) == len(b.entities)
+
+    def test_kernel_steps_counted(self, engines):
+        on, _ = engines
+        text = on._metrics.registry.expose()
+        assert "llm_engine_kernel_steps_total{" in text
+        assert 'kernel="bgmv"' in text
+
+    def test_narrow_bank_keeps_all_heads(self):
+        """min_tasks above the bank width: BGMV must not engage."""
+        eng = kernel_engine(kernels={"bgmv": {"enabled": True,
+                                              "min_tasks": 16}})
+        try:
+            assert all(not m["bgmv"]
+                       for m in eng.kernels_report()["groups"].values())
+        finally:
+            eng.shutdown()
+
+
+class TestHotFlip:
+    """engine.quant.mode / kernel toggles rebuild jit programs without
+    dropping in-flight batches (acceptance)."""
+
+    def test_flips_under_traffic(self):
+        eng = kernel_engine()
+        errors = []
+        stop = threading.Event()
+
+        def traffic():
+            i = 0
+            while not stop.is_set():
+                try:
+                    eng.classify_multi(TASKS, [CORPUS[i % len(CORPUS)]])
+                except Exception as exc:  # pragma: no cover
+                    errors.append(exc)
+                    return
+                i += 1
+
+        threads = [threading.Thread(target=traffic) for _ in range(3)]
+        try:
+            eng.classify_multi(TASKS, CORPUS[:2])  # warm before racing
+            for t in threads:
+                t.start()
+            for knobs in ({"bgmv": {"enabled": True, "min_tasks": 2}},
+                          {"epilogue": {"enabled": True}},
+                          {}):
+                eng.configure_kernels(knobs)
+            for mode in ("bf16", "int8", "off"):
+                eng.configure_quant({"mode": mode})
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            eng.shutdown()
+        assert not errors
+        assert eng.kernels_report()["rebuilds"] >= 5
+
+    def test_flip_swaps_program_set_atomically(self):
+        eng = kernel_engine()
+        try:
+            g = next(iter(eng._groups_by_gid.values()))
+            fns0 = g.fns
+            eng.configure_kernels({"epilogue": {"enabled": True}})
+            assert g.fns is not fns0
+            assert g.fns["meta"]["epilogue"]
+            # unchanged knobs → no rebuild, warm caches preserved
+            fns1 = g.fns
+            eng.configure_kernels({"epilogue": {"enabled": True}})
+            assert g.fns is fns1
+        finally:
+            eng.shutdown()
+
+    def test_off_flip_restores_goldens(self):
+        eng = kernel_engine()
+        try:
+            g0 = goldens(eng, CORPUS[:4])
+            eng.configure_quant({"mode": "int8"})
+            eng.configure_kernels({"bgmv": {"enabled": True,
+                                            "min_tasks": 2}})
+            goldens(eng, CORPUS[:4])
+            eng.configure_quant({"mode": "off"})
+            eng.configure_kernels({})
+            g1 = goldens(eng, CORPUS[:4])
+            for t in TASKS:
+                assert np.array_equal(g0[t], g1[t])
+        finally:
+            eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# knob wiring
+
+
+class TestKernelKnobs:
+    def test_normalize_quant_defaults(self):
+        q = normalize_quant(None)
+        assert q["mode"] == "off" and q["groups"] == []
+        assert q["parity"]["min_top_agree"] == pytest.approx(0.999)
+
+    def test_normalize_quant_malformed_falls_back(self):
+        q = normalize_quant({"mode": "fp4", "groups": 7,
+                             "parity": {"max_logit_diff": "x"}})
+        assert q["mode"] == "off" and q["groups"] == []
+        assert q["parity"]["max_logit_diff"] == pytest.approx(0.5)
+
+    def test_normalize_kernels_defaults_off(self):
+        k = normalize_kernels(None)
+        assert not k["epilogue"]["enabled"]
+        assert not k["bgmv"]["enabled"]
+        assert k["bgmv"]["min_tasks"] == 8
+
+    def test_quant_selects(self):
+        q = normalize_quant({"mode": "int8", "groups": ["intent"]})
+        assert quant_selects(q, "trunk0", ["intent", "x"]) == "int8"
+        assert quant_selects(q, "trunk1", ["other"]) == "off"
+        q = normalize_quant({"mode": "bf16"})
+        assert quant_selects(q, "anything", []) == "bf16"
+
+    def test_engine_config_carries_blocks(self):
+        cfg = InferenceEngineConfig.from_dict({
+            "quant": {"mode": "int8"},
+            "kernels": {"bgmv": {"enabled": True}}})
+        assert cfg.quant_config()["mode"] == "int8"
+        assert cfg.kernels_config()["bgmv"]["enabled"]
+        assert cfg.kernels_config()["epilogue"]["enabled"] is False
+
+    def test_router_config_roundtrip(self):
+        from semantic_router_tpu.config.schema import RouterConfig
+
+        cfg = RouterConfig.from_dict({"engine": {
+            "quant": {"mode": "bf16"},
+            "kernels": {"epilogue": {"enabled": True}}}})
+        assert cfg.engine.quant_config()["mode"] == "bf16"
+        assert cfg.engine.kernels_config()["epilogue"]["enabled"]
+
+    def test_apply_kernel_knobs_bootstrap(self):
+        from semantic_router_tpu.config.schema import RouterConfig
+        from semantic_router_tpu.runtime.bootstrap import (
+            apply_kernel_knobs,
+        )
+
+        eng = kernel_engine()
+        try:
+            cfg = RouterConfig.from_dict({"engine": {
+                "kernels": {"bgmv": {"enabled": True,
+                                     "min_tasks": 2}}}})
+            apply_kernel_knobs(cfg, eng)
+            rep = eng.kernels_report()
+            assert rep["kernels"]["bgmv"]["enabled"]
+            assert all(m["bgmv"] for m in rep["groups"].values())
+            # the hot-reload path is the same function applied again
+            apply_kernel_knobs(RouterConfig.from_dict({}), eng)
+            assert not eng.kernels_report()["kernels"]["bgmv"]["enabled"]
+            # malformed knob CONTENT must never raise out of bootstrap
+            # (a non-mapping block raises at config parse time, like
+            # every other engine sub-block)
+            apply_kernel_knobs(
+                RouterConfig.from_dict({"engine": {"quant": {
+                    "mode": 123, "groups": "x",
+                    "parity": "nope"}}}),
+                eng)
+        finally:
+            eng.shutdown()
+
+    def test_kernels_report_shape(self):
+        eng = kernel_engine()
+        try:
+            rep = eng.kernels_report()
+            assert set(rep) == {"quant", "kernels", "rebuilds", "groups"}
+            assert rep["quant"]["mode"] == "off"
+            import json
+
+            json.dumps(rep)  # /debug/runtime serves this verbatim
+        finally:
+            eng.shutdown()

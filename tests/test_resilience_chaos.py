@@ -288,6 +288,9 @@ class TestHTTPSurface:
         yield srv, controller, registry
         srv.stop()
         router.shutdown()
+        # detach closes the durable store, joining its writer thread
+        # (the VSR_ANALYZE thread-leak gate pins this)
+        explainer.attach_durable(None)
         backend.stop()
 
     def _post(self, url, payload, headers=None):
