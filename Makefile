@@ -102,16 +102,22 @@ kernels-smoke:
 	  tests/test_kernels.py -q -p no:cacheprovider
 
 # repo-native analysis gate (docs/ANALYSIS.md): the static lock-order
-# graph + cycle check, the jit-purity lint, the knob-wiring
-# cross-check (schema -> normalizer -> bootstrap boot+reload -> docs
-# row), and the metric cross-reference (code <-> dashboards/docs/
-# deploy), all counter-proven against planted violations under
-# tests/fixtures/analysis/.  Findings fail the gate unless justified
-# in semantic_router_tpu/analysis/baseline.toml.  Pure AST + text
-# scanning — no jax, no model loads, <60s budget asserted in the
-# test.  Tier-1 (runs inside `make tier1` too); the RUNTIME half (the
-# lock-order witness + thread-leak gate) arms via VSR_ANALYZE=1 on
-# the packing/fleet smoke suites above.
+# graph + cycle check, the shared-state race detector (Eraser-style
+# lockset inference: guard-violation / publish-race / escape), the
+# jit-purity lint, the knob-wiring cross-check (schema -> normalizer
+# -> bootstrap boot+reload -> docs row), the metric cross-reference
+# (code <-> dashboards/docs/deploy), the API-surface cross-check
+# (/debug + /metrics routes: dispatch <-> API_CATALOG <-> openapi
+# _META <-> docs), and the runtime-event cross-ref (every published
+# stage consumed or documented), all counter-proven against planted
+# violations under tests/fixtures/analysis/.  Findings fail the gate
+# unless justified in semantic_router_tpu/analysis/baseline.toml.
+# Pure AST + text scanning — no jax, no model loads, <60s budget
+# asserted in the test.  Tier-1 (runs inside `make tier1` too); the
+# RUNTIME half (the lock-order witness + thread-leak gate + the
+# sampled access witness whose empty-lockset pairs cross-prove the
+# static race findings) arms via VSR_ANALYZE=1 on the packing/fleet
+# smoke suites above.
 analyze:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_analysis.py \
 	  -q -p no:cacheprovider

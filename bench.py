@@ -1204,12 +1204,21 @@ def _measure_analyze() -> dict:
     from semantic_router_tpu.analysis import run_all
 
     report = run_all()
+    counts: dict = {}
+    for f in report.findings:
+        counts.setdefault(f.checker, [0, 0])[0] += 1
+    for f in report.suppressed:
+        counts.setdefault(f.checker, [0, 0])[1] += 1
     return {
         "wall_s": round(time.perf_counter() - t0, 3),
         "checker_wall_s": {k: round(v, 3)
                            for k, v in sorted(report.timings_s.items())},
         "new_findings": len(report.findings),
         "baselined": len(report.suppressed),
+        # per-checker [new, baselined] — the races/api-xref/events-xref
+        # rows make detector drift visible round over round
+        "findings_by_checker": {k: list(v)
+                                for k, v in sorted(counts.items())},
         "ok": report.ok,
     }
 

@@ -1,0 +1,269 @@
+"""API-surface cross-reference checker (docs/ANALYSIS.md).
+
+The management surface has four views that must agree: the dispatch
+``elif path == "/debug/..."`` chain in ``router/server.py``, the
+``API_CATALOG`` discovery document the same file serves at
+``GET /api/v1``, the per-route ``_META`` table in ``router/openapi.py``
+(the OpenAPI document derives from the catalog, but only ``_META``
+gives a route a real summary/tag instead of a generic stub), and the
+operator docs.  PR 4's openapi test proves catalog ↔ spec; this checker
+closes the remaining edges for the observability surface — every
+``/debug/*`` and ``/metrics*`` route (the ones operators reach for
+during an incident) must exist in all four views:
+
+- ``ghost-route:*`` — the catalog advertises a route the dispatch chain
+  never handles: ``GET /api/v1`` promises a 404;
+- ``unregistered-route:*`` — the dispatch chain handles a path the
+  catalog omits: an invisible endpoint, unreachable from the discovery
+  document, the OpenAPI spec, or ``/docs``;
+- ``unspecified-route:*`` — a catalog route with no ``_META`` entry:
+  the spec ships a bare ``GET /debug/x`` stub with no summary;
+- ``undocumented-route:*`` — no docs/README mention: operators cannot
+  find it when it matters.
+
+Matching is template-aware: a catalog path ``/debug/decisions/{id}``
+matches a ``path.startswith("/debug/decisions/")`` dispatch guard via
+its concrete prefix (the text before the first ``{``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Set, Tuple
+
+from .findings import Finding
+
+_SCOPE_PREFIXES = ("/debug/", "/metrics")
+
+
+@dataclass
+class ApiXrefConfig:
+    root: str
+    server: str = os.path.join("semantic_router_tpu", "router",
+                               "server.py")
+    openapi: str = os.path.join("semantic_router_tpu", "router",
+                                "openapi.py")
+    # docs surfaces searched for route mentions
+    docs_sources: Tuple[str, ...] = ("docs", "README.md")
+    prefixes: Tuple[str, ...] = _SCOPE_PREFIXES
+
+
+def _in_scope(path: str, prefixes: Tuple[str, ...]) -> bool:
+    return any(path.startswith(p) for p in prefixes)
+
+
+def _concrete_prefix(path: str) -> str:
+    """Template path up to the first ``{param}`` segment."""
+    i = path.find("{")
+    return path if i < 0 else path[:i]
+
+
+def collect_catalog(server_path: str,
+                    prefixes: Tuple[str, ...]
+                    ) -> Dict[Tuple[str, str], int]:
+    """(METHOD, path) -> line from the API_CATALOG literal."""
+    with open(server_path, "r") as f:
+        tree = ast.parse(f.read())
+    out: Dict[Tuple[str, str], int] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name)
+                        and t.id == "API_CATALOG"
+                        for t in node.targets)):
+            continue
+        for entry in ast.walk(node.value):
+            if not isinstance(entry, ast.Dict):
+                continue
+            keys = [k.value for k in entry.keys
+                    if isinstance(k, ast.Constant)]
+            if "path" not in keys or "method" not in keys:
+                continue
+            vals = {k.value: v.value
+                    for k, v in zip(entry.keys, entry.values)
+                    if isinstance(k, ast.Constant)
+                    and isinstance(v, ast.Constant)}
+            path = str(vals.get("path", ""))
+            method = str(vals.get("method", "")).upper()
+            if path and method and _in_scope(path, prefixes):
+                out[(method, path)] = entry.lineno
+    return out
+
+
+def collect_handlers(server_path: str,
+                     prefixes: Tuple[str, ...]
+                     ) -> Tuple[Dict[str, int], Dict[str, int]]:
+    """Dispatch evidence from the handler chain: (exact path literals,
+    startswith prefix literals), each -> first line seen."""
+    with open(server_path, "r") as f:
+        tree = ast.parse(f.read())
+    exact: Dict[str, int] = {}
+    starts: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        # path == "/debug/x"  |  path in ("/a", "/b")
+        if isinstance(node, ast.Compare):
+            for comp in node.comparators:
+                consts = []
+                if isinstance(comp, ast.Constant):
+                    consts = [comp.value]
+                elif isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                    consts = [e.value for e in comp.elts
+                              if isinstance(e, ast.Constant)]
+                for c in consts:
+                    if isinstance(c, str) and _in_scope(c, prefixes):
+                        exact.setdefault(c, node.lineno)
+        # path.startswith("/debug/x/")
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "startswith" and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, str) \
+                    and _in_scope(arg.value, prefixes):
+                starts.setdefault(arg.value, node.lineno)
+    return exact, starts
+
+
+def collect_meta(openapi_path: str,
+                 prefixes: Tuple[str, ...]) -> Set[Tuple[str, str]]:
+    """(METHOD, path) keys of the _META route-metadata table."""
+    with open(openapi_path, "r") as f:
+        tree = ast.parse(f.read())
+    out: Set[Tuple[str, str]] = set()
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        if not any(isinstance(t, ast.Name) and t.id == "_META"
+                   for t in targets):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Dict):
+            continue
+        for k in value.keys:
+            if isinstance(k, ast.Tuple) and len(k.elts) == 2 \
+                    and all(isinstance(e, ast.Constant)
+                            for e in k.elts):
+                method, path = k.elts[0].value, k.elts[1].value
+                if _in_scope(str(path), prefixes):
+                    out.add((str(method).upper(), str(path)))
+    return out
+
+
+def collect_doc_mentions(root: str,
+                         sources: Tuple[str, ...]) -> str:
+    texts: List[str] = []
+    for src in sources:
+        base = os.path.join(root, src)
+        if os.path.isfile(base):
+            paths = [base]
+        elif os.path.isdir(base):
+            paths = [os.path.join(dp, fn)
+                     for dp, _dn, fns in os.walk(base)
+                     for fn in sorted(fns) if fn.endswith(".md")]
+        else:
+            continue
+        for p in sorted(paths):
+            try:
+                with open(p, "r") as f:
+                    texts.append(f.read())
+            except OSError:
+                continue
+    text = "\n".join(texts).replace("\\|", "|")
+    # expand the docs' pipe-group shorthand —
+    # "/debug/profiler/start|stop|xla-dump" documents three routes
+    expanded: List[str] = []
+    for token in text.split():
+        if "|" in token and "/" in token:
+            first, *alts = token.split("|")
+            base = first.rsplit("/", 1)[0]
+            expanded.append(first)
+            expanded.extend(f"{base}/{alt}" for alt in alts)
+    return text + "\n" + "\n".join(expanded)
+
+
+def _prefix_match(a: str, b: str) -> bool:
+    """Segment-boundary prefix relation: ``a`` extends ``b`` only
+    through a ``/`` (so ``/debug/slowlog`` does NOT cover
+    ``/debug/slo``)."""
+    if a == b or a.rstrip("/") == b.rstrip("/"):
+        return True
+    if b.endswith("/") and a.startswith(b):
+        return True
+    if a.endswith("/") and b.startswith(a):
+        return True
+    return False
+
+
+def _covered(path: str, exact: Dict[str, int],
+             starts: Dict[str, int]) -> bool:
+    concrete = _concrete_prefix(path)
+    if path in exact or concrete.rstrip("/") in exact:
+        return True
+    return any(_prefix_match(concrete, p) for p in starts)
+
+
+def check(cfg: ApiXrefConfig) -> List[Finding]:
+    findings: List[Finding] = []
+    server = os.path.join(cfg.root, cfg.server)
+    openapi = os.path.join(cfg.root, cfg.openapi)
+    catalog = collect_catalog(server, cfg.prefixes)
+    exact, starts = collect_handlers(server, cfg.prefixes)
+    meta = collect_meta(openapi, cfg.prefixes)
+    doc_text = collect_doc_mentions(cfg.root, cfg.docs_sources)
+    rel_server = cfg.server
+    rel_openapi = cfg.openapi
+
+    for (method, path), line in sorted(catalog.items()):
+        if not _covered(path, exact, starts):
+            findings.append(Finding(
+                checker="api-xref", key=f"ghost-route:{method} {path}",
+                path=rel_server, line=line,
+                message=(f"API_CATALOG advertises {method} {path} but "
+                         f"the dispatch chain never matches it — "
+                         f"GET /api/v1 (and the OpenAPI spec derived "
+                         f"from it) promises a 404")))
+        if (method, path) not in meta:
+            findings.append(Finding(
+                checker="api-xref",
+                key=f"unspecified-route:{method} {path}",
+                path=rel_openapi, line=0,
+                message=(f"{method} {path} is in API_CATALOG but has "
+                         f"no _META entry in router/openapi.py — the "
+                         f"spec serves a summary-less stub for an "
+                         f"operator-facing debug route")))
+        concrete = _concrete_prefix(path)
+        if concrete.rstrip("/") not in doc_text \
+                and concrete not in doc_text:
+            findings.append(Finding(
+                checker="api-xref",
+                key=f"undocumented-route:{method} {path}",
+                path=rel_server, line=line,
+                message=(f"{method} {path} appears in no docs/*.md or "
+                         f"README — operators cannot find the route "
+                         f"when it matters")))
+
+    cat_concrete = {_concrete_prefix(p) for (_m, p) in catalog}
+    for lit, line in sorted({**exact, **starts}.items()):
+        if any(_prefix_match(lit, c) for c in cat_concrete):
+            continue
+        findings.append(Finding(
+            checker="api-xref", key=f"unregistered-route:{lit}",
+            path=rel_server, line=line,
+            message=(f"the dispatch chain handles {lit!r} but "
+                     f"API_CATALOG does not list it — an invisible "
+                     f"endpoint the discovery document, OpenAPI spec, "
+                     f"and /docs all omit")))
+    # _META entries for routes the catalog dropped (openapi drift)
+    cat_keys = set(catalog)
+    for (method, path) in sorted(meta - cat_keys):
+        findings.append(Finding(
+            checker="api-xref", key=f"ghost-meta:{method} {path}",
+            path=rel_openapi, line=0,
+            message=(f"_META documents {method} {path} but the "
+                     f"catalog does not list that route — stale "
+                     f"metadata for a removed endpoint")))
+    return findings

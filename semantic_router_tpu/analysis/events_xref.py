@@ -1,0 +1,185 @@
+"""Runtime-event cross-reference checker (docs/ANALYSIS.md).
+
+The ``runtime/events.py`` bus is fire-and-forget by design (emission
+must never hurt the emitter), which makes it easy to publish into the
+void: a component emits a lifecycle stage nobody subscribes to, or a
+subscriber filters on a stage nothing ever emits — both are silent
+wiring rot the type system cannot see.  This checker proves the event
+namespace end to end:
+
+- **stages** — every module-level ``UPPER_NAME = "snake_string"``
+  constant in ``runtime/events.py``;
+- **publishers** — ``bus.emit(STAGE, ...)`` / ``bus.emit("stage", ...)``
+  call sites anywhere in the package (conditional expressions in the
+  stage argument count every branch);
+- **consumers** — any OTHER reference to the stage constant or its
+  string value outside the defining module: ``ev.stage == STAGE``
+  comparisons inside subscribers, ``wait_for(STAGE)``,
+  ``recent(stage=...)``, membership tests;
+- **docs rows** — the stage string appearing in
+  ``docs/OBSERVABILITY.md`` (the generic consumers — the dashboard
+  feed, the events ring at ``/dashboard/api/events`` — deliver every
+  stage to operators, so a documented stage IS consumed).
+
+Rules:
+
+- ``orphan-publish:<stage>`` — emitted, but no consumer reference and
+  no docs row: cost without an audience;
+- ``ghost-subscription:<stage>`` — a consumer filters on a stage no
+  code emits: dead reaction logic (the bug class where a stage was
+  renamed at the emit site only).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Set, Tuple
+
+from .findings import Finding
+
+
+@dataclass
+class EventsXrefConfig:
+    root: str
+    package: str = "semantic_router_tpu"
+    events_module: str = os.path.join("semantic_router_tpu", "runtime",
+                                      "events.py")
+    docs: Tuple[str, ...] = (os.path.join("docs", "OBSERVABILITY.md"),)
+
+
+_STAGE_NAME_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
+
+
+def collect_stages(events_path: str) -> Dict[str, Tuple[str, int]]:
+    """constant name -> (stage string, line)."""
+    with open(events_path, "r") as f:
+        tree = ast.parse(f.read())
+    out: Dict[str, Tuple[str, int]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and _STAGE_NAME_RE.match(node.targets[0].id) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            out[node.targets[0].id] = (node.value.value, node.lineno)
+    return out
+
+
+def _iter_py(root: str, package: str) -> List[str]:
+    out = []
+    for dirpath, _dn, fns in os.walk(os.path.join(root, package)):
+        for fn in sorted(fns):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return sorted(out)
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def scan_usage(cfg: EventsXrefConfig,
+               stages: Dict[str, Tuple[str, int]]
+               ) -> Tuple[Dict[str, List[str]], Dict[str, List[str]]]:
+    """(publishers, consumers): stage string -> list of 'relpath:line'
+    evidence sites."""
+    by_value = {v: name for name, (v, _ln) in stages.items()}
+    const_names = set(stages)
+    publishers: Dict[str, List[str]] = {}
+    consumers: Dict[str, List[str]] = {}
+    events_rel = cfg.events_module
+
+    def _add(d: Dict[str, List[str]], stage: str, where: str) -> None:
+        d.setdefault(stage, []).append(where)
+
+    for path in _iter_py(cfg.root, cfg.package):
+        rel = os.path.relpath(path, cfg.root)
+        try:
+            with open(path, "r") as f:
+                tree = ast.parse(f.read(), filename=rel)
+        except (OSError, SyntaxError):
+            continue
+        is_defining = rel == events_rel
+        emit_arg_names: Set[Tuple[str, int]] = set()  # (name, lineno)
+        for node in ast.walk(tree):
+            # publishers: bus.emit(STAGE | "stage" | COND ? A : B, ...)
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "emit" and node.args:
+                arg = node.args[0]
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name) \
+                            and sub.id in const_names:
+                        stage = stages[sub.id][0]
+                        _add(publishers, stage, f"{rel}:{node.lineno}")
+                        emit_arg_names.add((sub.id, sub.lineno))
+                    elif isinstance(sub, ast.Constant) \
+                            and isinstance(sub.value, str) \
+                            and sub.value in by_value:
+                        _add(publishers, sub.value,
+                             f"{rel}:{node.lineno}")
+                        emit_arg_names.add((sub.value, sub.lineno))
+        if is_defining:
+            continue  # the definitions are neither pub nor sub evidence
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Name) and node.id in const_names \
+                    and (node.id, node.lineno) not in emit_arg_names:
+                _add(consumers, stages[node.id][0],
+                     f"{rel}:{node.lineno}")
+            elif isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and node.value in by_value \
+                    and (node.value, node.lineno) not in emit_arg_names:
+                _add(consumers, node.value, f"{rel}:{node.lineno}")
+    return publishers, consumers
+
+
+def documented_stages(cfg: EventsXrefConfig,
+                      stages: Dict[str, Tuple[str, int]]) -> Set[str]:
+    text = ""
+    for doc in cfg.docs:
+        p = os.path.join(cfg.root, doc)
+        if os.path.exists(p):
+            with open(p, "r") as f:
+                text += f.read() + "\n"
+    return {v for _name, (v, _ln) in stages.items() if v in text}
+
+
+def check(cfg: EventsXrefConfig) -> List[Finding]:
+    findings: List[Finding] = []
+    events_path = os.path.join(cfg.root, cfg.events_module)
+    if not os.path.exists(events_path):
+        return findings
+    stages = collect_stages(events_path)
+    publishers, consumers = scan_usage(cfg, stages)
+    documented = documented_stages(cfg, stages)
+    lines = {v: ln for _n, (v, ln) in stages.items()}
+
+    for stage in sorted(publishers):
+        if stage in consumers or stage in documented:
+            continue
+        sites = sorted(set(publishers[stage]))
+        findings.append(Finding(
+            checker="events-xref", key=f"orphan-publish:{stage}",
+            path=cfg.events_module, line=lines.get(stage, 0),
+            message=(f"event stage {stage!r} is emitted "
+                     f"({', '.join(sites[:3])}) but nothing consumes "
+                     f"it and no OBSERVABILITY.md row documents it — "
+                     f"publish into the void (subscribe, document, or "
+                     f"stop emitting)")))
+    for stage in sorted(consumers):
+        if stage in publishers:
+            continue
+        sites = sorted(set(consumers[stage]))
+        findings.append(Finding(
+            checker="events-xref", key=f"ghost-subscription:{stage}",
+            path=sites[0].rsplit(":", 1)[0],
+            line=int(sites[0].rsplit(":", 1)[1]),
+            message=(f"{', '.join(sites[:3])} filters on event stage "
+                     f"{stage!r} but no code emits it — dead reaction "
+                     f"logic (the stage was renamed or the emitter "
+                     f"removed)")))
+    return findings

@@ -196,11 +196,16 @@ def _collect_class(module: str, node: ast.ClassDef) -> ClassInfo:
         if isinstance(item, ast.FunctionDef):
             info.methods[item.name] = item
             for stmt in ast.walk(item):
-                if not isinstance(stmt, ast.Assign):
+                # self._x = ... and self._x: T = ... both census
+                if isinstance(stmt, ast.Assign) \
+                        and len(stmt.targets) == 1:
+                    target = stmt.targets[0]
+                elif isinstance(stmt, ast.AnnAssign) \
+                        and stmt.value is not None:
+                    target = stmt.target
+                else:
                     continue
-                if len(stmt.targets) != 1:
-                    continue
-                attr = _self_attr(stmt.targets[0])
+                attr = _self_attr(target)
                 if attr is None:
                     continue
                 kind = _is_lock_ctor(stmt.value)

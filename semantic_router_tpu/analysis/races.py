@@ -1,0 +1,619 @@
+"""Shared-state race detector — static lockset half (docs/ANALYSIS.md).
+
+PR 12's lock checkers prove lock *ordering*; this pass proves shared
+state is *guarded at all*.  It is an Eraser-style lockset inference over
+the threaded subsystems: for every class that owns at least one lock,
+every ``self._x`` access in every method is tagged with the set of locks
+held on that path (tracked through ``with self._lock:`` regions and
+inlined same-class calls, so the ``_flush_locked`` helper idiom
+resolves), and each attribute's **guard** is inferred as the lock held
+for the majority of its accesses.  Rules:
+
+- ``guard-violation:*`` — the attribute has an inferred guard, yet some
+  path *writes* it without that guard.  The classic unguarded-access
+  bug (the autotuner-snapshot and vectorstore-publish bugs PR 12 caught
+  indirectly are both this shape).
+- ``publish-race:*`` — a read-modify-write (``self._n += 1``,
+  ``self._x = f(self._x)``) of an attribute shared across methods, in a
+  lock-owning class, under **no** lock at all.  Lost-update shape.
+- ``escape:*`` — a method returns a guarded, **mutated-in-place**
+  collection raw, so callers iterate/mutate it unguarded after the lock
+  is released.  The RCU-snapshot idiom (writers REPLACE the whole
+  object under the lock, readers return the binding raw; or the method
+  returns a fresh ``dict(self._x)``/``list(self._x)`` copy) is the
+  sanctioned fix and is recognized, not flagged.
+
+The pass is deliberately write-biased (unguarded *reads* shift the
+majority vote toward "no guard" — the snapshot idiom — rather than
+producing findings) and excludes ``__init__``-phase accesses (Eraser's
+exclusive-before-publication phase).  What it cannot see — aliasing,
+cross-object sharing, accesses from modules outside the census — the
+runtime access witness (analysis/witness.py, ``VSR_ANALYZE=1``) records
+during the smoke suites; both halves key findings by the same
+``relpath:line`` sites so they merge at pytest sessionfinish.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from . import locks
+from .findings import Finding
+
+DEFAULT_SUBDIRS = locks.DEFAULT_SUBDIRS + ("runtime",)
+
+# attribute-method calls that mutate a collection in place
+_MUTATORS = frozenset({
+    "append", "appendleft", "add", "extend", "insert", "remove",
+    "discard", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "sort", "reverse",
+})
+
+# constructors whose result is a mutable collection (escape analysis)
+_COLLECTION_CTORS = frozenset({
+    "dict", "list", "set", "deque", "defaultdict", "OrderedDict",
+    "Counter", "bytearray",
+})
+
+# methods excluded from lockset accounting: the exclusive
+# before-publication phase (no second thread can hold a reference yet)
+_INIT_METHODS = frozenset({"__init__", "__new__", "__post_init__"})
+
+_MAX_INLINE_DEPTH = 5
+
+
+@dataclass(frozen=True)
+class Access:
+    attr: str
+    kind: str                 # read | write | rmw | mutate | return
+    held: FrozenSet[str]      # lock site keys held on this path
+    method: str               # method CONTAINING the access (stable
+    line: int                 # across entry contexts, unlike the entry)
+    raw_return: bool = False  # kind=="return": returned bare (no copy)
+
+
+@dataclass
+class AttrProfile:
+    """Every distinct access to one ``Class.attr`` across all entry
+    contexts, plus the inference derived from them."""
+
+    owner: str                               # "module:Class.attr"
+    accesses: Set[Access] = field(default_factory=set)
+    guard: Optional[str] = None              # inferred lock site key
+    guard_owner: str = ""                    # human lock name
+
+    def methods(self) -> Set[str]:
+        return {a.method for a in self.accesses}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _mentions_self_attr(node: ast.AST, attr: str) -> bool:
+    for sub in ast.walk(node):
+        if _self_attr(sub) == attr:
+            return True
+    return False
+
+
+class _AccessWalker(ast.NodeVisitor):
+    """Walks one entry method (inlining same-class calls, recursion-
+    guarded) tracking held locks and recording every self-attribute
+    access into the analyzer's profiles."""
+
+    def __init__(self, an: "RaceAnalyzer", info: locks.ClassInfo,
+                 entry: str) -> None:
+        self.an = an
+        self.info = info
+        self.entry = entry
+        self.lock_attrs = an.lock_an.census.effective_lock_attrs(info)
+        self.aliases = an.lock_an.census.effective_aliases(info)
+        self.held: List[str] = []        # lock site keys, outermost first
+        self.depth = 0
+        self._inlined: Set[str] = set()  # method names on the stack
+        self._mstack: List[str] = [entry]  # containing-method stack
+        # nested defs: inlined at their LOCAL call sites with the held
+        # context there (the `def purge(): ...; with lock: purge()`
+        # idiom); ones never called locally (thread targets, returned
+        # closures) are walked afterwards with an empty context
+        self._local_funcs: Dict[str, ast.AST] = {}
+        self._locally_called: Set[str] = set()
+
+    # -- recording ---------------------------------------------------------
+
+    def _skip_attr(self, attr: str) -> bool:
+        if attr in self.info.methods:
+            return True  # method reference, not data
+        attr = self.aliases.get(attr, attr)
+        return attr in self.lock_attrs
+
+    def _record(self, attr: str, kind: str, line: int,
+                raw_return: bool = False) -> None:
+        if self._skip_attr(attr):
+            return
+        self.an.record(self.info, Access(
+            attr=attr, kind=kind, held=frozenset(self.held),
+            method=self._mstack[-1], line=line, raw_return=raw_return))
+
+    # -- lock tracking (mirrors locks._MethodWalker) -----------------------
+
+    def _lock_site_of(self, expr: ast.AST) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is None:
+            return None
+        attr = self.aliases.get(attr, attr)
+        site = self.lock_attrs.get(attr)
+        return site.key if site is not None else None
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = 0
+        for item in node.items:
+            key = self._lock_site_of(item.context_expr)
+            if key is not None:
+                self.held.append(key)
+                acquired += 1
+            else:
+                self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(acquired):
+            self.held.pop()
+
+    # -- access classification ---------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.visit(node.value)
+        for target in node.targets:
+            self._visit_target(target, node)
+
+    def _visit_target(self, target: ast.AST, node: ast.Assign) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._visit_target(el, node)
+            return
+        attr = _self_attr(target)
+        if attr is not None:
+            kind = ("rmw" if _mentions_self_attr(node.value, attr)
+                    else "write")
+            self._record(attr, kind, node.lineno)
+            return
+        if isinstance(target, ast.Subscript):
+            owner = _self_attr(target.value)
+            if owner is not None:
+                # self._x[k] = v mutates the collection in place
+                self._record(owner, "mutate", node.lineno)
+            self.visit(target.value)
+            self.visit(target.slice)
+            return
+        self.visit(target)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        # self._x: Dict[...] = {} — same access as a plain assign (a
+        # bare annotation with no value is not an access at all)
+        if node.value is None:
+            return
+        self.visit(node.value)
+        attr = _self_attr(node.target)
+        if attr is not None:
+            kind = ("rmw" if _mentions_self_attr(node.value, attr)
+                    else "write")
+            self._record(attr, kind, node.lineno)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.visit(node.value)
+        attr = _self_attr(node.target)
+        if attr is not None:
+            self._record(attr, "rmw", node.lineno)
+            return
+        if isinstance(node.target, ast.Subscript):
+            owner = _self_attr(node.target.value)
+            if owner is not None:
+                self._record(owner, "mutate", node.lineno)
+            self.visit(node.target.slice)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for target in node.targets:
+            if isinstance(target, ast.Subscript):
+                owner = _self_attr(target.value)
+                if owner is not None:
+                    self._record(owner, "mutate", node.lineno)
+            attr = _self_attr(target)
+            if attr is not None:
+                self._record(attr, "write", node.lineno)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._local_funcs[node.name] = node
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._local_funcs[node.name] = node
+
+    def flush_uncalled_closures(self) -> None:
+        """Walk closures never called in-method (thread targets,
+        callbacks handed out) with no lock held — that is how they
+        run."""
+        pending = [f for name, f in self._local_funcs.items()
+                   if name not in self._locally_called]
+        self._local_funcs = {}
+        self._locally_called = set()
+        saved = self.held
+        self.held = []
+        for fn in pending:
+            for stmt in fn.body:
+                self.visit(stmt)
+            self.flush_uncalled_closures()
+        self.held = saved
+
+    def visit_Return(self, node: ast.Return) -> None:
+        if node.value is None:
+            return
+        attr = _self_attr(node.value)
+        if attr is not None:
+            self._record(attr, "return", node.lineno, raw_return=True)
+            return
+        # dict(self._x) / self._x.copy(): a snapshot copy — recorded as
+        # a plain read (it still needs the guard to be atomic, but the
+        # REFERENCE does not escape)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if attr is not None and isinstance(node.ctx, ast.Load):
+            self._record(attr, "read", node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        # purge() — a local closure called in-method runs with the
+        # locks held HERE
+        if isinstance(fn, ast.Name) and fn.id in self._local_funcs \
+                and fn.id not in self._inlined \
+                and self.depth < _MAX_INLINE_DEPTH:
+            self._locally_called.add(fn.id)
+            self._inlined.add(fn.id)
+            self.depth += 1
+            for stmt in self._local_funcs[fn.id].body:
+                self.visit(stmt)
+            self.depth -= 1
+            self._inlined.discard(fn.id)
+        # self._x.append(...) — in-place mutation
+        if isinstance(fn, ast.Attribute):
+            owner = _self_attr(fn.value)
+            if owner is not None and fn.attr in _MUTATORS:
+                self._record(owner, "mutate", node.lineno)
+                for a in node.args:
+                    self.visit(a)
+                for kw in node.keywords:
+                    self.visit(kw.value)
+                return
+        # self.method(...) — inline the same-class call with the current
+        # held context so the `_flush_locked` idiom resolves
+        if isinstance(fn, ast.Attribute) \
+                and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "self":
+            target = self.an.lock_an.census.find_method(self.info,
+                                                        fn.attr)
+            if target is not None and fn.attr not in self._inlined \
+                    and self.depth < _MAX_INLINE_DEPTH \
+                    and fn.attr not in _INIT_METHODS:
+                self._inlined.add(fn.attr)
+                self._mstack.append(fn.attr)
+                self.depth += 1
+                # closures belong to the method that defines them —
+                # scope the registry so an inlined method's thread
+                # targets are flushed under ITS name, not the entry's
+                outer_funcs = self._local_funcs
+                outer_called = self._locally_called
+                self._local_funcs, self._locally_called = {}, set()
+                for stmt in target[1].body:
+                    self.visit(stmt)
+                self.flush_uncalled_closures()
+                self._local_funcs = outer_funcs
+                self._locally_called = outer_called
+                self.depth -= 1
+                self._mstack.pop()
+                self._inlined.discard(fn.attr)
+        self.generic_visit(node)
+
+
+class RaceAnalyzer:
+    def __init__(self, root: str,
+                 subdirs: Tuple[str, ...] = DEFAULT_SUBDIRS,
+                 rel_root: Optional[str] = None) -> None:
+        self.lock_an = locks.LockAnalyzer(root, subdirs,
+                                          rel_root=rel_root)
+        # (module, class, attr) -> profile
+        self.profiles: Dict[Tuple[str, str, str], AttrProfile] = {}
+        # collection-typed attrs per (module, class): attr -> ctor name
+        self.collections: Dict[Tuple[str, str], Dict[str, str]] = {}
+
+    # -- collection typing --------------------------------------------------
+
+    def _collect_collections(self, info: locks.ClassInfo) -> None:
+        out: Dict[str, str] = {}
+        init = info.methods.get("__init__")
+        if init is not None:
+            for node in ast.walk(init):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1:
+                    target = node.targets[0]
+                elif isinstance(node, ast.AnnAssign) \
+                        and node.value is not None:
+                    target = node.target
+                else:
+                    continue
+                attr = _self_attr(target)
+                if attr is None:
+                    continue
+                v = node.value
+                if isinstance(v, (ast.Dict, ast.DictComp)):
+                    out[attr] = "dict"
+                elif isinstance(v, (ast.List, ast.ListComp)):
+                    out[attr] = "list"
+                elif isinstance(v, (ast.Set, ast.SetComp)):
+                    out[attr] = "set"
+                elif isinstance(v, ast.Call):
+                    name = (v.func.id if isinstance(v.func, ast.Name)
+                            else v.func.attr
+                            if isinstance(v.func, ast.Attribute)
+                            else "")
+                    if name in _COLLECTION_CTORS:
+                        out[attr] = name
+        self.collections[(info.module, info.name)] = out
+
+    # -- entry selection ----------------------------------------------------
+
+    def _entries(self, info: locks.ClassInfo) -> List[str]:
+        """Methods analyzed as roots.  A private helper whose every
+        same-class call site holds a lock is NOT a root — its accesses
+        are counted through inlining from the callers, with the lock
+        held, which is exactly how it runs."""
+        called_unlocked: Set[str] = set()
+        called_locked: Set[str] = set()
+        referenced: Set[str] = set()     # bare self._m (thread targets)
+        for mname, method in info.methods.items():
+            walker = _CallSiteWalker(self, info)
+            walker.visit(method)
+            # calls from __init__ stay single-threaded (not entry
+            # evidence), but a bare self._m reference there
+            # (Thread(target=self._loop)) still marks _m as an entry
+            if mname not in _INIT_METHODS:
+                called_unlocked |= walker.unlocked
+                called_locked |= walker.locked
+            referenced |= walker.referenced
+        entries: List[str] = []
+        for mname in info.methods:
+            if mname in _INIT_METHODS:
+                continue
+            if mname.startswith("__") and mname.endswith("__") \
+                    and mname != "__call__":
+                continue  # dunder protocol hooks: not thread entries
+            if not mname.startswith("_"):
+                entries.append(mname)
+            elif mname in referenced or mname in called_unlocked:
+                entries.append(mname)
+            elif mname in called_locked:
+                pass      # covered via inlining under the lock
+            else:
+                # private, never referenced in-class: external callers
+                # or dead code — analyze standalone to be safe
+                entries.append(mname)
+        return entries
+
+    # -- recording / analysis ----------------------------------------------
+
+    def record(self, info: locks.ClassInfo, access: Access) -> None:
+        key = (info.module, info.name, access.attr)
+        prof = self.profiles.get(key)
+        if prof is None:
+            prof = self.profiles[key] = AttrProfile(
+                owner=f"{info.module}:{info.name}.{access.attr}")
+        prof.accesses.add(access)
+
+    def analyze(self) -> List[Finding]:
+        self.lock_an.collect()
+        findings: List[Finding] = []
+        for info in self.lock_an.census.classes:
+            lock_attrs = self.lock_an.census.effective_lock_attrs(info)
+            if not lock_attrs:
+                continue  # lock-free class: nothing to infer against
+            self._collect_collections(info)
+            for entry in self._entries(info):
+                method = info.methods.get(entry)
+                if method is None:
+                    continue
+                walker = _AccessWalker(self, info, entry)
+                walker._inlined.add(entry)
+                for stmt in method.body:
+                    walker.visit(stmt)
+                walker.flush_uncalled_closures()
+        for (module, cls, attr), prof in sorted(self.profiles.items()):
+            findings.extend(self._infer(module, cls, attr, prof))
+        return findings
+
+    def _infer(self, module: str, cls: str, attr: str,
+               prof: AttrProfile) -> List[Finding]:
+        findings: List[Finding] = []
+        seen_keys: Set[str] = set()
+
+        def emit(f: Finding) -> None:
+            # one finding per key: the same access line reached through
+            # several entry contexts is ONE violation
+            if f.key not in seen_keys:
+                seen_keys.add(f.key)
+                findings.append(f)
+
+        accesses = prof.accesses
+        if not accesses:
+            return findings
+        # majority guard: the lock held at the most accesses
+        votes: Dict[str, int] = {}
+        for a in accesses:
+            for key in a.held:
+                votes[key] = votes.get(key, 0) + 1
+        total = len(accesses)
+        guard = None
+        if votes:
+            best = max(sorted(votes), key=lambda k: votes[k])
+            if votes[best] * 2 > total and votes[best] >= 2:
+                guard = best
+        prof.guard = guard
+        sites = self.lock_an.graph.sites
+        guard_name = (sites[guard].owner
+                      if guard is not None and guard in sites else guard)
+        writes = [a for a in accesses
+                  if a.kind in ("write", "rmw", "mutate")]
+        mutated_in_place = any(a.kind == "mutate" for a in accesses)
+
+        if guard is not None:
+            for a in sorted(writes, key=lambda a: a.line):
+                if guard in a.held:
+                    continue
+                emit(Finding(
+                    checker="races",
+                    key=f"guard-violation:{module}:{cls}.{attr}"
+                        f"@{a.method}",
+                    path=module, line=a.line,
+                    message=(
+                        f"{cls}.{attr} is guarded by {guard_name} on "
+                        f"the majority of its accesses, but "
+                        f"{a.method}() writes it at {module}:{a.line} "
+                        f"without that lock — a concurrent guarded "
+                        f"access can interleave (take the guard, or "
+                        f"publish an immutable snapshot instead)")))
+        else:
+            # no inferred guard: flag lock-free read-modify-writes of
+            # attrs shared across methods (lost-update shape).  Whole-
+            # object replacement writes stay clean — that is the RCU
+            # publish idiom.
+            if len(prof.methods()) >= 2:
+                for a in sorted(accesses, key=lambda a: a.line):
+                    if a.kind != "rmw" or a.held:
+                        continue
+                    emit(Finding(
+                        checker="races",
+                        key=f"publish-race:{module}:{cls}.{attr}"
+                            f"@{a.method}",
+                        path=module, line=a.line,
+                        message=(
+                            f"{cls}.{attr} is read-modified-written by "
+                            f"{a.method}() at {module}:{a.line} under "
+                            f"no lock, in a class that owns locks and "
+                            f"shares the attribute across methods — "
+                            f"two threads interleaving the read and "
+                            f"the write lose one update (guard it, or "
+                            f"make it a single atomic publish)")))
+
+        # escape: returning a guarded, mutated-in-place collection raw.
+        # RCU snapshots (never mutated in place, only replaced) and
+        # copy-shaped returns are the sanctioned idioms and stay clean.
+        is_collection = attr in self.collections.get((module, cls), {})
+        if mutated_in_place and is_collection and any(
+                guard in a.held if guard is not None else a.held
+                for a in accesses):
+            for a in sorted(accesses, key=lambda a: a.line):
+                if a.kind != "return" or not a.raw_return:
+                    continue
+                emit(Finding(
+                    checker="races",
+                    key=f"escape:{module}:{cls}.{attr}@{a.method}",
+                    path=module, line=a.line,
+                    message=(
+                        f"{cls}.{attr} is a collection mutated in "
+                        f"place under a lock, but {a.method}() returns "
+                        f"the raw reference at {module}:{a.line} — the "
+                        f"caller iterates/mutates it after the lock is "
+                        f"released, racing the guarded writers (return "
+                        f"a copy taken under the lock, or publish an "
+                        f"immutable snapshot)")))
+        return findings
+
+
+class _CallSiteWalker(ast.NodeVisitor):
+    """Classifies same-class call sites of each method (under a lock or
+    not) and collects bare ``self._m`` references (thread targets,
+    callbacks) — the input to entry selection."""
+
+    def __init__(self, an: RaceAnalyzer, info: locks.ClassInfo) -> None:
+        self.an = an
+        self.info = info
+        self.lock_attrs = an.lock_an.census.effective_lock_attrs(info)
+        self.aliases = an.lock_an.census.effective_aliases(info)
+        self.depth = 0
+        self.unlocked: Set[str] = set()
+        self.locked: Set[str] = set()
+        self.referenced: Set[str] = set()
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = 0
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr is not None \
+                    and self.aliases.get(attr, attr) in self.lock_attrs:
+                acquired += 1
+        self.depth += acquired
+        for stmt in node.body:
+            self.visit(stmt)
+        self.depth -= acquired
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) \
+                and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "self" \
+                and fn.attr in self.info.methods:
+            (self.locked if self.depth else self.unlocked).add(fn.attr)
+            for a in node.args:
+                self.visit(a)
+            for kw in node.keywords:
+                self.visit(kw.value)
+            return
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if attr is not None and attr in self.info.methods \
+                and isinstance(node.ctx, ast.Load):
+            self.referenced.add(attr)
+        self.generic_visit(node)
+
+
+def check(root: str, subdirs: Tuple[str, ...] = DEFAULT_SUBDIRS,
+          rel_root: Optional[str] = None) -> List[Finding]:
+    """Run the static lockset pass; returns findings."""
+    return RaceAnalyzer(root, subdirs, rel_root=rel_root).analyze()
+
+
+def merge_runtime(static_findings: List[Finding],
+                  runtime_findings: List[Finding]) -> List[Finding]:
+    """Cross-proof merge at pytest sessionfinish: a runtime empty-
+    lockset pair whose access site matches a static finding's
+    ``relpath:line`` adopts the STATIC key (one baseline entry governs
+    both halves, exactly like the lock-order gate); runtime-only
+    findings pass through under their own ``lockset:*`` keys."""
+    by_site: Dict[str, Finding] = {}
+    for f in static_findings:
+        if f.path and f.line:
+            by_site[f"{f.path}:{f.line}"] = f
+    merged: List[Finding] = []
+    for rf in runtime_findings:
+        site = f"{rf.path}:{rf.line}" if rf.path and rf.line else ""
+        sf = by_site.get(site)
+        if sf is not None:
+            merged.append(Finding(
+                checker=sf.checker, key=sf.key, path=sf.path,
+                line=sf.line,
+                message=(sf.message + "  [CROSS-PROVEN: the runtime "
+                         "access witness recorded an empty-lockset "
+                         "pair at this exact site — "
+                         + rf.message + "]")))
+        else:
+            merged.append(rf)
+    return merged

@@ -12,7 +12,15 @@ import os
 import time
 from typing import Optional
 
-from . import jitpurity, knobs, locks, metrics_xref
+from . import (
+    api_xref,
+    events_xref,
+    jitpurity,
+    knobs,
+    locks,
+    metrics_xref,
+    races,
+)
 from .findings import Report, apply_baseline, load_baseline
 
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -47,6 +55,20 @@ def run_all(root: Optional[str] = None,
     findings.extend(metrics_xref.check(
         metrics_xref.XrefConfig(root=root)))
     timings["metrics-xref"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    findings.extend(races.check(
+        os.path.join(root, "semantic_router_tpu"), rel_root=root))
+    timings["races"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    findings.extend(api_xref.check(api_xref.ApiXrefConfig(root=root)))
+    timings["api-xref"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    findings.extend(events_xref.check(
+        events_xref.EventsXrefConfig(root=root)))
+    timings["events-xref"] = time.perf_counter() - t0
 
     try:
         suppressions = load_baseline(baseline_path)

@@ -22,6 +22,21 @@ static graph and fails on any cycle; ``check_thread_leaks()`` is the
 companion gate asserting tests leave no stray non-daemon threads and no
 unexpected daemon threads (allowlisted process-lifetime threads aside).
 Both run from the conftest session hook under ``VSR_ANALYZE=1``.
+
+The **access witness** (the race detector's runtime half, ISSUE 14) is
+the Eraser algorithm live: ``watch_class()`` wraps a repo class's
+``__setattr__`` (and ``watch_dict_attr()`` a dict attribute's mutators)
+in a sampled recorder that tags each write with (thread, witnessed
+locks held).  Per (object, attr) the usual state machine runs —
+exclusive while one thread owns the object, then a candidate lockset
+initialized at the first second-thread access and intersected on every
+later one; an empty intersection across ≥2 threads is a race pair,
+reported by ``check_access_races()`` with the ``relpath:line`` of both
+write sites so findings merge with the static lockset pass
+(analysis/races.py) at pytest sessionfinish.  Sampling (default 1/8,
+``VSR_ACCESS_SAMPLE``) plus site extraction only on state transitions
+keeps the smoke-suite overhead inside the witness's existing ≤5%
+bound.
 """
 
 from __future__ import annotations
@@ -368,3 +383,345 @@ def check_thread_leaks(baseline: Iterable[threading.Thread],
                 f"process-lifetime threads belong on the conftest "
                 f"allowlist with a justification)")))
     return findings
+
+
+# -- access witness (the race detector's runtime half) ---------------------
+#
+# Eraser's lockset algorithm, live: every sampled write to a watched
+# object is tagged with (thread, witnessed locks held).  Per (object,
+# attr) the state machine runs exclusive -> shared: while one thread
+# owns the object nothing is inferred (the before-publication phase);
+# the first access from a second thread initializes the candidate
+# lockset to the locks held right then, and every later access
+# intersects it.  An empty intersection with >=2 threads is a race
+# pair — two threads wrote the same attribute with no common lock.
+
+_ACCESS_SAMPLE_DEFAULT = 8
+_MAX_TRACKED = 4096
+
+_access_lock = _thread.allocate_lock()
+_access_states: Dict[Tuple[int, str], "_AccessState"] = {}
+_access_races: Dict[str, Dict[str, str]] = {}   # "Cls.attr" -> pair info
+# cls -> (original __setattr__, had own __setattr__ in class dict)
+_watched_classes: Dict[type, Tuple[object, bool]] = {}
+_relcache: Dict[str, Optional[str]] = {}        # filename -> relpath|None
+# ids with a live weakref.finalize purging their states on GC — a
+# recycled id must NEVER inherit a dead object's access history (two
+# sequential objects would read as two racing threads)
+_access_finalized: Set[int] = set()
+# dead ids pending purge.  The finalizer must NOT take _access_lock:
+# GC can fire inside record_access's critical section (the state
+# dicts allocate) and the same thread would self-deadlock on the
+# non-reentrant lock — so it only does a lock-free list append
+# (atomic under the GIL) and record_access drains before each lookup.
+_access_purge_queue: List[int] = []
+
+
+def _purge_access_id(oid: int) -> None:
+    _access_purge_queue.append(oid)
+
+
+def _drain_purge_queue_locked() -> None:
+    """Caller holds _access_lock."""
+    while _access_purge_queue:
+        dead = _access_purge_queue.pop()
+        _access_finalized.discard(dead)
+        for key in [k for k in _access_states if k[0] == dead]:
+            del _access_states[key]
+
+
+class _AccessState:
+    __slots__ = ("cls_name", "owner_tid", "lockset", "sites")
+
+    def __init__(self, cls_name: str, tid: int) -> None:
+        self.cls_name = cls_name
+        self.owner_tid: Optional[int] = tid    # None once shared
+        self.lockset: Optional[frozenset] = None
+        self.sites: Dict[int, Tuple[str, str]] = {}  # tid -> (site, name)
+
+
+def _access_site(depth: int) -> Optional[str]:
+    """repo-relative ``path:line`` of the mutating frame (filename ->
+    relpath memoized: the hot cost is one dict hit + an f-string)."""
+    f = sys._getframe(depth)
+    fn = f.f_code.co_filename
+    rel = _relcache.get(fn)
+    if rel is None and fn not in _relcache:
+        ab = os.path.abspath(fn)
+        rel = (os.path.relpath(ab, _REPO_ROOT)
+               if ab.startswith(_REPO_ROOT + os.sep) else None)
+        _relcache[fn] = rel
+    if rel is None:
+        return None
+    return f"{rel}:{f.f_lineno}"
+
+
+def record_access(obj: object, attr: str, depth: int = 2,
+                  label: Optional[str] = None) -> None:
+    """One sampled write to ``obj.attr``.  ``depth`` is the stack
+    distance to the frame that performed the mutation; ``label``
+    overrides the ``Cls.attr`` reporting identity (dict proxies report
+    as their OWNER's attribute, not as _WatchedDict)."""
+    if not _installed:
+        return  # no lock witness -> locksets would all read empty
+    tid = _thread.get_ident()
+    held = frozenset(lk.site for lk in _held())
+    site = _access_site(depth + 1)
+    if site is None:
+        return
+    cls_name = (label.rsplit(".", 1)[0] if label
+                else type(obj).__name__)
+    if label:
+        attr = label.rsplit(".", 1)[1]
+    tname = threading.current_thread().name
+    oid = id(obj)
+    key = (oid, attr)
+    need_finalizer = False
+    with _access_lock:
+        _drain_purge_queue_locked()
+        st = _access_states.get(key)
+        if st is not None and st.cls_name != cls_name:
+            st = None   # id recycled across classes: stale history
+        if st is None:
+            if len(_access_states) >= _MAX_TRACKED:
+                return
+            st = _access_states[key] = _AccessState(cls_name, tid)
+            need_finalizer = oid not in _access_finalized
+            if need_finalizer:
+                _access_finalized.add(oid)
+        st.sites[tid] = (site, tname)
+        if st.owner_tid is not None and st.owner_tid != tid:
+            st.owner_tid = None             # shared: lockset starts NOW
+            st.lockset = held
+        elif st.owner_tid is None:
+            st.lockset = (st.lockset & held if st.lockset is not None
+                          else held)
+        race_key = f"{cls_name}.{attr}"
+        if st.owner_tid is None and not st.lockset \
+                and len(st.sites) >= 2 \
+                and race_key not in _access_races:
+            other = next(((s, n) for t, (s, n) in st.sites.items()
+                          if t != tid), ("?", "?"))
+            _access_races[race_key] = {
+                "cls": cls_name, "attr": attr,
+                "site": site, "thread": tname,
+                "other_site": other[0], "other_thread": other[1],
+            }
+    if need_finalizer:
+        # outside the state lock: weakref.finalize allocates
+        try:
+            import weakref
+
+            weakref.finalize(obj, _purge_access_id, oid)
+        except TypeError:
+            # not weakrefable (dict proxies): drop the marker so a
+            # future object at this address gets a fresh registration
+            # attempt; the cls-name mismatch guard above is the only
+            # stale-history protection for these
+            _access_finalized.discard(oid)
+
+
+def _watched_setattr_factory(cls: type, sample: int):
+    orig = cls.__setattr__
+    counter = [0]
+
+    def __setattr__(self, name, value):
+        orig(self, name, value)
+        counter[0] += 1    # racy increment: it only paces the sampling
+        if counter[0] % sample == 0:
+            # depth=2: the frame that performed `obj.attr = ...`
+            # (0=_access_site's caller chain starts at record_access,
+            # 1=this wrapper, 2=the mutating code)
+            record_access(self, name, depth=2)
+
+    __setattr__._vsr_watched = True
+    return __setattr__, orig
+
+
+def watch_class(cls: type, sample: Optional[int] = None) -> None:
+    """Wrap ``cls.__setattr__`` in the sampled recorder.  Idempotent,
+    inheritance-aware (a subclass of a watched class is already
+    covered — wrapping again would double-record)."""
+    if getattr(cls.__setattr__, "_vsr_watched", False):
+        return
+    if sample is None:
+        sample = int(os.environ.get("VSR_ACCESS_SAMPLE",
+                                    _ACCESS_SAMPLE_DEFAULT) or 0) \
+            or _ACCESS_SAMPLE_DEFAULT
+    had_own = "__setattr__" in cls.__dict__
+    wrapper, orig = _watched_setattr_factory(cls, max(1, sample))
+    _watched_classes[cls] = (orig, had_own)
+    cls.__setattr__ = wrapper
+
+
+class _WatchedDict(dict):
+    """Dict proxy recording in-place mutations (the ``self._x[k] = v``
+    shape ``__setattr__`` hooking cannot see)."""
+
+    __slots__ = ("_vsr_label",)
+
+    def _vsr_record(self) -> None:
+        # depth=3: 1=_vsr_record, 2=the mutator method, 3=the caller
+        record_access(self, "", depth=3, label=self._vsr_label)
+
+    def __setitem__(self, k, v):
+        super().__setitem__(k, v)
+        self._vsr_record()
+
+    def __delitem__(self, k):
+        super().__delitem__(k)
+        self._vsr_record()
+
+    def pop(self, *a):
+        out = super().pop(*a)
+        self._vsr_record()
+        return out
+
+    def popitem(self):
+        out = super().popitem()
+        self._vsr_record()
+        return out
+
+    def clear(self):
+        super().clear()
+        self._vsr_record()
+
+    def update(self, *a, **kw):
+        super().update(*a, **kw)
+        self._vsr_record()
+
+    def setdefault(self, k, default=None):
+        out = super().setdefault(k, default)
+        self._vsr_record()
+        return out
+
+
+def watch_dict_attr(obj: object, attr: str) -> "_WatchedDict":
+    """Replace ``obj.attr`` (a dict) with a recording proxy whose
+    accesses are attributed to ``Cls.attr``."""
+    proxy = _WatchedDict(getattr(obj, attr))
+    proxy._vsr_label = f"{type(obj).__name__}.{attr}"
+    object.__setattr__(obj, attr, proxy)
+    return proxy
+
+
+def unwatch(cls: type) -> None:
+    """Restore one class's original ``__setattr__`` (tests watch their
+    own fixture classes and must not disturb the session's arming)."""
+    entry = _watched_classes.pop(cls, None)
+    if entry is None:
+        return
+    orig, had_own = entry
+    if had_own:
+        cls.__setattr__ = orig
+    else:
+        try:
+            delattr(cls, "__setattr__")
+        except AttributeError:
+            cls.__setattr__ = orig
+
+
+def unwatch_all() -> None:
+    for cls in list(_watched_classes):
+        unwatch(cls)
+
+
+def reset_access() -> None:
+    with _access_lock:
+        del _access_purge_queue[:]
+        _access_finalized.clear()
+        _access_states.clear()
+        _access_races.clear()
+
+
+class access_capture:
+    """Scoped race capture for counter-proof tests: races recorded (and
+    per-object states created) inside the block are removed from the
+    global store on exit, so a deliberately-planted race in a self-test
+    can never fail the session gate — and a recycled object id cannot
+    inherit a dead test object's access history."""
+
+    def __enter__(self) -> "access_capture":
+        with _access_lock:
+            self._before_races = set(_access_races)
+            self._before_states = set(_access_states)
+        self.races: Dict[str, Dict[str, str]] = {}
+        return self
+
+    def __exit__(self, *exc) -> None:
+        with _access_lock:
+            for k in list(_access_races):
+                if k not in self._before_races:
+                    self.races[k] = _access_races.pop(k)
+            for k in list(_access_states):
+                if k not in self._before_states:
+                    del _access_states[k]
+
+
+def check_access_races() -> List[Finding]:
+    """Empty-lockset pairs as findings (checker="races", the SAME
+    namespace as the static lockset pass, so one baseline entry governs
+    a site regardless of which half saw it first).  ``path``/``line``
+    carry the recorded write site — the merge key races.merge_runtime
+    matches against static findings."""
+    with _access_lock:
+        races = [dict(v) for v in _access_races.values()]
+    out: List[Finding] = []
+    for r in sorted(races, key=lambda r: (r["cls"], r["attr"])):
+        path, _, line = r["site"].rpartition(":")
+        out.append(Finding(
+            checker="races",
+            key=f"lockset:{r['cls']}.{r['attr']}",
+            path=path, line=int(line or 0),
+            message=(
+                f"runtime access witness: threads {r['other_thread']!r} "
+                f"(at {r['other_site']}) and {r['thread']!r} (at "
+                f"{r['site']}) both wrote {r['cls']}.{r['attr']} with "
+                f"no common lock held — lockset intersection is empty; "
+                f"guard the attribute or publish immutable snapshots")))
+    return out
+
+
+# intentionally small: the hot concurrent classes whose shared state the
+# smoke suites actually exercise.  Arming is lazy — only classes whose
+# module is already in sys.modules wrap (the conftest re-arms at each
+# test boundary), so a session that never imports the engine never
+# pays its import.
+DEFAULT_WATCHED = (
+    ("semantic_router_tpu.engine.batcher", "DynamicBatcher"),
+    ("semantic_router_tpu.engine.packing.scheduler", "PackingBatcher"),
+    ("semantic_router_tpu.engine.packing.autotuner", "ShapeAutoTuner"),
+    ("semantic_router_tpu.runtime.events", "EventBus"),
+    ("semantic_router_tpu.stateplane.plane", "StatePlane"),
+    ("semantic_router_tpu.stateplane.backend", "GuardedBackend"),
+    ("semantic_router_tpu.stateplane.cache", "SharedSemanticCache"),
+    ("semantic_router_tpu.resilience.controller", "DegradationController"),
+    ("semantic_router_tpu.flywheel.controller", "FlywheelController"),
+)
+
+
+def arm_access_watch(entries=DEFAULT_WATCHED,
+                     sample: Optional[int] = None,
+                     load: bool = False) -> int:
+    """Instrument the watch list.  By default only classes whose module
+    is ALREADY imported are armed (the conftest re-arms at each test
+    boundary — cheap sys.modules lookups — so a session that never
+    imports the engine never pays the import); ``load=True`` forces the
+    imports for standalone consumers.  Returns how many classes are
+    armed after the call."""
+    n = 0
+    for mod, cls_name in entries:
+        try:
+            module = sys.modules.get(mod)
+            if module is None:
+                if not load:
+                    continue
+                import importlib
+
+                module = importlib.import_module(mod)
+            cls = getattr(module, cls_name)
+        except Exception:
+            continue
+        watch_class(cls, sample=sample)
+        n += 1
+    return n

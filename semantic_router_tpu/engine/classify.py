@@ -608,11 +608,15 @@ class InferenceEngine:
         g.fns = self._make_fused_fn(g, meta)
         g.apply_fn = g.fns["seq"]
         if old is not None:
-            self._kernel_rebuilds += 1
             self._series().kernel_rebuilds.inc(group=g.gid)
             group = f"trunk:{g.gid}"
 
             def purge():
+                # runs under self._lock on both paths below, so the
+                # rebuild counter and the registry purge are one
+                # atomic step (two concurrent reloads must not lose
+                # an increment or interleave the purge)
+                self._kernel_rebuilds += 1
                 keys = [k for k in self._compiled_steps
                         if k[0] == group]
                 self._compiled_steps = {

@@ -82,11 +82,13 @@ class PackingBatcher(DynamicBatcher):
         _int("max_segments_per_row", "max_segments_per_row", 1)
         _int("max_inflight_steps", "max_inflight_steps", 1)
         _int("starvation_steps", "starvation_steps", 0)
-        try:
-            self.max_items_per_step = int(
-                knobs.get("max_items_per_step", self.max_items_per_step))
-        except (TypeError, ValueError):
-            pass
+        if "max_items_per_step" in knobs:
+            # single atomic publish (no read-modify-write of the live
+            # value: the step thread reads this concurrently)
+            try:
+                self.max_items_per_step = int(knobs["max_items_per_step"])
+            except (TypeError, ValueError):
+                pass
 
     def _item_budget(self) -> int:
         """Items one packed step may carry.  0 (the default knob) means

@@ -336,7 +336,12 @@ class FlywheelController:
             pass
         self.update_admission_weights(ev)
 
-        if self.state in ("canary", "promoted"):
+        # one state read under the state lock, used through the rest of
+        # the decision — a rollback landing mid-cycle must not give the
+        # skip-check and the report two different answers
+        with self._lock:
+            state = self.state
+        if state in ("canary", "promoted"):
             # the current candidate is SERVING traffic: replacing it
             # mid-flight would leave the installed selectors orphaned
             # and — worse — move state out of the SLO-rollback guard's
@@ -344,9 +349,9 @@ class FlywheelController:
             # rolls back (or the burn guard does) before a new
             # candidate can enter the ladder.
             report["skipped_promotion"] = (
-                f"candidate already serving (state={self.state}); "
+                f"candidate already serving (state={state}); "
                 f"rollback first")
-            report["state"] = self.state
+            report["state"] = state
             self.last_cycle_at = time.time()
             return report
 
@@ -390,7 +395,10 @@ class FlywheelController:
                     continue
                 try:
                     self.run_cycle()
-                    self.cycles_run += 1
+                    with self._lock:
+                        # configure() may restart the runner; the old
+                        # and new loop threads must not lose a count
+                        self.cycles_run += 1
                 except Exception as exc:
                     component_event(
                         "flywheel", "scheduled_cycle_failed",

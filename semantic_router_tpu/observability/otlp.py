@@ -160,11 +160,16 @@ class _BatchingExporter:
             try:
                 with urllib.request.urlopen(req,
                                             timeout=self.timeout_s):
-                    self.exported += len(batch)
+                    with self._lock:
+                        # flush() runs on the exporter thread AND from
+                        # shutdown/test callers — counters under the
+                        # buffer lock, not bare +=
+                        self.exported += len(batch)
                     return len(batch)
             except Exception as exc:
                 if attempt == 1:
-                    self.dropped += len(batch)
+                    with self._lock:
+                        self.dropped += len(batch)
                     component_event("otlp", self._event_name,
                                     error=str(exc)[:200],
                                     dropped=len(batch), level="warning")

@@ -191,7 +191,11 @@ class RuntimeStats:
         if not self.enabled:
             return
         if len(self._pending) == self._pending.maxlen:
-            self._dropped += 1  # bounded: backpressure never blocks serving
+            # bounded: backpressure never blocks serving.  The lock is
+            # only taken on this saturated branch — the healthy path
+            # stays a lock-free deque append.
+            with self._lock:
+                self._dropped += 1
         self._pending.append((group, int(bucket), variant, int(rows),
                               int(padded_rows), float(seconds),
                               bool(compiled), int(tokens_real),

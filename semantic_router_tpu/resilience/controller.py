@@ -315,10 +315,15 @@ class DegradationController:
             self.slo = slo
         if fleet is not None:
             self.fleet = fleet
-        if tracer is not None:
-            self._tracer = tracer
-        if explain is not None:
-            self._explain = explain
+        if tracer is not None or explain is not None:
+            # the knob-shed path reads/writes these under the lock; a
+            # hot-reload rebind racing an L1 transition must not tear
+            # the save/restore pairing
+            with self._lock:
+                if tracer is not None:
+                    self._tracer = tracer
+                if explain is not None:
+                    self._explain = explain
         if events is not None and events is not self.event_bus:
             if self._unsubscribe is not None:
                 try:
@@ -577,33 +582,45 @@ class DegradationController:
     def _apply_knob_effects(self, old: int, new: int) -> None:
         """L1 knob shedding: entering the ladder drops trace sampling to
         the floor and floors decision-record sampling; returning to L0
-        restores the operator's values exactly.  Idempotent per edge."""
+        restores the operator's values exactly.  Idempotent per edge.
+
+        Runs under self._lock: _after_transition deliberately fires
+        outside the lock, so the tick thread and an engine-failed jump
+        can reach here concurrently — the save/restore swap of
+        _saved_knobs must be atomic or a restore loses the operator's
+        values.  The body only sets foreign plain attributes (no lock
+        acquisitions), so holding the lock here cannot invert."""
         try:
-            if old == L0_NORMAL and new > L0_NORMAL \
-                    and self._saved_knobs is None:
-                saved: Dict[str, float] = {}
-                if self._tracer is not None:
-                    saved["trace_sample_rate"] = float(
-                        getattr(self._tracer, "sample_rate", 0.0))
-                    self._tracer.sample_rate = self.trace_sample_floor
-                if self._explain is not None:
-                    saved["decision_sample_rate"] = float(
-                        getattr(self._explain, "sample_rate", 1.0))
-                    self._explain.sample_rate = min(
-                        saved["decision_sample_rate"],
-                        self.decision_sample_floor)
-                self._saved_knobs = saved
-            elif new == L0_NORMAL and self._saved_knobs is not None:
-                saved, self._saved_knobs = self._saved_knobs, None
-                if self._tracer is not None \
-                        and "trace_sample_rate" in saved:
-                    self._tracer.sample_rate = saved["trace_sample_rate"]
-                if self._explain is not None \
-                        and "decision_sample_rate" in saved:
-                    self._explain.sample_rate = \
-                        saved["decision_sample_rate"]
+            with self._lock:
+                self._apply_knob_effects_locked(old, new)
         except Exception:
             pass
+
+    def _apply_knob_effects_locked(self, old: int, new: int) -> None:
+        """The edge logic; caller holds self._lock."""
+        if old == L0_NORMAL and new > L0_NORMAL \
+                and self._saved_knobs is None:
+            saved: Dict[str, float] = {}
+            if self._tracer is not None:
+                saved["trace_sample_rate"] = float(
+                    getattr(self._tracer, "sample_rate", 0.0))
+                self._tracer.sample_rate = self.trace_sample_floor
+            if self._explain is not None:
+                saved["decision_sample_rate"] = float(
+                    getattr(self._explain, "sample_rate", 1.0))
+                self._explain.sample_rate = min(
+                    saved["decision_sample_rate"],
+                    self.decision_sample_floor)
+            self._saved_knobs = saved
+        elif new == L0_NORMAL and self._saved_knobs is not None:
+            saved, self._saved_knobs = self._saved_knobs, None
+            if self._tracer is not None \
+                    and "trace_sample_rate" in saved:
+                self._tracer.sample_rate = saved["trace_sample_rate"]
+            if self._explain is not None \
+                    and "decision_sample_rate" in saved:
+                self._explain.sample_rate = \
+                    saved["decision_sample_rate"]
 
     def resync_knob_effects(self) -> None:
         """Re-shed the sampling knobs after a config hot reload.  The
@@ -612,10 +629,18 @@ class DegradationController:
         would silently undo the L1 shed — and a later recovery would
         restore pre-reload values.  Forgetting the stale save and
         re-running the L0→current edge saves the fresh operator values
-        and floors them again."""
-        if self._level > L0_NORMAL:
-            self._saved_knobs = None
-            self._apply_knob_effects(L0_NORMAL, self._level)
+        and floors them again.  One critical section end to end: a
+        de-escalation to L0 interleaving between the forget and the
+        re-apply would otherwise skip its restore and strand the
+        floors."""
+        try:
+            with self._lock:
+                if self._level > L0_NORMAL:
+                    self._saved_knobs = None
+                    self._apply_knob_effects_locked(L0_NORMAL,
+                                                    self._level)
+        except Exception:
+            pass
 
     # -- admission (the hot path) -----------------------------------------
 
@@ -704,7 +729,11 @@ class DegradationController:
 
     def _shed(self, lvl: int, priority: str, retry_after_s: float,
               reason: str) -> Disposition:
-        self.shed_count += 1
+        with self._lock:
+            # admit() is lock-free on the healthy path; shedding is
+            # already the slow path, and concurrent sheds must not
+            # lose counts
+            self.shed_count += 1
         try:
             self.shed_total.inc(level=level_name(lvl), priority=priority)
         except Exception:

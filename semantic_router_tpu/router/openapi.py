@@ -293,6 +293,49 @@ _META: Dict[tuple, Dict[str, Any]] = {
         "request": _ref("VectorSearchRequest")},
     ("GET", "/debug/profiler"): {
         "tag": "debug", "summary": "Profiler status."},
+    ("GET", "/debug/flightrec"): {
+        "tag": "debug",
+        "summary": "Slow-request flight recorder: the retained "
+                   "over-threshold request traces (docs/TRACING.md)."},
+    ("POST", "/debug/flightrec/clear"): {
+        "tag": "debug", "summary": "Drop the retained flight-recorder "
+                                   "traces."},
+    ("GET", "/debug/slo"): {
+        "tag": "debug",
+        "summary": "SLO engine state: per-objective burn rates, "
+                   "multiwindow alert status, error budgets."},
+    ("GET", "/debug/runtime"): {
+        "tag": "debug",
+        "summary": "Per-jit-program device-step sampler: cold vs warm "
+                   "steps, padding waste, token fill, kernel/quant "
+                   "program-set state, process gauges."},
+    ("GET", "/debug/resilience"): {
+        "tag": "debug",
+        "summary": "Degradation-ladder snapshot: level, pressure "
+                   "inputs, shed counts, admission bucket fill, "
+                   "fleet-aggregated view."},
+    ("GET", "/debug/decisions"): {
+        "tag": "debug",
+        "summary": "Recent decision records (replay-grade routing "
+                   "audit trail).",
+        "params": [{"name": "limit", "in": "query",
+                    "schema": {"type": "integer"}}]},
+    ("GET", "/debug/decisions/{id}"): {
+        "tag": "debug", "summary": "One decision record, full detail."},
+    ("POST", "/debug/decisions/{id}/replay"): {
+        "tag": "debug",
+        "summary": "Deterministically re-drive a recorded decision "
+                   "(optionally against the live config for a "
+                   "counterfactual diff)."},
+    ("GET", "/debug/flywheel"): {
+        "tag": "debug",
+        "summary": "Learned-routing flywheel state: promotion ladder, "
+                   "last cycle report, counterfactual eval."},
+    ("POST", "/debug/flywheel/cycle"): {
+        "tag": "debug",
+        "summary": "Run one flywheel cycle now (export → train → "
+                   "eval → shadow-on-win); serialized with the "
+                   "scheduled runner."},
     ("GET", "/debug/stateplane"): {
         "tag": "debug",
         "summary": "Shared-state-plane snapshot: replica membership, "

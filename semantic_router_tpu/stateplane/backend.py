@@ -454,8 +454,12 @@ class GuardedBackend:
             raise StateBackendUnavailable(
                 f"state backend {op} failed: "
                 f"{type(exc).__name__}: {exc}") from exc
-        self.roundtrips += 1
-        self.roundtrip_s_total += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        with self._lock:
+            # replica threads share one guarded backend; the two
+            # counters move together or the mean roundtrip lies
+            self.roundtrips += 1
+            self.roundtrip_s_total += dt
         self._ok()
         return out
 

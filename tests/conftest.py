@@ -36,11 +36,20 @@ import pytest
 #     session end the edges merge with the static lock graph
 #     (analysis/locks.py) and any cycle fails the session;
 #   * the thread-leak gate: the session must end with no new
-#     non-daemon threads and no unexpected daemon threads.
+#     non-daemon threads and no unexpected daemon threads;
+#   * the ACCESS witness (the race detector's runtime half,
+#     docs/ANALYSIS.md): the hot concurrent classes get a sampled
+#     __setattr__ recorder tagging each write with (thread, locks
+#     held); at session end every empty-lockset pair across >=2
+#     threads merges with the static lockset pass
+#     (analysis/races.py) on relpath:line sites and fails the
+#     session unless baseline-justified.
 #
 # The witness is installed AFTER the jax import above: jax's internal
 # locks predate it (and out-of-repo constructions get raw primitives
-# back anyway), so tier-1 overhead stays <5% on the smoke suites.
+# back anyway), so tier-1 overhead stays <5% on the smoke suites; the
+# access watch samples 1/8 writes (VSR_ACCESS_SAMPLE) for the same
+# bound.
 
 VSR_ANALYZE = os.environ.get("VSR_ANALYZE", "") not in ("", "0")
 
@@ -65,6 +74,15 @@ def pytest_sessionstart(session):
     global _thread_baseline
     if VSR_ANALYZE:
         _thread_baseline = _witness.thread_snapshot()
+        _witness.arm_access_watch()
+
+
+def pytest_runtest_setup(item):
+    # re-arm at each test boundary: watch-list modules imported since
+    # the last check get wrapped now (sys.modules lookups only — a
+    # session that never imports the engine never pays its import)
+    if VSR_ANALYZE:
+        _witness.arm_access_watch()
 
 
 def pytest_sessionfinish(session, exitstatus):
@@ -80,16 +98,30 @@ def pytest_sessionfinish(session, exitstatus):
         DEFAULT_THREAD_ALLOWLIST,
     )
 
+    from semantic_router_tpu.analysis import races as _races
+
     problems = _witness.check_lock_order(static_lock_edges())
     problems += _witness.check_thread_leaks(
         _thread_baseline or set(),
         allowlist=tuple(DEFAULT_THREAD_ALLOWLIST) + THREAD_ALLOWLIST)
+    # the race detector's cross-proof: runtime empty-lockset pairs
+    # merge with the static lockset findings on relpath:line sites —
+    # a pair landing on a statically-flagged write adopts the static
+    # key, so ONE baseline entry governs both halves
+    access = _witness.check_access_races()
+    if access:
+        import semantic_router_tpu.analysis as _an
+
+        static_races = _races.check(
+            os.path.join(_an.REPO_ROOT, "semantic_router_tpu"),
+            rel_root=_an.REPO_ROOT)
+        problems += _races.merge_runtime(static_races, access)
     # honor baseline.toml here too: a justified suppression must mean
     # the same thing to `make analyze` and to this session gate (stale-
     # entry hygiene is `make analyze`'s job, not the smoke suites')
     try:
         sup = [s for s in load_baseline(BASELINE_PATH)
-               if s.checker in ("locks", "thread-leak")]
+               if s.checker in ("locks", "thread-leak", "races")]
         problems = apply_baseline(problems, sup).findings
     except ValueError:
         pass  # malformed baseline fails `make analyze` with the detail

@@ -1,0 +1,16 @@
+"""Fixture _META table: covers the clean routes, omits /debug/nometa
+(unspecified-route), and documents a route the catalog no longer lists
+(ghost-meta)."""
+
+_META = {
+    ("GET", "/debug/ok"): {"tag": "debug", "summary": "Clean route."},
+    ("GET", "/debug/items/{id}"): {"tag": "debug",
+                                   "summary": "Template route."},
+    ("GET", "/debug/nodocs"): {"tag": "debug",
+                               "summary": "Documented nowhere."},
+    ("GET", "/debug/ghost"): {"tag": "debug",
+                              "summary": "Catalog-only route."},
+    ("GET", "/debug/removed"): {"tag": "debug",
+                                "summary": "Stale: route removed."},
+    ("GET", "/metrics"): {"tag": "system", "summary": "Exposition."},
+}

@@ -1,0 +1,32 @@
+"""Planted API-surface drift for analysis/api_xref.py: a catalog ghost
+route, an uncataloged dispatch handler, a route with no _META entry,
+and an undocumented route — plus the clean twins (exact and
+template/startswith)."""
+
+API_CATALOG = {
+    "endpoints": [
+        {"path": "/debug/ok", "method": "GET"},
+        {"path": "/debug/items/{id}", "method": "GET"},
+        {"path": "/debug/ghost", "method": "GET"},     # no handler
+        {"path": "/debug/nometa", "method": "GET"},    # no _META row
+        {"path": "/debug/nodocs", "method": "GET"},    # no docs mention
+        {"path": "/metrics", "method": "GET"},
+    ],
+}
+
+
+class Handler:
+    def do_GET(self, path):
+        if path == "/debug/ok":
+            return 200
+        elif path.startswith("/debug/items/"):
+            return 200
+        elif path == "/debug/nometa":
+            return 200
+        elif path == "/debug/nodocs":
+            return 200
+        elif path == "/debug/hidden":   # planted: not in the catalog
+            return 200
+        elif path == "/metrics":
+            return 200
+        return 404

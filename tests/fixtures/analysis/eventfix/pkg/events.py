@@ -1,0 +1,6 @@
+"""Fixture event-stage constants for analysis/events_xref.py."""
+
+CLEAN_STAGE = "fix_clean_stage"          # emitted + consumed
+ORPHAN_STAGE = "fix_orphan_stage"        # emitted, never consumed
+GHOST_STAGE = "fix_ghost_stage"          # consumed, never emitted
+DOCUMENTED_STAGE = "fix_documented_stage"  # emitted, docs row only

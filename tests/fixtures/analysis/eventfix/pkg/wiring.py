@@ -1,0 +1,23 @@
+"""Planted publisher/subscriber drift: the orphan publish, the ghost
+subscription, and the clean + documented twins."""
+
+from .events import CLEAN_STAGE, DOCUMENTED_STAGE, GHOST_STAGE, ORPHAN_STAGE
+
+
+class Component:
+    def __init__(self, bus):
+        self.bus = bus
+
+    def work(self):
+        self.bus.emit(CLEAN_STAGE, ok=True)
+        self.bus.emit(ORPHAN_STAGE, oops=True)     # nobody listens
+        self.bus.emit(DOCUMENTED_STAGE, fine=True)  # docs row covers it
+
+
+class Subscriber:
+    def on_event(self, ev):
+        if ev.stage == CLEAN_STAGE:
+            return "reacted"
+        if ev.stage == GHOST_STAGE:               # nothing emits this
+            return "never happens"
+        return None

@@ -1,0 +1,94 @@
+"""Sanctioned twins for the race-detector counter-proofs: the same
+shapes as mod.py with the guard taken, the publish made atomic, and the
+RCU-snapshot / copy-return idioms — none may be flagged."""
+
+import threading
+
+
+class GuardedClean:
+    """Every access under the one guard."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+
+    def put(self, k, v):
+        with self._lock:
+            self._items[k] = v
+
+    def drop(self, k):
+        with self._lock:
+            self._items.pop(k, None)
+
+    def read(self, k):
+        with self._lock:
+            return self._items.get(k)
+
+
+class CountingClean:
+    """The RMW moved under the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+
+    def record(self):
+        with self._lock:
+            self.hits += 1
+
+    def snapshot(self):
+        with self._lock:
+            return {"hits": self.hits}
+
+
+class SnapshotClean:
+    """The RCU idiom: writers REPLACE the whole mapping under the lock
+    (never mutate in place); the reader returns the binding raw — an
+    immutable snapshot, not an escape."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._view = {}
+
+    def publish(self, rows):
+        fresh = dict(rows)
+        with self._lock:
+            self._view = fresh
+
+    def view(self):
+        return self._view   # sanctioned: whole-object publish, raw read
+
+
+class CopyClean:
+    """The copy-return idiom: the guarded collection IS mutated in
+    place, but readers get a copy taken under the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = []
+
+    def add(self, row):
+        with self._lock:
+            self._rows.append(row)
+
+    def rows(self):
+        with self._lock:
+            return list(self._rows)
+
+
+class LockedHelperClean:
+    """The _locked-helper idiom: the helper's accesses run under the
+    caller's lock — inlining must see the guard."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = []
+
+    def flush(self):
+        with self._lock:
+            return self._flush_locked()
+
+    def _flush_locked(self):
+        out = list(self._pending)
+        del self._pending[:]
+        return out

@@ -1,0 +1,80 @@
+"""Planted violations for the static lockset race detector
+(analysis/races.py) — one per rule.  The counter-proofs in
+tests/test_analysis.py assert each is FLAGGED; clean.py holds the
+sanctioned twins that must stay clean."""
+
+import threading
+
+
+class Guarded:
+    """guard-violation: _items is written under _lock everywhere except
+    the unguarded fast-path writer."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+
+    def put(self, k, v):
+        with self._lock:
+            self._items[k] = v
+
+    def drop(self, k):
+        with self._lock:
+            self._items.pop(k, None)
+
+    def read(self, k):
+        with self._lock:
+            return self._items.get(k)
+
+    def put_fast(self, k, v):
+        # the planted bug: same attribute, no guard
+        self._items[k] = v
+
+
+class Counting:
+    """publish-race: a read-modify-write of a shared counter outside
+    any lock, in a class that owns one."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+
+    def record(self):
+        self.hits += 1   # planted: lock-free RMW
+
+    def snapshot(self):
+        with self._lock:
+            return {"hits": self.hits}
+
+
+class AnnotatedEscape:
+    """escape, annotated-assignment flavor: `self._table: dict = {}`
+    must be just as visible to the collection census as a plain
+    assign (the live repo declares most collections this way)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table: dict = {}
+
+    def put(self, k, v):
+        with self._lock:
+            self._table[k] = v
+
+    def table(self):
+        return self._table   # planted: annotated collection escapes
+
+
+class Escaping:
+    """escape: a lock-guarded, mutated-in-place collection returned
+    raw — callers iterate it while writers mutate under the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = []
+
+    def add(self, row):
+        with self._lock:
+            self._rows.append(row)
+
+    def rows(self):
+        return self._rows   # planted: raw reference escapes the guard

@@ -399,3 +399,413 @@ class TestAnalyzeGate:
             assert e.reason.strip(), (
                 f"baseline entry ({e.checker}, {e.key}) lacks a "
                 f"justification")
+
+
+# -- shared-state race detector: static lockset half -----------------------
+
+
+class TestRaceChecker:
+    def _findings(self):
+        from semantic_router_tpu.analysis import races
+
+        return races.check(FIXDIR, subdirs=("racefix",))
+
+    def test_flags_guard_violation(self):
+        keys = {f.key for f in self._findings()}
+        assert ("guard-violation:racefix/mod.py:Guarded._items"
+                "@put_fast") in keys, keys
+
+    def test_flags_publish_race(self):
+        keys = {f.key for f in self._findings()}
+        assert ("publish-race:racefix/mod.py:Counting.hits"
+                "@record") in keys, keys
+
+    def test_flags_escaped_collection(self):
+        keys = {f.key for f in self._findings()}
+        assert "escape:racefix/mod.py:Escaping._rows@rows" in keys, keys
+
+    def test_flags_annotated_escape(self):
+        # `self._table: dict = {}` — the AnnAssign flavor the live
+        # repo uses for most collections must census identically
+        keys = {f.key for f in self._findings()}
+        assert ("escape:racefix/mod.py:AnnotatedEscape._table"
+                "@table") in keys, keys
+
+    def test_clean_twins_stay_clean(self):
+        # the fully-guarded class, the locked RMW, the RCU snapshot,
+        # the copy-return, and the _locked-helper idiom: zero findings
+        bad = [f for f in self._findings() if "clean.py" in f.key]
+        assert bad == [], [f.key for f in bad]
+
+    def test_guard_inference_majority(self):
+        from semantic_router_tpu.analysis import races
+
+        an = races.RaceAnalyzer(FIXDIR, subdirs=("racefix",))
+        an.analyze()
+        prof = an.profiles[("racefix/mod.py", "Guarded", "_items")]
+        assert prof.guard is not None
+        assert "mod.py" in prof.guard
+
+    def test_locked_helper_inlined_under_guard(self):
+        from semantic_router_tpu.analysis import races
+
+        an = races.RaceAnalyzer(FIXDIR, subdirs=("racefix",))
+        an.analyze()
+        prof = an.profiles[("racefix/clean.py", "LockedHelperClean",
+                            "_pending")]
+        assert prof.accesses and all(a.held for a in prof.accesses), \
+            sorted((a.method, a.kind, tuple(a.held))
+                   for a in prof.accesses)
+
+    def test_repo_profiles_populate(self):
+        from semantic_router_tpu.analysis import races
+
+        an = races.RaceAnalyzer(
+            os.path.join(REPO_ROOT, "semantic_router_tpu"),
+            rel_root=REPO_ROOT)
+        an.analyze()
+        assert len(an.profiles) >= 50, "lockset pass lost the repo"
+        guarded = [p for p in an.profiles.values()
+                   if p.guard is not None]
+        assert len(guarded) >= 10, "no guards inferred on the live repo"
+
+    def test_merge_runtime_adopts_static_key(self):
+        from semantic_router_tpu.analysis import races
+        from semantic_router_tpu.analysis.findings import Finding
+
+        static = [Finding("races", "guard-violation:m.py:C.x@w",
+                          "static msg", path="m.py", line=7)]
+        runtime = [
+            Finding("races", "lockset:C.x", "runtime msg",
+                    path="m.py", line=7),      # same site: cross-proof
+            Finding("races", "lockset:D.y", "runtime only",
+                    path="n.py", line=3),
+        ]
+        merged = races.merge_runtime(static, runtime)
+        assert merged[0].key == "guard-violation:m.py:C.x@w"
+        assert "CROSS-PROVEN" in merged[0].message
+        assert merged[1].key == "lockset:D.y"
+
+
+# -- API-surface cross-check -----------------------------------------------
+
+
+def _apifix_cfg():
+    from semantic_router_tpu.analysis import api_xref
+
+    return api_xref.ApiXrefConfig(
+        root=os.path.join(FIXDIR, "apifix"),
+        server=os.path.join("pkg", "server.py"),
+        openapi=os.path.join("pkg", "openapi.py"),
+        docs_sources=("docs",))
+
+
+class TestApiXref:
+    def test_flags_planted_drift(self):
+        from semantic_router_tpu.analysis import api_xref
+
+        keys = {f.key for f in api_xref.check(_apifix_cfg())}
+        assert "ghost-route:GET /debug/ghost" in keys, keys
+        assert "unregistered-route:/debug/hidden" in keys, keys
+        assert "unspecified-route:GET /debug/nometa" in keys, keys
+        assert "undocumented-route:GET /debug/nodocs" in keys, keys
+        assert "ghost-meta:GET /debug/removed" in keys, keys
+
+    def test_clean_routes_not_flagged(self):
+        from semantic_router_tpu.analysis import api_xref
+
+        keys = {f.key for f in api_xref.check(_apifix_cfg())}
+        for k in keys:
+            assert "/debug/ok" not in k, keys
+            assert "/debug/items" not in k, keys   # template route
+            assert "/metrics" not in k, keys
+
+    def test_repo_catalog_and_handlers_found(self):
+        from semantic_router_tpu.analysis import api_xref
+
+        server = os.path.join(REPO_ROOT, "semantic_router_tpu",
+                              "router", "server.py")
+        catalog = api_xref.collect_catalog(
+            server, api_xref._SCOPE_PREFIXES)
+        assert ("GET", "/debug/runtime") in catalog
+        assert ("GET", "/metrics/external") in catalog
+        exact, starts = api_xref.collect_handlers(
+            server, api_xref._SCOPE_PREFIXES)
+        assert "/debug/runtime" in exact
+        assert any(p.startswith("/debug/decisions") for p in starts)
+
+    def test_repo_meta_covers_debug_surface(self):
+        from semantic_router_tpu.analysis import api_xref
+
+        meta = api_xref.collect_meta(
+            os.path.join(REPO_ROOT, "semantic_router_tpu", "router",
+                         "openapi.py"),
+            api_xref._SCOPE_PREFIXES)
+        # the landing fix: every catalog debug route has real metadata
+        for route in [("GET", "/debug/runtime"), ("GET", "/debug/slo"),
+                      ("GET", "/debug/flywheel"),
+                      ("POST", "/debug/decisions/{id}/replay")]:
+            assert route in meta, route
+
+    def test_pipe_group_docs_shorthand_expands(self):
+        from semantic_router_tpu.analysis import api_xref
+
+        text = api_xref.collect_doc_mentions(REPO_ROOT, ("docs",))
+        # OBSERVABILITY.md documents the profiler POSTs as
+        # start|stop|xla-dump — the expansion must cover each
+        assert "/debug/profiler/stop" in text
+        assert "/debug/profiler/xla-dump" in text
+
+
+# -- runtime-event cross-ref -----------------------------------------------
+
+
+def _eventfix_cfg():
+    from semantic_router_tpu.analysis import events_xref
+
+    return events_xref.EventsXrefConfig(
+        root=os.path.join(FIXDIR, "eventfix"),
+        package="pkg",
+        events_module=os.path.join("pkg", "events.py"),
+        docs=(os.path.join("docs", "OBSERVABILITY.md"),))
+
+
+class TestEventsXref:
+    def test_flags_orphan_publish_and_ghost_subscription(self):
+        from semantic_router_tpu.analysis import events_xref
+
+        keys = {f.key for f in events_xref.check(_eventfix_cfg())}
+        assert "orphan-publish:fix_orphan_stage" in keys, keys
+        assert "ghost-subscription:fix_ghost_stage" in keys, keys
+
+    def test_consumed_and_documented_stages_clean(self):
+        from semantic_router_tpu.analysis import events_xref
+
+        keys = {f.key for f in events_xref.check(_eventfix_cfg())}
+        assert "orphan-publish:fix_clean_stage" not in keys
+        assert "orphan-publish:fix_documented_stage" not in keys
+
+    def test_repo_stages_collected(self):
+        from semantic_router_tpu.analysis import events_xref
+
+        stages = events_xref.collect_stages(
+            os.path.join(REPO_ROOT, "semantic_router_tpu", "runtime",
+                         "events.py"))
+        assert "ENGINE_READY" in stages
+        assert stages["ENGINE_READY"][0] == "engine_ready"
+        assert len(stages) >= 10
+
+    def test_repo_publishers_and_consumers_found(self):
+        from semantic_router_tpu.analysis import events_xref
+
+        cfg = events_xref.EventsXrefConfig(root=REPO_ROOT)
+        stages = events_xref.collect_stages(
+            os.path.join(REPO_ROOT, cfg.events_module))
+        pubs, subs = events_xref.scan_usage(cfg, stages)
+        assert "engine_ready" in pubs
+        assert "engine_failed" in subs, \
+            "the resilience controller's engine_failed filter is gone"
+
+
+# -- runtime access witness (the race detector's runtime half) -------------
+
+
+class _RaceyBox:
+    """Fixture class for the access-witness drives."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.value = 0
+
+
+def _drive_threads(*fns):
+    """Run the writer callables on OVERLAPPING threads (a barrier keeps
+    both alive at once: sequential start/join lets CPython recycle the
+    dead thread's ident, which would make two writers look like one to
+    the per-thread access bookkeeping)."""
+    barrier = threading.Barrier(len(fns))
+
+    def wrap(fn):
+        def run():
+            barrier.wait(timeout=5)
+            fn()
+        return run
+
+    threads = [threading.Thread(target=wrap(fn)) for fn in fns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+class TestAccessWitness:
+    def _installed(self):
+        was = witness.enabled()
+        if not was:
+            witness.install()
+        return was
+
+    def test_two_thread_unlocked_writes_record_empty_lockset(self):
+        was = self._installed()
+        try:
+            witness.watch_class(_RaceyBox, sample=1)
+            box = _RaceyBox()
+            with witness.access_capture() as cap:
+                def writer():
+                    for _ in range(4):
+                        box.value = 1
+
+                _drive_threads(writer, writer)
+            assert "_RaceyBox.value" in cap.races, cap.races
+            pair = cap.races["_RaceyBox.value"]
+            assert "test_analysis.py" in pair["site"]
+        finally:
+            witness.unwatch(_RaceyBox)
+            if not was:
+                witness.uninstall()
+
+    def test_common_lock_suppresses_race(self):
+        was = self._installed()
+        try:
+            witness.watch_class(_RaceyBox, sample=1)
+            box = _RaceyBox()
+            # the box's lock must be a WITNESSED lock for the lockset
+            # to be visible — construct it here (repo-relative site)
+            box.lock = threading.Lock()
+            with witness.access_capture() as cap:
+                def writer():
+                    for _ in range(4):
+                        with box.lock:
+                            box.value = 1
+
+                _drive_threads(writer, writer)
+            assert "_RaceyBox.value" not in cap.races, cap.races
+        finally:
+            witness.unwatch(_RaceyBox)
+            if not was:
+                witness.uninstall()
+
+    def test_exclusive_single_thread_never_flags(self):
+        was = self._installed()
+        try:
+            witness.watch_class(_RaceyBox, sample=1)
+            box = _RaceyBox()
+            with witness.access_capture() as cap:
+                for _ in range(50):
+                    box.value += 1   # one thread, no locks: exclusive
+            assert cap.races == {}
+        finally:
+            witness.unwatch(_RaceyBox)
+            if not was:
+                witness.uninstall()
+
+    def test_watched_dict_mutation_recorded(self):
+        was = self._installed()
+        try:
+            box = _RaceyBox()
+            box.table = {}
+            proxy = witness.watch_dict_attr(box, "table")
+            with witness.access_capture() as cap:
+                def writer(k):
+                    def run():
+                        for i in range(4):
+                            proxy[k] = i
+                    return run
+
+                _drive_threads(writer("a"), writer("b"))
+            assert "_RaceyBox.table" in cap.races, cap.races
+        finally:
+            if not was:
+                witness.uninstall()
+
+    def test_check_access_races_findings_shape(self):
+        was = self._installed()
+        try:
+            witness.watch_class(_RaceyBox, sample=1)
+            box = _RaceyBox()
+            with witness.access_capture() as cap:
+                def writer():
+                    box.value = 2
+
+                _drive_threads(writer, writer)
+                finds = witness.check_access_races()
+                assert any(f.key == "lockset:_RaceyBox.value"
+                           and f.checker == "races"
+                           and f.path.startswith("tests")
+                           and f.line > 0
+                           for f in finds), [f.key for f in finds]
+            # capture scope: the planted race left the global store
+            assert "_RaceyBox.value" in cap.races
+            assert not any(f.key == "lockset:_RaceyBox.value"
+                           for f in witness.check_access_races())
+        finally:
+            witness.unwatch(_RaceyBox)
+            if not was:
+                witness.uninstall()
+
+    def test_sampling_paces_recording(self):
+        was = self._installed()
+        try:
+            witness.watch_class(_RaceyBox, sample=1000)
+            box = _RaceyBox()
+            with witness.access_capture() as cap:
+                def writer():
+                    for _ in range(10):
+                        box.value = 3   # 20 writes << sample period
+
+                _drive_threads(writer, writer)
+            assert cap.races == {}   # nothing sampled, nothing tracked
+        finally:
+            witness.unwatch(_RaceyBox)
+            if not was:
+                witness.uninstall()
+
+    def test_overhead_within_witness_bound(self):
+        """The smoke-shaped bound: on a workload where attribute writes
+        are a realistic fraction of the work (they ride lock
+        acquisitions and real compute), the sampled access watch must
+        stay inside the witness's existing <=5% envelope."""
+        was = self._installed()
+
+        def workload(box):
+            acc = 0
+            for i in range(200):
+                with box.lock:
+                    # ~50us of work per attribute write: the smoke
+                    # suites do far MORE per write (a device step),
+                    # so this bounds the watch's worst realistic share
+                    for j in range(1000):
+                        acc += j * j
+                    box.value = i
+            return acc
+
+        def timed(fn, *a):
+            t0 = time.perf_counter()
+            fn(*a)
+            return time.perf_counter() - t0
+
+        try:
+            base_box = _RaceyBox()
+
+            class _ArmedBox(_RaceyBox):
+                pass
+
+            armed_box = _ArmedBox()
+            witness.watch_class(_ArmedBox, sample=8)
+            # warm both paths, then INTERLEAVE the measurements so CPU
+            # frequency / scheduler drift hits both sides equally
+            workload(base_box)
+            workload(armed_box)
+            base = armed = float("inf")
+            for _ in range(9):
+                base = min(base, timed(workload, base_box))
+                armed = min(armed, timed(workload, armed_box))
+        finally:
+            witness.unwatch(_ArmedBox)
+            witness.reset_access()
+            if not was:
+                witness.uninstall()
+        ratio = armed / base if base > 0 else 1.0
+        assert ratio < 1.05, (
+            f"sampled access watch cost {ratio:.3f}x on the "
+            f"smoke-shaped workload (bound 1.05x)")
