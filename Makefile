@@ -3,8 +3,8 @@ SHELL := /bin/bash
 
 .PHONY: test test-fast tier1 trace-smoke metrics-lint explain-smoke \
 	resilience-smoke fleet-smoke flywheel-smoke upstream-smoke \
-	packing-smoke kernels-smoke analyze native bench bench-replay \
-	perf perf-record serve-mock clean
+	packing-smoke kernels-smoke mesh-smoke analyze native bench \
+	bench-replay perf perf-record serve-mock clean
 
 bench-replay:
 	$(PY) benchmarks/replay_bench.py --n 500 \
@@ -100,6 +100,20 @@ packing-smoke:
 kernels-smoke:
 	env JAX_PLATFORMS=cpu VSR_ANALYZE=1 $(PY) -m pytest \
 	  tests/test_kernels.py -q -p no:cacheprovider
+
+# mesh-serving gate (docs/PARALLEL.md): dp×tp placement of the fused/
+# packed classifier bank on the forced 8-device CPU mesh (conftest
+# sets --xla_force_host_platform_device_count=8) — sharded-vs-single-
+# device logit parity (≤1e-4 float; quantized batches through the
+# engine.quant parity policy) across fused/packed/LoRA'd/deduped/token
+# batches, the hot mesh flip under concurrent traffic, the dp-scaled
+# scheduler budgets, enabled:false byte-identical, and the knob
+# wiring boot+reload.  VSR_ANALYZE=1: the lock-order witness, thread-
+# leak gate, and (read-sampling) access witness arm over the hot-flip
+# path.  Tier-1 (runs inside `make tier1` too).
+mesh-smoke:
+	env JAX_PLATFORMS=cpu VSR_ANALYZE=1 $(PY) -m pytest \
+	  tests/test_mesh_serving.py -q -p no:cacheprovider
 
 # repo-native analysis gate (docs/ANALYSIS.md): the static lock-order
 # graph + cycle check, the shared-state race detector (Eraser-style

@@ -284,6 +284,12 @@ def _reexec_cpu_isolated() -> int:
 
 
 def main() -> None:
+    if os.environ.get("SRT_BENCH_MESH_CHILD"):
+        # the mesh arm's isolated child: runs on a forced multi-device
+        # CPU host mesh (XLA_FLAGS set by the parent) and prints ONE
+        # json line — never the headline record
+        print(json.dumps(_mesh_measure_body()))
+        return
     if os.environ.get("SRT_BENCH_CHILD"):
         _child_main()
         return
@@ -990,6 +996,106 @@ def _measure_packing(platform: str) -> dict:
     return out
 
 
+def _mesh_measure_body() -> dict:
+    """Serving-mesh measurement (runs inside the mesh child, or
+    in-process on a real multi-device slice): signals/s through the
+    SAME shared-trunk engine with engine.mesh on (dp over every
+    visible device) vs off, plus the mesh-step counters proving the
+    sharded path actually served."""
+    import jax
+
+    from semantic_router_tpu.config.schema import InferenceEngineConfig
+    from semantic_router_tpu.engine.testing import make_shared_trunk_engine
+    from semantic_router_tpu.observability.metrics import (
+        MetricSeries,
+        MetricsRegistry,
+    )
+
+    import numpy as np
+
+    n_dev = jax.device_count()
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(0xE5)
+    words = ("alpha beta gamma delta epsilon zeta eta theta iota "
+             "kappa lambda mu nu xi omicron pi rho sigma").split()
+    texts = [" ".join(rng.choice(words, size=int(rng.integers(8, 28))))
+             for _ in range(64)]
+    window_s = 3.0 if platform == "cpu" else 6.0
+    rows = {}
+    for label, mesh in (("sharded", {"enabled": True}),
+                        ("unsharded", {})):
+        m = MetricSeries(MetricsRegistry())
+        eng = make_shared_trunk_engine(
+            engine_cfg=InferenceEngineConfig(
+                max_batch_size=16, max_wait_ms=2.0,
+                seq_len_buckets=[128, 512],
+                packing={"enabled": True}, mesh=mesh),
+            metrics=m)
+        try:
+            eng.classify_batch("intent", texts)  # warm the jit cache
+            n = 0
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < window_s:
+                eng.classify_batch("intent", texts)
+                n += len(texts)
+            dt = time.perf_counter() - t0
+            rows[label] = {
+                "signals_per_s": round(n / dt, 2),
+                "mesh_steps": int(m.mesh_steps.total()),
+                "packed_steps": int(m.packed_steps.total()),
+            }
+        finally:
+            eng.shutdown()
+    out = {
+        "devices": n_dev,
+        "platform": platform,
+        "axes": {"dp": n_dev, "tp": 1},
+        "sharded": rows["sharded"],
+        "unsharded": rows["unsharded"],
+    }
+    if rows["unsharded"]["signals_per_s"]:
+        out["speedup"] = round(rows["sharded"]["signals_per_s"]
+                               / rows["unsharded"]["signals_per_s"], 3)
+    if platform == "cpu":
+        out["note"] = ("forced multi-device CPU host mesh: the "
+                       f"{n_dev} 'devices' split one host, so this is "
+                       "a placement-correctness signal, not a speedup "
+                       "claim — on-chip rows land the first time a "
+                       "TPU claim grants")
+    return out
+
+
+def _measure_mesh(platform: str) -> dict:
+    """Serving-mesh arm (docs/PARALLEL.md, ISSUE 15): on a real
+    multi-device slice, measure in-process; otherwise re-exec a child
+    on a FORCED 8-device CPU host mesh
+    (--xla_force_host_platform_device_count=8) so every round proves
+    the dp-sharded path off-TPU."""
+    import jax
+
+    if platform != "cpu" and jax.device_count() >= 2:
+        return _mesh_measure_body()
+    import subprocess
+
+    env = dict(os.environ)
+    env.pop("SRT_BENCH_CHILD", None)
+    env.pop("SRT_BENCH_CPU_DIRECT", None)
+    env["SRT_BENCH_MESH_CHILD"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, capture_output=True, text=True, timeout=420)
+    if proc.returncode != 0 or not proc.stdout.strip():
+        raise RuntimeError(
+            f"mesh child rc={proc.returncode}: "
+            f"{proc.stderr.strip()[-300:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 def _clock_jit(fn, iters: int, *args):
     """Warm (one full compile+execute) then time: (ms_per_step, last
     output).  Shared by the kernel micro-arms; jax.device_get is the
@@ -1556,6 +1662,13 @@ def _run_bench(platform: str) -> None:
     except Exception as exc:
         sys.stderr.write(f"bench: bgmv arm failed "
                          f"({type(exc).__name__}: {exc}); skipped\n")
+    mesh_row = None
+    try:
+        mesh_row = _measure_mesh(platform)
+        sys.stderr.write(f"bench: mesh {mesh_row}\n")
+    except Exception as exc:
+        sys.stderr.write(f"bench: mesh arm failed "
+                         f"({type(exc).__name__}: {exc}); skipped\n")
 
     # the `make analyze` tier-1 gate's cost, kept visible in the BENCH
     # json (docs/ANALYSIS.md): per-checker wall time + finding counts —
@@ -1582,6 +1695,16 @@ def _run_bench(platform: str) -> None:
         "value": round(signals_per_s, 2),
         "unit": "signals/s",
         "vs_baseline": round(signals_per_s / GPU_BASELINE_SIGNALS_PER_S, 3),
+        # every round self-describes its device environment (ISSUE 15
+        # satellite): the r02–r05 CPU-fallback rows (vs_baseline
+        # ≈ 0.003) needed the stderr log to explain themselves
+        "device_env": {
+            "platform": platform,
+            "device_count": jax.device_count(),
+            "device_kind": getattr(jax.devices()[0], "device_kind",
+                                   platform),
+            "host_cores": os.cpu_count(),
+        },
     }
     if fused_row is not None:
         record["fused_bank_signals_per_s"] = fused_row["signals_per_s"]
@@ -1606,6 +1729,8 @@ def _run_bench(platform: str) -> None:
         record["epilogue"] = epilogue_row
     if bgmv_row is not None:
         record["bgmv"] = bgmv_row
+    if mesh_row is not None:
+        record["mesh"] = mesh_row
     if analyze_row is not None:
         record["analyze"] = analyze_row
     if platform != "cpu":

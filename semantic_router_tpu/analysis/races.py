@@ -36,6 +36,7 @@ during the smoke suites; both halves key findings by the same
 from __future__ import annotations
 
 import ast
+import os
 from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 
@@ -585,10 +586,454 @@ class _CallSiteWalker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+# -- module-level globals (ISSUE 15 satellite) ------------------------------
+#
+# The class pass above covers ``self._x`` under instance locks; bare
+# MODULE state (the ``_MEMO = {}`` + ``_MEMO_LOCK = threading.Lock()``
+# idiom, e.g. engine/classify.py's leaf-digest memo) was a blind spot.
+# Same Eraser shape, module scope: every function in a module that owns
+# at least one module-level lock is walked with ``with LOCK:`` regions
+# tracked, accesses to module-level mutable globals are tagged with the
+# locks held, the majority lock is the inferred guard, and writes
+# without it / lock-free RMWs across >=2 functions are flagged.  A
+# global only ever REPLACED whole (the module-RCU publish) or never
+# written from functions (a constant) stays clean by construction.
+
+_MAX_GLOBAL_INLINE = 5
+
+
+class _GlobalAccessWalker(ast.NodeVisitor):
+    """Walks one module-level function tracking held module locks and
+    recording accesses to the module's mutable globals.  Module-function
+    calls by bare name inline with the current held context (the
+    module-level ``_flush_locked`` idiom), recursion-guarded."""
+
+    def __init__(self, an: "ModuleGlobalAnalyzer", module: str,
+                 func: str) -> None:
+        self.an = an
+        self.module = module
+        self.held: List[str] = []
+        self.depth = 0
+        self._inlined: Set[str] = {func}
+        self._fstack: List[str] = [func]
+
+    @staticmethod
+    def _iter_own_scope(fn):
+        """Nodes of ONE function's scope — nested function/lambda
+        subtrees are pruned (ast.walk would descend into them, leaking
+        a nested def's locals/global-decls into the outer scope and
+        masking the outer function's real accesses)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue  # its names bind in ITS scope, not ours
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _function_scope(self, fn) -> Tuple[Set[str], Set[str]]:
+        """(global-declared names, locally-bound names) for one
+        function body — a name assigned WITHOUT a global declaration is
+        a local and shadows the module global for the whole function."""
+        declared: Set[str] = set()
+        local: Set[str] = set()
+        for node in self._iter_own_scope(fn):
+            if isinstance(node, ast.Global):
+                declared.update(node.names)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        local.add(t.id)
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)) \
+                    and isinstance(node.target, ast.Name):
+                local.add(node.target.id)
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                tgt = node.target
+                for sub in ast.walk(tgt):
+                    if isinstance(sub, ast.Name):
+                        local.add(sub.id)
+        args = getattr(fn, "args", None)
+        if args is not None:
+            for a in (args.args + args.posonlyargs + args.kwonlyargs):
+                local.add(a.arg)
+            if args.vararg:
+                local.add(args.vararg.arg)
+            if args.kwarg:
+                local.add(args.kwarg.arg)
+        return declared, local - declared
+
+    def walk_function(self, fn) -> None:
+        self._declared, self._local = self._function_scope(fn)
+        for stmt in fn.body:
+            self.visit(stmt)
+
+    def _tracked(self, name: str) -> bool:
+        return name in self.an.mutables.get(self.module, {}) \
+            and name not in self._local
+
+    def _record(self, name: str, kind: str, line: int) -> None:
+        if not self._tracked(name):
+            return
+        self.an.record(self.module, name, Access(
+            attr=name, kind=kind, held=frozenset(self.held),
+            method=self._fstack[-1], line=line))
+
+    # -- lock tracking -----------------------------------------------------
+
+    def _lock_key_of(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            return self.an.locks.get(self.module, {}).get(expr.id)
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = 0
+        for item in node.items:
+            key = self._lock_key_of(item.context_expr)
+            if key is not None:
+                self.held.append(key)
+                acquired += 1
+            else:
+                self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(acquired):
+            self.held.pop()
+
+    # -- access classification ---------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.visit(node.value)
+        for target in node.targets:
+            self._visit_target(target, node)
+
+    def _visit_target(self, target: ast.AST,
+                      node: ast.Assign) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            # `_STATE, ok = rebuild()` writes the global too
+            for el in target.elts:
+                self._visit_target(el, node)
+            return
+        if isinstance(target, ast.Name):
+            if target.id in self._declared:
+                kind = "rmw" if any(
+                    isinstance(s, ast.Name) and s.id == target.id
+                    for s in ast.walk(node.value)) else "write"
+                self._record(target.id, kind, node.lineno)
+        elif isinstance(target, ast.Subscript) \
+                and isinstance(target.value, ast.Name):
+            self._record(target.value.id, "mutate", node.lineno)
+            self.visit(target.slice)
+        else:
+            self.visit(target)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.visit(node.value)
+        if isinstance(node.target, ast.Name):
+            if node.target.id in self._declared:
+                self._record(node.target.id, "rmw", node.lineno)
+        elif isinstance(node.target, ast.Subscript) \
+                and isinstance(node.target.value, ast.Name):
+            self._record(node.target.value.id, "mutate", node.lineno)
+            self.visit(node.target.slice)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for target in node.targets:
+            if isinstance(target, ast.Subscript) \
+                    and isinstance(target.value, ast.Name):
+                self._record(target.value.id, "mutate", node.lineno)
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self._record(node.id, "read", node.lineno)
+
+    def _visit_nested(self, node) -> None:
+        """Descend into a nested def with ITS scope flags: a name the
+        nested function binds locally shadows the global only INSIDE
+        it (and its accesses there are locals, not global traffic);
+        outer locals stay shadowed through the closure."""
+        declared, local = self._function_scope(node)
+        saved = (self._declared, self._local)
+        self._declared = declared
+        self._local = (local | saved[1]) - declared
+        for stmt in node.body:
+            self.visit(stmt)
+        self._declared, self._local = saved
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_nested(node)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._visit_nested(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        # _GLOBAL.setdefault(...) — in-place mutation of a global
+        if isinstance(fn, ast.Attribute) \
+                and isinstance(fn.value, ast.Name) \
+                and fn.attr in _MUTATORS:
+            self._record(fn.value.id, "mutate", node.lineno)
+            for a in node.args:
+                self.visit(a)
+            for kw in node.keywords:
+                self.visit(kw.value)
+            return
+        # helper() — a module function called by bare name runs with
+        # the locks held HERE
+        if isinstance(fn, ast.Name):
+            target = self.an.functions.get(self.module, {}).get(fn.id)
+            if target is not None and fn.id not in self._inlined \
+                    and self.depth < _MAX_GLOBAL_INLINE:
+                self._inlined.add(fn.id)
+                self._fstack.append(fn.id)
+                self.depth += 1
+                saved = (self._declared, self._local)
+                self._declared, self._local = \
+                    self._function_scope(target)
+                for stmt in target.body:
+                    self.visit(stmt)
+                self._declared, self._local = saved
+                self.depth -= 1
+                self._fstack.pop()
+                self._inlined.discard(fn.id)
+        self.generic_visit(node)
+
+
+class _GlobalCallSiteWalker(ast.NodeVisitor):
+    """Classifies bare-name call sites of module functions (under a
+    module lock or not) and collects bare references (callbacks,
+    thread targets) — the input to module-level entry selection,
+    mirroring the class pass's _CallSiteWalker."""
+
+    def __init__(self, locks_map: Dict[str, str],
+                 funcs: Dict[str, ast.AST]) -> None:
+        self.locks = locks_map
+        self.funcs = funcs
+        self.depth = 0
+        self.locked: Set[str] = set()
+        self.unlocked: Set[str] = set()
+        self.referenced: Set[str] = set()
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = sum(
+            1 for item in node.items
+            if isinstance(item.context_expr, ast.Name)
+            and item.context_expr.id in self.locks)
+        self.depth += acquired
+        for stmt in node.body:
+            self.visit(stmt)
+        self.depth -= acquired
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id in self.funcs:
+            (self.locked if self.depth else self.unlocked).add(fn.id)
+            for a in node.args:
+                self.visit(a)
+            for kw in node.keywords:
+                self.visit(kw.value)
+            return
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load) and node.id in self.funcs:
+            self.referenced.add(node.id)
+
+
+class ModuleGlobalAnalyzer:
+    """Lockset inference over bare module state (the class pass's
+    module-scope sibling).  Only modules owning at least one
+    module-level lock are analyzed — a lock-free module has nothing to
+    infer a guard from."""
+
+    def __init__(self, root: str,
+                 subdirs: Tuple[str, ...] = DEFAULT_SUBDIRS,
+                 rel_root: Optional[str] = None) -> None:
+        self.root = root
+        self.subdirs = subdirs
+        self.rel_root = rel_root or root
+        # module -> {lock name: site key}
+        self.locks: Dict[str, Dict[str, str]] = {}
+        # module -> {global name: def line} (mutable collections AND
+        # scalars — a never-written constant produces no findings)
+        self.mutables: Dict[str, Dict[str, int]] = {}
+        # module -> {function name: ast def} (top-level only)
+        self.functions: Dict[str, Dict[str, ast.AST]] = {}
+        # module -> [("Cls.method", ast def)] — collected alongside
+        # the functions so analyze() never re-parses a file
+        self.methods: Dict[str, List[Tuple[str, ast.AST]]] = {}
+        # (module, name) -> profile
+        self.profiles: Dict[Tuple[str, str], AttrProfile] = {}
+
+    def record(self, module: str, name: str, access: Access) -> None:
+        key = (module, name)
+        prof = self.profiles.get(key)
+        if prof is None:
+            prof = self.profiles[key] = AttrProfile(
+                owner=f"{module}:{name}")
+        prof.accesses.add(access)
+
+    def _collect_module(self, rel: str, tree: ast.Module) -> None:
+        lock_map: Dict[str, str] = {}
+        mutables: Dict[str, int] = {}
+        funcs: Dict[str, ast.AST] = {}
+        methods: List[Tuple[str, ast.AST]] = []
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs[node.name] = node
+                continue
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) \
+                            and item.name not in _INIT_METHODS:
+                        methods.append(
+                            (f"{node.name}.{item.name}", item))
+                continue
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name, value = node.targets[0].id, node.value
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.value is not None:
+                name, value = node.target.id, node.value
+            else:
+                continue
+            if name.startswith("__"):
+                continue  # dunder module metadata (__all__ &c)
+            if locks._is_lock_ctor(value) is not None:
+                lock_map[name] = f"{rel}:{node.lineno}"
+                continue
+            if isinstance(value, (ast.Dict, ast.DictComp, ast.List,
+                                  ast.ListComp, ast.Set, ast.SetComp,
+                                  ast.Constant)):
+                mutables[name] = node.lineno
+            elif isinstance(value, ast.Call):
+                ctor = (value.func.id
+                        if isinstance(value.func, ast.Name)
+                        else value.func.attr
+                        if isinstance(value.func, ast.Attribute)
+                        else "")
+                if ctor in _COLLECTION_CTORS:
+                    mutables[name] = node.lineno
+        if lock_map:
+            self.locks[rel] = lock_map
+            self.mutables[rel] = mutables
+            self.functions[rel] = funcs
+            self.methods[rel] = methods
+
+    def analyze(self) -> List[Finding]:
+        for path in locks._iter_py(self.root, self.subdirs):
+            rel = os.path.relpath(path, self.rel_root)
+            try:
+                with open(path, "r") as f:
+                    tree = ast.parse(f.read(), filename=rel)
+            except (OSError, SyntaxError):
+                continue
+            self._collect_module(rel, tree)
+        for module, funcs in self.functions.items():
+            # class methods are always entries (instance methods reach
+            # the module memo exactly like free functions do)
+            methods = self.methods.get(module, [])
+            # entry selection mirrors the class pass: a PRIVATE module
+            # function whose every in-module call site holds a module
+            # lock is NOT a standalone entry — its accesses are counted
+            # through inlining from the callers, with the lock held,
+            # which is how it runs (the module-level _flush_locked
+            # idiom); walking it lock-free too would double-count and
+            # falsely flag clean code
+            cs = _GlobalCallSiteWalker(self.locks.get(module, {}),
+                                       funcs)
+            for _n, fn in list(funcs.items()) + methods:
+                cs.visit(fn)
+            entries: List[Tuple[str, ast.AST]] = []
+            for fname, fn in funcs.items():
+                if not fname.startswith("_") \
+                        or fname in cs.referenced \
+                        or fname in cs.unlocked:
+                    entries.append((fname, fn))
+                elif fname in cs.locked:
+                    pass  # covered via inlining under the lock
+                else:
+                    # private, never called in-module: external callers
+                    # or dead code — analyze standalone to be safe
+                    entries.append((fname, fn))
+            entries += methods
+            for fname, fn in entries:
+                walker = _GlobalAccessWalker(self, module, fname)
+                walker.walk_function(fn)
+        findings: List[Finding] = []
+        for (module, name), prof in sorted(self.profiles.items()):
+            findings.extend(self._infer(module, name, prof))
+        return findings
+
+    def _infer(self, module: str, name: str,
+               prof: AttrProfile) -> List[Finding]:
+        findings: List[Finding] = []
+        seen_keys: Set[str] = set()
+
+        def emit(f: Finding) -> None:
+            if f.key not in seen_keys:
+                seen_keys.add(f.key)
+                findings.append(f)
+
+        accesses = prof.accesses
+        votes: Dict[str, int] = {}
+        for a in accesses:
+            for key in a.held:
+                votes[key] = votes.get(key, 0) + 1
+        total = len(accesses)
+        guard = None
+        if votes:
+            best = max(sorted(votes), key=lambda k: votes[k])
+            if votes[best] * 2 > total and votes[best] >= 2:
+                guard = best
+        prof.guard = guard
+        writes = [a for a in accesses
+                  if a.kind in ("write", "rmw", "mutate")]
+        if guard is not None:
+            for a in sorted(writes, key=lambda a: a.line):
+                if guard in a.held:
+                    continue
+                emit(Finding(
+                    checker="races",
+                    key=f"guard-violation:{module}:{name}@{a.method}",
+                    path=module, line=a.line,
+                    message=(
+                        f"module global {name} is guarded by {guard} "
+                        f"on the majority of its accesses, but "
+                        f"{a.method}() writes it at {module}:{a.line} "
+                        f"without that lock — a concurrent guarded "
+                        f"access can interleave (take the guard, or "
+                        f"publish an immutable snapshot instead)")))
+        elif len(prof.methods()) >= 2:
+            for a in sorted(accesses, key=lambda a: a.line):
+                if a.kind != "rmw" or a.held:
+                    continue
+                emit(Finding(
+                    checker="races",
+                    key=f"publish-race:{module}:{name}@{a.method}",
+                    path=module, line=a.line,
+                    message=(
+                        f"module global {name} is read-modified-"
+                        f"written by {a.method}() at {module}:{a.line} "
+                        f"under no lock, in a module that owns locks "
+                        f"and shares it across functions — two threads "
+                        f"interleaving the read and the write lose one "
+                        f"update (guard it, or make it a single atomic "
+                        f"publish)")))
+        return findings
+
+
 def check(root: str, subdirs: Tuple[str, ...] = DEFAULT_SUBDIRS,
           rel_root: Optional[str] = None) -> List[Finding]:
-    """Run the static lockset pass; returns findings."""
-    return RaceAnalyzer(root, subdirs, rel_root=rel_root).analyze()
+    """Run the static lockset pass (class attributes AND module-level
+    globals); returns findings."""
+    findings = RaceAnalyzer(root, subdirs, rel_root=rel_root).analyze()
+    findings += ModuleGlobalAnalyzer(root, subdirs,
+                                     rel_root=rel_root).analyze()
+    return findings
 
 
 def merge_runtime(static_findings: List[Finding],

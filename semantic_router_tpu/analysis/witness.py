@@ -32,11 +32,15 @@ exclusive while one thread owns the object, then a candidate lockset
 initialized at the first second-thread access and intersected on every
 later one; an empty intersection across ≥2 threads is a race pair,
 reported by ``check_access_races()`` with the ``relpath:line`` of both
-write sites so findings merge with the static lockset pass
-(analysis/races.py) at pytest sessionfinish.  Sampling (default 1/8,
-``VSR_ACCESS_SAMPLE``) plus site extraction only on state transitions
-keeps the smoke-suite overhead inside the witness's existing ≤5%
-bound.
+access sites so findings merge with the static lockset pass
+(analysis/races.py) at pytest sessionfinish.  READS are witnessed too
+(ISSUE 15 satellite): ``watch_class`` also wraps ``__getattribute__``
+in a sparser sampled recorder (4× the write period,
+``VSR_READ_SAMPLE``), so read-write pairs surface — a race needs at
+least one WRITER among the empty-lockset threads; read-read sharing
+never flags.  Sampling (default 1/8 writes, ``VSR_ACCESS_SAMPLE``)
+plus site extraction only on sampled accesses keeps the smoke-suite
+overhead inside the witness's existing ≤5% bound.
 """
 
 from __future__ import annotations
@@ -397,13 +401,18 @@ def check_thread_leaks(baseline: Iterable[threading.Thread],
 # pair — two threads wrote the same attribute with no common lock.
 
 _ACCESS_SAMPLE_DEFAULT = 8
+# reads sample sparser than writes by default (4× the write period):
+# attribute READS on the hot classes outnumber writes by orders of
+# magnitude, and one sampled read per shared attr is all the state
+# machine needs to surface a read-write pair
+_READ_SAMPLE_FACTOR = 4
 _MAX_TRACKED = 4096
 
 _access_lock = _thread.allocate_lock()
 _access_states: Dict[Tuple[int, str], "_AccessState"] = {}
 _access_races: Dict[str, Dict[str, str]] = {}   # "Cls.attr" -> pair info
-# cls -> (original __setattr__, had own __setattr__ in class dict)
-_watched_classes: Dict[type, Tuple[object, bool]] = {}
+# cls -> {"setattr": (orig, had_own), "getattribute": (orig, had_own)}
+_watched_classes: Dict[type, Dict[str, Tuple[object, bool]]] = {}
 _relcache: Dict[str, Optional[str]] = {}        # filename -> relpath|None
 # ids with a live weakref.finalize purging their states on GC — a
 # recycled id must NEVER inherit a dead object's access history (two
@@ -431,13 +440,19 @@ def _drain_purge_queue_locked() -> None:
 
 
 class _AccessState:
-    __slots__ = ("cls_name", "owner_tid", "lockset", "sites")
+    __slots__ = ("cls_name", "owner_tid", "lockset", "sites", "writers",
+                 "unguarded_write")
 
     def __init__(self, cls_name: str, tid: int) -> None:
         self.cls_name = cls_name
         self.owner_tid: Optional[int] = tid    # None once shared
         self.lockset: Optional[frozenset] = None
-        self.sites: Dict[int, Tuple[str, str]] = {}  # tid -> (site, name)
+        # tid -> (site, thread name, last access kind)
+        self.sites: Dict[int, Tuple[str, str, str]] = {}
+        self.writers: set = set()              # tids that WROTE
+        # a shared-phase write happened with NO lock held: the gate for
+        # read-write reporting (see record_access)
+        self.unguarded_write = False
 
 
 def _access_site(depth: int) -> Optional[str]:
@@ -457,11 +472,16 @@ def _access_site(depth: int) -> Optional[str]:
 
 
 def record_access(obj: object, attr: str, depth: int = 2,
-                  label: Optional[str] = None) -> None:
-    """One sampled write to ``obj.attr``.  ``depth`` is the stack
-    distance to the frame that performed the mutation; ``label``
+                  label: Optional[str] = None,
+                  kind: str = "write") -> None:
+    """One sampled access to ``obj.attr``.  ``depth`` is the stack
+    distance to the frame that performed the access; ``label``
     overrides the ``Cls.attr`` reporting identity (dict proxies report
-    as their OWNER's attribute, not as _WatchedDict)."""
+    as their OWNER's attribute, not as _WatchedDict); ``kind`` is
+    "write" (the default — mutations) or "read" (the sampled
+    ``__getattribute__`` recorder).  A race pair needs at least one
+    WRITER among the empty-lockset threads: read-read sharing is
+    always clean."""
     if not _installed:
         return  # no lock witness -> locksets would all read empty
     tid = _thread.get_ident()
@@ -489,23 +509,49 @@ def record_access(obj: object, attr: str, depth: int = 2,
             need_finalizer = oid not in _access_finalized
             if need_finalizer:
                 _access_finalized.add(oid)
-        st.sites[tid] = (site, tname)
         if st.owner_tid is not None and st.owner_tid != tid:
             st.owner_tid = None             # shared: lockset starts NOW
             st.lockset = held
+            # Eraser's exclusive→shared(-modified) split: writes from
+            # the before-publication phase never make the state
+            # "modified" — the writer set starts with the SHARED phase
+            # (this transition access included), so an init-written,
+            # read-only-after object can never flag.  The exclusive
+            # owner's site STAYS as partner evidence: a write that
+            # flips the state to shared races the owner's last access.
+            st.writers = set()
+            st.unguarded_write = False
         elif st.owner_tid is None:
             st.lockset = (st.lockset & held if st.lockset is not None
                           else held)
+        st.sites[tid] = (site, tname, kind)
+        if st.owner_tid is None and kind != "read":
+            st.writers.add(tid)
+            if not held:
+                st.unguarded_write = True
         race_key = f"{cls_name}.{attr}"
+        # an empty intersection is a race when two writers share no
+        # lock (the original write-write gate), or when ANY shared-
+        # phase write ran unguarded (the read-write shape).  A
+        # consistently-GUARDED writer with lock-free readers is the
+        # repo's sanctioned RCU-snapshot idiom (whole-object publish
+        # under the lock, raw reads) — the same write bias the static
+        # lockset pass applies, so the two halves agree on what clean
+        # looks like.
         if st.owner_tid is None and not st.lockset \
                 and len(st.sites) >= 2 \
+                and (len(st.writers) >= 2 or st.unguarded_write) \
                 and race_key not in _access_races:
-            other = next(((s, n) for t, (s, n) in st.sites.items()
-                          if t != tid), ("?", "?"))
+            # prefer a WRITER as the reported partner: the read half of
+            # a read-write pair is only racy against the write
+            others = [(t, v) for t, v in st.sites.items() if t != tid]
+            other = next((v for t, v in others if t in st.writers),
+                         others[0][1] if others else ("?", "?", "?"))
             _access_races[race_key] = {
                 "cls": cls_name, "attr": attr,
-                "site": site, "thread": tname,
+                "site": site, "thread": tname, "kind": kind,
                 "other_site": other[0], "other_thread": other[1],
+                "other_kind": other[2],
             }
     if need_finalizer:
         # outside the state lock: weakref.finalize allocates
@@ -538,20 +584,61 @@ def _watched_setattr_factory(cls: type, sample: int):
     return __setattr__, orig
 
 
-def watch_class(cls: type, sample: Optional[int] = None) -> None:
-    """Wrap ``cls.__setattr__`` in the sampled recorder.  Idempotent,
+def _watched_getattribute_factory(cls: type, sample: int):
+    """Sampled READ recorder (the read-write half of the race
+    detector): every Nth attribute load records through the same
+    Eraser state machine as the write recorder.  The unsampled path is
+    one list-index increment + a modulo; dunder lookups and method
+    fetches (callable results) never record — they are protocol
+    traffic, not shared data."""
+    orig = cls.__getattribute__
+    counter = [0]
+
+    def __getattribute__(self, name):
+        value = orig(self, name)
+        counter[0] += 1    # racy increment: it only paces the sampling
+        if counter[0] % sample == 0 and not name.startswith("__") \
+                and not callable(value):
+            record_access(self, name, depth=2, kind="read")
+        return value
+
+    __getattribute__._vsr_watched = True
+    return __getattribute__, orig
+
+
+def watch_class(cls: type, sample: Optional[int] = None,
+                reads: bool = True) -> None:
+    """Wrap ``cls.__setattr__`` (and, with ``reads`` — the default —
+    ``cls.__getattribute__``) in the sampled recorder.  Idempotent,
     inheritance-aware (a subclass of a watched class is already
-    covered — wrapping again would double-record)."""
-    if getattr(cls.__setattr__, "_vsr_watched", False):
-        return
+    covered — wrapping again would double-record).  Reads sample 4×
+    sparser than writes (``VSR_READ_SAMPLE`` overrides) so the hot
+    read paths stay inside the ≤5% witness overhead bound."""
     if sample is None:
         sample = int(os.environ.get("VSR_ACCESS_SAMPLE",
                                     _ACCESS_SAMPLE_DEFAULT) or 0) \
             or _ACCESS_SAMPLE_DEFAULT
-    had_own = "__setattr__" in cls.__dict__
-    wrapper, orig = _watched_setattr_factory(cls, max(1, sample))
-    _watched_classes[cls] = (orig, had_own)
-    cls.__setattr__ = wrapper
+    sample = max(1, sample)
+    # idempotency is PER DUNDER: a class first watched write-only
+    # (reads=False) must still gain read instrumentation from a later
+    # reads=True arming — one shared early-return would silently leave
+    # __getattribute__ raw for the whole session
+    entry = _watched_classes.get(cls, {})
+    if not getattr(cls.__setattr__, "_vsr_watched", False):
+        wrapper, orig = _watched_setattr_factory(cls, sample)
+        entry["setattr"] = (orig, "__setattr__" in cls.__dict__)
+        cls.__setattr__ = wrapper
+    if reads and not getattr(cls.__getattribute__, "_vsr_watched",
+                             False):
+        read_sample = int(os.environ.get("VSR_READ_SAMPLE", 0) or 0) \
+            or sample * _READ_SAMPLE_FACTOR
+        g_wrapper, g_orig = _watched_getattribute_factory(
+            cls, max(1, read_sample))
+        entry["getattribute"] = (g_orig,
+                                 "__getattribute__" in cls.__dict__)
+        cls.__getattribute__ = g_wrapper
+    if entry:
+        _watched_classes[cls] = entry
 
 
 class _WatchedDict(dict):
@@ -606,19 +693,21 @@ def watch_dict_attr(obj: object, attr: str) -> "_WatchedDict":
 
 
 def unwatch(cls: type) -> None:
-    """Restore one class's original ``__setattr__`` (tests watch their
-    own fixture classes and must not disturb the session's arming)."""
+    """Restore one class's original ``__setattr__`` /
+    ``__getattribute__`` (tests watch their own fixture classes and
+    must not disturb the session's arming)."""
     entry = _watched_classes.pop(cls, None)
     if entry is None:
         return
-    orig, had_own = entry
-    if had_own:
-        cls.__setattr__ = orig
-    else:
-        try:
-            delattr(cls, "__setattr__")
-        except AttributeError:
-            cls.__setattr__ = orig
+    for dunder, (orig, had_own) in entry.items():
+        name = f"__{dunder}__"
+        if had_own:
+            setattr(cls, name, orig)
+        else:
+            try:
+                delattr(cls, name)
+            except AttributeError:
+                setattr(cls, name, orig)
 
 
 def unwatch_all() -> None:
@@ -669,6 +758,7 @@ def check_access_races() -> List[Finding]:
     out: List[Finding] = []
     for r in sorted(races, key=lambda r: (r["cls"], r["attr"])):
         path, _, line = r["site"].rpartition(":")
+        kinds = f"{r.get('other_kind', 'write')}/{r.get('kind', 'write')}"
         out.append(Finding(
             checker="races",
             key=f"lockset:{r['cls']}.{r['attr']}",
@@ -676,9 +766,10 @@ def check_access_races() -> List[Finding]:
             message=(
                 f"runtime access witness: threads {r['other_thread']!r} "
                 f"(at {r['other_site']}) and {r['thread']!r} (at "
-                f"{r['site']}) both wrote {r['cls']}.{r['attr']} with "
-                f"no common lock held — lockset intersection is empty; "
-                f"guard the attribute or publish immutable snapshots")))
+                f"{r['site']}) accessed {r['cls']}.{r['attr']} "
+                f"({kinds}) with no common lock held — lockset "
+                f"intersection is empty; guard the attribute or "
+                f"publish immutable snapshots")))
     return out
 
 

@@ -976,6 +976,12 @@ class InferenceEngineConfig:
     # the BGMV per-item gather.  All default OFF; hot-reloadable via
     # bootstrap apply_kernel_knobs.
     kernels: Dict[str, Any] = field(default_factory=dict)
+    # serving mesh (docs/PARALLEL.md): raw knob block normalized by
+    # engine.mesh.normalize_mesh — dp×tp placement of the fused/packed
+    # classifier bank ({"enabled": false} default = byte-identical
+    # single-device serving).  Hot-reloadable via bootstrap
+    # apply_mesh_knobs with the atomic program-set swap.
+    mesh: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "InferenceEngineConfig":
@@ -993,6 +999,7 @@ class InferenceEngineConfig:
             packing=dict(d.get("packing", {}) or {}),
             quant=dict(d.get("quant", {}) or {}),
             kernels=dict(d.get("kernels", {}) or {}),
+            mesh=dict(d.get("mesh", {}) or {}),
         )
         if d.get("seq_len_buckets"):
             out.seq_len_buckets = [int(x) for x in d["seq_len_buckets"]]
@@ -1019,6 +1026,14 @@ class InferenceEngineConfig:
         from ..engine.kernels import normalize_kernels
 
         return normalize_kernels(self.kernels)
+
+    def mesh_config(self) -> Dict[str, Any]:
+        """Normalized engine.mesh block (docs/PARALLEL.md) — same
+        delegation pattern: engine.mesh owns the ONE interpretation
+        point for the serving-mesh knobs."""
+        from ..engine.mesh import normalize_mesh
+
+        return normalize_mesh(self.mesh)
 
 
 DEFAULT_RECIPE_NAME = "default"

@@ -8,6 +8,7 @@ from .classify import (
     TrunkGroup,
 )
 from .kernels import normalize_kernels, normalize_quant
+from .mesh import build_serving_mesh, normalize_mesh
 from .packing import (
     PackedBatch,
     PackingBatcher,
@@ -21,6 +22,7 @@ __all__ = [
     "BatchItem", "ClassResult", "DynamicBatcher", "EntitySpan",
     "InferenceEngine", "PackedBatch", "PackingBatcher",
     "ShapeAutoTuner", "TRUNK_KEY", "TokenClassResult", "TrunkGroup",
-    "normalize_kernels", "normalize_packing", "normalize_quant",
-    "pack_items", "pick_bucket", "plan_take", "pow2_batch",
+    "build_serving_mesh", "normalize_kernels", "normalize_mesh",
+    "normalize_packing", "normalize_quant", "pack_items",
+    "pick_bucket", "plan_take", "pow2_batch",
 ]

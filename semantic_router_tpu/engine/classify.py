@@ -46,6 +46,13 @@ from ..config.schema import InferenceEngineConfig
 from ..utils.tokenization import Encoding, Tokenizer, decode_entity_spans
 from .batcher import BatchItem, DynamicBatcher, pick_bucket, pow2_batch
 from .kernels import normalize_kernels, normalize_quant, quant_selects
+from .mesh import (
+    build_serving_mesh,
+    mesh_axes,
+    mesh_signature,
+    mesh_suffix,
+    normalize_mesh,
+)
 from .packing import (
     RowPlan,
     PackingBatcher,
@@ -339,6 +346,33 @@ class InferenceEngine:
         self._kernels = normalize_kernels(getattr(self.cfg, "kernels",
                                                   None))
         self._kernel_rebuilds = 0
+        # serving mesh (engine.mesh, docs/PARALLEL.md): dp×tp placement
+        # of the trunk-group serving containers — OFF by default
+        # (byte-identical single-device serving).  Distinct from the
+        # legacy registration-time engine.mesh_shape path above: when
+        # THAT is active it owns placement and this block is inert.
+        self._mesh_knobs = normalize_mesh(getattr(self.cfg, "mesh",
+                                                  None))
+        self._serving_mesh = None
+        self._mesh_rebuilds = 0
+        if self.mesh is None and self._mesh_knobs["enabled"]:
+            try:
+                self._serving_mesh = build_serving_mesh(
+                    self._mesh_knobs)
+                self.batcher.dp_degree = int(
+                    self._serving_mesh.shape.get("dp", 1))
+            except Exception as exc:
+                # fail-open like the knob-apply paths: a malformed
+                # mesh block (tp beyond the visible devices, a bad
+                # axis product) must never stop the server at boot
+                # any more than at hot reload — single-device posture,
+                # loudly logged
+                self._serving_mesh = None
+                from ..observability.logging import component_event
+
+                component_event(
+                    "engine", "mesh_config_invalid", level="warning",
+                    error=f"{type(exc).__name__}: {exc}"[:200])
         # fused classifier bank: trunk fingerprint → TrunkGroup, plus the
         # task→group and gid→group views the hot path reads
         self._trunk_groups: Dict[tuple, TrunkGroup] = {}
@@ -544,10 +578,14 @@ class InferenceEngine:
             if not idxs:
                 return None
             bank = stack_head_bank([g.entries[i] for i in idxs])
-            if self.mesh is not None:
+            # either mesh path places the bank with head_bank_specs:
+            # the TASK axis lays out over tp when it divides evenly
+            mesh = self.mesh if self.mesh is not None \
+                else self._serving_mesh
+            if mesh is not None:
                 from ..parallel import shard_head_bank
 
-                return shard_head_bank(bank, self.mesh)
+                return shard_head_bank(bank, mesh)
             # commit to device ONCE: a host-numpy bank would re-upload
             # tens of MB per batch through the jit boundary
             return {k: jnp.asarray(v) for k, v in bank.items()}
@@ -577,14 +615,18 @@ class InferenceEngine:
 
     def _serving_meta(self, g: TrunkGroup) -> dict:
         """The kernel-knob snapshot one group's programs build under:
-        quant mode (per-group selector), epilogue fusion, and whether
-        the BGMV gather engages (bank at least min_tasks heads wide)."""
+        quant mode (per-group selector), epilogue fusion, whether the
+        BGMV gather engages (bank at least min_tasks heads wide), and
+        the serving-mesh signature (a mesh flip is a program-set
+        rebuild exactly like a quant flip — compile variants key on
+        the mesh shape)."""
         kk = self._kernels
         return {
             "quant": quant_selects(self._quant, g.gid, g.members),
             "epilogue": bool(kk["epilogue"]["enabled"]),
             "bgmv": bool(kk["bgmv"]["enabled"]
                          and len(g.widths) >= kk["bgmv"]["min_tasks"]),
+            "mesh": mesh_signature(self._serving_mesh),
         }
 
     def _refresh_serving(self, g: TrunkGroup,
@@ -604,9 +646,56 @@ class InferenceEngine:
         meta = self._serving_meta(g)
         old = g.fns
         if old is not None and old.get("meta") == meta:
+            if old.get("demux") is not g.demux:
+                # membership changed but the programs are reusable
+                # (banks are ARGUMENTS): refresh only the demux view,
+                # still as ONE atomic dict swap — the runner reads the
+                # (programs, params, mesh, demux) quad from a single
+                # g.fns read, so it can never pair banks placed on one
+                # mesh with programs built for another.  The swap is a
+                # LOCKED compare-and-swap: an unlocked read-modify-
+                # write here could clobber a concurrent full rebuild
+                # (registration/mesh flip under self._lock) and revert
+                # g.fns to old programs paired with the new demux —
+                # exactly the torn pairing this snapshot exists to
+                # prevent.
+                def refresh():
+                    cur = g.fns
+                    if cur is not None and cur.get("meta") == meta \
+                            and cur.get("demux") is not g.demux:
+                        g.fns = {**cur, "demux": g.demux}
+                        g.apply_fn = g.fns["seq"]
+
+                if locked:
+                    refresh()
+                else:
+                    with self._lock:
+                        refresh()
             return
-        g.fns = self._make_fused_fn(g, meta)
-        g.apply_fn = g.fns["seq"]
+        # heavy build (quantization, device placement) OUTSIDE the
+        # lock; the swap itself is a locked CAS like the demux refresh
+        # above — an unlocked `g.fns = fns` could clobber a concurrent
+        # locked rebuild (registration / mesh flip) and serve its
+        # pre-swap demux forever
+        fns = self._make_fused_fn(g, meta)
+
+        def swap() -> bool:
+            if self._serving_meta(g) != meta:
+                # knobs/membership changed while we built: the
+                # concurrent rebuild owns the newer truth — discard
+                return False
+            fns["demux"] = g.demux   # capture under the lock: pairs
+            g.fns = fns              # with the LIVE banks
+            g.apply_fn = fns["seq"]
+            return True
+
+        if locked:
+            swapped = swap()
+        else:
+            with self._lock:
+                swapped = swap()
+        if not swapped:
+            return
         if old is not None:
             self._series().kernel_rebuilds.inc(group=g.gid)
             group = f"trunk:{g.gid}"
@@ -652,6 +741,82 @@ class InferenceEngine:
         self._kernels = normalize_kernels(knobs)
         for g in list(self._groups_by_gid.values()):
             self._refresh_serving(g)
+
+    def configure_mesh(self, knobs: Optional[Dict[str, Any]]) -> None:
+        """Apply the engine.mesh block (boot + config hot reload):
+        build or tear down the serving mesh, re-stack each trunk
+        group's banks onto the new placement, and atomically swap each
+        group's program set — in-flight batches finish on the (mesh,
+        programs, banks) snapshot they already read, exactly the
+        configure_quant/configure_kernels hot-flip contract.  A no-op
+        re-apply (same axis sizes) rebuilds nothing.  With the legacy
+        registration-time engine.mesh_shape active this block is inert:
+        that path owns placement."""
+        mk = normalize_mesh(knobs)
+        if self.mesh is not None:
+            self._mesh_knobs = mk   # inert block: report only
+            return
+        # build BEFORE publishing the knobs: a rejected shape (loud
+        # resolve_axes failure) must leave /debug/runtime reporting
+        # the config that is actually serving, not the rejected one
+        new_mesh = build_serving_mesh(mk)   # None when disabled
+        self._mesh_knobs = mk
+        with self._lock:
+            if mesh_signature(new_mesh) != \
+                    mesh_signature(self._serving_mesh):
+                self._serving_mesh = new_mesh
+                self._mesh_rebuilds += 1
+                for g in list(self._groups_by_gid.values()):
+                    if g.members:
+                        # re-derives banks on the new placement, then
+                        # _refresh_serving sees the meta mesh changed
+                        # and swaps the program set whole
+                        self._rebuild_bank(g)
+            dp = 1
+            if self._serving_mesh is not None:
+                dp = int(self._serving_mesh.shape.get("dp", 1))
+            # scheduler step-size / row-trim scaling rides the dp
+            # degree (single atomic int publish — the picker thread
+            # reads it concurrently)
+            if isinstance(self.batcher, PackingBatcher):
+                self.batcher.dp_degree = dp
+        axes = mesh_axes(self._serving_mesh)
+        m = self._series()
+        for ax in ("dp", "tp"):
+            m.mesh_devices.set(
+                float(axes.get(ax, 1)) if self._serving_mesh is not None
+                else 0.0, axis=ax)
+
+    def mesh_report(self) -> Dict[str, Any]:
+        """Operator snapshot (GET /debug/runtime rides this): the live
+        normalized knob block, the active mesh (axes, per-axis device
+        counts, which path owns placement), per-group sharding state,
+        and how many mesh flips rebuilt program sets this process."""
+        active = self.mesh if self.mesh is not None \
+            else self._serving_mesh
+        out: Dict[str, Any] = {
+            "knobs": dict(self._mesh_knobs),
+            "enabled": active is not None,
+            "source": ("mesh_shape" if self.mesh is not None else
+                       "engine.mesh" if self._serving_mesh is not None
+                       else None),
+            "visible_devices": jax.device_count(),
+            "mesh_devices": int(active.devices.size)
+            if active is not None else 0,
+            "axes": {ax: int(active.shape.get(ax, 1))
+                     for ax in ("dp", "tp", "sp")}
+            if active is not None else {},
+            "rebuilds": self._mesh_rebuilds,
+        }
+        groups = {}
+        for gid, g in list(self._groups_by_gid.items()):
+            fns = g.fns
+            if fns is not None:
+                sig = fns["meta"].get("mesh")
+                groups[gid] = {"sharded": sig is not None,
+                               "mesh": list(sig) if sig else None}
+        out["groups"] = groups
+        return out
 
     def kernels_report(self) -> Dict[str, Any]:
         """Operator snapshot (GET /debug/runtime rides this): the live
@@ -706,7 +871,8 @@ class InferenceEngine:
 
         cfg = g.config
         meta = dict(meta or {"quant": "off", "epilogue": False,
-                             "bgmv": False})
+                             "bgmv": False, "mesh": None})
+        meta.setdefault("mesh", None)
         act = activation(cfg.classifier_activation)
         use_mean = cfg.classifier_pooling == "mean"
         if meta["quant"] == "off":
@@ -716,12 +882,23 @@ class InferenceEngine:
 
             trunk, serving_params = build_quant_trunk(
                 cfg, g.trunk_params, meta["quant"])
-            if serving_params is not g.trunk_params:
-                # int8: commit the quantized leaves to device ONCE — a
-                # host-numpy tree would re-upload per batch through the
-                # jit boundary
-                serving_params = jax.tree_util.tree_map(
-                    jnp.asarray, serving_params)
+        # serving-mesh placement (docs/PARALLEL.md): the SERVING copy of
+        # the trunk tree lands on the mesh per the Megatron rules (tp=1
+        # degenerates to replication); g.trunk_params keeps the
+        # unplaced original, so a mesh teardown restores byte-identical
+        # single-device serving from the same source arrays
+        srv_mesh = self._serving_mesh if meta["mesh"] is not None \
+            else None
+        if srv_mesh is not None:
+            from ..parallel import shard_params
+
+            serving_params = shard_params(serving_params, srv_mesh)
+        elif serving_params is not g.trunk_params:
+            # int8: commit the quantized leaves to device ONCE — a
+            # host-numpy tree would re-upload per batch through the
+            # jit boundary
+            serving_params = jax.tree_util.tree_map(
+                jnp.asarray, serving_params)
         epilogue = meta["epilogue"]
         bgmv = meta["bgmv"]
 
@@ -834,6 +1011,11 @@ class InferenceEngine:
             "packed_tok": jax.jit(packed_tok_fn),
             "packed_both": jax.jit(packed_both_fn),
             "trunk_params": serving_params,
+            # the Mesh this program set serves under (None = single
+            # device): carried IN the snapshot so an in-flight batch
+            # pads, places, and demuxes with the mesh its programs were
+            # built for — a hot mesh flip can never tear a batch
+            "mesh": srv_mesh,
             "meta": meta,
         }
 
@@ -1531,12 +1713,21 @@ class InferenceEngine:
                 if b > g.max_seq_len:
                     continue
                 try:
-                    padded_n = self._padded_batch(1)
+                    fns = g.fns
+                    srv_mesh = fns.get("mesh")
+                    # banks from the SAME snapshot as the programs —
+                    # the runner's consistency contract applies to
+                    # warmup too (a mesh flip mid-warmup must not mix
+                    # placements)
+                    dmx = fns.get("demux") or g.demux or {}
+                    bank = dmx.get("bank")
+                    tok_bank = dmx.get("tok_bank")
+                    padded_n = self._padded_batch(1, mesh=srv_mesh)
                     ids = np.full((padded_n, b), g.pad_id, np.int32)
                     ids[:, 0] = 1
                     mask = np.ones((padded_n, b), np.int32)
-                    ids_dev, mask_dev = self._to_device(ids, mask)
-                    fns = g.fns
+                    ids_dev, mask_dev = self._to_device(ids, mask,
+                                                        mesh=srv_mesh)
                     tp = fns["trunk_params"]
                     # BGMV programs carry the pair operands; warm the
                     # 1-pair entry shape (other pair widths compile on
@@ -1544,17 +1735,18 @@ class InferenceEngine:
                     pair = (jnp.zeros(1, jnp.int32),
                             jnp.zeros(1, jnp.int32)) \
                         if fns["meta"]["bgmv"] else ()
-                    if g.bank is not None:
+                    if bank is not None:
                         jax.block_until_ready(fns["seq"](
-                            tp, g.bank, ids_dev, mask_dev, *pair))
-                    if g.tok_bank is not None:
+                            tp, bank, ids_dev, mask_dev, *pair))
+                    if tok_bank is not None:
                         jax.block_until_ready(fns["tok"](
-                            tp, g.tok_bank, ids_dev, mask_dev))
-                        if g.bank is not None:
-                            out = fns["both"](tp, g.bank, g.tok_bank,
+                            tp, tok_bank, ids_dev, mask_dev))
+                        if bank is not None:
+                            out = fns["both"](tp, bank, tok_bank,
                                               ids_dev, mask_dev, *pair)
                             jax.block_until_ready(out)
-                    if g.traced_fns is not None and g.bank is not None:
+                    if g.traced_fns is not None and bank is not None \
+                            and srv_mesh is None:
                         # the split batch-trace programs (batchtrace
                         # stage fencing) compile on the first SAMPLED
                         # batch of a shape — warm them here too, or that
@@ -1564,7 +1756,7 @@ class InferenceEngine:
                         trunk_fn, head_fn = g.traced_fns
                         pooled = trunk_fn(g.trunk_params, ids_dev,
                                           mask_dev)
-                        jax.block_until_ready(head_fn(g.bank, pooled))
+                        jax.block_until_ready(head_fn(bank, pooled))
                 except Exception:
                     pass
                 self._warm_packed(g, b)
@@ -1575,8 +1767,10 @@ class InferenceEngine:
         entry shape every packed bucket hits first.  Other (rows, K)
         shapes warm from the compiled-step census via
         warmup_packed_hot (docs/PACKING.md "packed-path warmup")."""
+        mesh = g.fns.get("mesh") if g.fns is not None else None
         self._warm_packed_shape(g, bucket, k_pad=2,
-                                padded_rows=self._padded_batch(1))
+                                padded_rows=self._padded_batch(
+                                    1, mesh=mesh))
 
     def _warm_packed_shape(self, g: TrunkGroup, bucket: int, k_pad: int,
                            padded_rows: int, pair_pad: int = 0,
@@ -1593,6 +1787,11 @@ class InferenceEngine:
                            "dense") != "dense":
             return False
         fns = g.fns
+        srv_mesh = fns.get("mesh")
+        msfx = mesh_suffix(fns["meta"].get("mesh"))
+        dmx = fns.get("demux") or g.demux or {}
+        bank = dmx.get("bank")
+        tok_bank = dmx.get("tok_bank")
         try:
             class _WarmEnc:
                 """Minimal Encoding shim so warmup builds its packed
@@ -1613,11 +1812,22 @@ class InferenceEngine:
                 [_WarmEnc(half), _WarmEnc(bucket - half)], bucket,
                 g.pad_id, max_rows=1, max_segments_per_row=2,
                 pad_rows_to=padded_rows, pad_segments_to=k_eff)
-            ids_dev, mask_dev = self._to_device(pb.ids, pb.mask)
-            pos_dev = jnp.asarray(pb.position_ids)
-            seg_dev = jnp.asarray(pb.segment_ids)
-            row_dev = jnp.asarray(pb.seg_row)
-            start_dev = jnp.asarray(pb.seg_start)
+            ids_dev, mask_dev = self._to_device(pb.ids, pb.mask,
+                                                mesh=srv_mesh)
+            if srv_mesh is not None:
+                from ..parallel import batch_sharding, replicated
+
+                row_sh = batch_sharding(srv_mesh)
+                rep = replicated(srv_mesh)
+                pos_dev = jax.device_put(pb.position_ids, row_sh)
+                seg_dev = jax.device_put(pb.segment_ids, row_sh)
+                row_dev = jax.device_put(pb.seg_row, rep)
+                start_dev = jax.device_put(pb.seg_start, rep)
+            else:
+                pos_dev = jnp.asarray(pb.position_ids)
+                seg_dev = jnp.asarray(pb.segment_ids)
+                row_dev = jnp.asarray(pb.seg_row)
+                start_dev = jnp.asarray(pb.seg_start)
             tp = fns["trunk_params"]
             if fns["meta"]["bgmv"]:
                 pp = int(pair_pad) or 2
@@ -1627,28 +1837,28 @@ class InferenceEngine:
             else:
                 pair, sfx = (), ""
             want = set(flavors or ("seq", "tok", "both"))
-            if g.bank is not None and "seq" in want:
+            if bank is not None and "seq" in want:
                 jax.block_until_ready(fns["packed_seq"](
-                    tp, g.bank, ids_dev, mask_dev,
+                    tp, bank, ids_dev, mask_dev,
                     pos_dev, seg_dev, row_dev, start_dev, *pair))
                 self._step_fresh(f"trunk:{g.gid}",
-                                 f"packed:seq:{k_eff}{sfx}",
+                                 f"packed:seq:{k_eff}{sfx}{msfx}",
                                  (padded_rows, bucket))
-            if g.tok_bank is not None and "tok" in want:
+            if tok_bank is not None and "tok" in want:
                 jax.block_until_ready(fns["packed_tok"](
-                    tp, g.tok_bank, ids_dev, mask_dev,
+                    tp, tok_bank, ids_dev, mask_dev,
                     pos_dev, seg_dev))
                 self._step_fresh(f"trunk:{g.gid}",
-                                 f"packed:tok:{k_eff}",
+                                 f"packed:tok:{k_eff}{msfx}",
                                  (padded_rows, bucket))
-            if g.bank is not None and g.tok_bank is not None \
+            if bank is not None and tok_bank is not None \
                     and "both" in want:
                 out = fns["packed_both"](
-                    tp, g.bank, g.tok_bank, ids_dev, mask_dev,
+                    tp, bank, tok_bank, ids_dev, mask_dev,
                     pos_dev, seg_dev, row_dev, start_dev, *pair)
                 jax.block_until_ready(out)
                 self._step_fresh(f"trunk:{g.gid}",
-                                 f"packed:both:{k_eff}{sfx}",
+                                 f"packed:both:{k_eff}{sfx}{msfx}",
                                  (padded_rows, bucket))
             return True
         except Exception:
@@ -1675,7 +1885,13 @@ class InferenceEngine:
             try:
                 parts = variant.split(":")
                 flavor, k_pad = parts[1], int(parts[2])
-                pair_pad = int(parts[3][1:]) if len(parts) > 3 else 0
+                # optional trailing parts: ":pN" (BGMV pair pad) and
+                # ":mAxB" (mesh signature — not part of the census row;
+                # warmup re-derives the CURRENT mesh at warm time)
+                pair_pad = 0
+                for extra in parts[3:]:
+                    if extra.startswith("p"):
+                        pair_pad = int(extra[1:])
                 padded_rows, bucket = int(k[2]), int(k[3])
             except (IndexError, ValueError):
                 continue
@@ -1867,16 +2083,21 @@ class InferenceEngine:
         return self._encode_with(g.tokenizer, g.max_seq_len, text,
                                  enc_cache, g.gid, tuple(tasks))
 
-    def _to_device(self, ids: np.ndarray, mask: np.ndarray):
-        """Host batch → device, dp/sp-sharded when a mesh serves."""
+    def _to_device(self, ids: np.ndarray, mask: np.ndarray, mesh=None):
+        """Host batch → device, dp/sp-sharded when a mesh serves.
+        ``mesh``: the fused runner's per-batch serving mesh (from its
+        program-set snapshot — a hot mesh flip must not reshard a batch
+        mid-flight); the legacy whole-engine mesh wins when set."""
         if self.mesh is not None:
+            mesh = self.mesh
+        if mesh is not None:
             from ..parallel import batch_sharding
 
             # device_put the HOST arrays directly: each device receives
             # only its shard (asarray-then-reshard would stage the full
             # batch on device 0 first — double transfer on the hot path)
-            sh = batch_sharding(self.mesh,
-                                shard_seq=self.mesh.shape.get("sp", 1) > 1)
+            sh = batch_sharding(mesh,
+                                shard_seq=mesh.shape.get("sp", 1) > 1)
             return jax.device_put(ids, sh), jax.device_put(mask, sh)
         return jnp.asarray(ids), jnp.asarray(mask)
 
@@ -1901,11 +2122,21 @@ class InferenceEngine:
                     _Payload(text, enc, tok_s=tok_s, tok_cached=cached)))
         return futures
 
-    def _padded_batch(self, n: int) -> int:
-        padded_n = pow2_batch(n, self.cfg.max_batch_size)
-        if self.mesh is not None:
+    def _padded_batch(self, n: int, mesh=None) -> int:
+        """Padded row count for ``n`` real rows.  ``mesh``: the fused
+        runner's per-batch serving mesh — the row cap scales by dp
+        (each shard serves up to max_batch_size rows) and the padded
+        count divides evenly across the data axis."""
+        cap = self.cfg.max_batch_size
+        dp = 1
+        if mesh is not None and self.mesh is None:
+            dp = int(mesh.shape.get("dp", 1))
+            cap *= dp
+        elif self.mesh is not None:
+            dp = int(self.mesh.shape.get("dp", 1))
+        padded_n = pow2_batch(n, cap)
+        if dp > 1:
             # dp-sharded batches must divide evenly across the data axis
-            dp = self.mesh.shape.get("dp", 1)
             padded_n = max(dp, ((padded_n + dp - 1) // dp) * dp)
         return padded_n
 
@@ -2057,15 +2288,17 @@ class InferenceEngine:
         against the task's own label set — decode semantics identical to
         the traditional path."""
         g = self._groups_by_gid[gid]
-        # ONE consistent demux view (banks + row maps + widths) for this
-        # whole batch: a concurrent re-registration swaps g.demux
-        # atomically and can never pair new row indices with this
-        # batch's logits ordering.  The program set snapshots the same
-        # way: a hot kernel/quant flip swaps g.fns atomically, and this
-        # batch finishes on the (programs, serving trunk params, meta)
-        # triple it read here — never a torn mix
-        demux = g.demux
+        # ONE consistent snapshot for this whole batch: g.fns carries
+        # the programs, serving trunk params, meta, serving mesh AND
+        # the demux view (banks + row maps + widths), swapped as a
+        # single dict assignment — a concurrent re-registration or a
+        # hot kernel/quant/MESH flip can never pair new row indices
+        # with this batch's logits ordering, nor banks placed on one
+        # mesh with programs built for another (a torn demux/fns pair
+        # under a mesh flip would mix committed arrays from different
+        # device sets and fail the batch)
         fns = g.fns
+        demux = fns["demux"] if fns is not None else g.demux
         n = len(items)
         # identical token sequences within the batch ride a SINGLE
         # trunk row (the trunk output depends only on ids+mask; per-item
@@ -2118,6 +2351,13 @@ class InferenceEngine:
                     and fns is not None
                     and getattr(g.config, "attention_impl",
                                 "dense") == "dense")
+        # the serving mesh this batch pads/places/executes under comes
+        # from its program-set snapshot, never live engine state — the
+        # hot-flip atomicity contract (docs/PARALLEL.md)
+        srv_mesh = fns.get("mesh") if fns is not None else None
+        dp = int(srv_mesh.shape.get("dp", 1)) if srv_mesh is not None \
+            else 1
+        row_cap = self.cfg.max_batch_size * dp
         use_packed = False
         plan_rows = 0
         tuner = self._autotuner
@@ -2126,20 +2366,21 @@ class InferenceEngine:
         if packable and n_rows >= pk["min_segments"]:
             blocked = tuner is not None and \
                 tuner.blocked(f"trunk:{gid}", bucket)
-            must_pack = n_rows > self.cfg.max_batch_size
+            must_pack = n_rows > row_cap
             if must_pack or not blocked:
-                plan = RowPlan(bucket, self.cfg.max_batch_size, max_segs)
+                plan = RowPlan(bucket, row_cap, max_segs)
                 fits = all(
                     plan.add(min(len(it.payload.encoding), bucket))
                     is not None for it in uniq_items)
                 if fits:
-                    packed_padded = self._padded_batch(plan.rows_used)
+                    packed_padded = self._padded_batch(plan.rows_used,
+                                                       mesh=srv_mesh)
                     unpacked_padded = self._padded_batch(
-                        min(n_rows, self.cfg.max_batch_size))
+                        min(n_rows, row_cap), mesh=srv_mesh)
                     if must_pack or packed_padded < unpacked_padded:
                         use_packed = True
                         plan_rows = plan.rows_used
-        if not use_packed and n_rows > self.cfg.max_batch_size:
+        if not use_packed and n_rows > row_cap:
             # the scheduler over-took but the plan no longer fits (a
             # hot-reload raced the knobs down): serve in halves —
             # correctness over one perfect step
@@ -2245,10 +2486,16 @@ class InferenceEngine:
         """The fixed-row fused path: one trunk row per unique encoding,
         padded to the bucket edge — exactly the pre-packing behavior."""
         n_rows = len(uniq_items)
-        padded_n = self._padded_batch(n_rows)
+        srv_mesh = fns.get("mesh")
+        padded_n = self._padded_batch(n_rows, mesh=srv_mesh)
         bank, tok_bank = demux["bank"], demux["tok_bank"]
         meta = fns["meta"]
         tparams = fns["trunk_params"]
+        # sharding-aware compile variants key on the mesh shape: the
+        # sharded and single-device programs are distinct XLA programs
+        # with their own compile/EWMA accounting (sharded-vs-unsharded
+        # step time reads straight off /debug/runtime)
+        msfx = mesh_suffix(meta.get("mesh"))
         use_bgmv = meta["bgmv"] and flavor in ("seq", "both")
         pr_dev = pt_dev = pair_index = None
         pair_sfx = ""
@@ -2275,12 +2522,12 @@ class InferenceEngine:
             kind="fused")
         try:
             # detailed (fenced-split) sampling only describes the STOCK
-            # programs: with a kernel/quant knob live, the split
+            # programs: with a kernel/quant/mesh knob live, the split
             # programs would time math the serving path no longer runs
             detailed = step is not None and step.detailed \
                 and g.traced_fns is not None and flavor == "seq" \
                 and meta["quant"] == "off" and not meta["epilogue"] \
-                and not use_bgmv
+                and not use_bgmv and srv_mesh is None
             with batchtrace.stage(step, "stack"):
                 ids, mask, clipped = self._stack_items(uniq_items,
                                                        bucket,
@@ -2289,11 +2536,14 @@ class InferenceEngine:
                     if clipped[urow[i]]:
                         for task in item.payload.tasks:
                             self._series().bucket_overflows.inc(task=task)
-                ids_dev, mask_dev = self._to_device(ids, mask)
+                ids_dev, mask_dev = self._to_device(ids, mask,
+                                                    mesh=srv_mesh)
             self._note_shape(f"trunk:{gid}", (padded_n, bucket))
-            variant = "fused_detailed" if detailed else "fused"
+            variant = "fused_detailed" if detailed else \
+                ("fused_mesh" if srv_mesh is not None else "fused")
             fresh = self._step_fresh(f"trunk:{gid}",
-                                     f"{variant}:{flavor}{pair_sfx}",
+                                     f"{variant}:{flavor}{pair_sfx}"
+                                     f"{msfx}",
                                      (padded_n, bucket))
             tokens_real = sum(min(len(it.payload.encoding), bucket)
                               for it in uniq_items)
@@ -2344,6 +2594,8 @@ class InferenceEngine:
                               tokens_padded=padded_n * bucket,
                               segments=n_rows)
             self._series().trunk_forwards.inc(group=gid, path="fused")
+            if srv_mesh is not None:
+                self._series().mesh_steps.inc(group=gid)
             self._count_kernel_step(gid, meta, use_bgmv)
 
             demux_cm = batchtrace.stage(step, "demux")
@@ -2398,13 +2650,15 @@ class InferenceEngine:
         per-token logits.  Logit parity with the unpacked path is the
         golden gate (tests/test_packing.py, ≤1e-4)."""
         n_rows = len(uniq_items)
-        padded_rows = self._padded_batch(plan_rows)
+        srv_mesh = fns.get("mesh")
+        padded_rows = self._padded_batch(plan_rows, mesh=srv_mesh)
         # the segment axis pads to a power of two — K_pad joins the
         # closed static-shape set like the row axis does
         k_pad = 1 << max(0, n_rows - 1).bit_length()
         bank, tok_bank = demux["bank"], demux["tok_bank"]
         meta = fns["meta"]
         tparams = fns["trunk_params"]
+        msfx = mesh_suffix(meta.get("mesh"))
         use_bgmv = meta["bgmv"] and flavor in ("seq", "both")
         pr_dev = pt_dev = pair_index = None
         pair_sfx = ""
@@ -2424,9 +2678,11 @@ class InferenceEngine:
             kind="fused")
         try:
             with batchtrace.stage(step, "stack"):
+                dp = int(srv_mesh.shape.get("dp", 1)) \
+                    if srv_mesh is not None else 1
                 pb = pack_items(
                     [it.payload.encoding for it in uniq_items], bucket,
-                    g.pad_id, max_rows=self.cfg.max_batch_size,
+                    g.pad_id, max_rows=self.cfg.max_batch_size * dp,
                     max_segments_per_row=max_segs,
                     pad_rows_to=padded_rows, pad_segments_to=k_pad)
                 clipped = [s.clipped for s in pb.segments]
@@ -2434,11 +2690,26 @@ class InferenceEngine:
                     if clipped[urow[i]]:
                         for task in item.payload.tasks:
                             self._series().bucket_overflows.inc(task=task)
-                ids_dev, mask_dev = self._to_device(pb.ids, pb.mask)
-                pos_dev = jnp.asarray(pb.position_ids)
-                seg_dev = jnp.asarray(pb.segment_ids)
-                seg_row = jnp.asarray(pb.seg_row)
-                seg_start = jnp.asarray(pb.seg_start)
+                ids_dev, mask_dev = self._to_device(pb.ids, pb.mask,
+                                                    mesh=srv_mesh)
+                if srv_mesh is not None:
+                    # position/segment planes shard with their rows so
+                    # each dp shard masks/pools ITS row slice; the
+                    # per-segment demux maps ([K] gathers) replicate —
+                    # XLA inserts the gather collectives
+                    from ..parallel import batch_sharding, replicated
+
+                    row_sh = batch_sharding(srv_mesh)
+                    rep = replicated(srv_mesh)
+                    pos_dev = jax.device_put(pb.position_ids, row_sh)
+                    seg_dev = jax.device_put(pb.segment_ids, row_sh)
+                    seg_row = jax.device_put(pb.seg_row, rep)
+                    seg_start = jax.device_put(pb.seg_start, rep)
+                else:
+                    pos_dev = jnp.asarray(pb.position_ids)
+                    seg_dev = jnp.asarray(pb.segment_ids)
+                    seg_row = jnp.asarray(pb.seg_row)
+                    seg_start = jnp.asarray(pb.seg_start)
             if step is not None:
                 # packed-step span attributes: the trace shows HOW
                 # packed this step ran, next to the existing batch
@@ -2454,7 +2725,7 @@ class InferenceEngine:
             # shape still counts as the compile it is
             fresh = self._step_fresh(f"trunk:{gid}",
                                      f"packed:{flavor}:{k_pad}"
-                                     f"{pair_sfx}",
+                                     f"{pair_sfx}{msfx}",
                                      (padded_rows, bucket))
             seq_logits = tok_logits = None
             fwd_t0 = time.perf_counter()
@@ -2482,7 +2753,9 @@ class InferenceEngine:
                 if tok_logits is not None:
                     tok_logits = np.asarray(jax.device_get(tok_logits),
                                             dtype=np.float32)
-            self._record_step(f"trunk:{gid}", bucket, "packed",
+            self._record_step(f"trunk:{gid}", bucket,
+                              "packed_mesh" if srv_mesh is not None
+                              else "packed",
                               pb.rows_used, padded_rows,
                               time.perf_counter() - fwd_t0, fresh,
                               tokens_real=pb.tokens_real,
@@ -2491,8 +2764,12 @@ class InferenceEngine:
             # a packed step IS a fused trunk forward (dashboards sum
             # path="fused" for bank coalescing); packing visibility has
             # its own counter + the runtimestats "packed" variant
+            # ("packed_mesh" when dp-sharded — the auto-tuner reads
+            # only the single-device series by design)
             self._series().trunk_forwards.inc(group=gid, path="fused")
             self._series().packed_steps.inc(group=gid)
+            if srv_mesh is not None:
+                self._series().mesh_steps.inc(group=gid)
             self._count_kernel_step(gid, meta, use_bgmv)
 
             demux_cm = batchtrace.stage(step, "demux")

@@ -1,0 +1,122 @@
+"""Serving-mesh knob interpretation (docs/PARALLEL.md).
+
+The ONE interpretation point for the ``engine.mesh`` block — bootstrap
+knob application (apply_mesh_knobs), the engine constructor, and tests
+all read this normalized shape (same pattern as engine.packing and
+engine.kernels).  Every default is OFF, so an unconfigured engine
+serves byte-identically to the single-device repo.
+
+The block places each TrunkGroup's SERVING container onto a
+``jax.sharding.Mesh``:
+
+- ``dp`` (data): request batches split across devices — padded device
+  rows divide evenly over the axis and XLA inserts the collectives
+  (the BASELINE north star: "shards the classifier bank across a v5e
+  slice");
+- ``tp`` (tensor): trunk params tp-shard per the Megatron rules
+  (parallel.sharding.shard_params) and the stacked head/LoRA/token
+  banks shard on the TASK axis via parallel.head_bank_specs when the
+  member count divides evenly.
+
+``sp`` is deliberately not part of this block: sequence-parallel
+serving needs ring-attention models and stays on the registration-time
+``engine.mesh_shape`` path (classify.py refuses dense models there).
+Everything is provable off-TPU on a forced multi-device CPU mesh
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+def normalize_mesh(d: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Normalized ``engine.mesh`` block.
+
+    - ``enabled``: place trunk-group serving containers onto a (dp, tp)
+      mesh (default False = byte-identical single-device serving).
+    - ``dp``: data-parallel axis size; 0 (the default) = every visible
+      device not claimed by ``tp``.
+    - ``tp``: tensor-parallel axis size (default 1 — the pure-dp
+      classifier-bank layout; trunk params replicate).
+    """
+    d = dict(d or {})
+
+    def _int(key: str, default: int, lo: int) -> int:
+        try:
+            return max(lo, int(d.get(key, default)))
+        except (TypeError, ValueError):
+            return default
+
+    return {
+        "enabled": bool(d.get("enabled", False)),
+        "dp": _int("dp", 0, lo=0),
+        "tp": _int("tp", 1, lo=1),
+    }
+
+
+def resolve_axes(knobs: Dict[str, Any],
+                 n_devices: int) -> Optional[Dict[str, int]]:
+    """Concrete (dp, tp) axis sizes for ``n_devices``, or None when the
+    block is disabled.  ``dp: 0`` soaks up every device ``tp`` leaves;
+    an explicit shape that does not fit the device count raises (the
+    same loud-failure contract as parallel.create_mesh — a typo'd mesh
+    must never silently serve single-device)."""
+    if not knobs.get("enabled"):
+        return None
+    tp = max(1, int(knobs.get("tp", 1)))
+    if tp > n_devices:
+        raise ValueError(
+            f"engine.mesh: tp={tp} exceeds the {n_devices} visible "
+            f"device(s)")
+    dp = int(knobs.get("dp", 0))
+    if dp <= 0:
+        dp = max(1, n_devices // tp)
+    if dp * tp > n_devices:
+        raise ValueError(
+            f"engine.mesh: dp={dp} x tp={tp} exceeds the {n_devices} "
+            f"visible device(s)")
+    return {"dp": dp, "tp": tp}
+
+
+def build_serving_mesh(knobs: Dict[str, Any]):
+    """Build the serving Mesh for a normalized block (None when
+    disabled).  Uses the first dp*tp visible devices — an axis product
+    below the device count is allowed (half-slice serving), matching
+    how operators carve a v5e slice."""
+    import jax
+
+    devices = list(jax.devices())
+    axes = resolve_axes(knobs, len(devices))
+    if axes is None:
+        return None
+    from ..parallel import create_mesh
+
+    n = axes["dp"] * axes["tp"]
+    return create_mesh({"dp": axes["dp"], "tp": axes["tp"]},
+                       devices=devices[:n])
+
+
+def mesh_axes(mesh) -> Dict[str, int]:
+    """{axis: size} for the >1 axes of a live Mesh (report shape)."""
+    if mesh is None:
+        return {}
+    return {str(k): int(v) for k, v in mesh.shape.items() if int(v) > 1}
+
+
+def mesh_signature(mesh) -> Optional[tuple]:
+    """Hashable (dp, tp, sp) identity for program-set meta keys: two
+    meshes with the same axis sizes build the same programs, so a
+    no-op knob re-apply must not rebuild (the hot-flip contract)."""
+    if mesh is None:
+        return None
+    return tuple(int(mesh.shape.get(ax, 1)) for ax in ("dp", "tp", "sp"))
+
+
+def mesh_suffix(sig: Optional[tuple]) -> str:
+    """Compile-variant key suffix for a mesh signature (``":m8x1x1"``,
+    empty when unsharded) — the ONE place the format lives; the
+    engine's census parser skips ``m``-prefixed parts to match."""
+    if not sig:
+        return ""
+    return ":m" + "x".join(str(s) for s in sig)

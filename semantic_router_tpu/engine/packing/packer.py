@@ -116,7 +116,8 @@ def plan_take(lengths: Sequence[int], bucket: int, *, max_rows: int,
               max_segments_per_row: int, max_items: int,
               deferrals: Optional[Sequence[int]] = None,
               starvation_steps: int = 4,
-              backlog_beyond: bool = False
+              backlog_beyond: bool = False,
+              row_align: int = 1
               ) -> "tuple[List[int], List[int]]":
     """Select which queued items join the next packed step.
 
@@ -132,6 +133,12 @@ def plan_take(lengths: Sequence[int], bucket: int, *, max_rows: int,
     take — then the take trims back to a full power-of-two row count so
     the padded device shape carries no all-padding rows (the backlog
     refills next step immediately; trimmed items are NOT deferrals).
+
+    ``row_align``: the padder's row alignment (the dp degree under a
+    serving mesh, docs/PARALLEL.md) — the trim only targets a count
+    that pads to ITSELF (a power of two that is also an align
+    multiple); any other target would be rounded back up, re-growing
+    the device shape with all-padding rows.
 
     Returns ``(take, deferred)``: indices into ``lengths`` in arrival
     order, and the indices the LOOKAHEAD jumped past (whose deferral
@@ -160,9 +167,22 @@ def plan_take(lengths: Sequence[int], bucket: int, *, max_rows: int,
     # skipped beyond the last planned take was never actually jumped
     horizon = take[-1] if take else -1
     if backlog_beyond and plan.rows_used > 1:
-        pow2 = 1 << (plan.rows_used.bit_length() - 1)
-        if pow2 < plan.rows_used:
-            take = [i for i, r in zip(take, rows_of) if r < pow2]
+        # trim only to a row count that pads to ITSELF (a power of two
+        # that is also a row_align multiple): a target that the padder
+        # would round back up just re-grows the device shape with
+        # all-padding rows.  With no such count below rows_used (e.g.
+        # a non-power-of-two dp), keep the full take.
+        align = max(1, int(row_align))
+        target = plan.rows_used
+        t = 1 << (plan.rows_used.bit_length() - 1)
+        while t >= 1:
+            padded = max(align, ((t + align - 1) // align) * align)
+            if padded == t:
+                target = t
+                break
+            t >>= 1
+        if target < plan.rows_used:
+            take = [i for i, r in zip(take, rows_of) if r < target]
     return take, [i for i in skipped if i < horizon]
 
 

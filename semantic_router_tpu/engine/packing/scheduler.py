@@ -55,6 +55,13 @@ class PackingBatcher(DynamicBatcher):
         # knobs must exist BEFORE the base class starts the picker
         # thread (it may call the hooks immediately)
         self.enabled = bool(enabled)
+        # serving-mesh data-parallel degree (docs/PARALLEL.md): with dp
+        # shards each holding up to max_batch_size rows, one packed
+        # step can profitably carry dp× the rows/items, and the
+        # backlog row trim must never cut below a dp multiple (the
+        # padding would just grow the shape back).  The engine's
+        # configure_mesh publishes this atomically (single int write).
+        self.dp_degree = 1
         self.bucket_of = bucket_of
         self.segment_cap_of = segment_cap_of
         self.max_segments_per_row = max(1, int(max_segments_per_row))
@@ -94,8 +101,17 @@ class PackingBatcher(DynamicBatcher):
         """Items one packed step may carry.  0 (the default knob) means
         2× max_batch_size: packed rows hold several segments each, so a
         step can serve more items than rows without growing the device
-        batch; the padded SEGMENT axis stays a power of two ≤ this."""
-        return self.max_items_per_step or 2 * self.max_batch_size
+        batch; the padded SEGMENT axis stays a power of two ≤ this.
+        A dp-sharded step (dp_degree > 1) scales the budget by the data
+        axis — each shard serves its own row slice."""
+        base = self.max_items_per_step or 2 * self.max_batch_size
+        return base * max(1, self.dp_degree)
+
+    def _row_budget(self) -> int:
+        """Rows one packed step may fill: max_batch_size per dp shard
+        (the engine pads the row axis to a dp multiple and XLA splits
+        it across the data axis — docs/PARALLEL.md)."""
+        return self.max_batch_size * max(1, self.dp_degree)
 
     def _packable(self, key: Hashable) -> bool:
         if not self.enabled:
@@ -145,8 +161,8 @@ class PackingBatcher(DynamicBatcher):
             return super()._group_full(key, items)
         if len(items) >= self._item_budget():
             return True
-        # full when the pending lengths already fill max_batch_size rows
-        plan = RowPlan(bucket, self.max_batch_size, self._seg_cap(key))
+        # full when the pending lengths already fill the row budget
+        plan = RowPlan(bucket, self._row_budget(), self._seg_cap(key))
         for item in items:
             if plan.add(len(item.payload.encoding)) is None:
                 return True
@@ -159,12 +175,13 @@ class PackingBatcher(DynamicBatcher):
         lengths = [len(item.payload.encoding) for item in items]
         budget = self._item_budget()
         take, deferred = plan_take(
-            lengths, bucket, max_rows=self.max_batch_size,
+            lengths, bucket, max_rows=self._row_budget(),
             max_segments_per_row=self._seg_cap(key),
             max_items=budget,
             deferrals=[item.deferred for item in items],
             starvation_steps=self.starvation_steps,
-            backlog_beyond=len(items) > budget)
+            backlog_beyond=len(items) > budget,
+            row_align=max(1, self.dp_degree))
         chosen = set(take)
         batch = [items[i] for i in take]
         rest = [item for i, item in enumerate(items) if i not in chosen]

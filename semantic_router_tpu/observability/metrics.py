@@ -462,6 +462,17 @@ class MetricSeries:
             "Fused jit program-set rebuilds from engine.quant / "
             "engine.kernels hot flips (in-flight batches finish on the "
             "old programs; the next step serves the new)")
+        # serving-mesh observability (docs/PARALLEL.md): proof the
+        # dp×tp placement is on the actual hot path, not just in config
+        self.mesh_steps = registry.counter(
+            "llm_engine_mesh_steps_total",
+            "Device steps executed dp-sharded over the serving mesh "
+            "(engine.mesh), by trunk group — compare against "
+            "llm_engine_trunk_forwards_total for the sharded share")
+        self.mesh_devices = registry.gauge(
+            "llm_engine_mesh_devices",
+            "Serving-mesh axis sizes (engine.mesh), by axis (dp/tp); "
+            "0 = no serving mesh active")
         self.bucket_overflows = registry.counter(
             "llm_batcher_bucket_overflow_total",
             "Inputs longer than the largest seq bucket — clipped at the "
@@ -507,6 +518,8 @@ fused_dedup_rows = default_series.fused_dedup_rows
 packed_steps = default_series.packed_steps
 kernel_steps = default_series.kernel_steps
 kernel_rebuilds = default_series.kernel_rebuilds
+mesh_steps = default_series.mesh_steps
+mesh_devices = default_series.mesh_devices
 bucket_overflows = default_series.bucket_overflows
 batcher_queue_wait = default_series.batcher_queue_wait
 batcher_fill_ratio = default_series.batcher_fill_ratio

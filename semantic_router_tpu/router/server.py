@@ -1201,6 +1201,17 @@ class RouterServer:
                                 rep["kernels"] = eng.kernels_report()
                             except Exception:
                                 pass
+                        # serving-mesh placement (docs/PARALLEL.md):
+                        # mesh shape, per-axis device counts, and which
+                        # groups serve sharded — read next to the
+                        # per-variant step registry so sharded vs
+                        # unsharded step time is one page
+                        if eng is not None and hasattr(eng,
+                                                       "mesh_report"):
+                            try:
+                                rep["mesh"] = eng.mesh_report()
+                            except Exception:
+                                pass
                         self._json(200, rep)
                 elif path == "/debug/resilience":
                     # degradation-ladder snapshot: level, pressure

@@ -796,6 +796,32 @@ def apply_packing_knobs(cfg: RouterConfig, engine) -> None:
                         level="warning")
 
 
+def apply_mesh_knobs(cfg: RouterConfig, engine) -> None:
+    """Apply the engine.mesh block (docs/PARALLEL.md) to a live
+    engine: builds or tears down the dp×tp serving mesh and atomically
+    swaps each trunk group's serving container (banks re-placed,
+    program sets rebuilt) — in-flight batches finish on the snapshot
+    they already read, so a hot mesh flip never corrupts a batch.
+    Called at boot and on config hot reload; ``enabled: false`` (the
+    default) keeps byte-identical single-device serving.  Malformed
+    mesh config must never stop the server."""
+    if engine is None or not hasattr(engine, "configure_mesh"):
+        return
+    try:
+        mk = cfg.engine.mesh_config()
+        engine.configure_mesh(cfg.engine.mesh)
+        rep = engine.mesh_report() if hasattr(engine, "mesh_report") \
+            else {}
+        component_event("bootstrap", "mesh_configured",
+                        enabled=mk["enabled"],
+                        axes=rep.get("axes", {}),
+                        devices=rep.get("mesh_devices", 0))
+    except Exception as exc:
+        component_event("bootstrap", "mesh_config_invalid",
+                        error=f"{type(exc).__name__}: {exc}"[:200],
+                        level="warning")
+
+
 def apply_kernel_knobs(cfg: RouterConfig, engine) -> None:
     """Apply the engine.quant + engine.kernels blocks (docs/KERNELS.md)
     to a live engine: quantizes trunk-group weights / flips the tuned
@@ -954,6 +980,10 @@ def serve(config_path: str, port: int = 8801,
     # upstream resilience plane: after the degradation controller and
     # state plane exist, so the retry gate and fleet share bind live
     apply_upstream_knobs(cfg, server.registry, router)
+    # serving mesh (docs/PARALLEL.md): dp×tp placement of the trunk
+    # groups — applied BEFORE packing/kernels so their packed-shape
+    # warmups compile against the placed program sets
+    apply_mesh_knobs(cfg, engine)
     # sequence-packed batching: scheduler knobs + the shape auto-tuner
     # thread (the engine survives hot reloads, so this retunes in place)
     apply_packing_knobs(cfg, engine)
@@ -1003,6 +1033,7 @@ def serve(config_path: str, port: int = 8801,
             apply_observability_knobs(new_cfg, server.registry)
             apply_flywheel_knobs(new_cfg, server.registry, new_router)
             apply_upstream_knobs(new_cfg, server.registry, new_router)
+            apply_mesh_knobs(new_cfg, engine)
             apply_packing_knobs(new_cfg, engine)
             apply_kernel_knobs(new_cfg, engine)
             # grace period before tearing down the old dispatcher so

@@ -1,0 +1,70 @@
+"""Planted violations for the MODULE-GLOBAL lockset pass
+(analysis/races.py ModuleGlobalAnalyzer) — bare module state guarded by
+a module-level lock, with one unguarded writer and one lock-free
+counter RMW.  modglobal_clean.py holds the sanctioned twins."""
+
+import threading
+
+_REG_LOCK = threading.Lock()
+_REGISTRY = {}
+_HITS = 0
+
+
+def put(key, value):
+    with _REG_LOCK:
+        _REGISTRY[key] = value
+
+
+def drop(key):
+    with _REG_LOCK:
+        _REGISTRY.pop(key, None)
+
+
+def read(key):
+    with _REG_LOCK:
+        return _REGISTRY.get(key)
+
+
+def put_fast(key, value):
+    # the planted bug: same module global, no guard
+    _REGISTRY[key] = value
+
+
+def put_fast_shadowed(key, value):
+    # a NESTED function binding the same name in ITS scope must not
+    # shadow the outer scope: the write below is still unguarded
+    def helper():
+        _REGISTRY = {}
+        return _REGISTRY
+
+    _ = helper
+    _REGISTRY[key] = value   # planted: unguarded despite the helper
+
+
+def record_hit():
+    global _HITS
+    _HITS += 1   # planted: lock-free RMW of shared module state
+
+
+_STATE = {}
+
+
+def load_state():
+    with _REG_LOCK:
+        return dict(_STATE)
+
+
+def state_size():
+    with _REG_LOCK:
+        return len(_STATE)
+
+
+def swap_state(fresh):
+    global _STATE
+    # planted: tuple-unpack WRITE of the global, unguarded
+    _STATE, _rest = dict(fresh), None
+
+
+def snapshot():
+    with _REG_LOCK:
+        return {"hits": _HITS}

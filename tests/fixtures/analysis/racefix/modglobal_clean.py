@@ -1,0 +1,74 @@
+"""Sanctioned twins for the module-global lockset counter-proofs: the
+same shapes as modglobal.py with the guard taken everywhere, the RMW
+moved under the lock, the module-RCU whole-object publish, a
+locked-helper inline, and a read-only constant — none may be flagged."""
+
+import threading
+
+_REG_LOCK = threading.Lock()
+_REGISTRY = {}
+_HITS = 0
+_VIEW = {}
+_PENDING = []
+# a module constant: read everywhere, written nowhere — clean by
+# construction (no writes means nothing to guard)
+LIMIT = 64
+
+
+def put(key, value):
+    with _REG_LOCK:
+        _REGISTRY[key] = value
+
+
+def drop(key):
+    with _REG_LOCK:
+        _REGISTRY.pop(key, None)
+
+
+def read(key):
+    with _REG_LOCK:
+        return _REGISTRY.get(key)
+
+
+def record_hit():
+    global _HITS
+    with _REG_LOCK:
+        _HITS += 1
+
+
+def snapshot():
+    with _REG_LOCK:
+        return {"hits": _HITS, "limit": LIMIT}
+
+
+def publish(rows):
+    # the module-RCU idiom: whole-object replace under the lock,
+    # raw reads elsewhere
+    global _VIEW
+    fresh = dict(rows)
+    with _REG_LOCK:
+        _VIEW = fresh
+
+
+def view():
+    return dict(_VIEW)
+
+
+def pending_count():
+    # a second locked accessor: with the locked-helper below walked
+    # standalone (the entry-selection bug), these votes would push the
+    # majority over 50% and falsely flag the helper's accesses
+    with _REG_LOCK:
+        return len(_PENDING)
+
+
+def flush():
+    with _REG_LOCK:
+        return _flush_locked()
+
+
+def _flush_locked():
+    # the locked-helper idiom: inlined under the caller's lock
+    out = list(_PENDING)
+    del _PENDING[:]
+    return out
