@@ -3,7 +3,7 @@ SHELL := /bin/bash
 
 .PHONY: test test-fast tier1 trace-smoke metrics-lint explain-smoke \
 	resilience-smoke fleet-smoke flywheel-smoke upstream-smoke \
-	packing-smoke kernels-smoke mesh-smoke analyze native bench \
+	packing-smoke kernels-smoke mesh-smoke cascade-smoke analyze native bench \
 	bench-replay perf perf-record serve-mock clean
 
 bench-replay:
@@ -114,6 +114,21 @@ kernels-smoke:
 mesh-smoke:
 	env JAX_PLATFORMS=cpu VSR_ANALYZE=1 $(PY) -m pytest \
 	  tests/test_mesh_serving.py -q -p no:cacheprovider
+
+# early-exit cascade gate (docs/CASCADE.md): tri-state rule fold vs the
+# two-valued engine (fuzzed), planner relevance/pinning + the
+# SAFETY_FAMILIES floor, the certain-winner interval proof, cascade-on
+# vs cascade-off decision/model parity over a packed/LoRA'd shared-trunk
+# rig with real skips, skip-aware fused prefetch (skipped families never
+# reach the engine or occupy packed segments), brownout L2 truncation
+# semantics, knob boot+reload wiring, the explain-record skip
+# certificate + deterministic replay re-derivation, and the bench arm's
+# watchdog/parser contract.  VSR_ANALYZE=1 arms the lock-order witness
+# and thread-leak gate over the wave dispatcher.  Tier-1 (runs inside
+# `make tier1` too).
+cascade-smoke:
+	env JAX_PLATFORMS=cpu VSR_ANALYZE=1 $(PY) -m pytest \
+	  tests/test_cascade.py -q -p no:cacheprovider
 
 # repo-native analysis gate (docs/ANALYSIS.md): the static lock-order
 # graph + cycle check, the shared-state race detector (Eraser-style

@@ -290,6 +290,12 @@ def main() -> None:
         # json line — never the headline record
         print(json.dumps(_mesh_measure_body()))
         return
+    if os.environ.get("SRT_BENCH_CASCADE_CHILD"):
+        # the cascade arm's isolated CPU child: routes rule-heavy mixed
+        # traffic with engine.cascade on vs off and prints ONE json
+        # line — never the headline record
+        print(json.dumps(_cascade_measure_body()))
+        return
     if os.environ.get("SRT_BENCH_CHILD"):
         _child_main()
         return
@@ -1096,6 +1102,216 @@ def _measure_mesh(platform: str) -> dict:
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+def _cascade_measure_body() -> dict:
+    """Early-exit cascade workload (runs inside the cascade child):
+    signals/s through the FULL routing pipeline over rule-heavy mixed
+    traffic with engine.cascade on vs off, plus the forwards-avoided
+    fraction (docs/CASCADE.md, ISSUE 16 acceptance: >=1.3x with >=30%
+    of learned forwards skipped).  The traffic alternates requests an
+    escalation keyword decides at wave 0 (its priority beats every
+    learned decision's best-achievable key, so both learned forwards
+    are provably outcome-neutral) with requests only the learned
+    families can route.  Same interleaved alternate-order best-of
+    protocol as the explain arm (single shared core: sequential
+    A-then-B inherits warmup drift)."""
+    import time as _time
+
+    import jax
+
+    from semantic_router_tpu.config.schema import (
+        Decision,
+        KeywordRule,
+        ModelRef,
+        NamedRule,
+        RouterConfig,
+        RuleNode,
+        SignalsConfig,
+    )
+    from semantic_router_tpu.engine.cascade import (
+        CascadeEvaluator,
+        normalize_cascade,
+    )
+    from semantic_router_tpu.engine.testing import make_shared_trunk_engine
+    from semantic_router_tpu.observability.metrics import (
+        MetricSeries,
+        MetricsRegistry,
+    )
+    from semantic_router_tpu.observability.tracing import Tracer
+    from semantic_router_tpu.router.pipeline import Router
+
+    def leaf(styp: str, name: str) -> RuleNode:
+        return RuleNode(signal_type=styp, name=name)
+
+    # two skippable learned families (user_feedback + modality: neither
+    # pipeline-consumed nor a safety family) behind rule-heavy keyword
+    # decisions — the shape where the cascade pays off
+    cfg = RouterConfig(
+        default_model="backend-model",
+        strategy="priority",
+        signals=SignalsConfig(
+            keywords=[
+                KeywordRule(name="escalate",
+                            keywords=["urgent", "outage", "escalate"]),
+                KeywordRule(name="billing",
+                            keywords=["invoice", "refund", "charge"]),
+            ],
+            user_feedbacks=[NamedRule(name="positive"),
+                            NamedRule(name="negative")],
+            modality=[NamedRule(name="diffusion"),
+                      NamedRule(name="both")]),
+        decisions=[
+            Decision(name="escalation", priority=100,
+                     rules=leaf("keyword", "escalate"),
+                     model_refs=[ModelRef(model="backend-model")]),
+            Decision(name="billing", priority=90,
+                     rules=RuleNode(operator="AND", conditions=[
+                         leaf("keyword", "billing"),
+                         RuleNode(operator="NOT", conditions=[
+                             leaf("keyword", "escalate")])]),
+                     model_refs=[ModelRef(model="backend-model")]),
+            Decision(name="retry_churn", priority=50,
+                     rules=RuleNode(operator="OR", conditions=[
+                         leaf("user_feedback", "negative"),
+                         RuleNode(operator="AND", conditions=[
+                             leaf("user_feedback", "positive"),
+                             leaf("modality", "diffusion")])]),
+                     model_refs=[ModelRef(model="backend-model")]),
+            Decision(name="imagegen", priority=40,
+                     rules=RuleNode(operator="OR", conditions=[
+                         leaf("modality", "diffusion"),
+                         leaf("modality", "both")]),
+                     model_refs=[ModelRef(model="backend-model")]),
+        ])
+    n_learned = 2
+    engine = make_shared_trunk_engine(
+        tasks=[("user_feedback", ["none", "positive", "negative"]),
+               ("modality", ["ar", "diffusion", "both"])],
+        metrics=MetricSeries(MetricsRegistry()))
+    router = Router(cfg, engine=engine,
+                    metrics=MetricSeries(MetricsRegistry()),
+                    tracer=Tracer(sample_rate=0.0))
+    casc = CascadeEvaluator()
+    casc.configure(normalize_cascade({"enabled": True}))
+    try:
+        # mixed traffic: even requests hit the escalation keyword
+        # (decided at wave 0, both learned forwards skipped), odd
+        # requests need the learned families
+        texts = [
+            (f"urgent outage in the payment cluster, ticket {i}"
+             if i % 2 == 0 else
+             f"please summarize the quarterly report number {i}")
+            for i in range(16)]
+
+        def body(i: int) -> dict:
+            return {"model": "auto", "messages": [
+                {"role": "user", "content": texts[i % len(texts)]}]}
+
+        def run(cascade_on: bool, n: int) -> float:
+            router.cascade = casc if cascade_on else None
+            t0 = _time.perf_counter()
+            for i in range(n):
+                router.route(body(i))
+            return n_learned * n / (_time.perf_counter() - t0)
+
+        n_iters = 30
+        run(False, 6)  # warm jit cache + selector construction
+        run(True, 6)
+        off_rates, on_rates = [], []
+        for i in range(4):
+            order = (False, True) if i % 2 == 0 else (True, False)
+            for cascade_on in order:
+                (on_rates if cascade_on else off_rates).append(
+                    run(cascade_on, n_iters))
+        off, on = max(off_rates), max(on_rates)
+
+        rep = casc.report()
+        requests = max(1, rep["requests_total"])
+        skips = sum(rep["skipped_forwards"].values())
+        return {
+            "platform": jax.devices()[0].platform,
+            "engine_signals_per_s_cascade_off": round(off, 1),
+            "engine_signals_per_s_cascade_on": round(on, 1),
+            "speedup": round(on / off, 3) if off else 0.0,
+            "forwards_avoided_fraction":
+                round(skips / (n_learned * requests), 3),
+            "decided_early_fraction":
+                round(rep["decided_early_total"] / requests, 3),
+            "skipped_forwards": rep["skipped_forwards"],
+            "requests_total": rep["requests_total"],
+            "waves_total": rep["waves_total"],
+        }
+    finally:
+        router.shutdown()
+        engine.shutdown()
+
+
+def _parse_cascade_child(stdout: str) -> dict:
+    """Parse the cascade child's stdout: the row is the LAST line that
+    parses as a json object.  Diagnostics (jax platform notices, GC
+    warnings) can leak onto stdout ahead of the row, and a watchdog that
+    fires mid-print can leave a truncated trailing line — scan upward
+    past both.  Raises ValueError when no line parses (the caller turns
+    that into an error row, never a lost round)."""
+    for line in reversed((stdout or "").strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(row, dict):
+            return row
+    raise ValueError("no json object line in cascade child stdout")
+
+
+def _measure_cascade(platform: str) -> dict:
+    """Early-exit cascade arm (docs/CASCADE.md, ISSUE 16): re-exec the
+    workload in an isolated CPU child (the arm routes through the
+    shared-trunk engine; a wedged TPU tunnel must never hang the whole
+    bench) and parse its one json line.
+
+    PR 13 regression, fixed for this arm from day one: the claim loop's
+    lesson was that unbounded retries starve the always-emits-JSON
+    fallback.  Child attempts here are capped by the SAME knob
+    (SRT_BENCH_CLAIM_ATTEMPTS), each attempt's timeout is clamped to
+    the room left before the CPU reserve, and exhaustion returns a
+    complete row carrying an "error" key — every BENCH round emits a
+    complete json whether or not this child ever finishes."""
+    last_err = "no attempt ran"
+    for attempt in range(1, max(1, CLAIM_MAX_ATTEMPTS) + 1):
+        room = _hard_stop() - time.time()
+        if room <= 30.0:
+            last_err = "no room left before the CPU-fallback reserve"
+            sys.stderr.write(f"bench: cascade arm: {last_err}\n")
+            break
+        env = dict(os.environ)
+        for key in ("SRT_BENCH_CHILD", "SRT_BENCH_CPU_DIRECT",
+                    "SRT_BENCH_MESH_CHILD"):
+            env.pop(key, None)
+        env["SRT_BENCH_CASCADE_CHILD"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True,
+                timeout=min(420.0, room))
+        except subprocess.TimeoutExpired:
+            last_err = f"attempt {attempt}: child watchdog timeout"
+            sys.stderr.write(f"bench: cascade {last_err}\n")
+            continue
+        try:
+            if proc.returncode != 0:
+                raise ValueError(
+                    f"rc={proc.returncode}: "
+                    f"{(proc.stderr or '').strip()[-200:]}")
+            return _parse_cascade_child(proc.stdout)
+        except ValueError as exc:
+            last_err = f"attempt {attempt}: {exc}"
+            sys.stderr.write(f"bench: cascade child {last_err}\n")
+    return {"error": last_err[:300]}
+
+
 def _clock_jit(fn, iters: int, *args):
     """Warm (one full compile+execute) then time: (ms_per_step, last
     output).  Shared by the kernel micro-arms; jax.device_get is the
@@ -1670,6 +1886,18 @@ def _run_bench(platform: str) -> None:
         sys.stderr.write(f"bench: mesh arm failed "
                          f"({type(exc).__name__}: {exc}); skipped\n")
 
+    # early-exit cascade arm (docs/CASCADE.md, ISSUE 16 acceptance):
+    # signals/s with engine.cascade on vs off over rule-heavy mixed
+    # traffic + the forwards-avoided fraction.  _measure_cascade never
+    # raises (exhaustion returns an error row), but the belt stays on.
+    cascade_row = None
+    try:
+        cascade_row = _measure_cascade(platform)
+        sys.stderr.write(f"bench: cascade {cascade_row}\n")
+    except Exception as exc:
+        sys.stderr.write(f"bench: cascade arm failed "
+                         f"({type(exc).__name__}: {exc}); skipped\n")
+
     # the `make analyze` tier-1 gate's cost, kept visible in the BENCH
     # json (docs/ANALYSIS.md): per-checker wall time + finding counts —
     # the gate must stay cheap enough that nobody is tempted to skip it
@@ -1731,6 +1959,8 @@ def _run_bench(platform: str) -> None:
         record["bgmv"] = bgmv_row
     if mesh_row is not None:
         record["mesh"] = mesh_row
+    if cascade_row is not None:
+        record["cascade"] = cascade_row
     if analyze_row is not None:
         record["analyze"] = analyze_row
     if platform != "cpu":

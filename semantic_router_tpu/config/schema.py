@@ -982,6 +982,13 @@ class InferenceEngineConfig:
     # single-device serving).  Hot-reloadable via bootstrap
     # apply_mesh_knobs with the atomic program-set swap.
     mesh: Dict[str, Any] = field(default_factory=dict)
+    # decision-aware signal cascade (docs/CASCADE.md): raw knob block
+    # normalized by engine.cascade.normalize_cascade — cost-ordered wave
+    # dispatch that skips classifier forwards the routing decision
+    # provably cannot use ({"enabled": false} default = full fan-out,
+    # byte-identical routing).  Hot-reloadable via bootstrap
+    # apply_cascade_knobs.
+    cascade: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "InferenceEngineConfig":
@@ -1000,6 +1007,7 @@ class InferenceEngineConfig:
             quant=dict(d.get("quant", {}) or {}),
             kernels=dict(d.get("kernels", {}) or {}),
             mesh=dict(d.get("mesh", {}) or {}),
+            cascade=dict(d.get("cascade", {}) or {}),
         )
         if d.get("seq_len_buckets"):
             out.seq_len_buckets = [int(x) for x in d["seq_len_buckets"]]
@@ -1034,6 +1042,14 @@ class InferenceEngineConfig:
         from ..engine.mesh import normalize_mesh
 
         return normalize_mesh(self.mesh)
+
+    def cascade_config(self) -> Dict[str, Any]:
+        """Normalized engine.cascade block (docs/CASCADE.md) — same
+        delegation pattern: engine.cascade owns the ONE interpretation
+        point for the early-exit cascade knobs."""
+        from ..engine.cascade import normalize_cascade
+
+        return normalize_cascade(self.cascade)
 
 
 DEFAULT_RECIPE_NAME = "default"

@@ -81,6 +81,16 @@ RECORD_SCHEMA: Dict[str, tuple] = {
     # entry {model, endpoint, outcome, status[, latency_ms]}, stamped
     # after the forward completes via DecisionExplainer.annotate
     "failover_path": (list,),
+    # engine/cascade: learned families whose forwards were skipped
+    # (never submitted or cancelled) by the early-exit cascade — [] on
+    # the full fan-out.  A replay of a cascade-era record must know the
+    # families were intentionally absent, not broken.
+    "skipped_families": (list,),
+    # engine/cascade: the full skip certificate (planner version,
+    # submission order, waves, per-family skip reasons, decided winner)
+    # — replay.recorder.rederive_cascade_skips re-checks it against the
+    # recorded matches.  None = cascade not in the path.
+    "cascade": (dict, type(None)),
 }
 
 _SIGNAL_KEYS = ("source", "latency_ms", "error", "hits")
@@ -167,7 +177,7 @@ class RecordDraft:
     __slots__ = ("trace_id", "request_id", "signals", "projections",
                  "rule_trace", "decision", "selection", "plugins",
                  "fallback_reason", "query", "replay_payload",
-                 "degradation_level")
+                 "degradation_level", "cascade_cert")
 
     def __init__(self, trace_id: str, request_id: str) -> None:
         self.trace_id = trace_id
@@ -182,6 +192,7 @@ class RecordDraft:
         self.query = ""
         self.replay_payload: Dict[str, Any] = {}
         self.degradation_level = 0
+        self.cascade_cert: Optional[Dict[str, Any]] = None
 
     # -- capture methods (called from router.pipeline) --------------------
 
@@ -252,6 +263,12 @@ class RecordDraft:
             "candidates": _jsonable(breakdown or []),
         }
 
+    def capture_cascade(self, cert) -> None:
+        """The cascade skip certificate (engine/cascade DispatchReport
+        ``cascade`` field) — recorded verbatim so replay can re-derive
+        the skips against the captured matches."""
+        self.cascade_cert = _jsonable(cert) if cert is not None else None
+
     def capture_plugin(self, plugin: str, verdict: str, **detail) -> None:
         row = {"plugin": plugin, "verdict": verdict}
         if detail:
@@ -287,6 +304,9 @@ class RecordDraft:
             "config_hash": config_hash,
             "degradation_level": int(self.degradation_level),
             "failover_path": [],
+            "skipped_families": sorted(
+                (self.cascade_cert or {}).get("skipped", {})),
+            "cascade": self.cascade_cert,
         }
 
 

@@ -259,6 +259,12 @@ def runtime_slo() -> Dict:
                unit="s", panel_id=8, x=0, y=24),
         _text_panel("Flight recorder & debug dumps", _FLIGHTREC_MD,
                     panel_id=9, x=12, y=24),
+        _panel("Cascade skipped forwards / waves",
+               ["sum(rate(llm_engine_cascade_skipped_forwards_total"
+                "[5m])) by (family)",
+                "sum(rate(llm_engine_cascade_waves_total[5m]))"],
+               panel_id=10, x=0, y=32,
+               legends=["skipped {{family}}", "waves"]),
     ]
     return _dashboard("srt-runtime-slo", "Semantic Router — Runtime & "
                       "SLO", p, tags=["runtime", "slo"])

@@ -473,6 +473,19 @@ class MetricSeries:
             "llm_engine_mesh_devices",
             "Serving-mesh axis sizes (engine.mesh), by axis (dp/tp); "
             "0 = no serving mesh active")
+        # early-exit cascade observability (docs/CASCADE.md): how much
+        # learned-forward work the decision-aware skips actually saved
+        self.cascade_skipped = registry.counter(
+            "llm_engine_cascade_skipped_forwards_total",
+            "Learned classifier forwards never submitted or cancelled "
+            "by the decision-aware cascade (engine.cascade), by signal "
+            "family — each is a device forward the routing decision "
+            "provably could not use")
+        self.cascade_waves = registry.counter(
+            "llm_engine_cascade_waves_total",
+            "Cost-ordered cascade dispatch waves executed "
+            "(engine.cascade) — waves-per-request near 0 means most "
+            "requests decide on wave-0 heuristics alone")
         self.bucket_overflows = registry.counter(
             "llm_batcher_bucket_overflow_total",
             "Inputs longer than the largest seq bucket — clipped at the "
@@ -520,6 +533,8 @@ kernel_steps = default_series.kernel_steps
 kernel_rebuilds = default_series.kernel_rebuilds
 mesh_steps = default_series.mesh_steps
 mesh_devices = default_series.mesh_devices
+cascade_skipped = default_series.cascade_skipped
+cascade_waves = default_series.cascade_waves
 bucket_overflows = default_series.bucket_overflows
 batcher_queue_wait = default_series.batcher_queue_wait
 batcher_fill_ratio = default_series.batcher_fill_ratio

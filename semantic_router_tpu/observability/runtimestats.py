@@ -136,6 +136,11 @@ class RuntimeStats:
         self._gc_counts: Dict[str, int] = {}
         self._gc_published: Dict[str, int] = {}
         self._last_process_sample: Dict[str, Any] = {}
+        # per-signal-family warm-cost EWMAs (seconds), fed by the
+        # cascade evaluator after each learned forward — the series its
+        # cheap→expensive ordering reads.  Bounded: family names come
+        # from config, not requests.
+        self._family_costs: Dict[str, Tuple[int, float]] = {}
 
         self.step_seconds = registry.histogram(
             "llm_runtime_step_seconds",
@@ -437,6 +442,27 @@ class RuntimeStats:
 
     # -- reading -----------------------------------------------------------
 
+    def note_family_cost(self, family: str, seconds: float) -> None:
+        """One observed signal-family evaluation (wall seconds).  Same
+        EWMA discipline as ProgramStats.execute_ewma_s: first sample
+        seeds, later samples blend at ``ewma_alpha``."""
+        if not self.enabled or seconds < 0.0:
+            return
+        with self._lock:
+            if family not in self._family_costs \
+                    and len(self._family_costs) >= 128:
+                return  # bounded against pathological family churn
+            n, ewma = self._family_costs.get(family, (0, 0.0))
+            ewma = seconds if n == 0 else (
+                self.ewma_alpha * seconds + (1.0 - self.ewma_alpha) * ewma)
+            self._family_costs[family] = (n + 1, ewma)
+
+    def family_costs(self) -> Dict[str, float]:
+        """Warm-cost EWMA per signal family, in seconds."""
+        with self._lock:
+            return {f: ewma for f, (_n, ewma) in
+                    sorted(self._family_costs.items())}
+
     def programs(self) -> List[Dict[str, Any]]:
         self.flush()
         with self._lock:
@@ -461,6 +487,7 @@ class RuntimeStats:
     def clear(self) -> None:
         with self._lock:
             self._programs.clear()
+            self._family_costs.clear()
         self._pending.clear()
         self._dropped = 0
 

@@ -208,6 +208,85 @@ def _reproject(record: Dict[str, Any], cfg):
     return sm, trace
 
 
+def rederive_cascade_skips(record: Dict[str, Any], cfg) -> Dict[str, Any]:
+    """Deterministically re-check a record's cascade skip certificate
+    (engine/cascade): rebuild the final match set from the recorded raw
+    hits, treat the neutral-skipped families as unknown, and re-run the
+    three-valued winner proof.  A valid certificate yields
+    ``outcome_neutral=True`` with the same winner the record stored —
+    every resolution of the skipped families selects the same decision.
+
+    Truncated families (brownout/wave-budget skips) are excluded from
+    the unknown set: they are acknowledged quality trades, exactly like
+    a degradation-level family drop, and the certificate never claimed
+    them neutral."""
+    from ..decision.engine import DecisionEngine
+    from ..engine.cascade import (
+        NEUTRAL_SKIP_REASONS,
+        PLANNER_VERSION,
+        certain_winner,
+    )
+    from ..engine.cascade.planner import (
+        _composer_feeders,
+        _projection_feeders,
+    )
+
+    cert = record.get("cascade")
+    if not isinstance(cert, dict) or cert.get("mode") != "cascade":
+        return {"applicable": False}
+    skipped = dict(cert.get("skipped", {}) or {})
+    neutral = {f for f, why in skipped.items()
+               if why in NEUTRAL_SKIP_REASONS}
+    truncated = sorted(set(skipped) - neutral)
+
+    # the final matches exactly as the live cascade left them: raw
+    # recorded hits re-driven through composers + projections (the same
+    # stages the live finalize ran); legacy/partial records fall back to
+    # the recorded post-projection matches
+    redriven = None
+    try:
+        redriven = _reproject(record, cfg)
+    except Exception:
+        redriven = None
+    sm = redriven[0] if redriven is not None \
+        else signal_matches_from_record(record)
+
+    # derived families go unknown with their feeders, mirroring
+    # engine.cascade.assess — the live proof ran under the same rule
+    unknown = set(neutral)
+    if unknown & _composer_feeders(cfg.signals.complexity):
+        unknown.add("complexity")
+    from ..decision.projections import ProjectionEvaluator
+
+    if unknown & _projection_feeders(ProjectionEvaluator(cfg.projections),
+                                     cfg.signals):
+        unknown.add("projection")
+
+    engine = DecisionEngine(cfg.decisions, cfg.strategy)
+    decided, winner, _ = certain_winner(engine.decisions, engine.strategy,
+                                        sm, unknown)
+    two_valued = engine.evaluate(sm)
+    recorded_name = (record.get("decision") or {}).get("name")
+    return {
+        "applicable": True,
+        "planner_version": cert.get("planner_version"),
+        "planner_version_match":
+            cert.get("planner_version") == PLANNER_VERSION,
+        "skipped_families": sorted(skipped),
+        "neutral_families": sorted(neutral),
+        "truncated_families": truncated,
+        "outcome_neutral": bool(decided),
+        "winner": winner,
+        "two_valued_winner":
+            two_valued.decision.name if two_valued else None,
+        "matches_recorded_decision":
+            bool(decided)
+            and winner == (two_valued.decision.name if two_valued
+                           else None)
+            and (recorded_name is None or winner == recorded_name),
+    }
+
+
 def replay_decision(record: Dict[str, Any], cfg,
                     reproject: bool = True) -> Dict[str, Any]:
     """Deterministically re-drive the routing brain over a stored
@@ -269,6 +348,14 @@ def replay_decision(record: Dict[str, Any], cfg,
              "matched_rules": list(e.matched_rules), "tree": e.tree}
             for e in trace],
     }
+    if isinstance(record.get("cascade"), dict):
+        # cascade-era record: re-derive the skip proof alongside the
+        # decision re-drive (additive key; non-cascade records are
+        # byte-identical to before)
+        try:
+            out["cascade_rederive"] = rederive_cascade_skips(record, cfg)
+        except Exception:
+            out["cascade_rederive"] = {"applicable": False}
     if res is None:
         out["model"] = cfg.default_model or record.get("model", "")
         out["selection_basis"] = "no_decision_matched → default model"

@@ -308,7 +308,10 @@ _META: Dict[tuple, Dict[str, Any]] = {
         "tag": "debug",
         "summary": "Per-jit-program device-step sampler: cold vs warm "
                    "steps, padding waste, token fill, kernel/quant "
-                   "program-set state, process gauges."},
+                   "program-set state, process gauges, and the "
+                   "early-exit cascade block (ordering, per-family "
+                   "cost EWMAs, skip counters) when engine.cascade is "
+                   "on."},
     ("GET", "/debug/resilience"): {
         "tag": "debug",
         "summary": "Degradation-ladder snapshot: level, pressure "

@@ -314,6 +314,10 @@ class Router:
         # attached by bootstrap when resilience.upstream.enabled; None =
         # no health mask, no fallback export — byte-identical routing
         self.upstream_health = None
+        # decision-aware signal cascade (engine.cascade.CascadeEvaluator):
+        # attached by bootstrap when engine.cascade.enabled; None = the
+        # plain full fan-out, byte-identical routing
+        self.cascade = None
 
     def skip_requested(self, headers: Dict[str, str]) -> bool:
         """True when the (operator-enabled) skip-processing header is on
@@ -609,14 +613,18 @@ class Router:
             if recorded is not None:
                 compress = recorded
         skip = self._prepare_signal_view(ctx, headers, compress=compress)
-        if disp is not None and not disp.use_learned \
-                and precomputed_signals is None:
+        browned = (disp is not None and not disp.use_learned
+                   and precomputed_signals is None)
+        if browned and self.cascade is None:
             # L2 brownout: this request's priority class routes on
             # heuristics alone — engine-backed families are skipped,
             # reserving fused-bank capacity for higher classes, EXCEPT
             # the safety floor (disp.keep_families, default jailbreak):
             # browning out the abuse screen is never the right trade.
             # (A streamed prefetch already paid the forward; keep it.)
+            # With the cascade attached the same ladder level degrades
+            # to "truncate the cascade earlier" instead (see below) —
+            # shedding computation, not whole families.
             skip = skip + self._learned_families(dispatcher,
                                                  disp.keep_families)
         if precomputed_signals is not None:
@@ -624,6 +632,13 @@ class Router:
             # the body was still arriving (same text, same skip config,
             # same recipe — _engines_for_model on both paths)
             signals, report = precomputed_signals
+        elif self.cascade is not None:
+            with self.tracer.span("signals.evaluate",
+                                     request_id=request_id):
+                signals, report = self.cascade.evaluate(
+                    ctx, dispatcher, decision_engine,
+                    signals_cfg=self._signals_cfg_for(dispatcher),
+                    brownout=browned, skip_signals=skip)
         else:
             with self.tracer.span("signals.evaluate",
                                      request_id=request_id):
@@ -641,6 +656,8 @@ class Router:
         if rec is not None:
             rec.query = ctx.user_text
             rec.capture_signals(signals, report, self.explain.redact_pii)
+            if report.cascade is not None:
+                rec.capture_cascade(report.cascade)
 
         # explainability: the trace list makes the engine capture EVERY
         # decision's full rule tree (decision.engine.explain_rule_node),
@@ -816,6 +833,21 @@ class Router:
             types = dispatcher.learned_types()
         return [t for t in types if t not in keep] if keep \
             else list(types)
+
+    def _signals_cfg_for(self, dispatcher):
+        """The SignalsConfig a dispatcher was built from — the cascade
+        planner resolves projection-partition members to their feeder
+        families through it (build_plan).  Recipe dispatchers map back
+        to their recipe's signal block; unknown dispatchers (carry-over
+        from a hot swap) return None and the planner goes
+        conservative."""
+        if dispatcher is self.dispatcher:
+            return self.cfg.signals
+        for name, (disp, _eng) in self._recipe_engines.items():
+            if disp is dispatcher:
+                rec = self.cfg.recipe_by_name(name)
+                return rec.signals if rec is not None else None
+        return None
 
     def _fail_static(self, body: Dict[str, Any], ctx: RequestContext,
                      headers: Dict[str, str], request_id: str,

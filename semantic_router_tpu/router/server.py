@@ -1212,6 +1212,16 @@ class RouterServer:
                                 rep["mesh"] = eng.mesh_report()
                             except Exception:
                                 pass
+                        # early-exit cascade state (docs/CASCADE.md):
+                        # submission order, per-family warm-cost EWMAs,
+                        # skip counters, planner version — absent when
+                        # engine.cascade is off
+                        casc = server.registry.get("cascade")
+                        if casc is not None:
+                            try:
+                                rep["cascade"] = casc.report()
+                            except Exception:
+                                pass
                         self._json(200, rep)
                 elif path == "/debug/resilience":
                     # degradation-ladder snapshot: level, pressure

@@ -896,6 +896,44 @@ def apply_flywheel_knobs(cfg: RouterConfig, registry, router) -> None:
                         level="warning")
 
 
+def apply_cascade_knobs(cfg: RouterConfig, registry, router) -> None:
+    """Attach/configure/detach the decision-aware signal cascade
+    (engine/cascade, docs/CASCADE.md) on a router.  Called at boot and
+    on config hot reload; ``engine.cascade.enabled: false`` (the
+    default) detaches any previous evaluator — the pipeline falls back
+    to the plain full fan-out, byte-identical routing.  Malformed
+    cascade config must never stop the server."""
+    try:
+        ck = cfg.engine.cascade_config()
+        if not ck["enabled"]:
+            if registry.get("cascade") is not None:
+                registry.swap(cascade=None)
+                component_event("bootstrap", "cascade_detached")
+            if router is not None:
+                router.cascade = None
+            return
+        from ..engine.cascade import CascadeEvaluator
+
+        casc = registry.get("cascade")
+        if casc is None:
+            casc = CascadeEvaluator(
+                metrics=registry.metric_series(),
+                runtime_stats=registry.get("runtimestats"))
+            registry.swap(cascade=casc)
+            component_event("bootstrap", "cascade_attached")
+        # re-bound every apply: hot reload swaps the router (and with it
+        # the flywheel handle the ordering discount reads)
+        casc.flywheel_provider = lambda: getattr(router, "flywheel", None)
+        casc.runtime_stats = registry.get("runtimestats")
+        casc.configure(ck)
+        if router is not None:
+            router.cascade = casc
+    except Exception as exc:
+        component_event("bootstrap", "cascade_config_invalid",
+                        error=f"{type(exc).__name__}: {exc}"[:200],
+                        level="warning")
+
+
 def serve(config_path: str, port: int = 8801,
           default_backend: str = "", mock_models: bool = False,
           status_path: Optional[str] = None,
@@ -977,6 +1015,9 @@ def serve(config_path: str, port: int = 8801,
     # learned-routing flywheel: attached after the observability stack
     # so it can bind the explainer / event bus / cost model it feeds on
     apply_flywheel_knobs(cfg, server.registry, router)
+    # early-exit signal cascade: after the flywheel so the ordering
+    # discount can read the just-attached controller's value estimates
+    apply_cascade_knobs(cfg, server.registry, router)
     # upstream resilience plane: after the degradation controller and
     # state plane exist, so the retry gate and fleet share bind live
     apply_upstream_knobs(cfg, server.registry, router)
@@ -1032,6 +1073,7 @@ def serve(config_path: str, port: int = 8801,
             server.cfg = new_cfg
             apply_observability_knobs(new_cfg, server.registry)
             apply_flywheel_knobs(new_cfg, server.registry, new_router)
+            apply_cascade_knobs(new_cfg, server.registry, new_router)
             apply_upstream_knobs(new_cfg, server.registry, new_router)
             apply_mesh_knobs(new_cfg, engine)
             apply_packing_knobs(new_cfg, engine)

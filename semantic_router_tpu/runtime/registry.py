@@ -38,7 +38,12 @@ _SLOTS = ("metrics", "tracer", "sessions", "profiler", "events",
           # UpstreamHealth): empty unless resilience.upstream.enabled —
           # built by bootstrap, so the disabled posture constructs
           # nothing and routing stays byte-identical
-          "upstreams")
+          "upstreams",
+          # decision-aware signal cascade (engine.cascade
+          # CascadeEvaluator): empty unless engine.cascade.enabled —
+          # built by bootstrap; registry-held so its skip counters and
+          # warm-cost ordering survive router hot-reload swaps
+          "cascade")
 
 
 class RuntimeRegistry:

@@ -45,6 +45,10 @@ class DispatchReport:
     # the text the signals actually saw.  None = not recorded (direct
     # dispatcher callers).
     compressed_view: Optional[bool] = None
+    # skip certificate from the cascade evaluator (engine/cascade): which
+    # forwards were never submitted/cancelled and why.  None = plain
+    # full-fan-out dispatch.
+    cascade: Optional[dict] = None
 
 
 def apply_complexity_composers(signals: SignalMatches,
@@ -109,12 +113,33 @@ class SignalDispatcher:
         skip = set(skip_signals or ())
         active = [e for e in self.active_evaluators() if e.signal_type not in skip]
 
-        # Trace propagation across the thread fan-out: the pool workers
-        # have no thread-local span context, so without this every
-        # engine submit under them would detach from the request's trace
-        # (the batcher's batch.ride spans key off the captured context).
-        # Capture once here, re-establish per family as a signal.<type>
-        # child span; no active trace → zero-cost no-op.
+        run = self._runner(ctx)
+        self._prefetch_fused(ctx, active)
+        if len(active) <= 1:
+            results = [run(e) for e in active]
+        else:
+            results = list(self.pool.map(run, active))
+
+        signals = SignalMatches()
+        kb_metrics: dict = {}
+        for r in results:
+            self._fold_result(r, signals, report, kb_metrics)
+        self._finalize(signals, report, kb_metrics)
+        report.wall_s = time.perf_counter() - start
+        return signals, report
+
+    def _runner(self, ctx: RequestContext):
+        """Per-evaluator closure shared with the cascade evaluator
+        (engine/cascade): trace re-establishment + fail-open + source
+        attribution, identical whether the family runs in the full
+        fan-out or in a cascade wave.
+
+        Trace propagation across the thread fan-out: the pool workers
+        have no thread-local span context, so without this every
+        engine submit under them would detach from the request's trace
+        (the batcher's batch.ride spans key off the captured context).
+        Capture once here, re-establish per family as a signal.<type>
+        child span; no active trace → zero-cost no-op."""
         from ..observability import batchtrace
 
         parent = batchtrace.capture()
@@ -140,43 +165,42 @@ class SignalDispatcher:
                                         e, "engine", None) is not None
                                     else "heuristic")
 
-        self._prefetch_fused(ctx, active)
-        if len(active) <= 1:
-            results = [run(e) for e in active]
-        else:
-            results = list(self.pool.map(run, active))
+        return run
 
-        signals = SignalMatches()
-        kb_metrics: dict = {}
-        for r in results:
-            report.results[r.signal_type] = r
-            for h in r.hits:
-                signals.add(r.signal_type, h.rule, h.confidence)
-                if h.detail:
-                    signals.details.setdefault(r.signal_type, {})[h.rule] = \
-                        h.detail.get("keywords", h.detail)
-            if r.metrics:  # kb family → kb_metric projection inputs
-                kb_metrics.update(r.metrics)
+    @staticmethod
+    def _fold_result(r: SignalResult, signals: SignalMatches,
+                     report: DispatchReport, kb_metrics: dict) -> None:
+        """Fold one family's result into the running match set."""
+        report.results[r.signal_type] = r
+        for h in r.hits:
+            signals.add(r.signal_type, h.rule, h.confidence)
+            if h.detail:
+                signals.details.setdefault(r.signal_type, {})[h.rule] = \
+                    h.detail.get("keywords", h.detail)
+        if r.metrics:  # kb family → kb_metric projection inputs
+            kb_metrics.update(r.metrics)
 
-        # Complexity composers: boolean expressions over sibling families
-        # that force-escalate a rule to "hard" (reference: the composer
-        # block on complexity signals — evaluated after the fan-out since
-        # it references other signals).
-        if self.complexity_rules:
-            apply_complexity_composers(signals, self.complexity_rules)
-
-        needs_projection = (
+    def _needs_projection(self) -> bool:
+        return (
             self.projections is not None
             and (self.used_types is None or SIGNAL_PROJECTION in self.used_types
                  or bool(self.projections.cfg.scores)
                  or bool(self.projections.cfg.partitions))
         )
-        if needs_projection:
+
+    def _finalize(self, signals: SignalMatches, report: DispatchReport,
+                  kb_metrics: dict) -> None:
+        """Post-fan-out derivations, in dispatch order.
+
+        Complexity composers: boolean expressions over sibling families
+        that force-escalate a rule to "hard" (reference: the composer
+        block on complexity signals — evaluated after the fan-out since
+        it references other signals).  Then projections."""
+        if self.complexity_rules:
+            apply_complexity_composers(signals, self.complexity_rules)
+        if self._needs_projection():
             report.projection_trace = self.projections.evaluate(
                 signals, kb_metrics=kb_metrics)
-
-        report.wall_s = time.perf_counter() - start
-        return signals, report
 
     def _prefetch_fused(self, ctx: RequestContext, active: list) -> None:
         """Tokenize-once + trunk-once for the learned fan-out.

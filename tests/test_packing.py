@@ -568,6 +568,35 @@ class TestAutoTuner:
         tuner = ShapeAutoTuner(stats, None, min_samples=50)
         assert tuner.step() == {}
 
+    def test_cascade_thinner_fill_keeps_segment_cap(self):
+        """Cascade skips thin the packed rows (skipped families never
+        occupy segments), so fill drops while segs/row sits well under
+        the cap. Traffic — not the cap — bounds fill: retuning must not
+        touch the cap."""
+        stats = _StatsStub([{
+            "group": "trunk:trunk0", "bucket": 128, "variant": "packed",
+            "executes": 200, "execute_s_total": 2.0, "rows_real": 200,
+            "token_fill_ratio": 0.35, "segments_real": 500,  # 2.5/row
+        }])
+        tuner = ShapeAutoTuner(stats, None, target_fill=0.85,
+                               min_samples=50, segments_floor=8,
+                               max_segments_cap=32)
+        assert tuner.step() == {}
+        assert tuner.retunes == 0
+
+    def test_cascade_packed_only_traffic_never_demotes(self):
+        """Under heavy skipping only the packed variant accrues samples.
+        Demotion needs BOTH variants past min_samples — a slow-looking
+        packed series alone must not block the bucket."""
+        stats = _StatsStub([{
+            "group": "trunk:trunk0", "bucket": 512, "variant": "packed",
+            "executes": 100, "execute_s_total": 50.0, "rows_real": 100,
+            "token_fill_ratio": 0.9, "segments_real": 100,
+        }])
+        tuner = ShapeAutoTuner(stats, None, min_samples=50)
+        tuner.step()
+        assert tuner.blocked("trunk:trunk0", 512) is False
+
     def test_demoted_bucket_stops_packing_live(self):
         """A blocked bucket flips the engine's bucket_of to None — the
         runner keeps that bucket on the unpacked path."""
