@@ -3,8 +3,9 @@ SHELL := /bin/bash
 
 .PHONY: test test-fast tier1 trace-smoke metrics-lint explain-smoke \
 	resilience-smoke fleet-smoke flywheel-smoke upstream-smoke \
-	packing-smoke kernels-smoke mesh-smoke cascade-smoke analyze native bench \
-	bench-replay perf perf-record serve-mock clean
+	packing-smoke kernels-smoke mesh-smoke cascade-smoke profile-smoke \
+	analyze native bench \
+	bench-replay perf perf-record perfgate perfgate-record serve-mock clean
 
 bench-replay:
 	$(PY) benchmarks/replay_bench.py --n 500 \
@@ -176,6 +177,34 @@ upstream-smoke:
 	env JAX_PLATFORMS=cpu VSR_ANALYZE=1 $(PY) -m pytest \
 	  tests/test_upstream.py \
 	  tests/test_upstream_chaos.py -q -p no:cacheprovider
+
+# program-observatory gate (docs/OBSERVABILITY.md "Program catalog &
+# roofline"): per-compiled-program XLA cost capture (cost_analysis +
+# memory_analysis) across the fused/packed/quant/kernel/mesh variant
+# matrix on the forced 8-device CPU mesh rig, the runtimestats join →
+# roofline fractions, census-purge/retirement coherence under 10
+# consecutive hot flips, the perf-regression gate (clean + planted-2x
+# counter-proof), SLO-burn-triggered capture with the flight-recorder
+# cross-link, the /debug/runtime report-schema matrix, and the
+# device-memory gauge spelling table.  VSR_ANALYZE=1 arms the
+# lock-order witness + thread-leak gate over the capture controller's
+# bounded stop timer.  Tier-1 (runs inside `make tier1` too).
+profile-smoke:
+	env JAX_PLATFORMS=cpu VSR_ANALYZE=1 $(PY) -m pytest \
+	  tests/test_programstats.py -q -p no:cacheprovider
+
+# the program-cost regression gate itself, runnable standalone: clean
+# check against the pinned perf/program_baseline.json, THEN the
+# counter-proof — the planted 2x fixture MUST flag (inverted verdict)
+# or the gate is vacuous
+perfgate:
+	env JAX_PLATFORMS=cpu $(PY) perf/programgate.py --check
+	env JAX_PLATFORMS=cpu $(PY) perf/programgate.py --check \
+	  --baseline tests/fixtures/perf/program_baseline_regressed.json \
+	  --expect-regression
+
+perfgate-record:
+	env JAX_PLATFORMS=cpu $(PY) perf/programgate.py --record
 
 native:
 	$(PY) -m semantic_router_tpu.native.build

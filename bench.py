@@ -437,6 +437,67 @@ def _measure_runtime_stats_overhead(platform: str) -> dict:
         eng.shutdown()
 
 
+def _measure_program_catalog(platform: str) -> dict:
+    """The program-level observatory's BENCH block (ISSUE 18
+    acceptance): drive the shared-trunk engine through the fused and
+    packed paths, capture the XLA cost model per compiled program, join
+    with the measured warm-step EWMAs, and report per-variant roofline
+    fractions + catalog size.  On CPU the roofline denominator is the
+    flagged placeholder tier, so the rows carry the peak_note verbatim
+    — a CPU fraction is an honesty-annotated smoke number, never a
+    cross-machine claim."""
+    from semantic_router_tpu.engine.testing import make_shared_trunk_engine
+    from semantic_router_tpu.observability.metrics import (
+        MetricSeries,
+        MetricsRegistry,
+    )
+    from semantic_router_tpu.observability.programstats import ProgramCatalog
+    from semantic_router_tpu.observability.runtimestats import RuntimeStats
+
+    reg = MetricsRegistry()
+    rs = RuntimeStats(reg)
+    cat = ProgramCatalog(reg)
+    eng = make_shared_trunk_engine(metrics=MetricSeries(reg),
+                                   runtime_stats=rs, program_stats=cat)
+    try:
+        texts = [f"program catalog probe {i} about contract law"
+                 for i in range(12)]
+        eng.configure_packing({"enabled": False})
+        for _ in range(4):  # warm executes so the EWMA join has data
+            eng.classify_batch("intent", texts)
+        eng.configure_packing({"enabled": True})
+        for _ in range(4):
+            eng.classify_batch("intent", texts)
+        snap = cat.catalog(runtime_stats=rs)
+        variants = {}
+        for row in snap.get("programs", []):
+            key = f"{row['variant']}|q={row['quant']}" \
+                  f"|k={row['kernels']}|m={row['mesh']}"
+            entry = {
+                "flops": row.get("flops", 0.0),
+                "hbm_peak_bytes": row.get("hbm_peak_bytes", 0),
+            }
+            if "roofline_fraction" in row:
+                entry["roofline_fraction"] = round(
+                    row["roofline_fraction"], 5)
+                entry["bound"] = row.get("bound", "")
+            if row.get("error"):
+                entry["error"] = row["error"]
+            variants[key] = entry
+        tier = snap.get("device", {})
+        out = {
+            "catalog_size": snap.get("catalog_size", 0),
+            "capture_errors": snap.get("capture_errors", 0),
+            "tier": tier.get("tier", ""),
+            "variants": variants,
+        }
+        if tier.get("placeholder"):
+            out["peak_note"] = tier.get("peak_note", "")
+        return out
+    finally:
+        eng.shutdown()
+
+
 def _measure_explain_overhead(platform: str) -> dict:
     """signals/s through the FULL routing pipeline (signal fan-out over
     the shared-trunk engine → decision engine → selection) with decision
@@ -1791,6 +1852,17 @@ def _run_bench(platform: str) -> None:
         sys.stderr.write(f"bench: runtime-stats arm failed "
                          f"({type(exc).__name__}: {exc}); skipped\n")
 
+    # program-catalog arm (docs/OBSERVABILITY.md, ISSUE 18 acceptance):
+    # per-variant XLA cost model + roofline fractions joined from the
+    # warm EWMAs — the llm_program_* series' numbers, in the BENCH json
+    programs_row = None
+    try:
+        programs_row = _measure_program_catalog(platform)
+        sys.stderr.write(f"bench: programs {programs_row}\n")
+    except Exception as exc:
+        sys.stderr.write(f"bench: programs arm failed "
+                         f"({type(exc).__name__}: {exc}); skipped\n")
+
     # decision-record overhead arm (docs/OBSERVABILITY.md, ISSUE 4
     # acceptance): recording at sample_rate=1.0 must cost <1% of the
     # routing path — assembly is dict builds on the routing thread, the
@@ -1941,6 +2013,8 @@ def _run_bench(platform: str) -> None:
         record["observability"] = obs_row
     if rs_row is not None:
         record["runtime_stats"] = rs_row
+    if programs_row is not None:
+        record["programs"] = programs_row
     if explain_row is not None:
         record["explain"] = explain_row
     if resilience_row is not None:

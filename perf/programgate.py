@@ -1,0 +1,174 @@
+"""Program-cost perf-regression gate (docs/OBSERVABILITY.md).
+
+perf/benchmarks.py gates *wall-clock* µs/op — inherently noisy, so its
+factor is loose and its unit is the whole serving machinery.  This gate
+pins the *XLA cost model* instead: per compiled program variant, the
+flops / bytes-accessed / peak-HBM the compiler says the program costs.
+Those numbers are deterministic for a fixed rig (same model geometry,
+same padded shapes → same HLO → same cost analysis), so the gate factor
+can be tight and a CI box's load average cannot flake it.  What it
+catches: a refactor that silently doubles the work a program compiles
+to — an extra forward, a lost fusion, a padding-policy regression that
+balloons the padded shape — before any latency dashboard moves.
+
+Usage:
+  python perf/programgate.py --record     # write perf/program_baseline.json
+  python perf/programgate.py --check      # gate vs the pinned baseline
+  python perf/programgate.py --check --baseline <path> --expect-regression
+                                          # counter-proof: the planted 2x
+                                          # fixture MUST flag, else exit 1
+
+`make perfgate` runs the clean check AND the counter-proof against
+tests/fixtures/perf/program_baseline_regressed.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+BASELINE_PATH = os.path.join(os.path.dirname(__file__),
+                             "program_baseline.json")
+# cost-model numbers are deterministic per rig; 1.5x is loose enough for
+# jax-version cost-model drift and tight enough that the planted 2x
+# fixture (and any real doubled-work regression) always flags
+GATE_FACTOR = 1.5
+GATE_FIELDS = ("flops", "bytes_accessed", "hbm_peak_bytes")
+
+
+def key_str(row: Dict[str, Any]) -> str:
+    return "|".join([str(row["group"]), str(row["bucket"]),
+                     str(row["variant"]), str(row["quant"]),
+                     str(row["kernels"]), str(row["mesh"])])
+
+
+def build_rig_rows() -> Dict[str, Dict[str, Any]]:
+    """Deterministic gate rig: the shared-trunk test engine with its own
+    ProgramCatalog, driven through the fused and packed paths, then
+    cost-captured.  Returns {key_str: {field: value}} over the rows the
+    llm_program_* gauges would publish."""
+    from semantic_router_tpu.engine.testing import make_shared_trunk_engine
+    from semantic_router_tpu.observability.metrics import MetricsRegistry
+    from semantic_router_tpu.observability.programstats import ProgramCatalog
+    from semantic_router_tpu.observability.runtimestats import RuntimeStats
+
+    registry = MetricsRegistry()
+    rs = RuntimeStats(registry)
+    cat = ProgramCatalog(registry)
+    eng = make_shared_trunk_engine(runtime_stats=rs, program_stats=cat)
+    texts = [f"gate probe text number {i} with some padding words"
+             for i in range(6)]
+    # fused path (packing off), then the packed path — two program
+    # families is enough surface for the gate; the full variant matrix
+    # (quant/kernels/mesh) belongs to the tier-1 tests, not a CI gate
+    # that must stay fast
+    eng.configure_packing({"enabled": False})
+    eng.classify_batch("intent", texts)
+    eng.configure_packing({"enabled": True})
+    eng.classify_batch("intent", texts)
+    cat.capture_pending()
+
+    rows: Dict[str, Dict[str, Any]] = {}
+    for cost in cat.rows():
+        row = cost.snapshot()
+        if row.get("error"):
+            continue
+        rows[key_str(row)] = {f: row.get(f, 0) for f in GATE_FIELDS}
+    return rows
+
+
+def compare(rows: Dict[str, Dict[str, Any]],
+            baseline: Dict[str, Dict[str, Any]],
+            factor: float = GATE_FACTOR) -> Dict[str, Any]:
+    """Per-key, per-field ratio check.  Keys only in one side are
+    reported but do not fail (the program set legitimately changes when
+    the rig changes — re-record then); zero overlapping keys fails,
+    because a gate that compared nothing proved nothing."""
+    regressions, matched = [], 0
+    for key, base in sorted(baseline.items()):
+        cur = rows.get(key)
+        if cur is None:
+            continue
+        matched += 1
+        for f in GATE_FIELDS:
+            b, c = float(base.get(f) or 0), float(cur.get(f) or 0)
+            if b > 0 and c > b * factor:
+                regressions.append(
+                    f"{key} {f}: {c:.3g} vs baseline {b:.3g} "
+                    f"({c / b:.2f}x > {factor}x)")
+    return {
+        "matched": matched,
+        "only_baseline": sorted(set(baseline) - set(rows)),
+        "only_current": sorted(set(rows) - set(baseline)),
+        "regressions": regressions,
+        "ok": matched > 0 and not regressions,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--record", action="store_true",
+                    help="write current rig costs as the new baseline")
+    ap.add_argument("--check", action="store_true",
+                    help="gate current rig costs against the baseline")
+    ap.add_argument("--baseline", default=BASELINE_PATH,
+                    help="baseline json to gate against")
+    ap.add_argument("--expect-regression", action="store_true",
+                    help="invert the verdict: exit 0 only when the gate "
+                         "DOES flag a regression (fixture counter-proof)")
+    ap.add_argument("--factor", type=float, default=GATE_FACTOR)
+    args = ap.parse_args()
+
+    rows = build_rig_rows()
+    if not rows:
+        print("program gate: rig produced no cost rows", file=sys.stderr)
+        return 1
+
+    if args.record:
+        with open(BASELINE_PATH, "w") as f:
+            json.dump(rows, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"recorded {len(rows)} program baselines to "
+              f"{BASELINE_PATH}", file=sys.stderr)
+        return 0
+
+    if not args.check:
+        print(json.dumps(rows, indent=2, sort_keys=True))
+        return 0
+
+    if not os.path.exists(args.baseline):
+        print(f"no baseline at {args.baseline}; run --record first",
+              file=sys.stderr)
+        return 1
+    with open(args.baseline) as f:
+        baseline = json.load(f)
+    verdict = compare(rows, baseline, factor=args.factor)
+    print(json.dumps({k: v for k, v in verdict.items()
+                      if k != "regressions"}, indent=2))
+    if verdict["regressions"]:
+        print("PROGRAM COST REGRESSIONS:\n"
+              + "\n".join(verdict["regressions"]), file=sys.stderr)
+    if args.expect_regression:
+        if verdict["regressions"]:
+            print("counter-proof ok: planted regression flagged",
+                  file=sys.stderr)
+            return 0
+        print("counter-proof FAILED: planted regression NOT flagged",
+              file=sys.stderr)
+        return 1
+    if not verdict["ok"]:
+        if verdict["matched"] == 0:
+            print("program gate: no baseline keys matched the rig — "
+                  "re-record the baseline", file=sys.stderr)
+        return 1
+    print("program perf gate passed", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -53,6 +53,12 @@ class XrefConfig:
         # deploy/k8s only: the Envoy configs under deploy/envoy use
         # llm_* as LISTENER/CLUSTER names, not metric series
         ("deploy", os.path.join("deploy", "k8s"), (".yaml", ".yml")),
+        # perf-regression gate + bench harness: the llm_program_*
+        # roofline series are consumed there too, and a gate comparing
+        # a series nobody exports is the same silent failure as an
+        # empty dashboard panel
+        ("perf", "perf", (".py",)),
+        ("bench", "bench.py", (".py",)),
     )
 
 

@@ -1268,6 +1268,16 @@ class RouterConfig:
     #       enabled: true          # always-on device-step sampler +
     #                              # process gauges (llm_runtime_*)
     #       interval_s: 10         # sampler flush/gauge period
+    #     programstats:
+    #       enabled: true          # XLA program-cost catalog: compile
+    #                              # sites register deferred cost
+    #                              # captures (llm_program_* rooflines,
+    #                              # GET /debug/programs)
+    #       slo_capture:
+    #         enabled: true        # a firing SLO alert arms ONE bounded
+    #                              # profiler trace + catalog snapshot
+    #         trace_s: 2.0         # bounded trace duration
+    #         cooldown_s: 300      # min seconds between captures
     #     slo:
     #       enabled: true          # in-process burn-rate monitors
     #       evaluation_interval_s: 10
@@ -1314,6 +1324,27 @@ class RouterConfig:
             interval = 10.0
         return {"enabled": bool(rs.get("enabled", True)),
                 "interval_s": interval}
+
+    def programstats_config(self) -> Dict[str, Any]:
+        """Normalized observability.programstats block: the XLA
+        program-cost catalog (on by default — capture is deferred, so
+        the hot path only pays an abstract-shape insert) and the
+        SLO-burn-triggered capture arm (bounded trace + snapshot)."""
+        ps = (self.observability or {}).get("programstats", {}) or {}
+        cap = ps.get("slo_capture", {}) or {}
+        try:
+            trace_s = float(cap.get("trace_s", 2.0))
+        except (TypeError, ValueError):
+            trace_s = 2.0
+        try:
+            cooldown_s = float(cap.get("cooldown_s", 300.0))
+        except (TypeError, ValueError):
+            cooldown_s = 300.0
+        return {"enabled": bool(ps.get("enabled", True)),
+                "slo_capture": {
+                    "enabled": bool(cap.get("enabled", True)),
+                    "trace_s": max(0.0, trace_s),
+                    "cooldown_s": max(0.0, cooldown_s)}}
 
     def slo_config(self) -> Dict[str, Any]:
         """The observability.slo block, passed verbatim to

@@ -253,7 +253,8 @@ class InferenceEngine:
     """Owner of all TPU-served classifier tasks + the batching shim."""
 
     def __init__(self, cfg: Optional[InferenceEngineConfig] = None,
-                 metrics=None, events=None, runtime_stats=None) -> None:
+                 metrics=None, events=None, runtime_stats=None,
+                 program_stats=None) -> None:
         self.cfg = cfg or InferenceEngineConfig()
         self._tasks: Dict[str, _Task] = {}
         self._lock = threading.Lock()
@@ -269,6 +270,15 @@ class InferenceEngine:
 
             runtime_stats = default_runtime_stats
         self._runtime_stats = runtime_stats
+        # XLA program-cost catalog (observability.programstats): fresh
+        # compile sites register a deferred lower-thunk keyed like the
+        # census; the AOT cost capture runs at catalog-read time, so
+        # the hot path only ever pays an abstract-shape dict insert
+        if program_stats is None:
+            from ..observability.programstats import default_program_stats
+
+            program_stats = default_program_stats
+        self._program_stats = program_stats
 
         # serving-side sharded classifier bank (SURVEY §2.4 north-star
         # layout: pjit-sharded bank over a slice): engine.mesh_shape
@@ -724,6 +734,22 @@ class InferenceEngine:
             g.warm_hints = sorted(
                 set(self._parse_census_keys(keys))
                 | {tuple(r) for r in (g.warm_hints or ())})
+            # the census purge's telemetry twin: the old programs no
+            # longer exist, so their runtimestats EWMAs and cost-catalog
+            # rows must go too — without this, repeated hot flips grow
+            # (group, bucket, variant) cardinality without bound and
+            # /debug/runtime keeps reporting dead programs
+            self._retire_programs(group=group)
+
+    def _retire_programs(self, group: Optional[str] = None,
+                         variant_prefix: Optional[str] = None) -> None:
+        """Retire measured + cost rows for rebuilt programs; fail-open
+        (telemetry retirement must never break a hot flip)."""
+        for store in (self._runtime_stats, self._program_stats):
+            try:
+                store.retire(group=group, variant_prefix=variant_prefix)
+            except Exception:
+                pass
 
     def configure_quant(self, knobs: Optional[Dict[str, Any]]) -> None:
         """Apply the engine.quant block (boot + config hot reload):
@@ -1071,7 +1097,28 @@ class InferenceEngine:
         live scheduler + auto-tuner in place — no batcher swap, no
         pending-item loss."""
         pk = normalize_packing(knobs)
+        was_enabled = bool(self._packing.get("enabled"))
         self._packing = pk
+        if was_enabled and not pk["enabled"]:
+            # packing off: the packed programs stop serving.  Purge
+            # their census keys into warm hints (re-enable warms them
+            # back via warmup_packed_hot, same as a rebuild) and retire
+            # their measured/cost rows so repeated enable/disable flips
+            # can't grow label cardinality or report dead packed EWMAs.
+            with self._lock:
+                keys = [k for k in self._compiled_steps
+                        if k[1].startswith("packed:")]
+                self._compiled_steps -= set(keys)
+            by_group: Dict[str, List[tuple]] = {}
+            for k in keys:
+                by_group.setdefault(k[0], []).append(k)
+            for g in list(self._groups_by_gid.values()):
+                gkeys = by_group.get(f"trunk:{g.gid}")
+                if gkeys:
+                    g.warm_hints = sorted(
+                        set(self._parse_census_keys(gkeys))
+                        | {tuple(r) for r in (g.warm_hints or ())})
+            self._retire_programs(variant_prefix="packed")
         if isinstance(self.batcher, PackingBatcher):
             self.batcher.configure(pk)
         tuner = self._autotuner
@@ -1385,6 +1432,11 @@ class InferenceEngine:
 
         self._note_shape("stacked", (padded_n, bucket))
         fresh = self._step_fresh("stacked", "stacked", (padded_n, bucket))
+        if fresh:
+            self._capture_program(
+                "stacked", bucket, "stacked", (padded_n, bucket),
+                st["apply_fn"], (st["params"], ids_dev, mask_dev),
+                "stacked")
         fwd_t0 = time.perf_counter()
         with trace_span("engine.classify_multi.stacked"):
             logits_by_task = st["apply_fn"](st["params"], ids_dev,
@@ -1837,29 +1889,50 @@ class InferenceEngine:
             else:
                 pair, sfx = (), ""
             want = set(flavors or ("seq", "tok", "both"))
+            meta = fns["meta"]
+            measured = "packed_mesh" if srv_mesh is not None else "packed"
             if bank is not None and "seq" in want:
                 jax.block_until_ready(fns["packed_seq"](
                     tp, bank, ids_dev, mask_dev,
                     pos_dev, seg_dev, row_dev, start_dev, *pair))
-                self._step_fresh(f"trunk:{g.gid}",
-                                 f"packed:seq:{k_eff}{sfx}{msfx}",
-                                 (padded_rows, bucket))
+                if self._step_fresh(f"trunk:{g.gid}",
+                                    f"packed:seq:{k_eff}{sfx}{msfx}",
+                                    (padded_rows, bucket)):
+                    self._capture_program(
+                        f"trunk:{g.gid}", bucket,
+                        f"packed:seq:{k_eff}{sfx}{msfx}",
+                        (padded_rows, bucket), fns["packed_seq"],
+                        (tp, bank, ids_dev, mask_dev, pos_dev, seg_dev,
+                         row_dev, start_dev, *pair), measured, meta)
             if tok_bank is not None and "tok" in want:
                 jax.block_until_ready(fns["packed_tok"](
                     tp, tok_bank, ids_dev, mask_dev,
                     pos_dev, seg_dev))
-                self._step_fresh(f"trunk:{g.gid}",
-                                 f"packed:tok:{k_eff}{msfx}",
-                                 (padded_rows, bucket))
+                if self._step_fresh(f"trunk:{g.gid}",
+                                    f"packed:tok:{k_eff}{msfx}",
+                                    (padded_rows, bucket)):
+                    self._capture_program(
+                        f"trunk:{g.gid}", bucket,
+                        f"packed:tok:{k_eff}{msfx}",
+                        (padded_rows, bucket), fns["packed_tok"],
+                        (tp, tok_bank, ids_dev, mask_dev, pos_dev,
+                         seg_dev), measured, meta)
             if bank is not None and tok_bank is not None \
                     and "both" in want:
                 out = fns["packed_both"](
                     tp, bank, tok_bank, ids_dev, mask_dev,
                     pos_dev, seg_dev, row_dev, start_dev, *pair)
                 jax.block_until_ready(out)
-                self._step_fresh(f"trunk:{g.gid}",
-                                 f"packed:both:{k_eff}{sfx}{msfx}",
-                                 (padded_rows, bucket))
+                if self._step_fresh(f"trunk:{g.gid}",
+                                    f"packed:both:{k_eff}{sfx}{msfx}",
+                                    (padded_rows, bucket)):
+                    self._capture_program(
+                        f"trunk:{g.gid}", bucket,
+                        f"packed:both:{k_eff}{sfx}{msfx}",
+                        (padded_rows, bucket), fns["packed_both"],
+                        (tp, bank, tok_bank, ids_dev, mask_dev, pos_dev,
+                         seg_dev, row_dev, start_dev, *pair),
+                        measured, meta)
             return True
         except Exception:
             return False
@@ -2026,6 +2099,44 @@ class InferenceEngine:
                 group, bucket, variant, rows, padded_rows, seconds,
                 compiled=compiled, tokens_real=tokens_real,
                 tokens_padded=tokens_padded, segments=segments)
+        except Exception:
+            pass
+
+    def _capture_program(self, group: str, bucket: int, variant: str,
+                         shape: tuple, fn, args,
+                         measured_variant: str,
+                         meta: Optional[Dict[str, Any]] = None,
+                         kwargs: Optional[Dict[str, Any]] = None) -> None:
+        """Register a freshly-compiled program with the cost catalog
+        (observability.programstats).  Called exactly where
+        ``_step_fresh`` said the census key is new — the same sites that
+        count an XLA compile.  The hot path only pays a tree_map to
+        ShapeDtypeStructs (no device arrays pinned) plus one dict
+        insert; the AOT ``lower().compile().cost_analysis()`` runs
+        deferred at catalog-read time.  Never raises."""
+        ps = self._program_stats
+        if ps is None or not getattr(ps, "enabled", False):
+            return
+        try:
+            abstract = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(jnp.shape(x),
+                                               jnp.result_type(x)),
+                tuple(args))
+            kw = dict(kwargs or {})
+
+            def lower(fn=fn, abstract=abstract, kw=kw):
+                return fn.lower(*abstract, **kw)
+
+            meta = meta or {}
+            kernels = "+".join(k for k in ("epilogue", "bgmv")
+                               if meta.get(k)) or "off"
+            sig = meta.get("mesh")
+            mesh = "x".join(str(s) for s in sig) if sig else "off"
+            ps.note_compile(
+                group, bucket, variant, tuple(shape), lower,
+                measured_variant=measured_variant,
+                quant=str(meta.get("quant") or "off"),
+                kernels=kernels, mesh=mesh)
         except Exception:
             pass
 
@@ -2205,6 +2316,13 @@ class InferenceEngine:
 
             if t.kind == "embedding":
                 p = items[0].payload
+                if fresh:
+                    self._capture_program(
+                        f"task:{task_name}", bucket, "split",
+                        (padded_n, bucket), t.apply_fn,
+                        (t.params, ids_dev, mask_dev), "split",
+                        kwargs={"exit_layer": p.exit_layer,
+                                "output_dim": p.output_dim})
                 fwd_t0 = time.perf_counter()
                 with trace_span(f"engine.embed.{t.name}"), fwd_cm:
                     emb = t.apply_fn(t.params, ids_dev, mask_dev,
@@ -2218,6 +2336,11 @@ class InferenceEngine:
                                                   path="traditional")
                 return [emb[i] for i in range(n)]
 
+            if fresh:
+                self._capture_program(
+                    f"task:{task_name}", bucket, "split",
+                    (padded_n, bucket), t.apply_fn,
+                    (t.params, ids_dev, mask_dev), "split")
             fwd_t0 = time.perf_counter()
             with trace_span(f"engine.classify.{t.name}"), fwd_cm:
                 logits = t.apply_fn(t.params, ids_dev, mask_dev)
@@ -2545,6 +2668,28 @@ class InferenceEngine:
                                      f"{variant}:{flavor}{pair_sfx}"
                                      f"{msfx}",
                                      (padded_n, bucket))
+            if fresh and not detailed:
+                # fenced-split detailed programs are a sampling artifact,
+                # not a serving program — the cost catalog only carries
+                # what the hot path runs
+                if flavor == "seq":
+                    cap_fn = fns["seq"]
+                    cap_args = (tparams, bank, ids_dev, mask_dev)
+                    if use_bgmv:
+                        cap_args += (pr_dev, pt_dev)
+                elif flavor == "tok":
+                    cap_fn = fns["tok"]
+                    cap_args = (tparams, tok_bank, ids_dev, mask_dev)
+                else:
+                    cap_fn = fns["both"]
+                    cap_args = (tparams, bank, tok_bank, ids_dev,
+                                mask_dev)
+                    if use_bgmv:
+                        cap_args += (pr_dev, pt_dev)
+                self._capture_program(
+                    f"trunk:{gid}", bucket,
+                    f"{variant}:{flavor}{pair_sfx}{msfx}",
+                    (padded_n, bucket), cap_fn, cap_args, variant, meta)
             tokens_real = sum(min(len(it.payload.encoding), bucket)
                               for it in uniq_items)
             seq_logits = tok_logits = None
@@ -2727,6 +2872,30 @@ class InferenceEngine:
                                      f"packed:{flavor}:{k_pad}"
                                      f"{pair_sfx}{msfx}",
                                      (padded_rows, bucket))
+            if fresh:
+                if flavor == "seq":
+                    cap_fn = fns["packed_seq"]
+                    cap_args = (tparams, bank, ids_dev, mask_dev,
+                                pos_dev, seg_dev, seg_row, seg_start)
+                    if use_bgmv:
+                        cap_args += (pr_dev, pt_dev)
+                elif flavor == "tok":
+                    cap_fn = fns["packed_tok"]
+                    cap_args = (tparams, tok_bank, ids_dev, mask_dev,
+                                pos_dev, seg_dev)
+                else:
+                    cap_fn = fns["packed_both"]
+                    cap_args = (tparams, bank, tok_bank, ids_dev,
+                                mask_dev, pos_dev, seg_dev, seg_row,
+                                seg_start)
+                    if use_bgmv:
+                        cap_args += (pr_dev, pt_dev)
+                self._capture_program(
+                    f"trunk:{gid}", bucket,
+                    f"packed:{flavor}:{k_pad}{pair_sfx}{msfx}",
+                    (padded_rows, bucket), cap_fn, cap_args,
+                    "packed_mesh" if srv_mesh is not None else "packed",
+                    meta)
             seq_logits = tok_logits = None
             fwd_t0 = time.perf_counter()
             with trace_span(f"engine.classify.packed.{gid}"):

@@ -99,6 +99,7 @@ def make_shared_trunk_engine(
     fuse: Optional[bool] = None,
     metrics=None,
     runtime_stats=None,
+    program_stats=None,
 ) -> InferenceEngine:
     """Engine whose sequence tasks share ONE ModernBERT trunk — the fused
     classifier-bank shape.  The trunk initializes once; every task's param
@@ -124,7 +125,8 @@ def make_shared_trunk_engine(
     cfg = engine_cfg or InferenceEngineConfig(
         max_batch_size=8, max_wait_ms=1.0, seq_len_buckets=[32, 128, 512])
     engine = InferenceEngine(cfg, metrics=metrics,
-                             runtime_stats=runtime_stats)
+                             runtime_stats=runtime_stats,
+                             program_stats=program_stats)
     tok = HashTokenizer(vocab_size=TINY["vocab_size"])
     key = jax.random.PRNGKey(seed)
     dummy = jnp.ones((1, 8), jnp.int32)

@@ -61,6 +61,13 @@ class FlightRecorder:
         # threshold-breaching / slowest-N traces are force-kept by the
         # sampler, not just recorded locally
         self.on_retain: Optional[Callable[[str], None]] = None
+        # SLO-burn capture cross-link (observability.programstats): the
+        # capture controller registers its link provider here so dumps
+        # point at the bounded profiler traces + catalog snapshots taken
+        # AT the burn — one incident bundle, not three disjoint debug
+        # endpoints
+        self.capture_provider: Optional[
+            Callable[[], List[Dict[str, Any]]]] = None
 
     def configure(self, slowest_n: Optional[int] = None,
                   threshold_s: Optional[float] = None,
@@ -139,7 +146,7 @@ class FlightRecorder:
         with self._lock:
             slowest = [r for _, _, r in
                        sorted(self._slowest, key=lambda e: -e[0])]
-            return {
+            out = {
                 "slowest_n": self.slowest_n,
                 "threshold_s": self.threshold_s,
                 "considered": self.considered,
@@ -147,6 +154,14 @@ class FlightRecorder:
                 "slowest": slowest,
                 "breaches": list(self._breaches),
             }
+        provider = self.capture_provider
+        if provider is not None:
+            # outside the lock: the provider reads another subsystem
+            try:
+                out["slo_captures"] = provider()
+            except Exception:
+                pass
+        return out
 
     def clear(self) -> None:
         with self._lock:

@@ -486,6 +486,74 @@ def upstreams() -> Dict:
                       p, tags=["resilience", "upstreams"])
 
 
+_PROGRAMS_MD = """\
+**Program-level performance observatory** (docs/OBSERVABILITY.md
+§ Program catalog & roofline): every compiled XLA program the engine
+serves — fused, packed, quantized, bgmv/epilogue-kernel, mesh-sharded —
+is cost-accounted at compile time (`cost_analysis()` flops/bytes,
+`memory_analysis()` peak HBM) and joined with the measured warm-step
+EWMAs into achieved-FLOP/s and roofline fractions against the device
+peak table (v5e/v5p/v6e tiers; CPU rows use a placeholder tier and say
+so).
+
+- `GET /debug/programs` — the full catalog: cost-model + measured rows
+  per `(group, bucket, variant, quant, kernels, mesh)` key
+- `make perfgate` — compares the live catalog against the pinned
+  baseline in `perf/program_baseline.json`; a cost regression ≥ the
+  gate factor fails CI
+- SLO burn fires a bounded profiler trace + catalog snapshot
+  automatically (`slo_capture` knob), cross-linked from
+  `GET /debug/flightrec`
+"""
+
+
+def programs() -> Dict:
+    """The "Programs" dashboard: per-program cost-model gauges and the
+    roofline fraction each variant achieves, next to the measured step
+    time the fractions are computed from."""
+    p = [
+        _stat("Programs in catalog",
+              "count(llm_program_flops)",
+              panel_id=1, x=0, y=0),
+        _stat("Best roofline fraction",
+              "max(llm_program_roofline_fraction)",
+              unit="percentunit", panel_id=2, x=6, y=0),
+        _stat("Worst roofline fraction",
+              "min(llm_program_roofline_fraction)",
+              unit="percentunit", panel_id=3, x=12, y=0),
+        _stat("Peak HBM (largest program)",
+              "max(llm_program_hbm_peak_bytes)",
+              unit="bytes", panel_id=4, x=18, y=0),
+        _panel("Roofline fraction by variant",
+               ["max(llm_program_roofline_fraction) by (variant, quant, "
+                "kernels, mesh)"],
+               unit="percentunit", panel_id=5, x=0, y=4,
+               legends=["{{variant}} q={{quant}} k={{kernels}} "
+                        "m={{mesh}}"]),
+        _panel("Cost-model FLOPs by program",
+               ["max(llm_program_flops) by (group, bucket, variant)"],
+               panel_id=6, x=12, y=4,
+               legends=["{{group}}/{{bucket}} {{variant}}"]),
+        _panel("Bytes accessed by program",
+               ["max(llm_program_bytes) by (group, bucket, variant)"],
+               unit="bytes", panel_id=7, x=0, y=12,
+               legends=["{{group}}/{{bucket}} {{variant}}"]),
+        _panel("Peak HBM by program",
+               ["max(llm_program_hbm_peak_bytes) by (group, bucket, "
+                "variant)"],
+               unit="bytes", panel_id=8, x=12, y=12,
+               legends=["{{group}}/{{bucket}} {{variant}}"]),
+        _panel("Measured step time by group (p95)",
+               ["histogram_quantile(0.95, sum(rate("
+                "llm_runtime_step_seconds_bucket[5m])) by (le, group))"],
+               unit="s", panel_id=9, x=0, y=20, legends=["{{group}}"]),
+        _text_panel("Program catalog & perf gate", _PROGRAMS_MD,
+                    panel_id=10, x=12, y=20),
+    ]
+    return _dashboard("srt-programs", "Semantic Router — Programs",
+                      p, tags=["programs", "roofline"])
+
+
 def catalog(registry=None) -> Dict:
     """Auto-generated dashboard: one panel per registered series —
     anything new in the registry shows up here without template edits."""
@@ -542,6 +610,7 @@ def render_all(out_dir: str, registry=None) -> List[str]:
         "resilience.json": resilience(),
         "flywheel.json": flywheel(),
         "upstreams.json": upstreams(),
+        "programs.json": programs(),
         "metric_catalog.json": catalog(registry),
     }
     for fname, dash in dashboards.items():

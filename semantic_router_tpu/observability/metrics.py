@@ -58,6 +58,14 @@ class Counter:
     def get(self, **labels: str) -> float:
         return self._values.get(_label_key(labels), 0.0)
 
+    def remove(self, **labels: str) -> bool:
+        """Drop ONE labeled sample.  Program/series retirement (hot
+        quant/kernel/mesh flips rebuild jit programs) must also shrink
+        exposition — a gauge row describing a dead program is a lie the
+        scraper keeps reading forever."""
+        with self._lock:
+            return self._values.pop(_label_key(labels), None) is not None
+
     def values(self) -> Dict[tuple, float]:
         """Snapshot of all labeled values (dashboard aggregation)."""
         with self._lock:

@@ -48,6 +48,20 @@ _STEP_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
 _COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
                     30.0, 60.0, 120.0)
 
+# llm_device_memory_bytes mapping: canonical stat label → accepted
+# ``memory_stats()`` key spellings, first present wins.  PJRT backends
+# disagree on spelling across runtimes/versions (TPU libtpu reports the
+# canonical trio; some builds only expose the reservable limit or pool
+# peaks), and CPU reports nothing at all (``memory_stats() is None``) —
+# the table keeps the gauge honest per backend instead of hardcoding
+# one runtime's names.
+DEVICE_MEMORY_STATS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    ("bytes_in_use", ("bytes_in_use",)),
+    ("bytes_limit", ("bytes_limit", "bytes_reservable_limit",
+                     "pool_bytes")),
+    ("peak_bytes_in_use", ("peak_bytes_in_use", "peak_pool_bytes")),
+)
+
 
 @dataclass
 class ProgramStats:
@@ -300,6 +314,28 @@ class RuntimeStats:
                     continue
         return queues
 
+    def device_memory_row(self, d) -> Dict[str, Any]:
+        """Publish one device's ``memory_stats()`` through the
+        DEVICE_MEMORY_STATS spelling table and return the report row.
+        A backend without memory stats (CPU: ``memory_stats() is None``)
+        yields the identity row only — the gauge stays empty rather than
+        publishing zeros that read as 'no memory in use'."""
+        row: Dict[str, Any] = {"device": str(getattr(d, "id", "?")),
+                               "platform": getattr(d, "platform", "")}
+        try:
+            ms = d.memory_stats() or {}
+        except Exception:
+            ms = {}
+        for stat, spellings in DEVICE_MEMORY_STATS:
+            for spelling in spellings:
+                if spelling in ms:
+                    self.device_memory.set(
+                        float(ms[spelling]), device=row["device"],
+                        stat=stat)
+                    row[stat] = int(ms[spelling])
+                    break
+        return row
+
     @staticmethod
     def _read_rss_bytes() -> float:
         try:
@@ -334,20 +370,7 @@ class RuntimeStats:
             import jax
 
             for d in jax.local_devices():
-                try:
-                    ms = d.memory_stats() or {}
-                except Exception:
-                    ms = {}
-                row = {"device": str(getattr(d, "id", "?")),
-                       "platform": getattr(d, "platform", "")}
-                for stat in ("bytes_in_use", "bytes_limit",
-                             "peak_bytes_in_use"):
-                    if stat in ms:
-                        self.device_memory.set(
-                            float(ms[stat]), device=row["device"],
-                            stat=stat)
-                        row[stat] = int(ms[stat])
-                devices.append(row)
+                devices.append(self.device_memory_row(d))
         except Exception:
             pass  # no jax / no backend: host gauges still report
         sample["devices"] = devices
@@ -467,6 +490,26 @@ class RuntimeStats:
         self.flush()
         with self._lock:
             return [p.snapshot() for _, p in sorted(self._programs.items())]
+
+    def retire(self, group: Optional[str] = None,
+               variant_prefix: Optional[str] = None) -> int:
+        """Drop program rows a hot flip just invalidated (quant / kernel
+        / mesh rebuilds retire a trunk group; a packing disable retires
+        every ``packed*`` variant).  The census purge in
+        ``engine/classify.py`` calls this in the same breath — without
+        it, repeated flips grow the (group, bucket, variant) registry
+        and /debug/runtime keeps reporting EWMAs of programs that no
+        longer exist.  Pending samples are flushed first so a dead
+        program's in-flight step can't resurrect its row."""
+        self.flush()
+        with self._lock:
+            keys = [k for k in self._programs
+                    if (group is None or k[0] == group)
+                    and (variant_prefix is None
+                         or k[2].startswith(variant_prefix))]
+            for k in keys:
+                del self._programs[k]
+        return len(keys)
 
     def report(self, sample: bool = True) -> Dict[str, Any]:
         """Operator snapshot for GET /debug/runtime: the program registry

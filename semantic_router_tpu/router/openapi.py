@@ -312,6 +312,13 @@ _META: Dict[tuple, Dict[str, Any]] = {
                    "early-exit cascade block (ordering, per-family "
                    "cost EWMAs, skip counters) when engine.cascade is "
                    "on."},
+    ("GET", "/debug/programs"): {
+        "tag": "debug",
+        "summary": "Program-level performance observatory: per-compiled-"
+                   "program XLA cost analysis (flops, bytes, peak HBM) "
+                   "joined with measured warm-step EWMAs into roofline "
+                   "fractions against the device peak table "
+                   "(docs/OBSERVABILITY.md)."},
     ("GET", "/debug/resilience"): {
         "tag": "debug",
         "summary": "Degradation-ladder snapshot: level, pressure "

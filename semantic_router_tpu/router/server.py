@@ -88,6 +88,7 @@ API_CATALOG = {
         {"path": "/debug/flightrec/clear", "method": "POST"},
         {"path": "/debug/slo", "method": "GET"},
         {"path": "/debug/runtime", "method": "GET"},
+        {"path": "/debug/programs", "method": "GET"},
         {"path": "/debug/resilience", "method": "GET"},
         {"path": "/debug/upstreams", "method": "GET"},
         {"path": "/debug/stateplane", "method": "GET"},
@@ -134,6 +135,53 @@ API_CATALOG = {
         {"path": "/dashboard/static/{asset}", "method": "GET"},
     ],
 }
+
+
+def runtime_debug_report(registry, engine):
+    """Assemble the GET /debug/runtime body: the runtimestats snapshot
+    plus the engine's packing/kernels/mesh blocks and the registry's
+    cascade block.  Block-presence contract (tests drive this function
+    directly across the knob matrix): packing/kernels/mesh are present
+    whenever an engine serves — each block carries its own ``enabled``
+    truth, because "knob off" is a report, not an absence; ``cascade``
+    is present exactly when engine.cascade built an evaluator.  Returns
+    None when the registry has no runtimestats slot (the 503 case)."""
+    rs = registry.get("runtimestats")
+    if rs is None:
+        return None
+    rep = rs.report()
+    # the packing scheduler/auto-tuner state (docs/PACKING.md)
+    if engine is not None and hasattr(engine, "packing_report"):
+        try:
+            rep["packing"] = engine.packing_report()
+        except Exception:
+            pass
+    # per-kernel on/off + quant mode + rebuild count (docs/KERNELS.md):
+    # the serving truth, next to the program registry the knobs act on
+    if engine is not None and hasattr(engine, "kernels_report"):
+        try:
+            rep["kernels"] = engine.kernels_report()
+        except Exception:
+            pass
+    # serving-mesh placement (docs/PARALLEL.md): mesh shape, per-axis
+    # device counts, and which groups serve sharded — read next to the
+    # per-variant step registry so sharded vs unsharded step time is
+    # one page
+    if engine is not None and hasattr(engine, "mesh_report"):
+        try:
+            rep["mesh"] = engine.mesh_report()
+        except Exception:
+            pass
+    # early-exit cascade state (docs/CASCADE.md): submission order,
+    # per-family warm-cost EWMAs, skip counters, planner version —
+    # absent when engine.cascade is off
+    casc = registry.get("cascade")
+    if casc is not None:
+        try:
+            rep["cascade"] = casc.report()
+        except Exception:
+            pass
+    return rep
 
 
 class BackendResolver:
@@ -1180,49 +1228,25 @@ class RouterServer:
                     # compile/execute registry + process/device gauges,
                     # plus the packing scheduler/auto-tuner state when
                     # an engine serves (docs/PACKING.md)
-                    rs = server.registry.get("runtimestats")
-                    if rs is None:
+                    rep = runtime_debug_report(
+                        server.registry,
+                        getattr(server.router, "engine", None))
+                    if rep is None:
                         self._json(503, {"error": "no runtime stats"})
                     else:
-                        rep = rs.report()
-                        eng = getattr(server.router, "engine", None)
-                        if eng is not None and hasattr(eng,
-                                                       "packing_report"):
-                            try:
-                                rep["packing"] = eng.packing_report()
-                            except Exception:
-                                pass
-                        # per-kernel on/off + quant mode + rebuild count
-                        # (docs/KERNELS.md): the serving truth, next to
-                        # the program registry the knobs act on
-                        if eng is not None and hasattr(eng,
-                                                       "kernels_report"):
-                            try:
-                                rep["kernels"] = eng.kernels_report()
-                            except Exception:
-                                pass
-                        # serving-mesh placement (docs/PARALLEL.md):
-                        # mesh shape, per-axis device counts, and which
-                        # groups serve sharded — read next to the
-                        # per-variant step registry so sharded vs
-                        # unsharded step time is one page
-                        if eng is not None and hasattr(eng,
-                                                       "mesh_report"):
-                            try:
-                                rep["mesh"] = eng.mesh_report()
-                            except Exception:
-                                pass
-                        # early-exit cascade state (docs/CASCADE.md):
-                        # submission order, per-family warm-cost EWMAs,
-                        # skip counters, planner version — absent when
-                        # engine.cascade is off
-                        casc = server.registry.get("cascade")
-                        if casc is not None:
-                            try:
-                                rep["cascade"] = casc.report()
-                            except Exception:
-                                pass
                         self._json(200, rep)
+                elif path == "/debug/programs":
+                    # XLA program-cost catalog joined with the warm-step
+                    # EWMAs: per-program flops/bytes/HBM footprint and
+                    # achieved-vs-roofline fractions (docs/
+                    # OBSERVABILITY.md "Program catalog & roofline")
+                    ps = server.registry.get("programstats")
+                    if ps is None:
+                        self._json(503, {"error": "no program catalog"})
+                    else:
+                        self._json(200, ps.report(
+                            runtime_stats=server.registry.get(
+                                "runtimestats")))
                 elif path == "/debug/resilience":
                     # degradation-ladder snapshot: level, pressure
                     # inputs, admission buckets, cost model, transitions

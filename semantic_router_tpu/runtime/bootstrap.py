@@ -126,6 +126,8 @@ def build_engine(cfg: RouterConfig, mock: bool = False, registry=None):
         metrics=registry.metric_series() if registry is not None else None,
         events=registry.events if registry is not None else None,
         runtime_stats=registry.get("runtimestats")
+        if registry is not None else None,
+        program_stats=registry.get("programstats")
         if registry is not None else None)
 
     # Dedup caches: tasks whose specs point at the SAME checkpoint /
@@ -612,6 +614,41 @@ def apply_observability_knobs(cfg: RouterConfig, registry) -> None:
                 rs.stop()
     except Exception as exc:
         component_event("bootstrap", "runtime_stats_config_invalid",
+                        error=str(exc)[:200], level="warning")
+    try:
+        # XLA program-cost catalog (observability.programstats): the
+        # enabled knob gates the engine's compile-site capture hooks;
+        # slo_capture arms the SLO-burn-triggered bounded profiler
+        # trace + catalog snapshot on THIS registry's event bus
+        ps = registry.get("programstats")
+        if ps is not None:
+            ps_cfg = cfg.programstats_config()
+            ps.enabled = ps_cfg["enabled"]
+            cap_cfg = ps_cfg["slo_capture"]
+            ctl = getattr(ps, "slo_capture", None)
+            if ps_cfg["enabled"] and cap_cfg["enabled"]:
+                if ctl is None:
+                    from ..observability.programstats import (
+                        SLOCaptureController,
+                    )
+
+                    ctl = SLOCaptureController(catalog=ps)
+                    ps.slo_capture = ctl
+                # (re)bind to the registry's live slots every apply —
+                # a hot reload may have swapped any of them
+                ctl.runtime_stats = registry.get("runtimestats")
+                ctl.profiler = registry.get("profiler")
+                ctl.flightrec = registry.get("flightrec")
+                ctl.trace_s = cap_cfg["trace_s"]
+                ctl.cooldown_s = cap_cfg["cooldown_s"]
+                fr = registry.get("flightrec")
+                if fr is not None:
+                    fr.capture_provider = ctl.links
+                ctl.attach(registry.get("events"))
+            elif ctl is not None:
+                ctl.detach()
+    except Exception as exc:
+        component_event("bootstrap", "programstats_config_invalid",
                         error=str(exc)[:200], level="warning")
     try:
         # in-process SLO engine (observability.slo): objectives parse

@@ -35,6 +35,10 @@ CONFIG_RELOADED = "config_reloaded"
 # firing instead of only reporting in /debug/slo
 SLO_ALERT_FIRING = "slo_alert_firing"
 SLO_ALERT_RESOLVED = "slo_alert_resolved"
+# SLO-burn-triggered capture (observability/programstats.py): a firing
+# alert armed one bounded profiler trace + a program-catalog snapshot —
+# the event carries the capture id + trace dir for the incident bundle
+SLO_CAPTURE = "slo_capture"
 # degradation-ladder transitions (resilience/controller.py): every level
 # change is a lifecycle event, so operators and the kube controller see
 # the data plane shedding in the same feed the alerts arrive on

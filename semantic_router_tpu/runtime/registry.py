@@ -22,7 +22,12 @@ import threading
 from typing import Any, Dict, Optional
 
 _SLOTS = ("metrics", "tracer", "sessions", "profiler", "events",
-          "flightrec", "runtimestats", "slo", "explain", "resilience",
+          "flightrec", "runtimestats",
+          # XLA program-cost catalog (observability.programstats): the
+          # engine's compile sites feed it, GET /debug/programs and the
+          # perf-regression gate read it
+          "programstats",
+          "slo", "explain", "resilience",
           "engine", "cache", "memory_store", "vectorstores",
           "replay_store",
           # shared state plane (stateplane.StatePlane): empty in the
@@ -63,6 +68,7 @@ class RuntimeRegistry:
         from ..observability.flightrec import default_flight_recorder
         from ..observability.metrics import default_registry
         from ..observability.profiler import default_profiler
+        from ..observability.programstats import default_program_stats
         from ..observability.runtimestats import default_runtime_stats
         from ..observability.session import default_session_telemetry
         from ..observability.slo import default_slo_monitor
@@ -78,6 +84,7 @@ class RuntimeRegistry:
             "events": default_bus,
             "flightrec": default_flight_recorder,
             "runtimestats": default_runtime_stats,
+            "programstats": default_program_stats,
             "slo": default_slo_monitor,
             "explain": default_decision_explainer,
             "resilience": default_degradation_controller,
@@ -101,6 +108,7 @@ class RuntimeRegistry:
         from ..observability.flightrec import FlightRecorder
         from ..observability.metrics import MetricsRegistry
         from ..observability.profiler import ProfilerControl
+        from ..observability.programstats import ProgramCatalog
         from ..observability.runtimestats import RuntimeStats
         from ..observability.session import SessionTelemetry
         from ..observability.slo import SLOMonitor
@@ -122,6 +130,9 @@ class RuntimeRegistry:
             # metrics registry, so embedded routers' llm_runtime_*/
             # llm_slo_* series stay isolated like everything else
             "runtimestats": runtimestats,
+            # per-instance program-cost catalog: an embedded router's
+            # llm_program_* rooflines never mix with another's
+            "programstats": ProgramCatalog(metrics),
             "slo": SLOMonitor(metrics),
             # per-instance decision-record ring: an embedded router's
             # audit trail never mixes with another's

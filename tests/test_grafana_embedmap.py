@@ -50,9 +50,10 @@ class TestGrafana:
         rc = main(["grafana", "--out-dir", str(tmp_path / "g")])
         assert rc == 0
         out = json.loads(capsys.readouterr().out)
-        # 9 curated dashboards (incl. Runtime & SLO, Decisions,
-        # Resilience, Flywheel, and Upstreams) + catalog + provider
-        assert len(out["rendered"]) == 11
+        # 10 curated dashboards (incl. Runtime & SLO, Decisions,
+        # Resilience, Flywheel, Upstreams, and Programs) + catalog
+        # + provider
+        assert len(out["rendered"]) == 12
 
 
 class TestEmbedMap:
