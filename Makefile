@@ -2,7 +2,8 @@ PY ?= python
 SHELL := /bin/bash
 
 .PHONY: test test-fast tier1 trace-smoke metrics-lint explain-smoke \
-	resilience-smoke fleet-smoke flywheel-smoke upstream-smoke \
+	resilience-smoke fleet-smoke fleetobs-smoke flywheel-smoke \
+	upstream-smoke \
 	packing-smoke kernels-smoke mesh-smoke cascade-smoke profile-smoke \
 	analyze native bench \
 	bench-replay perf perf-record perfgate perfgate-record serve-mock clean
@@ -78,6 +79,20 @@ fleet-smoke:
 	  tests/test_stateplane.py \
 	  tests/test_stateplane_chaos.py \
 	  "tests/test_packing.py::TestPackingLoad" -q -p no:cacheprovider
+
+# fleet-observability gate (docs/OBSERVABILITY.md "Fleet
+# observability"): snapshot wire-format golden byte-stability +
+# version-skew rejection, histogram merge commutativity across
+# divergent bucket layouts, a 3-replica fleet where errors on ONE
+# replica fire the fleet-scoped SLO on ALL replicas within one fast
+# window, plane kill degrading every fleet view to a stamped
+# local-fallback with zero request failures (restart re-converges),
+# the /metrics/fleet + /debug/fleet + ?source=fleet HTTP surface, and
+# the default-off posture building nothing.  Tier-1 (runs inside
+# `make tier1` too).
+fleetobs-smoke:
+	env JAX_PLATFORMS=cpu VSR_ANALYZE=1 $(PY) -m pytest \
+	  tests/test_fleetobs.py -q -p no:cacheprovider
 
 # sequence-packing gate (docs/PACKING.md): packer layout + mask/
 # position-id contract, packed-vs-unpacked logits parity (≤1e-4) across

@@ -942,6 +942,113 @@ def _measure_stateplane_overhead(platform: str) -> dict:
         engine.shutdown()
 
 
+def _measure_fleetobs(platform: str) -> dict:
+    """Fleet observability arm (docs/OBSERVABILITY.md "Fleet
+    observability", ISSUE 19 acceptance): snapshot serialize ns + wire
+    bytes on a realistically-populated registry, merge wall vs member
+    count, the heartbeat-thread delta with the publisher attached, and
+    the publication duty cycle at the default heartbeat cadence — the
+    <1% overhead gate.  Request-path cost is zero by construction
+    (publication rides the heartbeat thread; aggregation is read-time),
+    so the gate bounds the heartbeat thread's duty cycle instead."""
+    import time as _time
+
+    from semantic_router_tpu.observability.fleetobs import (
+        FleetAggregator,
+        build_fleet_obs,
+    )
+    from semantic_router_tpu.observability.metrics import (
+        MetricsRegistry,
+        encode_snapshot,
+    )
+    from semantic_router_tpu.stateplane import StatePlane, build_backend
+
+    def populate(reg: MetricsRegistry, seed: int) -> None:
+        # a loaded replica's shape: labeled counters, a latency
+        # histogram, the ladder gauge
+        c = reg.counter("llm_model_requests_total", "requests")
+        for m in range(8):
+            c.inc(seed + m, model=f"model-{m}", decision=f"d{m % 4}")
+        h = reg.histogram("llm_model_routing_latency_seconds",
+                          "routing latency")
+        for i in range(128):
+            h.observe(0.0005 * ((seed + i) % 64), model=f"model-{i % 8}")
+        reg.gauge("llm_degradation_level", "ladder level").set(
+            float(seed % 4))
+
+    reg = MetricsRegistry()
+    populate(reg, 1)
+
+    # snapshot + encode cost (what each publication pays up front)
+    iters = 200
+    t0 = _time.perf_counter_ns()
+    raw = b""
+    for _ in range(iters):
+        raw = encode_snapshot(reg.snapshot())
+    serialize_ns = (_time.perf_counter_ns() - t0) / iters
+
+    # merge wall vs member count (what each /metrics/fleet scrape or
+    # fleet SLO tick pays on a cache miss)
+    merge_ms: dict = {}
+    for n in (2, 4, 8):
+        snaps = []
+        for i in range(n):
+            r = MetricsRegistry()
+            populate(r, i + 1)
+            snaps.append(r.snapshot())
+        t0 = _time.perf_counter()
+        rounds = 20
+        for _ in range(rounds):
+            merged = MetricsRegistry()
+            for s in snaps:
+                merged.merge_snapshot(s)
+        merge_ms[str(n)] = round(
+            (_time.perf_counter() - t0) / rounds * 1e3, 4)
+
+    # heartbeat-thread delta: beats/s with and without the publisher
+    # attached (memory backend — the plane cost itself nets out)
+    plane = StatePlane(build_backend({"backend": "memory"}),
+                       replica_id="bench-fleet")
+    beats = 200
+    t0 = _time.perf_counter()
+    for _ in range(beats):
+        plane.heartbeat_once()
+    plain_ms = (_time.perf_counter() - t0) / beats * 1e3
+    fobs = build_fleet_obs(
+        {"publish_interval_s": 0.0, "cache_s": 0.0, "debug_top_n": 8},
+        plane, reg)
+    plane.add_publisher(fobs.publisher.maybe_publish)
+    t0 = _time.perf_counter()
+    for _ in range(beats):
+        plane.heartbeat_once()
+    publishing_ms = (_time.perf_counter() - t0) / beats * 1e3
+    publish_ms = max(0.0, publishing_ms - plain_ms)
+
+    # aggregation read cost over the published member (cache off)
+    agg = FleetAggregator(plane, reg, cache_s=0.0)
+    t0 = _time.perf_counter()
+    for _ in range(50):
+        agg.collect(force=True)
+    collect_ms = (_time.perf_counter() - t0) / 50 * 1e3
+
+    # duty cycle at the default cadence (publish every heartbeat,
+    # heartbeat_s=2.0): fraction of one core the publication consumes
+    duty_pct = publish_ms / 1e3 / 2.0 * 100.0
+    plane.close()
+    return {
+        "snapshot_serialize_ns": round(serialize_ns, 1),
+        "snapshot_bytes": len(raw),
+        "merge_ms_by_members": merge_ms,
+        "heartbeat_ms_plain": round(plain_ms, 4),
+        "heartbeat_ms_publishing": round(publishing_ms, 4),
+        "publish_ms_per_beat": round(publish_ms, 4),
+        "collect_ms": round(collect_ms, 4),
+        "duty_cycle_pct_at_default_cadence": round(duty_pct, 4),
+        "overhead_gate_pct": 1.0,
+        "overhead_ok": bool(duty_pct < 1.0),
+    }
+
+
 def _measure_tracing_overhead(platform: str) -> dict:
     """signals/s through the tiny shared-trunk ENGINE (batcher + fused
     trunk group — the path batch tracing instruments) under three tracing
@@ -1899,6 +2006,18 @@ def _run_bench(platform: str) -> None:
         sys.stderr.write(f"bench: stateplane arm failed "
                          f"({type(exc).__name__}: {exc}); skipped\n")
 
+    # fleet-observability arm (docs/OBSERVABILITY.md "Fleet
+    # observability", ISSUE 19 acceptance): snapshot serialize ns,
+    # merge wall per member count, heartbeat-thread publication delta,
+    # and the <1% duty-cycle gate at the default cadence.
+    fleetobs_row = None
+    try:
+        fleetobs_row = _measure_fleetobs(platform)
+        sys.stderr.write(f"bench: fleetobs {fleetobs_row}\n")
+    except Exception as exc:
+        sys.stderr.write(f"bench: fleetobs arm failed "
+                         f"({type(exc).__name__}: {exc}); skipped\n")
+
     # flywheel arm (docs/FLYWHEEL.md, ISSUE 8): corpus-export rows/s
     # plus the counterfactual candidate-vs-incumbent reward delta over
     # a labeled request stream — the closed loop's own perf trajectory.
@@ -2021,6 +2140,8 @@ def _run_bench(platform: str) -> None:
         record["resilience"] = resilience_row
     if stateplane_row is not None:
         record["stateplane"] = stateplane_row
+    if fleetobs_row is not None:
+        record["fleetobs"] = fleetobs_row
     if flywheel_row is not None:
         record["flywheel"] = flywheel_row
     if packing_row is not None:

@@ -1286,6 +1286,17 @@ class RouterConfig:
     #         - signal error-rate < 0.1% over 5m
     #       fast_burn: 14.4        # page pair (w, 12w) threshold
     #       slow_burn: 6.0         # ticket pair (6w, 72w) threshold
+    #     fleet:
+    #       enabled: false         # fleet observability plane
+    #                              # (observability/fleetobs.py) —
+    #                              # requires stateplane.enabled; off
+    #                              # builds nothing
+    #       publish_interval_s: 0  # snapshot publication cadence on the
+    #                              # heartbeat thread (0 = every beat)
+    #       cache_s: 1.0           # read-time merge cache (scrapes +
+    #                              # SLO ticks share one merge)
+    #       debug_top_n: 8         # slowest-N / newest-N summary rows
+    #                              # shipped per replica
 
     def tracing_config(self) -> Dict[str, Any]:
         return dict((self.observability or {}).get("tracing", {}) or {})
@@ -1351,6 +1362,30 @@ class RouterConfig:
         SLOMonitor.configure (which owns parsing + error containment) —
         absent block = no objectives = monitor disabled."""
         return dict((self.observability or {}).get("slo", {}) or {})
+
+    def fleet_obs_config(self) -> Dict[str, Any]:
+        """Normalized observability.fleet block — the fleet
+        observability plane (observability/fleetobs.py).  Default OFF:
+        the disabled posture builds nothing (no publisher on the
+        heartbeat, no llm_fleet_* series, /metrics byte-identical).
+        Only effective when stateplane.enabled is also true — there is
+        no plane to federate over otherwise."""
+        f = (self.observability or {}).get("fleet", {}) or {}
+        out: Dict[str, Any] = {"enabled": bool(f.get("enabled", False))}
+        try:
+            out["publish_interval_s"] = max(
+                0.0, float(f.get("publish_interval_s", 0.0)))
+        except (TypeError, ValueError):
+            out["publish_interval_s"] = 0.0
+        try:
+            out["cache_s"] = max(0.0, float(f.get("cache_s", 1.0)))
+        except (TypeError, ValueError):
+            out["cache_s"] = 1.0
+        try:
+            out["debug_top_n"] = max(1, int(f.get("debug_top_n", 8)))
+        except (TypeError, ValueError):
+            out["debug_top_n"] = 8
+        return out
 
     def decision_explain_config(self) -> Dict[str, Any]:
         """Normalized observability.decisions block — the per-request

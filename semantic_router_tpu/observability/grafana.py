@@ -554,6 +554,60 @@ def programs() -> Dict:
                       p, tags=["programs", "roofline"])
 
 
+_FLEET_MD = (
+    "**Fleet observability plane** (docs/OBSERVABILITY.md \"Fleet "
+    "observability\"): every replica publishes a mergeable snapshot of "
+    "its metric registry to the state plane on the heartbeat thread; "
+    "every replica merges the live members' snapshots into the fleet "
+    "view scraped at `/metrics/fleet` (counters/histograms summed, "
+    "gauges worst-of-fleet).  Fleet-scoped SLO objectives burn against "
+    "the merged counts and export as `llm_fleet_slo_*`.  "
+    "`llm_fleet_local_fallback` = 1 means the state plane is down and "
+    "the view degraded to local-only.  Inspect live state at "
+    "`/debug/fleet`."
+)
+
+
+def fleet() -> Dict:
+    """The "Fleet" dashboard (ISSUE 19): merged-view membership and
+    fallback state, snapshot staleness, fleet-scoped SLO burn — scraped
+    from /metrics/fleet, next to a link panel into /debug/fleet."""
+    p = [
+        _stat("Merged replicas",
+              "max(llm_fleet_members)",
+              panel_id=1, x=0, y=0),
+        _stat("Local fallback",
+              "max(llm_fleet_local_fallback)",
+              panel_id=2, x=6, y=0),
+        _stat("Fleet SLO alerts firing",
+              "sum(llm_fleet_slo_alert_firing) or vector(0)",
+              panel_id=3, x=12, y=0),
+        _stat("Stalest member snapshot",
+              "max(llm_fleet_snapshot_age_seconds)",
+              unit="s", panel_id=4, x=18, y=0),
+        _panel("Snapshot age by replica",
+               ["max(llm_fleet_snapshot_age_seconds) by (replica)"],
+               unit="s", panel_id=5, x=0, y=4,
+               legends=["{{replica}}"]),
+        _panel("Fleet SLO burn rate by objective/window",
+               ["max(llm_fleet_slo_burn_rate) by (objective, window)"],
+               panel_id=6, x=12, y=4,
+               legends=["{{objective}} {{window}}"]),
+        _panel("Fleet SLO good ratio",
+               ["min(llm_fleet_slo_good_ratio) by (objective)"],
+               unit="percentunit", panel_id=7, x=0, y=12,
+               legends=["{{objective}}"]),
+        _panel("State plane membership vs merged view",
+               ["max(llm_stateplane_members)", "max(llm_fleet_members)"],
+               panel_id=8, x=12, y=12,
+               legends=["plane members", "merged snapshots"]),
+        _text_panel("Fleet observability", _FLEET_MD,
+                    panel_id=9, x=0, y=20),
+    ]
+    return _dashboard("srt-fleet", "Semantic Router — Fleet",
+                      p, tags=["fleet", "observability"])
+
+
 def catalog(registry=None) -> Dict:
     """Auto-generated dashboard: one panel per registered series —
     anything new in the registry shows up here without template edits."""
@@ -611,6 +665,7 @@ def render_all(out_dir: str, registry=None) -> List[str]:
         "flywheel.json": flywheel(),
         "upstreams.json": upstreams(),
         "programs.json": programs(),
+        "fleet.json": fleet(),
         "metric_catalog.json": catalog(registry),
     }
     for fname, dash in dashboards.items():

@@ -13,14 +13,48 @@ cache/signal/decision/plugin series).
 
 from __future__ import annotations
 
+import json
 import math
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 _DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                     0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+# fleet-observability wire format (observability/fleetobs.py): bump on
+# any change to the snapshot shape below — the aggregator SKIPS members
+# publishing a different version rather than merging garbage, so a
+# mixed-version fleet mid-rollout degrades to fewer members, never to
+# wrong numbers
+SNAPSHOT_VERSION = 1
+
+
+def encode_snapshot(snap: Dict[str, Any]) -> bytes:
+    """Canonical bytes for a registry snapshot: sorted keys + compact
+    separators, so the same registry state always serializes to the same
+    bytes (tests/test_fleetobs.py pins a golden)."""
+    return json.dumps(snap, sort_keys=True,
+                      separators=(",", ":")).encode()
+
+
+def decode_snapshot(raw: bytes) -> Dict[str, Any]:
+    """Inverse of :func:`encode_snapshot`; raises ValueError on a
+    malformed payload or a version mismatch (callers skip the member)."""
+    snap = json.loads(raw)
+    if not isinstance(snap, dict) \
+            or int(snap.get("v", -1)) != SNAPSHOT_VERSION:
+        raise ValueError(
+            f"unsupported metrics snapshot version "
+            f"{snap.get('v') if isinstance(snap, dict) else None!r} "
+            f"(want {SNAPSHOT_VERSION})")
+    return snap
+
+
+def _pairs_key(pairs: Iterable) -> Tuple[Tuple[str, str], ...]:
+    """Wire label pairs ([[k, v], ...]) back to the registry key form."""
+    return tuple(sorted((str(k), str(v)) for k, v in pairs))
 
 
 def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
@@ -45,6 +79,8 @@ def _help_line(family: str, help_: str) -> List[str]:
 
 
 class Counter:
+    _kind = "counter"
+
     def __init__(self, name: str, help_: str = "") -> None:
         self.name, self.help = name, help_
         self._values: Dict[tuple, float] = {}
@@ -75,6 +111,23 @@ class Counter:
         with self._lock:
             return sum(self._values.values())
 
+    def snapshot(self) -> Dict[str, Any]:
+        """Mergeable wire form: rows of [[label pairs], value], sorted
+        by label key — deterministic ordering is what makes the registry
+        snapshot byte-stable."""
+        with self._lock:
+            return {"kind": self._kind,
+                    "samples": [[[list(p) for p in key], v]
+                                for key, v in sorted(self._values.items())]}
+
+    def merge(self, snap: Dict[str, Any]) -> None:
+        """Fold a sibling replica's snapshot in.  Counters are
+        cumulative, so merge is addition per label set."""
+        for pairs, v in snap.get("samples", []) or []:
+            key = _pairs_key(pairs)
+            with self._lock:
+                self._values[key] = self._values.get(key, 0.0) + float(v)
+
     def expose(self, openmetrics: bool = False) -> List[str]:
         # OpenMetrics declares a counter FAMILY without the _total suffix
         # while its samples keep it ('# TYPE llm_x counter' + 'llm_x_total
@@ -92,9 +145,28 @@ class Counter:
 
 
 class Gauge(Counter):
+    _kind = "gauge"
+
     def set(self, value: float, **labels: str) -> None:
         with self._lock:
             self._values[_label_key(labels)] = value
+
+    def merge(self, snap: Dict[str, Any], mode: str = "max") -> None:
+        """Fold a sibling's gauge snapshot in.  Gauges are last-values,
+        not cumulative, so fleet merge defaults to MAX per label set —
+        the worst-of-fleet read the external-metrics endpoint and shed
+        ladder want (``mode="sum"`` for additive gauges, ``"last"`` to
+        overwrite)."""
+        for pairs, v in snap.get("samples", []) or []:
+            key = _pairs_key(pairs)
+            v = float(v)
+            with self._lock:
+                if mode == "sum":
+                    self._values[key] = self._values.get(key, 0.0) + v
+                elif mode == "max":
+                    self._values[key] = max(self._values.get(key, v), v)
+                else:
+                    self._values[key] = v
 
     def expose(self, openmetrics: bool = False) -> List[str]:
         out = _help_line(self.name, self.help) + \
@@ -217,6 +289,47 @@ class Histogram:
         """Locked snapshot of per-label observation counts."""
         with self._lock:
             return dict(self._totals)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Mergeable wire form.  The snapshot CARRIES its edge vector:
+        ``add_bucket_edge`` mutates bucket layout lazily at read time
+        (objective-aware edges), so two replicas' histograms routinely
+        disagree on layout — without the edges a bucket vector is
+        meaningless to a sibling."""
+        with self._lock:
+            return {"kind": "histogram",
+                    "edges": [float(b) for b in self.buckets],
+                    "samples": [[[list(p) for p in key],
+                                 list(self._counts[key]),
+                                 self._sums.get(key, 0.0),
+                                 int(self._totals.get(key, 0))]
+                                for key in sorted(self._counts)]}
+
+    def merge(self, snap: Dict[str, Any]) -> None:
+        """Fold a sibling's histogram snapshot in, re-bucketing onto the
+        UNION of edge vectors.  Each incoming bucket's count lands in
+        the target bucket ending at the SAME edge (that edge exists
+        exactly after the union insert), so cumulative counts at every
+        incoming edge are preserved and a finer local layout only splits
+        the target's own history — which makes merge(a, b) == merge(b, a)
+        (tests/test_fleetobs.py pins commutativity)."""
+        edges = [float(e) for e in snap.get("edges", []) or []]
+        for e in edges:
+            self.add_bucket_edge(e)  # no-op when already present
+        with self._lock:
+            # exact index of each incoming edge in the unioned layout
+            idx = [self.buckets.index(e) for e in edges]
+            for pairs, counts, sum_, total in snap.get("samples", []) or []:
+                key = _pairs_key(pairs)
+                mine = self._counts.setdefault(
+                    key, [0] * (len(self.buckets) + 1))
+                for i, c in enumerate(counts[:len(idx)]):
+                    if c:
+                        mine[idx[i]] += int(c)
+                if len(counts) > len(idx):  # +Inf overflow slot
+                    mine[-1] += int(counts[-1])
+                self._sums[key] = self._sums.get(key, 0.0) + float(sum_)
+                self._totals[key] = self._totals.get(key, 0) + int(total)
 
     def summary(self) -> Dict[str, float]:
         """Aggregate count/mean/p50/p95/p99 across all label sets
@@ -345,6 +458,48 @@ class MetricsRegistry:
             # server also switches content type + appends '# EOF')
             lines.extend(m.expose(om))  # type: ignore[attr-defined]
         return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Versioned, mergeable snapshot of every registered series —
+        the fleet-observability wire unit each replica publishes to the
+        stateplane (serialize with :func:`encode_snapshot`)."""
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        series: Dict[str, Any] = {}
+        for name, m in metrics:
+            take = getattr(m, "snapshot", None)
+            if take is None:
+                continue
+            row = take()
+            row["help"] = getattr(m, "help", "")
+            series[name] = row
+        return {"v": SNAPSHOT_VERSION, "series": series}
+
+    def merge_snapshot(self, snap: Dict[str, Any],
+                       gauge_mode: str = "max") -> None:
+        """Fold one replica's snapshot into this registry (the fleet
+        aggregator builds a fresh registry and folds every live member
+        in, then exposes it).  A series whose registered kind disagrees
+        with the snapshot's is skipped — never merged as the wrong
+        shape."""
+        for name, fam in (snap.get("series") or {}).items():
+            kind = fam.get("kind")
+            if kind == "counter":
+                m = self.counter(name, fam.get("help", ""))
+                if type(m) is not Counter:  # Gauge subclasses Counter
+                    continue
+                m.merge(fam)
+            elif kind == "gauge":
+                m = self.gauge(name, fam.get("help", ""))
+                if not isinstance(m, Gauge):
+                    continue
+                m.merge(fam, mode=gauge_mode)
+            elif kind == "histogram":
+                m = self.histogram(name, fam.get("help", ""),
+                                   buckets=fam.get("edges") or ())
+                if not isinstance(m, Histogram):
+                    continue
+                m.merge(fam)
 
     def families(self) -> List[Tuple[str, str, str]]:
         """(name, kind, help) for every registered series — the catalog

@@ -129,11 +129,15 @@ class SLOObjective:
     # to label sets carrying every pair — per-model SLOs like
     # routing_latency{model=qwen3-8b} p99 < 25ms
     labels: Dict[str, str] = field(default_factory=dict)
+    # "local" reads this replica's registry; "fleet" reads the MERGED
+    # fleet counts (observability/fleetobs.py FleetAggregator) so one
+    # objective burns against all N replicas' traffic, not 1/N of it
+    scope: str = "local"
 
     def describe(self) -> Dict[str, Any]:
         d = {"name": self.name, "kind": self.kind, "metric": self.metric,
              "budget": self.budget, "window_s": self.window_s,
-             "objective": self.raw}
+             "objective": self.raw, "scope": self.scope}
         if self.kind == "latency":
             d["threshold_s"] = self.threshold_s
         else:
@@ -152,7 +156,25 @@ class SLOObjective:
 
 def parse_objective(spec: Any) -> SLOObjective:
     """Objective from a compact expression string or an explicit dict
-    (``{name?, objective}`` or fully spelled-out fields)."""
+    (``{name?, objective}`` or fully spelled-out fields).  A dict may
+    add ``scope: fleet`` to evaluate over the merged fleet counts."""
+    scope = "local"
+    if isinstance(spec, dict):
+        scope = str(spec.get("scope", "local")).lower() or "local"
+        if scope not in ("local", "fleet"):
+            raise ValueError(f"bad SLO scope {scope!r} "
+                             f"(want local|fleet)")
+    obj = _parse_objective_spec(spec)
+    obj.scope = scope
+    if scope == "fleet" and (not isinstance(spec, dict)
+                             or not spec.get("name")):
+        # auto-generated names get a scope prefix so a fleet objective
+        # never collides with its local twin's ring/alert/gauge rows
+        obj.name = f"fleet:{obj.name}"
+    return obj
+
+
+def _parse_objective_spec(spec: Any) -> SLOObjective:
     name = ""
     if isinstance(spec, dict):
         name = str(spec.get("name", ""))
@@ -262,6 +284,19 @@ class SLOMonitor:
         # operator can REACT (shed traffic / scale), not just report;
         # wired by bootstrap to the registry's bus
         self.event_bus = None
+        # fleet-scoped count source (observability/fleetobs.py): a
+        # callable returning (merged registry, scope) — bootstrap wires
+        # it to FleetAggregator.merged_registry when observability.fleet
+        # is on; None = fleet objectives evaluate locally (stamped
+        # "local-fallback" in reports)
+        self.fleet_source = None
+        # llm_fleet_slo_* gauges are created LAZILY on the first fleet-
+        # scoped tick: with no fleet objectives the families never
+        # register and /metrics stays byte-identical to today
+        self._fleet_gauges: Optional[Tuple] = None
+        # per-objective count provenance for reports: "local", "fleet",
+        # or "local-fallback" (fleet scope degraded to local counts)
+        self._sources: Dict[str, str] = {}
         # snapshot rings are bounded by the 72w horizon AND by count:
         # an aggressive scraper ticking inline must not grow them (and
         # the O(ring) window scans) without bound
@@ -314,6 +349,9 @@ class SLOMonitor:
             for name in list(self._alerts):
                 if name not in keep:
                     del self._alerts[name]
+            for name in list(self._sources):
+                if name not in keep:
+                    del self._sources[name]
         # zero the firing gauge for every series that stops being ticked
         # (renamed/removed objectives, or everything when disabled):
         # the Gauge has no series-removal API, so a latched 1.0 would
@@ -335,9 +373,11 @@ class SLOMonitor:
         for name in names:
             obj = (by_name or {}).get(name)
             extra = obj.gauge_labels() if obj is not None else {}
+            gauge = self.alert_gauge
+            if obj is not None and obj.scope == "fleet":
+                gauge = self._ensure_fleet_gauges()[1]
             for sev in ("fast", "slow"):
-                self.alert_gauge.set(0.0, objective=name, severity=sev,
-                                     **extra)
+                gauge.set(0.0, objective=name, severity=sev, **extra)
 
     def windows_for(self, obj: SLOObjective) -> Dict[str, Any]:
         """The objective's four evaluation windows, derived from its base
@@ -349,10 +389,63 @@ class SLOMonitor:
 
     # -- count reads -------------------------------------------------------
 
+    def _ensure_fleet_gauges(self) -> Tuple:
+        """(burn, alert, sli) gauges for fleet-scoped objectives —
+        llm_fleet_slo_* so fleet pages are distinguishable from local
+        ones in PromQL; created on first use only."""
+        if self._fleet_gauges is None:
+            self._fleet_gauges = (
+                self.registry.gauge(
+                    "llm_fleet_slo_burn_rate",
+                    "Error-budget burn multiple per FLEET-scoped "
+                    "objective and window, evaluated over the merged "
+                    "fleet counts"),
+                self.registry.gauge(
+                    "llm_fleet_slo_alert_firing",
+                    "1 when a fleet-scoped objective's multi-window "
+                    "burn-rate alert fires (every replica converges on "
+                    "the same merged counts)"),
+                self.registry.gauge(
+                    "llm_fleet_slo_good_ratio",
+                    "Fraction of good events per fleet-scoped objective "
+                    "over its base window, fleet-wide"),
+            )
+        return self._fleet_gauges
+
+    def _gauges_for(self, obj: SLOObjective) -> Tuple:
+        if obj.scope == "fleet":
+            return self._ensure_fleet_gauges()
+        return self.burn_gauge, self.alert_gauge, self.sli_gauge
+
+    def firing(self) -> Dict[str, str]:
+        """{objective: severity} for every firing alert — what the
+        fleet publisher ships so siblings' /debug/fleet can union who
+        pages (cheap; never ticks)."""
+        with self._lock:
+            return {n: s.severity for n, s in self._alerts.items()
+                    if s.firing}
+
     def _counts(self, obj: SLOObjective) -> Tuple[float, float]:
         """Cumulative (good, bad) event counts for an objective right
-        now; (0, 0) when the series doesn't exist yet."""
-        find = getattr(self.registry, "find", None)
+        now; (0, 0) when the series doesn't exist yet.  Fleet-scoped
+        objectives read the MERGED fleet registry; when the aggregator
+        is absent or degraded, the local registry stands in and the
+        provenance is stamped "local-fallback"."""
+        registry = self.registry
+        source = "local"
+        if obj.scope == "fleet":
+            source = "local-fallback"
+            src = self.fleet_source
+            if src is not None:
+                try:
+                    merged, scope = src()
+                except Exception:
+                    merged, scope = None, ""
+                if merged is not None and scope == "fleet":
+                    registry, source = merged, "fleet"
+        with self._lock:
+            self._sources[obj.name] = source
+        find = getattr(registry, "find", None)
         if find is None:
             return 0.0, 0.0
         if obj.kind == "latency":
@@ -407,8 +500,12 @@ class SLOMonitor:
             if snap[0] <= cutoff:
                 start = snap
                 break
-        d_good = end[1] - start[1]
-        d_bad = end[2] - start[2]
+        # clamped at zero: LOCAL counters are monotone, but merged
+        # fleet counts regress when a member ages out of the view (its
+        # contribution vanishes) — a negative delta must read as "no
+        # events", not a negative burn
+        d_good = max(0.0, end[1] - start[1])
+        d_bad = max(0.0, end[2] - start[2])
         total = d_good + d_bad
         if total <= 0:
             return 0.0, 0.0
@@ -460,20 +557,22 @@ class SLOMonitor:
                 state.severity = firing
                 state.burn = burns
             # per-objective selector labels ride every llm_slo_* read
-            # (per-model objectives stay distinguishable in PromQL)
+            # (per-model objectives stay distinguishable in PromQL);
+            # fleet-scoped objectives write llm_fleet_slo_* instead
+            burn_gauge, alert_gauge, sli_gauge = self._gauges_for(obj)
             extra = obj.gauge_labels()
             for key, b in burns.items():
-                self.burn_gauge.set(round(b, 4), objective=obj.name,
-                                    window=key, **extra)
+                burn_gauge.set(round(b, 4), objective=obj.name,
+                               window=key, **extra)
             # write EVERY severity series each tick: gauges keyed on a
             # mutable label would otherwise latch the old severity at
             # 1.0 after the alert clears or changes severity
             for sev in ("fast", "slow"):
-                self.alert_gauge.set(1.0 if firing == sev else 0.0,
-                                     objective=obj.name, severity=sev,
-                                     **extra)
-            self.sli_gauge.set(round(1.0 - frac, 6), objective=obj.name,
-                               **extra)
+                alert_gauge.set(1.0 if firing == sev else 0.0,
+                                objective=obj.name, severity=sev,
+                                **extra)
+            sli_gauge.set(round(1.0 - frac, 6), objective=obj.name,
+                          **extra)
             # alert transitions → runtime events (outside the monitor
             # lock: subscribers may call back into the monitor)
             if firing != was_severity or bool(firing) != was_firing:
@@ -496,13 +595,14 @@ class SLOMonitor:
 
             if firing:
                 bus.emit(SLO_ALERT_FIRING, objective=obj.name,
-                         severity=firing, labels=dict(obj.labels),
+                         severity=firing, scope=obj.scope,
+                         labels=dict(obj.labels),
                          burn_rates={k: round(v, 4)
                                      for k, v in burns.items()},
                          objective_raw=obj.raw)
             elif was_firing:
                 bus.emit(SLO_ALERT_RESOLVED, objective=obj.name,
-                         labels=dict(obj.labels))
+                         scope=obj.scope, labels=dict(obj.labels))
         except Exception:
             pass
 
@@ -549,6 +649,12 @@ class SLOMonitor:
                     "severity": state.severity,
                     "since_unix": state.since_unix if state.firing
                     else None,
+                    # count provenance: fleet objectives say whether the
+                    # last tick actually read merged fleet counts or
+                    # degraded to this replica's ("local-fallback")
+                    "source": self._sources.get(
+                        obj.name, "local" if obj.scope == "local"
+                        else "local-fallback"),
                 })
             return {
                 "enabled": self.enabled,

@@ -167,6 +167,7 @@ class DegradationController:
         self._over_ticks = 0
         self._healthy_ticks = 0
         self._firing: Dict[str, str] = {}     # objective -> severity
+        self._alert_scopes: Dict[str, str] = {}  # objective -> scope
         self._engine_down = False
         self._buckets: Dict[str, TokenBucket] = {}
         self._lock = threading.Lock()
@@ -353,10 +354,17 @@ class DegradationController:
                 with self._lock:
                     self._firing[name] = str(
                         ev.detail.get("severity", "fast"))
+                    # fleet-scoped alerts (observability.fleet) step the
+                    # ladder exactly like local ones; the scope is kept
+                    # for /debug/resilience so an operator can tell a
+                    # local burn from a fleet-wide one
+                    self._alert_scopes[name] = str(
+                        ev.detail.get("scope", "local") or "local")
             elif ev.stage == SLO_ALERT_RESOLVED:
                 name = str(ev.detail.get("objective", ""))
                 with self._lock:
                     self._firing.pop(name, None)
+                    self._alert_scopes.pop(name, None)
             elif ev.stage == ENGINE_FAILED:
                 with self._lock:
                     self._engine_down = True
@@ -764,6 +772,7 @@ class DegradationController:
                 "reject_class": PRIORITY_CLASSES[min(
                     self.reject_min_rank, len(PRIORITY_CLASSES) - 1)],
                 "pressure": dict(self._last_pressure),
+                "alert_scopes": dict(self._alert_scopes),
                 "admission_buckets": buckets,
                 "cost_model": self.cost_model.report(),
                 "shed_count": self.shed_count,

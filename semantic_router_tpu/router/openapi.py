@@ -296,7 +296,12 @@ _META: Dict[tuple, Dict[str, Any]] = {
     ("GET", "/debug/flightrec"): {
         "tag": "debug",
         "summary": "Slow-request flight recorder: the retained "
-                   "over-threshold request traces (docs/TRACING.md)."},
+                   "over-threshold request traces (docs/TRACING.md); "
+                   "?source=fleet merges the live siblings' slowest-N "
+                   "summaries.",
+        "params": [{"name": "source", "in": "query",
+                    "schema": {"type": "string",
+                               "enum": ["fleet"]}}]},
     ("POST", "/debug/flightrec/clear"): {
         "tag": "debug", "summary": "Drop the retained flight-recorder "
                                    "traces."},
@@ -327,9 +332,14 @@ _META: Dict[tuple, Dict[str, Any]] = {
     ("GET", "/debug/decisions"): {
         "tag": "debug",
         "summary": "Recent decision records (replay-grade routing "
-                   "audit trail).",
+                   "audit trail); ?source=durable reads the SQLite "
+                   "mirror, ?source=fleet merges the live siblings' "
+                   "newest-record summaries.",
         "params": [{"name": "limit", "in": "query",
-                    "schema": {"type": "integer"}}]},
+                    "schema": {"type": "integer"}},
+                   {"name": "source", "in": "query",
+                    "schema": {"type": "string",
+                               "enum": ["durable", "fleet"]}}]},
     ("GET", "/debug/decisions/{id}"): {
         "tag": "debug", "summary": "One decision record, full detail."},
     ("POST", "/debug/decisions/{id}/replay"): {
@@ -357,11 +367,24 @@ _META: Dict[tuple, Dict[str, Any]] = {
                    "endpoint) circuit-breaker state, EWMA error rate "
                    "and latency, retry-budget fill, and fleet-shared "
                    "open circuits."},
+    ("GET", "/debug/fleet"): {
+        "tag": "debug",
+        "summary": "Fleet observability snapshot: merged-view scope "
+                   "(fleet vs local-fallback), per-replica snapshot "
+                   "staleness, publisher/aggregator health, union of "
+                   "firing fleet SLO alerts."},
     ("GET", "/metrics/external"): {
         "tag": "system", "open": True,
         "summary": "ExternalMetricValueList-shaped scaling signals "
                    "(llm_degradation_level, llm_queue_pressure) for "
                    "KEDA / an HPA external-metrics adapter."},
+    ("GET", "/metrics/fleet"): {
+        "tag": "system", "open": True,
+        "summary": "Fleet-merged Prometheus exposition: the live "
+                   "members' published metric snapshots folded with "
+                   "the local registry (counters/histograms summed, "
+                   "gauges worst-of-fleet), scope and staleness "
+                   "stamped as llm_fleet_* series."},
     ("POST", "/debug/profiler/start"): {
         "tag": "debug", "summary": "Start a JAX profiler trace."},
     ("POST", "/debug/profiler/stop"): {

@@ -92,7 +92,9 @@ API_CATALOG = {
         {"path": "/debug/resilience", "method": "GET"},
         {"path": "/debug/upstreams", "method": "GET"},
         {"path": "/debug/stateplane", "method": "GET"},
+        {"path": "/debug/fleet", "method": "GET"},
         {"path": "/metrics/external", "method": "GET"},
+        {"path": "/metrics/fleet", "method": "GET"},
         {"path": "/debug/decisions", "method": "GET"},
         {"path": "/debug/decisions/{id}", "method": "GET"},
         {"path": "/debug/decisions/{id}/replay", "method": "POST"},
@@ -438,11 +440,19 @@ class RouterServer:
         ``llm_queue_pressure`` first (stable order — KEDA indexes into
         them), then one level row per replica when a state plane is
         attached.  ``metric`` filters (the adapter path's last
-        segment)."""
+        segment).
+
+        When the fleet observability plane is attached, the fleet-wide
+        values come from ONE aggregation point —
+        FleetAggregator.scaling_view (federated llm_degradation_level
+        snapshots + the plane's pressure rows) — instead of a second
+        ad-hoc fleet_pressure read here; behavior-identical, just
+        deduplicated."""
         import datetime as _dt
 
         res = self.registry.get("resilience")
         plane = self.registry.get("stateplane")
+        fobs = self.registry.get("fleetobs")
         level = float(res.level()) if res is not None else 0.0
         pending = 0.0
         if res is not None:
@@ -452,7 +462,16 @@ class RouterServer:
             except Exception:
                 pending = 0.0
         levels: Dict[str, float] = {}
-        if plane is not None:
+        if fobs is not None:
+            try:
+                sv = fobs.aggregator.scaling_view(level, pending)
+                levels = {str(r): float(v)
+                          for r, v in sv["levels"].items()}
+                level = float(sv["level"])
+                pending = float(sv["pending"])
+            except Exception:
+                pass  # fleet view down: serve the local values
+        elif plane is not None:
             try:
                 fleet = plane.fleet_pressure()
                 levels = {str(r): float(v)
@@ -1111,6 +1130,22 @@ class RouterServer:
                     else:
                         self._text(200, reg.expose(),
                                    "text/plain; version=0.0.4")
+                elif path == "/metrics/fleet":
+                    # fleet-merged exposition (open like /metrics): the
+                    # live members' published snapshots + the local
+                    # registry folded in, with scope/staleness stamped
+                    # as llm_fleet_* series.  Merged registries never
+                    # carry exemplars, so this is always classic 0.0.4.
+                    fobs = server.registry.get("fleetobs")
+                    if fobs is None:
+                        self._json(503, {"error": "no fleet "
+                                                  "observability plane "
+                                                  "(observability.fleet"
+                                                  ".enabled is false)"})
+                    else:
+                        text, _ = fobs.aggregator.exposition()
+                        self._text(200, text,
+                                   "text/plain; version=0.0.4")
                 elif path == "/metrics/external" \
                         or path.startswith(
                             "/apis/external.metrics.k8s.io/v1beta1"):
@@ -1213,7 +1248,23 @@ class RouterServer:
                     self._json(200, server.registry.profiler.status())
                 elif path == "/debug/flightrec":
                     # slow-request flight recorder dump: slowest-N +
-                    # threshold breaches with full span trees
+                    # threshold breaches with full span trees;
+                    # ?source=fleet merges the live siblings' published
+                    # slowest-N summaries (full records stay on the
+                    # owning replica)
+                    if self._query().get("source", "") == "fleet":
+                        fobs = server.registry.get("fleetobs")
+                        if fobs is None:
+                            self._json(503, {"error": "no fleet "
+                                                      "observability "
+                                                      "plane "
+                                                      "(observability."
+                                                      "fleet.enabled is "
+                                                      "false)"})
+                            return
+                        self._json(200, fobs.aggregator.flightrec_fleet(
+                            server.flightrec().dump()))
+                        return
                     self._json(200, server.flightrec().dump())
                 elif path == "/debug/slo":
                     # in-process SLO report: objectives, burn rates per
@@ -1268,6 +1319,20 @@ class RouterServer:
                                                   ".enabled is false)"})
                     else:
                         self._json(200, up.report())
+                elif path == "/debug/fleet":
+                    # fleet observability snapshot: merged-view scope +
+                    # per-replica snapshot staleness, publisher/
+                    # aggregator health, union of firing fleet SLO
+                    # alerts (docs/OBSERVABILITY.md "Fleet
+                    # observability")
+                    fobs = server.registry.get("fleetobs")
+                    if fobs is None:
+                        self._json(503, {"error": "no fleet "
+                                                  "observability plane "
+                                                  "(observability.fleet"
+                                                  ".enabled is false)"})
+                    else:
+                        self._json(200, fobs.report())
                 elif path == "/debug/stateplane":
                     # shared-state-plane snapshot: membership, ring
                     # distribution, backend health, fleet pressure
@@ -1294,13 +1359,29 @@ class RouterServer:
                     # decision-record listing, filterable by model /
                     # decision / rule ("type:name") / signal family;
                     # ?source=durable reads the SQLite mirror (records
-                    # that survived a restart) instead of the ring
+                    # that survived a restart) instead of the ring;
+                    # ?source=fleet merges the live siblings' newest
+                    # record summaries (full records by id from the
+                    # owning replica's durable mirror)
                     ex = server.explainer()
                     q = self._query()
                     try:
                         limit = int(q.get("limit", "50") or 50)
                     except ValueError:
                         limit = 50
+                    if q.get("source", "") == "fleet":
+                        fobs = server.registry.get("fleetobs")
+                        if fobs is None:
+                            self._json(503, {"error": "no fleet "
+                                                      "observability "
+                                                      "plane "
+                                                      "(observability."
+                                                      "fleet.enabled is "
+                                                      "false)"})
+                            return
+                        self._json(200, fobs.aggregator.decisions_fleet(
+                            ex.list(limit=limit)))
+                        return
                     if q.get("source", "") == "durable":
                         store = getattr(ex, "durable_store", None)
                         if store is None:

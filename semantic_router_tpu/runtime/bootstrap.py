@@ -719,6 +719,65 @@ def apply_observability_knobs(cfg: RouterConfig, registry) -> None:
         component_event("bootstrap", "decision_explain_config_invalid",
                         error=str(exc)[:200], level="warning")
     try:
+        # fleet observability plane (observability.fleet): metric
+        # federation, fleet-scoped SLO counts, and cross-replica debug
+        # aggregation over the stateplane (observability/fleetobs.py).
+        # Built only when BOTH stateplane.enabled and
+        # observability.fleet.enabled — the default-off posture
+        # constructs nothing, publishes nothing, and /metrics stays
+        # byte-identical.
+        fl_cfg = cfg.fleet_obs_config()
+        plane = registry.get("stateplane")
+        fobs = registry.get("fleetobs")
+        slo = registry.get("slo")
+        if fl_cfg["enabled"] and plane is not None:
+            if fobs is None or fobs.plane is not plane:
+                from ..observability.fleetobs import build_fleet_obs
+
+                if fobs is not None:  # plane was swapped out under us
+                    try:
+                        fobs.plane.remove_publisher(
+                            fobs.publisher.maybe_publish)
+                    except Exception:
+                        pass
+                fobs = build_fleet_obs(
+                    fl_cfg, plane, registry.metrics,
+                    flightrec=registry.get("flightrec"),
+                    explain=registry.get("explain"), slo=slo)
+                plane.add_publisher(fobs.publisher.maybe_publish)
+                registry.swap(fleetobs=fobs)
+                component_event("bootstrap", "fleetobs_attached",
+                                replica=plane.replica_id)
+            else:
+                # hot reload: retune knobs + rebind sinks in place (a
+                # reload may have swapped any of the slots)
+                fobs.publisher.interval_s = fl_cfg["publish_interval_s"]
+                fobs.publisher.debug_top_n = fl_cfg["debug_top_n"]
+                fobs.aggregator.cache_s = fl_cfg["cache_s"]
+                fobs.publisher.flightrec = registry.get("flightrec")
+                fobs.publisher.explain = registry.get("explain")
+                fobs.publisher.slo = slo
+            # fleet-scoped SLO objectives read the merged fleet counts
+            if slo is not None:
+                slo.fleet_source = fobs.aggregator.merged_registry
+        else:
+            if fobs is not None:
+                # reload DISABLE: stop publishing, drop the published
+                # keys, empty the slot — "off" must mean off
+                try:
+                    fobs.plane.remove_publisher(
+                        fobs.publisher.maybe_publish)
+                except Exception:
+                    pass
+                fobs.close()
+                registry.swap(fleetobs=None)
+                component_event("bootstrap", "fleetobs_detached")
+            if slo is not None:
+                slo.fleet_source = None
+    except Exception as exc:
+        component_event("bootstrap", "fleetobs_config_invalid",
+                        error=str(exc)[:200], level="warning")
+    try:
         # overload control (resilience.controller): bind the ladder to
         # THIS registry's sensors (event bus, SLO monitor, runtimestats)
         # and effect surfaces (tracer, explainer), configure the knobs,

@@ -35,6 +35,12 @@ _SLOTS = ("metrics", "tracer", "sessions", "profiler", "events",
           # stateplane.enabled — per-registry, so two embedded routers
           # can ride different planes (or none)
           "stateplane",
+          # fleet observability plane (observability.fleetobs.FleetObs):
+          # empty unless BOTH stateplane.enabled and
+          # observability.fleet.enabled — built by bootstrap, so the
+          # default-off posture constructs nothing and /metrics stays
+          # byte-identical
+          "fleetobs",
           # learned routing flywheel (flywheel.FlywheelController):
           # empty unless flywheel.enabled — built by bootstrap, so the
           # disabled posture constructs nothing

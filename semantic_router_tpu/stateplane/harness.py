@@ -80,6 +80,7 @@ class Replica:
     registry: object
     router: object
     controller: object
+    fleetobs: object = None
 
     def route(self, text: str, **headers) -> object:
         return self.router.route(
@@ -99,6 +100,11 @@ class ReplicaFleet:
     n: int = 3
     cfg: Optional[RouterConfig] = None
     heartbeat_s: float = 0.2
+    # opt-in fleet observability plane per replica (observability.fleet
+    # posture: publisher on the heartbeat, aggregator + fleet-scoped
+    # SLO source wired) — the fleetobs gate drives this
+    fleet_obs: bool = False
+    fleet_obs_cfg: Dict[str, object] = field(default_factory=dict)
     replicas: List[Replica] = field(default_factory=list)
 
     def start(self) -> "ReplicaFleet":
@@ -127,10 +133,27 @@ class ReplicaFleet:
                 plane, embed, similarity_threshold=0.85,
                 local=self._local_cache(embed))
             router.stateplane = plane
+            fobs = None
+            if self.fleet_obs:
+                from ..observability.fleetobs import build_fleet_obs
+
+                fl_cfg = {"publish_interval_s": 0.0, "cache_s": 0.0,
+                          "debug_top_n": 8}
+                fl_cfg.update(self.fleet_obs_cfg)
+                fobs = build_fleet_obs(
+                    fl_cfg, plane, registry.metrics,
+                    flightrec=registry.get("flightrec"),
+                    explain=registry.get("explain"),
+                    slo=registry.get("slo"))
+                plane.add_publisher(fobs.publisher.maybe_publish)
+                registry.swap(fleetobs=fobs)
+                mon = registry.get("slo")
+                if mon is not None:
+                    mon.fleet_source = fobs.aggregator.merged_registry
             plane.start()
             self.replicas.append(Replica(
                 name=name, plane=plane, registry=registry,
-                router=router, controller=controller))
+                router=router, controller=controller, fleetobs=fobs))
         # one settle beat so every replica sees the full membership
         for r in self.replicas:
             try:
@@ -167,6 +190,13 @@ class ReplicaFleet:
                 r.controller.stop()
             except Exception:
                 pass
+            if r.fleetobs is not None:
+                try:
+                    r.plane.remove_publisher(
+                        r.fleetobs.publisher.maybe_publish)
+                    r.fleetobs.close()
+                except Exception:
+                    pass
             try:
                 r.router.shutdown()
             except Exception:

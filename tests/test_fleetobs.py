@@ -1,0 +1,555 @@
+"""Fleet observability gate (ISSUE 19, docs/OBSERVABILITY.md "Fleet
+observability").
+
+1. wire format: golden byte-stability of the versioned snapshot,
+   decode/version-skew rejection, merge commutativity across divergent
+   histogram bucket layouts, counter-sum / gauge-max semantics;
+2. a 3-replica fleet over one shared backend: every replica's merged
+   view sees all members, /metrics/fleet output passes the metrics-lint
+   grammar, and errors driven on ONE replica fire the fleet-scoped SLO
+   alert on ALL replicas within one fast window;
+3. the plane killed mid-run degrades every fleet view to a stamped
+   local-fallback with zero request failures; a restart re-converges;
+4. the external-metrics endpoint reads its fleet values through the
+   FleetAggregator when attached (one aggregation point) and stays
+   behavior-identical to the raw fleet_pressure derivation;
+5. default-off: no fleetobs service is built and /metrics carries no
+   llm_fleet_* series.
+
+CPU-only, engine-free (``make fleetobs-smoke``; runs inside tier-1).
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from semantic_router_tpu.observability.fleetobs import (
+    FleetAggregator,
+    build_fleet_obs,
+)
+from semantic_router_tpu.observability.metrics import (
+    SNAPSHOT_VERSION,
+    MetricsRegistry,
+    decode_snapshot,
+    encode_snapshot,
+)
+from semantic_router_tpu.observability.metrics_lint import lint_exposition
+from semantic_router_tpu.stateplane import GuardedBackend, StatePlane
+from semantic_router_tpu.stateplane.backend import InMemoryStateBackend
+from semantic_router_tpu.stateplane.harness import ReplicaFleet
+
+# the v1 wire format, byte for byte: canonical JSON (sorted keys,
+# compact separators) over the registry snapshot.  If this golden
+# changes, SNAPSHOT_VERSION must bump — a silent re-encoding would make
+# rolling-upgrade fleets drop each other's snapshots as "malformed".
+GOLDEN = (
+    b'{"series":{"llm_demo_level":{"help":"demo gauge","kind":"gauge",'
+    b'"samples":[[[],2.5]]},"llm_demo_seconds":{"edges":[0.1,1.0],'
+    b'"help":"demo histogram","kind":"histogram","samples":'
+    b'[[[],[1,0,1],5.05,2]]},"llm_demo_total":{"help":"demo counter",'
+    b'"kind":"counter","samples":[[[["decision","d"],["model","m"]],'
+    b'3.0]]}},"v":1}'
+)
+
+
+def _golden_registry() -> MetricsRegistry:
+    reg = MetricsRegistry()
+    reg.counter("llm_demo_total", "demo counter").inc(
+        3, model="m", decision="d")
+    reg.gauge("llm_demo_level", "demo gauge").set(2.5)
+    h = reg.histogram("llm_demo_seconds", "demo histogram",
+                      buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(5.0)
+    return reg
+
+
+class TestSnapshotWire:
+    def test_golden_byte_stability(self):
+        assert encode_snapshot(_golden_registry().snapshot()) == GOLDEN
+
+    def test_round_trip(self):
+        snap = decode_snapshot(GOLDEN)
+        assert snap["v"] == SNAPSHOT_VERSION
+        assert set(snap["series"]) == {"llm_demo_total",
+                                       "llm_demo_level",
+                                       "llm_demo_seconds"}
+        merged = MetricsRegistry()
+        merged.merge_snapshot(snap)
+        assert encode_snapshot(merged.snapshot()) == GOLDEN
+
+    def test_version_skew_and_malformed_rejected(self):
+        with pytest.raises(ValueError):
+            decode_snapshot(b'{"v":999,"series":{}}')
+        with pytest.raises(ValueError):
+            decode_snapshot(b'{"series":{}}')
+        with pytest.raises(ValueError):
+            decode_snapshot(b"not json")
+
+    def test_histogram_merge_commutes_across_divergent_edges(self):
+        def regs():
+            a = MetricsRegistry()
+            ha = a.histogram("llm_x_seconds", "x",
+                             buckets=(0.01, 0.1))
+            for v in (0.005, 0.05, 0.5):
+                ha.observe(v)
+            b = MetricsRegistry()
+            hb = b.histogram("llm_x_seconds", "x",
+                             buckets=(0.025, 0.25, 2.5))
+            for v in (0.02, 0.2, 2.0, 20.0):
+                hb.observe(v)
+            return a.snapshot(), b.snapshot()
+
+        sa, sb = regs()
+        ab, ba = MetricsRegistry(), MetricsRegistry()
+        ab.merge_snapshot(sa)
+        ab.merge_snapshot(sb)
+        ba.merge_snapshot(sb)
+        ba.merge_snapshot(sa)
+        assert encode_snapshot(ab.snapshot()) \
+            == encode_snapshot(ba.snapshot())
+        # cumulative counts at every incoming edge are preserved:
+        # at 0.025 only a's 0.005 (<=0.01) and b's 0.02 are provably
+        # at or below — a's 0.05 stays attributed to its 0.1 edge
+        h = ab.find("llm_x_seconds")
+        assert h.le_total(0.025) == (2, 7)
+        # at 0.1: a's 0.005+0.05 plus b's 0.02; at 2.5: a's 0.5 sat in
+        # a's +Inf overflow so only b's 0.02+0.2+2.0 join a's first two
+        assert h.le_total(0.1) == (3, 7)
+        assert h.le_total(2.5) == (5, 7)
+
+    def test_counter_sum_gauge_max(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.counter("llm_y_total", "y").inc(5, model="m")
+        b.counter("llm_y_total", "y").inc(7, model="m")
+        a.gauge("llm_z", "z").set(1.0)
+        b.gauge("llm_z", "z").set(3.0)
+        merged = MetricsRegistry()
+        merged.merge_snapshot(a.snapshot())
+        merged.merge_snapshot(b.snapshot())
+        assert merged.find("llm_y_total").total() == 12.0
+        exp = merged.expose()
+        assert "llm_z 3" in exp
+
+
+class _Killable:
+    """Per-replica proxy over ONE shared in-memory store with one
+    shared kill switch — 'the Redis died' as seen from every pod."""
+
+    def __init__(self, inner, flag):
+        self._inner = inner
+        self._flag = flag
+
+    def __getattr__(self, name):
+        fn = getattr(self._inner, name)
+        if not callable(fn):
+            return fn
+
+        def call(*a, **kw):
+            if self._flag["down"]:
+                raise OSError("state backend down")
+            return fn(*a, **kw)
+
+        return call
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    mem = InMemoryStateBackend()
+    down = {"down": False}
+    fl = ReplicaFleet(
+        backend_factory=lambda: GuardedBackend(_Killable(mem, down),
+                                               cooldown_s=0.1),
+        n=3, heartbeat_s=0.2, fleet_obs=True).start()
+    for r in fl.replicas:
+        mon = r.registry.get("slo")
+        mon.event_bus = r.registry.get("events")
+        mon.configure({"objectives": [
+            {"objective": "signal error-rate < 1% over 0.2s",
+             "scope": "fleet"}]})
+        r.controller.bind(slo=mon)
+    fl.heartbeat_all()
+    yield fl, down
+    fl.stop()
+
+
+class TestFleetConvergence:
+    """Ordered phases over one module-scoped fleet."""
+
+    def test_1_merged_view_sees_every_member(self, fleet):
+        fl, _down = fleet
+        for r in fl.replicas:
+            r.route("what does this contract clause mean")
+        fl.heartbeat_all()
+        names = {r.name for r in fl.replicas}
+        for r in fl.replicas:
+            view = r.fleetobs.aggregator.collect(force=True)
+            assert view["scope"] == "fleet"
+            assert set(view["replicas"]) == names
+            assert not view["skipped"]
+
+    def test_2_metrics_fleet_passes_lint(self, fleet):
+        fl, _down = fleet
+        text, view = fl.replicas[0].fleetobs.aggregator.exposition()
+        assert text.startswith("# fleet-scope: fleet replicas=3\n")
+        assert "llm_fleet_members 3" in text
+        assert "llm_fleet_local_fallback 0" in text
+        assert lint_exposition(text, openmetrics=False) == []
+
+    def test_3_errors_on_one_replica_fire_fleet_slo_on_all(self, fleet):
+        fl, _down = fleet
+        r0 = fl.replicas[0]
+        t0 = 1000.0
+        for r in fl.replicas:
+            r.registry.get("slo").tick(now=t0)  # baseline snapshot
+        # replica-0 alone takes the errors — 50% >> the 1% budget
+        m = r0.registry.metrics
+        m.counter("llm_signal_errors_total",
+                  "signal evaluation failures").inc(50)
+        lat = m.histogram("llm_signal_latency_seconds",
+                          "signal latency")
+        for _ in range(50):
+            lat.observe(0.001)
+        fl.heartbeat_all()  # publish the poisoned snapshot
+        for r in fl.replicas:
+            r.registry.get("slo").tick(now=t0 + 0.3)
+        for r in fl.replicas:
+            mon = r.registry.get("slo")
+            firing = mon.firing()
+            assert firing.get("fleet:signal_error_rate") == "fast", \
+                (r.name, firing)
+            rows = {row["name"]: row for row in mon.report()["objectives"]}
+            assert rows["fleet:signal_error_rate"]["source"] == "fleet"
+        # the alert event reached each replica's OWN controller with
+        # its scope (each monitor fires locally off the merged counts)
+        for r in fl.replicas:
+            rep = r.controller.report()
+            assert rep["alert_scopes"].get(
+                "fleet:signal_error_rate") == "fleet", (r.name, rep)
+        # the llm_fleet_slo_* gauges exist only now (lazy creation)
+        assert r0.registry.metrics.find(
+            "llm_fleet_slo_alert_firing") is not None
+
+    def test_4_plane_kill_degrades_to_stamped_local_fallback(self, fleet):
+        fl, down = fleet
+        down["down"] = True
+        for r in fl.replicas:
+            view = r.fleetobs.aggregator.collect(force=True)
+            assert view["scope"] == "local-fallback"
+            assert set(view["replicas"]) == {r.name}  # self only, live
+            text, _ = r.fleetobs.aggregator.exposition()
+            assert "llm_fleet_local_fallback 1" in text
+            assert lint_exposition(text, openmetrics=False) == []
+            # debug aggregation degrades the same way
+            fr = r.fleetobs.aggregator.flightrec_fleet(
+                r.registry.get("flightrec").dump())
+            assert fr["scope"] == "local-fallback"
+        # zero request failures while the plane is dead
+        for r in fl.replicas:
+            for i in range(5):
+                res = r.route(f"is this contract {i} enforceable")
+                assert res is not None and res.kind in (
+                    "route", "cache_hit")
+        # the SLO monitors stamp their degraded provenance
+        for r in fl.replicas:
+            mon = r.registry.get("slo")
+            mon.tick(now=2000.0)
+            rows = {row["name"]: row for row in mon.report()["objectives"]}
+            assert rows["fleet:signal_error_rate"]["source"] \
+                == "local-fallback"
+
+    def test_5_plane_restart_reconverges(self, fleet):
+        fl, down = fleet
+        down["down"] = False
+        time.sleep(0.15)  # breaker cooldown elapses
+        names = {r.name for r in fl.replicas}
+        deadline = time.time() + 5.0
+        converged = False
+        while time.time() < deadline and not converged:
+            fl.heartbeat_all()
+            converged = all(
+                r.fleetobs.aggregator.collect(force=True)["scope"]
+                == "fleet"
+                and set(r.fleetobs.aggregator.collect()["replicas"])
+                == names
+                for r in fl.replicas)
+            if not converged:
+                time.sleep(0.05)
+        assert converged
+        for r in fl.replicas:
+            mon = r.registry.get("slo")
+            mon.tick(now=3000.0)
+            rows = {row["name"]: row for row in mon.report()["objectives"]}
+            assert rows["fleet:signal_error_rate"]["source"] == "fleet"
+
+
+def _get_json(url, path):
+    with urllib.request.urlopen(url + path, timeout=10) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get_text(url, path):
+    with urllib.request.urlopen(url + path, timeout=10) as resp:
+        return resp.status, resp.read().decode()
+
+
+class TestServerSurface:
+    """/metrics/fleet, /debug/fleet, ?source=fleet, and the unified
+    external-metrics derivation over the real HTTP server."""
+
+    @pytest.fixture()
+    def server(self):
+        from semantic_router_tpu.router.pipeline import Router
+        from semantic_router_tpu.router.server import RouterServer
+        from semantic_router_tpu.runtime.registry import RuntimeRegistry
+        from semantic_router_tpu.stateplane import build_backend
+        from semantic_router_tpu.stateplane.harness import fleet_config
+
+        plane = StatePlane(build_backend({"backend": "memory"}),
+                           replica_id="srv-a", heartbeat_s=0.2)
+        plane.heartbeat_once()
+        registry = RuntimeRegistry.isolated(stateplane=plane)
+        controller = registry.get("resilience")
+        controller.bind(events=registry.get("events"), fleet=plane)
+        cfg = fleet_config()
+        controller.configure(cfg.resilience_config())
+        router = Router(cfg, metrics=registry.metric_series(),
+                        tracer=registry.tracer,
+                        flightrec=registry.get("flightrec"),
+                        explain=registry.get("explain"),
+                        resilience=controller)
+        router.stateplane = plane
+        fobs = build_fleet_obs(
+            {"publish_interval_s": 0.0, "cache_s": 0.0,
+             "debug_top_n": 8},
+            plane, registry.metrics,
+            flightrec=registry.get("flightrec"),
+            explain=registry.get("explain"),
+            slo=registry.get("slo"))
+        plane.add_publisher(fobs.publisher.maybe_publish)
+        registry.swap(fleetobs=fobs)
+        srv = RouterServer(router, cfg, registry=registry).start()
+        yield srv, plane, registry
+        srv.stop()
+        router.shutdown()
+        fobs.close()
+        plane.close()
+
+    @staticmethod
+    def _publish_sibling(plane, level: float, pending: float):
+        """A sibling replica publishing BOTH its pressure row and its
+        metric snapshot, like a live fleet member."""
+        sib = StatePlane(plane.backend, replica_id="srv-b",
+                         namespace=plane.ns, heartbeat_s=0.2)
+        sib.heartbeat_once()
+        sib_reg = MetricsRegistry()
+        sib_reg.gauge("llm_degradation_level",
+                      "ladder level").set(level)
+        sib_reg.counter("llm_model_requests_total",
+                        "requests").inc(9, model="model-large")
+        sib_obs = build_fleet_obs(
+            {"publish_interval_s": 0.0, "cache_s": 0.0,
+             "debug_top_n": 8}, sib, sib_reg)
+        sib_obs.publisher.publish_once()
+        sib.publish_pressure({"level": int(level),
+                              "pending_items": pending})
+        return sib
+
+    def test_metrics_fleet_and_debug_fleet(self, server):
+        srv, plane, registry = server
+        sib = self._publish_sibling(plane, 2.0, 9.0)
+        plane.heartbeat_once()  # publish self + see the sibling
+        try:
+            status, text = _get_text(srv.url, "/metrics/fleet")
+            assert status == 200
+            assert text.startswith("# fleet-scope: fleet replicas=2\n")
+            assert lint_exposition(text, openmetrics=False) == []
+            assert 'llm_model_requests_total{model="model-large"} 9' \
+                in text
+            status, rep = _get_json(srv.url, "/debug/fleet")
+            assert status == 200
+            assert rep["replica_id"] == "srv-a"
+            assert rep["scope"] == "fleet"
+            assert set(rep["replicas"]) == {"srv-a", "srv-b"}
+            assert rep["wire_version"] == SNAPSHOT_VERSION
+            assert rep["publisher"]["publishes"] >= 1
+        finally:
+            sib.close()
+
+    def test_external_metrics_unified_and_behavior_identical(self, server):
+        srv, plane, registry = server
+        sib = self._publish_sibling(plane, 2.0, 9.0)
+        plane.heartbeat_once()
+        try:
+            status, doc = _get_json(srv.url, "/metrics/external")
+            assert status == 200
+            by_name = {}
+            for item in doc["items"]:
+                by_name.setdefault(item["metricName"], []).append(item)
+            fleet_level = [i for i in by_name["llm_degradation_level"]
+                           if i["metricLabels"].get("scope") == "fleet"]
+            pressure = [i for i in by_name["llm_queue_pressure"]
+                        if i["metricLabels"].get("scope") == "fleet"]
+            replicas = {i["metricLabels"].get("replica")
+                        for i in by_name["llm_degradation_level"]
+                        if "replica" in i["metricLabels"]}
+            # identical to the legacy raw-fleet_pressure derivation
+            legacy = plane.fleet_pressure()
+            res = registry.get("resilience")
+            legacy_level = max([float(res.level())]
+                               + [float(v) for v in
+                                  legacy["levels"].values()])
+            assert fleet_level \
+                and float(fleet_level[0]["value"]) == legacy_level == 2.0
+            assert pressure and float(pressure[0]["value"]) \
+                == float(legacy["pending_items"]) == 9.0
+            assert replicas == {"srv-a", "srv-b"}
+        finally:
+            sib.close()
+
+    def test_debug_sources_fleet(self, server):
+        srv, plane, registry = server
+        sib = self._publish_sibling(plane, 1.0, 0.0)
+        plane.heartbeat_once()
+        try:
+            status, doc = _get_json(srv.url,
+                                    "/debug/flightrec?source=fleet")
+            assert status == 200
+            assert doc["scope"] == "fleet"
+            assert set(doc["replicas"]) == {"srv-a", "srv-b"}
+            status, doc = _get_json(srv.url,
+                                    "/debug/decisions?source=fleet")
+            assert status == 200
+            assert doc["scope"] == "fleet"
+            assert "records" in doc
+        finally:
+            sib.close()
+
+    def test_503_and_default_off_posture(self):
+        from semantic_router_tpu.router.pipeline import Router
+        from semantic_router_tpu.router.server import RouterServer
+        from semantic_router_tpu.runtime.registry import RuntimeRegistry
+        from semantic_router_tpu.stateplane.harness import fleet_config
+
+        cfg = fleet_config()
+        registry = RuntimeRegistry.isolated()
+        router = Router(cfg, metrics=registry.metric_series())
+        srv = RouterServer(router, cfg, registry=registry).start()
+        try:
+            assert registry.get("fleetobs") is None
+            for path in ("/metrics/fleet", "/debug/fleet",
+                         "/debug/flightrec?source=fleet",
+                         "/debug/decisions?source=fleet"):
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    urllib.request.urlopen(srv.url + path, timeout=10)
+                assert err.value.code == 503
+            # default off builds nothing and exports nothing: the local
+            # exposition carries no llm_fleet_* series at all
+            status, text = _get_text(srv.url, "/metrics")
+            assert status == 200
+            assert "llm_fleet_" not in text
+        finally:
+            srv.stop()
+            router.shutdown()
+
+
+def _teardown_bootstrap(registry, plane):
+    """apply_observability_knobs starts real worker threads (controller
+    tick loop, plane decision-mirror writer, runtime-stats sampler);
+    the VSR_ANALYZE thread-leak gate pins that we join them all."""
+    for slot, stopper in (("resilience", "stop"), ("slo", "stop"),
+                          ("runtimestats", "stop")):
+        comp = registry.get(slot)
+        if comp is not None:
+            getattr(comp, stopper)()
+    explain = registry.get("explain")
+    if explain is not None:
+        explain.attach_durable(None)
+    plane.close()
+
+
+class TestBootstrapWiring:
+    def test_knob_builds_and_detaches(self):
+        from semantic_router_tpu.config.schema import RouterConfig
+        from semantic_router_tpu.runtime.bootstrap import (
+            apply_observability_knobs,
+        )
+        from semantic_router_tpu.runtime.registry import RuntimeRegistry
+        from semantic_router_tpu.stateplane import build_backend
+
+        plane = StatePlane(build_backend({"backend": "memory"}),
+                           replica_id="boot-a", heartbeat_s=0.2)
+        registry = RuntimeRegistry.isolated(stateplane=plane)
+        cfg = RouterConfig.from_dict({"observability": {"fleet": {
+            "enabled": True, "publish_interval_s": 0.5,
+            "cache_s": 0.25, "debug_top_n": 4}}})
+        try:
+            apply_observability_knobs(cfg, registry)
+            fobs = registry.get("fleetobs")
+            assert fobs is not None
+            assert fobs.publisher.interval_s == 0.5
+            assert fobs.aggregator.cache_s == 0.25
+            slo = registry.get("slo")
+            assert slo.fleet_source is not None
+            # publication rides the heartbeat
+            plane.heartbeat_once()
+            time.sleep(0.6)
+            plane.heartbeat_once()
+            assert fobs.publisher.publishes >= 1
+            # hot-disable detaches and clears the fleet source
+            off = RouterConfig.from_dict({"observability": {"fleet": {
+                "enabled": False}}})
+            apply_observability_knobs(off, registry)
+            assert registry.get("fleetobs") is None
+            assert slo.fleet_source is None
+        finally:
+            _teardown_bootstrap(registry, plane)
+
+    def test_default_config_builds_nothing(self):
+        from semantic_router_tpu.config.schema import RouterConfig
+        from semantic_router_tpu.runtime.bootstrap import (
+            apply_observability_knobs,
+        )
+        from semantic_router_tpu.runtime.registry import RuntimeRegistry
+        from semantic_router_tpu.stateplane import build_backend
+
+        plane = StatePlane(build_backend({"backend": "memory"}),
+                           replica_id="boot-b")
+        registry = RuntimeRegistry.isolated(stateplane=plane)
+        try:
+            apply_observability_knobs(RouterConfig.from_dict({}),
+                                      registry)
+            assert registry.get("fleetobs") is None
+            assert "llm_fleet_" not in registry.metrics.expose()
+        finally:
+            _teardown_bootstrap(registry, plane)
+
+
+class TestAggregatorResilience:
+    def test_malformed_and_skewed_snapshots_skipped(self):
+        mem = InMemoryStateBackend()
+        g = GuardedBackend(mem)
+        plane = StatePlane(g, replica_id="r1", heartbeat_s=0.2)
+        plane.heartbeat_once()
+        reg = MetricsRegistry()
+        reg.counter("llm_y_total", "y").inc(1)
+        agg = FleetAggregator(plane, reg, cache_s=0.0)
+        # two live siblings: one garbage payload, one version skew
+        for rid, raw in (
+                ("bad-json", b"{nope"),
+                ("skewed", encode_snapshot(
+                    {"replica": "skewed", "ts_unix": 1.0,
+                     "snap": {"v": SNAPSHOT_VERSION + 1,
+                              "series": {}}}))):
+            mem.put(plane.key("replica", rid), b"{}", ttl_s=30)
+            mem.put(plane.key("obs", "metrics", rid), raw, ttl_s=30)
+        plane.heartbeat_once()
+        view = agg.collect(force=True)
+        assert view["scope"] == "fleet"
+        assert sorted(view["skipped"]) == ["bad-json", "skewed"]
+        assert set(view["replicas"]) == {"r1"}
+        assert view["registry"].find("llm_y_total").total() == 1.0
+        plane.close()

@@ -50,10 +50,10 @@ class TestGrafana:
         rc = main(["grafana", "--out-dir", str(tmp_path / "g")])
         assert rc == 0
         out = json.loads(capsys.readouterr().out)
-        # 10 curated dashboards (incl. Runtime & SLO, Decisions,
-        # Resilience, Flywheel, Upstreams, and Programs) + catalog
-        # + provider
-        assert len(out["rendered"]) == 12
+        # 11 curated dashboards (incl. Runtime & SLO, Decisions,
+        # Resilience, Flywheel, Upstreams, Programs, and Fleet)
+        # + catalog + provider
+        assert len(out["rendered"]) == 13
 
 
 class TestEmbedMap:
