@@ -5,6 +5,7 @@ SHELL := /bin/bash
 	resilience-smoke fleet-smoke fleetobs-smoke flywheel-smoke \
 	upstream-smoke \
 	packing-smoke kernels-smoke mesh-smoke cascade-smoke profile-smoke \
+	ann-smoke \
 	analyze native bench \
 	bench-replay perf perf-record perfgate perfgate-record serve-mock clean
 
@@ -145,6 +146,20 @@ mesh-smoke:
 cascade-smoke:
 	env JAX_PLATFORMS=cpu VSR_ANALYZE=1 $(PY) -m pytest \
 	  tests/test_cascade.py -q -p no:cacheprovider
+
+# on-device ANN gate (docs/ANN.md): sharded-vs-single-device top-k
+# bit-identity on the forced 8-device CPU mesh, int8/bf16 recall@10 ≥
+# 0.99 vs float32 brute force (+ the calibrated recall-gate fallback),
+# the exact sha256 path bypassing the bank, mirror gating (ONE
+# similarity interpretation point), host-tier promotion/eviction/
+# tombstone compaction, hot capacity/quant/mesh flips under concurrent
+# lookups with zero lost lookups, ann.enabled:false byte-identical,
+# and the knob wiring boot+reload+detach.  VSR_ANALYZE=1 arms the
+# lock-order witness + thread-leak gate over the maintenance thread
+# and lookup batcher.  Tier-1 (runs inside `make tier1` too).
+ann-smoke:
+	env JAX_PLATFORMS=cpu VSR_ANALYZE=1 $(PY) -m pytest \
+	  tests/test_ann.py -q -p no:cacheprovider
 
 # repo-native analysis gate (docs/ANALYSIS.md): the static lock-order
 # graph + cycle check, the shared-state race detector (Eraser-style

@@ -1480,6 +1480,79 @@ def _measure_cascade(platform: str) -> dict:
     return {"error": last_err[:300]}
 
 
+def _measure_ann(platform: str) -> dict:
+    """On-device ANN arm (docs/ANN.md, ISSUE 20 acceptance): per-lookup
+    p50/p99 + lookups/s at 10k / 100k / 1M entries across three serving
+    paths — the device-bank top-k program, the host-tier exact
+    argpartition scan, and the stateplane-mirror scan the bank replaces
+    (full ``matrix @ q`` + argsort per lookup, what
+    SharedSemanticCache's in-proc mirror does).  Honest note: on a CPU
+    fallback the "device" program runs on the same host cores as BLAS,
+    so CPU rows are a lower bound — the sharded matmul only pulls ahead
+    for real on an accelerator (the record's device_env says which this
+    was).  In-process and f32-only: quant recall policy is covered by
+    `make ann-smoke`, not timed here."""
+    import numpy as np
+
+    from semantic_router_tpu.ann import (DeviceBank, HostTier,
+                                         TopKPrograms, normalize_rows)
+
+    dim, k, n_lookups = 32, 8, 32
+    rng = np.random.default_rng(7)
+    queries = rng.standard_normal((n_lookups, dim)).astype(np.float32)
+
+    def timed(fn) -> dict:
+        lat = []
+        for i in range(n_lookups):
+            t0 = time.perf_counter()
+            fn(queries[i:i + 1])
+            lat.append(time.perf_counter() - t0)
+        lat.sort()
+        return {"p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+                "p99_ms": round(lat[min(len(lat) - 1,
+                                        int(len(lat) * 0.99))] * 1e3, 3),
+                "lookups_per_s": round(len(lat) / max(sum(lat), 1e-9),
+                                       1)}
+
+    programs = TopKPrograms()
+    sizes_out = {}
+    for n in (10_000, 100_000, 1_000_000):
+        corpus = rng.standard_normal((n, dim)).astype(np.float32)
+        ids = [f"e{i}" for i in range(n)]
+
+        bank = DeviceBank(dim=dim, min_capacity=1024,
+                          max_capacity=1 << 20)
+        bank.extend(ids, corpus)
+        view = bank.publish()
+        programs.run(view, queries[:1], k)  # compile off the clock
+
+        host = HostTier()
+        host.extend(ids, corpus)
+        host.scan(queries[0], k)  # cached matrix built off the clock
+
+        matrix = normalize_rows(corpus)
+
+        def scan_lookup(q, _m=matrix):
+            sims = _m @ normalize_rows(q)[0]
+            np.argsort(-sims)[:k]
+
+        sizes_out[str(n)] = {
+            "tier": view.tier,
+            "device_bank": timed(
+                lambda q, _v=view: programs.run(_v, q, k)),
+            "host_tier": timed(lambda q, _h=host: _h.scan(q[0], k)),
+            "stateplane_scan": timed(scan_lookup),
+        }
+        del corpus, matrix, bank, host, view  # bound peak RSS at 1M
+    programs.purge()
+    return {"dim": dim, "k": k, "lookups_per_size": n_lookups,
+            "sizes": sizes_out,
+            "note": ("CPU fallback: the device matmul shares host "
+                     "cores with BLAS — treat device_bank rows as a "
+                     "lower bound" if platform == "cpu"
+                     else "accelerator-resident bank")}
+
+
 def _clock_jit(fn, iters: int, *args):
     """Warm (one full compile+execute) then time: (ms_per_step, last
     output).  Shared by the kernel micro-arms; jax.device_get is the
@@ -2089,6 +2162,17 @@ def _run_bench(platform: str) -> None:
         sys.stderr.write(f"bench: cascade arm failed "
                          f"({type(exc).__name__}: {exc}); skipped\n")
 
+    # on-device ANN arm (docs/ANN.md, ISSUE 20 acceptance): lookup
+    # p50/p99 + lookups/s at 10k/100k/1M — device-bank program vs
+    # host-tier scan vs the stateplane-mirror scan it replaces
+    ann_row = None
+    try:
+        ann_row = _measure_ann(platform)
+        sys.stderr.write(f"bench: ann {ann_row}\n")
+    except Exception as exc:
+        sys.stderr.write(f"bench: ann arm failed "
+                         f"({type(exc).__name__}: {exc}); skipped\n")
+
     # the `make analyze` tier-1 gate's cost, kept visible in the BENCH
     # json (docs/ANALYSIS.md): per-checker wall time + finding counts —
     # the gate must stay cheap enough that nobody is tempted to skip it
@@ -2156,6 +2240,8 @@ def _run_bench(platform: str) -> None:
         record["mesh"] = mesh_row
     if cascade_row is not None:
         record["cascade"] = cascade_row
+    if ann_row is not None:
+        record["ann"] = ann_row
     if analyze_row is not None:
         record["analyze"] = analyze_row
     if platform != "cpu":

@@ -324,7 +324,14 @@ bool srt_init(const char* host, int port, const char* api_key) {
   g_port = port;
   g_api_key = api_key ? api_key : "";
   std::string resp;
+  // same transport-level retry as post_json: one transient refusal on
+  // a loaded host must not fail the whole init
   int status = http_request("GET", "/health", "", &resp);
+  if (status < 0) {
+    usleep(50 * 1000);
+    resp.clear();
+    status = http_request("GET", "/health", "", &resp);
+  }
   g_inited = (status == 200);
   return g_inited;
 }

@@ -34,7 +34,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from .findings import Finding
 
 DEFAULT_SUBDIRS = ("engine", "stateplane", "resilience", "flywheel",
-                   "observability")
+                   "observability", "ann")
 
 _LOCK_FACTORIES = ("Lock", "RLock", "Condition")
 

@@ -1,0 +1,29 @@
+"""TPU-native ANN plane (docs/ANN.md): semantic-cache similarity and
+RAG retrieval as a sharded on-device matmul — ``scores = Q @ bank.T``
++ ``lax.top_k`` as one more program in the serving bank, replacing the
+reference's CPU-side HNSW/Milvus/Qdrant round-trips (ROADMAP direction
+2; subsumes the Milvus/Qdrant StateBackend follow-on from PR 6)."""
+
+from .bank import DeviceBank, measure_recall, normalize_rows, tier_for
+from .knobs import BANK_MODES, normalize_ann
+from .plane import AnnIndex, AnnPlane
+from .search import AnnSearcher, TopKPrograms
+from .sync import VersionedRowSync, cache_index_sync
+from .tiering import HostTier, TierPolicy
+
+__all__ = [
+    "AnnIndex",
+    "AnnPlane",
+    "AnnSearcher",
+    "BANK_MODES",
+    "DeviceBank",
+    "HostTier",
+    "TierPolicy",
+    "TopKPrograms",
+    "VersionedRowSync",
+    "cache_index_sync",
+    "measure_recall",
+    "normalize_ann",
+    "normalize_rows",
+    "tier_for",
+]

@@ -1,0 +1,446 @@
+"""Device-resident embedding bank (docs/ANN.md "Capacity tiers").
+
+The host owns the authoritative float32 ``[N, D]`` store (plus the
+id↔slot maps and the tombstone mask); the device serves an immutable
+placed *view* of it — bank transposed to ``[D, tier]`` so a batched
+lookup is one ``Q @ bank_t`` riding the same closed jit-shape
+discipline as the engine's bucketed batches: capacities round up to a
+pow2 *tier*, so growing a bank walks a small ladder of compiled shapes
+instead of recompiling per add.
+
+Views follow the engine's hot-flip contract (docs/KERNELS.md,
+docs/PARALLEL.md): ``publish()`` builds a fresh ``_DeviceView`` off the
+hot lock and swaps it atomically; in-flight lookups finish on the
+snapshot they already read.  Quantized views (bf16/int8 via
+ops.quant) must clear a calibrated recall@10 gate against the float32
+reference before they publish — a bank whose geometry quantizes badly
+falls back to f32 and says so, it never silently serves bad recall.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+MIN_TIER = 16
+
+# Concurrent multi-device launches (sharded device_put / sharded
+# program steps) can deadlock XLA's CPU collective runtime when
+# several threads interleave them; every mesh-placed transfer and
+# sharded top-k step serializes on this one leaf lock.  Single-device
+# work never takes it.
+MESH_EXEC_LOCK = threading.Lock()
+
+
+def tier_for(n: int, min_capacity: int, max_capacity: int) -> int:
+    """Smallest pow2 capacity tier holding ``n`` rows (clamped)."""
+    t = max(int(min_capacity), MIN_TIER)
+    while t < n and t < max_capacity:
+        t <<= 1
+    return min(t, int(max_capacity))
+
+
+def normalize_rows(vecs: np.ndarray) -> np.ndarray:
+    """L2-normalize rows so scores are cosine similarities."""
+    vecs = np.asarray(vecs, dtype=np.float32)
+    if vecs.ndim == 1:
+        vecs = vecs[None, :]
+    norms = np.linalg.norm(vecs, axis=-1, keepdims=True)
+    return vecs / np.maximum(norms, 1e-12)
+
+
+class _DeviceView:
+    """Immutable placed snapshot — everything a lookup needs, so a hot
+    capacity/quant/mesh flip never mutates what an in-flight lookup
+    reads.  ``ids`` maps device slot → entry id (host-side tuple)."""
+
+    __slots__ = ("tier", "dim", "mode", "mesh", "mesh_sig", "ids",
+                 "bank_t", "qbank", "scale", "valid", "n_valid",
+                 "version", "recall", "quant_fallback")
+
+    def __init__(self, tier: int, dim: int, mode: str, mesh,
+                 mesh_sig, ids: Tuple[str, ...], bank_t, qbank, scale,
+                 valid, n_valid: int, version: int, recall: float,
+                 quant_fallback: bool) -> None:
+        self.tier = tier
+        self.dim = dim
+        self.mode = mode
+        self.mesh = mesh
+        self.mesh_sig = mesh_sig
+        self.ids = ids
+        self.bank_t = bank_t      # [D, tier] f32/bf16 (None in int8 mode)
+        self.qbank = qbank        # [D, tier] int8 (int8 mode only)
+        self.scale = scale        # [tier] f32 per-row scale (int8 only)
+        self.valid = valid        # [tier] bool — False = tombstone/pad
+        self.n_valid = n_valid
+        self.version = version
+        self.recall = recall
+        self.quant_fallback = quant_fallback
+
+
+def _emulate_int8_scores(q: np.ndarray, bank: np.ndarray) -> np.ndarray:
+    """Host-side oracle of the int8 device program (ops.quant layout,
+    per-row symmetric over the embedding axis): used by the calibration
+    gate, never on the lookup path."""
+    absmax = np.max(np.abs(bank), axis=1)
+    scale = np.maximum(absmax / 127.0, 1e-12)
+    qb = np.clip(np.round(bank / scale[:, None]), -127, 127)
+    return (q @ qb.T) * scale[None, :]
+
+
+def _emulate_bf16_scores(q: np.ndarray, bank: np.ndarray) -> np.ndarray:
+    """Host-side oracle of the bf16 device program: bf16 storage,
+    float32 accumulate (matching preferred_element_type)."""
+    import jax.numpy as jnp
+
+    qb = jnp.asarray(bank, jnp.bfloat16).astype(jnp.float32)
+    qq = jnp.asarray(q, jnp.bfloat16).astype(jnp.float32)
+    return np.asarray(qq @ qb.T, dtype=np.float32)
+
+
+def measure_recall(bank: np.ndarray, mode: str, k: int = 10,
+                   n_queries: int = 64, seed: int = 0) -> float:
+    """Calibrated recall@k of the quantized scoring path vs the float32
+    brute-force reference, probed with perturbed bank rows (the
+    query distribution a semantic cache actually sees: near-duplicates
+    of stored entries)."""
+    n = bank.shape[0]
+    if n == 0 or mode == "f32":
+        return 1.0
+    k = min(k, n)
+    rng = np.random.default_rng(seed)
+    idx = rng.choice(n, size=min(n_queries, n), replace=False)
+    queries = normalize_rows(
+        bank[idx] + 0.05 * rng.standard_normal((len(idx),
+                                                bank.shape[1])))
+    ref = np.argsort(-(queries @ bank.T), axis=1)[:, :k]
+    if mode == "int8":
+        approx_scores = _emulate_int8_scores(queries, bank)
+    else:
+        approx_scores = _emulate_bf16_scores(queries, bank)
+    approx = np.argsort(-approx_scores, axis=1)[:, :k]
+    hits = sum(len(set(r) & set(a)) for r, a in zip(ref, approx))
+    return hits / float(ref.size)
+
+
+class DeviceBank:
+    """Fixed-capacity device bank over a host-authoritative store."""
+
+    def __init__(self, dim: int = 0, min_capacity: int = 1024,
+                 max_capacity: int = 1 << 20, mode: str = "f32",
+                 mesh=None, recall_floor: float = 0.99,
+                 calibration_queries: int = 64,
+                 name: str = "bank") -> None:
+        self.name = name
+        self.dim = int(dim)
+        self.min_capacity = int(min_capacity)
+        self.max_capacity = int(max_capacity)
+        self.mode = mode
+        self.mesh = mesh
+        self.recall_floor = float(recall_floor)
+        self.calibration_queries = int(calibration_queries)
+        self._lock = threading.Lock()
+        self._vecs: Optional[np.ndarray] = None   # [alloc, D] f32
+        self._valid: Optional[np.ndarray] = None  # [alloc] bool
+        self._ids: List[Optional[str]] = []       # slot → id
+        self._id2slot: Dict[str, int] = {}
+        self._used = 0        # rows allocated (valid + tombstoned)
+        self._tombstones = 0
+        self._dirty = False
+        self._version = 0
+        self._view: Optional[_DeviceView] = None
+
+    # -- host-side mutation (callers publish() when ready) ------------------
+
+    def _ensure_alloc(self, dim: int, need: int) -> None:
+        if self._vecs is None:
+            self.dim = self.dim or dim
+            if dim != self.dim:
+                raise ValueError(f"ann bank {self.name!r}: dim {dim} != "
+                                 f"configured {self.dim}")
+            alloc = max(MIN_TIER, need)
+            self._vecs = np.zeros((alloc, self.dim), np.float32)
+            self._valid = np.zeros(alloc, bool)
+            return
+        if need > self._vecs.shape[0]:
+            alloc = max(need, self._vecs.shape[0] * 2)
+            grown = np.zeros((alloc, self.dim), np.float32)
+            grown[:self._used] = self._vecs[:self._used]
+            self._vecs = grown
+            v = np.zeros(alloc, bool)
+            v[:self._used] = self._valid[:self._used]
+            self._valid = v
+
+    def add(self, entry_id: str, vec: np.ndarray) -> bool:
+        """Insert/overwrite one row; False when the bank is at its max
+        capacity tier (the caller's host tier keeps the overflow)."""
+        row = normalize_rows(vec)[0]
+        with self._lock:
+            slot = self._id2slot.get(entry_id)
+            if slot is not None:
+                self._vecs[slot] = row
+                self._valid[slot] = True
+                self._dirty = True
+                return True
+            if len(self._id2slot) >= self.max_capacity:
+                return False
+            self._ensure_alloc(row.shape[0], self._used + 1)
+            slot = self._used
+            self._used += 1
+            self._vecs[slot] = row
+            self._valid[slot] = True
+            if slot < len(self._ids):
+                self._ids[slot] = entry_id
+            else:
+                self._ids.append(entry_id)
+            self._id2slot[entry_id] = slot
+            self._dirty = True
+            return True
+
+    def extend(self, ids: List[str], vecs: np.ndarray) -> int:
+        """Bulk insert (ingest/bench path): one normalize + one
+        allocation for the whole block instead of per-row add() calls;
+        ids already resident overwrite in place.  Returns the number of
+        NEW rows (capacity-capped — overflow stays with the caller)."""
+        rows = normalize_rows(vecs)
+        with self._lock:
+            fresh: List[int] = []
+            for i, entry_id in enumerate(ids):
+                slot = self._id2slot.get(entry_id)
+                if slot is not None:
+                    self._vecs[slot] = rows[i]
+                    self._valid[slot] = True
+                else:
+                    fresh.append(i)
+            room = self.max_capacity - len(self._id2slot)
+            fresh = fresh[:max(room, 0)]
+            if fresh:
+                self._ensure_alloc(rows.shape[1],
+                                   self._used + len(fresh))
+                base = self._used
+                self._vecs[base:base + len(fresh)] = rows[fresh]
+                self._valid[base:base + len(fresh)] = True
+                for j, i in enumerate(fresh):
+                    slot = base + j
+                    if slot < len(self._ids):
+                        self._ids[slot] = ids[i]
+                    else:
+                        self._ids.append(ids[i])
+                    self._id2slot[ids[i]] = slot
+                self._used = base + len(fresh)
+            self._dirty = True
+            return len(fresh)
+
+    def delete(self, entry_id: str) -> bool:
+        """Tombstone (valid=False): the slot is reclaimed by the next
+        ``compact()`` rewrite, not in place — the serving view's slot →
+        id map must stay frozen."""
+        with self._lock:
+            slot = self._id2slot.pop(entry_id, None)
+            if slot is None:
+                return False
+            self._valid[slot] = False
+            self._ids[slot] = None
+            self._tombstones += 1
+            self._dirty = True
+            return True
+
+    def compact(self) -> int:
+        """Rewrite the host store dropping tombstoned rows; returns the
+        number reclaimed.  The device view republishes on the next
+        ``publish()``."""
+        with self._lock:
+            if self._tombstones == 0:
+                return 0
+            keep = [s for s in range(self._used) if self._valid[s]]
+            vecs = self._vecs[keep].copy()
+            ids = [self._ids[s] for s in keep]
+            reclaimed = self._used - len(keep)
+            self._vecs[:len(keep)] = vecs
+            self._valid[:] = False
+            self._valid[:len(keep)] = True
+            self._ids = list(ids)
+            self._id2slot = {i: s for s, i in enumerate(ids)}
+            self._used = len(keep)
+            self._tombstones = 0
+            self._dirty = True
+            return reclaimed
+
+    # -- stats ---------------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._id2slot)
+
+    def __contains__(self, entry_id: str) -> bool:
+        with self._lock:
+            return entry_id in self._id2slot
+
+    def tombstone_ratio(self) -> float:
+        with self._lock:
+            return self._tombstones / self._used if self._used else 0.0
+
+    def dirty(self) -> bool:
+        with self._lock:
+            return self._dirty
+
+    def entry_ids(self) -> List[str]:
+        with self._lock:
+            return [i for i in self._ids[:self._used] if i is not None]
+
+    def get_vector(self, entry_id: str) -> Optional[np.ndarray]:
+        with self._lock:
+            slot = self._id2slot.get(entry_id)
+            if slot is None:
+                return None
+            return self._vecs[slot].copy()
+
+    # -- view publication ----------------------------------------------------
+
+    def view(self) -> Optional[_DeviceView]:
+        with self._lock:
+            return self._view
+
+    def configure(self, mode: Optional[str] = None, mesh=...,
+                  min_capacity: Optional[int] = None,
+                  max_capacity: Optional[int] = None) -> bool:
+        """Retune storage knobs; returns True when a republish is due.
+        ``mesh=...`` (ellipsis) means "leave unchanged"."""
+        changed = False
+        with self._lock:
+            if mode is not None and mode != self.mode:
+                self.mode = mode
+                changed = True
+            if mesh is not ... and mesh is not self.mesh:
+                self.mesh = mesh
+                changed = True
+            if min_capacity is not None \
+                    and int(min_capacity) != self.min_capacity:
+                self.min_capacity = int(min_capacity)
+                changed = True
+            if max_capacity is not None \
+                    and int(max_capacity) != self.max_capacity:
+                self.max_capacity = int(max_capacity)
+                changed = True
+            if changed:
+                self._dirty = True
+        return changed
+
+    def publish(self) -> Optional[_DeviceView]:
+        """Build + atomically swap a fresh device view of the current
+        host store.  Heavy work (quant gate, device transfer) runs off
+        the hot lock; lookups keep serving the previous snapshot until
+        the single reference swap at the end."""
+        import jax
+
+        with self._lock:
+            if self._vecs is None:
+                self._dirty = False
+                self._view = None
+                return None
+            n = self._used
+            dense = self._vecs[:n].copy()
+            valid_host = self._valid[:n].copy()
+            ids = tuple(self._ids[:n])
+            mode = self.mode
+            mesh = self.mesh
+            min_cap, max_cap = self.min_capacity, self.max_capacity
+            version = self._version + 1
+
+        tier = tier_for(n, min_cap, max_cap)
+        recall, fallback = 1.0, False
+        if mode in ("bf16", "int8"):
+            live = dense[valid_host]
+            recall = measure_recall(live, mode,
+                                    n_queries=self.calibration_queries)
+            if recall < self.recall_floor:
+                mode, fallback = "f32", True
+
+        bank = np.zeros((tier, dense.shape[1]), np.float32)
+        bank[:n] = dense
+        valid = np.zeros(tier, bool)
+        valid[:n] = valid_host
+
+        from ..engine.mesh import mesh_signature
+
+        sig = mesh_signature(mesh)
+        shardings = self._placements(mesh, tier, dense.shape[1])
+        bank_t = qbank = scale = None
+        guard = MESH_EXEC_LOCK if mesh is not None else \
+            contextlib.nullcontext()
+        with guard:
+            if mode == "int8":
+                absmax = np.max(np.abs(bank), axis=1)
+                scale_np = np.maximum(absmax / 127.0,
+                                      1e-12).astype(np.float32)
+                q_np = np.clip(np.round(bank / scale_np[:, None]),
+                               -127, 127).astype(np.int8)
+                qbank = jax.device_put(q_np.T.copy(),
+                                       shardings["bank_t"])
+                scale = jax.device_put(scale_np, shardings["rows"])
+            else:
+                import jax.numpy as jnp
+
+                host_t = bank.T.copy()
+                arr = jnp.asarray(host_t, jnp.bfloat16) \
+                    if mode == "bf16" else host_t
+                bank_t = jax.device_put(arr, shardings["bank_t"])
+            valid_dev = jax.device_put(valid, shardings["rows"])
+
+        view = _DeviceView(tier, dense.shape[1], mode, mesh, sig, ids,
+                           bank_t, qbank, scale, valid_dev,
+                           int(valid_host.sum()), version, recall,
+                           fallback)
+        with self._lock:
+            self._view = view
+            self._version = version
+            self._dirty = False
+        return view
+
+    @staticmethod
+    def _placements(mesh, tier: int, dim: int):
+        """Row-shard the bank over the flattened dp×tp device grid when
+        the tier divides evenly (the head_bank_specs contract: an axis
+        that does not divide replicates rather than erroring).  The
+        embedding axis D stays unsharded, so every score's D-reduction
+        is local to one device — that is WHY sharded top-k is
+        bit-identical to single-device (docs/ANN.md "Mesh sharding")."""
+        if mesh is None:
+            return {"bank_t": None, "rows": None}
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        n_dev = 1
+        for ax in ("dp", "tp"):
+            n_dev *= int(mesh.shape.get(ax, 1))
+        if n_dev <= 1 or tier % n_dev != 0:
+            return {"bank_t": NamedSharding(mesh, P(None, None)),
+                    "rows": NamedSharding(mesh, P(None))}
+        return {"bank_t": NamedSharding(mesh, P(None, ("dp", "tp"))),
+                "rows": NamedSharding(mesh, P(("dp", "tp")))}
+
+    def report(self) -> Dict[str, object]:
+        with self._lock:
+            view = self._view
+            out = {
+                "entries": len(self._id2slot),
+                "used_slots": self._used,
+                "tombstones": self._tombstones,
+                "dirty": self._dirty,
+                "mode": self.mode,
+                "tier": view.tier if view is not None else 0,
+                "view_version": view.version if view is not None else 0,
+                "view_mode": view.mode if view is not None else "none",
+                "quant_fallback": bool(view.quant_fallback)
+                if view is not None else False,
+                "recall": round(view.recall, 4)
+                if view is not None else 1.0,
+                "mesh": None,
+            }
+        from ..engine.mesh import mesh_axes
+
+        if view is not None and view.mesh is not None:
+            out["mesh"] = mesh_axes(view.mesh)
+        return out

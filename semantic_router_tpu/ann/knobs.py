@@ -1,0 +1,92 @@
+"""The ``ann`` config block's ONE interpretation point (docs/ANN.md).
+
+Same delegation contract as engine.mesh.normalize_mesh /
+engine.cascade.normalize_cascade: config.schema's ``ann_config()``
+accessor hands the raw dict here, every consumer reads the normalized
+shape, and defaults can never drift between bootstrap, tests, and the
+bench arm.  ``enabled: false`` (the default) must normalize to a block
+under which apply_ann_knobs constructs NOTHING.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+# device-bank storage modes (docs/ANN.md "Quantized banks"): f32 is the
+# parity oracle; bf16/int8 must clear the calibrated recall gate before
+# a view publishes quantized
+BANK_MODES = ("f32", "bf16", "int8")
+
+
+def _pow2_ceil(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def normalize_ann(d: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Raw ``ann:`` block → fully-defaulted knob dict."""
+    d = dict(d or {})
+
+    def _num(key: str, default: float, lo: float, hi: float) -> float:
+        try:
+            v = float(d.get(key, default))
+        except (TypeError, ValueError):
+            v = default
+        return min(max(v, lo), hi)
+
+    def _int(key: str, default: int, lo: int) -> int:
+        try:
+            v = int(d.get(key, default))
+        except (TypeError, ValueError):
+            v = default
+        return max(v, lo)
+
+    mode = str(d.get("quant", "f32")).lower()
+    if mode not in BANK_MODES:
+        mode = "f32"
+    min_cap = _pow2_ceil(_int("min_capacity", 1024, 16))
+    max_cap = _pow2_ceil(_int("max_capacity", 1 << 20, 16))
+    if max_cap < min_cap:
+        max_cap = min_cap
+
+    raw_batch = d.get("batch", {}) or {}
+    batch = {
+        "enabled": bool(raw_batch.get("enabled", False)),
+        "max_batch": max(1, int(raw_batch.get("max_batch", 32) or 32)),
+        "max_wait_ms": max(0.0, float(raw_batch.get("max_wait_ms", 0.5)
+                                      or 0.0)),
+    }
+
+    # dp×tp row-sharding of the device bank: same knob shape as
+    # engine.mesh (and normalized by it — ONE mesh interpretation point)
+    from ..engine.mesh import normalize_mesh
+
+    mesh = normalize_mesh(d.get("mesh", {}) or {})
+
+    raw_share = d.get("share", {}) or {}
+    share = {
+        "cache": bool(raw_share.get("cache", True)),
+        "vectorstore": bool(raw_share.get("vectorstore", True)),
+    }
+
+    return {
+        "enabled": bool(d.get("enabled", False)),
+        "dim": _int("dim", 0, 0),  # 0 = infer from the first add
+        "min_capacity": min_cap,
+        "max_capacity": max_cap,
+        "quant": mode,
+        "recall_floor": _num("recall_floor", 0.99, 0.0, 1.0),
+        "calibration_queries": _int("calibration_queries", 64, 4),
+        "top_k": _int("top_k", 8, 1),
+        "promote_ewma": _num("promote_ewma", 0.2, 0.01, 1.0),
+        "promote_min_hits": _num("promote_min_hits", 0.0, 0.0, 1e9),
+        "compact_interval_s": _num("compact_interval_s", 2.0, 0.05, 3600),
+        "tombstone_ratio": _num("tombstone_ratio", 0.25, 0.01, 1.0),
+        "evict_watermark": _num("evict_watermark", 0.9, 0.1, 1.0),
+        "sync_interval_s": _num("sync_interval_s", 2.0, 0.05, 3600),
+        "batch": batch,
+        "mesh": mesh,
+        "share": share,
+    }

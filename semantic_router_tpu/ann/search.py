@@ -1,0 +1,245 @@
+"""Batched top-k scoring programs (docs/ANN.md "Lookups as programs").
+
+One lookup is ``lax.top_k(Q @ bank_t, k)`` — a first-class program in
+the serving bank, not a library call: query batches pad to pow2 rows
+and k pads to pow2, so the compile cache is closed over
+``(tier, q_rows, k, mode, mesh_sig)``; each fresh compile registers
+with the program-cost catalog through the same ``note_compile`` seam
+as the engine's trunk groups, and every step samples into
+runtimestats — programstats/rooflines and /debug/runtime see ANN
+lookups exactly like classifier steps.
+
+Query batching piggybacks on the engine's ``DynamicBatcher``:
+concurrent cache probes coalesce into one device step (the runner
+resolves the bank view ONCE per batch, so every rider in a batch —
+and any in-flight batch during a hot flip — finishes on a single
+consistent snapshot).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..engine.batcher import DynamicBatcher, pow2_batch
+from ..engine.mesh import mesh_suffix
+from .bank import MESH_EXEC_LOCK, _DeviceView, normalize_rows
+
+MAX_QUERY_BATCH = 64
+
+
+def _pow2(n: int, cap: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return min(p, cap)
+
+
+class TopKPrograms:
+    """Compile cache for the scoring programs, keyed on the closed
+    shape set; owns the census hooks (note_compile + record_step)."""
+
+    def __init__(self, catalog=None, runtime_stats=None,
+                 step_observer: Optional[Callable[[float], None]] = None
+                 ) -> None:
+        self.catalog = catalog
+        self.runtime_stats = runtime_stats
+        self.step_observer = step_observer
+        self._programs: Dict[Tuple, Callable] = {}
+        self._lock = threading.Lock()
+
+    def _build(self, key: Tuple, view: _DeviceView, qb: int,
+               k: int) -> Callable:
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.quant import dequant_matmul
+
+        mode = view.mode
+
+        def score_topk(q, bank_t, scale, valid):
+            if mode == "int8":
+                scores = dequant_matmul(q, bank_t, scale,
+                                        compute_dtype=jnp.bfloat16)
+            elif mode == "bf16":
+                scores = jax.lax.dot_general(
+                    q.astype(jnp.bfloat16), bank_t,
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            else:
+                scores = jax.lax.dot_general(
+                    q, bank_t, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            scores = jnp.where(valid[None, :],
+                               scores.astype(jnp.float32), -jnp.inf)
+            return jax.lax.top_k(scores, k)
+
+        fn = jax.jit(score_topk)
+        if self.catalog is not None:
+            tier, _qb, _k, _mode, sig = key
+            bank_arr = view.qbank if mode == "int8" else view.bank_t
+            abstract = [
+                jax.ShapeDtypeStruct((qb, view.dim), jnp.float32),
+                jax.ShapeDtypeStruct(bank_arr.shape, bank_arr.dtype),
+                jax.ShapeDtypeStruct((tier,), jnp.float32),
+                jax.ShapeDtypeStruct((tier,), jnp.bool_),
+            ]
+
+            def lower():
+                return fn.lower(*abstract)
+
+            try:
+                self.catalog.note_compile(
+                    "ann", tier,
+                    f"topk:q{qb}:k{k}:{mode}{mesh_suffix(sig)}",
+                    (qb, view.dim), lower,
+                    quant=mode if mode != "f32" else "off",
+                    mesh=("x".join(str(s) for s in sig)
+                          if sig else "off"))
+            except Exception:
+                pass  # census is observability, never the lookup path
+        return fn
+
+    def run(self, view: _DeviceView, queries: np.ndarray, k: int
+            ) -> Tuple[np.ndarray, np.ndarray]:
+        """Score ``queries [nq, D]`` against the view; returns
+        (scores [nq, k'], slot indices [nq, k']) with k' = min(k, tier)
+        — padded query rows are sliced off before returning."""
+        import jax
+        import jax.numpy as jnp
+
+        nq = queries.shape[0]
+        qb = _pow2(nq, MAX_QUERY_BATCH) if nq <= MAX_QUERY_BATCH \
+            else nq  # oversize batches run unpadded (bench-scale only)
+        kk = min(_pow2(k, view.tier), view.tier)
+        key = (view.tier, qb, kk, view.mode, view.mesh_sig)
+        with self._lock:
+            fn = self._programs.get(key)
+            compiled = fn is None
+            if fn is None:
+                fn = self._build(key, view, qb, kk)
+                self._programs[key] = fn
+        qpad = np.zeros((qb, view.dim), np.float32)
+        qpad[:nq] = queries
+        bank_arr = view.qbank if view.mode == "int8" else view.bank_t
+        # Sharded steps serialize on the mesh execution lock (see
+        # bank.MESH_EXEC_LOCK): the sharded placement, the program
+        # launch, AND the device→host readback stay one critical
+        # section so no two multi-device launches interleave.
+        guard = MESH_EXEC_LOCK if view.mesh is not None else \
+            contextlib.nullcontext()
+        t0 = time.perf_counter()
+        with guard:
+            if view.mesh is not None:
+                from jax.sharding import NamedSharding, \
+                    PartitionSpec as P
+
+                qpad = jax.device_put(
+                    qpad, NamedSharding(view.mesh, P(None, None)))
+            scale = view.scale
+            if scale is None:
+                scale = jnp.ones((view.tier,), jnp.float32)
+                if view.mesh is not None:
+                    scale = jax.device_put(
+                        scale, NamedSharding(view.mesh, P(None)))
+            scores, idx = fn(qpad, bank_arr, scale, view.valid)
+            scores = np.asarray(scores)[:nq, :k]
+            idx = np.asarray(idx)[:nq, :k]
+        dt = time.perf_counter() - t0
+        if self.runtime_stats is not None:
+            try:
+                self.runtime_stats.record_step(
+                    "ann", view.tier,
+                    f"topk:q{qb}:k{kk}:{view.mode}"
+                    f"{mesh_suffix(view.mesh_sig)}",
+                    rows=nq, padded_rows=qb, seconds=dt,
+                    compiled=compiled)
+            except Exception:
+                pass
+        if self.step_observer is not None:
+            try:
+                self.step_observer(dt)
+            except Exception:
+                pass
+        return scores, idx
+
+    def purge(self) -> None:
+        with self._lock:
+            self._programs.clear()
+
+
+class AnnSearcher:
+    """Lookup front end: direct device steps, or coalesced through a
+    ``DynamicBatcher`` when ``ann.batch.enabled`` — concurrent probes
+    then amortize into one top-k program execution."""
+
+    def __init__(self, view_provider: Callable[[], Optional[_DeviceView]],
+                 programs: TopKPrograms, name: str = "ann") -> None:
+        self.view_provider = view_provider
+        self.programs = programs
+        self.name = name
+        self._batcher: Optional[DynamicBatcher] = None
+        self._lock = threading.Lock()
+
+    def configure_batching(self, knobs: Dict) -> None:
+        with self._lock:
+            old, self._batcher = self._batcher, None
+            if knobs.get("enabled"):
+                self._batcher = DynamicBatcher(
+                    self._run_batch,
+                    max_batch_size=int(knobs["max_batch"]),
+                    max_wait_ms=float(knobs["max_wait_ms"]),
+                    name=f"{self.name}-lookup", dispatch_workers=1)
+        if old is not None:
+            old.shutdown(timeout=2.0)
+
+    def _run_batch(self, group_key, items):
+        k = int(group_key)
+        view = self.view_provider()  # ONE snapshot for the whole batch
+        if view is None:
+            return [([], []) for _ in items]
+        queries = np.stack([normalize_rows(i.payload)[0]
+                            for i in items])
+        scores, idx = self.programs.run(view, queries, k)
+        return [self._resolve(view, scores[i], idx[i])
+                for i in range(len(items))]
+
+    @staticmethod
+    def _resolve(view: _DeviceView, scores: np.ndarray,
+                 idx: np.ndarray) -> Tuple[List[str], List[float]]:
+        ids: List[str] = []
+        out_scores: List[float] = []
+        for s, slot in zip(scores, idx):
+            if not np.isfinite(s):
+                continue  # -inf = tombstone/pad slot
+            entry_id = view.ids[slot] if slot < len(view.ids) else None
+            if entry_id is None:
+                continue
+            ids.append(entry_id)
+            out_scores.append(float(s))
+        return ids, out_scores
+
+    def search(self, query: np.ndarray, k: int
+               ) -> Tuple[List[str], List[float]]:
+        """Top-k (ids, scores) over the device bank; empty when no view
+        is published yet."""
+        with self._lock:
+            batcher = self._batcher
+        if batcher is not None:
+            return batcher.submit(k, np.asarray(query)).result(timeout=30)
+        view = self.view_provider()
+        if view is None:
+            return [], []
+        q = normalize_rows(query)
+        scores, idx = self.programs.run(view, q, k)
+        return self._resolve(view, scores[0], idx[0])
+
+    def close(self) -> None:
+        with self._lock:
+            batcher, self._batcher = self._batcher, None
+        if batcher is not None:
+            batcher.shutdown(timeout=2.0)

@@ -1170,6 +1170,16 @@ class RouterConfig:
     # {mode: off|shadow|auto, canary_fraction, canary_min_requests,
     # rollback_on: any|fast}, admission: {enabled, floor, ceiling}}
     flywheel: Dict[str, Any] = field(default_factory=dict)
+    # on-device ANN plane (ann/, docs/ANN.md): semantic-cache similarity
+    # + RAG retrieval as a sharded device matmul — {enabled, dim,
+    # min_capacity, max_capacity, quant: f32|bf16|int8, recall_floor,
+    # calibration_queries, top_k, promote_ewma, promote_min_hits,
+    # compact_interval_s, tombstone_ratio, evict_watermark,
+    # sync_interval_s, batch: {enabled, max_batch, max_wait_ms},
+    # mesh: {enabled, dp, tp}, share: {cache, vectorstore}} — raw block
+    # normalized by ann.normalize_ann, applied by apply_ann_knobs
+    # ({"enabled": false} default = byte-identical cache/vectorstore)
+    ann: Dict[str, Any] = field(default_factory=dict)
     # canonical v0.3 contract surface (canonical_config.go): named routing
     # profiles + virtual-model entrypoints + deployment listeners/providers
     recipes: List[RoutingRecipe] = field(default_factory=list)
@@ -1225,6 +1235,7 @@ class RouterConfig:
             resilience=dict(d.get("resilience", {}) or {}),
             stateplane=dict(d.get("stateplane", {}) or {}),
             flywheel=dict(d.get("flywheel", {}) or {}),
+            ann=dict(d.get("ann", {}) or {}),
             recipes=[RoutingRecipe.from_dict(r)
                      for r in d.get("recipes", []) or []],
             entrypoints=[Entrypoint.from_dict(e)
@@ -1586,6 +1597,14 @@ class RouterConfig:
                         for k in ("cache", "vectorstore", "explain",
                                   "fleet")}
         return out
+
+    def ann_config(self) -> Dict[str, Any]:
+        """Normalized ``ann`` block (docs/ANN.md knob table) — same
+        delegation pattern as mesh/cascade: ann.normalize_ann owns the
+        ONE interpretation point for the on-device ANN plane knobs."""
+        from ..ann.knobs import normalize_ann
+
+        return normalize_ann(self.ann)
 
     def flywheel_config(self) -> Dict[str, Any]:
         """Normalized ``flywheel`` block — the ONE interpretation point

@@ -608,6 +608,60 @@ def fleet() -> Dict:
                       p, tags=["fleet", "observability"])
 
 
+_ANN_MD = (
+    "**On-device ANN plane** (docs/ANN.md): semantic-cache similarity "
+    "and RAG retrieval served as a sharded on-device matmul — "
+    "`scores = Q @ bank.T` + `lax.top_k` over a device-resident "
+    "embedding bank in bucketed pow2 capacity tiers (bf16/int8 with a "
+    "calibrated recall-parity gate).  Overflow rides a host-RAM tier; "
+    "a background cycle promotes hot rows (EWMA), evicts cold ones "
+    "(LRU), and compacts tombstones.  `llm_ann_local_fallback` = 1 "
+    "means the state-plane sync degraded to local-only serving — "
+    "lookups keep answering from the resident bank."
+)
+
+
+def ann_dashboard() -> Dict:
+    """The "ANN" dashboard (ISSUE 20): bank fill and host-tier depth,
+    lookup rate by serving path, promotion/eviction churn, device
+    top-k step latency, sync fallback state."""
+    p = [
+        _stat("Bank fill (fullest index)",
+              "max(llm_ann_bank_fill)",
+              unit="percentunit", panel_id=1, x=0, y=0),
+        _stat("Host-tier entries",
+              "sum(llm_ann_host_entries)",
+              panel_id=2, x=6, y=0),
+        _stat("Lookups / s",
+              "sum(rate(llm_ann_lookups_total[5m])) or vector(0)",
+              panel_id=3, x=12, y=0),
+        _stat("Local-only fallback",
+              "max(llm_ann_local_fallback) or vector(0)",
+              panel_id=4, x=18, y=0),
+        _panel("Lookups by serving path",
+               ["sum(rate(llm_ann_lookups_total[5m])) "
+                "by (index, path)"],
+               panel_id=5, x=0, y=4, legends=["{{index}} {{path}}"]),
+        _panel("Device top-k step latency",
+               _hist_quantiles("llm_ann_topk_step_seconds"),
+               unit="s", panel_id=6, x=12, y=4,
+               legends=["p50", "p95", "p99"]),
+        _panel("Promotions / evictions",
+               ["sum(rate(llm_ann_promotions_total[5m])) by (index)",
+                "sum(rate(llm_ann_evictions_total[5m])) by (index)"],
+               panel_id=7, x=0, y=12,
+               legends=["promote {{index}}", "evict {{index}}"]),
+        _panel("Bank fill by index",
+               ["max(llm_ann_bank_fill) by (index)",
+                "max(llm_ann_host_entries) by (index)"],
+               panel_id=8, x=12, y=12,
+               legends=["fill {{index}}", "host {{index}}"]),
+        _text_panel("ANN plane", _ANN_MD, panel_id=9, x=0, y=20),
+    ]
+    return _dashboard("srt-ann", "Semantic Router — ANN Plane",
+                      p, tags=["ann", "retrieval"])
+
+
 def catalog(registry=None) -> Dict:
     """Auto-generated dashboard: one panel per registered series —
     anything new in the registry shows up here without template edits."""
@@ -666,6 +720,7 @@ def render_all(out_dir: str, registry=None) -> List[str]:
         "upstreams.json": upstreams(),
         "programs.json": programs(),
         "fleet.json": fleet(),
+        "ann.json": ann_dashboard(),
         "metric_catalog.json": catalog(registry),
     }
     for fname, dash in dashboards.items():

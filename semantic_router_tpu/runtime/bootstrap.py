@@ -1030,6 +1030,65 @@ def apply_cascade_knobs(cfg: RouterConfig, registry, router) -> None:
                         level="warning")
 
 
+def apply_ann_knobs(cfg: RouterConfig, registry, router) -> None:
+    """Attach/configure/detach the on-device ANN plane (ann/,
+    docs/ANN.md) for a registry + router pair.  Called at boot and on
+    config hot reload; ``ann.enabled: false`` (the default) constructs
+    NOTHING and detaches any previous plane — cache similarity and
+    vector-store search stay byte-identical.  Malformed ann config must
+    never stop the server."""
+    try:
+        ak = cfg.ann_config()
+        cache = getattr(router, "cache", None) \
+            if router is not None else None
+        vsm = getattr(router, "vectorstores", None) \
+            if router is not None else None
+        if not ak["enabled"]:
+            old = registry.get("ann")
+            if old is not None:
+                try:
+                    old.close()
+                except Exception:
+                    pass
+                registry.swap(ann=None)
+                component_event("bootstrap", "ann_detached")
+            if cache is not None and hasattr(cache, "detach_ann"):
+                cache.detach_ann()
+            if vsm is not None:
+                vsm.ann = None
+            return
+        from ..ann import AnnPlane
+
+        plane = registry.get("ann")
+        if plane is None:
+            plane = AnnPlane(registry.metrics,
+                             programstats=registry.get("programstats"),
+                             runtime_stats=registry.get("runtimestats"))
+            registry.swap(ann=plane)
+            component_event("bootstrap", "ann_attached")
+        plane.configure(ak)
+        # the semantic cache rides the "cache" index: similarity moves
+        # onto the device bank and the in-proc mirror gates OFF — ONE
+        # similarity interpretation point (cache.similarity_owner())
+        if cache is not None and hasattr(cache, "attach_ann"):
+            if ak["share"]["cache"]:
+                sp = getattr(router, "stateplane", None)
+                idx = plane.bind_cache_sync(sp) if sp is not None \
+                    else plane.index("cache")
+                cache.attach_ann(idx)
+            else:
+                cache.detach_ann()
+        if vsm is not None:
+            vsm.ann = plane if ak["share"]["vectorstore"] else None
+        component_event("bootstrap", "ann_configured",
+                        quant=ak["quant"],
+                        mesh=ak["mesh"]["enabled"])
+    except Exception as exc:
+        component_event("bootstrap", "ann_config_invalid",
+                        error=f"{type(exc).__name__}: {exc}"[:200],
+                        level="warning")
+
+
 def serve(config_path: str, port: int = 8801,
           default_backend: str = "", mock_models: bool = False,
           status_path: Optional[str] = None,
@@ -1117,6 +1176,9 @@ def serve(config_path: str, port: int = 8801,
     # upstream resilience plane: after the degradation controller and
     # state plane exist, so the retry gate and fleet share bind live
     apply_upstream_knobs(cfg, server.registry, router)
+    # on-device ANN plane: after the state plane + cache exist so the
+    # cache index can bind its fleet sync and gate the in-proc mirror
+    apply_ann_knobs(cfg, server.registry, router)
     # serving mesh (docs/PARALLEL.md): dp×tp placement of the trunk
     # groups — applied BEFORE packing/kernels so their packed-shape
     # warmups compile against the placed program sets
@@ -1171,6 +1233,7 @@ def serve(config_path: str, port: int = 8801,
             apply_flywheel_knobs(new_cfg, server.registry, new_router)
             apply_cascade_knobs(new_cfg, server.registry, new_router)
             apply_upstream_knobs(new_cfg, server.registry, new_router)
+            apply_ann_knobs(new_cfg, server.registry, new_router)
             apply_mesh_knobs(new_cfg, engine)
             apply_packing_knobs(new_cfg, engine)
             apply_kernel_knobs(new_cfg, engine)

@@ -54,7 +54,12 @@ _SLOTS = ("metrics", "tracer", "sessions", "profiler", "events",
           # CascadeEvaluator): empty unless engine.cascade.enabled —
           # built by bootstrap; registry-held so its skip counters and
           # warm-cost ordering survive router hot-reload swaps
-          "cascade")
+          "cascade",
+          # on-device ANN plane (ann.AnnPlane, docs/ANN.md): empty
+          # unless ann.enabled — built by apply_ann_knobs; registry-held
+          # so device banks and their maintenance thread survive router
+          # hot-reload swaps (in-flight lookups finish on their view)
+          "ann")
 
 
 class RuntimeRegistry:

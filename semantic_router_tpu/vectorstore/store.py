@@ -170,7 +170,7 @@ class VectorStoreManager:
                  backend: str = "memory",
                  base_path: Optional[str] = None,
                  backend_config: Optional[Dict] = None,
-                 registry=None, stateplane=None) -> None:
+                 registry=None, stateplane=None, ann=None) -> None:
         self.embed_fn = embed_fn
         self.backend = backend
         self.base_path = base_path
@@ -184,6 +184,10 @@ class VectorStoreManager:
         # plane (stateplane.SharedVectorStore) — rows ingested through
         # one replica retrieve on every replica
         self.stateplane = stateplane
+        # backend="ann": chunk vectors live on the device ANN plane
+        # (ann.AnnPlane, docs/ANN.md) — bootstrap's apply_ann_knobs
+        # sets this handle; None means fall back to in-memory stores
+        self.ann = ann
         self._stores: Dict[str, InMemoryVectorStore] = {}
         self._lock = threading.Lock()
         # serializes CREATE end-to-end (rare admin op; network I/O is
@@ -220,6 +224,21 @@ class VectorStoreManager:
         return self._llamastack
 
     def _new_store(self, name: str, **kwargs) -> InMemoryVectorStore:
+        if self.backend == "ann":
+            if self.ann is not None:
+                from .ann_store import AnnVectorStore
+
+                return AnnVectorStore(self.ann.index(f"vs:{name}"),
+                                      embed_fn=self.embed_fn, **kwargs)
+            # operator asked for the device bank but ann.enabled never
+            # attached a plane: serve in-memory rather than fail, and
+            # say so (the knob table documents this fallback)
+            from ..observability.logging import component_event
+
+            component_event("vectorstore", "ann_backend_fallback",
+                            level="warning", store=name,
+                            reason="no ANN plane attached; "
+                                   "using in-memory store")
         if self.backend == "stateplane" and self.stateplane is not None:
             from ..stateplane.vectorstore import SharedVectorStore
 
@@ -484,6 +503,16 @@ class VectorStoreManager:
                     return True
             except Exception:
                 pass
+        elif self.backend == "ann" and store is not None:
+            # tombstone every chunk the store indexed on the device bank
+            idx = getattr(store, "index", None)
+            if idx is not None:
+                try:
+                    for cid in idx.ids():
+                        idx.delete(cid)
+                    return True
+                except Exception:
+                    pass
         elif self.backend == "qdrant":
             prefix = self.backend_config.get("collection_prefix", "vsr-")
             try:

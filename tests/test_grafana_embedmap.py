@@ -50,10 +50,11 @@ class TestGrafana:
         rc = main(["grafana", "--out-dir", str(tmp_path / "g")])
         assert rc == 0
         out = json.loads(capsys.readouterr().out)
-        # 11 curated dashboards (incl. Runtime & SLO, Decisions,
-        # Resilience, Flywheel, Upstreams, Programs, and Fleet)
+        # 12 curated dashboards (incl. Runtime & SLO, Decisions,
+        # Resilience, Flywheel, Upstreams, Programs, Fleet, and ANN)
         # + catalog + provider
-        assert len(out["rendered"]) == 13
+        assert len(out["rendered"]) == 14
+        assert any(p.endswith("/ann.json") for p in out["rendered"])
 
 
 class TestEmbedMap:

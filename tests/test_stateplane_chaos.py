@@ -233,6 +233,124 @@ class TestFleetConvergence:
         assert _route(c, offline_q).kind == "cache_hit"
 
 
+class TestAnnChaos:
+    """ANN plane under backend loss (ISSUE 20 chaos satellite): the
+    MiniRedis dies MID-maintenance under a live device bank — lookups
+    keep serving with zero failures, the sync stamps local-only (report
+    + ``llm_ann_local_fallback``), and a restarted plane reconverges
+    the bank within one sync interval of breaker recovery."""
+
+    @pytest.fixture(scope="class")
+    def ann_stack(self):
+        from semantic_router_tpu.ann import AnnPlane, normalize_ann
+        from semantic_router_tpu.observability.metrics import (
+            MetricsRegistry,
+        )
+        from semantic_router_tpu.stateplane import (
+            GuardedBackend,
+            RespStateBackend,
+        )
+        from semantic_router_tpu.stateplane.cache import (
+            SharedSemanticCache,
+        )
+        from semantic_router_tpu.stateplane.harness import hash_embed
+
+        mini = MiniRedis().start()
+        port = mini.port
+        embed = hash_embed()
+        mk = lambda rid: StatePlane(
+            GuardedBackend(RespStateBackend(port=port), cooldown_s=0.2),
+            replica_id=rid, namespace="annchaos")
+        pa, pb = mk("ann-a"), mk("ann-b")
+        ca = SharedSemanticCache(pa, embed, similarity_threshold=0.6)
+        cb = SharedSemanticCache(pb, embed, similarity_threshold=0.6)
+        reg = MetricsRegistry()
+        ann = AnnPlane(reg)
+        ann.configure(normalize_ann({
+            "enabled": True, "sync_interval_s": 0.1,
+            "compact_interval_s": 0.1}))
+        cb.attach_ann(ann.bind_cache_sync(pb))  # maintenance thread up
+        stack = {"mini": mini, "port": port, "pa": pa, "pb": pb,
+                 "ca": ca, "cb": cb, "ann": ann, "reg": reg,
+                 "embed": embed, "idx": ann.index("cache")}
+        yield stack
+        ann.close()  # joins ann-maintain (VSR_ANALYZE thread gate)
+        pa.close()
+        pb.close()
+        stack["mini"].stop()
+
+    def test_1_fleet_writes_converge_into_the_bank(self, ann_stack):
+        ca, cb, idx = ann_stack["ca"], ann_stack["cb"], ann_stack["idx"]
+        assert cb.similarity_owner() == "ann"
+        for q, r in (("what does this indemnity clause cover", "i1"),
+                     ("how do i rotate the api credentials", "i2"),
+                     ("which model serves legal questions", "i3")):
+            ca.add(q, r)
+        # replica B's maintenance thread version-polls and adopts the
+        # sibling writes — no request-path scan anywhere
+        deadline = time.time() + 5.0
+        while time.time() < deadline and len(idx) < 3:
+            time.sleep(0.05)
+        assert len(idx) == 3
+        hit = cb.find_similar("what does this indemnity clause cover?")
+        assert hit is not None and hit.response == "i1"
+
+    def test_2_backend_killed_mid_maintenance_fails_open(self, ann_stack):
+        cb, idx, ann = ann_stack["cb"], ann_stack["idx"], ann_stack["ann"]
+        ann_stack["mini"].stop()
+        # the maintenance thread keeps cycling against the dead plane:
+        # within a breaker trip + one sync interval it stamps local-only
+        deadline = time.time() + 5.0
+        while time.time() < deadline and not (
+                idx.sync.local_only
+                and ann_stack["reg"].gauge(
+                    "llm_ann_local_fallback").values().get((), 0.0)):
+            time.sleep(0.05)
+        assert idx.report()["sync"]["local_only"] is True
+        assert ann_stack["reg"].gauge(
+            "llm_ann_local_fallback").values()[()] == 1.0
+        # zero lookup failures: the cache degrades to its local
+        # fallback, and the bank itself still answers direct lookups
+        # from device/host state — nothing raises, nothing hangs
+        for i in range(20):
+            assert cb.find_similar(f"an offline question {i}") is None
+        ids, scores = idx.lookup(
+            ann_stack["embed"]("which model serves legal questions"))
+        assert ids and scores[0] > 0.9
+
+    def test_3_restart_reconverges_within_a_sync_interval(self, ann_stack):
+        ca, cb, idx = ann_stack["ca"], ann_stack["cb"], ann_stack["idx"]
+        ann_stack["mini"] = MiniRedis(port=ann_stack["port"]).start()
+        offline_q = "a policy question asked while the plane was down"
+        # replica A's breaker probes on use; once it closes, the write
+        # lands on the plane and the exact path serves it again
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            ca.add(offline_q, "recovered answer")
+            if ca.find_similar(offline_q) is not None:
+                break
+            time.sleep(0.1)
+        assert ca.find_similar(offline_q) is not None
+        # replica B's sync recovers via its own breaker probe (driven by
+        # the maintenance thread), marks itself stale, and full-resyncs.
+        # The restarted MiniRedis came back EMPTY, so convergence means
+        # adopting the new entry AND retiring the three pre-kill ids —
+        # the store wins, the bank never serves rows the fleet lost.
+        deadline = time.time() + 10.0
+        while time.time() < deadline and (
+                len(idx) != 1 or ann_stack["reg"].gauge(
+                    "llm_ann_local_fallback").values().get((), 1.0)):
+            time.sleep(0.05)
+        assert len(idx) == 1
+        assert idx.sync.local_only is False
+        assert ann_stack["reg"].gauge(
+            "llm_ann_local_fallback").values()[()] == 0.0
+        hit = cb.find_similar(offline_q + "?")
+        assert hit is not None and hit.response == "recovered answer"
+        assert cb.find_similar(
+            "what does this indemnity clause cover?") is None
+
+
 class TestHTTPSurface:
     """/debug/stateplane + the external-metrics scaling endpoint over
     the real HTTP server."""
