@@ -283,6 +283,12 @@ class DeviceBank:
         with self._lock:
             return self._tombstones / self._used if self._used else 0.0
 
+    def used_slots(self) -> int:
+        """Allocated slots (live + tombstoned) — the quantity that must
+        fit a capacity tier, not the live-entry count ``len()``."""
+        with self._lock:
+            return self._used
+
     def dirty(self) -> bool:
         with self._lock:
             return self._dirty
@@ -337,6 +343,15 @@ class DeviceBank:
         import jax
 
         with self._lock:
+            # add() caps LIVE entries at max_capacity, but _used also
+            # counts tombstoned slots — delete + add churn at the max
+            # tier can push _used past every tier.  Reclaim before
+            # padding, or the [tier, D] bank cannot hold the snapshot.
+            over_tier = self._vecs is not None and self._used > tier_for(
+                self._used, self.min_capacity, self.max_capacity)
+        if over_tier:
+            self.compact()
+        with self._lock:
             if self._vecs is None:
                 self._dirty = False
                 self._view = None
@@ -351,6 +366,8 @@ class DeviceBank:
             version = self._version + 1
 
         tier = tier_for(n, min_cap, max_cap)
+        while tier < n:  # churn between compact and snapshot: cover n
+            tier <<= 1
         recall, fallback = 1.0, False
         if mode in ("bf16", "int8"):
             live = dense[valid_host]

@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..observability.logging import component_event
 from .bank import DeviceBank
 from .knobs import normalize_ann
 from .search import AnnSearcher, TopKPrograms
@@ -180,6 +181,8 @@ class AnnIndex:
 
     def close(self) -> None:
         self.searcher.close()
+        if self.sync is not None:
+            self.sync.close()
 
 
 class AnnPlane:
@@ -208,6 +211,11 @@ class AnnPlane:
             "llm_ann_local_fallback",
             "1 when an index's stateplane sync is degraded to "
             "local-only serving")
+        self.m_maint_failures = registry.counter(
+            "llm_ann_maintenance_failures_total",
+            "ANN maintenance-cycle crashes per index (caught and "
+            "retried next cycle — a climbing rate means compaction/"
+            "promotion/sync is persistently failing)")
         m_topk = registry.histogram(
             "llm_ann_topk_step_seconds",
             "Device top-k program step latency")
@@ -259,9 +267,11 @@ class AnnPlane:
         plane's cache keyspace — idempotent per plane."""
         idx = self.index("cache")
         if idx.sync is None or idx.sync.plane is not stateplane:
-            idx.sync = cache_index_sync(
+            old, idx.sync = idx.sync, cache_index_sync(
                 stateplane, idx,
                 interval_s=self.knobs["sync_interval_s"])
+            if old is not None:  # unhook the superseded sync's
+                old.close()      # recovery callback (no accumulation)
         return idx
 
     # -- maintenance thread --------------------------------------------------
@@ -281,21 +291,41 @@ class AnnPlane:
         while not self._stop.is_set():
             try:
                 self.maintain_once()
-            except Exception:
-                pass  # maintenance must never die; next cycle retries
+            except Exception as exc:
+                # maintenance must never die — but it must not fail
+                # invisibly either: stamp the counter + event so a
+                # persistently crashing cycle shows up on the dashboard
+                # instead of silently serving an ever-staler view
+                self._note_maintenance_failure("_plane", exc)
             with self._lock:
                 interval = self.knobs["compact_interval_s"]
             self._stop.wait(interval)
 
+    def _note_maintenance_failure(self, index: str,
+                                  exc: Exception) -> None:
+        try:
+            self.m_maint_failures.inc(1.0, index=index)
+            component_event("ann", "maintenance_failed", level="error",
+                            index=index, error=f"{type(exc).__name__}: "
+                                               f"{exc}")
+        except Exception:
+            pass  # observability never takes the maintenance loop down
+
     def maintain_once(self) -> Dict[str, Dict[str, int]]:
         """One maintenance pass over every index (also the test/bench
-        entry point for deterministic cycles)."""
+        entry point for deterministic cycles).  A crashing index stamps
+        the failure counter and does NOT starve the other indexes'
+        compaction/promotion/sync."""
         with self._lock:
             indexes = dict(self._indexes)
         out = {}
         fallback = 0.0
         for name, idx in indexes.items():
-            out[name] = idx.maintain()  # stamps per-index gauges
+            try:
+                out[name] = idx.maintain()  # stamps per-index gauges
+            except Exception as exc:
+                self._note_maintenance_failure(name, exc)
+                out[name] = {"failed": 1}
             if idx.sync is not None and idx.sync.local_only:
                 fallback = 1.0
         self.m_fallback.set(fallback)

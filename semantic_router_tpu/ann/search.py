@@ -230,7 +230,14 @@ class AnnSearcher:
         with self._lock:
             batcher = self._batcher
         if batcher is not None:
-            return batcher.submit(k, np.asarray(query)).result(timeout=30)
+            try:
+                return batcher.submit(
+                    k, np.asarray(query)).result(timeout=30)
+            except Exception:
+                # stalled/dead dispatch worker (or a step that blew up
+                # in flight): a missed ANN lookup degrades to a cache
+                # miss up the probe path, never an error
+                return [], []
         view = self.view_provider()
         if view is None:
             return [], []

@@ -45,7 +45,22 @@ class VersionedRowSync:
         self._lock = threading.Lock()
         self.local_only = False
         self.syncs = 0
+        self._closed = False
         self.backend.on_recover(self.mark_stale)
+
+    def close(self) -> None:
+        """Unhook from the backend's recovery list — a superseded sync
+        (rebind, index close) must not stay alive firing mark_stale on
+        every plane recovery."""
+        if self._closed:
+            return
+        self._closed = True
+        off = getattr(self.backend, "off_recover", None)
+        if off is not None:
+            try:
+                off(self.mark_stale)
+            except Exception:
+                pass
 
     @staticmethod
     def _default_extract(h: Dict[str, bytes]) -> Optional[np.ndarray]:

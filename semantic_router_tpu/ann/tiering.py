@@ -157,7 +157,11 @@ class TierPolicy:
         promoted = self._promote(ewma)
         evicted = self._evict(ewma)
         compacted = 0
-        if self.bank.tombstone_ratio() >= self.tombstone_ratio:
+        # compact on the ratio, but ALSO whenever delete + promote
+        # churn left more allocated slots than the max capacity tier
+        # can hold — publish() would otherwise have to reclaim inline
+        if self.bank.tombstone_ratio() >= self.tombstone_ratio \
+                or self.bank.used_slots() > self.bank.max_capacity:
             compacted = self.bank.compact()
         published = 0
         if self.bank.dirty():

@@ -646,11 +646,14 @@ def ann_dashboard() -> Dict:
                _hist_quantiles("llm_ann_topk_step_seconds"),
                unit="s", panel_id=6, x=12, y=4,
                legends=["p50", "p95", "p99"]),
-        _panel("Promotions / evictions",
+        _panel("Maintenance churn / failures",
                ["sum(rate(llm_ann_promotions_total[5m])) by (index)",
-                "sum(rate(llm_ann_evictions_total[5m])) by (index)"],
+                "sum(rate(llm_ann_evictions_total[5m])) by (index)",
+                "sum(rate(llm_ann_maintenance_failures_total[5m])) "
+                "by (index)"],
                panel_id=7, x=0, y=12,
-               legends=["promote {{index}}", "evict {{index}}"]),
+               legends=["promote {{index}}", "evict {{index}}",
+                        "FAILED {{index}}"]),
         _panel("Bank fill by index",
                ["max(llm_ann_bank_fill) by (index)",
                 "max(llm_ann_host_entries) by (index)"],

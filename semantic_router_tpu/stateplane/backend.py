@@ -392,6 +392,16 @@ class GuardedBackend:
         with self._lock:
             self._recover_cbs.append(fn)
 
+    def off_recover(self, fn: Callable[[], None]) -> None:
+        """Unregister a recovery callback (no-op when absent) — a
+        consumer that rebinds/closes must unhook, or superseded objects
+        stay alive and keep firing on every recovery."""
+        with self._lock:
+            try:
+                self._recover_cbs.remove(fn)
+            except ValueError:
+                pass
+
     def _admit(self) -> None:
         """Breaker gate: closed → go; open → fail fast, except ONE
         probe per cooldown window."""

@@ -250,10 +250,12 @@ class SharedSemanticCache:
             return self._local_find(query, threshold, category)
         if ann is not None:
             # ANN owns similarity (similarity_owner() == "ann"); any
-            # device-path failure degrades like a plane failure would
+            # device-path failure degrades like a plane failure would —
+            # a JAX runtime error mid hot-flip must cost a cache miss,
+            # never fail the request (the pre-ANN mirror path couldn't)
             try:
                 return self._ann_find(ann, query, thresh, category)
-            except StateBackendUnavailable:
+            except Exception:
                 self._stats.errors += 1
                 return self._local_find(query, threshold, category)
         with self._lock:

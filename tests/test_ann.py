@@ -150,6 +150,30 @@ class TestDeviceBank:
         with pytest.raises(ValueError):
             bank.add("b", np.ones(16, np.float32))
 
+    def test_publish_survives_tombstone_overflow_at_max_tier(self):
+        """Delete + add churn at the max tier: add() caps LIVE entries
+        but tombstoned slots keep counting, so allocated slots can
+        exceed every capacity tier — publish() must reclaim and serve
+        all live entries, not crash on the padded broadcast."""
+        bank = DeviceBank(min_capacity=16, max_capacity=32)
+        vecs = _corpus(36, seed=73)
+        ids = [f"o{i}" for i in range(36)]
+        bank.extend(ids[:32], vecs[:32])
+        bank.publish()
+        for eid in ("o1", "o2", "o3", "o4"):
+            bank.delete(eid)  # below the 0.25 compaction ratio
+        for i in range(32, 36):
+            assert bank.add(ids[i], vecs[i])  # 36 allocated > tier 32
+        view = bank.publish()
+        assert view is not None
+        assert view.tier == 32
+        assert view.n_valid == 32
+        assert len(bank) == 32
+        # the churned-in entries are findable on the fresh view
+        programs = TopKPrograms()
+        _scores, idx = programs.run(view, vecs[35:36], k=1)
+        assert view.ids[idx[0][0]] == "o35"
+
 
 class TestLookupParity:
     """Device program and host scan against the numpy oracle."""
@@ -357,6 +381,29 @@ class TestTiering:
         assert counts["published"] == 1
         assert bank.view().n_valid == 5
 
+    def test_run_cycle_forces_compaction_on_slot_overflow(self):
+        """Allocated slots past the max tier compact even below the
+        tombstone ratio, so the maintenance publish never has to
+        reclaim inline."""
+        bank = DeviceBank(min_capacity=16, max_capacity=32)
+        host = HostTier()
+        policy = TierPolicy(bank, host, tombstone_ratio=0.9,
+                            evict_watermark=2.0)
+        vecs = _corpus(34, seed=79)
+        bank.extend([f"ov{i}" for i in range(32)], vecs[:32])
+        bank.publish()
+        bank.delete("ov0")
+        bank.delete("ov1")  # 2/34 tombstones — far below the 0.9 ratio
+        for i in range(32, 34):
+            assert bank.add(f"ov{i}", vecs[i])
+        assert bank.used_slots() == 34
+        counts = policy.run_cycle()
+        assert counts["compacted"] == 2
+        assert counts["published"] == 1
+        assert bank.used_slots() == 32
+        assert bank.view().tier == 32
+        assert bank.view().n_valid == 32
+
     def test_index_retires_deleted_markers_after_compaction(self):
         idx = AnnIndex("retire", _knobs(min_capacity=16,
                                         tombstone_ratio=0.01),
@@ -404,6 +451,34 @@ class TestBatchingAndHotFlips:
         finally:
             direct.close()
             batched.close()  # joins the "<name>-lookup" batcher thread
+
+    def test_dead_batcher_degrades_to_cache_miss(self):
+        """A stalled/dead dispatch worker must cost a missed device
+        lookup, not an error up the cache-probe path — and the merged
+        index lookup still answers from the host tier."""
+        idx = AnnIndex(
+            "dead", _knobs(min_capacity=16,
+                           batch={"enabled": True, "max_batch": 8,
+                                  "max_wait_ms": 0.5}),
+            TopKPrograms())
+        try:
+            vecs = _corpus(4, seed=83)
+            idx.bank.extend([f"db{i}" for i in range(3)], vecs[:3])
+            idx.bank.publish()
+            idx.host.add("db3", vecs[3])
+
+            class _DeadFuture:
+                def result(self, timeout=None):
+                    raise TimeoutError("dispatch worker stalled")
+
+            idx.searcher._batcher.submit = \
+                lambda *a, **k: _DeadFuture()
+            assert idx.searcher.search(vecs[0], 2) == ([], [])
+            ids, scores = idx.lookup(vecs[3], k=2)
+            assert ids[0] == "db3"  # host scan still serves
+            assert scores[0] == pytest.approx(1.0, abs=1e-5)
+        finally:
+            idx.close()
 
     def test_hot_flips_lose_zero_lookups(self):
         """Capacity + quant flips republish the view atomically while
@@ -544,6 +619,35 @@ class TestCacheHandoff:
             idx.close()
             plane.close()
 
+    def test_device_path_failure_degrades_like_plane_failure(self):
+        """A JAX/device blow-up inside the ANN lookup (hot mesh/quant
+        flip mid-step) must degrade to a miss, exactly like a plane
+        failure — never propagate out of find_similar."""
+        plane, cache, _ = self._cache("annd")
+        idx = AnnIndex("cache", _knobs(), TopKPrograms())
+        try:
+            cache.attach_ann(idx)
+            cache.add("a query the device path will drop", "served")
+            errors_before = cache.stats().errors
+
+            def boom(*_a, **_k):
+                raise RuntimeError("XlaRuntimeError: device lost")
+
+            idx.lookup = boom
+            # near-duplicate query: exact sha256 path misses, the ANN
+            # path raises, and the probe degrades to a miss
+            hit = cache.find_similar(
+                "a query the device path will drop!!")
+            assert hit is None
+            assert cache.stats().errors == errors_before + 1
+            # exact hits never touch the bank and keep serving
+            hit = cache.find_similar(
+                "a query the device path will drop")
+            assert hit is not None and hit.response == "served"
+        finally:
+            idx.close()
+            plane.close()
+
     def test_invalidate_and_clear_reach_the_index(self):
         plane, cache, _ = self._cache("anni")
         idx = AnnIndex("cache", _knobs(), TopKPrograms())
@@ -587,6 +691,36 @@ class TestStateplaneSync:
             assert sync.report()["local_only"] is False
         finally:
             idx.close()
+            pa.close()
+            pb.close()
+
+    def test_rebind_unregisters_superseded_recovery_hook(self):
+        """Hot-reload churn rebinding the cache sync between planes
+        must not accumulate recovery callbacks (each one pins a
+        superseded sync object alive and refires on every recovery)."""
+        reg = MetricsRegistry()
+        annplane = AnnPlane(reg)
+        annplane.configure(_knobs(compact_interval_s=60))
+        be_a = GuardedBackend(InMemoryStateBackend())
+        be_b = GuardedBackend(InMemoryStateBackend())
+        pa = StatePlane(be_a, replica_id="rb-a", namespace="rb1")
+        pb = StatePlane(be_b, replica_id="rb-b", namespace="rb2")
+        n_a0, n_b0 = len(be_a._recover_cbs), len(be_b._recover_cbs)
+        try:
+            idx = annplane.bind_cache_sync(pa)
+            first = idx.sync
+            assert len(be_a._recover_cbs) == n_a0 + 1
+            for _ in range(5):
+                annplane.bind_cache_sync(pb)
+                annplane.bind_cache_sync(pa)
+            assert idx.sync is not first
+            # exactly ONE live hook on the bound plane, zero leftovers
+            # on the other — not 11 accumulated callbacks
+            assert len(be_a._recover_cbs) == n_a0 + 1
+            assert len(be_b._recover_cbs) == n_b0
+        finally:
+            annplane.close()  # index close unhooks the last sync
+            assert len(be_a._recover_cbs) == n_a0
             pa.close()
             pb.close()
 
@@ -776,5 +910,32 @@ class TestMetricsSurface:
             fill = reg.gauge("llm_ann_bank_fill").values()
             assert fill[(("index", "m"),)] == pytest.approx(4 / 16)
             assert reg.gauge("llm_ann_local_fallback").values()[()] == 0.0
+        finally:
+            plane.close()
+
+    def test_maintenance_failure_is_counted_not_swallowed(self):
+        """A crashing index stamps llm_ann_maintenance_failures_total
+        and does not starve the other indexes' maintenance."""
+        reg = MetricsRegistry()
+        plane = AnnPlane(reg)
+        # keep the maintenance thread out of this test: cycles run
+        # ONLY through the explicit maintain_once call below, so the
+        # failure counter assertions are deterministic
+        plane._closed = True
+        plane.configure(_knobs(min_capacity=16, compact_interval_s=60))
+        good, bad = plane.index("good"), plane.index("bad")
+        try:
+            good.add("g0", _corpus(1, seed=89)[0])
+
+            def _boom():
+                raise RuntimeError("compaction blew up")
+
+            bad.maintain = _boom
+            out = plane.maintain_once()  # must not raise
+            assert out["bad"] == {"failed": 1}
+            assert out["good"]["published"] == 1  # not starved
+            vals = reg.counter(
+                "llm_ann_maintenance_failures_total").values()
+            assert vals[(("index", "bad"),)] == 1.0
         finally:
             plane.close()
